@@ -1,0 +1,12 @@
+"""seaweedfs_tpu: a from-scratch, TPU-native distributed object store.
+
+Haystack-style hot storage + f4-style erasure-coded warm storage with the
+capabilities of SeaweedFS (master / volume servers / filer / S3 / admin shell /
+benchmark), built so the warm-storage compute hot paths — Reed-Solomon RS(10,4)
+GF(2^8) erasure coding and bulk needle-index lookups — run on TPU via JAX/Pallas.
+
+On-disk formats (.dat/.idx/.ecx/.ecj/.ec00-13) are byte-compatible with the
+reference implementation (see SURVEY.md; citations into /root/reference).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,58 @@
+"""File id = (volume id, needle key, cookie) with the reference string format
+``{vid},{key_hex}{cookie_hex8}`` where leading zero bytes of the 12-byte
+key+cookie buffer are trimmed (ref: weed/storage/needle/file_id.go:63-73).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..types import (
+    COOKIE_SIZE,
+    NEEDLE_ID_SIZE,
+    cookie_to_bytes,
+    needle_id_to_bytes,
+)
+
+
+def parse_volume_id(s: str) -> int:
+    """Volume id string -> int; ignores anything after non-digits
+    (ref: weed/storage/needle/volume_id.go NewVolumeId uses ParseUint)."""
+    return int(s)
+
+
+def format_needle_id_cookie(key: int, cookie: int) -> str:
+    buf = needle_id_to_bytes(key) + cookie_to_bytes(cookie)
+    nonzero = 0
+    while nonzero < len(buf) - 1 and buf[nonzero] == 0:
+        nonzero += 1
+    return buf[nonzero:].hex()
+
+
+def parse_needle_id_cookie(s: str) -> tuple[int, int]:
+    """Reverse of format_needle_id_cookie: last 8 hex chars are the cookie."""
+    if len(s) <= 8:
+        raise ValueError(f"needle id+cookie too short: {s!r}")
+    # strip any url-style suffix like ".jpg" the reference tolerates upstream
+    key = int(s[:-8], 16)
+    cookie = int(s[-8:], 16)
+    return key, cookie
+
+
+@dataclass(frozen=True)
+class FileId:
+    volume_id: int
+    key: int
+    cookie: int
+
+    @staticmethod
+    def parse(fid: str) -> "FileId":
+        comma = fid.find(",")
+        if comma <= 0:
+            raise ValueError(f"wrong fid format: {fid!r}")
+        vid = parse_volume_id(fid[:comma])
+        key, cookie = parse_needle_id_cookie(fid[comma + 1 :])
+        return FileId(volume_id=vid, key=key, cookie=cookie)
+
+    def __str__(self) -> str:
+        return f"{self.volume_id},{format_needle_id_cookie(self.key, self.cookie)}"

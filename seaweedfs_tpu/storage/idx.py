@@ -1,0 +1,79 @@
+"""Needle-map index (.idx/.ecx) entry codec and walker.
+
+16-byte entries: key u64 BE | offset u32 BE (in 8-byte units) | size u32 BE
+(ref: weed/storage/idx/walk.go:13-53, weed/storage/types/needle_types.go:27).
+
+Also provides vectorized numpy parse of a whole index file — the TPU-first
+path used to build index snapshots for the bulk-lookup kernel.
+"""
+
+from __future__ import annotations
+
+from typing import BinaryIO, Callable, Iterator
+
+import numpy as np
+
+from ..types import (
+    NEEDLE_MAP_ENTRY_SIZE,
+    bytes_to_u32,
+    bytes_to_u64,
+    offset_to_bytes,
+    u32_to_bytes,
+    u64_to_bytes,
+)
+
+ROW_BATCH = 1024 * 1024  # entries per read batch when walking
+
+
+def entry_to_bytes(key: int, offset_units: int, size: int) -> bytes:
+    return u64_to_bytes(key) + offset_to_bytes(offset_units) + u32_to_bytes(size)
+
+
+def parse_entry(b: bytes) -> tuple[int, int, int]:
+    """-> (key, offset_units, size)"""
+    return bytes_to_u64(b[0:8]), bytes_to_u32(b[8:12]), bytes_to_u32(b[12:16])
+
+
+def iter_index(f: BinaryIO) -> Iterator[tuple[int, int, int]]:
+    """Iterate (key, offset_units, size) over an open .idx stream."""
+    while True:
+        chunk = f.read(NEEDLE_MAP_ENTRY_SIZE * ROW_BATCH)
+        if not chunk:
+            return
+        usable = len(chunk) - (len(chunk) % NEEDLE_MAP_ENTRY_SIZE)
+        for i in range(0, usable, NEEDLE_MAP_ENTRY_SIZE):
+            yield parse_entry(chunk[i : i + NEEDLE_MAP_ENTRY_SIZE])
+        if usable != len(chunk):
+            return
+
+
+def walk_index_file(
+    f: BinaryIO, fn: Callable[[int, int, int], None]
+) -> None:
+    """Ref WalkIndexFile: calls fn(key, offset_units, size) per entry."""
+    for key, offset_units, size in iter_index(f):
+        fn(key, offset_units, size)
+
+
+def parse_index_bytes(data: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorized parse: -> (keys u64[n], offset_units u32[n], sizes u32[n])."""
+    n = len(data) // NEEDLE_MAP_ENTRY_SIZE
+    arr = np.frombuffer(data[: n * NEEDLE_MAP_ENTRY_SIZE], dtype=np.uint8).reshape(
+        n, NEEDLE_MAP_ENTRY_SIZE
+    )
+    keys = arr[:, 0:8].copy().view(">u8").reshape(n).astype(np.uint64)
+    offsets = arr[:, 8:12].copy().view(">u4").reshape(n).astype(np.uint32)
+    sizes = arr[:, 12:16].copy().view(">u4").reshape(n).astype(np.uint32)
+    return keys, offsets, sizes
+
+
+def entries_to_bytes(
+    keys: np.ndarray, offset_units: np.ndarray, sizes: np.ndarray
+) -> bytes:
+    """Vectorized serialize of index entries (inverse of parse_index_bytes)."""
+    n = len(keys)
+    arr = np.empty((n, NEEDLE_MAP_ENTRY_SIZE), dtype=np.uint8)
+    arr[:, 0:8] = np.ascontiguousarray(keys, dtype=">u8").view(np.uint8).reshape(n, 8)
+    arr[:, 8:12] = np.ascontiguousarray(offset_units, dtype=">u4").view(np.uint8).reshape(n, 4)
+    arr[:, 12:16] = np.ascontiguousarray(sizes, dtype=">u4").view(np.uint8).reshape(n, 4)
+    return arr.tobytes()

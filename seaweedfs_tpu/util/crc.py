@@ -1,0 +1,58 @@
+"""Masked CRC32-Castagnoli needle checksum.
+
+The reference computes CRC32C over the needle data and stores a *masked* value:
+``value = rotr15(crc) + 0xa282ead8 (mod 2^32)``
+(ref: weed/storage/needle/crc.go:12-25 — klauspost/crc32 Castagnoli table,
+Value() = (c>>15 | c<<17) + 0xa282ead8).
+
+Uses the C-accelerated google-crc32c when present, with a pure-Python
+table fallback so the package has no hard native dependency.
+"""
+
+from __future__ import annotations
+
+try:
+    import google_crc32c as _gcrc
+
+    def crc32c(data: bytes, init: int = 0) -> int:
+        return _gcrc.extend(init, data)
+
+except ImportError:  # pragma: no cover - fallback path
+    _POLY = 0x82F63B78  # reversed Castagnoli
+    _TABLE = []
+    for _i in range(256):
+        _c = _i
+        for _ in range(8):
+            _c = (_c >> 1) ^ _POLY if _c & 1 else _c >> 1
+        _TABLE.append(_c)
+
+    def crc32c(data: bytes, init: int = 0) -> int:
+        c = init ^ 0xFFFFFFFF
+        for b in data:
+            c = _TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
+        return c ^ 0xFFFFFFFF
+
+
+class CRC:
+    """Incremental CRC mirroring the reference's needle.CRC type."""
+
+    __slots__ = ("raw",)
+
+    def __init__(self, raw: int = 0):
+        self.raw = raw & 0xFFFFFFFF
+
+    def update(self, data: bytes) -> "CRC":
+        return CRC(crc32c(data, self.raw))
+
+    def value(self) -> int:
+        """Masked checksum as stored on disk (ref: crc.go:23-25)."""
+        c = self.raw
+        return (((c >> 15) | (c << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def new_crc(data: bytes) -> CRC:
+    return CRC(0).update(data)
+
+
+def masked_crc(data: bytes) -> int:
+    return new_crc(data).value()

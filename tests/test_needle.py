@@ -1,0 +1,134 @@
+import os
+
+import pytest
+
+from seaweedfs_tpu.types import (
+    CURRENT_VERSION,
+    NEEDLE_HEADER_SIZE,
+    VERSION1,
+    VERSION2,
+    VERSION3,
+)
+from seaweedfs_tpu.storage.backend import MemoryFile
+from seaweedfs_tpu.storage.needle import (
+    CrcError,
+    Needle,
+    get_actual_size,
+    needle_body_length,
+    padding_length,
+    read_needle_data,
+    read_needle_header,
+)
+from seaweedfs_tpu.storage.ttl import TTL
+
+from conftest import REFERENCE_ROOT, reference_available
+
+
+def roundtrip(n: Needle, version: int) -> Needle:
+    blob, size_for_index, actual = n.to_bytes(version)
+    assert len(blob) == actual
+    assert actual % 8 == 0
+    m = Needle()
+    m.read_bytes(blob, 0, n.size, version)
+    return m
+
+
+def test_padding_never_zero():
+    # the reference pads 1..8 bytes, never 0 (needle_read_write.go:291-297)
+    for size in range(0, 64):
+        for v in (VERSION1, VERSION2, VERSION3):
+            p = padding_length(size, v)
+            assert 1 <= p <= 8
+            assert (NEEDLE_HEADER_SIZE + needle_body_length(size, v)) % 8 == 0
+
+
+def test_roundtrip_v1():
+    n = Needle(cookie=0x1234, id=42, data=b"hello world")
+    m = roundtrip(n, VERSION1)
+    assert m.data == b"hello world"
+    assert m.id == 42
+    assert m.cookie == 0x1234
+
+
+@pytest.mark.parametrize("version", [VERSION2, VERSION3])
+def test_roundtrip_v2_v3_full(version):
+    n = Needle(cookie=0xABCD, id=7)
+    n.data = os.urandom(1000)
+    n.set_name(b"file.txt")
+    n.set_mime(b"text/plain")
+    n.set_last_modified(1234567890)
+    n.set_ttl(TTL.read("3h"))
+    n.set_pairs(b'{"Seaweed-k":"v"}')
+    if version == VERSION3:
+        n.append_at_ns = 987654321012345678
+    m = roundtrip(n, version)
+    assert m.data == n.data
+    assert m.name == b"file.txt"
+    assert m.mime == b"text/plain"
+    assert m.last_modified == 1234567890
+    assert m.ttl == TTL.read("3h")
+    assert m.pairs == b'{"Seaweed-k":"v"}'
+    if version == VERSION3:
+        assert m.append_at_ns == 987654321012345678
+
+
+def test_roundtrip_empty_data():
+    n = Needle(cookie=1, id=2)
+    blob, size_for_index, actual = n.to_bytes(CURRENT_VERSION)
+    assert n.size == 0
+    m = Needle()
+    m.read_bytes(blob, 0, 0, CURRENT_VERSION)
+    assert m.data == b""
+
+
+def test_crc_detects_corruption():
+    n = Needle(cookie=1, id=2, data=b"payload-bytes")
+    blob, _, _ = n.to_bytes(VERSION3)
+    corrupted = bytearray(blob)
+    corrupted[NEEDLE_HEADER_SIZE + 4 + 2] ^= 0xFF  # +4 skips the data_size field
+    m = Needle()
+    with pytest.raises(CrcError):
+        m.read_bytes(bytes(corrupted), 0, n.size, VERSION3)
+
+
+def test_read_from_backend_file():
+    f = MemoryFile()
+    n = Needle(cookie=9, id=77, data=b"x" * 300)
+    n.set_name(b"a.bin")
+    blob, _, actual = n.to_bytes(VERSION3)
+    off = f.append(blob)
+    got = read_needle_data(f, off, n.size, VERSION3)
+    assert got.data == n.data
+    hdr, body_len = read_needle_header(f, VERSION3, off)
+    assert hdr.id == 77
+    assert NEEDLE_HEADER_SIZE + body_len == actual
+
+
+FIXTURE_BASE = os.path.join(REFERENCE_ROOT, "weed/storage/erasure_coding/1")
+
+
+@pytest.mark.skipif(
+    not reference_available() or not os.path.exists(FIXTURE_BASE + ".dat"),
+    reason="reference fixtures not present",
+)
+def test_reference_fixture_parity():
+    """Read every needle of the reference's checked-in volume fixture through
+    our parser, using its .idx entries as ground truth."""
+    from seaweedfs_tpu.storage.backend import DiskFile
+    from seaweedfs_tpu.storage.idx import iter_index
+    from seaweedfs_tpu.storage.super_block import read_super_block
+    from seaweedfs_tpu.types import TOMBSTONE_FILE_SIZE, to_actual_offset
+
+    dat = DiskFile(FIXTURE_BASE + ".dat", create=False, read_only=True)
+    sb = read_super_block(dat)
+    assert sb.version in (1, 2, 3)
+    count = 0
+    with open(FIXTURE_BASE + ".idx", "rb") as idxf:
+        for key, offset_units, size in iter_index(idxf):
+            if size == TOMBSTONE_FILE_SIZE or offset_units == 0:
+                continue
+            n = read_needle_data(dat, to_actual_offset(offset_units), size, sb.version)
+            assert n.id == key
+            count += 1
+    assert count > 0
+    dat.close()

@@ -1,0 +1,74 @@
+import pytest
+
+from seaweedfs_tpu import types as t
+from seaweedfs_tpu.storage.file_id import FileId, format_needle_id_cookie
+from seaweedfs_tpu.storage.ttl import TTL, EMPTY_TTL
+from seaweedfs_tpu.util.crc import CRC, masked_crc
+
+
+def test_endian_codecs():
+    assert t.u64_to_bytes(0x0102030405060708) == bytes(range(1, 9))
+    assert t.bytes_to_u64(bytes(range(1, 9))) == 0x0102030405060708
+    assert t.u32_to_bytes(0xDEADBEEF) == b"\xde\xad\xbe\xef"
+    assert t.bytes_to_u32(b"\xde\xad\xbe\xef") == 0xDEADBEEF
+    assert t.u16_to_bytes(0x0102) == b"\x01\x02"
+    assert t.bytes_to_u16(b"\x01\x02") == 0x0102
+
+
+def test_offset_units_roundtrip():
+    for actual in [0, 8, 16, 1024, t.MAX_POSSIBLE_VOLUME_SIZE - 8]:
+        units = t.to_offset_units(actual)
+        b = t.offset_to_bytes(units)
+        assert len(b) == t.OFFSET_SIZE
+        assert t.to_actual_offset(t.bytes_to_offset(b)) == actual
+
+
+def test_constants_match_reference():
+    # ref: weed/storage/types/needle_types.go:24-32
+    assert t.NEEDLE_HEADER_SIZE == 16
+    assert t.NEEDLE_MAP_ENTRY_SIZE == 16
+    assert t.NEEDLE_PADDING_SIZE == 8
+    assert t.TOMBSTONE_FILE_SIZE == 0xFFFFFFFF
+    assert t.MAX_POSSIBLE_VOLUME_SIZE == 32 * 1024**3
+
+
+def test_crc_masked_known_value():
+    # CRC32C("123456789") = 0xE3069283; masked per crc.go Value()
+    raw = 0xE3069283
+    assert CRC(raw).raw == raw
+    expected = (((raw >> 15) | (raw << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+    assert masked_crc(b"123456789") == expected
+
+
+def test_crc_incremental():
+    whole = CRC(0).update(b"hello world")
+    parts = CRC(0).update(b"hello ").update(b"world")
+    assert whole.raw == parts.raw
+
+
+def test_ttl_roundtrip():
+    for s in ["3m", "4h", "5d", "6w", "7M", "8y", "90"]:
+        ttl = TTL.read(s)
+        assert TTL.from_bytes(ttl.to_bytes()) == ttl
+        assert TTL.from_u32(ttl.to_u32()) == ttl
+    assert TTL.read("") is EMPTY_TTL
+    assert TTL.read("90") == TTL(count=90, unit=1)
+    assert str(TTL.read("3m")) == "3m"
+    assert TTL.from_bytes(b"\x00\x00") is EMPTY_TTL
+
+
+def test_file_id_format():
+    # leading zero bytes trimmed (ref file_id.go:63-73)
+    assert format_needle_id_cookie(1, 0x12345678) == "0112345678"
+    fid = FileId(volume_id=3, key=0x1234, cookie=0xABCD1234)
+    s = str(fid)
+    assert s.startswith("3,")
+    parsed = FileId.parse(s)
+    assert parsed == fid
+
+
+def test_file_id_parse_errors():
+    with pytest.raises(ValueError):
+        FileId.parse("no-comma")
+    with pytest.raises(ValueError):
+        FileId.parse(",123")
