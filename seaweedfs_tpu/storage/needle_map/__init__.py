@@ -1,0 +1,24 @@
+"""Needle maps: fid -> (offset, size) indexes.
+
+The mapper contract follows the reference's NeedleMapper interface
+(ref: weed/storage/needle_map.go:21-34): Put/Get/Delete/AscendingVisit plus
+metrics. Implementations here are designed TPU-first: every map can emit a
+sorted-array snapshot (numpy u32 columns) consumed by the vectorized
+bulk-lookup kernel in ops/index_kernel.py.
+"""
+
+from .needle_value import NeedleValue
+from .compact_map import CompactMap
+from .memdb import MemDb
+from .metric import MapMetric
+from .mapper import NeedleMap, new_needle_map, load_needle_map
+
+__all__ = [
+    "NeedleValue",
+    "CompactMap",
+    "MemDb",
+    "MapMetric",
+    "NeedleMap",
+    "new_needle_map",
+    "load_needle_map",
+]

@@ -1,0 +1,144 @@
+import os
+import random
+
+import numpy as np
+
+from seaweedfs_tpu.types import TOMBSTONE_FILE_SIZE
+from seaweedfs_tpu.storage.idx import parse_index_bytes, entries_to_bytes
+from seaweedfs_tpu.storage.needle_map import (
+    CompactMap,
+    MemDb,
+    load_needle_map,
+    new_needle_map,
+)
+
+
+def test_compact_map_set_get_delete():
+    cm = CompactMap()
+    old = cm.set(7, 100, 50)
+    assert old == (0, 0)
+    old = cm.set(7, 200, 60)
+    assert old == (100, 50)
+    nv = cm.get(7)
+    assert (nv.offset_units, nv.size) == (200, 60)
+
+    freed = cm.delete(7)
+    assert freed == 60
+    nv = cm.get(7)
+    assert nv is not None and nv.size == TOMBSTONE_FILE_SIZE
+    assert cm.delete(7) == 0  # double delete frees nothing
+    assert cm.delete(404) == 0  # absent key
+
+
+def test_compact_map_ascending_visit_sorted():
+    cm = CompactMap()
+    keys = random.sample(range(1, 10_000_000), 1000)
+    for k in keys:
+        cm.set(k, k * 2, 10)
+    seen = []
+    cm.ascending_visit(lambda nv: seen.append(nv.key))
+    assert seen == sorted(keys)
+
+
+def test_compact_map_snapshot_excludes_tombstones():
+    cm = CompactMap()
+    for k in range(100):
+        cm.set(k + 1, k + 10, 5)
+    for k in range(0, 100, 3):
+        cm.delete(k + 1)
+    keys, offsets, sizes = cm.snapshot()
+    assert keys.dtype == np.uint64
+    live = [k + 1 for k in range(100) if k % 3 != 0]
+    assert keys.tolist() == live
+    assert np.all(sizes == 5)
+    # snapshot caches until next mutation
+    k2, _, _ = cm.snapshot()
+    assert k2 is keys
+    cm.set(5000, 1, 1)
+    k3, _, _ = cm.snapshot()
+    assert len(k3) == len(live) + 1
+
+
+def test_memdb_sorted_save_load(tmp_path):
+    db = MemDb()
+    keys = random.sample(range(1, 1_000_000), 500)
+    for k in keys:
+        db.set(k, k, 42)
+    db.delete(keys[0])
+    path = str(tmp_path / "sorted.idx")
+    db.save_to_idx(path)
+
+    with open(path, "rb") as f:
+        data = f.read()
+    pk, po, ps = parse_index_bytes(data)
+    assert pk.tolist() == sorted(keys[1:])
+
+    db2 = MemDb()
+    db2.load_from_idx(path)
+    assert len(db2) == len(keys) - 1
+
+
+def test_memdb_load_replays_tombstones(tmp_path):
+    keys = np.array([1, 2, 3], dtype=np.uint64)
+    offs = np.array([10, 20, 30], dtype=np.uint32)
+    sizes = np.array([5, 5, 5], dtype=np.uint32)
+    live = entries_to_bytes(keys, offs, sizes)
+    tomb = entries_to_bytes(
+        np.array([2], dtype=np.uint64),
+        np.array([20], dtype=np.uint32),
+        np.array([TOMBSTONE_FILE_SIZE], dtype=np.uint32),
+    )
+    path = str(tmp_path / "x.idx")
+    with open(path, "wb") as f:
+        f.write(live + tomb)
+    db = MemDb()
+    db.load_from_idx(path)
+    assert db.get(2) is None
+    assert db.get(1) is not None and db.get(3) is not None
+
+
+def test_needle_map_idx_log_and_reload(tmp_path):
+    path = str(tmp_path / "v.idx")
+    nm = new_needle_map(path)
+    nm.put(1, 2, 100)
+    nm.put(2, 20, 200)
+    nm.put(3, 50, 300)
+    nm.delete(2, 20)
+    assert nm.file_count == 3
+    assert nm.deleted_count == 1
+    assert nm.max_file_key == 3
+    assert nm.index_file_size() == 4 * 16
+    nm.close()
+
+    nm2 = load_needle_map(path)
+    assert nm2.get(1).size == 100
+    got2 = nm2.get(2)
+    assert got2 is None or got2.size == TOMBSTONE_FILE_SIZE
+    assert nm2.get(3).size == 300
+    assert nm2.max_file_key == 3
+    nm2.close()
+
+
+def test_needle_map_overwrite_counts_deletion(tmp_path):
+    path = str(tmp_path / "v.idx")
+    nm = new_needle_map(path)
+    nm.put(9, 1, 10)
+    nm.put(9, 2, 20)  # overwrite: old 10 bytes become garbage
+    assert nm.deleted_count == 1
+    assert nm.deleted_size == 10
+    assert nm.content_size == 30
+    nm.close()
+
+
+def test_compact_map_10k_perf_smoke():
+    # scaled-down analogue of the reference's 10M-entry perf test
+    cm = CompactMap()
+    n = 10_000
+    for k in range(1, n + 1):
+        cm.set(k, k, 8)
+    for k in range(1, n + 1, 7):
+        cm.delete(k)
+    hits = sum(1 for k in range(1, n + 1) if cm.get(k).size != TOMBSTONE_FILE_SIZE)
+    assert hits == n - len(range(1, n + 1, 7))
+    keys, _, _ = cm.snapshot()
+    assert len(keys) == hits
