@@ -1,0 +1,223 @@
+"""Vacuum (compaction): copy live needles to shadow files, then commit.
+
+Mirrors the reference's two-phase protocol (ref: weed/storage/volume_vacuum.go):
+- compact() / compact2() write .cpd/.cpx shadow files while the volume keeps
+  serving writes; the super block's compaction revision is bumped in the copy;
+- commit_compact() closes the volume, replays writes that raced compaction
+  from the old .idx tail into the shadow files (makeup_diff,
+  volume_vacuum.go:181-308), renames .cpd/.cpx over .dat/.idx and reloads.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ..types import (
+    NEEDLE_MAP_ENTRY_SIZE,
+    NEEDLE_PADDING_SIZE,
+    TOMBSTONE_FILE_SIZE,
+    to_actual_offset,
+    to_offset_units,
+)
+from .backend import DiskFile
+from .idx import entry_to_bytes, parse_entry
+from .needle import Needle, read_needle_blob, read_needle_data
+from .needle_map import MemDb
+from .super_block import SuperBlock, read_super_block
+from .volume import Volume
+
+
+def compact2(v: Volume) -> None:
+    """Copy live data based on the .idx (ref Compact2, volume_vacuum.go:66-89)."""
+    v.is_compacting = True
+    base = v.file_name()
+    v.last_compact_index_offset = v.index_file_size()
+    v.last_compact_revision = v.super_block.compaction_revision
+    v.sync()
+    _copy_data_based_on_index_file(
+        base + ".dat", base + ".idx", base + ".cpd", base + ".cpx",
+        v.super_block, v.version,
+    )
+    v.is_compacting = False
+
+
+def compact(v: Volume) -> None:
+    """Copy live data by scanning the .dat (ref Compact, volume_vacuum.go:37-63)."""
+    v.is_compacting = True
+    base = v.file_name()
+    v.last_compact_index_offset = v.index_file_size()
+    v.last_compact_revision = v.super_block.compaction_revision
+    v.sync()
+
+    dst = DiskFile(base + ".cpd", create=True)
+    dst.truncate(0)
+    sb = SuperBlock(
+        version=v.super_block.version,
+        replica_placement=v.super_block.replica_placement,
+        ttl=v.super_block.ttl,
+        compaction_revision=v.super_block.compaction_revision + 1,
+        extra=v.super_block.extra,
+    )
+    dst.write_at(sb.to_bytes(), 0)
+    new_offset = sb.block_size()
+    nm = MemDb()
+    now = time.time()
+
+    def visit(n: Needle, offset: int, body: bytes) -> None:
+        nonlocal new_offset
+        if n.has_ttl() and n.ttl is not None and now >= n.last_modified + v.ttl.minutes * 60:
+            return
+        nv = v.nm.get(n.id)
+        if (
+            nv is not None
+            and to_actual_offset(nv.offset_units) == offset
+            and nv.size > 0
+            and nv.size != TOMBSTONE_FILE_SIZE
+        ):
+            nm.set(n.id, to_offset_units(new_offset), n.size)
+            blob, _, actual = n.to_bytes(v.version)
+            dst.write_at(blob, new_offset)
+            new_offset += actual
+
+    v.scan(visit, read_body=True)
+    dst.close()
+    nm.save_to_idx(base + ".cpx")
+    v.is_compacting = False
+
+
+def commit_compact(v: Volume) -> Volume:
+    """Swap shadow files in, absorbing racing writes; returns the reloaded
+    volume (ref CommitCompact, volume_vacuum.go:91-156)."""
+    base = v.file_name()
+    v.is_compacting = True
+    with v._lock:
+        v.close()
+        try:
+            _makeup_diff(
+                v, base + ".cpd", base + ".cpx", base + ".dat", base + ".idx"
+            )
+        except Exception:
+            os.remove(base + ".cpd")
+            os.remove(base + ".cpx")
+            raise
+        os.rename(base + ".cpd", base + ".dat")
+        os.rename(base + ".cpx", base + ".idx")
+    return Volume(v.dir, v.collection, v.id, create=False)
+
+
+def cleanup_compact(v: Volume) -> None:
+    base = v.file_name()
+    for ext in (".cpd", ".cpx"):
+        try:
+            os.remove(base + ext)
+        except FileNotFoundError:
+            pass
+
+
+def _copy_data_based_on_index_file(
+    src_dat: str, src_idx: str, dst_dat: str, dst_idx: str,
+    sb: SuperBlock, version: int,
+) -> None:
+    """Ref copyDataBasedOnIndexFile (volume_vacuum.go:381-447)."""
+    old_nm = MemDb()
+    old_nm.load_from_idx(src_idx)
+    src = DiskFile(src_dat, create=False, read_only=True)
+    dst = DiskFile(dst_dat, create=True)
+    dst.truncate(0)
+
+    new_sb = SuperBlock(
+        version=sb.version,
+        replica_placement=sb.replica_placement,
+        ttl=sb.ttl,
+        compaction_revision=sb.compaction_revision + 1,
+        extra=sb.extra,
+    )
+    dst.write_at(new_sb.to_bytes(), 0)
+    new_offset = new_sb.block_size()
+    new_nm = MemDb()
+    now = time.time()
+
+    def visit(value) -> None:
+        nonlocal new_offset
+        if value.offset_units == 0 or value.size == TOMBSTONE_FILE_SIZE:
+            return
+        try:
+            n = read_needle_data(
+                src, to_actual_offset(value.offset_units), value.size, version
+            )
+        except Exception:
+            return
+        if n.has_ttl() and n.ttl is not None and now >= n.last_modified + sb.ttl.minutes * 60:
+            return
+        new_nm.set(n.id, to_offset_units(new_offset), n.size)
+        blob, _, actual = n.to_bytes(sb.version)
+        dst.write_at(blob, new_offset)
+        new_offset += actual
+
+    old_nm.ascending_visit(visit)
+    src.close()
+    dst.close()
+    new_nm.save_to_idx(dst_idx)
+
+
+def _makeup_diff(
+    v: Volume, new_dat: str, new_idx: str, old_dat: str, old_idx: str
+) -> None:
+    """Replay idx-tail updates that raced compaction into the shadow files
+    (ref makeupDiff, volume_vacuum.go:181-308)."""
+    idx_size = os.path.getsize(old_idx)
+    if idx_size % NEEDLE_MAP_ENTRY_SIZE != 0:
+        raise ValueError(f"old idx size {idx_size} corrupt")
+    if idx_size == 0 or idx_size <= v.last_compact_index_offset:
+        return
+
+    old_dat_f = DiskFile(old_dat, create=False, read_only=True)
+    old_rev = read_super_block(old_dat_f).compaction_revision
+    if old_rev != v.last_compact_revision:
+        old_dat_f.close()
+        raise ValueError(
+            f"old dat compact revision {old_rev} != expected {v.last_compact_revision}"
+        )
+
+    # newest entry wins per key, walking the tail backwards
+    updated: dict[int, tuple[int, int]] = {}
+    with open(old_idx, "rb") as f:
+        off = idx_size - NEEDLE_MAP_ENTRY_SIZE
+        while off >= v.last_compact_index_offset:
+            f.seek(off)
+            key, offset_units, size = parse_entry(f.read(NEEDLE_MAP_ENTRY_SIZE))
+            if key not in updated:
+                updated[key] = (offset_units, size)
+            off -= NEEDLE_MAP_ENTRY_SIZE
+    if not updated:
+        old_dat_f.close()
+        return
+
+    dst = DiskFile(new_dat, create=False)
+    new_rev = read_super_block(dst).compaction_revision
+    if old_rev + 1 != new_rev:
+        old_dat_f.close()
+        dst.close()
+        raise ValueError(f"new dat compact revision {new_rev} != old {old_rev}+1")
+
+    idx_f = DiskFile(new_idx, create=False)
+    for key, (offset_units, size) in updated.items():
+        offset = dst.size()
+        if offset % NEEDLE_PADDING_SIZE != 0:
+            offset += NEEDLE_PADDING_SIZE - offset % NEEDLE_PADDING_SIZE
+        if offset_units != 0 and size != 0 and size != TOMBSTONE_FILE_SIZE:
+            blob = read_needle_blob(
+                old_dat_f, to_actual_offset(offset_units), size, v.version
+            )
+            dst.write_at(blob, offset)
+            idx_f.append(entry_to_bytes(key, to_offset_units(offset), size))
+        else:
+            fake = Needle(id=key, cookie=0x12345678)
+            fake.append_at_ns = time.time_ns()
+            blob, _, _ = fake.to_bytes(v.version)
+            dst.write_at(blob, offset)
+            idx_f.append(entry_to_bytes(key, 0, size))
+    old_dat_f.close()
+    dst.close()
+    idx_f.close()

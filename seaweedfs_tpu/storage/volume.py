@@ -1,0 +1,337 @@
+"""Volume engine: one append-only .dat + .idx pair.
+
+Semantics follow the reference volume (ref: weed/storage/volume.go:21-47,
+volume_read_write.go, volume_loading.go, volume_checking.go):
+
+- writes append a v3 needle record and log (key, offset, size) to the .idx;
+- deletes append a zero-data tombstone needle and log TOMBSTONE_FILE_SIZE;
+- reads look up the in-memory map and pread one record, verifying cookie at a
+  higher layer and TTL expiry here;
+- load replays the .idx and verifies the last entry against the .dat (CRC),
+  marking the volume read-only on failure.
+
+The reference's async group-commit worker (volume_read_write.go:290-363)
+batches fsyncs across goroutines; here a single lock serializes writers and
+`sync=True` requests fsync with the same truncate-rollback-on-failure
+guarantee.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..types import (
+    MAX_POSSIBLE_VOLUME_SIZE,
+    NEEDLE_HEADER_SIZE,
+    NEEDLE_MAP_ENTRY_SIZE,
+    TOMBSTONE_FILE_SIZE,
+    to_actual_offset,
+    to_offset_units,
+)
+from .backend import BackendStorageFile, DiskFile
+from .needle import (
+    Needle,
+    get_actual_size,
+    needle_body_length,
+    read_needle_data,
+    read_needle_header,
+)
+from .needle_map import NeedleMap, load_needle_map, new_needle_map
+from .super_block import SUPER_BLOCK_SIZE, SuperBlock, read_super_block
+from .ttl import EMPTY_TTL
+
+
+class NotFound(Exception):
+    pass
+
+
+class AlreadyDeleted(Exception):
+    pass
+
+
+class VolumeSizeExceeded(Exception):
+    pass
+
+
+class CookieMismatch(Exception):
+    pass
+
+
+def volume_base_name(directory: str, collection: str, vid: int) -> str:
+    """Ref: weed/storage/volume.go FileName() — dir/[collection_]vid."""
+    if collection:
+        return os.path.join(directory, f"{collection}_{vid}")
+    return os.path.join(directory, str(vid))
+
+
+def check_volume_data_integrity(
+    dat: BackendStorageFile, version: int, idx_path: str
+) -> int:
+    """Verify idx size alignment and the last entry's needle CRC; returns
+    last_append_at_ns (ref: weed/storage/volume_checking.go:15-46)."""
+    idx_size = os.path.getsize(idx_path)
+    if idx_size % NEEDLE_MAP_ENTRY_SIZE != 0:
+        raise ValueError(f"index file size {idx_size} not a multiple of 16")
+    if idx_size == 0:
+        return 0
+    from .idx import parse_entry
+
+    with open(idx_path, "rb") as f:
+        f.seek(idx_size - NEEDLE_MAP_ENTRY_SIZE)
+        key, offset_units, size = parse_entry(f.read(NEEDLE_MAP_ENTRY_SIZE))
+    if offset_units == 0:
+        return 0
+    if size == TOMBSTONE_FILE_SIZE:
+        size = 0
+    n = read_needle_data(dat, to_actual_offset(offset_units), size, version)
+    if n.id != key:
+        raise ValueError(f"index key {key:#x} does not match needle id {n.id:#x}")
+    return n.append_at_ns
+
+
+class Volume:
+    def __init__(
+        self,
+        directory: str,
+        collection: str,
+        vid: int,
+        replica_placement=None,
+        ttl=None,
+        create: bool = True,
+    ):
+        self.dir = directory
+        self.collection = collection
+        self.id = vid
+        self.no_write_or_delete = False
+        self.is_compacting = False
+        self.last_append_at_ns = 0
+        self.last_modified_ts_seconds = 0
+        self.last_compact_index_offset = 0
+        self.last_compact_revision = 0
+        self._lock = threading.RLock()
+
+        base = self.file_name()
+        dat_exists = os.path.exists(base + ".dat")
+        if not dat_exists and not create:
+            raise FileNotFoundError(f"Volume data file {base}.dat does not exist")
+
+        self.data_backend: BackendStorageFile = DiskFile(base + ".dat", create=True)
+        if dat_exists and self.data_backend.size() >= SUPER_BLOCK_SIZE:
+            self.super_block = read_super_block(self.data_backend)
+        else:
+            from .super_block import ReplicaPlacement
+
+            self.super_block = SuperBlock(
+                replica_placement=replica_placement or ReplicaPlacement(),
+                ttl=ttl or EMPTY_TTL,
+            )
+            self.data_backend.write_at(self.super_block.to_bytes(), 0)
+
+        self.nm: NeedleMap
+        if os.path.exists(base + ".idx") and dat_exists:
+            try:
+                self.last_append_at_ns = check_volume_data_integrity(
+                    self.data_backend, self.version, base + ".idx"
+                )
+            except Exception:
+                self.no_write_or_delete = True
+            self.nm = load_needle_map(base + ".idx")
+        else:
+            self.nm = new_needle_map(base + ".idx")
+
+    # --- basic accessors ---
+    def file_name(self) -> str:
+        return volume_base_name(self.dir, self.collection, self.id)
+
+    @property
+    def version(self) -> int:
+        return self.super_block.version
+
+    @property
+    def ttl(self):
+        return self.super_block.ttl
+
+    def content_size(self) -> int:
+        return self.nm.content_size
+
+    def deleted_size(self) -> int:
+        return self.nm.deleted_size
+
+    def file_count(self) -> int:
+        return self.nm.file_count
+
+    def deleted_count(self) -> int:
+        return self.nm.deleted_count
+
+    def max_file_key(self) -> int:
+        return self.nm.max_file_key
+
+    def index_file_size(self) -> int:
+        return self.nm.index_file_size()
+
+    def data_file_size(self) -> int:
+        return self.data_backend.size()
+
+    def is_read_only(self) -> bool:
+        return self.no_write_or_delete
+
+    def garbage_level(self) -> float:
+        """Ref: volume_vacuum.go:20-34."""
+        if self.content_size() == 0:
+            return 0.0
+        return self.deleted_size() / self.content_size()
+
+    # --- data path ---
+    def _is_file_unchanged(self, n: Needle) -> bool:
+        """Dedup identical rewrite (ref: volume_read_write.go:22-41)."""
+        if str(self.ttl):
+            return False
+        nv = self.nm.get(n.id)
+        if nv is None or nv.offset_units == 0 or nv.size == TOMBSTONE_FILE_SIZE:
+            return False
+        try:
+            old = read_needle_data(
+                self.data_backend, to_actual_offset(nv.offset_units), nv.size, self.version
+            )
+        except Exception:
+            return False
+        return old.cookie == n.cookie and old.data == n.data
+
+    def write_needle(self, n: Needle, sync: bool = False) -> tuple[int, int, bool]:
+        """Append a needle; returns (offset, size, is_unchanged)
+        (ref: volume_read_write.go:71-142)."""
+        if self.no_write_or_delete:
+            raise PermissionError(f"volume {self.id} is read only")
+        if n.ttl is None or n.ttl == EMPTY_TTL:
+            if self.ttl != EMPTY_TTL:
+                n.set_ttl(self.ttl)
+        with self._lock:
+            actual_size = get_actual_size(len(n.data), self.version)
+            if MAX_POSSIBLE_VOLUME_SIZE < self.content_size() + actual_size:
+                raise VolumeSizeExceeded(
+                    f"volume size limit {MAX_POSSIBLE_VOLUME_SIZE} exceeded! "
+                    f"current size is {self.content_size()}"
+                )
+            if self._is_file_unchanged(n):
+                return 0, len(n.data), True
+
+            nv = self.nm.get(n.id)
+            if nv is not None and nv.offset_units != 0:
+                existing, _ = read_needle_header(
+                    self.data_backend, self.version, to_actual_offset(nv.offset_units)
+                )
+                if existing.cookie != n.cookie:
+                    raise CookieMismatch(f"mismatching cookie {n.cookie:x}")
+
+            n.append_at_ns = time.time_ns()
+            end = self.data_backend.size()
+            blob, size_for_index, _ = n.to_bytes(self.version)
+            try:
+                self.data_backend.write_at(blob, end)
+                if sync:
+                    self.data_backend.sync()
+            except Exception:
+                self.data_backend.truncate(end)
+                raise
+            self.last_append_at_ns = n.append_at_ns
+            offset = end
+
+            if nv is None or to_actual_offset(nv.offset_units) < offset:
+                self.nm.put(n.id, to_offset_units(offset), n.size)
+            if self.last_modified_ts_seconds < n.last_modified:
+                self.last_modified_ts_seconds = n.last_modified
+            return offset, size_for_index, False
+
+    def delete_needle(self, n: Needle) -> int:
+        """Append tombstone + mark map; returns freed size
+        (ref: volume_read_write.go:186-231)."""
+        if self.no_write_or_delete:
+            raise PermissionError(f"volume {self.id} is read only")
+        with self._lock:
+            nv = self.nm.get(n.id)
+            if nv is None or nv.size == TOMBSTONE_FILE_SIZE:
+                return 0
+            size = nv.size
+            n.data = b""
+            n.append_at_ns = time.time_ns()
+            end = self.data_backend.size()
+            blob, _, _ = n.to_bytes(self.version)
+            self.data_backend.write_at(blob, end)
+            self.last_append_at_ns = n.append_at_ns
+            self.nm.delete(n.id, to_offset_units(end))
+            return size
+
+    def read_needle(self, n: Needle) -> int:
+        """Fill in needle content by map lookup; returns bytes read
+        (ref: volume_read_write.go:255-288)."""
+        with self._lock:
+            nv = self.nm.get(n.id)
+            if nv is None or nv.offset_units == 0:
+                raise NotFound(f"needle {n.id} not found")
+            if nv.size == TOMBSTONE_FILE_SIZE:
+                raise AlreadyDeleted(f"needle {n.id} already deleted")
+            if nv.size == 0:
+                return 0
+            got = read_needle_data(
+                self.data_backend, to_actual_offset(nv.offset_units), nv.size, self.version
+            )
+            n.__dict__.update(got.__dict__)
+        if n.has_ttl() and n.ttl is not None and n.ttl.minutes:
+            if n.has_last_modified_date() and time.time() >= n.last_modified + n.ttl.minutes * 60:
+                raise NotFound(f"needle {n.id} expired")
+        return len(n.data)
+
+    def sync(self) -> None:
+        self.nm.sync()
+        self.data_backend.sync()
+
+    def close(self) -> None:
+        with self._lock:
+            self.nm.close()
+            self.data_backend.close()
+
+    def destroy(self) -> None:
+        """Remove all files (ref: volume_read_write.go:44-65)."""
+        self.close()
+        base = self.file_name()
+        for ext in (".dat", ".idx", ".vif", ".sdx", ".cpd", ".cpx"):
+            try:
+                os.remove(base + ext)
+            except FileNotFoundError:
+                pass
+
+    # --- scanning ---
+    def scan(
+        self,
+        visit: Callable[[Needle, int, bytes], None],
+        read_body: bool = True,
+    ) -> None:
+        """Visit every record in the .dat in file order
+        (ref: volume_read_write.go:371-428)."""
+        scan_volume_file(self.data_backend, self.super_block, visit, read_body)
+
+
+def scan_volume_file(
+    dat: BackendStorageFile,
+    super_block: SuperBlock,
+    visit: Callable[[Needle, int, bytes], None],
+    read_body: bool = True,
+) -> None:
+    version = super_block.version
+    offset = super_block.block_size()
+    end = dat.size()
+    while offset + NEEDLE_HEADER_SIZE <= end:
+        try:
+            n, body_len = read_needle_header(dat, version, offset)
+        except EOFError:
+            return
+        body = b""
+        if read_body and body_len > 0:
+            body = dat.read_at(body_len, offset + NEEDLE_HEADER_SIZE)
+            n.read_needle_body_bytes(body, version)
+        visit(n, offset, body)
+        offset += NEEDLE_HEADER_SIZE + body_len

@@ -1,0 +1,151 @@
+import os
+import random
+
+import pytest
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.vacuum import commit_compact, compact, compact2
+from seaweedfs_tpu.storage.volume import (
+    AlreadyDeleted,
+    CookieMismatch,
+    NotFound,
+    Volume,
+)
+
+
+def new_needle(nid: int, size: int = 100, cookie: int = 0x42) -> Needle:
+    n = Needle(cookie=cookie, id=nid)
+    n.data = random.randbytes(size)
+    return n
+
+
+def test_volume_write_read_delete(tmp_path):
+    v = Volume(str(tmp_path), "", 1)
+    n = new_needle(1)
+    offset, size, unchanged = v.write_needle(n)
+    assert not unchanged
+    assert size == len(n.data)
+
+    got = Needle(id=1)
+    assert v.read_needle(got) == len(n.data)
+    assert got.data == n.data
+    assert got.cookie == 0x42
+
+    # the map stores the needle's Size field (4 + data + flags byte), and
+    # delete frees that (ref syncDelete returns nv.Size)
+    freed = v.delete_needle(Needle(id=1, cookie=0x42))
+    assert freed == size + 5
+    with pytest.raises(AlreadyDeleted):
+        v.read_needle(Needle(id=1))
+    with pytest.raises(NotFound):
+        v.read_needle(Needle(id=999))
+    v.close()
+
+
+def test_volume_unchanged_write_dedup(tmp_path):
+    v = Volume(str(tmp_path), "", 1)
+    n = new_needle(5)
+    v.write_needle(n)
+    size_before = v.data_file_size()
+    n2 = Needle(cookie=0x42, id=5, data=n.data)
+    _, _, unchanged = v.write_needle(n2)
+    assert unchanged
+    assert v.data_file_size() == size_before
+    v.close()
+
+
+def test_volume_cookie_mismatch(tmp_path):
+    v = Volume(str(tmp_path), "", 1)
+    v.write_needle(new_needle(7, cookie=0xAAAA))
+    with pytest.raises(CookieMismatch):
+        v.write_needle(new_needle(7, cookie=0xBBBB))
+    v.close()
+
+
+def test_volume_reload_from_disk(tmp_path):
+    v = Volume(str(tmp_path), "col", 3)
+    payloads = {}
+    for nid in range(1, 20):
+        n = new_needle(nid, size=50 + nid)
+        payloads[nid] = n.data
+        v.write_needle(n)
+    v.delete_needle(Needle(id=4, cookie=0x42))
+    v.close()
+
+    v2 = Volume(str(tmp_path), "col", 3, create=False)
+    assert not v2.is_read_only()
+    assert v2.file_count() == 19
+    assert v2.deleted_count() == 1
+    for nid, data in payloads.items():
+        if nid == 4:
+            with pytest.raises(AlreadyDeleted):
+                v2.read_needle(Needle(id=nid))
+        else:
+            got = Needle(id=nid)
+            v2.read_needle(got)
+            assert got.data == data
+    v2.close()
+
+
+def test_volume_integrity_check_marks_readonly_on_corruption(tmp_path):
+    v = Volume(str(tmp_path), "", 9)
+    v.write_needle(new_needle(1, size=64))
+    v.write_needle(new_needle(2, size=64))
+    v.close()
+
+    # corrupt the data of the last needle
+    dat = str(tmp_path / "9.dat")
+    size = os.path.getsize(dat)
+    with open(dat, "r+b") as f:
+        f.seek(size - 30)
+        f.write(b"\xff" * 4)
+
+    v2 = Volume(str(tmp_path), "", 9, create=False)
+    assert v2.is_read_only()
+    v2.close()
+
+
+@pytest.mark.parametrize("compact_fn", [compact, compact2])
+def test_vacuum_roundtrip(tmp_path, compact_fn):
+    v = Volume(str(tmp_path), "", 2)
+    payloads = {}
+    for nid in range(1, 16):
+        n = new_needle(nid, size=100)
+        payloads[nid] = n.data
+        v.write_needle(n)
+    for nid in (2, 4, 6):
+        v.delete_needle(Needle(id=nid, cookie=0x42))
+        del payloads[nid]
+    assert v.garbage_level() > 0
+
+    size_before = v.data_file_size()
+    compact_fn(v)
+
+    # racing write + delete between compact and commit (makeupDiff path)
+    racing = new_needle(100, size=77)
+    payloads[100] = racing.data
+    v.write_needle(racing)
+    v.delete_needle(Needle(id=1, cookie=0x42))
+    del payloads[1]
+
+    v2 = commit_compact(v)
+    assert v2.data_file_size() < size_before
+    for nid, data in payloads.items():
+        got = Needle(id=nid)
+        v2.read_needle(got)
+        assert got.data == data, f"needle {nid} mismatch after vacuum"
+    for nid in (2, 4, 6, 1):
+        with pytest.raises((AlreadyDeleted, NotFound)):
+            v2.read_needle(Needle(id=nid))
+    v2.close()
+
+
+def test_scan_volume_file(tmp_path):
+    v = Volume(str(tmp_path), "", 8)
+    for nid in range(1, 6):
+        v.write_needle(new_needle(nid))
+    seen = []
+    v.scan(lambda n, offset, body: seen.append((n.id, offset)))
+    assert [s[0] for s in seen] == [1, 2, 3, 4, 5]
+    assert all(off % 8 == 0 for _, off in seen)
+    v.close()
