@@ -1,0 +1,298 @@
+"""EcVolume: serving reads from erasure-coded shards.
+
+Holds the sorted .ecx index (binary-searched on disk), the .ecj deletion
+journal, and whichever local .ecNN shard files exist
+(ref: weed/storage/erasure_coding/ec_volume.go, ec_shard.go,
+ec_volume_delete.go).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Optional
+
+from . import (
+    DATA_SHARDS_COUNT,
+    EC_LARGE_BLOCK_SIZE,
+    EC_SMALL_BLOCK_SIZE,
+    TOTAL_SHARDS_COUNT,
+    to_ext,
+)
+from ...types import (
+    NEEDLE_ID_SIZE,
+    NEEDLE_MAP_ENTRY_SIZE,
+    TOMBSTONE_FILE_SIZE,
+    VERSION3,
+    needle_id_to_bytes,
+    to_actual_offset,
+    u32_to_bytes,
+)
+from ..idx import parse_entry
+from ..needle import get_actual_size
+from .locate import Interval, locate_data
+
+
+class NeedleNotFound(Exception):
+    pass
+
+
+def ec_shard_file_name(collection: str, directory: str, vid: int) -> str:
+    if collection:
+        return os.path.join(directory, f"{collection}_{vid}")
+    return os.path.join(directory, str(vid))
+
+
+def ec_shard_base_file_name(collection: str, vid: int) -> str:
+    if collection:
+        return f"{collection}_{vid}"
+    return str(vid)
+
+
+class ShardBits:
+    """uint32 bitmask of present shard ids (ref: ec_volume_info.go:61-110)."""
+
+    def __init__(self, bits: int = 0):
+        self.bits = bits & 0xFFFFFFFF
+
+    def add(self, shard_id: int) -> "ShardBits":
+        return ShardBits(self.bits | (1 << shard_id))
+
+    def remove(self, shard_id: int) -> "ShardBits":
+        return ShardBits(self.bits & ~(1 << shard_id))
+
+    def has(self, shard_id: int) -> bool:
+        return bool(self.bits & (1 << shard_id))
+
+    def shard_ids(self) -> list[int]:
+        return [i for i in range(TOTAL_SHARDS_COUNT) if self.has(i)]
+
+    def count(self) -> int:
+        return bin(self.bits).count("1")
+
+    def minus(self, other: "ShardBits") -> "ShardBits":
+        return ShardBits(self.bits & ~other.bits)
+
+    def plus(self, other: "ShardBits") -> "ShardBits":
+        return ShardBits(self.bits | other.bits)
+
+    def minus_parity_shards(self) -> "ShardBits":
+        b = self
+        for i in range(DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT):
+            b = b.remove(i)
+        return b
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ShardBits) and self.bits == other.bits
+
+    def __repr__(self) -> str:
+        return f"ShardBits({self.shard_ids()})"
+
+
+class EcVolumeShard:
+    """One local .ecNN file (ref: ec_shard.go:16-110)."""
+
+    def __init__(self, directory: str, collection: str, vid: int, shard_id: int):
+        self.dir = directory
+        self.collection = collection
+        self.volume_id = vid
+        self.shard_id = shard_id
+        path = self.file_name() + to_ext(shard_id)
+        self._f = open(path, "rb")
+        self.size = os.path.getsize(path)
+
+    def file_name(self) -> str:
+        return ec_shard_file_name(self.collection, self.dir, self.volume_id)
+
+    def read_at(self, size: int, offset: int) -> bytes:
+        return os.pread(self._f.fileno(), size, offset)
+
+    def close(self) -> None:
+        self._f.close()
+
+    def destroy(self) -> None:
+        self.close()
+        os.remove(self.file_name() + to_ext(self.shard_id))
+
+
+def search_needle_from_sorted_index(
+    ecx_f,
+    ecx_file_size: int,
+    needle_id: int,
+    process_fn: Optional[Callable[[object, int], None]] = None,
+) -> tuple[int, int]:
+    """Binary search the on-disk sorted .ecx; returns (offset_units, size).
+    process_fn(file, entry_offset) runs on the matched entry while positioned
+    (ref SearchNeedleFromSortedIndex, ec_volume.go:210-235)."""
+    lo, hi = 0, ecx_file_size // NEEDLE_MAP_ENTRY_SIZE
+    while lo < hi:
+        mid = (lo + hi) // 2
+        entry = os.pread(
+            ecx_f.fileno(), NEEDLE_MAP_ENTRY_SIZE, mid * NEEDLE_MAP_ENTRY_SIZE
+        )
+        if len(entry) != NEEDLE_MAP_ENTRY_SIZE:
+            raise IOError(f"ecx short read at {mid * NEEDLE_MAP_ENTRY_SIZE}")
+        key, offset_units, size = parse_entry(entry)
+        if key == needle_id:
+            if process_fn is not None:
+                process_fn(ecx_f, mid * NEEDLE_MAP_ENTRY_SIZE)
+            return offset_units, size
+        if key < needle_id:
+            lo = mid + 1
+        else:
+            hi = mid
+    raise NeedleNotFound(f"needle {needle_id} not found in ecx")
+
+
+def mark_needle_deleted(f, entry_offset: int) -> None:
+    """Tombstone the size field of an .ecx entry in place
+    (ref MarkNeedleDeleted, ec_volume_delete.go:13-25)."""
+    os.pwrite(
+        f.fileno(),
+        u32_to_bytes(TOMBSTONE_FILE_SIZE),
+        entry_offset + NEEDLE_ID_SIZE + 4,  # key + offset come first
+    )
+
+
+class EcVolume:
+    def __init__(self, directory: str, collection: str, vid: int):
+        self.dir = directory
+        self.collection = collection
+        self.volume_id = vid
+        base = self.file_name()
+        if not os.path.exists(base + ".ecx"):
+            raise FileNotFoundError(f"cannot open ec volume index {base}.ecx")
+        self._ecx = open(base + ".ecx", "r+b")
+        self.ecx_file_size = os.path.getsize(base + ".ecx")
+        self._ecj = open(base + ".ecj", "a+b")
+        self._ecj_lock = threading.Lock()
+        self.version = VERSION3
+        vif = base + ".vif"
+        if os.path.exists(vif):
+            from ..volume_info import load_volume_info
+
+            info = load_volume_info(vif)
+            if info is not None and info.version:
+                self.version = info.version
+        self.shards: list[EcVolumeShard] = []
+        # shard_id -> list of server addresses, refreshed from master
+        self.shard_locations: dict[int, list[str]] = {}
+        self.shard_locations_lock = threading.RLock()
+        self.shard_locations_refresh_time = 0.0
+
+    def file_name(self) -> str:
+        return ec_shard_file_name(self.collection, self.dir, self.volume_id)
+
+    # --- shard registry ---
+    def add_shard(self, shard: EcVolumeShard) -> bool:
+        if any(s.shard_id == shard.shard_id for s in self.shards):
+            return False
+        self.shards.append(shard)
+        self.shards.sort(key=lambda s: (s.volume_id, s.shard_id))
+        return True
+
+    def delete_shard(self, shard_id: int) -> Optional[EcVolumeShard]:
+        for i, s in enumerate(self.shards):
+            if s.shard_id == shard_id:
+                return self.shards.pop(i)
+        return None
+
+    def find_shard(self, shard_id: int) -> Optional[EcVolumeShard]:
+        for s in self.shards:
+            if s.shard_id == shard_id:
+                return s
+        return None
+
+    def shard_ids(self) -> list[int]:
+        return [s.shard_id for s in self.shards]
+
+    def shard_bits(self) -> ShardBits:
+        b = ShardBits()
+        for s in self.shards:
+            b = b.add(s.shard_id)
+        return b
+
+    def shard_size(self) -> int:
+        return self.shards[0].size if self.shards else 0
+
+    def size(self) -> int:
+        return sum(s.size for s in self.shards)
+
+    # --- lookup ---
+    def find_needle_from_ecx(self, needle_id: int) -> tuple[int, int]:
+        return search_needle_from_sorted_index(
+            self._ecx, self.ecx_file_size, needle_id
+        )
+
+    def locate_needle(self, needle_id: int) -> tuple[int, int, list[Interval]]:
+        """-> (offset_units, size, intervals)
+        (ref LocateEcShardNeedle, ec_volume.go:190-206)."""
+        offset_units, size = self.find_needle_from_ecx(needle_id)
+        shard_size = self.shard_size()
+        intervals = locate_data(
+            EC_LARGE_BLOCK_SIZE,
+            EC_SMALL_BLOCK_SIZE,
+            DATA_SHARDS_COUNT * shard_size,
+            to_actual_offset(offset_units),
+            get_actual_size(size, self.version),
+        )
+        return offset_units, size, intervals
+
+    # --- delete ---
+    def delete_needle_from_ecx(self, needle_id: int) -> None:
+        """Tombstone in .ecx + journal to .ecj
+        (ref DeleteNeedleFromEcx, ec_volume_delete.go:27-49)."""
+        try:
+            search_needle_from_sorted_index(
+                self._ecx, self.ecx_file_size, needle_id, mark_needle_deleted
+            )
+        except NeedleNotFound:
+            return
+        with self._ecj_lock:
+            self._ecj.seek(0, 2)
+            self._ecj.write(needle_id_to_bytes(needle_id))
+            self._ecj.flush()
+
+    def close(self) -> None:
+        for s in self.shards:
+            s.close()
+        with self._ecj_lock:
+            self._ecj.close()
+        self._ecx.close()
+
+    def destroy(self) -> None:
+        self.close()
+        for s in self.shards:
+            try:
+                os.remove(s.file_name() + to_ext(s.shard_id))
+            except FileNotFoundError:
+                pass
+        base = self.file_name()
+        for ext in (".ecx", ".ecj", ".vif"):
+            try:
+                os.remove(base + ext)
+            except FileNotFoundError:
+                pass
+
+
+def rebuild_ecx_file(base_file_name: str) -> None:
+    """Replay the .ecj journal into .ecx tombstones, then drop the journal
+    (ref RebuildEcxFile, ec_volume_delete.go:51-96)."""
+    if not os.path.exists(base_file_name + ".ecj"):
+        return
+    with open(base_file_name + ".ecx", "r+b") as ecx:
+        size = os.path.getsize(base_file_name + ".ecx")
+        with open(base_file_name + ".ecj", "rb") as ecj:
+            while True:
+                b = ecj.read(NEEDLE_ID_SIZE)
+                if len(b) != NEEDLE_ID_SIZE:
+                    break
+                from ...types import bytes_to_u64
+
+                try:
+                    search_needle_from_sorted_index(
+                        ecx, size, bytes_to_u64(b), mark_needle_deleted
+                    )
+                except NeedleNotFound:
+                    pass
+    os.remove(base_file_name + ".ecj")

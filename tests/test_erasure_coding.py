@@ -1,0 +1,360 @@
+import os
+import random
+import shutil
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage.erasure_coding import (
+    DATA_SHARDS_COUNT,
+    TOTAL_SHARDS_COUNT,
+    CpuRSCodec,
+    find_dat_file_size,
+    locate_data,
+    rebuild_ec_files,
+    to_ext,
+    write_dat_file,
+    write_ec_files,
+    write_idx_file_from_ec_index,
+    write_sorted_file_from_idx,
+)
+from seaweedfs_tpu.storage.erasure_coding.ec_volume import (
+    EcVolume,
+    NeedleNotFound,
+    ShardBits,
+    rebuild_ecx_file,
+    search_needle_from_sorted_index,
+)
+from seaweedfs_tpu.storage.erasure_coding.galois import (
+    EXP_TABLE,
+    LOG_TABLE,
+    MUL_TABLE,
+    build_matrix,
+    gf_mul,
+    mat_inv,
+    mat_mul,
+    reconstruction_matrix,
+)
+from seaweedfs_tpu.storage.erasure_coding.locate import Interval
+from seaweedfs_tpu.storage.idx import iter_index
+from seaweedfs_tpu.storage.needle import get_actual_size
+from seaweedfs_tpu.types import TOMBSTONE_FILE_SIZE, VERSION3, to_actual_offset
+
+from conftest import REFERENCE_ROOT, reference_available
+
+# test-scale geometry, same as the reference's ec_test.go:16-19
+LARGE_BLOCK = 10000
+SMALL_BLOCK = 100
+
+FIXTURE_BASE = os.path.join(REFERENCE_ROOT, "weed/storage/erasure_coding/1")
+
+
+# ---------- galois ----------
+def test_gf_tables_basic():
+    assert EXP_TABLE[0] == 1
+    assert LOG_TABLE[2] == 1  # generator
+    assert gf_mul(0, 5) == 0 and gf_mul(7, 0) == 0
+    assert gf_mul(1, 123) == 123
+    # known value in GF(2^8)/0x11D: 2*128 = 0x11D ^ 0x100 = 0x1D
+    assert gf_mul(2, 0x80) == 0x1D
+    # commutativity + distributivity spot checks
+    for _ in range(200):
+        a, b, c = random.randrange(256), random.randrange(256), random.randrange(256)
+        assert gf_mul(a, b) == gf_mul(b, a)
+        assert gf_mul(a, b ^ c) == gf_mul(a, b) ^ gf_mul(a, c)
+
+
+def test_mat_inv_roundtrip():
+    rng = np.random.default_rng(0)
+    for n in (1, 3, 5, 10):
+        while True:
+            m = rng.integers(0, 256, size=(n, n)).astype(np.uint8)
+            try:
+                inv = mat_inv(m)
+                break
+            except ValueError:
+                continue
+        assert np.array_equal(mat_mul(m, inv), np.eye(n, dtype=np.uint8))
+
+
+def test_build_matrix_systematic():
+    m = build_matrix(10, 14)
+    assert np.array_equal(m[:10], np.eye(10, dtype=np.uint8))
+    # any 10 of the 14 rows must be invertible (MDS property)
+    for _ in range(20):
+        rows = sorted(random.sample(range(14), 10))
+        reconstruction_matrix(m, rows)  # raises if singular
+
+
+@pytest.mark.parametrize("k,m", [(10, 4), (6, 3), (12, 4)])
+def test_codec_encode_reconstruct(k, m):
+    codec = CpuRSCodec(k, m)
+    rng = np.random.default_rng(42)
+    data = rng.integers(0, 256, size=(k, 1000)).astype(np.uint8)
+    shards = codec.encode_all(data)
+    assert codec.verify(shards)
+
+    # kill up to m random shards, reconstruct, compare
+    for kill_count in range(1, m + 1):
+        killed = random.sample(range(k + m), kill_count)
+        partial = [None if i in killed else shards[i] for i in range(k + m)]
+        full = codec.reconstruct(partial)
+        for i in range(k + m):
+            assert np.array_equal(full[i], shards[i]), f"shard {i} mismatch"
+
+
+def test_codec_too_few_shards():
+    codec = CpuRSCodec(10, 4)
+    with pytest.raises(ValueError):
+        codec.reconstruct([None] * 5 + [np.zeros(10, np.uint8)] * 9)
+
+
+# ---------- locate math ----------
+def test_locate_data_reference_case():
+    # ref TestLocateData (ec_test.go:189-200)
+    intervals = locate_data(
+        LARGE_BLOCK, SMALL_BLOCK, DATA_SHARDS_COUNT * LARGE_BLOCK + 1,
+        DATA_SHARDS_COUNT * LARGE_BLOCK, 1,
+    )
+    assert len(intervals) == 1
+    iv = intervals[0]
+    assert (iv.block_index, iv.inner_block_offset, iv.size, iv.is_large_block) == (
+        0, 0, 1, False,
+    )
+
+    intervals = locate_data(
+        LARGE_BLOCK, SMALL_BLOCK, DATA_SHARDS_COUNT * LARGE_BLOCK + 1,
+        DATA_SHARDS_COUNT * LARGE_BLOCK // 2 + 100,
+        DATA_SHARDS_COUNT * LARGE_BLOCK + 1
+        - DATA_SHARDS_COUNT * LARGE_BLOCK // 2 - 100,
+    )
+    total = sum(iv.size for iv in intervals)
+    assert total == (
+        DATA_SHARDS_COUNT * LARGE_BLOCK + 1
+        - DATA_SHARDS_COUNT * LARGE_BLOCK // 2 - 100
+    )
+
+
+def test_interval_to_shard_id_and_offset():
+    iv = Interval(
+        block_index=13, inner_block_offset=7, size=10,
+        is_large_block=True, large_block_rows_count=2,
+    )
+    shard, off = iv.to_shard_id_and_offset(LARGE_BLOCK, SMALL_BLOCK)
+    assert shard == 3
+    assert off == 1 * LARGE_BLOCK + 7
+    iv_small = Interval(
+        block_index=25, inner_block_offset=3, size=10,
+        is_large_block=False, large_block_rows_count=2,
+    )
+    shard, off = iv_small.to_shard_id_and_offset(LARGE_BLOCK, SMALL_BLOCK)
+    assert shard == 5
+    assert off == 2 * LARGE_BLOCK + 2 * SMALL_BLOCK + 3
+
+
+# ---------- the end-to-end oracle (ref ec_test.go TestEncodingDecoding) ----------
+def _setup_fixture(tmp_path) -> str:
+    base = str(tmp_path / "1")
+    shutil.copy(FIXTURE_BASE + ".dat", base + ".dat")
+    shutil.copy(FIXTURE_BASE + ".idx", base + ".idx")
+    os.chmod(base + ".dat", 0o644)
+    os.chmod(base + ".idx", 0o644)
+    return base
+
+
+def _read_shard_interval(base, intervals, version) -> bytes:
+    out = b""
+    for iv in intervals:
+        shard_id, off = iv.to_shard_id_and_offset(LARGE_BLOCK, SMALL_BLOCK)
+        with open(base + to_ext(shard_id), "rb") as f:
+            f.seek(off)
+            out += f.read(iv.size)
+    return out
+
+
+@pytest.mark.skipif(
+    not reference_available() or not os.path.exists(FIXTURE_BASE + ".dat"),
+    reason="reference fixtures not present",
+)
+def test_encoding_decoding_oracle(tmp_path):
+    """Encode the reference fixture volume at test-scale geometry, then read
+    back every live needle from the shards via locate_data and byte-compare
+    against the .dat — including reconstruction from 10 random other shards."""
+    base = _setup_fixture(tmp_path)
+    write_ec_files(base, large_block_size=LARGE_BLOCK, small_block_size=SMALL_BLOCK)
+    write_sorted_file_from_idx(base)
+
+    codec = CpuRSCodec()
+    dat_size = os.path.getsize(base + ".dat")
+    checked = 0
+    with open(base + ".idx", "rb") as f:
+        entries = list(iter_index(f))
+    with open(base + ".dat", "rb") as dat:
+        for key, offset_units, size in entries:
+            if offset_units == 0 or size == TOMBSTONE_FILE_SIZE:
+                continue
+            offset = to_actual_offset(offset_units)
+            actual = get_actual_size(size, VERSION3)
+            dat.seek(offset)
+            want = dat.read(actual)
+
+            intervals = locate_data(LARGE_BLOCK, SMALL_BLOCK, dat_size, offset, actual)
+            got = _read_shard_interval(base, intervals, VERSION3)
+            assert got == want, f"needle {key}: shard read != dat read"
+
+            # reconstruct each interval from 10 random OTHER shards
+            for iv in intervals[:2]:
+                shard_id, off = iv.to_shard_id_and_offset(LARGE_BLOCK, SMALL_BLOCK)
+                others = [i for i in range(TOTAL_SHARDS_COUNT) if i != shard_id]
+                chosen = random.sample(others, DATA_SHARDS_COUNT)
+                bufs = [None] * TOTAL_SHARDS_COUNT
+                for i in chosen:
+                    with open(base + to_ext(i), "rb") as f:
+                        f.seek(off)
+                        bufs[i] = np.frombuffer(f.read(iv.size), dtype=np.uint8)
+                full = codec.reconstruct(bufs, data_only=(shard_id < DATA_SHARDS_COUNT))
+                with open(base + to_ext(shard_id), "rb") as f:
+                    f.seek(off)
+                    direct = f.read(iv.size)
+                assert full[shard_id].tobytes() == direct, (
+                    f"needle {key}: reconstruction mismatch on shard {shard_id}"
+                )
+            checked += 1
+    assert checked > 10
+
+
+@pytest.mark.skipif(
+    not reference_available() or not os.path.exists(FIXTURE_BASE + ".dat"),
+    reason="reference fixtures not present",
+)
+def test_rebuild_and_decode_roundtrip(tmp_path):
+    base = _setup_fixture(tmp_path)
+    write_ec_files(base, large_block_size=LARGE_BLOCK, small_block_size=SMALL_BLOCK)
+    write_sorted_file_from_idx(base)
+
+    originals = {}
+    for i in range(TOTAL_SHARDS_COUNT):
+        with open(base + to_ext(i), "rb") as f:
+            originals[i] = f.read()
+
+    # kill 4 shards (2 data + 2 parity), rebuild, byte-compare
+    for i in (0, 7, 10, 13):
+        os.remove(base + to_ext(i))
+    generated = rebuild_ec_files(base)
+    assert sorted(generated) == [0, 7, 10, 13]
+    for i in (0, 7, 10, 13):
+        with open(base + to_ext(i), "rb") as f:
+            assert f.read() == originals[i], f"rebuilt shard {i} differs"
+
+    # decode back to .dat and compare with the original (test-scale blocks
+    # match the encode geometry, so use the generic layout-aware copy)
+    dat_size = os.path.getsize(base + ".dat")
+    with open(base + ".dat", "rb") as f:
+        original_dat = f.read()
+    os.remove(base + ".dat")
+
+    # reconstruct .dat by reading every byte range through locate_data
+    out = bytearray()
+    pos = 0
+    step = 64 * 1024
+    while pos < dat_size:
+        n = min(step, dat_size - pos)
+        intervals = locate_data(LARGE_BLOCK, SMALL_BLOCK, dat_size, pos, n)
+        out += _read_shard_interval(base, intervals, VERSION3)
+        pos += n
+    assert bytes(out) == original_dat
+
+
+def test_write_dat_file_full_scale_layout(tmp_path):
+    """Full-scale block layout roundtrip on a small synthetic volume (only
+    small blocks at this size): encode -> decode -> byte equality."""
+    base = str(tmp_path / "5")
+    rng = np.random.default_rng(7)
+    payload = rng.integers(0, 256, size=3_500_000).astype(np.uint8).tobytes()
+    with open(base + ".dat", "wb") as f:
+        f.write(payload)
+    write_ec_files(base)  # real 1GB/1MB geometry
+    os.rename(base + ".dat", base + ".dat.orig")
+    write_dat_file(base, len(payload))
+    with open(base + ".dat", "rb") as f:
+        assert f.read() == payload
+
+
+def test_ecx_search_delete_and_rebuild(tmp_path):
+    from seaweedfs_tpu.storage.needle_map import MemDb
+
+    base = str(tmp_path / "9")
+    db = MemDb()
+    keys = sorted(random.sample(range(1, 100000), 200))
+    for k in keys:
+        db.set(k, k * 2, 100 + (k % 50))
+    db.save_to_idx(base + ".idx")
+    write_sorted_file_from_idx(base)
+
+    with open(base + ".ecx", "r+b") as f:
+        size = os.path.getsize(base + ".ecx")
+        off_units, sz = search_needle_from_sorted_index(f, size, keys[50])
+        assert off_units == keys[50] * 2
+        assert sz == 100 + (keys[50] % 50)
+        with pytest.raises(NeedleNotFound):
+            search_needle_from_sorted_index(f, size, 100001)
+
+    # EcVolume delete path: tombstone in ecx + ecj journal
+    with open(base + ".ec00", "wb") as f:  # minimal shard so EcVolume opens
+        from seaweedfs_tpu.storage.super_block import SuperBlock
+
+        f.write(SuperBlock().to_bytes())
+    ev = EcVolume(str(tmp_path), "", 9)
+    ev.delete_needle_from_ecx(keys[10])
+    ev.delete_needle_from_ecx(keys[20])
+    with pytest.raises(NeedleNotFound):
+        # tombstoned entries still exist but size is TOMBSTONE
+        off_units, sz = ev.find_needle_from_ecx(keys[10])
+        if sz == TOMBSTONE_FILE_SIZE:
+            raise NeedleNotFound("deleted")
+    ev.close()
+    assert os.path.getsize(base + ".ecj") == 16  # two journaled ids
+
+    # idx regeneration from ecx+ecj appends tombstones
+    write_idx_file_from_ec_index(base)
+    with open(base + ".idx", "rb") as f:
+        entries = list(iter_index(f))
+    assert len(entries) == 202
+    assert entries[-1][2] == TOMBSTONE_FILE_SIZE
+
+    # replaying ecj into ecx drops the journal
+    rebuild_ecx_file(base)
+    assert not os.path.exists(base + ".ecj")
+
+
+def test_find_dat_file_size(tmp_path):
+    base = str(tmp_path / "3")
+    rng = np.random.default_rng(3)
+    payload = rng.integers(0, 256, size=500_000).astype(np.uint8).tobytes()
+    # build a tiny volume through the Volume engine so idx entries are real
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(str(tmp_path), "", 3)
+    pos = 0
+    while pos < len(payload):
+        n = Needle(cookie=1, id=pos + 1, data=payload[pos : pos + 10000])
+        v.write_needle(n)
+        pos += 10000
+    dat_size = v.data_file_size()
+    v.close()
+
+    write_ec_files(base, large_block_size=LARGE_BLOCK, small_block_size=SMALL_BLOCK)
+    write_sorted_file_from_idx(base)
+    assert find_dat_file_size(base) == dat_size
+
+
+def test_shard_bits():
+    b = ShardBits()
+    b = b.add(0).add(5).add(13)
+    assert b.shard_ids() == [0, 5, 13]
+    assert b.count() == 3
+    assert b.has(5) and not b.has(4)
+    assert b.remove(5).shard_ids() == [0, 13]
+    assert b.minus(ShardBits().add(0)).shard_ids() == [5, 13]
+    assert b.minus_parity_shards().shard_ids() == [0, 5]
