@@ -1,0 +1,9 @@
+"""TPU compute kernels (JAX/Pallas) for the storage hot paths:
+
+- gf256: GF(2^8) matrix-multiply over byte streams — the Reed-Solomon
+  encode/decode/rebuild engine (replaces klauspost/reedsolomon's SIMD path,
+  ref: weed/storage/erasure_coding/ec_encoder.go:198);
+- index_kernel: vectorized fid -> (offset, size) probes over sorted index
+  snapshots (replaces CompactMap's per-request binary search,
+  ref: weed/storage/needle_map/compact_map.go:145).
+"""

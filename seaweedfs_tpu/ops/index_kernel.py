@@ -1,0 +1,88 @@
+"""Vectorized needle-index probes: fid -> (offset, size) in bulk.
+
+Replaces CompactMap's per-request binary search (ref: weed/storage/
+needle_map/compact_map.go:145-172) for bulk/EC reads: the sorted index
+snapshot is uploaded once, probes run as a branchless batched binary search
+entirely on device — log2(M) gather steps over (hi, lo) uint32 key planes
+(TPU has no native 64-bit lanes).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _split_u64(keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    return (keys >> np.uint64(32)).astype(np.uint32), (
+        keys & np.uint64(0xFFFFFFFF)
+    ).astype(np.uint32)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _bulk_lookup(steps: int, khi, klo, offsets, sizes, phi, plo):
+    n = khi.shape[0]
+    p = phi.shape[0]
+    lo = jnp.zeros((p,), dtype=jnp.int32)
+    hi = jnp.full((p,), n, dtype=jnp.int32)
+
+    def body(_, carry):
+        lo, hi = carry
+        mid = (lo + hi) // 2
+        mhi = khi[mid]
+        mlo = klo[mid]
+        less = (mhi < phi) | ((mhi == phi) & (mlo < plo))
+        return jnp.where(less, mid + 1, lo), jnp.where(less, hi, mid)
+
+    lo, hi = jax.lax.fori_loop(0, steps, body, (lo, hi))
+    idx = jnp.minimum(lo, n - 1)
+    found = (lo < n) & (khi[idx] == phi) & (klo[idx] == plo)
+    return (
+        jnp.where(found, offsets[idx], 0),
+        jnp.where(found, sizes[idx], 0),
+        found,
+    )
+
+
+class IndexSnapshot:
+    """Device-resident sorted index for bulk probes.
+
+    Built from a CompactMap/NeedleMap snapshot() (sorted live entries).
+    """
+
+    def __init__(self, keys: np.ndarray, offsets: np.ndarray, sizes: np.ndarray):
+        assert len(keys) == len(offsets) == len(sizes)
+        self.n = len(keys)
+        khi, klo = _split_u64(keys)
+        self.khi = jnp.asarray(khi)
+        self.klo = jnp.asarray(klo)
+        self.offsets = jnp.asarray(offsets.astype(np.uint32))
+        self.sizes = jnp.asarray(sizes.astype(np.uint32))
+        self.steps = max(1, int(np.ceil(np.log2(max(self.n, 1)))) + 1)
+
+    @classmethod
+    def from_map(cls, needle_map) -> "IndexSnapshot":
+        keys, offsets, sizes = needle_map.snapshot()
+        return cls(keys, offsets, sizes)
+
+    def lookup(self, probe_keys: np.ndarray):
+        """probe_keys u64[P] -> (offset_units u32[P], sizes u32[P], found bool[P])."""
+        if self.n == 0:
+            p = len(probe_keys)
+            z = np.zeros(p, dtype=np.uint32)
+            return z, z.copy(), np.zeros(p, dtype=bool)
+        phi, plo = _split_u64(np.asarray(probe_keys))
+        off, size, found = _bulk_lookup(
+            self.steps,
+            self.khi,
+            self.klo,
+            self.offsets,
+            self.sizes,
+            jnp.asarray(phi),
+            jnp.asarray(plo),
+        )
+        return np.asarray(off), np.asarray(size), np.asarray(found)

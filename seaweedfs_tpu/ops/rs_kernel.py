@@ -1,0 +1,105 @@
+"""TPU Reed-Solomon codec: same interface as CpuRSCodec, compute on TPU.
+
+Encode, reconstruct and rebuild are all one primitive — a GF(2^8) constant-
+matrix multiply (gf256.gf_matmul_bytes) — applied with the parity matrix, a
+survivor-inverse matrix, or selected rows of either. Decode matrices are tiny
+(k x k) and computed host-side in numpy per missing-shard pattern; kernels are
+compiled per pattern and cached by jit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..storage.erasure_coding.galois import (
+    build_matrix,
+    mat_mul,
+    reconstruction_matrix,
+)
+from .gf256 import gf_matmul_bytes
+
+
+class TpuRSCodec:
+    """Drop-in for CpuRSCodec with JAX/Pallas compute.
+
+    Accepts numpy or jax uint8 arrays of shape [shards, N]; returns numpy
+    arrays (the storage pipeline writes them straight to shard files).
+    """
+
+    def __init__(
+        self,
+        data_shards: int = 10,
+        parity_shards: int = 4,
+        force_pallas: Optional[bool] = None,
+        interpret: bool = False,
+    ):
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        self.matrix = build_matrix(data_shards, self.total_shards)
+        self.parity_matrix = self.matrix[data_shards:]
+        self._force_pallas = force_pallas
+        self._interpret = interpret
+
+    def _apply(self, matrix: np.ndarray, data) -> np.ndarray:
+        out = gf_matmul_bytes(
+            matrix,
+            data,
+            force_pallas=self._force_pallas,
+            interpret=self._interpret,
+        )
+        return np.asarray(out)
+
+    def encode(self, data) -> np.ndarray:
+        """uint8[k, N] -> parity uint8[m, N]."""
+        return self._apply(self.parity_matrix, data)
+
+    def encode_all(self, data) -> np.ndarray:
+        data_np = np.asarray(data, dtype=np.uint8)
+        return np.concatenate([data_np, self.encode(data)], axis=0)
+
+    def verify(self, shards) -> bool:
+        shards = np.asarray(shards, dtype=np.uint8)
+        return bool(
+            np.array_equal(self.encode(shards[: self.data_shards]),
+                           shards[self.data_shards :])
+        )
+
+    def reconstruct(
+        self, shards: Sequence[Optional[np.ndarray]], data_only: bool = False
+    ) -> list:
+        shards = list(shards)
+        if len(shards) != self.total_shards:
+            raise ValueError(f"expected {self.total_shards} shard slots")
+        present = [i for i, s in enumerate(shards) if s is not None]
+        if len(present) < self.data_shards:
+            raise ValueError(f"too few shards: {len(present)} < {self.data_shards}")
+        missing_data = [i for i in range(self.data_shards) if shards[i] is None]
+        missing_parity = [
+            i for i in range(self.data_shards, self.total_shards) if shards[i] is None
+        ]
+        if not missing_data and not missing_parity:
+            return shards
+
+        survivors = present[: self.data_shards]
+        sub = np.stack([np.asarray(shards[i], dtype=np.uint8) for i in survivors])
+
+        if missing_data or (missing_parity and not data_only):
+            dec = reconstruction_matrix(self.matrix, survivors)
+            # one fused kernel: [missing_data rows; missing_parity rows] where
+            # parity rows are (parity_matrix . dec) applied to the survivors
+            rows = []
+            if missing_data:
+                rows.append(dec[np.asarray(missing_data)])
+            if missing_parity and not data_only:
+                par_rows = self.matrix[np.asarray(missing_parity)]
+                rows.append(mat_mul(par_rows, dec))
+            m = np.concatenate(rows, axis=0)
+            recovered = self._apply(m, sub)
+            targets = missing_data + (missing_parity if not data_only else [])
+            for out_row, i in enumerate(targets):
+                shards[i] = recovered[out_row]
+        return shards
