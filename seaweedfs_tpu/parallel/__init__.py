@@ -1,0 +1,23 @@
+"""Multi-chip parallelism for the EC compute plane.
+
+The storage-system analogue of dp/sp parallelism (SURVEY.md §2.5): volumes
+are the batch dimension (dp), the byte stream inside a stripe is the sequence
+dimension (sp), and the 14 output shards are the model-parallel outputs.
+Sharding rides `jax.sharding.Mesh` + `shard_map`; encode is elementwise
+across bytes so sharding needs no collectives, while distributed verify /
+degraded reconstruction use psum / all_gather over ICI.
+"""
+
+from .sharded_ec import (
+    make_mesh,
+    sharded_encode,
+    sharded_verify,
+    sharded_reconstruct_step,
+)
+
+__all__ = [
+    "make_mesh",
+    "sharded_encode",
+    "sharded_verify",
+    "sharded_reconstruct_step",
+]

@@ -1,8 +1,4 @@
-"""Test config: force JAX onto a virtual 8-device CPU mesh.
-
-Multi-chip TPU hardware is not available in CI; sharding/collective tests run
-against 8 virtual CPU devices. Must run before jax initializes a backend.
-"""
+"""Test config (see repo-root conftest.py for the CPU re-exec)."""
 
 import os
 import sys

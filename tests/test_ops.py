@@ -10,7 +10,7 @@ from seaweedfs_tpu.storage.erasure_coding.coder_cpu import CpuRSCodec
 from seaweedfs_tpu.storage.needle_map import CompactMap
 
 
-@pytest.mark.parametrize("n", [1, 100, 4096, 100_000])
+@pytest.mark.parametrize("n", [4096, 100_001])
 def test_gf_matmul_jnp_matches_cpu_oracle(n):
     cpu = CpuRSCodec()
     rng = np.random.default_rng(n)
