@@ -1,0 +1,98 @@
+"""North-star benchmark: RS(10,4) ec.encode throughput on TPU vs CPU baseline.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+- TPU number: steady-state Pallas GF(2^8) encode over HBM-resident packed
+  stripe batches (the BASELINE.json batched-multi-volume configuration).
+  Timing uses K-run slope with a host digest pull per measurement, because
+  block_until_ready on tunneled backends can return before execution
+  completes — the slope between K=4 and K=20 cancels the constant RTT.
+- CPU baseline: the same encode via the single-threaded table-gather numpy
+  path, standing in for the reference's single-threaded
+  klauspost/reedsolomon pipeline (ref: ec_encoder.go:120-136; BASELINE.md
+  notes the reference publishes no ec.encode number).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def measure_cpu_baseline(codec, data: np.ndarray, min_seconds: float = 1.0) -> float:
+    """GB/s of data encoded by the numpy single-thread path."""
+    codec.encode(data[:, : 1 << 16])  # warm tables
+    n_bytes = data.size
+    iters = 0
+    t0 = time.perf_counter()
+    while True:
+        codec.encode(data)
+        iters += 1
+        dt = time.perf_counter() - t0
+        if dt >= min_seconds and iters >= 2:
+            return n_bytes * iters / dt / 1e9
+
+
+def measure_tpu(parity_matrix, packed_np: np.ndarray) -> float:
+    """GB/s of data encoded on device (slope-timed)."""
+    import jax
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ops.gf256 import gf_matmul_packed
+
+    packed = jax.device_put(jnp.asarray(packed_np))
+    n_bytes = packed_np.size * 4
+
+    encode = jax.jit(lambda p: gf_matmul_packed(parity_matrix, p))
+    digest = jax.jit(lambda x: x.sum(dtype=jnp.uint32))
+
+    _ = np.asarray(digest(encode(packed)))  # compile + warm
+
+    def run(k: int) -> float:
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(k):
+            out = encode(packed)
+        _ = np.asarray(digest(out))  # forces the whole FIFO queue to drain
+        return time.perf_counter() - t0
+
+    run(2)  # warm the pull path
+    t_lo = min(run(4) for _ in range(3))
+    t_hi = min(run(20) for _ in range(3))
+    per_iter = (t_hi - t_lo) / 16
+    return n_bytes / per_iter / 1e9
+
+
+def main() -> None:
+    from seaweedfs_tpu.ops.gf256 import pack_bytes_host
+    from seaweedfs_tpu.storage.erasure_coding.coder_cpu import CpuRSCodec
+
+    codec = CpuRSCodec()
+    rng = np.random.default_rng(0)
+
+    # CPU baseline on a 40MB stripe batch (single-threaded numpy)
+    cpu_data = rng.integers(0, 256, size=(10, 4 << 20), dtype=np.uint8)
+    cpu_gbps = measure_cpu_baseline(codec, cpu_data)
+
+    # TPU on a 160MB HBM-resident stripe batch
+    data = rng.integers(0, 256, size=(10, 16 << 20), dtype=np.uint8)
+    packed = pack_bytes_host(data)
+    tpu_gbps = measure_tpu(codec.parity_matrix, packed)
+
+    print(
+        json.dumps(
+            {
+                "metric": "ec.encode_throughput",
+                "value": round(tpu_gbps, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(tpu_gbps / cpu_gbps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -126,7 +126,12 @@ DEFAULT_BLOCK_ROWS = 512  # 512 x 128 lanes x 4B = 256KB per shard slice
 
 
 def pack_bytes(data, n: int, granule: int):
-    """uint8[C, n] -> packed uint32[C, padded_n/4], zero-padded to granule."""
+    """uint8[C, n] -> packed uint32[C, padded_n/4], zero-padded to granule.
+
+    jnp path — note: on TPU an on-device u8->u32 bitcast is a RELAYOUT
+    (different tilings) and costs ~30x the kernel itself; prefer
+    pack_bytes_host for host-resident data.
+    """
     padded_n = ((n + granule - 1) // granule) * granule
     if padded_n != n:
         data = jnp.pad(data, ((0, 0), (0, padded_n - n)))
@@ -136,9 +141,57 @@ def pack_bytes(data, n: int, granule: int):
 
 
 def unpack_bytes(packed, n: int):
-    """packed uint32[R, m] -> uint8[R, n]."""
+    """packed uint32[R, m] -> uint8[R, n] (jnp path; see pack_bytes note)."""
     b = jax.lax.bitcast_convert_type(packed, jnp.uint8)
     return b.reshape(packed.shape[0], -1)[:, :n]
+
+
+def pack_bytes_host(data: np.ndarray, granule: int = 4) -> np.ndarray:
+    """Host-side free packing: numpy uint8[C, n] -> uint32[C, padded_n/4]."""
+    c, n = data.shape
+    padded_n = ((n + granule - 1) // granule) * granule
+    if padded_n != n:
+        padded = np.zeros((c, padded_n), dtype=np.uint8)
+        padded[:, :n] = data
+        data = padded
+    return np.ascontiguousarray(data).view(np.uint32)
+
+
+def unpack_bytes_host(packed: np.ndarray, n: int) -> np.ndarray:
+    """Host-side free unpacking: uint32[R, m] -> uint8[R, n]."""
+    return np.ascontiguousarray(packed).view(np.uint8)[:, :n]
+
+
+def gf_matmul_packed(
+    matrix: np.ndarray,
+    packed,
+    block_rows: int = DEFAULT_BLOCK_ROWS,
+    force_pallas: bool | None = None,
+    interpret: bool = False,
+):
+    """GF(2^8) matmul on packed words: uint32[C, W] -> uint32[R, W].
+
+    The native device API — keeps data uint32 end-to-end (the kernel is
+    HBM-bound at this layout; measured ~450 GB/s data throughput on v5e).
+    W must be a multiple of (block_rows * LANE) for the Pallas path; the
+    jnp path takes any W.
+    """
+    matrix = np.asarray(matrix, dtype=np.uint8)
+    key = tuple(map(tuple, matrix))
+    packed = jnp.asarray(packed, dtype=jnp.uint32)
+    assert packed.shape[0] == matrix.shape[1], (packed.shape, matrix.shape)
+
+    use_pallas = force_pallas if force_pallas is not None else _on_tpu()
+    w = packed.shape[1]
+    if not use_pallas and not interpret:
+        return _gf_matmul_jnp_packed(key, packed)
+    granule = block_rows * LANE
+    if w % granule:
+        pad = granule - w % granule
+        packed = jnp.pad(packed, ((0, 0), (0, pad)))
+    packed3d = packed.reshape(packed.shape[0], -1, LANE)
+    out = _gf_matmul_pallas(key, packed3d, block_rows, interpret)
+    return out.reshape(out.shape[0], -1)[:, :w]
 
 
 def gf_matmul_bytes(
@@ -151,19 +204,26 @@ def gf_matmul_bytes(
     """GF(2^8) matmul over flat byte rows: uint8[C, N] -> uint8[R, N].
 
     Zero padding is exact (zero bytes yield zero parity columns, truncated on
-    return). Runs the Pallas kernel on TPU, the jnp packed path elsewhere.
+    return). Host numpy input is packed with a free view; device input falls
+    back to on-device bitcasts (slow on TPU — prefer gf_matmul_packed).
     """
     matrix = np.asarray(matrix, dtype=np.uint8)
-    key = tuple(map(tuple, matrix))
-    data = jnp.asarray(data, dtype=jnp.uint8)
     assert data.shape[0] == matrix.shape[1], (data.shape, matrix.shape)
     n = data.shape[1]
 
+    if isinstance(data, np.ndarray):
+        packed = pack_bytes_host(data.astype(np.uint8, copy=False))
+        out = gf_matmul_packed(
+            matrix, packed, block_rows, force_pallas, interpret
+        )
+        return unpack_bytes_host(np.asarray(out), n)
+
+    key = tuple(map(tuple, matrix))
+    data = jnp.asarray(data, dtype=jnp.uint8)
     use_pallas = force_pallas if force_pallas is not None else _on_tpu()
     if not use_pallas and not interpret:
         packed = pack_bytes(data, n, 4)
         return unpack_bytes(_gf_matmul_jnp_packed(key, packed), n)
-
     granule = block_rows * LANE * 4
     packed = pack_bytes(data, n, granule)
     packed3d = packed.reshape(packed.shape[0], -1, LANE)

@@ -1,0 +1,46 @@
+"""ErasureCoder selection: the `storage.backend=tpu` switch.
+
+The reference hard-codes klauspost/reedsolomon (ref: ec_encoder.go:198);
+here the codec is an injected dependency of the EC file pipeline and the
+volume-server EC handlers, selected by configuration:
+
+    [storage]
+    backend = "tpu"     # or "cpu"
+
+Both implementations expose the same interface (encode / encode_all /
+verify / reconstruct over uint8[shards, N]) and produce byte-identical
+output.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def get_codec(
+    backend: str = "cpu",
+    data_shards: int = 10,
+    parity_shards: int = 4,
+    interpret: bool = False,
+):
+    if backend == "tpu":
+        from ..ops.rs_kernel import TpuRSCodec
+
+        return TpuRSCodec(data_shards, parity_shards, interpret=interpret)
+    if backend == "cpu":
+        from ..storage.erasure_coding.coder_cpu import CpuRSCodec
+
+        return CpuRSCodec(data_shards, parity_shards)
+    raise ValueError(f"unknown storage backend {backend!r} (want 'cpu' or 'tpu')")
+
+
+def detect_backend() -> str:
+    """'tpu' when a TPU is attached, else 'cpu'."""
+    try:
+        import jax
+
+        if jax.devices()[0].platform == "tpu":
+            return "tpu"
+    except Exception:
+        pass
+    return "cpu"
