@@ -1,0 +1,106 @@
+"""MasterClient: KeepConnected stream consumer maintaining the vid ->
+locations cache (ref: weed/wdclient/masterclient.go, vid_map.go)."""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Optional
+
+from ..pb import grpc_address
+from ..pb.rpc import Stub
+
+
+class VidMap:
+    """vid -> [urls] with round-robin-ish random picking
+    (ref: wdclient/vid_map.go:23-45)."""
+
+    def __init__(self):
+        self._map: dict[int, list[str]] = {}
+
+    def lookup(self, vid: int) -> list[str]:
+        return list(self._map.get(vid, []))
+
+    def pick(self, vid: int) -> Optional[str]:
+        locs = self._map.get(vid)
+        if not locs:
+            return None
+        return random.choice(locs)
+
+    def add(self, vid: int, url: str) -> None:
+        locs = self._map.setdefault(vid, [])
+        if url not in locs:
+            locs.append(url)
+
+    def remove(self, vid: int, url: str) -> None:
+        locs = self._map.get(vid)
+        if locs and url in locs:
+            locs.remove(url)
+            if not locs:
+                del self._map[vid]
+
+
+class MasterClient:
+    def __init__(self, name: str, masters: list[str]):
+        self.name = name
+        self.masters = masters
+        self.current_master = masters[0]
+        self.vid_map = VidMap()
+        self._task: Optional[asyncio.Task] = None
+        self._connected = asyncio.Event()
+
+    async def start(self) -> None:
+        self._task = asyncio.ensure_future(self._keep_connected_loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    async def wait_connected(self, timeout: float = 10.0) -> None:
+        await asyncio.wait_for(self._connected.wait(), timeout)
+
+    async def _keep_connected_loop(self) -> None:
+        """(ref masterclient.go:47-121 — follows leader redirects)"""
+        while True:
+            for master in self.masters:
+                try:
+                    await self._consume(master)
+                except asyncio.CancelledError:
+                    return
+                except Exception:
+                    pass
+                self._connected.clear()
+                await asyncio.sleep(0.5)
+
+    async def _consume(self, master: str) -> None:
+        stub = Stub(grpc_address(master), "master")
+        call = stub.bidi_stream("KeepConnected")
+        await call.write({"name": self.name})
+        self.current_master = master
+        while True:
+            msg = await call.read()
+            if msg is None:
+                return
+            url = msg.get("url")
+            if url:
+                for vid in msg.get("new_vids", []):
+                    self.vid_map.add(int(vid), url)
+                for vid in msg.get("deleted_vids", []):
+                    self.vid_map.remove(int(vid), url)
+            leader = msg.get("leader")
+            self._connected.set()
+            if leader and leader != master and leader not in ("",):
+                if leader not in self.masters:
+                    self.masters.append(leader)
+
+    def lookup_file_id(self, fid: str) -> str:
+        """fid -> full http url (ref vid_map.go:57-70)."""
+        vid = int(fid.split(",")[0])
+        url = self.vid_map.pick(vid)
+        if url is None:
+            raise LookupError(f"volume {vid} not found in cache")
+        return f"http://{url}/{fid}"

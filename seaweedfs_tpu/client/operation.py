@@ -1,0 +1,118 @@
+"""Client operations: assign, upload, lookup, delete, submit
+(ref: weed/operation/assign_file_id.go, upload_content.go, submit.go,
+delete_content.go)."""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Optional
+
+import aiohttp
+
+from ..pb import grpc_address
+from ..pb.rpc import Stub
+
+
+@dataclass
+class AssignResult:
+    fid: str
+    url: str
+    public_url: str
+    count: int
+
+
+async def assign(
+    master: str,
+    count: int = 1,
+    collection: str = "",
+    replication: str = "",
+    ttl: str = "",
+    data_center: str = "",
+) -> AssignResult:
+    stub = Stub(grpc_address(master), "master")
+    resp = await stub.call(
+        "Assign",
+        {
+            "count": count,
+            "collection": collection,
+            "replication": replication,
+            "ttl": ttl,
+            "dataCenter": data_center,
+        },
+    )
+    if resp.get("error"):
+        raise RuntimeError(f"assign: {resp['error']}")
+    return AssignResult(
+        fid=resp["fid"],
+        url=resp["url"],
+        public_url=resp.get("publicUrl", resp["url"]),
+        count=int(resp.get("count", count)),
+    )
+
+
+async def upload_data(
+    session: aiohttp.ClientSession,
+    url: str,
+    fid: str,
+    data: bytes,
+    filename: str = "",
+    mime: str = "",
+    ttl: str = "",
+) -> dict:
+    target = f"http://{url}/{fid}"
+    if ttl:
+        target += f"?ttl={ttl}"
+    form = aiohttp.FormData()
+    form.add_field(
+        "file", data, filename=filename or "file", content_type=mime or None
+    )
+    async with session.post(target, data=form) as resp:
+        body = await resp.json()
+        if resp.status >= 300 or body.get("error"):
+            raise RuntimeError(f"upload {fid}: {resp.status} {body.get('error')}")
+        return body
+
+
+async def read_url(session: aiohttp.ClientSession, full_url: str) -> bytes:
+    async with session.get(full_url) as resp:
+        if resp.status != 200:
+            raise RuntimeError(f"read {full_url}: status {resp.status}")
+        return await resp.read()
+
+
+async def delete_file(
+    session: aiohttp.ClientSession, url: str, fid: str
+) -> dict:
+    async with session.delete(f"http://{url}/{fid}") as resp:
+        return await resp.json()
+
+
+async def lookup(master: str, vid: int, collection: str = "") -> list[str]:
+    stub = Stub(grpc_address(master), "master")
+    resp = await stub.call(
+        "LookupVolume", {"volume_ids": [str(vid)], "collection": collection}
+    )
+    for r in resp.get("volume_id_locations", []):
+        if r.get("locations"):
+            return [l["url"] for l in r["locations"]]
+    return []
+
+
+async def submit_file(
+    session: aiohttp.ClientSession,
+    master: str,
+    data: bytes,
+    filename: str = "",
+    collection: str = "",
+    replication: str = "",
+    ttl: str = "",
+) -> tuple[str, dict]:
+    """assign + upload in one call (ref operation/submit.go:41)."""
+    ar = await assign(
+        master, collection=collection, replication=replication, ttl=ttl
+    )
+    result = await upload_data(
+        session, ar.url, ar.fid, data, filename=filename, ttl=ttl
+    )
+    return ar.fid, result

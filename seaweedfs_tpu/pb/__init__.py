@@ -1,0 +1,18 @@
+"""RPC layer: gRPC transport with msgpack message bodies.
+
+Mirrors the reference's RPC architecture (ref: weed/pb/): gRPC for control +
+maintenance streams, HTTP for the client data plane, and the port convention
+gRPC port = HTTP port + 10000 (ref: weed/pb/grpc_client_server.go:119).
+Messages are msgpack-encoded dicts (grpcio's dynamic method handlers; the
+environment has no protoc-python-grpc plugin, and cross-language wire
+compatibility is not a goal — semantic parity with master.proto /
+volume_server.proto is).
+"""
+
+GRPC_PORT_OFFSET = 10000
+
+
+def grpc_address(http_address: str) -> str:
+    """host:port -> host:(port+10000) (ref grpc_client_server.go:119-140)."""
+    host, _, port = http_address.rpartition(":")
+    return f"{host}:{int(port) + GRPC_PORT_OFFSET}"

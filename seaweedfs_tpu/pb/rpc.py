@@ -1,0 +1,197 @@
+"""Minimal dynamic-gRPC framework: named methods, msgpack bodies.
+
+Servers register async handler methods on a Service; clients call through
+a Stub that lazily opens cached channels with keepalive (mirroring the
+reference's shared dial helper, ref: weed/pb/grpc_client_server.go:56-140).
+
+Method kinds: unary_unary, unary_stream, stream_stream — enough for the
+reference's surface (heartbeat bidi stream, KeepConnected push stream,
+CopyFile/EcShardRead download streams, everything else unary).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Dict
+
+import grpc
+import grpc.aio
+import msgpack
+
+UNARY_UNARY = "unary_unary"
+UNARY_STREAM = "unary_stream"
+STREAM_STREAM = "stream_stream"
+
+
+def _pack(obj: Any) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def _unpack(data: bytes) -> Any:
+    return msgpack.unpackb(data, raw=False, strict_map_key=False)
+
+
+@dataclass
+class _Method:
+    kind: str
+    handler: Callable
+
+
+class Service:
+    """One named gRPC service; register handlers then add to a server."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._methods: Dict[str, _Method] = {}
+
+    def unary(self, method_name: str):
+        def deco(fn):
+            self._methods[method_name] = _Method(UNARY_UNARY, fn)
+            return fn
+
+        return deco
+
+    def server_stream(self, method_name: str):
+        def deco(fn):
+            self._methods[method_name] = _Method(UNARY_STREAM, fn)
+            return fn
+
+        return deco
+
+    def bidi_stream(self, method_name: str):
+        def deco(fn):
+            self._methods[method_name] = _Method(STREAM_STREAM, fn)
+            return fn
+
+        return deco
+
+    def build_handler(self) -> grpc.GenericRpcHandler:
+        rpc_handlers = {}
+        for mname, m in self._methods.items():
+            if m.kind == UNARY_UNARY:
+
+                def make_uu(handler):
+                    async def call(request, context):
+                        return _pack(await handler(_unpack(request), context))
+
+                    return call
+
+                rpc_handlers[mname] = grpc.unary_unary_rpc_method_handler(
+                    make_uu(m.handler),
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b,
+                )
+            elif m.kind == UNARY_STREAM:
+
+                def make_us(handler):
+                    async def call(request, context):
+                        async for item in handler(_unpack(request), context):
+                            yield _pack(item)
+
+                    return call
+
+                rpc_handlers[mname] = grpc.unary_stream_rpc_method_handler(
+                    make_us(m.handler),
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b,
+                )
+            elif m.kind == STREAM_STREAM:
+
+                def make_ss(handler):
+                    async def call(request_iterator, context):
+                        async def decoded():
+                            async for raw in request_iterator:
+                                yield _unpack(raw)
+
+                        async for item in handler(decoded(), context):
+                            yield _pack(item)
+
+                    return call
+
+                rpc_handlers[mname] = grpc.stream_stream_rpc_method_handler(
+                    make_ss(m.handler),
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b,
+                )
+        return grpc.method_handlers_generic_handler(self.name, rpc_handlers)
+
+
+_KEEPALIVE_OPTIONS = [
+    ("grpc.keepalive_time_ms", 30_000),
+    ("grpc.keepalive_timeout_ms", 10_000),
+    ("grpc.max_send_message_length", 64 << 20),
+    ("grpc.max_receive_message_length", 64 << 20),
+]
+
+
+class Stub:
+    """Client for one Service over a (cached) channel."""
+
+    def __init__(self, address: str, service_name: str):
+        self.address = address
+        self.service = service_name
+        self._channel = get_channel(address)
+
+    def _path(self, method: str) -> str:
+        return f"/{self.service}/{method}"
+
+    async def call(self, method: str, request: Any, timeout: float | None = 30):
+        fn = self._channel.unary_unary(
+            self._path(method),
+            request_serializer=_pack,
+            response_deserializer=_unpack,
+        )
+        return await fn(request, timeout=timeout)
+
+    def server_stream(
+        self, method: str, request: Any, timeout: float | None = None
+    ) -> AsyncIterator[Any]:
+        fn = self._channel.unary_stream(
+            self._path(method),
+            request_serializer=_pack,
+            response_deserializer=_unpack,
+        )
+        return fn(request, timeout=timeout)
+
+    def bidi_stream(self, method: str, request_iterator=None):
+        fn = self._channel.stream_stream(
+            self._path(method),
+            request_serializer=_pack,
+            response_deserializer=_unpack,
+        )
+        return fn(request_iterator) if request_iterator is not None else fn()
+
+
+_channels: Dict[str, grpc.aio.Channel] = {}
+_channels_lock = threading.Lock()
+
+
+def get_channel(address: str) -> grpc.aio.Channel:
+    """Cached insecure channel with keepalive (ref grpc_client_server.go:56)."""
+    with _channels_lock:
+        ch = _channels.get(address)
+        if ch is None:
+            ch = grpc.aio.insecure_channel(address, options=_KEEPALIVE_OPTIONS)
+            _channels[address] = ch
+        return ch
+
+
+async def close_all_channels() -> None:
+    with _channels_lock:
+        channels = list(_channels.values())
+        _channels.clear()
+    for ch in channels:
+        await ch.close()
+
+
+async def serve(
+    bind_address: str, *services: Service
+) -> grpc.aio.Server:
+    server = grpc.aio.server(options=_KEEPALIVE_OPTIONS)
+    for svc in services:
+        server.add_generic_rpc_handlers((svc.build_handler(),))
+    server.add_insecure_port(bind_address)
+    await server.start()
+    return server

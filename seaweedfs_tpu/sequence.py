@@ -1,0 +1,31 @@
+"""Monotonic file-id sequencer (ref: weed/sequence/memory_sequencer.go).
+
+The etcd-backed variant (etcd_sequencer.go) is out of scope until a
+multi-master deployment needs it; the interface matches.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class MemorySequencer:
+    def __init__(self, start: int = 1):
+        self._counter = start
+        self._lock = threading.Lock()
+
+    def next_file_id(self, count: int) -> int:
+        """Reserve `count` ids; returns the first."""
+        with self._lock:
+            start = self._counter
+            self._counter += count
+            return start
+
+    def set_max(self, seen_value: int) -> None:
+        with self._lock:
+            if self._counter <= seen_value:
+                self._counter = seen_value + 1
+
+    def peek(self) -> int:
+        with self._lock:
+            return self._counter

@@ -1,0 +1,549 @@
+"""Master server: volume -> location mapping and file-id assignment.
+
+HTTP (data-plane control, ref: weed/server/master_server.go:112-130):
+  /dir/assign /dir/lookup /dir/status /vol/grow /vol/vacuum /col/delete
+  /{fileId} redirect
+gRPC (ref: weed/server/master_grpc_server*.go):
+  SendHeartbeat (bidi; full + delta volume/EC inventories),
+  KeepConnected (vid-location push to clients), Assign, Statistics,
+  LookupVolume, LookupEcVolume, CollectionList/Delete, VolumeList,
+  LeaseAdminToken/ReleaseAdminToken.
+
+Single-master deployment this round: the leader is always self (the
+reference's raft backs only leader election + max-volume-id,
+ref: weed/topology/topology.go:115-122 — our max-volume-id is served by the
+same in-process topology the allocations go through).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from ..pb import grpc_address
+from ..pb.rpc import Service, Stub, serve
+from ..sequence import MemorySequencer
+from ..storage.erasure_coding.ec_volume import ShardBits
+from ..storage.super_block import ReplicaPlacement
+from ..storage.ttl import TTL
+from ..topology import GrowOption, Topology, VolumeGrowth
+from ..topology.volume_growth import NoFreeSpaceError, grow_count_for_copy_level
+
+
+class MasterServer:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 9333,
+        volume_size_limit_mb: int = 30_000,
+        default_replication: str = "000",
+        garbage_threshold: float = 0.3,
+        pulse_seconds: float = 5.0,
+    ):
+        self.host = host
+        self.port = port
+        self.address = f"{host}:{port}"
+        self.default_replication = default_replication
+        self.garbage_threshold = garbage_threshold
+        self.pulse_seconds = pulse_seconds
+        self.topo = Topology(
+            volume_size_limit=volume_size_limit_mb * 1024 * 1024,
+            sequencer=MemorySequencer(),
+        )
+        self.growth = VolumeGrowth()
+        self._clients: dict[str, asyncio.Queue] = {}
+        self._admin_token: Optional[tuple[int, float]] = None  # (token, ts)
+        self._http_runner: Optional[web.AppRunner] = None
+        self._grpc_server = None
+        self._shutdown = False
+
+    @property
+    def leader(self) -> str:
+        return self.address
+
+    # ---------------- lifecycle ----------------
+    async def start(self) -> None:
+        app = web.Application()
+        app.router.add_route("*", "/dir/assign", self._dir_assign)
+        app.router.add_route("*", "/dir/lookup", self._dir_lookup)
+        app.router.add_get("/dir/status", self._dir_status)
+        app.router.add_route("*", "/vol/grow", self._vol_grow)
+        app.router.add_route("*", "/vol/vacuum", self._vol_vacuum)
+        app.router.add_route("*", "/col/delete", self._col_delete)
+        app.router.add_get("/cluster/status", self._cluster_status)
+        app.router.add_get("/{file_id:[0-9]+,.+}", self._redirect)
+        self._http_runner = web.AppRunner(app)
+        await self._http_runner.setup()
+        site = web.TCPSite(self._http_runner, self.host, self.port)
+        await site.start()
+
+        svc = Service("master")
+        svc.bidi_stream("SendHeartbeat")(self._send_heartbeat)
+        svc.bidi_stream("KeepConnected")(self._keep_connected)
+        svc.unary("Assign")(self._grpc_assign)
+        svc.unary("LookupVolume")(self._grpc_lookup_volume)
+        svc.unary("LookupEcVolume")(self._grpc_lookup_ec_volume)
+        svc.unary("Statistics")(self._grpc_statistics)
+        svc.unary("CollectionList")(self._grpc_collection_list)
+        svc.unary("CollectionDelete")(self._grpc_collection_delete)
+        svc.unary("VolumeList")(self._grpc_volume_list)
+        svc.unary("LeaseAdminToken")(self._grpc_lease_admin_token)
+        svc.unary("ReleaseAdminToken")(self._grpc_release_admin_token)
+        svc.unary("GetMasterConfiguration")(self._grpc_get_configuration)
+        self._grpc_server = await serve(grpc_address(self.address), svc)
+
+    async def stop(self) -> None:
+        self._shutdown = True
+        if self._grpc_server is not None:
+            await self._grpc_server.stop(0.5)
+        if self._http_runner is not None:
+            await self._http_runner.cleanup()
+
+    # ---------------- assignment core ----------------
+    def _parse_option(self, params) -> GrowOption:
+        return GrowOption(
+            collection=params.get("collection", ""),
+            replica_placement=ReplicaPlacement.parse(
+                params.get("replication", "") or self.default_replication
+            ),
+            ttl=TTL.read(params.get("ttl", "")),
+            data_center=params.get("dataCenter", ""),
+            rack=params.get("rack", ""),
+        )
+
+    async def _allocate_volume(self, vid: int, option: GrowOption, servers) -> bool:
+        """AllocateVolume RPC to each chosen server (ref
+        topology/allocate_volume.go)."""
+        ok = True
+        for dn in servers:
+            stub = Stub(grpc_address(dn.url), "volume")
+            try:
+                resp = await stub.call(
+                    "AllocateVolume",
+                    {
+                        "volume_id": vid,
+                        "collection": option.collection,
+                        "replication": str(option.replica_placement),
+                        "ttl": str(option.ttl),
+                        "preallocate": option.preallocate,
+                    },
+                )
+                ok = ok and not resp.get("error")
+            except Exception:
+                ok = False
+        return ok
+
+    async def _ensure_writable(self, option: GrowOption) -> None:
+        layout = self.topo.get_volume_layout(
+            option.collection, option.replica_placement, option.ttl
+        )
+        if layout.has_writable_volume():
+            return
+        count = grow_count_for_copy_level(option.replica_placement.copy_count())
+        grown = await self.growth.grow_by_count(
+            count, self.topo, option, self._allocate_volume
+        )
+        if grown == 0:
+            raise NoFreeSpaceError("no free volumes left")
+
+    async def _do_assign(self, params) -> dict:
+        count = int(params.get("count", 1) or 1)
+        option = self._parse_option(params)
+        try:
+            await self._ensure_writable(option)
+            fid, cnt, locations = self.topo.pick_for_write(
+                count, option.collection, option.replica_placement, option.ttl
+            )
+        except (NoFreeSpaceError, LookupError) as e:
+            return {"error": str(e)}
+        dn = locations[0]
+        return {
+            "fid": fid,
+            "url": dn.url,
+            "publicUrl": dn.public_url,
+            "count": cnt,
+        }
+
+    def _do_lookup(self, vid_str: str, collection: str = "") -> dict:
+        try:
+            vid = int(vid_str.split(",")[0])
+        except ValueError:
+            return {"volumeId": vid_str, "error": "unknown volumeId format"}
+        locations = self.topo.lookup(collection, vid)
+        if not locations:
+            ec = self.topo.lookup_ec_shards(vid)
+            if ec is not None:
+                urls = sorted(
+                    {dn.url for locs in ec.locations for dn in locs}
+                )
+                if urls:
+                    return {
+                        "volumeId": vid_str,
+                        "locations": [
+                            {"url": u, "publicUrl": u} for u in urls
+                        ],
+                    }
+            return {"volumeId": vid_str, "error": "volume id not found"}
+        return {
+            "volumeId": vid_str,
+            "locations": [
+                {"url": dn.url, "publicUrl": dn.public_url} for dn in locations
+            ],
+        }
+
+    # ---------------- HTTP handlers ----------------
+    async def _dir_assign(self, request: web.Request) -> web.Response:
+        params = dict(request.query)
+        if request.method == "POST":
+            params.update(dict(await request.post()))
+        return web.json_response(await self._do_assign(params))
+
+    async def _dir_lookup(self, request: web.Request) -> web.Response:
+        params = dict(request.query)
+        if request.method == "POST":
+            params.update(dict(await request.post()))
+        vid = params.get("volumeId", "")
+        return web.json_response(
+            self._do_lookup(vid, params.get("collection", ""))
+        )
+
+    async def _dir_status(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"Topology": self.topo.to_info(), "Version": "seaweedfs-tpu 0.1"}
+        )
+
+    async def _vol_grow(self, request: web.Request) -> web.Response:
+        params = dict(request.query)
+        option = self._parse_option(params)
+        count = int(params.get("count", 1) or 1)
+        grown = await self.growth.grow_by_count(
+            count, self.topo, option, self._allocate_volume
+        )
+        if grown == 0:
+            return web.json_response({"error": "no free volumes left"}, status=404)
+        return web.json_response({"count": grown})
+
+    async def _vol_vacuum(self, request: web.Request) -> web.Response:
+        threshold = float(
+            request.query.get("garbageThreshold", self.garbage_threshold)
+        )
+        results = await self.vacuum(threshold)
+        return web.json_response({"Result": results})
+
+    async def _col_delete(self, request: web.Request) -> web.Response:
+        collection = request.query.get("collection", "")
+        for dn in self.topo.data_nodes():
+            stub = Stub(grpc_address(dn.url), "volume")
+            try:
+                await stub.call("DeleteCollection", {"collection": collection})
+            except Exception:
+                pass
+        self.topo.delete_collection(collection)
+        return web.json_response({})
+
+    async def _cluster_status(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"IsLeader": True, "Leader": self.leader, "Peers": []}
+        )
+
+    async def _redirect(self, request: web.Request) -> web.Response:
+        file_id = request.match_info["file_id"]
+        result = self._do_lookup(file_id.split(",")[0])
+        if "error" in result:
+            return web.json_response(result, status=404)
+        url = result["locations"][0]["publicUrl"]
+        raise web.HTTPMovedPermanently(location=f"http://{url}/{file_id}")
+
+    # ---------------- gRPC: heartbeats ----------------
+    async def _send_heartbeat(self, request_iterator, context):
+        """Bidi heartbeat stream from one volume server
+        (ref: master_grpc_server.go:20-178)."""
+        dn = None
+        try:
+            async for hb in request_iterator:
+                if dn is None and hb.get("ip"):
+                    dc = self.topo.get_or_create_data_center(
+                        hb.get("data_center") or "DefaultDataCenter"
+                    )
+                    rack = dc.get_or_create_rack(hb.get("rack") or "DefaultRack")
+                    dn = rack.get_or_create_data_node(
+                        f"{hb['ip']}:{hb['port']}",
+                        f"{hb['ip']}:{hb['port']}",
+                        hb.get("public_url", ""),
+                        int(hb.get("max_volume_count", 7)),
+                    )
+                if dn is None:
+                    continue
+                dn.last_seen = time.time()
+                if hb.get("max_file_key"):
+                    self.topo.sequence.set_max(int(hb["max_file_key"]))
+
+                new_vids, deleted_vids = [], []
+                if hb.get("volumes") is not None or hb.get("has_no_volumes"):
+                    new_infos, deleted_infos = dn.update_volumes(
+                        hb.get("volumes") or []
+                    )
+                    for info in hb.get("volumes") or []:
+                        self.topo.register_volume(info, dn)
+                    for info in deleted_infos:
+                        self.topo.unregister_volume(info, dn)
+                    new_vids += [int(i["id"]) for i in new_infos]
+                    deleted_vids += [int(i["id"]) for i in deleted_infos]
+                if hb.get("new_volumes"):
+                    dn.delta_update_volumes(hb["new_volumes"], [])
+                    for info in hb["new_volumes"]:
+                        self.topo.register_volume(info, dn)
+                        new_vids.append(int(info["id"]))
+                if hb.get("deleted_volumes"):
+                    dn.delta_update_volumes([], hb["deleted_volumes"])
+                    for info in hb["deleted_volumes"]:
+                        self.topo.unregister_volume(info, dn)
+                        deleted_vids.append(int(info["id"]))
+
+                if hb.get("ec_shards") is not None or hb.get("has_no_ec_shards"):
+                    new_ec, deleted_ec = dn.update_ec_shards(
+                        hb.get("ec_shards") or []
+                    )
+                    for vid, collection, bits in new_ec:
+                        self.topo.register_ec_shards(vid, collection, bits, dn)
+                        new_vids.append(vid)
+                    for vid, collection, bits in deleted_ec:
+                        self.topo.unregister_ec_shards(vid, collection, bits, dn)
+                if hb.get("new_ec_shards"):
+                    for m in hb["new_ec_shards"]:
+                        bits = ShardBits(int(m["ec_index_bits"]))
+                        dn.delta_update_ec_shards(
+                            [(int(m["id"]), m.get("collection", ""), bits)], []
+                        )
+                        self.topo.register_ec_shards(
+                            int(m["id"]), m.get("collection", ""), bits, dn
+                        )
+                        new_vids.append(int(m["id"]))
+                if hb.get("deleted_ec_shards"):
+                    for m in hb["deleted_ec_shards"]:
+                        bits = ShardBits(int(m["ec_index_bits"]))
+                        dn.delta_update_ec_shards(
+                            [], [(int(m["id"]), m.get("collection", ""), bits)]
+                        )
+                        self.topo.unregister_ec_shards(
+                            int(m["id"]), m.get("collection", ""), bits, dn
+                        )
+                        if not dn.ec_shards.get(int(m["id"])):
+                            deleted_vids.append(int(m["id"]))
+
+                if new_vids or deleted_vids:
+                    self._broadcast_location(
+                        dn, new_vids=new_vids, deleted_vids=deleted_vids
+                    )
+
+                yield {
+                    "volume_size_limit": self.topo.volume_size_limit,
+                    "leader": self.leader,
+                    "metrics_interval_seconds": 15,
+                }
+        finally:
+            if dn is not None:
+                self._unregister_data_node(dn)
+
+    def _unregister_data_node(self, dn) -> None:
+        """Heartbeat stream broke: drop all its volumes/EC shards
+        (ref master_grpc_server.go:24-52)."""
+        deleted = []
+        for info in list(dn.volumes.values()):
+            self.topo.unregister_volume(info, dn)
+            deleted.append(int(info["id"]))
+        for vid, bits in list(dn.ec_shards.items()):
+            self.topo.unregister_ec_shards(vid, "", bits, dn)
+            deleted.append(vid)
+        dn.update_volumes([])
+        dn.update_ec_shards([])
+        if dn.parent:
+            dn.parent.unlink_child(dn.id)
+        if deleted:
+            self._broadcast_location(dn, new_vids=[], deleted_vids=deleted)
+
+    def _broadcast_location(self, dn, new_vids, deleted_vids) -> None:
+        msg = {
+            "url": dn.url,
+            "public_url": dn.public_url,
+            "new_vids": sorted(set(new_vids)),
+            "deleted_vids": sorted(set(deleted_vids)),
+            "leader": self.leader,
+        }
+        for q in list(self._clients.values()):
+            try:
+                q.put_nowait(msg)
+            except asyncio.QueueFull:
+                pass
+
+    # ---------------- gRPC: client push ----------------
+    async def _keep_connected(self, request_iterator, context):
+        """vid-location push stream (ref master_grpc_server.go:182-235)."""
+        first = await request_iterator.__anext__()
+        client_name = f"{first.get('name', 'client')}@{id(context)}"
+        queue: asyncio.Queue = asyncio.Queue(maxsize=10_000)
+        self._clients[client_name] = queue
+
+        # initial full state
+        for dn in self.topo.data_nodes():
+            vids = sorted(set(list(dn.volumes.keys()) + list(dn.ec_shards.keys())))
+            if vids:
+                yield {
+                    "url": dn.url,
+                    "public_url": dn.public_url,
+                    "new_vids": vids,
+                    "deleted_vids": [],
+                    "leader": self.leader,
+                }
+
+        async def drain_requests():
+            try:
+                async for _ in request_iterator:
+                    pass
+            except Exception:
+                pass
+
+        drainer = asyncio.ensure_future(drain_requests())
+        try:
+            while not self._shutdown:
+                try:
+                    msg = await asyncio.wait_for(queue.get(), timeout=5.0)
+                    yield msg
+                except asyncio.TimeoutError:
+                    yield {"leader": self.leader}  # keepalive tick
+        finally:
+            drainer.cancel()
+            self._clients.pop(client_name, None)
+
+    # ---------------- gRPC: unary ----------------
+    async def _grpc_assign(self, req, context) -> dict:
+        return await self._do_assign(req)
+
+    async def _grpc_lookup_volume(self, req, context) -> dict:
+        results = []
+        for vid in req.get("volume_ids", []):
+            results.append(self._do_lookup(str(vid), req.get("collection", "")))
+        return {"volume_id_locations": results}
+
+    async def _grpc_lookup_ec_volume(self, req, context) -> dict:
+        """(ref master_grpc_server_volume.go LookupEcVolume)"""
+        vid = int(req["volume_id"])
+        locs = self.topo.lookup_ec_shards(vid)
+        if locs is None:
+            return {"error": f"ec volume {vid} not found"}
+        shard_locations = []
+        for shard_id, nodes in enumerate(locs.locations):
+            if nodes:
+                shard_locations.append(
+                    {
+                        "shard_id": shard_id,
+                        "locations": [
+                            {"url": dn.url, "public_url": dn.public_url}
+                            for dn in nodes
+                        ],
+                    }
+                )
+        return {"volume_id": vid, "shard_id_locations": shard_locations}
+
+    async def _grpc_statistics(self, req, context) -> dict:
+        return {
+            "used_size": sum(
+                int(v.get("size", 0))
+                for dn in self.topo.data_nodes()
+                for v in dn.volumes.values()
+            ),
+        }
+
+    async def _grpc_collection_list(self, req, context) -> dict:
+        return {"collections": [{"name": c} for c in self.topo.collections]}
+
+    async def _grpc_collection_delete(self, req, context) -> dict:
+        name = req.get("name", "")
+        for dn in self.topo.data_nodes():
+            stub = Stub(grpc_address(dn.url), "volume")
+            try:
+                await stub.call("DeleteCollection", {"collection": name})
+            except Exception:
+                pass
+        self.topo.delete_collection(name)
+        return {}
+
+    async def _grpc_volume_list(self, req, context) -> dict:
+        return {
+            "topology_info": self.topo.to_info(),
+            "volume_size_limit_mb": self.topo.volume_size_limit // (1024 * 1024),
+        }
+
+    async def _grpc_lease_admin_token(self, req, context) -> dict:
+        """Cluster-wide exclusive admin lock
+        (ref master_grpc_server_admin.go:113-131)."""
+        now = time.time()
+        prev = int(req.get("previous_token", 0))
+        if self._admin_token is not None:
+            token, ts = self._admin_token
+            if now - ts < 10 and token != prev:
+                return {"error": "already locked"}
+        token = int(now * 1e9) & 0x7FFFFFFFFFFFFFFF
+        self._admin_token = (token, now)
+        return {"token": token, "lock_ts_ns": int(now * 1e9)}
+
+    async def _grpc_release_admin_token(self, req, context) -> dict:
+        if self._admin_token and self._admin_token[0] == int(
+            req.get("previous_token", 0)
+        ):
+            self._admin_token = None
+        return {}
+
+    async def _grpc_get_configuration(self, req, context) -> dict:
+        return {
+            "metrics_address": "",
+            "metrics_interval_seconds": 15,
+        }
+
+    # ---------------- vacuum driver (ref topology_vacuum.go) ----------------
+    async def vacuum(self, garbage_threshold: float) -> list[dict]:
+        results = []
+        for collection in list(self.topo.collections.values()):
+            for layout in collection.layouts():
+                for vid, nodes in list(layout.vid_to_locations.items()):
+                    checks = []
+                    for dn in nodes:
+                        stub = Stub(grpc_address(dn.url), "volume")
+                        try:
+                            r = await stub.call(
+                                "VacuumVolumeCheck", {"volume_id": vid}
+                            )
+                            checks.append(float(r.get("garbage_ratio", 0)))
+                        except Exception:
+                            checks.append(0.0)
+                    if not checks or min(checks) < garbage_threshold:
+                        continue
+                    ok = True
+                    for dn in nodes:
+                        stub = Stub(grpc_address(dn.url), "volume")
+                        try:
+                            r = await stub.call(
+                                "VacuumVolumeCompact", {"volume_id": vid},
+                                timeout=600,
+                            )
+                            ok = ok and not r.get("error")
+                        except Exception:
+                            ok = False
+                    for dn in nodes:
+                        stub = Stub(grpc_address(dn.url), "volume")
+                        try:
+                            if ok:
+                                await stub.call(
+                                    "VacuumVolumeCommit", {"volume_id": vid}
+                                )
+                            else:
+                                await stub.call(
+                                    "VacuumVolumeCleanup", {"volume_id": vid}
+                                )
+                        except Exception:
+                            pass
+                    results.append({"volume_id": vid, "compacted": ok})
+        return results

@@ -1,0 +1,497 @@
+"""Volume server: HTTP data plane + gRPC admin + heartbeat loop.
+
+HTTP (ref: weed/server/volume_server_handlers_{read,write}.go):
+  GET/HEAD /{vid},{fid}[/name][.ext]  read (EC fallback when no volume)
+  POST     /{vid},{fid}               write (+ synchronous replication fan-out,
+                                      ref: weed/topology/store_replicate.go:20)
+  DELETE   /{vid},{fid}               delete (+ replication fan-out)
+
+gRPC "volume" service (ref: weed/server/volume_grpc_*.go): allocation,
+vacuum, mount/unmount, copy streams, batch delete, and the EC suite
+(see volume_ec.py).
+
+Heartbeat loop (ref: weed/server/volume_grpc_client_to_master.go): bidi
+stream to the master carrying full inventories at connect + deltas per tick;
+EC full-state refresh every 17 pulses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from ..pb import grpc_address
+from ..pb.rpc import Service, Stub, serve
+from ..storage.erasure_coding import to_ext
+from ..storage.file_id import FileId
+from ..storage.needle import Needle, NotFoundError
+from ..storage.store import Store
+from ..storage.volume import AlreadyDeleted, CookieMismatch, NotFound
+from ..storage import vacuum as vacuum_mod
+from .volume_ec import EcHandlers
+
+
+class VolumeServer(EcHandlers):
+    def __init__(
+        self,
+        master: str,
+        directories: list[str],
+        host: str = "127.0.0.1",
+        port: int = 8080,
+        public_url: str = "",
+        max_volume_counts: Optional[list[int]] = None,
+        pulse_seconds: float = 1.0,
+        data_center: str = "",
+        rack: str = "",
+        codec_backend: str = "cpu",
+    ):
+        self.master = master
+        self.host = host
+        self.port = port
+        self.address = f"{host}:{port}"
+        self.public_url = public_url or self.address
+        self.pulse_seconds = pulse_seconds
+        self.data_center = data_center
+        self.rack = rack
+        self.codec_backend = codec_backend
+        self.store = Store(
+            host,
+            port,
+            self.public_url,
+            directories,
+            max_volume_counts or [7] * len(directories),
+        )
+        self.store.load()
+        self._http_runner: Optional[web.AppRunner] = None
+        self._grpc_server = None
+        self._heartbeat_task: Optional[asyncio.Task] = None
+        self._http_client: Optional[aiohttp.ClientSession] = None
+        self._shutdown = False
+        self._codec = None
+
+    @property
+    def codec(self):
+        if self._codec is None:
+            from ..tpu.coder import get_codec
+
+            self._codec = get_codec(self.codec_backend)
+        return self._codec
+
+    # ---------------- lifecycle ----------------
+    async def start(self) -> None:
+        self._http_client = aiohttp.ClientSession()
+        app = web.Application(client_max_size=256 << 20)
+        app.router.add_route("*", "/{tail:.*}", self._dispatch)
+        self._http_runner = web.AppRunner(app)
+        await self._http_runner.setup()
+        site = web.TCPSite(self._http_runner, self.host, self.port)
+        await site.start()
+
+        svc = Service("volume")
+        svc.unary("AllocateVolume")(self._grpc_allocate_volume)
+        svc.unary("VolumeMount")(self._grpc_volume_mount)
+        svc.unary("VolumeUnmount")(self._grpc_volume_unmount)
+        svc.unary("VolumeDelete")(self._grpc_volume_delete)
+        svc.unary("VolumeMarkReadonly")(self._grpc_volume_mark_readonly)
+        svc.unary("DeleteCollection")(self._grpc_delete_collection)
+        svc.unary("VacuumVolumeCheck")(self._grpc_vacuum_check)
+        svc.unary("VacuumVolumeCompact")(self._grpc_vacuum_compact)
+        svc.unary("VacuumVolumeCommit")(self._grpc_vacuum_commit)
+        svc.unary("VacuumVolumeCleanup")(self._grpc_vacuum_cleanup)
+        svc.unary("BatchDelete")(self._grpc_batch_delete)
+        svc.unary("VolumeServerStatus")(self._grpc_status)
+        svc.server_stream("CopyFile")(self._grpc_copy_file)
+        self.register_ec_rpcs(svc)
+        self._grpc_server = await serve(grpc_address(self.address), svc)
+
+        self._heartbeat_task = asyncio.ensure_future(self._heartbeat_loop())
+
+    async def stop(self) -> None:
+        self._shutdown = True
+        if self._heartbeat_task is not None:
+            self._heartbeat_task.cancel()
+            try:
+                await self._heartbeat_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._grpc_server is not None:
+            await self._grpc_server.stop(0.5)
+        if self._http_runner is not None:
+            await self._http_runner.cleanup()
+        if self._http_client is not None:
+            await self._http_client.close()
+        self.store.close()
+
+    # ---------------- heartbeat (ref volume_grpc_client_to_master.go) ----------------
+    async def _heartbeat_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                await self._heartbeat_once()
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                await asyncio.sleep(self.pulse_seconds)
+
+    async def _heartbeat_once(self) -> None:
+        stub = Stub(grpc_address(self.master), "master")
+        call = stub.bidi_stream("SendHeartbeat")
+
+        async def write_full(with_ec: bool = True) -> None:
+            hb = self.store.collect_heartbeat()
+            hb["data_center"] = self.data_center
+            hb["rack"] = self.rack
+            if with_ec:
+                hb.update(self.store.collect_ec_heartbeat())
+            await call.write(hb)
+
+        await write_full()
+        tick = 0
+        while not self._shutdown:
+            try:
+                resp = await asyncio.wait_for(call.read(), timeout=self.pulse_seconds)
+                if resp is not None and resp != aiohttp.http.EMPTY_PAYLOAD:
+                    if isinstance(resp, dict) and resp.get("volume_size_limit"):
+                        self.store.volume_size_limit = int(resp["volume_size_limit"])
+            except asyncio.TimeoutError:
+                pass
+            tick += 1
+            deltas = self.store.drain_deltas()
+            hb = {"ip": self.host, "port": self.port}
+            if any(deltas.values()):
+                hb.update({k: v for k, v in deltas.items() if v})
+            if tick % 17 == 0:
+                # periodic full EC state (ref :121 — EC tick = 17 x pulse)
+                hb.update(self.store.collect_ec_heartbeat())
+            await call.write(hb)
+            await asyncio.sleep(self.pulse_seconds)
+
+    # ---------------- HTTP dispatch ----------------
+    async def _dispatch(self, request: web.Request) -> web.StreamResponse:
+        path = request.path
+        if path == "/status":
+            return web.json_response({"Version": "seaweedfs-tpu", "Volumes": []})
+        try:
+            if request.method in ("GET", "HEAD"):
+                return await self._handle_read(request)
+            if request.method in ("POST", "PUT"):
+                return await self._handle_write(request)
+            if request.method == "DELETE":
+                return await self._handle_delete(request)
+        except (NotFound, NotFoundError, AlreadyDeleted, LookupError) as e:
+            return web.json_response({"error": str(e)}, status=404)
+        except CookieMismatch as e:
+            return web.json_response({"error": str(e)}, status=403)
+        return web.json_response({"error": "method not allowed"}, status=405)
+
+    def _parse_fid_path(self, path: str) -> tuple[FileId, str]:
+        parts = path.lstrip("/").split("/")
+        fid_part = parts[0]
+        filename = parts[1] if len(parts) > 1 else ""
+        ext = ""
+        if "." in fid_part:
+            fid_part, _, ext = fid_part.partition(".")
+        if "," not in fid_part and len(parts) > 1 and "," in parts[1]:
+            # /vid/fid form
+            fid_part = parts[0] + "," + parts[1]
+        return FileId.parse(fid_part), filename
+
+    # ---------------- read (ref volume_server_handlers_read.go) ----------------
+    async def _handle_read(self, request: web.Request) -> web.StreamResponse:
+        fid, _filename = self._parse_fid_path(request.path)
+        vid = fid.volume_id
+
+        if self.store.has_volume(vid):
+            n = Needle(id=fid.key)
+            self.store.read_volume_needle(vid, n)
+            if n.cookie != fid.cookie:
+                return web.json_response({"error": "cookie mismatch"}, status=404)
+            return self._needle_response(request, n)
+
+        ev = self.store.find_ec_volume(vid)
+        if ev is not None:
+            n = await self.read_ec_needle(ev, fid.key)
+            if n is None:
+                return web.json_response({"error": "not found"}, status=404)
+            if n.cookie != fid.cookie:
+                return web.json_response({"error": "cookie mismatch"}, status=404)
+            return self._needle_response(request, n)
+
+        # not local: redirect via master lookup (ref :41-53)
+        result = await self._lookup_volume(vid)
+        if result:
+            url = result[0]
+            if url != self.address and url != self.public_url:
+                raise web.HTTPMovedPermanently(
+                    location=f"http://{url}{request.path_qs}"
+                )
+        return web.json_response({"error": "volume not found"}, status=404)
+
+    def _needle_response(self, request: web.Request, n: Needle) -> web.Response:
+        headers = {"Etag": f'"{n.etag()}"'}
+        if n.last_modified:
+            headers["Last-Modified-Ts"] = str(n.last_modified)
+        body = bytes(n.data)
+        if n.is_compressed():
+            accept = request.headers.get("Accept-Encoding", "")
+            if "gzip" in accept:
+                headers["Content-Encoding"] = "gzip"
+            else:
+                import gzip as _gzip
+
+                body = _gzip.decompress(body)
+        content_type = (
+            n.mime.decode() if n.mime else "application/octet-stream"
+        )
+        if request.method == "HEAD":
+            headers["Content-Length"] = str(len(body))
+            return web.Response(status=200, headers=headers)
+        return web.Response(body=body, content_type=content_type, headers=headers)
+
+    # ---------------- write (ref volume_server_handlers_write.go) ----------------
+    async def _parse_upload(self, request: web.Request) -> tuple[bytes, str, str]:
+        """-> (data, filename, mime)"""
+        content_type = request.headers.get("Content-Type", "")
+        if content_type.startswith("multipart/form-data"):
+            reader = await request.multipart()
+            async for part in reader:
+                if part.name in ("file", "upload") or part.filename:
+                    data = await part.read(decode=False)
+                    return (
+                        bytes(data),
+                        part.filename or "",
+                        part.headers.get("Content-Type", ""),
+                    )
+            return b"", "", ""
+        return await request.read(), "", content_type
+
+    async def _handle_write(self, request: web.Request) -> web.Response:
+        fid, _ = self._parse_fid_path(request.path)
+        vid = fid.volume_id
+        if not self.store.has_volume(vid):
+            return web.json_response({"error": f"volume {vid} not found"}, status=404)
+
+        data, filename, mime = await self._parse_upload(request)
+        n = Needle(cookie=fid.cookie, id=fid.key, data=data)
+        if filename:
+            n.set_name(filename.encode())
+        if mime and mime != "application/octet-stream":
+            n.set_mime(mime.encode())
+        ts = request.query.get("ts")
+        if ts:
+            n.set_last_modified(int(ts))
+        ttl = request.query.get("ttl")
+        if ttl:
+            from ..storage.ttl import TTL
+
+            n.set_ttl(TTL.read(ttl))
+
+        is_replicate = request.query.get("type") == "replicate"
+        offset, size, unchanged = self.store.write_volume_needle(vid, n)
+
+        if not is_replicate:
+            err = await self._replicate(request, vid, "POST", await self._raw_body(n))
+            if err:
+                return web.json_response({"error": err}, status=500)
+        return web.json_response(
+            {"name": filename, "size": size, "eTag": n.etag()}, status=201
+        )
+
+    async def _raw_body(self, n: Needle) -> bytes:
+        return bytes(n.data)
+
+    async def _handle_delete(self, request: web.Request) -> web.Response:
+        fid, _ = self._parse_fid_path(request.path)
+        vid = fid.volume_id
+        is_replicate = request.query.get("type") == "replicate"
+
+        if self.store.has_volume(vid):
+            n = Needle(id=fid.key, cookie=fid.cookie)
+            try:
+                check = Needle(id=fid.key)
+                self.store.read_volume_needle(vid, check)
+                if check.cookie != fid.cookie:
+                    return web.json_response({"error": "cookie mismatch"}, status=403)
+            except (NotFound, AlreadyDeleted):
+                return web.json_response({"size": 0}, status=404)
+            size = self.store.delete_volume_needle(vid, n)
+            if not is_replicate:
+                await self._replicate(request, vid, "DELETE", b"")
+            return web.json_response({"size": size}, status=202)
+
+        ev = self.store.find_ec_volume(vid)
+        if ev is not None:
+            size = await self.delete_ec_needle(ev, fid.key)
+            return web.json_response({"size": size}, status=202)
+        return web.json_response({"error": "volume not found"}, status=404)
+
+    # ---------------- replication (ref store_replicate.go:20-121) ----------------
+    async def _lookup_volume(self, vid: int) -> list[str]:
+        try:
+            stub = Stub(grpc_address(self.master), "master")
+            resp = await stub.call("LookupVolume", {"volume_ids": [str(vid)]})
+            for r in resp.get("volume_id_locations", []):
+                if int(r.get("volumeId", "0").split(",")[0]) == vid and r.get(
+                    "locations"
+                ):
+                    return [l["url"] for l in r["locations"]]
+        except Exception:
+            pass
+        return []
+
+    async def _replicate(
+        self, request: web.Request, vid: int, method: str, body: bytes
+    ) -> str:
+        v = self.store.find_volume(vid)
+        if v is None or v.super_block.replica_placement.copy_count() <= 1:
+            return ""
+        locations = await self._lookup_volume(vid)
+        others = [u for u in locations if u not in (self.address, self.public_url)]
+        if len(others) + 1 < v.super_block.replica_placement.copy_count():
+            return f"replicating to {len(others)} replicas, need more"
+        errs = []
+
+        async def one(url: str) -> None:
+            target = f"http://{url}{request.path}?type=replicate"
+            q = {k: v for k, v in request.query.items() if k != "type"}
+            if q:
+                target += "&" + "&".join(f"{k}={v}" for k, v in q.items())
+            try:
+                if method == "POST":
+                    form = aiohttp.FormData()
+                    form.add_field("file", body, filename="replica")
+                    async with self._http_client.post(target, data=form) as resp:
+                        if resp.status >= 300:
+                            errs.append(f"{url}: status {resp.status}")
+                else:
+                    async with self._http_client.delete(target) as resp:
+                        if resp.status >= 400 and resp.status != 404:
+                            errs.append(f"{url}: status {resp.status}")
+            except Exception as e:
+                errs.append(f"{url}: {e}")
+
+        await asyncio.gather(*(one(u) for u in others))
+        return "; ".join(errs)
+
+    # ---------------- gRPC admin ----------------
+    async def _grpc_allocate_volume(self, req, context) -> dict:
+        try:
+            self.store.add_volume(
+                int(req["volume_id"]),
+                req.get("collection", ""),
+                req.get("replication", "000") or "000",
+                req.get("ttl", "") or "",
+                int(req.get("preallocate", 0)),
+            )
+            return {}
+        except Exception as e:
+            return {"error": str(e)}
+
+    async def _grpc_volume_mount(self, req, context) -> dict:
+        self.store.mount_volume(int(req["volume_id"]))
+        return {}
+
+    async def _grpc_volume_unmount(self, req, context) -> dict:
+        self.store.unmount_volume(int(req["volume_id"]))
+        return {}
+
+    async def _grpc_volume_delete(self, req, context) -> dict:
+        self.store.delete_volume(int(req["volume_id"]))
+        return {}
+
+    async def _grpc_volume_mark_readonly(self, req, context) -> dict:
+        self.store.mark_volume_readonly(int(req["volume_id"]))
+        return {}
+
+    async def _grpc_delete_collection(self, req, context) -> dict:
+        collection = req.get("collection", "")
+        for loc in self.store.locations:
+            for vid, v in list(loc.volumes.items()):
+                if v.collection == collection:
+                    loc.delete_volume(vid)
+        return {}
+
+    async def _grpc_vacuum_check(self, req, context) -> dict:
+        v = self.store.find_volume(int(req["volume_id"]))
+        if v is None:
+            return {"error": "volume not found"}
+        return {"garbage_ratio": v.garbage_level()}
+
+    async def _grpc_vacuum_compact(self, req, context) -> dict:
+        v = self.store.find_volume(int(req["volume_id"]))
+        if v is None:
+            return {"error": "volume not found"}
+        loop = asyncio.get_event_loop()
+        try:
+            await loop.run_in_executor(None, vacuum_mod.compact2, v)
+            return {}
+        except Exception as e:
+            return {"error": str(e)}
+
+    async def _grpc_vacuum_commit(self, req, context) -> dict:
+        vid = int(req["volume_id"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return {"error": "volume not found"}
+        loop = asyncio.get_event_loop()
+        try:
+            new_v = await loop.run_in_executor(None, vacuum_mod.commit_compact, v)
+            for loc in self.store.locations:
+                if loc.find_volume(vid) is not None:
+                    loc.volumes[vid] = new_v
+            return {}
+        except Exception as e:
+            return {"error": str(e)}
+
+    async def _grpc_vacuum_cleanup(self, req, context) -> dict:
+        v = self.store.find_volume(int(req["volume_id"]))
+        if v is not None:
+            vacuum_mod.cleanup_compact(v)
+        return {}
+
+    async def _grpc_batch_delete(self, req, context) -> dict:
+        results = []
+        for fid_str in req.get("file_ids", []):
+            try:
+                fid = FileId.parse(fid_str)
+                n = Needle(id=fid.key, cookie=fid.cookie)
+                size = self.store.delete_volume_needle(fid.volume_id, n)
+                results.append({"file_id": fid_str, "status": 202, "size": size})
+            except Exception as e:
+                results.append({"file_id": fid_str, "status": 500, "error": str(e)})
+        return {"results": results}
+
+    async def _grpc_status(self, req, context) -> dict:
+        return {
+            "volumes": [
+                self.store._volume_message(v)
+                for loc in self.store.locations
+                for v in loc.volumes.values()
+            ],
+        }
+
+    async def _grpc_copy_file(self, req, context):
+        """Stream a volume file's bytes (ref volume_grpc_copy.go doCopyFile).
+
+        req: {volume_id, collection, ext, compaction_revision,
+              stop_offset, is_ec_volume}
+        """
+        vid = int(req["volume_id"])
+        collection = req.get("collection", "")
+        ext = req["ext"]
+        from ..storage.volume import volume_base_name
+
+        for loc in self.store.locations:
+            base = volume_base_name(loc.directory, collection, vid)
+            path = base + ext
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    while True:
+                        chunk = f.read(1 << 20)
+                        if not chunk:
+                            return
+                        yield {"file_content": chunk}
+        yield {"error": f"{vid}{ext} not found"}

@@ -1,0 +1,450 @@
+"""Volume-server EC handlers: the 9 EC RPCs + the distributed EC read path.
+
+RPC surface mirrors the reference (ref: weed/server/
+volume_grpc_erasure_coding.go:39-391): Generate / Rebuild / Copy / Delete /
+Mount / Unmount / ShardRead(stream) / BlobDelete / ShardsToVolume.
+
+Read path (ref: weed/storage/store_ec.go:119-373): locate the needle via the
+local sorted .ecx, map to shard intervals, read each interval from a local
+shard, else a remote shard holder (VolumeEcShardRead stream), else
+reconstruct on the fly from any 10 shards through the RS codec (the TPU
+kernel when storage.backend=tpu). Shard locations come from the master's
+LookupEcVolume, cached with a TTL refresh.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from typing import Optional
+
+from ..pb import grpc_address
+from ..pb.rpc import Stub
+from ..storage.erasure_coding import (
+    DATA_SHARDS_COUNT,
+    TOTAL_SHARDS_COUNT,
+    rebuild_ec_files,
+    to_ext,
+    write_dat_file,
+    write_ec_files,
+    write_idx_file_from_ec_index,
+    write_sorted_file_from_idx,
+    find_dat_file_size,
+)
+from ..storage.erasure_coding.ec_volume import (
+    EcVolume,
+    NeedleNotFound,
+    ShardBits,
+    rebuild_ecx_file,
+)
+from ..storage.needle import Needle, get_actual_size
+from ..storage.volume import volume_base_name
+from ..storage.volume_info import VolumeInfo, save_volume_info
+from ..types import TOMBSTONE_FILE_SIZE, to_actual_offset
+
+SHARD_LOCATION_TTL = 10.0  # seconds between LookupEcVolume refreshes
+
+
+class EcHandlers:
+    """Mixin for VolumeServer (expects .store, .master, .codec, .address)."""
+
+    def register_ec_rpcs(self, svc) -> None:
+        svc.unary("VolumeEcShardsGenerate")(self._grpc_ec_generate)
+        svc.unary("VolumeEcShardsRebuild")(self._grpc_ec_rebuild)
+        svc.unary("VolumeEcShardsCopy")(self._grpc_ec_copy)
+        svc.unary("VolumeEcShardsDelete")(self._grpc_ec_delete)
+        svc.unary("VolumeEcShardsMount")(self._grpc_ec_mount)
+        svc.unary("VolumeEcShardsUnmount")(self._grpc_ec_unmount)
+        svc.server_stream("VolumeEcShardRead")(self._grpc_ec_shard_read)
+        svc.unary("VolumeEcBlobDelete")(self._grpc_ec_blob_delete)
+        svc.unary("VolumeEcShardsToVolume")(self._grpc_ec_shards_to_volume)
+
+    def _base_name(self, collection: str, vid: int) -> Optional[str]:
+        v = self.store.find_volume(vid)
+        if v is not None:
+            return v.file_name()
+        for loc in self.store.locations:
+            base = volume_base_name(loc.directory, collection, vid)
+            if any(
+                os.path.exists(base + ext) for ext in (".ecx", ".dat", ".ec00")
+            ):
+                return base
+        return None
+
+    # ---------------- RPCs ----------------
+    async def _grpc_ec_generate(self, req, context) -> dict:
+        """.dat/.idx -> .ec00-13 + .ecx + .vif (ref :39-75)."""
+        vid = int(req["volume_id"])
+        collection = req.get("collection", "")
+        base = self._base_name(collection, vid)
+        if base is None:
+            return {"error": f"volume {vid} not found"}
+        loop = asyncio.get_event_loop()
+        try:
+            await loop.run_in_executor(
+                None, lambda: write_ec_files(base, codec=self.codec)
+            )
+            await loop.run_in_executor(None, write_sorted_file_from_idx, base)
+            v = self.store.find_volume(vid)
+            save_volume_info(
+                base + ".vif", VolumeInfo(version=v.version if v else 3)
+            )
+            return {}
+        except Exception as e:
+            return {"error": str(e)}
+
+    async def _grpc_ec_rebuild(self, req, context) -> dict:
+        """Rebuild missing local shards from >=10 present (ref :77-106)."""
+        vid = int(req["volume_id"])
+        collection = req.get("collection", "")
+        base = self._base_name(collection, vid)
+        if base is None:
+            return {"error": f"volume {vid} not found"}
+        loop = asyncio.get_event_loop()
+        try:
+            rebuilt = await loop.run_in_executor(
+                None, lambda: rebuild_ec_files(base, codec=self.codec)
+            )
+            return {"rebuilt_shard_ids": rebuilt}
+        except Exception as e:
+            return {"error": str(e)}
+
+    async def _grpc_ec_copy(self, req, context) -> dict:
+        """Pull shards (+ index files) from a source server via its CopyFile
+        stream (ref :108-164)."""
+        vid = int(req["volume_id"])
+        collection = req.get("collection", "")
+        shard_ids = [int(s) for s in req.get("shard_ids", [])]
+        source = req["source_data_node"]
+        loc = max(
+            self.store.locations,
+            key=lambda l: l.max_volume_count - len(l.volumes),
+        )
+        base = volume_base_name(loc.directory, collection, vid)
+        stub = Stub(grpc_address(source), "volume")
+
+        async def pull(ext: str) -> None:
+            tmp = base + ext + ".tmp"
+            with open(tmp, "wb") as f:
+                async for msg in stub.server_stream(
+                    "CopyFile",
+                    {"volume_id": vid, "collection": collection, "ext": ext,
+                     "is_ec_volume": True},
+                ):
+                    if msg.get("error"):
+                        raise IOError(msg["error"])
+                    f.write(msg.get("file_content", b""))
+            os.replace(tmp, base + ext)
+
+        try:
+            for shard_id in shard_ids:
+                await pull(to_ext(shard_id))
+            if req.get("copy_ecx_file", True):
+                await pull(".ecx")
+                try:
+                    await pull(".ecj")
+                except Exception:
+                    with open(base + ".ecj", "wb"):
+                        pass
+                try:
+                    await pull(".vif")
+                except Exception:
+                    save_volume_info(base + ".vif", VolumeInfo(version=3))
+            return {}
+        except Exception as e:
+            return {"error": str(e)}
+
+    async def _grpc_ec_delete(self, req, context) -> dict:
+        """Remove local shard files; drop index files with the last shard
+        (ref :166-216)."""
+        vid = int(req["volume_id"])
+        collection = req.get("collection", "")
+        shard_ids = [int(s) for s in req.get("shard_ids", [])]
+        base = self._base_name(collection, vid)
+        if base is None:
+            return {}
+        for shard_id in shard_ids:
+            try:
+                os.remove(base + to_ext(shard_id))
+            except FileNotFoundError:
+                pass
+        remaining = [
+            i
+            for i in range(TOTAL_SHARDS_COUNT)
+            if os.path.exists(base + to_ext(i))
+        ]
+        if not remaining:
+            for ext in (".ecx", ".ecj", ".vif"):
+                try:
+                    os.remove(base + ext)
+                except FileNotFoundError:
+                    pass
+        return {}
+
+    async def _grpc_ec_mount(self, req, context) -> dict:
+        """(ref :218-244)"""
+        vid = int(req["volume_id"])
+        collection = req.get("collection", "")
+        shard_ids = [int(s) for s in req.get("shard_ids", [])]
+        added = ShardBits()
+        try:
+            for shard_id in shard_ids:
+                for loc in self.store.locations:
+                    base = volume_base_name(loc.directory, collection, vid)
+                    if os.path.exists(base + to_ext(shard_id)):
+                        loc.load_ec_shard(collection, vid, shard_id)
+                        added = added.add(shard_id)
+                        break
+            if added.bits:
+                self.store.note_ec_shards_changed(
+                    vid, collection, added, ShardBits()
+                )
+            return {}
+        except Exception as e:
+            return {"error": str(e)}
+
+    async def _grpc_ec_unmount(self, req, context) -> dict:
+        """(ref :246-268)"""
+        vid = int(req["volume_id"])
+        shard_ids = [int(s) for s in req.get("shard_ids", [])]
+        removed = ShardBits()
+        for shard_id in shard_ids:
+            for loc in self.store.locations:
+                if loc.unload_ec_shard(vid, shard_id):
+                    removed = removed.add(shard_id)
+                    break
+        if removed.bits:
+            self.store.note_ec_shards_changed(vid, "", ShardBits(), removed)
+        return {}
+
+    async def _grpc_ec_shard_read(self, req, context):
+        """Stream bytes of one local shard (ref :270-325)."""
+        vid = int(req["volume_id"])
+        shard_id = int(req["shard_id"])
+        offset = int(req.get("offset", 0))
+        size = int(req.get("size", 0))
+        shard = self.store.find_ec_shard(vid, shard_id)
+        if shard is None:
+            yield {"error": f"ec shard {vid}.{shard_id} not found"}
+            return
+        # optional liveness check of the whole needle (ref :283-298)
+        if req.get("file_key"):
+            ev = self.store.find_ec_volume(vid)
+            if ev is not None:
+                try:
+                    _, nsize = ev.find_needle_from_ecx(int(req["file_key"]))
+                    if nsize == TOMBSTONE_FILE_SIZE:
+                        yield {"is_deleted": True}
+                        return
+                except NeedleNotFound:
+                    pass
+        remaining = size
+        pos = offset
+        while remaining > 0:
+            chunk = shard.read_at(min(1 << 20, remaining), pos)
+            if not chunk:
+                break
+            yield {"data": chunk}
+            pos += len(chunk)
+            remaining -= len(chunk)
+
+    async def _grpc_ec_blob_delete(self, req, context) -> dict:
+        """Tombstone a needle in the local .ecx/.ecj (ref :327-352)."""
+        vid = int(req["volume_id"])
+        ev = self.store.find_ec_volume(vid)
+        if ev is None:
+            return {}
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(
+            None, ev.delete_needle_from_ecx, int(req["file_key"])
+        )
+        return {}
+
+    async def _grpc_ec_shards_to_volume(self, req, context) -> dict:
+        """Decode local data shards back into a normal volume (ref :354-391)."""
+        vid = int(req["volume_id"])
+        collection = req.get("collection", "")
+        ev = self.store.find_ec_volume(vid)
+        if ev is None:
+            return {"error": f"ec volume {vid} not found"}
+        present = ev.shard_ids()
+        if any(i not in present for i in range(DATA_SHARDS_COUNT)):
+            return {"error": "need all data shards locally to decode"}
+        base = ev.file_name()
+        loop = asyncio.get_event_loop()
+        try:
+            dat_size = await loop.run_in_executor(None, find_dat_file_size, base)
+            await loop.run_in_executor(None, write_dat_file, base, dat_size)
+            await loop.run_in_executor(None, write_idx_file_from_ec_index, base)
+            return {}
+        except Exception as e:
+            return {"error": str(e)}
+
+    # ---------------- EC read path (ref store_ec.go:119-373) ----------------
+    async def _refresh_shard_locations(self, ev: EcVolume) -> None:
+        now = time.time()
+        if now - ev.shard_locations_refresh_time < SHARD_LOCATION_TTL:
+            return
+        stub = Stub(grpc_address(self.master), "master")
+        try:
+            resp = await stub.call("LookupEcVolume", {"volume_id": ev.volume_id})
+        except Exception:
+            return
+        if resp.get("error"):
+            return
+        with ev.shard_locations_lock:
+            ev.shard_locations.clear()
+            for entry in resp.get("shard_id_locations", []):
+                ev.shard_locations[int(entry["shard_id"])] = [
+                    l["url"] for l in entry["locations"]
+                ]
+            ev.shard_locations_refresh_time = now
+
+    async def _read_remote_shard_interval(
+        self, ev: EcVolume, shard_id: int, offset: int, size: int, file_key: int
+    ) -> Optional[bytes]:
+        with ev.shard_locations_lock:
+            urls = list(ev.shard_locations.get(shard_id, []))
+        for url in urls:
+            if url in (self.address, self.public_url):
+                continue
+            stub = Stub(grpc_address(url), "volume")
+            buf = bytearray()
+            try:
+                async for msg in stub.server_stream(
+                    "VolumeEcShardRead",
+                    {
+                        "volume_id": ev.volume_id,
+                        "shard_id": shard_id,
+                        "offset": offset,
+                        "size": size,
+                        "file_key": file_key,
+                    },
+                    timeout=30,
+                ):
+                    if msg.get("error"):
+                        raise IOError(msg["error"])
+                    if msg.get("is_deleted"):
+                        return None
+                    buf.extend(msg.get("data", b""))
+                return bytes(buf)
+            except Exception:
+                continue
+        return None
+
+    async def _read_one_ec_interval(
+        self, ev: EcVolume, shard_id: int, offset: int, size: int, file_key: int
+    ) -> Optional[bytes]:
+        shard = ev.find_shard(shard_id)
+        if shard is not None:
+            return shard.read_at(size, offset)
+        await self._refresh_shard_locations(ev)
+        data = await self._read_remote_shard_interval(
+            ev, shard_id, offset, size, file_key
+        )
+        if data is not None:
+            return data
+        # degraded: reconstruct from any DATA_SHARDS_COUNT other shards
+        # (ref store_ec.go:319-373)
+        return await self._recover_one_interval(
+            ev, shard_id, offset, size, file_key
+        )
+
+    async def _recover_one_interval(
+        self, ev: EcVolume, missing_shard: int, offset: int, size: int, file_key: int
+    ) -> Optional[bytes]:
+        import numpy as np
+
+        bufs: list[Optional[np.ndarray]] = [None] * TOTAL_SHARDS_COUNT
+
+        async def fetch(shard_id: int) -> None:
+            shard = ev.find_shard(shard_id)
+            if shard is not None:
+                b = shard.read_at(size, offset)
+            else:
+                b = await self._read_remote_shard_interval(
+                    ev, shard_id, offset, size, file_key
+                )
+            if b is not None and len(b) == size:
+                bufs[shard_id] = np.frombuffer(b, dtype=np.uint8)
+
+        candidates = [i for i in range(TOTAL_SHARDS_COUNT) if i != missing_shard]
+        await asyncio.gather(*(fetch(i) for i in candidates))
+        present = [i for i in range(TOTAL_SHARDS_COUNT) if bufs[i] is not None]
+        if len(present) < DATA_SHARDS_COUNT:
+            return None
+        keep = present[:DATA_SHARDS_COUNT]
+        trimmed: list[Optional[np.ndarray]] = [
+            bufs[i] if i in keep else None for i in range(TOTAL_SHARDS_COUNT)
+        ]
+        loop = asyncio.get_event_loop()
+        full = await loop.run_in_executor(
+            None,
+            lambda: self.codec.reconstruct(
+                trimmed, data_only=missing_shard < DATA_SHARDS_COUNT
+            ),
+        )
+        out = full[missing_shard]
+        return None if out is None else out.tobytes()
+
+    async def read_ec_needle(self, ev: EcVolume, key: int) -> Optional[Needle]:
+        try:
+            offset_units, size = ev.find_needle_from_ecx(key)
+        except NeedleNotFound:
+            return None
+        if size == TOMBSTONE_FILE_SIZE:
+            return None
+        _, _, intervals = ev.locate_needle(key)
+        chunks = []
+        for iv in intervals:
+            shard_id, shard_offset = iv.to_shard_id_and_offset(
+                1024 * 1024 * 1024, 1024 * 1024
+            )
+            data = await self._read_one_ec_interval(
+                ev, shard_id, shard_offset, iv.size, key
+            )
+            if data is None or len(data) != iv.size:
+                return None
+            chunks.append(data)
+        blob = b"".join(chunks)
+        n = Needle()
+        n.read_bytes(blob, to_actual_offset(offset_units), size, ev.version)
+        return n
+
+    async def delete_ec_needle(self, ev: EcVolume, key: int) -> int:
+        """Tombstone locally + fan out to every shard holder
+        (ref store_ec_delete.go:15-110)."""
+        try:
+            _, size = ev.find_needle_from_ecx(key)
+        except NeedleNotFound:
+            return 0
+        if size == TOMBSTONE_FILE_SIZE:
+            return 0
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(None, ev.delete_needle_from_ecx, key)
+        await self._refresh_shard_locations(ev)
+        urls = set()
+        with ev.shard_locations_lock:
+            for shard_urls in ev.shard_locations.values():
+                urls.update(shard_urls)
+        urls.discard(self.address)
+        urls.discard(self.public_url)
+
+        async def one(url: str) -> None:
+            stub = Stub(grpc_address(url), "volume")
+            try:
+                await stub.call(
+                    "VolumeEcBlobDelete",
+                    {
+                        "volume_id": ev.volume_id,
+                        "collection": ev.collection,
+                        "file_key": key,
+                        "version": ev.version,
+                    },
+                )
+            except Exception:
+                pass
+
+        await asyncio.gather(*(one(u) for u in urls))
+        return size

@@ -1,0 +1,141 @@
+"""DiskLocation: one data directory of volumes and EC shards
+(ref: weed/storage/disk_location.go, disk_location_ec.go)."""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Dict, Optional
+
+from .erasure_coding import to_ext
+from .erasure_coding.ec_volume import EcVolume, EcVolumeShard
+from .volume import Volume
+
+_DAT_RE = re.compile(r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.dat$")
+_EC_RE = re.compile(r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.ec(?P<shard>[0-9][0-9])$")
+
+
+def parse_volume_file_name(name: str) -> Optional[tuple[str, int]]:
+    m = _DAT_RE.match(name)
+    if not m:
+        return None
+    return m.group("collection") or "", int(m.group("vid"))
+
+
+class DiskLocation:
+    def __init__(self, directory: str, max_volume_count: int = 7):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_volume_count = max_volume_count
+        self.volumes: Dict[int, Volume] = {}
+        self.ec_volumes: Dict[int, EcVolume] = {}
+        self._lock = threading.RLock()
+
+    # --- normal volumes ---
+    def load_existing_volumes(self) -> int:
+        count = 0
+        for name in sorted(os.listdir(self.directory)):
+            parsed = parse_volume_file_name(name)
+            if parsed is None:
+                continue
+            collection, vid = parsed
+            with self._lock:
+                if vid in self.volumes:
+                    continue
+                try:
+                    v = Volume(self.directory, collection, vid, create=False)
+                except Exception:
+                    continue
+                self.volumes[vid] = v
+                count += 1
+        return count
+
+    def add_volume(self, v: Volume) -> None:
+        with self._lock:
+            self.volumes[v.id] = v
+
+    def find_volume(self, vid: int) -> Optional[Volume]:
+        with self._lock:
+            return self.volumes.get(vid)
+
+    def delete_volume(self, vid: int) -> bool:
+        with self._lock:
+            v = self.volumes.pop(vid, None)
+        if v is None:
+            return False
+        v.destroy()
+        return True
+
+    def unmount_volume(self, vid: int) -> bool:
+        with self._lock:
+            v = self.volumes.pop(vid, None)
+        if v is None:
+            return False
+        v.close()
+        return True
+
+    def close(self) -> None:
+        with self._lock:
+            for v in self.volumes.values():
+                v.close()
+            for ev in self.ec_volumes.values():
+                ev.close()
+            self.volumes.clear()
+            self.ec_volumes.clear()
+
+    # --- EC shards (ref disk_location_ec.go) ---
+    def find_ec_volume(self, vid: int) -> Optional[EcVolume]:
+        with self._lock:
+            return self.ec_volumes.get(vid)
+
+    def load_ec_shard(self, collection: str, vid: int, shard_id: int) -> EcVolumeShard:
+        shard = EcVolumeShard(self.directory, collection, vid, shard_id)
+        with self._lock:
+            ev = self.ec_volumes.get(vid)
+            if ev is None:
+                ev = EcVolume(self.directory, collection, vid)
+                self.ec_volumes[vid] = ev
+            ev.add_shard(shard)
+        return shard
+
+    def unload_ec_shard(self, vid: int, shard_id: int) -> bool:
+        with self._lock:
+            ev = self.ec_volumes.get(vid)
+            if ev is None:
+                return False
+            shard = ev.delete_shard(shard_id)
+            if shard is None:
+                return False
+            shard.close()
+            if not ev.shards:
+                ev.close()
+                del self.ec_volumes[vid]
+            return True
+
+    def load_all_ec_shards(self) -> int:
+        """Discover .ecNN files with a matching .ecx (ref
+        disk_location_ec.go:115-161)."""
+        count = 0
+        for name in sorted(os.listdir(self.directory)):
+            m = _EC_RE.match(name)
+            if not m:
+                continue
+            collection = m.group("collection") or ""
+            vid = int(m.group("vid"))
+            shard_id = int(m.group("shard"))
+            base = (
+                os.path.join(self.directory, f"{collection}_{vid}")
+                if collection
+                else os.path.join(self.directory, str(vid))
+            )
+            if not os.path.exists(base + ".ecx"):
+                continue
+            # a .dat alongside means the volume is not yet converted; the
+            # reference still loads the shard and lets the server choose
+            try:
+                self.load_ec_shard(collection, vid, shard_id)
+                count += 1
+            except Exception:
+                continue
+        return count

@@ -1,0 +1,253 @@
+"""Store: per-server facade over disk locations; assembles heartbeats and
+delta change queues (ref: weed/storage/store.go, store_ec.go)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..storage.erasure_coding.ec_volume import ShardBits
+from .disk_location import DiskLocation
+from .needle import Needle
+from .super_block import ReplicaPlacement
+from .ttl import TTL
+from .volume import Volume
+
+
+class Store:
+    def __init__(
+        self,
+        ip: str,
+        port: int,
+        public_url: str,
+        directories: list[str],
+        max_volume_counts: list[int],
+    ):
+        self.ip = ip
+        self.port = port
+        self.public_url = public_url
+        self.locations = [
+            DiskLocation(d, m) for d, m in zip(directories, max_volume_counts)
+        ]
+        self.volume_size_limit = 0  # set by master heartbeat response
+        self._lock = threading.RLock()
+        # delta queues drained into heartbeats (ref store.go:41-44)
+        self.new_volumes: list[dict] = []
+        self.deleted_volumes: list[dict] = []
+        self.new_ec_shards: list[dict] = []
+        self.deleted_ec_shards: list[dict] = []
+
+    # --- lifecycle ---
+    def load(self) -> None:
+        for loc in self.locations:
+            loc.load_existing_volumes()
+            loc.load_all_ec_shards()
+
+    def close(self) -> None:
+        for loc in self.locations:
+            loc.close()
+
+    # --- volumes ---
+    def find_volume(self, vid: int) -> Optional[Volume]:
+        for loc in self.locations:
+            v = loc.find_volume(vid)
+            if v is not None:
+                return v
+        return None
+
+    def has_volume(self, vid: int) -> bool:
+        return self.find_volume(vid) is not None
+
+    def add_volume(
+        self,
+        vid: int,
+        collection: str,
+        replication: str = "000",
+        ttl_string: str = "",
+        preallocate: int = 0,
+    ) -> Volume:
+        if self.find_volume(vid) is not None:
+            raise ValueError(f"volume id {vid} already exists")
+        location = max(
+            self.locations, key=lambda l: l.max_volume_count - len(l.volumes)
+        )
+        v = Volume(
+            location.directory,
+            collection,
+            vid,
+            replica_placement=ReplicaPlacement.parse(replication),
+            ttl=TTL.read(ttl_string),
+        )
+        location.add_volume(v)
+        with self._lock:
+            self.new_volumes.append(self._volume_message(v))
+        return v
+
+    def delete_volume(self, vid: int) -> bool:
+        v = self.find_volume(vid)
+        if v is None:
+            return False
+        msg = self._volume_message(v)
+        for loc in self.locations:
+            if loc.delete_volume(vid):
+                with self._lock:
+                    self.deleted_volumes.append(msg)
+                return True
+        return False
+
+    def unmount_volume(self, vid: int) -> bool:
+        v = self.find_volume(vid)
+        if v is None:
+            return False
+        msg = self._volume_message(v)
+        for loc in self.locations:
+            if loc.unmount_volume(vid):
+                with self._lock:
+                    self.deleted_volumes.append(msg)
+                return True
+        return False
+
+    def mount_volume(self, vid: int) -> bool:
+        for loc in self.locations:
+            count = loc.load_existing_volumes()
+            v = loc.find_volume(vid)
+            if v is not None:
+                with self._lock:
+                    self.new_volumes.append(self._volume_message(v))
+                return True
+        return False
+
+    def mark_volume_readonly(self, vid: int) -> bool:
+        v = self.find_volume(vid)
+        if v is None:
+            return False
+        v.no_write_or_delete = True
+        return True
+
+    # --- data path ---
+    def write_volume_needle(self, vid: int, n: Needle, sync: bool = False):
+        v = self.find_volume(vid)
+        if v is None:
+            raise LookupError(f"volume {vid} not found")
+        if v.is_read_only():
+            raise PermissionError(f"volume {vid} is read only")
+        result = v.write_needle(n, sync=sync)
+        if (
+            self.volume_size_limit
+            and v.data_file_size() > self.volume_size_limit
+        ):
+            # report full volume at next heartbeat via size field
+            pass
+        return result
+
+    def read_volume_needle(self, vid: int, n: Needle) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            raise LookupError(f"volume {vid} not found")
+        return v.read_needle(n)
+
+    def delete_volume_needle(self, vid: int, n: Needle) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            return 0
+        return v.delete_needle(n)
+
+    # --- heartbeat assembly (ref store.go:194-254) ---
+    def _volume_message(self, v: Volume) -> dict:
+        return {
+            "id": v.id,
+            "size": v.data_file_size(),
+            "collection": v.collection,
+            "file_count": v.file_count(),
+            "delete_count": v.deleted_count(),
+            "deleted_byte_count": v.deleted_size(),
+            "read_only": v.is_read_only(),
+            "replica_placement": v.super_block.replica_placement.to_byte(),
+            "version": v.version,
+            "ttl": v.super_block.ttl.to_u32(),
+            "compact_revision": v.super_block.compaction_revision,
+            "modified_at_second": int(v.last_modified_ts_seconds),
+        }
+
+    def collect_heartbeat(self) -> dict:
+        volume_messages = []
+        max_volume_count = 0
+        max_file_key = 0
+        for loc in self.locations:
+            max_volume_count += loc.max_volume_count
+            for v in list(loc.volumes.values()):
+                if v.max_file_key() > max_file_key:
+                    max_file_key = v.max_file_key()
+                volume_messages.append(self._volume_message(v))
+        return {
+            "ip": self.ip,
+            "port": self.port,
+            "public_url": self.public_url,
+            "max_volume_count": max_volume_count,
+            "max_file_key": max_file_key,
+            "volumes": volume_messages,
+            "has_no_volumes": len(volume_messages) == 0,
+        }
+
+    def collect_ec_heartbeat(self) -> dict:
+        shard_messages = []
+        for loc in self.locations:
+            for vid, ev in loc.ec_volumes.items():
+                shard_messages.append(
+                    {
+                        "id": vid,
+                        "collection": ev.collection,
+                        "ec_index_bits": ev.shard_bits().bits,
+                    }
+                )
+        return {
+            "ec_shards": shard_messages,
+            "has_no_ec_shards": len(shard_messages) == 0,
+        }
+
+    def drain_deltas(self) -> dict:
+        with self._lock:
+            out = {
+                "new_volumes": self.new_volumes,
+                "deleted_volumes": self.deleted_volumes,
+                "new_ec_shards": self.new_ec_shards,
+                "deleted_ec_shards": self.deleted_ec_shards,
+            }
+            self.new_volumes = []
+            self.deleted_volumes = []
+            self.new_ec_shards = []
+            self.deleted_ec_shards = []
+            return out
+
+    def note_ec_shards_changed(
+        self, vid: int, collection: str, added: ShardBits, removed: ShardBits
+    ) -> None:
+        with self._lock:
+            if added.bits:
+                self.new_ec_shards.append(
+                    {"id": vid, "collection": collection, "ec_index_bits": added.bits}
+                )
+            if removed.bits:
+                self.deleted_ec_shards.append(
+                    {"id": vid, "collection": collection, "ec_index_bits": removed.bits}
+                )
+
+
+# --- EC volume access (ref store_ec.go) ---
+def _store_find_ec_volume(self, vid: int):
+    for loc in self.locations:
+        ev = loc.find_ec_volume(vid)
+        if ev is not None:
+            return ev
+    return None
+
+
+def _store_find_ec_shard(self, vid: int, shard_id: int):
+    ev = self.find_ec_volume(vid)
+    if ev is None:
+        return None
+    return ev.find_shard(shard_id)
+
+
+Store.find_ec_volume = _store_find_ec_volume
+Store.find_ec_shard = _store_find_ec_shard

@@ -1,0 +1,17 @@
+"""Cluster topology: DataCenter/Rack/DataNode tree, volume layouts,
+replica placement, EC shard registry (ref: weed/topology/)."""
+
+from .node import DataCenter, DataNode, Rack
+from .topology import Topology
+from .volume_layout import VolumeLayout
+from .volume_growth import VolumeGrowth, GrowOption
+
+__all__ = [
+    "DataCenter",
+    "DataNode",
+    "Rack",
+    "Topology",
+    "VolumeLayout",
+    "VolumeGrowth",
+    "GrowOption",
+]
