@@ -1,0 +1,170 @@
+"""Load benchmark: concurrent writers then readers of small files, with the
+reference's stats report (ref: weed/command/benchmark.go:109-541).
+
+Writers assign a fid from the master and POST a deterministic payload to the
+returned volume server; readers look up cached vid locations and GET.
+Latencies land in a 0.1ms-bucket histogram with the same percentile table.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import dataclass, field
+
+import aiohttp
+
+from ..client import MasterClient, assign
+from ..client.operation import read_url, upload_data
+
+
+def fake_payload(seed_id: int, size: int) -> bytes:
+    """Deterministic payload (ref FakeReader, benchmark.go:518-541):
+    the id stamped every 8 bytes."""
+    block = seed_id.to_bytes(8, "big")
+    reps = size // 8 + 1
+    return (block * reps)[:size]
+
+
+@dataclass
+class Stats:
+    name: str
+    start: float = 0.0
+    end: float = 0.0
+    completed: int = 0
+    failed: int = 0
+    transferred: int = 0
+    # 0.1ms buckets up to 10s (ref benchmark.go:361)
+    buckets: list = field(default_factory=lambda: [0] * 100_000)
+    latencies_ns_min: int = 1 << 62
+    latencies_ns_max: int = 0
+    _sum_ms: float = 0.0
+    _sumsq_ms: float = 0.0
+
+    def record(self, dt: float, nbytes: int) -> None:
+        self.completed += 1
+        self.transferred += nbytes
+        ms = dt * 1000
+        bucket = min(int(ms * 10), len(self.buckets) - 1)
+        self.buckets[bucket] += 1
+        self._sum_ms += ms
+        self._sumsq_ms += ms * ms
+        ns = int(dt * 1e9)
+        self.latencies_ns_min = min(self.latencies_ns_min, ns)
+        self.latencies_ns_max = max(self.latencies_ns_max, ns)
+
+    def percentile(self, p: float) -> float:
+        target = self.completed * p / 100
+        cum = 0
+        for i, c in enumerate(self.buckets):
+            cum += c
+            if cum >= target and c:
+                return i / 10
+        return self.latencies_ns_max / 1e6
+
+    def report(self, concurrency: int) -> str:
+        elapsed = max(self.end - self.start, 1e-9)
+        avg = self._sum_ms / max(self.completed, 1)
+        var = self._sumsq_ms / max(self.completed, 1) - avg * avg
+        std = var**0.5 if var > 0 else 0.0
+        lines = [
+            f"\n------------ {self.name} ----------",
+            f"Concurrency Level:      {concurrency}",
+            f"Time taken for tests:   {elapsed:.3f} seconds",
+            f"Complete requests:      {self.completed}",
+            f"Failed requests:        {self.failed}",
+            f"Total transferred:      {self.transferred} bytes",
+            f"Requests per second:    {self.completed / elapsed:.2f} [#/sec]",
+            f"Transfer rate:          {self.transferred / 1024 / elapsed:.2f} [Kbytes/sec]",
+            "",
+            "Connection Times (ms)",
+            "              min      avg        max      std",
+            f"Total:        {self.latencies_ns_min / 1e6:.1f}      "
+            f"{avg:.1f}       {self.latencies_ns_max / 1e6:.1f}      {std:.1f}",
+            "",
+            "Percentage of the requests served within a certain time (ms)",
+        ]
+        for p in (50, 66, 75, 80, 90, 95, 98, 99, 100):
+            lines.append(f"   {p}%    {self.percentile(p):.1f} ms")
+        return "\n".join(lines)
+
+
+async def run_benchmark(
+    master: str,
+    num_files: int = 1024,
+    file_size: int = 1024,
+    concurrency: int = 16,
+    collection: str = "",
+    do_write: bool = True,
+    do_read: bool = True,
+) -> str:
+    out = []
+    mc = MasterClient("benchmark", [master])
+    await mc.start()
+    try:
+        await mc.wait_connected()
+        fids: list[str] = []
+        if do_write:
+            stats = Stats("Writing Benchmark")
+            queue: asyncio.Queue = asyncio.Queue()
+            for i in range(num_files):
+                queue.put_nowait(i)
+
+            async with aiohttp.ClientSession() as session:
+
+                async def writer() -> None:
+                    while True:
+                        try:
+                            i = queue.get_nowait()
+                        except asyncio.QueueEmpty:
+                            return
+                        t0 = time.perf_counter()
+                        try:
+                            ar = await assign(master, collection=collection)
+                            await upload_data(
+                                session,
+                                ar.url,
+                                ar.fid,
+                                fake_payload(i, file_size),
+                            )
+                            stats.record(time.perf_counter() - t0, file_size)
+                            fids.append(ar.fid)
+                        except Exception:
+                            stats.failed += 1
+
+                stats.start = time.perf_counter()
+                await asyncio.gather(*(writer() for _ in range(concurrency)))
+                stats.end = time.perf_counter()
+            out.append(stats.report(concurrency))
+
+        if do_read and fids:
+            stats = Stats("Randomly Reading Benchmark")
+            reads = [random.choice(fids) for _ in range(num_files)]
+            queue = asyncio.Queue()
+            for fid in reads:
+                queue.put_nowait(fid)
+
+            async with aiohttp.ClientSession() as session:
+
+                async def reader() -> None:
+                    while True:
+                        try:
+                            fid = queue.get_nowait()
+                        except asyncio.QueueEmpty:
+                            return
+                        t0 = time.perf_counter()
+                        try:
+                            url = mc.lookup_file_id(fid)
+                            data = await read_url(session, url)
+                            stats.record(time.perf_counter() - t0, len(data))
+                        except Exception:
+                            stats.failed += 1
+
+                stats.start = time.perf_counter()
+                await asyncio.gather(*(reader() for _ in range(concurrency)))
+                stats.end = time.perf_counter()
+            out.append(stats.report(concurrency))
+    finally:
+        await mc.stop()
+    return "\n".join(out)

@@ -1,0 +1,404 @@
+"""`weed-tpu` multi-command CLI (ref: weed/command/command.go:10-31).
+
+Commands: master, volume, server (combined), shell, benchmark, upload,
+download, export, fix, compact, scaffold, version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+
+
+def _add_master_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=9333)
+    p.add_argument("-volumeSizeLimitMB", type=int, default=30_000)
+    p.add_argument("-defaultReplication", default="000")
+    p.add_argument("-garbageThreshold", type=float, default=0.3)
+
+
+def _add_volume_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8080)
+    p.add_argument("-dir", default="./data", help="comma-separated data dirs")
+    p.add_argument("-max", default="7", help="comma-separated max volume counts")
+    p.add_argument("-mserver", default="127.0.0.1:9333")
+    p.add_argument("-dataCenter", default="")
+    p.add_argument("-rack", default="")
+    p.add_argument("-publicUrl", default="")
+    p.add_argument(
+        "-storageBackend",
+        default=os.environ.get("SEAWEEDFS_TPU_BACKEND", "cpu"),
+        choices=["cpu", "tpu"],
+        help="erasure-coding compute backend",
+    )
+
+
+def _build_volume_server(args, port_offset: int = 0):
+    from ..server.volume import VolumeServer
+
+    dirs = args.dir.split(",")
+    maxes = [int(m) for m in args.max.split(",")]
+    if len(maxes) == 1:
+        maxes = maxes * len(dirs)
+    return VolumeServer(
+        master=args.mserver,
+        directories=dirs,
+        host=args.ip,
+        port=args.port + port_offset,
+        public_url=args.publicUrl,
+        max_volume_counts=maxes,
+        data_center=args.dataCenter,
+        rack=args.rack,
+        codec_backend=args.storageBackend,
+    )
+
+
+async def _run_forever(*servers) -> None:
+    for s in servers:
+        await s.start()
+    stop = asyncio.Event()
+    try:
+        await stop.wait()
+    finally:
+        for s in servers:
+            await s.stop()
+
+
+def cmd_master(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="weed-tpu master")
+    _add_master_flags(p)
+    args = p.parse_args(argv)
+    from ..server.master import MasterServer
+
+    ms = MasterServer(
+        host=args.ip,
+        port=args.port,
+        volume_size_limit_mb=args.volumeSizeLimitMB,
+        default_replication=args.defaultReplication,
+        garbage_threshold=args.garbageThreshold,
+    )
+    print(f"master listening on {args.ip}:{args.port}")
+    asyncio.run(_run_forever(ms))
+    return 0
+
+
+def cmd_volume(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="weed-tpu volume")
+    _add_volume_flags(p)
+    args = p.parse_args(argv)
+    vs = _build_volume_server(args)
+    print(f"volume server listening on {args.ip}:{args.port}")
+    asyncio.run(_run_forever(vs))
+    return 0
+
+
+def cmd_server(argv: list[str]) -> int:
+    """Combined master + volume server (ref command/server.go)."""
+    p = argparse.ArgumentParser(prog="weed-tpu server")
+    _add_master_flags(p)
+    p.add_argument("-dir", default="./data")
+    p.add_argument("-max", default="7")
+    p.add_argument("-volumePort", type=int, default=8080)
+    p.add_argument("-dataCenter", default="")
+    p.add_argument("-rack", default="")
+    p.add_argument("-storageBackend", default="cpu", choices=["cpu", "tpu"])
+    args = p.parse_args(argv)
+    from ..server.master import MasterServer
+    from ..server.volume import VolumeServer
+
+    ms = MasterServer(
+        host=args.ip,
+        port=args.port,
+        volume_size_limit_mb=args.volumeSizeLimitMB,
+        default_replication=args.defaultReplication,
+    )
+    vs = VolumeServer(
+        master=f"{args.ip}:{args.port}",
+        directories=args.dir.split(","),
+        host=args.ip,
+        port=args.volumePort,
+        max_volume_counts=[int(m) for m in args.max.split(",")],
+        data_center=args.dataCenter,
+        rack=args.rack,
+        codec_backend=args.storageBackend,
+    )
+    print(
+        f"server: master on {args.ip}:{args.port}, volume on "
+        f"{args.ip}:{args.volumePort}"
+    )
+    asyncio.run(_run_forever(ms, vs))
+    return 0
+
+
+def cmd_shell(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="weed-tpu shell")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("commands", nargs="*", help="semicolon-separated one-shot commands")
+    args = p.parse_args(argv)
+
+    from ..shell import CommandEnv, run_command
+
+    async def repl() -> None:
+        env = CommandEnv(args.master)
+        try:
+            if args.commands:
+                for line in " ".join(args.commands).split(";"):
+                    out = await run_command(env, line)
+                    if out:
+                        print(out)
+                return
+            print("seaweedfs-tpu shell; `help` lists commands, ctrl-d exits")
+            loop = asyncio.get_event_loop()
+            while True:
+                try:
+                    line = await loop.run_in_executor(None, input, "> ")
+                except EOFError:
+                    break
+                out = await run_command(env, line)
+                if out:
+                    print(out)
+        finally:
+            await env.release_lock()
+            from ..pb.rpc import close_all_channels
+
+            await close_all_channels()
+
+    asyncio.run(repl())
+    return 0
+
+
+def cmd_benchmark(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="weed-tpu benchmark")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-n", type=int, default=1024 * 1024)
+    p.add_argument("-size", type=int, default=1024)
+    p.add_argument("-c", type=int, default=16)
+    p.add_argument("-collection", default="")
+    p.add_argument("-write", action="store_true", default=True)
+    p.add_argument("-skipRead", action="store_true")
+    args = p.parse_args(argv)
+    from .benchmark import run_benchmark
+
+    out = asyncio.run(
+        run_benchmark(
+            args.master,
+            num_files=args.n,
+            file_size=args.size,
+            concurrency=args.c,
+            collection=args.collection,
+            do_read=not args.skipRead,
+        )
+    )
+    print(out)
+    return 0
+
+
+def cmd_upload(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="weed-tpu upload")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    p.add_argument("-ttl", default="")
+    p.add_argument("files", nargs="+")
+    args = p.parse_args(argv)
+
+    async def go() -> None:
+        import aiohttp
+
+        from ..client.operation import submit_file
+
+        async with aiohttp.ClientSession() as session:
+            for path in args.files:
+                with open(path, "rb") as f:
+                    data = f.read()
+                fid, result = await submit_file(
+                    session,
+                    args.master,
+                    data,
+                    filename=os.path.basename(path),
+                    collection=args.collection,
+                    replication=args.replication,
+                    ttl=args.ttl,
+                )
+                print(f"{path} -> fid {fid} ({result.get('size')} bytes)")
+
+    asyncio.run(go())
+    return 0
+
+
+def cmd_download(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="weed-tpu download")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-dir", default=".")
+    p.add_argument("fids", nargs="+")
+    args = p.parse_args(argv)
+
+    async def go() -> None:
+        import aiohttp
+
+        from ..client.operation import lookup, read_url
+
+        async with aiohttp.ClientSession() as session:
+            for fid in args.fids:
+                vid = int(fid.split(",")[0])
+                locs = await lookup(args.master, vid)
+                if not locs:
+                    print(f"{fid}: volume not found", file=sys.stderr)
+                    continue
+                data = await read_url(session, f"http://{locs[0]}/{fid}")
+                out = os.path.join(args.dir, fid.replace(",", "_"))
+                with open(out, "wb") as f:
+                    f.write(data)
+                print(f"{fid} -> {out} ({len(data)} bytes)")
+
+    asyncio.run(go())
+    return 0
+
+
+def cmd_export(argv: list[str]) -> int:
+    """List/extract needles from a volume .dat (ref command/export.go)."""
+    p = argparse.ArgumentParser(prog="weed-tpu export")
+    p.add_argument("-dir", required=True)
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-o", default="", help="output directory (default: list only)")
+    args = p.parse_args(argv)
+
+    from ..storage.volume import Volume
+
+    v = Volume(args.dir, args.collection, args.volumeId, create=False)
+
+    def visit(n, offset, body) -> None:
+        print(
+            f"key={n.id:x} cookie={n.cookie:x} size={n.size} "
+            f"name={n.name.decode(errors='replace')!r} offset={offset}"
+        )
+        if args.o and n.data:
+            name = n.name.decode(errors="replace") or f"{n.id:x}"
+            with open(os.path.join(args.o, name), "wb") as f:
+                f.write(n.data)
+
+    if args.o:
+        os.makedirs(args.o, exist_ok=True)
+    v.scan(visit)
+    v.close()
+    return 0
+
+
+def cmd_fix(argv: list[str]) -> int:
+    """Rebuild the .idx from the .dat (ref command/fix.go)."""
+    p = argparse.ArgumentParser(prog="weed-tpu fix")
+    p.add_argument("-dir", required=True)
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    args = p.parse_args(argv)
+    return _fix(args)
+
+
+def _fix(args) -> int:
+    from ..storage.backend import DiskFile
+    from ..storage.needle_map import MemDb
+    from ..storage.super_block import read_super_block
+    from ..storage.volume import scan_volume_file, volume_base_name
+    from ..types import to_offset_units
+
+    base = volume_base_name(args.dir, args.collection, args.volumeId)
+    dat = DiskFile(base + ".dat", create=False, read_only=True)
+    sb = read_super_block(dat)
+    nm = MemDb()
+
+    def visit(n, offset, body) -> None:
+        if n.size > 0:
+            nm.set(n.id, to_offset_units(offset), n.size)
+        else:
+            nm.delete(n.id)
+
+    scan_volume_file(dat, sb, visit, read_body=False)
+    nm.save_to_idx(base + ".idx")
+    dat.close()
+    print(f"rebuilt {base}.idx with {len(nm)} entries")
+    return 0
+
+
+def cmd_compact(argv: list[str]) -> int:
+    """Offline vacuum (ref command/compact.go)."""
+    p = argparse.ArgumentParser(prog="weed-tpu compact")
+    p.add_argument("-dir", required=True)
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    args = p.parse_args(argv)
+
+    from ..storage.vacuum import commit_compact, compact2
+    from ..storage.volume import Volume
+
+    v = Volume(args.dir, args.collection, args.volumeId, create=False)
+    compact2(v)
+    v2 = commit_compact(v)
+    print(f"compacted volume {args.volumeId}: {v2.data_file_size()} bytes")
+    v2.close()
+    return 0
+
+
+def cmd_scaffold(argv: list[str]) -> int:
+    print(
+        """# seaweedfs-tpu example configuration (TOML)
+[master]
+ip = "127.0.0.1"
+port = 9333
+volume_size_limit_mb = 30000
+default_replication = "000"
+
+[volume]
+port = 8080
+dir = "./data"
+max = 7
+mserver = "127.0.0.1:9333"
+
+[storage]
+backend = "tpu"   # route erasure coding through the TPU kernels
+"""
+    )
+    return 0
+
+
+def cmd_version(argv: list[str]) -> int:
+    from .. import __version__
+
+    print(f"seaweedfs-tpu {__version__}")
+    return 0
+
+
+COMMANDS = {
+    "master": cmd_master,
+    "volume": cmd_volume,
+    "server": cmd_server,
+    "shell": cmd_shell,
+    "benchmark": cmd_benchmark,
+    "upload": cmd_upload,
+    "download": cmd_download,
+    "export": cmd_export,
+    "fix": cmd_fix,
+    "compact": cmd_compact,
+    "scaffold": cmd_scaffold,
+    "version": cmd_version,
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help", "help"):
+        print("usage: weed-tpu <command> [options]\ncommands: " + " ".join(sorted(COMMANDS)))
+        return 0
+    cmd = COMMANDS.get(argv[0])
+    if cmd is None:
+        print(f"unknown command {argv[0]!r}", file=sys.stderr)
+        return 1
+    return cmd(argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -105,6 +105,7 @@ class VolumeServer(EcHandlers):
         svc.unary("BatchDelete")(self._grpc_batch_delete)
         svc.unary("VolumeServerStatus")(self._grpc_status)
         svc.server_stream("CopyFile")(self._grpc_copy_file)
+        svc.unary("VolumeCopy")(self._grpc_volume_copy)
         self.register_ec_rpcs(svc)
         self._grpc_server = await serve(grpc_address(self.address), svc)
 
@@ -472,6 +473,47 @@ class VolumeServer(EcHandlers):
                 for v in loc.volumes.values()
             ],
         }
+
+    async def _grpc_volume_copy(self, req, context) -> dict:
+        """Pull a whole volume (.dat/.idx/.vif) from a source server and
+        mount it (ref volume_grpc_copy.go:23-116)."""
+        vid = int(req["volume_id"])
+        collection = req.get("collection", "")
+        source = req["source_data_node"]
+        if self.store.has_volume(vid):
+            return {"error": f"volume {vid} already exists"}
+        loc = max(
+            self.store.locations,
+            key=lambda l: l.max_volume_count - len(l.volumes),
+        )
+        from ..storage.volume import volume_base_name
+
+        base = volume_base_name(loc.directory, collection, vid)
+        stub = Stub(grpc_address(source), "volume")
+        try:
+            for ext in (".dat", ".idx", ".vif"):
+                tmp = base + ext + ".tmp"
+                got_any = False
+                with open(tmp, "wb") as f:
+                    async for msg in stub.server_stream(
+                        "CopyFile",
+                        {"volume_id": vid, "collection": collection, "ext": ext},
+                        timeout=3600,
+                    ):
+                        if msg.get("error"):
+                            if ext == ".vif":
+                                break
+                            raise IOError(msg["error"])
+                        f.write(msg.get("file_content", b""))
+                        got_any = True
+                if got_any or ext != ".vif":
+                    os.replace(tmp, base + ext)
+                else:
+                    os.remove(tmp)
+            self.store.mount_volume(vid)
+            return {}
+        except Exception as e:
+            return {"error": str(e)}
 
     async def _grpc_copy_file(self, req, context):
         """Stream a volume file's bytes (ref volume_grpc_copy.go doCopyFile).

@@ -265,13 +265,16 @@ class EcHandlers:
         """Decode local data shards back into a normal volume (ref :354-391)."""
         vid = int(req["volume_id"])
         collection = req.get("collection", "")
-        ev = self.store.find_ec_volume(vid)
-        if ev is None:
+        base = self._base_name(collection, vid)
+        if base is None or not os.path.exists(base + ".ecx"):
             return {"error": f"ec volume {vid} not found"}
-        present = ev.shard_ids()
-        if any(i not in present for i in range(DATA_SHARDS_COUNT)):
-            return {"error": "need all data shards locally to decode"}
-        base = ev.file_name()
+        missing = [
+            i
+            for i in range(DATA_SHARDS_COUNT)
+            if not os.path.exists(base + to_ext(i))
+        ]
+        if missing:
+            return {"error": f"need all data shards locally to decode, missing {missing}"}
         loop = asyncio.get_event_loop()
         try:
             dat_size = await loop.run_in_executor(None, find_dat_file_size, base)

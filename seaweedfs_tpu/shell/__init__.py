@@ -1,0 +1,11 @@
+"""Admin shell: cluster maintenance commands (ref: weed/shell/).
+
+Commands are async callables `cmd(env, args) -> str` registered in COMMANDS;
+mutating commands must hold the cluster-wide exclusive admin lease
+(ref: weed/shell/commands.go:71-78).
+"""
+
+from .command_env import CommandEnv
+from .commands import COMMANDS, run_command
+
+__all__ = ["CommandEnv", "COMMANDS", "run_command"]

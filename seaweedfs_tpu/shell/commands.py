@@ -1,0 +1,525 @@
+"""Shell command registry (ref: weed/shell/commands.go + command files).
+
+Each command: async fn(env, argv) -> output string.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import defaultdict
+
+from ..storage.erasure_coding import DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT
+from ..storage.erasure_coding.ec_volume import ShardBits
+from .command_env import CommandEnv
+from .ec_common import (
+    EcNode,
+    ShardMove,
+    execute_shard_move,
+    nodes_from_topology,
+    plan_balanced_spread,
+    plan_dedupe,
+    plan_rack_balance,
+)
+
+COMMANDS: dict[str, callable] = {}
+
+
+def command(name: str):
+    def deco(fn):
+        COMMANDS[name] = fn
+        return fn
+
+    return deco
+
+
+def _parse_flags(argv: list[str]) -> dict[str, str]:
+    flags = {}
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg.startswith("-"):
+            key = arg.lstrip("-")
+            if "=" in key:
+                key, _, val = key.partition("=")
+                flags[key] = val
+            elif i + 1 < len(argv) and not argv[i + 1].startswith("-"):
+                flags[key] = argv[i + 1]
+                i += 1
+            else:
+                flags[key] = "true"
+        i += 1
+    return flags
+
+
+async def run_command(env: CommandEnv, line: str) -> str:
+    parts = line.strip().split()
+    if not parts:
+        return ""
+    name, argv = parts[0], parts[1:]
+    fn = COMMANDS.get(name)
+    if fn is None:
+        return f"unknown command: {name} (try `help`)"
+    return await fn(env, argv)
+
+
+# ---------------- basic ----------------
+@command("help")
+async def cmd_help(env, argv) -> str:
+    return "commands:\n  " + "\n  ".join(sorted(COMMANDS))
+
+
+@command("lock")
+async def cmd_lock(env, argv) -> str:
+    await env.acquire_lock()
+    return "locked"
+
+
+@command("unlock")
+async def cmd_unlock(env, argv) -> str:
+    await env.release_lock()
+    return "unlocked"
+
+
+@command("volume.list")
+async def cmd_volume_list(env, argv) -> str:
+    nodes = await env.collect_data_nodes()
+    lines = []
+    for dn in nodes:
+        lines.append(
+            f"node {dn['url']} dc:{dn['data_center']} rack:{dn['rack']} "
+            f"volumes:{len(dn.get('volumes', []))} free:{dn.get('free_space', 0)}"
+        )
+        for v in dn.get("volumes", []):
+            lines.append(
+                f"  volume id:{v['id']} size:{v.get('size', 0)} "
+                f"collection:{v.get('collection', '')!r} "
+                f"file_count:{v.get('file_count', 0)} "
+                f"deleted:{v.get('delete_count', 0)} "
+                f"read_only:{v.get('read_only', False)}"
+            )
+        for m in dn.get("ec_shards", []):
+            bits = ShardBits(int(m["ec_index_bits"]))
+            lines.append(f"  ec volume id:{m['id']} shards:{bits.shard_ids()}")
+    return "\n".join(lines) or "no volume servers"
+
+
+@command("collection.list")
+async def cmd_collection_list(env, argv) -> str:
+    resp = await env.master_stub.call("CollectionList", {})
+    names = [c["name"] or "(default)" for c in resp.get("collections", [])]
+    return "\n".join(names) or "no collections"
+
+
+@command("collection.delete")
+async def cmd_collection_delete(env, argv) -> str:
+    env.confirm_is_locked()
+    flags = _parse_flags(argv)
+    name = flags.get("collection", argv[0] if argv else "")
+    await env.master_stub.call("CollectionDelete", {"name": name})
+    return f"deleted collection {name!r}"
+
+
+# ---------------- volume management ----------------
+@command("volume.mark.readonly")
+async def cmd_volume_mark_readonly(env, argv) -> str:
+    env.confirm_is_locked()
+    flags = _parse_flags(argv)
+    vid = int(flags["volumeId"])
+    for dn in await env.collect_data_nodes():
+        if any(int(v["id"]) == vid for v in dn.get("volumes", [])):
+            await env.volume_stub(dn["url"]).call(
+                "VolumeMarkReadonly", {"volume_id": vid}
+            )
+    return f"volume {vid} marked readonly"
+
+
+@command("volume.delete")
+async def cmd_volume_delete(env, argv) -> str:
+    env.confirm_is_locked()
+    flags = _parse_flags(argv)
+    vid = int(flags["volumeId"])
+    node = flags.get("node", "")
+    for dn in await env.collect_data_nodes():
+        if node and dn["url"] != node:
+            continue
+        if any(int(v["id"]) == vid for v in dn.get("volumes", [])):
+            await env.volume_stub(dn["url"]).call("VolumeDelete", {"volume_id": vid})
+    return f"volume {vid} deleted"
+
+
+@command("volume.unmount")
+async def cmd_volume_unmount(env, argv) -> str:
+    env.confirm_is_locked()
+    flags = _parse_flags(argv)
+    vid = int(flags["volumeId"])
+    node = flags["node"]
+    await env.volume_stub(node).call("VolumeUnmount", {"volume_id": vid})
+    return f"volume {vid} unmounted from {node}"
+
+
+@command("volume.mount")
+async def cmd_volume_mount(env, argv) -> str:
+    env.confirm_is_locked()
+    flags = _parse_flags(argv)
+    vid = int(flags["volumeId"])
+    node = flags["node"]
+    await env.volume_stub(node).call("VolumeMount", {"volume_id": vid})
+    return f"volume {vid} mounted on {node}"
+
+
+@command("volume.move")
+async def cmd_volume_move(env, argv) -> str:
+    """Copy a volume to a target node, then delete the source copy
+    (ref command_volume_move.go)."""
+    env.confirm_is_locked()
+    flags = _parse_flags(argv)
+    vid = int(flags["volumeId"])
+    source, target = flags["source"], flags["target"]
+    collection = flags.get("collection", "")
+    tstub = env.volume_stub(target)
+    r = await tstub.call(
+        "VolumeCopy",
+        {"volume_id": vid, "collection": collection, "source_data_node": source},
+        timeout=600,
+    )
+    if r.get("error"):
+        return f"move failed: {r['error']}"
+    await env.volume_stub(source).call("VolumeDelete", {"volume_id": vid})
+    return f"volume {vid} moved {source} -> {target}"
+
+
+@command("volume.vacuum")
+async def cmd_volume_vacuum(env, argv) -> str:
+    flags = _parse_flags(argv)
+    threshold = float(flags.get("garbageThreshold", 0.3))
+    import aiohttp
+
+    async with aiohttp.ClientSession() as session:
+        async with session.get(
+            f"http://{env.master}/vol/vacuum?garbageThreshold={threshold}"
+        ) as resp:
+            data = await resp.json()
+    return f"vacuum: {data}"
+
+
+@command("volume.fix.replication")
+async def cmd_volume_fix_replication(env, argv) -> str:
+    """Re-replicate under-replicated volumes (ref
+    command_volume_fix_replication.go)."""
+    env.confirm_is_locked()
+    nodes = await env.collect_data_nodes()
+    fixes = plan_replication_fixes(nodes)
+    done = []
+    for vid, source, target, collection in fixes:
+        r = await env.volume_stub(target).call(
+            "VolumeCopy",
+            {"volume_id": vid, "collection": collection,
+             "source_data_node": source},
+            timeout=600,
+        )
+        if not r.get("error"):
+            done.append(f"volume {vid}: copied {source} -> {target}")
+    return "\n".join(done) or "no under-replicated volumes"
+
+
+def plan_replication_fixes(
+    nodes: list[dict],
+) -> list[tuple[int, str, str, str]]:
+    """Pure planner: -> [(vid, source_url, target_url, collection)]."""
+    locations = defaultdict(list)
+    info_by_vid = {}
+    for dn in nodes:
+        for v in dn.get("volumes", []):
+            locations[int(v["id"])].append(dn["url"])
+            info_by_vid[int(v["id"])] = v
+    fixes = []
+    for vid, urls in locations.items():
+        info = info_by_vid[vid]
+        from ..storage.super_block import ReplicaPlacement
+
+        rp = ReplicaPlacement.from_byte(int(info.get("replica_placement", 0)))
+        want = rp.copy_count()
+        if len(urls) >= want:
+            continue
+        candidates = [
+            dn["url"]
+            for dn in nodes
+            if dn["url"] not in urls and int(dn.get("free_space", 0)) > 0
+        ]
+        for target in candidates[: want - len(urls)]:
+            fixes.append((vid, urls[0], target, info.get("collection", "")))
+    return fixes
+
+
+# ---------------- EC suite ----------------
+async def _collect_ec_nodes(env) -> list[EcNode]:
+    return nodes_from_topology(await env.collect_data_nodes())
+
+
+@command("ec.encode")
+async def cmd_ec_encode(env, argv) -> str:
+    """Erasure-code volumes and spread shards
+    (ref command_ec_encode.go:55-264)."""
+    env.confirm_is_locked()
+    flags = _parse_flags(argv)
+    collection = flags.get("collection", "")
+    vids: list[int] = []
+    if "volumeId" in flags:
+        vids = [int(flags["volumeId"])]
+    else:
+        full_pct = float(flags.get("fullPercent", 95))
+        nodes = await env.collect_data_nodes()
+        resp = await env.master_stub.call("VolumeList", {})
+        limit_mb = int(resp.get("volume_size_limit_mb", 30000))
+        seen = set()
+        for dn in nodes:
+            for v in dn.get("volumes", []):
+                vid = int(v["id"])
+                if vid in seen or v.get("collection", "") != collection:
+                    continue
+                if int(v.get("size", 0)) >= limit_mb * 1024 * 1024 * full_pct / 100:
+                    seen.add(vid)
+                    vids.append(vid)
+    results = []
+    for vid in vids:
+        results.append(await _do_ec_encode(env, vid, collection))
+    return "\n".join(results) or "no volumes to encode"
+
+
+async def _do_ec_encode(env, vid: int, collection: str) -> str:
+    nodes = await env.collect_data_nodes()
+    source = None
+    for dn in nodes:
+        if any(int(v["id"]) == vid for v in dn.get("volumes", [])):
+            source = dn["url"]
+            break
+    if source is None:
+        return f"volume {vid}: not found"
+    sstub = env.volume_stub(source)
+    await sstub.call("VolumeMarkReadonly", {"volume_id": vid})
+    r = await sstub.call(
+        "VolumeEcShardsGenerate",
+        {"volume_id": vid, "collection": collection},
+        timeout=3600,
+    )
+    if r.get("error"):
+        return f"volume {vid}: generate failed: {r['error']}"
+
+    ec_nodes = await _collect_ec_nodes(env)
+    assignment = plan_balanced_spread(
+        ec_nodes, vid, list(range(TOTAL_SHARDS_COUNT)), source
+    )
+    for target, shard_ids in assignment.items():
+        tstub = env.volume_stub(target)
+        if target != source:
+            r = await tstub.call(
+                "VolumeEcShardsCopy",
+                {
+                    "volume_id": vid,
+                    "collection": collection,
+                    "shard_ids": shard_ids,
+                    "copy_ecx_file": True,
+                    "source_data_node": source,
+                },
+                timeout=3600,
+            )
+            if r.get("error"):
+                return f"volume {vid}: copy to {target} failed: {r['error']}"
+        r = await tstub.call(
+            "VolumeEcShardsMount",
+            {"volume_id": vid, "collection": collection, "shard_ids": shard_ids},
+        )
+        if r.get("error"):
+            return f"volume {vid}: mount on {target} failed: {r['error']}"
+
+    # drop the source volume + its non-assigned shard files
+    await sstub.call("VolumeUnmount", {"volume_id": vid})
+    await sstub.call("VolumeDelete", {"volume_id": vid})
+    own = assignment.get(source, [])
+    await sstub.call(
+        "VolumeEcShardsDelete",
+        {
+            "volume_id": vid,
+            "collection": collection,
+            "shard_ids": [i for i in range(TOTAL_SHARDS_COUNT) if i not in own],
+        },
+    )
+    spread = {t: s for t, s in assignment.items()}
+    return f"volume {vid}: encoded, spread {spread}"
+
+
+@command("ec.decode")
+async def cmd_ec_decode(env, argv) -> str:
+    """Collect all data shards to one node and convert back to a volume
+    (ref command_ec_decode.go:75-148)."""
+    env.confirm_is_locked()
+    flags = _parse_flags(argv)
+    vid = int(flags["volumeId"])
+    collection = flags.get("collection", "")
+    ec_nodes = [n for n in await _collect_ec_nodes(env) if vid in n.shards]
+    if not ec_nodes:
+        return f"ec volume {vid} not found"
+    target = max(ec_nodes, key=lambda n: n.shards[vid].count())
+    have = set(target.shards[vid].shard_ids())
+    tstub = env.volume_stub(target.url)
+    for n in ec_nodes:
+        if n.url == target.url:
+            continue
+        missing_here = [
+            s for s in n.shards[vid].shard_ids() if s not in have
+        ]
+        if not missing_here:
+            continue
+        r = await tstub.call(
+            "VolumeEcShardsCopy",
+            {
+                "volume_id": vid,
+                "collection": collection,
+                "shard_ids": missing_here,
+                "copy_ecx_file": False,
+                "source_data_node": n.url,
+            },
+            timeout=3600,
+        )
+        if r.get("error"):
+            return f"copy shards {missing_here} from {n.url}: {r['error']}"
+        have.update(missing_here)
+    if len([s for s in have if s < DATA_SHARDS_COUNT]) < DATA_SHARDS_COUNT:
+        # rebuild missing data shards locally from parity
+        r = await tstub.call(
+            "VolumeEcShardsRebuild",
+            {"volume_id": vid, "collection": collection},
+            timeout=3600,
+        )
+        if r.get("error"):
+            return f"rebuild for decode failed: {r['error']}"
+    r = await tstub.call(
+        "VolumeEcShardsToVolume",
+        {"volume_id": vid, "collection": collection},
+        timeout=3600,
+    )
+    if r.get("error"):
+        return f"decode failed: {r['error']}"
+    # unmount ec shards everywhere, mount the volume
+    for n in ec_nodes:
+        nstub = env.volume_stub(n.url)
+        await nstub.call(
+            "VolumeEcShardsUnmount",
+            {"volume_id": vid, "shard_ids": n.shards[vid].shard_ids()},
+        )
+        await nstub.call(
+            "VolumeEcShardsDelete",
+            {"volume_id": vid, "collection": collection,
+             "shard_ids": list(range(TOTAL_SHARDS_COUNT))},
+        )
+    await tstub.call("VolumeMount", {"volume_id": vid})
+    return f"ec volume {vid} decoded back to a normal volume on {target.url}"
+
+
+@command("ec.rebuild")
+async def cmd_ec_rebuild(env, argv) -> str:
+    """Rebuild missing shards of damaged EC volumes
+    (ref command_ec_rebuild.go:97-244)."""
+    env.confirm_is_locked()
+    flags = _parse_flags(argv)
+    collection = flags.get("collection", "")
+    ec_nodes = await _collect_ec_nodes(env)
+    by_vid: dict[int, ShardBits] = defaultdict(ShardBits)
+    for n in ec_nodes:
+        for vid, bits in n.shards.items():
+            by_vid[vid] = by_vid[vid].plus(bits)
+    results = []
+    for vid, bits in sorted(by_vid.items()):
+        missing = [
+            i for i in range(TOTAL_SHARDS_COUNT) if not bits.has(i)
+        ]
+        if not missing:
+            continue
+        if bits.count() < DATA_SHARDS_COUNT:
+            results.append(f"volume {vid}: unrepairable ({bits.count()} shards)")
+            continue
+        rebuilder = max(ec_nodes, key=lambda n: n.free_slots)
+        rstub = env.volume_stub(rebuilder.url)
+        local = rebuilder.shards.get(vid, ShardBits())
+        # pull every survivor shard the rebuilder lacks
+        for n in ec_nodes:
+            if n.url == rebuilder.url:
+                continue
+            pull = [
+                s
+                for s in n.shards.get(vid, ShardBits()).shard_ids()
+                if not local.has(s)
+            ]
+            if not pull:
+                continue
+            r = await rstub.call(
+                "VolumeEcShardsCopy",
+                {
+                    "volume_id": vid,
+                    "collection": collection,
+                    "shard_ids": pull,
+                    "copy_ecx_file": True,
+                    "source_data_node": n.url,
+                },
+                timeout=3600,
+            )
+            if r.get("error"):
+                return f"volume {vid}: copy for rebuild: {r['error']}"
+            for s in pull:
+                local = local.add(s)
+        r = await rstub.call(
+            "VolumeEcShardsRebuild",
+            {"volume_id": vid, "collection": collection},
+            timeout=3600,
+        )
+        if r.get("error"):
+            results.append(f"volume {vid}: rebuild failed: {r['error']}")
+            continue
+        rebuilt = r.get("rebuilt_shard_ids", [])
+        await rstub.call(
+            "VolumeEcShardsMount",
+            {"volume_id": vid, "collection": collection, "shard_ids": rebuilt},
+        )
+        # drop the extra survivor copies the rebuilder pulled
+        extra = [
+            s for s in local.shard_ids()
+            if s not in rebuilt and not rebuilder.shards.get(vid, ShardBits()).has(s)
+        ]
+        if extra:
+            await rstub.call(
+                "VolumeEcShardsDelete",
+                {"volume_id": vid, "collection": collection, "shard_ids": extra},
+            )
+        results.append(f"volume {vid}: rebuilt shards {rebuilt} on {rebuilder.url}")
+    return "\n".join(results) or "no damaged ec volumes"
+
+
+@command("ec.balance")
+async def cmd_ec_balance(env, argv) -> str:
+    """Dedupe + rack-aware rebalancing of EC shards
+    (ref command_ec_balance.go:29-95)."""
+    env.confirm_is_locked()
+    flags = _parse_flags(argv)
+    collection = flags.get("collection", "")
+    ec_nodes = await _collect_ec_nodes(env)
+    vids = sorted({vid for n in ec_nodes for vid in n.shards})
+    log = []
+    for vid in vids:
+        for shard_id, url in plan_dedupe(ec_nodes, vid):
+            stub = env.volume_stub(url)
+            await stub.call(
+                "VolumeEcShardsUnmount", {"volume_id": vid, "shard_ids": [shard_id]}
+            )
+            await stub.call(
+                "VolumeEcShardsDelete",
+                {"volume_id": vid, "collection": collection,
+                 "shard_ids": [shard_id]},
+            )
+            log.append(f"volume {vid}: dropped duplicate shard {shard_id} on {url}")
+        for move in plan_rack_balance(ec_nodes, vid):
+            await execute_shard_move(env, move, collection)
+            log.append(
+                f"volume {vid}: moved shard {move.shard_id} "
+                f"{move.source} -> {move.target}"
+            )
+    return "\n".join(log) or "balanced: no moves needed"

@@ -1,0 +1,225 @@
+"""Shell planners (pure, fake topologies — like the reference's
+command_ec_test.go) + end-to-end shell commands on the in-process cluster."""
+
+import asyncio
+import random
+
+import pytest
+
+from seaweedfs_tpu.shell.ec_common import (
+    EcNode,
+    plan_balanced_spread,
+    plan_dedupe,
+    plan_rack_balance,
+)
+from seaweedfs_tpu.shell.commands import plan_replication_fixes
+from seaweedfs_tpu.storage.erasure_coding.ec_volume import ShardBits
+
+
+def make_node(url, rack="r1", dc="dc1", free=100, shards=None):
+    n = EcNode(url=url, data_center=dc, rack=rack, free_slots=free)
+    for vid, ids in (shards or {}).items():
+        for sid in ids:
+            n.add(vid, sid)
+    return n
+
+
+def test_plan_balanced_spread_even():
+    nodes = [make_node(f"s{i}", free=100) for i in range(3)]
+    assignment = plan_balanced_spread(nodes, 1, list(range(14)), "s0")
+    counts = sorted(len(v) for v in assignment.values())
+    assert sum(counts) == 14
+    assert counts[-1] - counts[0] <= 1  # even +/- 1
+
+
+def test_plan_balanced_spread_respects_existing_load():
+    nodes = [
+        make_node("s0", shards={9: range(10)}),  # already has 10 shards
+        make_node("s1"),
+        make_node("s2"),
+    ]
+    assignment = plan_balanced_spread(nodes, 1, list(range(14)), "s0")
+    assert len(assignment.get("s0", [])) < len(assignment.get("s1", []))
+
+
+def test_plan_dedupe():
+    nodes = [
+        make_node("s0", shards={1: [0, 1, 2]}),
+        make_node("s1", shards={1: [2, 3]}),  # shard 2 duplicated
+    ]
+    deletions = plan_dedupe(nodes, 1)
+    assert len(deletions) == 1
+    assert deletions[0][0] == 2
+
+
+def test_plan_rack_balance_across_racks():
+    # all 14 shards on rack r1 over 2 nodes; racks r2, r3 empty
+    nodes = [
+        make_node("s0", rack="r1", shards={1: range(7)}),
+        make_node("s1", rack="r1", shards={1: range(7, 14)}),
+        make_node("s2", rack="r2"),
+        make_node("s3", rack="r3"),
+    ]
+    moves = plan_rack_balance(nodes, 1)
+    assert moves, "expected rebalancing moves"
+    # after the planned moves, no rack should hold more than ceil(14/3)=5
+    holder_rack = {}
+    by_url = {n.url: n for n in nodes}
+    for n in nodes:
+        for sid in n.shards.get(1, ShardBits()).shard_ids():
+            holder_rack[sid] = n.rack
+    for m in moves:
+        holder_rack[m.shard_id] = by_url[m.target].rack
+    per_rack = {}
+    for sid, rack in holder_rack.items():
+        per_rack[rack] = per_rack.get(rack, 0) + 1
+    assert max(per_rack.values()) <= 5, per_rack
+
+
+def test_plan_replication_fixes():
+    nodes = [
+        {
+            "url": "s0",
+            "free_space": 5,
+            "volumes": [
+                {"id": 1, "replica_placement": 1, "collection": ""},  # wants 2 copies
+                {"id": 2, "replica_placement": 0, "collection": ""},
+            ],
+        },
+        {"url": "s1", "free_space": 5, "volumes": []},
+    ]
+    fixes = plan_replication_fixes(nodes)
+    assert fixes == [(1, "s0", "s1", "")]
+
+
+def test_shell_commands_end_to_end(tmp_path):
+    from test_cluster import Cluster
+
+    import aiohttp
+
+    from seaweedfs_tpu.client import assign
+    from seaweedfs_tpu.client.operation import read_url, upload_data
+    from seaweedfs_tpu.pb.rpc import close_all_channels
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
+
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=3)
+        await cluster.start()
+        try:
+            env = CommandEnv(cluster.master.address)
+            out = await run_command(env, "volume.list")
+            assert "node" in out
+
+            # mutating command without the lock must fail
+            from seaweedfs_tpu.shell.command_env import NotLockedError
+
+            with pytest.raises(NotLockedError):
+                await run_command(env, "ec.encode -volumeId 1")
+
+            async with aiohttp.ClientSession() as session:
+                ar0 = await assign(cluster.master.address)
+                vid = int(ar0.fid.split(",")[0])
+                payloads = {}
+                for i in range(1, 15):
+                    fid = f"{vid},{format_needle_id_cookie(i, 0xCC00 + i)}"
+                    data = random.randbytes(3000 + i * 7)
+                    await upload_data(session, ar0.url, fid, data)
+                    payloads[fid] = data
+
+                # wait for the new volume to arrive in a heartbeat inventory
+                for _ in range(100):
+                    nodes = await env.collect_data_nodes()
+                    if any(
+                        int(v["id"]) == vid
+                        for dn in nodes
+                        for v in dn.get("volumes", [])
+                    ):
+                        break
+                    await asyncio.sleep(0.1)
+
+                assert (await run_command(env, "lock")) == "locked"
+                out = await run_command(env, f"ec.encode -volumeId {vid}")
+                assert "encoded" in out, out
+
+                # wait for ec registration, then read through the EC path
+                for _ in range(100):
+                    locs = cluster.master.topo.lookup_ec_shards(vid)
+                    if locs is not None and sum(1 for l in locs.locations if l) == 14:
+                        break
+                    await asyncio.sleep(0.1)
+                servers = [vs.address for vs in cluster.volume_servers]
+                for fid, data in payloads.items():
+                    got = await read_url(session, f"http://{servers[0]}/{fid}")
+                    assert got == data
+
+                out = await run_command(env, "ec.balance")
+                assert "balanced" in out or "moved" in out or "dropped" in out
+
+                # damage: drop one server's shards, then rebuild
+                victim = cluster.volume_servers[1]
+                victim_shards = []
+                for loc in victim.store.locations:
+                    ev = loc.find_ec_volume(vid)
+                    if ev:
+                        victim_shards = ev.shard_ids()
+                victim_shards = victim_shards[:4]  # parity can repair <= 4
+                if victim_shards:
+                    from seaweedfs_tpu.pb import grpc_address
+                    from seaweedfs_tpu.pb.rpc import Stub
+
+                    vstub = Stub(grpc_address(victim.address), "volume")
+                    await vstub.call(
+                        "VolumeEcShardsUnmount",
+                        {"volume_id": vid, "shard_ids": victim_shards},
+                    )
+                    await vstub.call(
+                        "VolumeEcShardsDelete",
+                        {"volume_id": vid, "shard_ids": victim_shards},
+                    )
+                    await asyncio.sleep(0.5)
+                    out = await run_command(env, "ec.rebuild")
+                    assert "rebuilt" in out, out
+
+                # decode back to a normal volume and read again
+                out = await run_command(env, f"ec.decode -volumeId {vid}")
+                assert "decoded" in out, out
+                await asyncio.sleep(0.5)
+                for fid, data in list(payloads.items())[:3]:
+                    from seaweedfs_tpu.client.operation import lookup
+
+                    locs = await lookup(cluster.master.address, vid)
+                    assert locs, "decoded volume not registered"
+                    got = await read_url(session, f"http://{locs[0]}/{fid}")
+                    assert got == data
+
+                assert (await run_command(env, "unlock")) == "unlocked"
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_benchmark_smoke(tmp_path):
+    from test_cluster import Cluster
+
+    from seaweedfs_tpu.command.benchmark import fake_payload, run_benchmark
+
+    assert fake_payload(7, 16) == (7).to_bytes(8, "big") * 2
+    assert len(fake_payload(3, 100)) == 100
+
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        try:
+            report = await run_benchmark(
+                cluster.master.address, num_files=40, file_size=512, concurrency=4
+            )
+            assert "Writing Benchmark" in report
+            assert "Randomly Reading Benchmark" in report
+            assert "Requests per second" in report
+            assert "Failed requests:        0" in report
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
