@@ -104,3 +104,18 @@ class MasterClient:
         if url is None:
             raise LookupError(f"volume {vid} not found in cache")
         return f"http://{url}/{fid}"
+
+    async def lookup_file_id_async(self, fid: str) -> str:
+        """Cache lookup with a master-RPC fallback on miss."""
+        vid = int(fid.split(",")[0])
+        url = self.vid_map.pick(vid)
+        if url is None:
+            stub = Stub(grpc_address(self.current_master), "master")
+            resp = await stub.call("LookupVolume", {"volume_ids": [str(vid)]})
+            for r in resp.get("volume_id_locations", []):
+                for loc in r.get("locations", []):
+                    self.vid_map.add(vid, loc["url"])
+            url = self.vid_map.pick(vid)
+        if url is None:
+            raise LookupError(f"volume {vid} not found")
+        return f"http://{url}/{fid}"

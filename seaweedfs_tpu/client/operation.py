@@ -77,7 +77,10 @@ async def upload_data(
 async def read_url(session: aiohttp.ClientSession, full_url: str) -> bytes:
     async with session.get(full_url) as resp:
         if resp.status != 200:
-            raise RuntimeError(f"read {full_url}: status {resp.status}")
+            body = (await resp.read())[:200]
+            raise RuntimeError(
+                f"read {full_url}: status {resp.status} body {body!r}"
+            )
         return await resp.read()
 
 

@@ -1,0 +1,28 @@
+"""Filer: a directory/file namespace over the object store
+(ref: weed/filer2/). Entries carry chunk lists pointing at needle fids;
+stores are pluggable (memory, sqlite standing in for the reference's
+leveldb/sql family)."""
+
+from .entry import Attr, Entry, FileChunk
+from .filechunks import (
+    VisibleInterval,
+    non_overlapping_visible_intervals,
+    read_from_visible_intervals,
+    total_size,
+)
+from .filer import Filer
+from .filer_store import FilerStore, MemoryFilerStore, SqliteFilerStore
+
+__all__ = [
+    "Attr",
+    "Entry",
+    "FileChunk",
+    "VisibleInterval",
+    "non_overlapping_visible_intervals",
+    "read_from_visible_intervals",
+    "total_size",
+    "Filer",
+    "FilerStore",
+    "MemoryFilerStore",
+    "SqliteFilerStore",
+]

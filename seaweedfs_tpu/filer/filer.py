@@ -1,0 +1,134 @@
+"""Filer core: directory-tree invariants over a FilerStore
+(ref: weed/filer2/filer.go:29-42, filer_delete_entry.go)."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from .entry import Attr, Entry, FileChunk, new_directory_entry
+from .filer_store import FilerStore
+
+
+class Filer:
+    def __init__(self, store: FilerStore, on_delete_chunks: Optional[Callable] = None):
+        self.store = store
+        self.on_delete_chunks = on_delete_chunks  # async fid-deletion queue hook
+        root = self.store.find_entry("/")
+        if root is None:
+            self.store.insert_entry(new_directory_entry("/", 0o775))
+
+    # --- mkdir -p for parents (ref filer.go CreateEntry ensuring dirs) ---
+    def _ensure_parents(self, full_path: str) -> None:
+        parts = [p for p in full_path.split("/") if p][:-1]
+        path = ""
+        for p in parts:
+            path += "/" + p
+            existing = self.store.find_entry(path)
+            if existing is None:
+                self.store.insert_entry(new_directory_entry(path))
+            elif not existing.is_directory:
+                raise NotADirectoryError(f"{path} is a file")
+
+    def create_entry(self, entry: Entry) -> None:
+        if entry.full_path != "/":
+            self._ensure_parents(entry.full_path)
+        existing = self.store.find_entry(entry.full_path)
+        if existing is not None and self.on_delete_chunks and existing.chunks:
+            old_fids = {c.fid for c in existing.chunks} - {
+                c.fid for c in entry.chunks
+            }
+            if old_fids:
+                self.on_delete_chunks(sorted(old_fids))
+        self.store.insert_entry(entry)
+
+    def update_entry(self, entry: Entry) -> None:
+        self.store.update_entry(entry)
+
+    def find_entry(self, full_path: str) -> Optional[Entry]:
+        return self.store.find_entry(full_path)
+
+    def delete_entry(
+        self, full_path: str, recursive: bool = False, delete_chunks: bool = True
+    ) -> list[FileChunk]:
+        """Returns the chunks to garbage-collect
+        (ref filer_delete_entry.go)."""
+        entry = self.store.find_entry(full_path)
+        if entry is None:
+            return []
+        collected: list[FileChunk] = []
+        if entry.is_directory:
+            children = self.store.list_directory_entries(full_path, "", True, 2)
+            if children and not recursive:
+                raise OSError(f"directory {full_path} not empty")
+            for child in self.list_entries_recursive(full_path):
+                collected.extend(child.chunks)
+            self.store.delete_folder_children(full_path)
+        else:
+            collected.extend(entry.chunks)
+        self.store.delete_entry(full_path)
+        if delete_chunks and self.on_delete_chunks and collected:
+            self.on_delete_chunks(sorted({c.fid for c in collected}))
+        return collected
+
+    def list_entries(
+        self,
+        dir_path: str,
+        start_file_name: str = "",
+        inclusive: bool = True,
+        limit: int = 1024,
+    ) -> list[Entry]:
+        return self.store.list_directory_entries(
+            dir_path, start_file_name, inclusive, limit
+        )
+
+    def list_entries_recursive(self, dir_path: str):
+        stack = [dir_path]
+        while stack:
+            d = stack.pop()
+            last = ""
+            while True:
+                batch = self.store.list_directory_entries(d, last, False, 1024)
+                if not batch:
+                    break
+                for e in batch:
+                    yield e
+                    if e.is_directory:
+                        stack.append(e.full_path)
+                last = batch[-1].name
+
+    def rename(self, old_path: str, new_path: str) -> None:
+        """Move a file or directory subtree (ref filer_grpc_server_rename.go)."""
+        entry = self.store.find_entry(old_path)
+        if entry is None:
+            raise FileNotFoundError(old_path)
+        self._ensure_parents(new_path)
+        if entry.is_directory:
+            for child in list(self.list_entries_recursive(old_path)):
+                suffix = child.full_path[len(old_path) :]
+                moved = Entry(
+                    full_path=new_path + suffix,
+                    attr=child.attr,
+                    chunks=child.chunks,
+                    extended=child.extended,
+                )
+                self.store.insert_entry(moved)
+            self.store.delete_folder_children(old_path)
+        entry_new = Entry(
+            full_path=new_path,
+            attr=entry.attr,
+            chunks=entry.chunks,
+            extended=entry.extended,
+        )
+        self.store.insert_entry(entry_new)
+        self.store.delete_entry(old_path)
+
+    def touch(self, full_path: str, mime: str, chunks: list[FileChunk], **attrs) -> Entry:
+        now = time.time()
+        entry = Entry(
+            full_path=full_path,
+            attr=Attr(mtime=now, crtime=now, mime=mime, **attrs),
+            chunks=chunks,
+        )
+        self.create_entry(entry)
+        return entry

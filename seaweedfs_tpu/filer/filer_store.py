@@ -1,0 +1,148 @@
+"""Pluggable filer stores (ref: weed/filer2/filerstore.go:12-31).
+
+Interface: insert/update/find/delete/delete_children/list by (directory,
+name). Two implementations: in-memory dict (ref memdb store) and sqlite
+(standing in for the reference's leveldb/mysql/postgres family — same
+abstract-sql shape, ref weed/filer2/abstract_sql/)."""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+from typing import Optional, Protocol
+
+from .entry import Entry
+
+
+class FilerStore(Protocol):
+    def insert_entry(self, entry: Entry) -> None: ...
+    def update_entry(self, entry: Entry) -> None: ...
+    def find_entry(self, full_path: str) -> Optional[Entry]: ...
+    def delete_entry(self, full_path: str) -> None: ...
+    def delete_folder_children(self, full_path: str) -> None: ...
+    def list_directory_entries(
+        self, dir_path: str, start_file_name: str, inclusive: bool, limit: int
+    ) -> list[Entry]: ...
+
+
+def _split(full_path: str) -> tuple[str, str]:
+    if full_path == "/":
+        return "", "/"
+    d, _, name = full_path.rstrip("/").rpartition("/")
+    return d or "/", name
+
+
+class MemoryFilerStore:
+    def __init__(self):
+        # directory -> {name -> Entry}
+        self._dirs: dict[str, dict[str, Entry]] = {}
+        self._lock = threading.RLock()
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, name = _split(entry.full_path)
+        with self._lock:
+            self._dirs.setdefault(d, {})[name] = entry
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Optional[Entry]:
+        d, name = _split(full_path)
+        with self._lock:
+            return self._dirs.get(d, {}).get(name)
+
+    def delete_entry(self, full_path: str) -> None:
+        d, name = _split(full_path)
+        with self._lock:
+            self._dirs.get(d, {}).pop(name, None)
+
+    def delete_folder_children(self, full_path: str) -> None:
+        prefix = full_path.rstrip("/")
+        with self._lock:
+            self._dirs.pop(prefix, None)
+            for d in [k for k in self._dirs if k.startswith(prefix + "/")]:
+                self._dirs.pop(d, None)
+
+    def list_directory_entries(
+        self, dir_path: str, start_file_name: str, inclusive: bool, limit: int
+    ) -> list[Entry]:
+        with self._lock:
+            names = sorted(self._dirs.get(dir_path.rstrip("/") or "/", {}))
+            out = []
+            for name in names:
+                if start_file_name:
+                    if inclusive and name < start_file_name:
+                        continue
+                    if not inclusive and name <= start_file_name:
+                        continue
+                out.append(self._dirs[dir_path.rstrip("/") or "/"][name])
+                if len(out) >= limit:
+                    break
+            return out
+
+
+class SqliteFilerStore:
+    """Durable store with the abstract-sql schema shape
+    (dirhash+name keyed rows, ref weed/filer2/abstract_sql)."""
+
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.RLock()
+        self._conn.execute(
+            """CREATE TABLE IF NOT EXISTS filemeta (
+                directory TEXT NOT NULL,
+                name TEXT NOT NULL,
+                meta TEXT NOT NULL,
+                PRIMARY KEY (directory, name)
+            )"""
+        )
+        self._conn.commit()
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, name = _split(entry.full_path)
+        with self._lock:
+            self._conn.execute(
+                "REPLACE INTO filemeta (directory, name, meta) VALUES (?,?,?)",
+                (d, name, json.dumps(entry.to_dict())),
+            )
+            self._conn.commit()
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Optional[Entry]:
+        d, name = _split(full_path)
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT meta FROM filemeta WHERE directory=? AND name=?",
+                (d, name),
+            ).fetchone()
+        return Entry.from_dict(json.loads(row[0])) if row else None
+
+    def delete_entry(self, full_path: str) -> None:
+        d, name = _split(full_path)
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM filemeta WHERE directory=? AND name=?", (d, name)
+            )
+            self._conn.commit()
+
+    def delete_folder_children(self, full_path: str) -> None:
+        prefix = full_path.rstrip("/")
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM filemeta WHERE directory=? OR directory LIKE ?",
+                (prefix, prefix + "/%"),
+            )
+            self._conn.commit()
+
+    def list_directory_entries(
+        self, dir_path: str, start_file_name: str, inclusive: bool, limit: int
+    ) -> list[Entry]:
+        op = ">=" if inclusive else ">"
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT meta FROM filemeta WHERE directory=? AND name {op} ? "
+                "ORDER BY name LIMIT ?",
+                (dir_path.rstrip("/") or "/", start_file_name, limit),
+            ).fetchall()
+        return [Entry.from_dict(json.loads(r[0])) for r in rows]

@@ -1,0 +1,313 @@
+"""S3-compatible gateway over the filer (ref: weed/s3api/).
+
+Buckets are directories under /buckets in the filer namespace
+(ref: s3api_server.go router + filer_util.go). Supported surface:
+ListBuckets, Create/Delete bucket, Put/Get/Head/Delete object,
+ListObjectsV2, and multipart uploads (initiate / upload part / complete /
+abort) — completion is a metadata-only merge of the parts' chunk lists, no
+data copy. Anonymous auth (the reference allows anonymous without IAM
+config; V4 signatures are a later round).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+import xml.etree.ElementTree as ET
+from typing import Optional
+
+from aiohttp import web
+
+from ..filer import (
+    Entry,
+    FileChunk,
+    Filer,
+    non_overlapping_visible_intervals,
+    read_from_visible_intervals,
+)
+
+BUCKETS_ROOT = "/buckets"
+UPLOADS_DIR = "/.uploads"
+
+
+def _xml(root: ET.Element) -> web.Response:
+    return web.Response(
+        body=b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root),
+        content_type="application/xml",
+    )
+
+
+def _error(code: str, message: str, status: int) -> web.Response:
+    root = ET.Element("Error")
+    ET.SubElement(root, "Code").text = code
+    ET.SubElement(root, "Message").text = message
+    return web.Response(
+        body=ET.tostring(root), status=status, content_type="application/xml"
+    )
+
+
+class S3Server:
+    """Protocol translator: S3 REST <-> filer namespace.
+
+    Runs in-process with a FilerServer (shares its Filer + chunk IO),
+    mirroring the reference where s3api rides the filer's gRPC.
+    """
+
+    def __init__(self, filer_server, host: str = "127.0.0.1", port: int = 8333):
+        self.fs = filer_server
+        self.filer: Filer = filer_server.filer
+        self.host = host
+        self.port = port
+        self.address = f"{host}:{port}"
+        self._http_runner: Optional[web.AppRunner] = None
+
+    async def start(self) -> None:
+        app = web.Application(client_max_size=1024 << 20)
+        app.router.add_route("*", "/{tail:.*}", self._dispatch)
+        self._http_runner = web.AppRunner(app)
+        await self._http_runner.setup()
+        site = web.TCPSite(self._http_runner, self.host, self.port)
+        await site.start()
+
+    async def stop(self) -> None:
+        if self._http_runner is not None:
+            await self._http_runner.cleanup()
+
+    # ---------------- routing ----------------
+    async def _dispatch(self, request: web.Request) -> web.Response:
+        path = request.path.strip("/")
+        if not path:
+            return await self._list_buckets(request)
+        bucket, _, key = path.partition("/")
+        if not key:
+            if request.method == "PUT":
+                return await self._create_bucket(bucket)
+            if request.method == "DELETE":
+                return await self._delete_bucket(bucket)
+            if request.method in ("GET", "HEAD"):
+                return await self._list_objects(request, bucket)
+            return _error("MethodNotAllowed", "method not allowed", 405)
+        if "uploads" in request.query and request.method == "POST":
+            return await self._initiate_multipart(bucket, key)
+        if "uploadId" in request.query:
+            if request.method == "PUT":
+                return await self._upload_part(request, bucket, key)
+            if request.method == "POST":
+                return await self._complete_multipart(request, bucket, key)
+            if request.method == "DELETE":
+                return await self._abort_multipart(request, bucket, key)
+        if request.method == "PUT":
+            return await self._put_object(request, bucket, key)
+        if request.method in ("GET", "HEAD"):
+            return await self._get_object(request, bucket, key)
+        if request.method == "DELETE":
+            return await self._delete_object(bucket, key)
+        return _error("MethodNotAllowed", "method not allowed", 405)
+
+    # ---------------- buckets ----------------
+    async def _list_buckets(self, request: web.Request) -> web.Response:
+        root = ET.Element("ListAllMyBucketsResult")
+        owner = ET.SubElement(root, "Owner")
+        ET.SubElement(owner, "ID").text = "seaweedfs-tpu"
+        buckets = ET.SubElement(root, "Buckets")
+        base = self.filer.find_entry(BUCKETS_ROOT)
+        if base is not None:
+            for e in self.filer.list_entries(BUCKETS_ROOT):
+                if e.is_directory and not e.name.startswith("."):
+                    b = ET.SubElement(buckets, "Bucket")
+                    ET.SubElement(b, "Name").text = e.name
+                    ET.SubElement(b, "CreationDate").text = time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime(e.attr.crtime)
+                    )
+        return _xml(root)
+
+    async def _create_bucket(self, bucket: str) -> web.Response:
+        from ..filer.entry import new_directory_entry
+
+        self.filer.create_entry(new_directory_entry(f"{BUCKETS_ROOT}/{bucket}"))
+        return web.Response(status=200)
+
+    async def _delete_bucket(self, bucket: str) -> web.Response:
+        path = f"{BUCKETS_ROOT}/{bucket}"
+        if self.filer.find_entry(path) is None:
+            return _error("NoSuchBucket", f"bucket {bucket} not found", 404)
+        self.filer.delete_entry(path, recursive=True)
+        return web.Response(status=204)
+
+    async def _list_objects(self, request: web.Request, bucket: str) -> web.Response:
+        path = f"{BUCKETS_ROOT}/{bucket}"
+        if self.filer.find_entry(path) is None:
+            return _error("NoSuchBucket", f"bucket {bucket} not found", 404)
+        prefix = request.query.get("prefix", "")
+        max_keys = int(request.query.get("max-keys", 1000))
+        delimiter = request.query.get("delimiter", "")
+
+        contents: list[tuple[str, Entry]] = []
+        common: set[str] = set()
+
+        def walk(dir_path: str, rel: str) -> None:
+            for e in self.filer.list_entries(dir_path, limit=100_000):
+                child_rel = f"{rel}{e.name}" if rel else e.name
+                if e.is_directory:
+                    if delimiter == "/" and child_rel.startswith(prefix):
+                        common.add(child_rel + "/")
+                        continue
+                    walk(e.full_path, child_rel + "/")
+                elif child_rel.startswith(prefix):
+                    contents.append((child_rel, e))
+
+        walk(path, "")
+        contents.sort(key=lambda t: t[0])
+        root = ET.Element("ListBucketResult")
+        ET.SubElement(root, "Name").text = bucket
+        ET.SubElement(root, "Prefix").text = prefix
+        ET.SubElement(root, "MaxKeys").text = str(max_keys)
+        ET.SubElement(root, "KeyCount").text = str(min(len(contents), max_keys))
+        ET.SubElement(root, "IsTruncated").text = (
+            "true" if len(contents) > max_keys else "false"
+        )
+        for key, e in contents[:max_keys]:
+            c = ET.SubElement(root, "Contents")
+            ET.SubElement(c, "Key").text = key
+            ET.SubElement(c, "Size").text = str(e.size())
+            ET.SubElement(c, "LastModified").text = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(e.attr.mtime)
+            )
+            ET.SubElement(c, "ETag").text = '"%s"' % (e.extended.get("etag", ""))
+        for p in sorted(common):
+            cp = ET.SubElement(root, "CommonPrefixes")
+            ET.SubElement(cp, "Prefix").text = p
+        return _xml(root)
+
+    # ---------------- objects ----------------
+    def _object_path(self, bucket: str, key: str) -> str:
+        return f"{BUCKETS_ROOT}/{bucket}/{key}"
+
+    async def _put_object(self, request: web.Request, bucket: str, key: str) -> web.Response:
+        if self.filer.find_entry(f"{BUCKETS_ROOT}/{bucket}") is None:
+            return _error("NoSuchBucket", f"bucket {bucket} not found", 404)
+        data = await request.read()
+        chunks = await self.fs._write_chunks(data)
+        import hashlib
+
+        etag = hashlib.md5(data).hexdigest()
+        entry = self.filer.touch(
+            self._object_path(bucket, key),
+            request.headers.get("Content-Type", ""),
+            chunks,
+        )
+        entry.extended["etag"] = etag
+        self.filer.update_entry(entry)
+        return web.Response(status=200, headers={"ETag": f'"{etag}"'})
+
+    async def _get_object(self, request: web.Request, bucket: str, key: str) -> web.Response:
+        entry = self.filer.find_entry(self._object_path(bucket, key))
+        if entry is None or entry.is_directory:
+            return _error("NoSuchKey", f"key {key} not found", 404)
+        size = entry.size()
+        headers = {
+            "Content-Length": str(size),
+            "ETag": '"%s"' % entry.extended.get("etag", ""),
+            "Last-Modified": time.strftime(
+                "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(entry.attr.mtime)
+            ),
+        }
+        if request.method == "HEAD":
+            return web.Response(status=200, headers=headers)
+        visibles = non_overlapping_visible_intervals(entry.chunks)
+        blobs = {}
+        for v in visibles:
+            if v.fid not in blobs:
+                blobs[v.fid] = await self.fs._fetch_chunk(v.fid)
+        body = read_from_visible_intervals(visibles, blobs.__getitem__, 0, size)
+        return web.Response(
+            body=body,
+            content_type=entry.attr.mime or "application/octet-stream",
+            headers={"ETag": headers["ETag"]},
+        )
+
+    async def _delete_object(self, bucket: str, key: str) -> web.Response:
+        self.filer.delete_entry(self._object_path(bucket, key))
+        return web.Response(status=204)
+
+    # ---------------- multipart ----------------
+    def _upload_dir(self, upload_id: str) -> str:
+        return f"{BUCKETS_ROOT}{UPLOADS_DIR}/{upload_id}"
+
+    async def _initiate_multipart(self, bucket: str, key: str) -> web.Response:
+        upload_id = uuid.uuid4().hex
+        from ..filer.entry import new_directory_entry
+
+        d = new_directory_entry(self._upload_dir(upload_id))
+        d.extended = {"bucket": bucket, "key": key}
+        self.filer.create_entry(d)
+        root = ET.Element("InitiateMultipartUploadResult")
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "Key").text = key
+        ET.SubElement(root, "UploadId").text = upload_id
+        return _xml(root)
+
+    async def _upload_part(self, request: web.Request, bucket: str, key: str) -> web.Response:
+        upload_id = request.query["uploadId"]
+        part_number = int(request.query.get("partNumber", 1))
+        if self.filer.find_entry(self._upload_dir(upload_id)) is None:
+            return _error("NoSuchUpload", upload_id, 404)
+        data = await request.read()
+        chunks = await self.fs._write_chunks(data)
+        import hashlib
+
+        etag = hashlib.md5(data).hexdigest()
+        entry = self.filer.touch(
+            f"{self._upload_dir(upload_id)}/{part_number:05d}.part", "", chunks
+        )
+        entry.extended["etag"] = etag
+        self.filer.update_entry(entry)
+        return web.Response(status=200, headers={"ETag": f'"{etag}"'})
+
+    async def _complete_multipart(self, request: web.Request, bucket: str, key: str) -> web.Response:
+        upload_id = request.query["uploadId"]
+        updir = self._upload_dir(upload_id)
+        if self.filer.find_entry(updir) is None:
+            return _error("NoSuchUpload", upload_id, 404)
+        parts = sorted(
+            (e for e in self.filer.list_entries(updir) if e.name.endswith(".part")),
+            key=lambda e: e.name,
+        )
+        # metadata-only concatenation: shift each part's chunks
+        merged: list[FileChunk] = []
+        offset = 0
+        for part in parts:
+            for c in sorted(part.chunks, key=lambda c: c.offset):
+                merged.append(
+                    FileChunk(
+                        fid=c.fid,
+                        offset=offset + c.offset,
+                        size=c.size,
+                        mtime_ns=c.mtime_ns,
+                        etag=c.etag,
+                    )
+                )
+            offset += part.size()
+        entry = self.filer.touch(self._object_path(bucket, key), "", merged)
+        import hashlib
+
+        etag = (
+            hashlib.md5(b"".join(p.extended.get("etag", "").encode() for p in parts)).hexdigest()
+            + f"-{len(parts)}"
+        )
+        entry.extended["etag"] = etag
+        self.filer.update_entry(entry)
+        # drop part entries without freeing the (now shared) chunks
+        for part in parts:
+            self.filer.delete_entry(part.full_path, delete_chunks=False)
+        self.filer.delete_entry(updir, recursive=True, delete_chunks=False)
+        root = ET.Element("CompleteMultipartUploadResult")
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "Key").text = key
+        ET.SubElement(root, "ETag").text = f'"{etag}"'
+        return _xml(root)
+
+    async def _abort_multipart(self, request: web.Request, bucket: str, key: str) -> web.Response:
+        upload_id = request.query["uploadId"]
+        self.filer.delete_entry(self._upload_dir(upload_id), recursive=True)
+        return web.Response(status=204)

@@ -1,0 +1,324 @@
+"""Filer server: HTTP file namespace + gRPC metadata API.
+
+HTTP (ref: weed/server/filer_server_handlers_{read,write}*.go):
+  GET    /path        file content (chunk-assembled) or directory JSON
+  PUT/POST /path      upload with auto-chunking to volume servers
+  DELETE /path[?recursive=true]
+
+gRPC "filer" (ref: weed/server/filer_grpc_server.go): LookupDirectoryEntry,
+ListEntries, CreateEntry, UpdateEntry, DeleteEntry, AtomicRenameEntry,
+AssignVolume, Statistics, GetFilerConfiguration.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from ..client import MasterClient
+from ..client.operation import assign, upload_data
+from ..filer import (
+    Attr,
+    Entry,
+    FileChunk,
+    Filer,
+    MemoryFilerStore,
+    SqliteFilerStore,
+    non_overlapping_visible_intervals,
+    read_from_visible_intervals,
+)
+from ..pb import grpc_address
+from ..pb.rpc import Service, serve
+
+
+class FilerServer:
+    def __init__(
+        self,
+        master: str,
+        host: str = "127.0.0.1",
+        port: int = 8888,
+        store_path: str = "",  # "" = in-memory, else sqlite file
+        chunk_size: int = 4 * 1024 * 1024,
+        collection: str = "",
+        replication: str = "",
+    ):
+        self.master = master
+        self.host = host
+        self.port = port
+        self.address = f"{host}:{port}"
+        self.chunk_size = chunk_size
+        self.collection = collection
+        self.replication = replication
+        store = SqliteFilerStore(store_path) if store_path else MemoryFilerStore()
+        self.filer = Filer(store, on_delete_chunks=self._queue_chunk_deletion)
+        self.master_client = MasterClient(f"filer@{self.address}", [master])
+        self._deletion_queue: asyncio.Queue = asyncio.Queue()
+        self._deletion_task: Optional[asyncio.Task] = None
+        self._http_runner: Optional[web.AppRunner] = None
+        self._grpc_server = None
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    # ---------------- lifecycle ----------------
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession()
+        await self.master_client.start()
+        self._deletion_task = asyncio.ensure_future(self._deletion_loop())
+        app = web.Application(client_max_size=1024 << 20)
+        app.router.add_route("*", "/{tail:.*}", self._dispatch)
+        self._http_runner = web.AppRunner(app)
+        await self._http_runner.setup()
+        site = web.TCPSite(self._http_runner, self.host, self.port)
+        await site.start()
+
+        svc = Service("filer")
+        svc.unary("LookupDirectoryEntry")(self._grpc_lookup_entry)
+        svc.unary("ListEntries")(self._grpc_list_entries)
+        svc.unary("CreateEntry")(self._grpc_create_entry)
+        svc.unary("UpdateEntry")(self._grpc_update_entry)
+        svc.unary("DeleteEntry")(self._grpc_delete_entry)
+        svc.unary("AtomicRenameEntry")(self._grpc_rename)
+        svc.unary("AssignVolume")(self._grpc_assign_volume)
+        svc.unary("Statistics")(self._grpc_statistics)
+        svc.unary("GetFilerConfiguration")(self._grpc_configuration)
+        self._grpc_server = await serve(grpc_address(self.address), svc)
+
+    async def stop(self) -> None:
+        if self._grpc_server is not None:
+            await self._grpc_server.stop(0.5)
+        if self._http_runner is not None:
+            await self._http_runner.cleanup()
+        if self._deletion_task is not None:
+            self._deletion_task.cancel()
+            try:
+                await self._deletion_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        await self.master_client.stop()
+        if self._session is not None:
+            await self._session.close()
+
+    # ---------------- async chunk GC (ref filer2/filer_deletion.go) ----------------
+    def _queue_chunk_deletion(self, fids: list[str]) -> None:
+        for fid in fids:
+            self._deletion_queue.put_nowait(fid)
+
+    async def _deletion_loop(self) -> None:
+        while True:
+            fid = await self._deletion_queue.get()
+            try:
+                url = await self.master_client.lookup_file_id_async(fid)
+                async with self._session.delete(url) as resp:
+                    await resp.read()
+            except Exception:
+                pass
+
+    # ---------------- chunk IO ----------------
+    async def _fetch_chunk(self, fid: str) -> bytes:
+        url = await self.master_client.lookup_file_id_async(fid)
+        async with self._session.get(url) as resp:
+            if resp.status != 200:
+                raise IOError(f"chunk {fid}: status {resp.status}")
+            return await resp.read()
+
+    async def _write_chunks(self, data: bytes, ttl: str = "") -> list[FileChunk]:
+        chunks = []
+        now = time.time_ns()
+        for offset in range(0, len(data), self.chunk_size):
+            piece = data[offset : offset + self.chunk_size]
+            ar = await assign(
+                self.master,
+                collection=self.collection,
+                replication=self.replication,
+                ttl=ttl,
+            )
+            result = await upload_data(self._session, ar.url, ar.fid, piece, ttl=ttl)
+            chunks.append(
+                FileChunk(
+                    fid=ar.fid,
+                    offset=offset,
+                    size=len(piece),
+                    mtime_ns=now,
+                    etag=result.get("eTag", ""),
+                )
+            )
+        return chunks
+
+    # ---------------- HTTP ----------------
+    async def _dispatch(self, request: web.Request) -> web.StreamResponse:
+        path = "/" + request.match_info["tail"].rstrip("/")
+        if path == "/":
+            path = "/"
+        try:
+            if request.method in ("GET", "HEAD"):
+                return await self._handle_get(request, path)
+            if request.method in ("PUT", "POST"):
+                return await self._handle_put(request, path)
+            if request.method == "DELETE":
+                return await self._handle_delete(request, path)
+        except FileNotFoundError:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response({"error": "method not allowed"}, status=405)
+
+    async def _handle_get(self, request: web.Request, path: str) -> web.StreamResponse:
+        entry = self.filer.find_entry(path)
+        if entry is None:
+            return web.json_response({"error": "not found"}, status=404)
+        if entry.is_directory:
+            limit = int(request.query.get("limit", 1000))
+            last = request.query.get("lastFileName", "")
+            entries = self.filer.list_entries(path, last, not last, limit)
+            return web.json_response(
+                {
+                    "Path": path,
+                    "Entries": [
+                        {
+                            "FullPath": e.full_path,
+                            "IsDirectory": e.is_directory,
+                            "Size": e.size(),
+                            "Mtime": e.attr.mtime,
+                            "Mime": e.attr.mime,
+                        }
+                        for e in entries
+                    ],
+                }
+            )
+        visibles = non_overlapping_visible_intervals(entry.chunks)
+        size = entry.size()
+        body = b""
+        if request.method == "GET" and size:
+            blobs = {}
+
+            async def fetch_all():
+                for v in visibles:
+                    if v.fid not in blobs:
+                        blobs[v.fid] = await self._fetch_chunk(v.fid)
+
+            await fetch_all()
+            body = read_from_visible_intervals(visibles, blobs.__getitem__, 0, size)
+        headers = {"Content-Length": str(size)}
+        if request.method == "HEAD":
+            return web.Response(status=200, headers=headers)
+        return web.Response(
+            body=body,
+            content_type=entry.attr.mime or "application/octet-stream",
+        )
+
+    async def _handle_put(self, request: web.Request, path: str) -> web.Response:
+        content_type = request.headers.get("Content-Type", "")
+        mime = ""
+        if content_type.startswith("multipart/form-data"):
+            reader = await request.multipart()
+            data = b""
+            async for part in reader:
+                if part.filename or part.name in ("file", "upload"):
+                    data = bytes(await part.read(decode=False))
+                    mime = part.headers.get("Content-Type", "")
+                    if path.endswith("/") or self._is_dir(path):
+                        path = path.rstrip("/") + "/" + (part.filename or "file")
+                    break
+        else:
+            data = await request.read()
+            mime = content_type
+        chunks = await self._write_chunks(data, ttl=request.query.get("ttl", ""))
+        entry = self.filer.touch(
+            path,
+            mime,
+            chunks,
+            replication=self.replication,
+            collection=self.collection,
+        )
+        return web.json_response(
+            {"name": entry.name, "size": len(data)}, status=201
+        )
+
+    def _is_dir(self, path: str) -> bool:
+        e = self.filer.find_entry(path)
+        return e is not None and e.is_directory
+
+    async def _handle_delete(self, request: web.Request, path: str) -> web.Response:
+        recursive = request.query.get("recursive") == "true"
+        try:
+            self.filer.delete_entry(path, recursive=recursive)
+        except OSError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        return web.Response(status=204)
+
+    # ---------------- gRPC ----------------
+    async def _grpc_lookup_entry(self, req, context) -> dict:
+        path = req["directory"].rstrip("/") + "/" + req["name"]
+        entry = self.filer.find_entry(path)
+        if entry is None:
+            return {"error": "not found"}
+        return {"entry": entry.to_dict()}
+
+    async def _grpc_list_entries(self, req, context) -> dict:
+        entries = self.filer.list_entries(
+            req["directory"],
+            req.get("start_from_file_name", ""),
+            bool(req.get("inclusive_start_from", True)),
+            int(req.get("limit", 1024)),
+        )
+        return {"entries": [e.to_dict() for e in entries]}
+
+    async def _grpc_create_entry(self, req, context) -> dict:
+        self.filer.create_entry(Entry.from_dict(req["entry"]))
+        return {}
+
+    async def _grpc_update_entry(self, req, context) -> dict:
+        self.filer.update_entry(Entry.from_dict(req["entry"]))
+        return {}
+
+    async def _grpc_delete_entry(self, req, context) -> dict:
+        path = req["directory"].rstrip("/") + "/" + req["name"]
+        try:
+            self.filer.delete_entry(
+                path,
+                recursive=bool(req.get("is_recursive", False)),
+                delete_chunks=bool(req.get("is_delete_data", True)),
+            )
+        except OSError as e:
+            return {"error": str(e)}
+        return {}
+
+    async def _grpc_rename(self, req, context) -> dict:
+        old = req["old_directory"].rstrip("/") + "/" + req["old_name"]
+        new = req["new_directory"].rstrip("/") + "/" + req["new_name"]
+        try:
+            self.filer.rename(old, new)
+        except (FileNotFoundError, NotADirectoryError) as e:
+            return {"error": str(e)}
+        return {}
+
+    async def _grpc_assign_volume(self, req, context) -> dict:
+        try:
+            ar = await assign(
+                self.master,
+                count=int(req.get("count", 1)),
+                collection=req.get("collection", self.collection),
+                replication=req.get("replication", self.replication),
+                ttl=req.get("ttl", ""),
+                data_center=req.get("data_center", ""),
+            )
+            return {
+                "file_id": ar.fid,
+                "url": ar.url,
+                "public_url": ar.public_url,
+                "count": ar.count,
+            }
+        except Exception as e:
+            return {"error": str(e)}
+
+    async def _grpc_statistics(self, req, context) -> dict:
+        return {"used_size": 0, "file_count": 0}
+
+    async def _grpc_configuration(self, req, context) -> dict:
+        return {
+            "masters": [self.master],
+            "collection": self.collection,
+            "replication": self.replication,
+            "max_mb": self.chunk_size // (1024 * 1024),
+        }

@@ -148,6 +148,11 @@ class MasterServer:
         )
         if grown == 0:
             raise NoFreeSpaceError("no free volumes left")
+        # push the fresh vid locations to KeepConnected clients right away
+        # (heartbeat deltas would also deliver them, but only a pulse later)
+        for vid, locs in list(layout.vid_to_locations.items()):
+            for dn in locs:
+                self._broadcast_location(dn, new_vids=[vid], deleted_vids=[])
 
     async def _do_assign(self, params) -> dict:
         count = int(params.get("count", 1) or 1)

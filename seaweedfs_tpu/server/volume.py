@@ -138,37 +138,49 @@ class VolumeServer(EcHandlers):
                 await asyncio.sleep(self.pulse_seconds)
 
     async def _heartbeat_once(self) -> None:
+        import grpc
+
         stub = Stub(grpc_address(self.master), "master")
         call = stub.bidi_stream("SendHeartbeat")
 
-        async def write_full(with_ec: bool = True) -> None:
+        # responses are drained by a dedicated task: wrapping call.read() in
+        # wait_for would CANCEL the whole RPC on timeout and tear the stream
+        # down every quiet pulse
+        async def reader() -> None:
+            while True:
+                resp = await call.read()
+                if resp is grpc.aio.EOF or resp is None:
+                    return
+                if isinstance(resp, dict) and resp.get("volume_size_limit"):
+                    self.store.volume_size_limit = int(resp["volume_size_limit"])
+
+        reader_task = asyncio.ensure_future(reader())
+        try:
             hb = self.store.collect_heartbeat()
             hb["data_center"] = self.data_center
             hb["rack"] = self.rack
-            if with_ec:
-                hb.update(self.store.collect_ec_heartbeat())
+            hb.update(self.store.collect_ec_heartbeat())
             await call.write(hb)
-
-        await write_full()
-        tick = 0
-        while not self._shutdown:
+            tick = 0
+            while not self._shutdown:
+                await asyncio.sleep(self.pulse_seconds)
+                if reader_task.done():
+                    break  # master closed the stream; reconnect
+                tick += 1
+                deltas = self.store.drain_deltas()
+                hb = {"ip": self.host, "port": self.port}
+                if any(deltas.values()):
+                    hb.update({k: v for k, v in deltas.items() if v})
+                if tick % 17 == 0:
+                    # periodic full EC state (ref :121 — EC tick = 17 x pulse)
+                    hb.update(self.store.collect_ec_heartbeat())
+                await call.write(hb)
+        finally:
+            reader_task.cancel()
             try:
-                resp = await asyncio.wait_for(call.read(), timeout=self.pulse_seconds)
-                if resp is not None and resp != aiohttp.http.EMPTY_PAYLOAD:
-                    if isinstance(resp, dict) and resp.get("volume_size_limit"):
-                        self.store.volume_size_limit = int(resp["volume_size_limit"])
-            except asyncio.TimeoutError:
+                call.cancel()
+            except Exception:
                 pass
-            tick += 1
-            deltas = self.store.drain_deltas()
-            hb = {"ip": self.host, "port": self.port}
-            if any(deltas.values()):
-                hb.update({k: v for k, v in deltas.items() if v})
-            if tick % 17 == 0:
-                # periodic full EC state (ref :121 — EC tick = 17 x pulse)
-                hb.update(self.store.collect_ec_heartbeat())
-            await call.write(hb)
-            await asyncio.sleep(self.pulse_seconds)
 
     # ---------------- HTTP dispatch ----------------
     async def _dispatch(self, request: web.Request) -> web.StreamResponse:

@@ -285,9 +285,11 @@ class EcHandlers:
             return {"error": str(e)}
 
     # ---------------- EC read path (ref store_ec.go:119-373) ----------------
-    async def _refresh_shard_locations(self, ev: EcVolume) -> None:
+    async def _refresh_shard_locations(
+        self, ev: EcVolume, force: bool = False
+    ) -> None:
         now = time.time()
-        if now - ev.shard_locations_refresh_time < SHARD_LOCATION_TTL:
+        if not force and now - ev.shard_locations_refresh_time < SHARD_LOCATION_TTL:
             return
         stub = Stub(grpc_address(self.master), "master")
         try:
@@ -343,6 +345,14 @@ class EcHandlers:
         if shard is not None:
             return shard.read_at(size, offset)
         await self._refresh_shard_locations(ev)
+        data = await self._read_remote_shard_interval(
+            ev, shard_id, offset, size, file_key
+        )
+        if data is not None:
+            return data
+        # the cached locations may be stale (ref store_ec.go:211 forgets
+        # failed shard locations); force-refresh once and retry
+        await self._refresh_shard_locations(ev, force=True)
         data = await self._read_remote_shard_interval(
             ev, shard_id, offset, size, file_key
         )

@@ -1,0 +1,139 @@
+import pytest
+
+from seaweedfs_tpu.filer import (
+    Entry,
+    FileChunk,
+    Filer,
+    MemoryFilerStore,
+    SqliteFilerStore,
+    non_overlapping_visible_intervals,
+    read_from_visible_intervals,
+    total_size,
+)
+from seaweedfs_tpu.filer.filechunks import view_from_visibles
+
+
+def chunk(fid, offset, size, mtime):
+    return FileChunk(fid=fid, offset=offset, size=size, mtime_ns=mtime)
+
+
+# ---------- chunk visibility (ref filer2/filechunks_test.go) ----------
+def test_visibles_single_chunk():
+    vis = non_overlapping_visible_intervals([chunk("a", 0, 100, 1)])
+    assert len(vis) == 1
+    assert (vis[0].start, vis[0].stop, vis[0].fid) == (0, 100, "a")
+
+
+def test_visibles_newest_wins_full_overwrite():
+    vis = non_overlapping_visible_intervals(
+        [chunk("a", 0, 100, 1), chunk("b", 0, 100, 2)]
+    )
+    assert len(vis) == 1
+    assert vis[0].fid == "b"
+
+
+def test_visibles_partial_overwrite():
+    vis = non_overlapping_visible_intervals(
+        [chunk("a", 0, 100, 1), chunk("b", 50, 100, 2)]
+    )
+    assert [(v.start, v.stop, v.fid) for v in vis] == [
+        (0, 50, "a"),
+        (50, 150, "b"),
+    ]
+
+
+def test_visibles_middle_overwrite_splits():
+    vis = non_overlapping_visible_intervals(
+        [chunk("a", 0, 300, 1), chunk("b", 100, 50, 2)]
+    )
+    assert [(v.start, v.stop, v.fid) for v in vis] == [
+        (0, 100, "a"),
+        (100, 150, "b"),
+        (150, 300, "a"),
+    ]
+
+
+def test_visibles_disjoint_with_hole():
+    vis = non_overlapping_visible_intervals(
+        [chunk("a", 0, 100, 1), chunk("b", 200, 100, 1)]
+    )
+    assert [(v.start, v.stop) for v in vis] == [(0, 100), (200, 300)]
+    assert total_size([chunk("a", 0, 100, 1), chunk("b", 200, 100, 1)]) == 300
+
+
+def test_read_from_visibles_assembles_and_zero_fills():
+    blobs = {"a": bytes(range(100)), "b": bytes(reversed(range(100)))}
+    chunks = [chunk("a", 0, 100, 1), chunk("b", 200, 100, 1)]
+    vis = non_overlapping_visible_intervals(chunks)
+    out = read_from_visible_intervals(vis, blobs.__getitem__, 50, 200)
+    assert out[:50] == bytes(range(50, 100))
+    assert out[50:150] == b"\x00" * 100
+    assert out[150:200] == bytes(reversed(range(100)))[:50]
+
+
+def test_view_from_visibles_offsets_into_chunks():
+    chunks = [chunk("a", 0, 100, 1), chunk("b", 50, 100, 2)]
+    vis = non_overlapping_visible_intervals(chunks)
+    views = view_from_visibles(vis, 60, 30)
+    assert len(views) == 1
+    assert views[0].fid == "b"
+    assert views[0].offset_in_chunk == 10
+    assert views[0].size == 30
+
+
+# ---------- filer + stores ----------
+@pytest.mark.parametrize("store_cls", [MemoryFilerStore, SqliteFilerStore])
+def test_filer_crud_and_tree(store_cls):
+    f = Filer(store_cls())
+    f.touch("/docs/readme.txt", "text/plain", [chunk("1,ab", 0, 10, 1)])
+    f.touch("/docs/sub/inner.bin", "", [chunk("2,cd", 0, 20, 1)])
+
+    e = f.find_entry("/docs/readme.txt")
+    assert e is not None and e.size() == 10
+    d = f.find_entry("/docs")
+    assert d is not None and d.is_directory
+
+    listing = f.list_entries("/docs")
+    assert [e.name for e in listing] == ["readme.txt", "sub"]
+
+    # rename a directory subtree
+    f.rename("/docs", "/archive")
+    assert f.find_entry("/docs/readme.txt") is None
+    assert f.find_entry("/archive/readme.txt") is not None
+    assert f.find_entry("/archive/sub/inner.bin") is not None
+
+    # refuse non-recursive delete of a non-empty dir
+    with pytest.raises(OSError):
+        f.delete_entry("/archive")
+    deleted_chunks = f.delete_entry("/archive", recursive=True)
+    assert {c.fid for c in deleted_chunks} == {"1,ab", "2,cd"}
+    assert f.find_entry("/archive/readme.txt") is None
+
+
+def test_filer_overwrite_collects_old_chunks():
+    collected = []
+    f = Filer(MemoryFilerStore(), on_delete_chunks=collected.extend)
+    f.touch("/a.txt", "", [chunk("1,aa", 0, 5, 1)])
+    f.touch("/a.txt", "", [chunk("2,bb", 0, 7, 2)])
+    assert collected == ["1,aa"]
+
+
+def test_filer_file_blocks_subdirectory():
+    f = Filer(MemoryFilerStore())
+    f.touch("/x", "", [])
+    with pytest.raises(NotADirectoryError):
+        f.touch("/x/y", "", [])
+
+
+@pytest.mark.parametrize("store_cls", [MemoryFilerStore, SqliteFilerStore])
+def test_store_pagination(store_cls):
+    f = Filer(store_cls())
+    for i in range(25):
+        f.touch(f"/dir/f{i:03d}", "", [])
+    page1 = f.list_entries("/dir", limit=10)
+    assert len(page1) == 10
+    page2 = f.list_entries("/dir", start_file_name=page1[-1].name, inclusive=False, limit=10)
+    assert len(page2) == 10
+    assert page1[-1].name < page2[0].name
+    page3 = f.list_entries("/dir", start_file_name=page2[-1].name, inclusive=False, limit=10)
+    assert len(page3) == 5

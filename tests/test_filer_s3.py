@@ -1,0 +1,194 @@
+"""Filer server + S3 gateway over the in-process cluster."""
+
+import asyncio
+import random
+import xml.etree.ElementTree as ET
+
+import aiohttp
+
+from test_cluster import Cluster, free_port_pair
+
+
+def test_filer_http_and_s3(tmp_path):
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=2)
+        await cluster.start()
+        from seaweedfs_tpu.pb.rpc import close_all_channels
+        from seaweedfs_tpu.s3.server import S3Server
+        from seaweedfs_tpu.server.filer import FilerServer
+
+        fs = FilerServer(
+            master=cluster.master.address,
+            port=free_port_pair(),
+            chunk_size=64 * 1024,  # force multi-chunk files
+        )
+        await fs.start()
+        s3 = S3Server(fs, port=free_port_pair())
+        await s3.start()
+        try:
+            await fs.master_client.wait_connected()
+            async with aiohttp.ClientSession() as session:
+                base = f"http://{fs.address}"
+
+                # ---- filer HTTP: write a 200KB file (4 chunks), read back
+                payload = random.randbytes(200 * 1024)
+                async with session.put(f"{base}/docs/big.bin", data=payload) as resp:
+                    assert resp.status == 201, await resp.text()
+                async with session.get(f"{base}/docs/big.bin") as resp:
+                    assert resp.status == 200
+                    assert await resp.read() == payload
+
+                # directory listing
+                async with session.get(f"{base}/docs") as resp:
+                    listing = await resp.json()
+                    assert [e["FullPath"] for e in listing["Entries"]] == [
+                        "/docs/big.bin"
+                    ]
+
+                # overwrite queues old chunks for deletion; still readable
+                payload2 = random.randbytes(50 * 1024)
+                async with session.put(f"{base}/docs/big.bin", data=payload2) as resp:
+                    assert resp.status == 201
+                async with session.get(f"{base}/docs/big.bin") as resp:
+                    assert await resp.read() == payload2
+
+                # delete
+                async with session.delete(f"{base}/docs/big.bin") as resp:
+                    assert resp.status == 204
+                async with session.get(f"{base}/docs/big.bin") as resp:
+                    assert resp.status == 404
+
+                # ---- S3 gateway
+                s3base = f"http://{s3.address}"
+                async with session.put(f"{s3base}/mybucket") as resp:
+                    assert resp.status == 200
+                async with session.get(s3base) as resp:
+                    xml = await resp.text()
+                    assert "<Name>mybucket</Name>" in xml
+
+                obj = random.randbytes(150 * 1024)
+                async with session.put(
+                    f"{s3base}/mybucket/dir/hello.bin", data=obj
+                ) as resp:
+                    assert resp.status == 200
+                    etag = resp.headers["ETag"]
+                async with session.get(f"{s3base}/mybucket/dir/hello.bin") as resp:
+                    assert resp.status == 200
+                    assert await resp.read() == obj
+                    assert resp.headers["ETag"] == etag
+                async with session.head(f"{s3base}/mybucket/dir/hello.bin") as resp:
+                    assert resp.status == 200
+                    assert int(resp.headers["Content-Length"]) == len(obj)
+
+                # ListObjectsV2 with prefix + delimiter
+                async with session.put(f"{s3base}/mybucket/top.txt", data=b"x") as r:
+                    assert r.status == 200
+                async with session.get(
+                    f"{s3base}/mybucket?list-type=2&delimiter=/"
+                ) as resp:
+                    tree = ET.fromstring(await resp.text())
+                    keys = [c.findtext("Key") for c in tree.findall("Contents")]
+                    prefixes = [
+                        p.findtext("Prefix")
+                        for p in tree.findall("CommonPrefixes")
+                    ]
+                    assert keys == ["top.txt"]
+                    assert prefixes == ["dir/"]
+                async with session.get(f"{s3base}/mybucket?prefix=dir/") as resp:
+                    tree = ET.fromstring(await resp.text())
+                    keys = [c.findtext("Key") for c in tree.findall("Contents")]
+                    assert keys == ["dir/hello.bin"]
+
+                # ---- multipart upload (3 parts, metadata-only merge)
+                async with session.post(
+                    f"{s3base}/mybucket/assembled.bin?uploads"
+                ) as resp:
+                    tree = ET.fromstring(await resp.text())
+                    upload_id = tree.findtext("UploadId")
+                parts = [random.randbytes(80 * 1024) for _ in range(3)]
+                for i, part in enumerate(parts, start=1):
+                    async with session.put(
+                        f"{s3base}/mybucket/assembled.bin"
+                        f"?uploadId={upload_id}&partNumber={i}",
+                        data=part,
+                    ) as resp:
+                        assert resp.status == 200
+                async with session.post(
+                    f"{s3base}/mybucket/assembled.bin?uploadId={upload_id}"
+                ) as resp:
+                    assert resp.status == 200
+                async with session.get(f"{s3base}/mybucket/assembled.bin") as resp:
+                    assert await resp.read() == b"".join(parts)
+
+                # delete object + bucket
+                async with session.delete(
+                    f"{s3base}/mybucket/dir/hello.bin"
+                ) as resp:
+                    assert resp.status == 204
+                async with session.delete(f"{s3base}/mybucket") as resp:
+                    assert resp.status == 204
+                async with session.get(f"{s3base}/mybucket?list-type=2") as resp:
+                    assert resp.status == 404
+        finally:
+            await s3.stop()
+            await fs.stop()
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_filer_grpc_metadata(tmp_path):
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        from seaweedfs_tpu.pb import grpc_address
+        from seaweedfs_tpu.pb.rpc import Stub
+        from seaweedfs_tpu.server.filer import FilerServer
+
+        fs = FilerServer(master=cluster.master.address, port=free_port_pair())
+        await fs.start()
+        try:
+            await fs.master_client.wait_connected()
+            stub = Stub(grpc_address(fs.address), "filer")
+            r = await stub.call(
+                "CreateEntry",
+                {
+                    "entry": {
+                        "full_path": "/meta/file1",
+                        "attr": {"mtime": 1.0},
+                        "chunks": [],
+                    }
+                },
+            )
+            assert not r.get("error")
+            r = await stub.call(
+                "LookupDirectoryEntry", {"directory": "/meta", "name": "file1"}
+            )
+            assert r["entry"]["full_path"] == "/meta/file1"
+            r = await stub.call("ListEntries", {"directory": "/meta"})
+            assert len(r["entries"]) == 1
+            r = await stub.call(
+                "AtomicRenameEntry",
+                {
+                    "old_directory": "/meta",
+                    "old_name": "file1",
+                    "new_directory": "/meta2",
+                    "new_name": "renamed",
+                },
+            )
+            assert not r.get("error")
+            r = await stub.call(
+                "LookupDirectoryEntry", {"directory": "/meta2", "name": "renamed"}
+            )
+            assert r["entry"]["full_path"] == "/meta2/renamed"
+            r = await stub.call(
+                "DeleteEntry", {"directory": "/meta2", "name": "renamed"}
+            )
+            assert not r.get("error")
+            r = await stub.call("AssignVolume", {"count": 1})
+            assert "file_id" in r, r
+        finally:
+            await fs.stop()
+            await cluster.stop()
+
+    asyncio.run(body())

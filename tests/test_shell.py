@@ -181,17 +181,26 @@ def test_shell_commands_end_to_end(tmp_path):
                     out = await run_command(env, "ec.rebuild")
                     assert "rebuilt" in out, out
 
-                # decode back to a normal volume and read again
+                # decode back to a normal volume and read again; the master's
+                # registry converges via delta heartbeats, so poll
                 out = await run_command(env, f"ec.decode -volumeId {vid}")
                 assert "decoded" in out, out
-                await asyncio.sleep(0.5)
-                for fid, data in list(payloads.items())[:3]:
-                    from seaweedfs_tpu.client.operation import lookup
+                from seaweedfs_tpu.client.operation import lookup
 
+                got = None
+                first_fid, first_data = next(iter(payloads.items()))
+                for _ in range(50):
                     locs = await lookup(cluster.master.address, vid)
-                    assert locs, "decoded volume not registered"
-                    got = await read_url(session, f"http://{locs[0]}/{fid}")
-                    assert got == data
+                    if locs:
+                        try:
+                            got = await read_url(
+                                session, f"http://{locs[0]}/{first_fid}"
+                            )
+                            break
+                        except RuntimeError:
+                            pass
+                    await asyncio.sleep(0.2)
+                assert got == first_data
 
                 assert (await run_command(env, "unlock")) == "unlocked"
         finally:
