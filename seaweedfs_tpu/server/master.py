@@ -42,7 +42,11 @@ class MasterServer:
         default_replication: str = "000",
         garbage_threshold: float = 0.3,
         pulse_seconds: float = 5.0,
+        jwt_signing_key: str = "",
+        jwt_expires_seconds: int = 10,
     ):
+        self.jwt_signing_key = jwt_signing_key
+        self.jwt_expires_seconds = jwt_expires_seconds
         self.host = host
         self.port = port
         self.address = f"{host}:{port}"
@@ -74,6 +78,7 @@ class MasterServer:
         app.router.add_route("*", "/vol/vacuum", self._vol_vacuum)
         app.router.add_route("*", "/col/delete", self._col_delete)
         app.router.add_get("/cluster/status", self._cluster_status)
+        app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/{file_id:[0-9]+,.+}", self._redirect)
         self._http_runner = web.AppRunner(app)
         await self._http_runner.setup()
@@ -165,12 +170,19 @@ class MasterServer:
         except (NoFreeSpaceError, LookupError) as e:
             return {"error": str(e)}
         dn = locations[0]
-        return {
+        result = {
             "fid": fid,
             "url": dn.url,
             "publicUrl": dn.public_url,
             "count": cnt,
         }
+        if self.jwt_signing_key:
+            from ..util.security import gen_jwt
+
+            result["auth"] = gen_jwt(
+                self.jwt_signing_key, self.jwt_expires_seconds, fid
+            )
+        return result
 
     def _do_lookup(self, vid_str: str, collection: str = "") -> dict:
         try:
@@ -248,6 +260,11 @@ class MasterServer:
                 pass
         self.topo.delete_collection(collection)
         return web.json_response({})
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        from ..util.metrics import REGISTRY
+
+        return web.Response(text=REGISTRY.render(), content_type="text/plain")
 
     async def _cluster_status(self, request: web.Request) -> web.Response:
         return web.json_response(

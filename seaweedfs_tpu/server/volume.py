@@ -48,7 +48,9 @@ class VolumeServer(EcHandlers):
         data_center: str = "",
         rack: str = "",
         codec_backend: str = "cpu",
+        jwt_signing_key: str = "",
     ):
+        self.jwt_signing_key = jwt_signing_key
         self.master = master
         self.host = host
         self.port = port
@@ -184,9 +186,27 @@ class VolumeServer(EcHandlers):
 
     # ---------------- HTTP dispatch ----------------
     async def _dispatch(self, request: web.Request) -> web.StreamResponse:
+        import time as _time
+
+        from ..util.metrics import REQUEST_COUNTER, REQUEST_HISTOGRAM
+
         path = request.path
         if path == "/status":
             return web.json_response({"Version": "seaweedfs-tpu", "Volumes": []})
+        if path == "/metrics":
+            from ..util.metrics import REGISTRY
+
+            return web.Response(text=REGISTRY.render(), content_type="text/plain")
+        t0 = _time.perf_counter()
+        try:
+            return await self._dispatch_inner(request)
+        finally:
+            REQUEST_COUNTER.inc(server="volume", operation=request.method)
+            REQUEST_HISTOGRAM.observe(
+                _time.perf_counter() - t0, server="volume", operation=request.method
+            )
+
+    async def _dispatch_inner(self, request: web.Request) -> web.StreamResponse:
         try:
             if request.method in ("GET", "HEAD"):
                 return await self._handle_read(request)
@@ -284,6 +304,15 @@ class VolumeServer(EcHandlers):
     async def _handle_write(self, request: web.Request) -> web.Response:
         fid, _ = self._parse_fid_path(request.path)
         vid = fid.volume_id
+        if self.jwt_signing_key:
+            from ..util.security import Guard
+
+            guard = Guard(signing_key=self.jwt_signing_key)
+            if not guard.check_jwt(
+                request.headers.get("Authorization", ""),
+                request.path.lstrip("/").split("/")[0],
+            ):
+                return web.json_response({"error": "unauthorized"}, status=401)
         if not self.store.has_volume(vid):
             return web.json_response({"error": f"volume {vid} not found"}, status=404)
 

@@ -1,0 +1,52 @@
+"""Leveled V-style logging (ref: weed/glog/ — vendored glog fork).
+
+Thin adapter over the stdlib: `V(2).info(...)` emits only when the global
+verbosity is >= 2, matching the reference's glog.V(n).Infof convention.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_VERBOSITY = int(os.environ.get("SEAWEEDFS_TPU_V", "0"))
+
+_logger = logging.getLogger("seaweedfs_tpu")
+if not _logger.handlers:
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        logging.Formatter("%(levelname).1s%(asctime)s %(name)s: %(message)s", "%m%d %H:%M:%S")
+    )
+    _logger.addHandler(handler)
+    _logger.setLevel(logging.INFO)
+
+
+def set_verbosity(v: int) -> None:
+    global _VERBOSITY
+    _VERBOSITY = v
+
+
+class _VLogger:
+    def __init__(self, enabled: bool):
+        self.enabled = enabled
+
+    def info(self, msg: str, *args) -> None:
+        if self.enabled:
+            _logger.info(msg, *args)
+
+
+def V(level: int) -> _VLogger:  # noqa: N802 - glog convention
+    return _VLogger(level <= _VERBOSITY)
+
+
+def info(msg: str, *args) -> None:
+    _logger.info(msg, *args)
+
+
+def warning(msg: str, *args) -> None:
+    _logger.warning(msg, *args)
+
+
+def error(msg: str, *args) -> None:
+    _logger.error(msg, *args)

@@ -1,0 +1,158 @@
+"""Prometheus-style metrics registry (ref: weed/stats/metrics.go:15-93).
+
+Counters, gauges and histograms with label support, rendered in the
+Prometheus text exposition format at /metrics on each server. No external
+client library; the push-gateway mode of the reference is replaced by pull.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right
+from collections import defaultdict
+
+_DEFAULT_BUCKETS = [
+    0.0001, 0.0003, 0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1, 3, 10,
+]
+
+
+class _Labeled:
+    def __init__(self, name: str, help_text: str, kind: str):
+        self.name = name
+        self.help = help_text
+        self.kind = kind
+        self._lock = threading.Lock()
+
+
+class Counter(_Labeled):
+    def __init__(self, name: str, help_text: str = ""):
+        super().__init__(name, help_text, "counter")
+        self._values: dict[tuple, float] = defaultdict(float)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] += amount
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            for key, v in self._values.items():
+                out.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return out
+
+
+class Gauge(_Labeled):
+    def __init__(self, name: str, help_text: str = ""):
+        super().__init__(name, help_text, "gauge")
+        self._values: dict[tuple, float] = defaultdict(float)
+
+    def set(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = value
+
+    def add(self, amount: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] += amount
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for key, v in self._values.items():
+                out.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return out
+
+
+class Histogram(_Labeled):
+    def __init__(self, name: str, help_text: str = "", buckets=None):
+        super().__init__(name, help_text, "histogram")
+        self.buckets = list(buckets or _DEFAULT_BUCKETS)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = defaultdict(float)
+        self._totals: dict[tuple, int] = defaultdict(int)
+
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            idx = bisect_right(self.buckets, value)
+            if idx < len(counts):
+                counts[idx] += 1  # cumulative sums computed at render time
+            self._sums[key] += value
+            self._totals[key] += 1
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for key, counts in self._counts.items():
+                cumulative = 0
+                for b, c in zip(self.buckets, counts):
+                    cumulative += c
+                    out.append(
+                        f'{self.name}_bucket{_fmt_labels(key, le=str(b))} {cumulative}'
+                    )
+                out.append(
+                    f'{self.name}_bucket{_fmt_labels(key, le="+Inf")} '
+                    f"{self._totals[key]}"
+                )
+                out.append(f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]}")
+                out.append(f"{self.name}_count{_fmt_labels(key)} {self._totals[key]}")
+        return out
+
+
+def _fmt_labels(key: tuple, **extra) -> str:
+    items = list(key) + sorted(extra.items())
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in items)
+    return "{" + inner + "}"
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list = []
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        m = Counter(name, help_text)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        m = Gauge(name, help_text)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def histogram(self, name: str, help_text: str = "", buckets=None) -> Histogram:
+        m = Histogram(name, help_text, buckets)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def render(self) -> str:
+        lines = []
+        with self._lock:
+            for m in self._metrics:
+                lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+# global registry + the server metric families the reference defines
+REGISTRY = Registry()
+
+REQUEST_COUNTER = REGISTRY.counter(
+    "seaweedfs_tpu_request_total", "number of requests by server/operation"
+)
+REQUEST_HISTOGRAM = REGISTRY.histogram(
+    "seaweedfs_tpu_request_seconds", "request latency by server/operation"
+)
+VOLUME_GAUGE = REGISTRY.gauge(
+    "seaweedfs_tpu_volumes", "volumes/ec-shards served per collection"
+)
+EC_ENCODE_BYTES = REGISTRY.counter(
+    "seaweedfs_tpu_ec_encoded_bytes_total", "bytes erasure-coded, by backend"
+)

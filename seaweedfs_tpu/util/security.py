@@ -1,0 +1,97 @@
+"""Security: HS256 JWT per-fid tokens + access guard.
+
+Mirrors the reference's model (ref: weed/security/jwt.go:21-40,
+guard.go:43-62): the master signs a short-lived token scoped to a file id at
+assign time; volume servers verify it on writes when a signing key is
+configured. Implemented with stdlib hmac (no external jwt dependency).
+"""
+
+from __future__ import annotations
+
+import base64
+import hmac
+import json
+import time
+from dataclasses import dataclass
+from hashlib import sha256
+
+
+def _b64(data: bytes) -> bytes:
+    return base64.urlsafe_b64encode(data).rstrip(b"=")
+
+
+def _unb64(data: str) -> bytes:
+    pad = "=" * (-len(data) % 4)
+    return base64.urlsafe_b64decode(data + pad)
+
+
+def gen_jwt(signing_key: str, expires_seconds: int, fid: str) -> str:
+    """Signed token bound to one file id (ref jwt.go GenJwt)."""
+    if not signing_key:
+        return ""
+    header = _b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    claims = {"Fid": fid}
+    if expires_seconds:
+        claims["exp"] = int(time.time()) + expires_seconds
+    payload = _b64(json.dumps(claims).encode())
+    msg = header + b"." + payload
+    sig = _b64(hmac.new(signing_key.encode(), msg, sha256).digest())
+    return (msg + b"." + sig).decode()
+
+
+class TokenError(Exception):
+    pass
+
+
+def decode_jwt(signing_key: str, token: str) -> dict:
+    """Verify signature + expiry; returns claims (ref jwt.go DecodeJwt)."""
+    try:
+        header, payload, sig = token.split(".")
+    except ValueError as e:
+        raise TokenError("malformed token") from e
+    msg = f"{header}.{payload}".encode()
+    expected = _b64(hmac.new(signing_key.encode(), msg, sha256).digest()).decode()
+    if not hmac.compare_digest(sig, expected):
+        raise TokenError("invalid signature")
+    claims = json.loads(_unb64(payload))
+    if "exp" in claims and time.time() > claims["exp"]:
+        raise TokenError("token expired")
+    return claims
+
+
+def verify_fid_token(signing_key: str, token: str, fid: str) -> None:
+    """Raise unless the token authorizes this exact fid (volumes ignore the
+    cookie part like the reference's write check)."""
+    claims = decode_jwt(signing_key, token)
+    token_fid = claims.get("Fid", "")
+    if token_fid != fid and token_fid.split(",")[0] != fid.split(",")[0]:
+        raise TokenError("token fid mismatch")
+
+
+@dataclass
+class Guard:
+    """Whitelist + JWT gate for HTTP handlers (ref guard.go)."""
+
+    white_list: tuple = ()
+    signing_key: str = ""
+    expires_seconds: int = 10
+
+    @property
+    def is_active(self) -> bool:
+        return bool(self.white_list or self.signing_key)
+
+    def check_whitelist(self, peer_ip: str) -> bool:
+        if not self.white_list:
+            return True
+        return peer_ip in self.white_list
+
+    def check_jwt(self, auth_header: str, fid: str) -> bool:
+        if not self.signing_key:
+            return True
+        if not auth_header.startswith("Bearer "):
+            return False
+        try:
+            verify_fid_token(self.signing_key, auth_header[7:], fid)
+            return True
+        except TokenError:
+            return False

@@ -1,0 +1,69 @@
+import time
+
+import pytest
+
+from seaweedfs_tpu.util.metrics import Counter, Gauge, Histogram, Registry
+from seaweedfs_tpu.util.security import (
+    Guard,
+    TokenError,
+    decode_jwt,
+    gen_jwt,
+    verify_fid_token,
+)
+
+
+def test_jwt_roundtrip():
+    token = gen_jwt("secret", 60, "3,01abcdef")
+    claims = decode_jwt("secret", token)
+    assert claims["Fid"] == "3,01abcdef"
+    verify_fid_token("secret", token, "3,01abcdef")
+
+
+def test_jwt_bad_signature():
+    token = gen_jwt("secret", 60, "3,01abcdef")
+    with pytest.raises(TokenError):
+        decode_jwt("other-key", token)
+
+
+def test_jwt_expiry():
+    token = gen_jwt("secret", -5, "3,x")  # already expired
+    with pytest.raises(TokenError):
+        decode_jwt("secret", token)
+
+
+def test_jwt_fid_mismatch():
+    token = gen_jwt("secret", 60, "3,01abcdef")
+    with pytest.raises(TokenError):
+        verify_fid_token("secret", token, "4,01abcdef")
+
+
+def test_guard():
+    g = Guard(signing_key="k")
+    assert g.is_active
+    token = gen_jwt("k", 60, "1,ff")
+    assert g.check_jwt(f"Bearer {token}", "1,ff")
+    assert not g.check_jwt("Bearer bogus", "1,ff")
+    assert not g.check_jwt("", "1,ff")
+    open_guard = Guard()
+    assert not open_guard.is_active
+    assert open_guard.check_jwt("", "1,ff")
+
+
+def test_metrics_render():
+    reg = Registry()
+    c = reg.counter("test_total", "help text")
+    c.inc(server="volume", operation="GET")
+    c.inc(2, server="volume", operation="GET")
+    g = reg.gauge("test_gauge")
+    g.set(5, kind="volume")
+    h = reg.histogram("test_seconds", buckets=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.render()
+    assert 'test_total{operation="GET",server="volume"} 3.0' in text
+    assert 'test_gauge{kind="volume"} 5' in text
+    assert 'test_seconds_bucket{le="0.1"} 1' in text
+    assert 'test_seconds_bucket{le="1.0"} 2' in text
+    assert 'test_seconds_bucket{le="+Inf"} 3' in text
+    assert "test_seconds_count 3" in text
