@@ -1,0 +1,89 @@
+"""Native (C++/SIMD) GF(2^8) kernel, compiled on demand and loaded via
+ctypes. Provides the host-side fast path the reference gets from
+klauspost/reedsolomon's assembly; falls back to None when no toolchain is
+available (callers then use the numpy tables)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "gf256.cpp")
+_LIB = os.path.join(_HERE, "libgf256.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    for flags in (["-mssse3"], []):  # fall back to scalar on non-x86
+        cmd = ["g++", "-O3", "-shared", "-fPIC", *flags, _SRC, "-o", _LIB]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            return True
+        except (subprocess.SubprocessError, FileNotFoundError):
+            continue
+    return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The compiled library, building it first if necessary."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(
+            _SRC
+        ):
+            if not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.gf_matmul.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),  # matrix
+            ctypes.c_int,  # rows
+            ctypes.c_int,  # cols
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),  # data rows
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),  # out rows
+            ctypes.c_size_t,  # n
+        ]
+        lib.gf_matmul.restype = None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def gf_matmul_native(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """uint8[R,C] x uint8[C,N] -> uint8[R,N] via the native kernel."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native gf256 library unavailable")
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    rows, cols = matrix.shape
+    assert data.shape[0] == cols
+    n = data.shape[1]
+    out = np.empty((rows, n), dtype=np.uint8)
+
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    data_ptrs = (u8p * cols)(
+        *(row.ctypes.data_as(u8p) for row in data)
+    )
+    out_ptrs = (u8p * rows)(*(row.ctypes.data_as(u8p) for row in out))
+    lib.gf_matmul(
+        matrix.ctypes.data_as(u8p), rows, cols, data_ptrs, out_ptrs, n
+    )
+    return out

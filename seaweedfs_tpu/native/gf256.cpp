@@ -1,0 +1,101 @@
+// GF(2^8) constant-matrix multiply over byte streams — host-side SIMD path.
+//
+// Plays the role klauspost/reedsolomon's amd64 assembly plays in the
+// reference (ref: weed/storage/erasure_coding/ec_encoder.go:198): the
+// classic SSSE3 PSHUFB nibble-table technique — for each matrix constant c,
+// 16-entry tables of c*low_nibble and c*high_nibble, applied 16 bytes per
+// instruction. Field polynomial 0x11D, matching galois.py.
+//
+// Build: g++ -O3 -mssse3 -shared -fPIC gf256.cpp -o libgf256.so
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+#ifdef __SSSE3__
+#include <tmmintrin.h>
+#endif
+
+namespace {
+
+constexpr unsigned kPoly = 0x11D;
+
+uint8_t gf_mul_scalar(unsigned a, unsigned b) {
+  unsigned r = 0;
+  while (b) {
+    if (b & 1) r ^= a;
+    a <<= 1;
+    if (a & 0x100) a ^= kPoly;
+    b >>= 1;
+  }
+  return static_cast<uint8_t>(r);
+}
+
+void build_tables(uint8_t c, uint8_t lo[16], uint8_t hi[16]) {
+  for (int x = 0; x < 16; x++) {
+    lo[x] = gf_mul_scalar(c, x);
+    hi[x] = gf_mul_scalar(c, x << 4);
+  }
+}
+
+// out ^= c * src over [0, n)
+void mul_add_row(uint8_t c, const uint8_t* src, uint8_t* out, size_t n) {
+  if (c == 0) return;
+  if (c == 1) {
+    size_t i = 0;
+#ifdef __SSSE3__
+    for (; i + 16 <= n; i += 16) {
+      __m128i v = _mm_loadu_si128(reinterpret_cast<const __m128i*>(src + i));
+      __m128i o = _mm_loadu_si128(reinterpret_cast<__m128i*>(out + i));
+      _mm_storeu_si128(reinterpret_cast<__m128i*>(out + i),
+                       _mm_xor_si128(o, v));
+    }
+#endif
+    for (; i < n; i++) out[i] ^= src[i];
+    return;
+  }
+  uint8_t lo[16], hi[16];
+  build_tables(c, lo, hi);
+  size_t i = 0;
+#ifdef __SSSE3__
+  const __m128i vlo = _mm_loadu_si128(reinterpret_cast<const __m128i*>(lo));
+  const __m128i vhi = _mm_loadu_si128(reinterpret_cast<const __m128i*>(hi));
+  const __m128i mask = _mm_set1_epi8(0x0F);
+  for (; i + 16 <= n; i += 16) {
+    __m128i v = _mm_loadu_si128(reinterpret_cast<const __m128i*>(src + i));
+    __m128i l = _mm_and_si128(v, mask);
+    __m128i h = _mm_and_si128(_mm_srli_epi64(v, 4), mask);
+    __m128i prod =
+        _mm_xor_si128(_mm_shuffle_epi8(vlo, l), _mm_shuffle_epi8(vhi, h));
+    __m128i o = _mm_loadu_si128(reinterpret_cast<__m128i*>(out + i));
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(out + i),
+                     _mm_xor_si128(o, prod));
+  }
+#endif
+  for (; i < n; i++) {
+    out[i] ^= static_cast<uint8_t>(lo[src[i] & 0x0F] ^ hi[src[i] >> 4]);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// out[r] = XOR_j matrix[r*cols+j] * data[j], all rows length n.
+// Chunked so the working set stays cache-resident.
+void gf_matmul(const uint8_t* matrix, int rows, int cols,
+               const uint8_t* const* data, uint8_t* const* out, size_t n) {
+  constexpr size_t kChunk = 32 * 1024;
+  for (size_t off = 0; off < n; off += kChunk) {
+    size_t len = (n - off < kChunk) ? (n - off) : kChunk;
+    for (int r = 0; r < rows; r++) {
+      std::memset(out[r] + off, 0, len);
+      for (int j = 0; j < cols; j++) {
+        mul_add_row(matrix[r * cols + j], data[j] + off, out[r] + off, len);
+      }
+    }
+  }
+}
+
+uint8_t gf_mul(uint8_t a, uint8_t b) { return gf_mul_scalar(a, b); }
+}

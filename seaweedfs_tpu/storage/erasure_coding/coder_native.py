@@ -1,0 +1,27 @@
+"""Native-SIMD RS codec: CpuRSCodec's interface over the C++ PSHUFB kernel.
+
+The production host-side codec (the numpy table path stays as the oracle);
+decode matrices still come from the numpy galois module — only the bulk
+byte-stream matmul runs natively.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .coder_cpu import CpuRSCodec
+
+
+class NativeRSCodec(CpuRSCodec):
+    def __init__(self, data_shards: int = 10, parity_shards: int = 4):
+        super().__init__(data_shards, parity_shards)
+        from ... import native
+
+        if not native.available():
+            raise RuntimeError("native gf256 library unavailable")
+        self._native = native
+
+    def _mat_apply(self, m: np.ndarray, data: np.ndarray) -> np.ndarray:
+        return self._native.gf_matmul_native(m, data)

@@ -28,10 +28,24 @@ def get_codec(
 
         return TpuRSCodec(data_shards, parity_shards, interpret=interpret)
     if backend == "cpu":
+        # prefer the native SIMD kernel (the klauspost-equivalent host path);
+        # numpy tables are the always-available fallback and oracle
+        try:
+            from ..storage.erasure_coding.coder_native import NativeRSCodec
+
+            return NativeRSCodec(data_shards, parity_shards)
+        except (RuntimeError, OSError):
+            pass
         from ..storage.erasure_coding.coder_cpu import CpuRSCodec
 
         return CpuRSCodec(data_shards, parity_shards)
-    raise ValueError(f"unknown storage backend {backend!r} (want 'cpu' or 'tpu')")
+    if backend == "numpy":
+        from ..storage.erasure_coding.coder_cpu import CpuRSCodec
+
+        return CpuRSCodec(data_shards, parity_shards)
+    raise ValueError(
+        f"unknown storage backend {backend!r} (want 'cpu', 'numpy' or 'tpu')"
+    )
 
 
 def detect_backend() -> str:

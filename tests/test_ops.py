@@ -129,3 +129,24 @@ def test_write_ec_files_with_tpu_codec_byte_identical(tmp_path):
         with open(str(tmp_path / "tpu" / "1") + to_ext(i), "rb") as f:
             tpu_bytes = f.read()
         assert cpu_bytes == tpu_bytes, f"shard {i} differs between backends"
+
+
+def test_native_codec_matches_oracle():
+    from seaweedfs_tpu import native
+
+    if not native.available():
+        pytest.skip("no C++ toolchain")
+    from seaweedfs_tpu.storage.erasure_coding.coder_native import NativeRSCodec
+
+    for k, m in ((10, 4), (6, 3)):
+        cpu = CpuRSCodec(k, m)
+        nat = NativeRSCodec(k, m)
+        rng = np.random.default_rng(k)
+        data = rng.integers(0, 256, size=(k, 100_003)).astype(np.uint8)
+        assert np.array_equal(nat.encode(data), cpu.encode(data))
+        shards = cpu.encode_all(data)
+        killed = random.sample(range(k + m), m)
+        partial = [None if i in killed else shards[i] for i in range(k + m)]
+        full = nat.reconstruct(partial)
+        for i in range(k + m):
+            assert np.array_equal(full[i], shards[i])
