@@ -11,12 +11,24 @@ from .filer_store import FilerStore
 
 
 class Filer:
-    def __init__(self, store: FilerStore, on_delete_chunks: Optional[Callable] = None):
+    def __init__(
+        self,
+        store: FilerStore,
+        on_delete_chunks: Optional[Callable] = None,
+        notifier=None,
+    ):
         self.store = store
         self.on_delete_chunks = on_delete_chunks  # async fid-deletion queue hook
+        self.notifier = notifier  # notification.Notifier (ref filer_notify.go)
         root = self.store.find_entry("/")
         if root is None:
             self.store.insert_entry(new_directory_entry("/", 0o775))
+
+    def _notify(self, event_type: str, path: str, entry: Optional[Entry]) -> None:
+        if self.notifier is not None:
+            self.notifier.notify(
+                event_type, path, entry.to_dict() if entry else None
+            )
 
     # --- mkdir -p for parents (ref filer.go CreateEntry ensuring dirs) ---
     def _ensure_parents(self, full_path: str) -> None:
@@ -41,6 +53,13 @@ class Filer:
             if old_fids:
                 self.on_delete_chunks(sorted(old_fids))
         self.store.insert_entry(entry)
+        from ..notification import EVENT_CREATE, EVENT_UPDATE
+
+        self._notify(
+            EVENT_UPDATE if existing is not None else EVENT_CREATE,
+            entry.full_path,
+            entry,
+        )
 
     def update_entry(self, entry: Entry) -> None:
         self.store.update_entry(entry)
@@ -69,6 +88,9 @@ class Filer:
         self.store.delete_entry(full_path)
         if delete_chunks and self.on_delete_chunks and collected:
             self.on_delete_chunks(sorted({c.fid for c in collected}))
+        from ..notification import EVENT_DELETE
+
+        self._notify(EVENT_DELETE, full_path, entry)
         return collected
 
     def list_entries(
@@ -122,6 +144,9 @@ class Filer:
         )
         self.store.insert_entry(entry_new)
         self.store.delete_entry(old_path)
+        from ..notification import EVENT_RENAME
+
+        self._notify(EVENT_RENAME, new_path, entry_new)
 
     def touch(self, full_path: str, mime: str, chunks: list[FileChunk], **attrs) -> Entry:
         now = time.time()

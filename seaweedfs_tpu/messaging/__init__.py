@@ -1,0 +1,3 @@
+from .broker import MessageBroker, pick_partition
+
+__all__ = ["MessageBroker", "pick_partition"]

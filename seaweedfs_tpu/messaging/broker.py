@@ -1,0 +1,118 @@
+"""Pub/sub message broker (ref: weed/messaging/broker/).
+
+Topics are split into partitions; producers hash a key onto a partition
+(ref broker/consistent_distribution.go) and consumers subscribe per
+(namespace, topic, partition) with an offset. gRPC service "messaging":
+Publish (unary), Subscribe (server stream), GetTopicConfiguration.
+Messages persist in memory per broker this round (the reference journals to
+filer log files — durable storage lands with the log-buffer subsystem).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import time
+from collections import defaultdict
+from typing import Optional
+
+from ..pb import grpc_address
+from ..pb.rpc import Service, serve
+
+DEFAULT_PARTITIONS = 4
+
+
+def pick_partition(key: bytes, partition_count: int) -> int:
+    """Stable key -> partition hash (ref consistent_distribution.go)."""
+    if not key:
+        return 0
+    digest = hashlib.md5(key).digest()
+    return int.from_bytes(digest[:4], "big") % partition_count
+
+
+class _Partition:
+    def __init__(self):
+        self.messages: list[dict] = []
+        self.new_message = asyncio.Event()
+
+
+class MessageBroker:
+    def __init__(self, host: str = "127.0.0.1", port: int = 17777):
+        self.host = host
+        self.port = port
+        self.address = f"{host}:{port}"
+        self._topics: dict[tuple[str, str], list[_Partition]] = {}
+        self._configs: dict[tuple[str, str], dict] = {}
+        self._grpc_server = None
+
+    def _partitions(self, namespace: str, topic: str) -> list[_Partition]:
+        key = (namespace, topic)
+        if key not in self._topics:
+            count = self._configs.get(key, {}).get(
+                "partition_count", DEFAULT_PARTITIONS
+            )
+            self._topics[key] = [_Partition() for _ in range(count)]
+        return self._topics[key]
+
+    async def start(self) -> None:
+        svc = Service("messaging")
+        svc.unary("ConfigureTopic")(self._grpc_configure)
+        svc.unary("GetTopicConfiguration")(self._grpc_get_configuration)
+        svc.unary("Publish")(self._grpc_publish)
+        svc.server_stream("Subscribe")(self._grpc_subscribe)
+        self._grpc_server = await serve(grpc_address(self.address), svc)
+
+    async def stop(self) -> None:
+        if self._grpc_server is not None:
+            await self._grpc_server.stop(0.5)
+
+    # ---------------- RPCs ----------------
+    async def _grpc_configure(self, req, context) -> dict:
+        key = (req.get("namespace", ""), req["topic"])
+        self._configs[key] = {
+            "partition_count": int(req.get("partition_count", DEFAULT_PARTITIONS))
+        }
+        return {}
+
+    async def _grpc_get_configuration(self, req, context) -> dict:
+        key = (req.get("namespace", ""), req["topic"])
+        return self._configs.get(key, {"partition_count": DEFAULT_PARTITIONS})
+
+    async def _grpc_publish(self, req, context) -> dict:
+        namespace = req.get("namespace", "")
+        topic = req["topic"]
+        partitions = self._partitions(namespace, topic)
+        partition = req.get("partition")
+        if partition is None:
+            partition = pick_partition(
+                req.get("key", b"") or b"", len(partitions)
+            )
+        p = partitions[int(partition)]
+        p.messages.append(
+            {
+                "key": req.get("key", b""),
+                "value": req.get("value", b""),
+                "headers": req.get("headers", {}),
+                "ts_ns": time.time_ns(),
+                "offset": len(p.messages),
+            }
+        )
+        p.new_message.set()
+        p.new_message = asyncio.Event()
+        return {"partition": int(partition), "offset": len(p.messages) - 1}
+
+    async def _grpc_subscribe(self, req, context):
+        namespace = req.get("namespace", "")
+        topic = req["topic"]
+        partition = int(req.get("partition", 0))
+        offset = int(req.get("start_offset", 0))
+        p = self._partitions(namespace, topic)[partition]
+        while True:
+            while offset < len(p.messages):
+                yield p.messages[offset]
+                offset += 1
+            event = p.new_message
+            try:
+                await asyncio.wait_for(event.wait(), timeout=30)
+            except asyncio.TimeoutError:
+                yield {"keepalive": True}

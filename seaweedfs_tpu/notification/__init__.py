@@ -1,0 +1,77 @@
+"""Filer-event notification fanout (ref: weed/notification/configuration.go).
+
+Sinks receive (event_type, path, entry_dict) tuples. The reference ships
+kafka/aws_sqs/google_pub_sub/gocdk plugins; in this zero-egress build those
+are registered as unavailable stubs, with log and in-memory sinks active.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ..util import log
+
+EVENT_CREATE = "create"
+EVENT_UPDATE = "update"
+EVENT_DELETE = "delete"
+EVENT_RENAME = "rename"
+
+
+class NotificationSink:
+    def send(self, event_type: str, path: str, entry: Optional[dict]) -> None:
+        raise NotImplementedError
+
+
+class LogSink(NotificationSink):
+    def send(self, event_type, path, entry) -> None:
+        log.info("filer event %s %s", event_type, path)
+
+
+class MemorySink(NotificationSink):
+    """Test/inspection sink."""
+
+    def __init__(self):
+        self.events: list[tuple[str, str, Optional[dict]]] = []
+        self._lock = threading.Lock()
+
+    def send(self, event_type, path, entry) -> None:
+        with self._lock:
+            self.events.append((event_type, path, entry))
+
+
+class UnavailableSink(NotificationSink):
+    def __init__(self, name: str):
+        self.name = name
+
+    def send(self, event_type, path, entry) -> None:
+        raise RuntimeError(
+            f"notification sink {self.name!r} requires external connectivity "
+            "not available in this deployment"
+        )
+
+
+SINK_FACTORIES: dict[str, Callable[[], NotificationSink]] = {
+    "log": LogSink,
+    "memory": MemorySink,
+    # external plugins registered as stubs (ref notification/configuration.go)
+    "kafka": lambda: UnavailableSink("kafka"),
+    "aws_sqs": lambda: UnavailableSink("aws_sqs"),
+    "google_pub_sub": lambda: UnavailableSink("google_pub_sub"),
+    "gocdk_pub_sub": lambda: UnavailableSink("gocdk_pub_sub"),
+}
+
+
+class Notifier:
+    """Fan events out to the configured sinks; failures are swallowed like
+    the reference's queue (delivery is best-effort)."""
+
+    def __init__(self, sinks: Optional[list[NotificationSink]] = None):
+        self.sinks = sinks or []
+
+    def notify(self, event_type: str, path: str, entry: Optional[dict] = None) -> None:
+        for sink in self.sinks:
+            try:
+                sink.send(event_type, path, entry)
+            except Exception:
+                pass

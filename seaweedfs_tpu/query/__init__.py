@@ -1,0 +1,11 @@
+"""S3-Select-style querying of stored JSON objects
+(ref: weed/query/json/, Query RPC at weed/pb/volume_server.proto:86).
+
+Supports a practical subset: projection of (possibly nested, dotted) fields
+and conjunctive equality/comparison predicates over JSON-lines or single
+JSON documents.
+"""
+
+from .json_query import query_json, parse_where
+
+__all__ = ["query_json", "parse_where"]

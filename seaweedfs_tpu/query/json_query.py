@@ -1,0 +1,103 @@
+"""JSON querying: project fields + filter rows (ref: weed/query/json/)."""
+
+from __future__ import annotations
+
+import json
+import operator
+import re
+from typing import Any, Callable, Iterator, Optional
+
+_OPS: dict[str, Callable] = {
+    "=": operator.eq,
+    "==": operator.eq,
+    "!=": operator.ne,
+    ">": operator.gt,
+    ">=": operator.ge,
+    "<": operator.lt,
+    "<=": operator.le,
+}
+
+_COND_RE = re.compile(
+    r"\s*([\w.]+)\s*(==|!=|>=|<=|=|>|<)\s*('(?:[^']*)'|\"(?:[^\"]*)\"|[^\s]+)\s*"
+)
+
+
+def _get_path(doc: Any, path: str) -> Any:
+    cur = doc
+    for part in path.split("."):
+        if isinstance(cur, dict) and part in cur:
+            cur = cur[part]
+        else:
+            return None
+    return cur
+
+
+def _parse_value(raw: str) -> Any:
+    if raw and raw[0] in "'\"":
+        return raw[1:-1]
+    if raw.lower() in ("true", "false"):
+        return raw.lower() == "true"
+    try:
+        return int(raw)
+    except ValueError:
+        try:
+            return float(raw)
+        except ValueError:
+            return raw
+
+
+def parse_where(where: str) -> list[tuple[str, str, Any]]:
+    """'a.b = 5 AND c != "x"' -> [(path, op, value), ...]."""
+    if not where.strip():
+        return []
+    conds = []
+    for clause in re.split(r"\s+(?:AND|and)\s+", where.strip()):
+        m = _COND_RE.fullmatch(clause)
+        if not m:
+            raise ValueError(f"cannot parse condition: {clause!r}")
+        path, op, raw = m.groups()
+        conds.append((path, op, _parse_value(raw)))
+    return conds
+
+
+def _matches(doc: Any, conds: list[tuple[str, str, Any]]) -> bool:
+    for path, op, want in conds:
+        got = _get_path(doc, path)
+        if got is None:
+            return False
+        try:
+            if not _OPS[op](got, want):
+                return False
+        except TypeError:
+            return False
+    return True
+
+
+def query_json(
+    data: bytes,
+    fields: Optional[list[str]] = None,
+    where: str = "",
+) -> Iterator[dict]:
+    """Iterate matching (projected) rows of a JSON document or JSON-lines
+    blob. fields=None selects everything (SELECT *)."""
+    conds = parse_where(where)
+    text = data.decode("utf-8", errors="replace").strip()
+
+    def docs():
+        if not text:
+            return
+        if text[0] == "[":
+            yield from json.loads(text)
+            return
+        for line in text.splitlines():
+            line = line.strip()
+            if line:
+                yield json.loads(line)
+
+    for doc in docs():
+        if not _matches(doc, conds):
+            continue
+        if fields is None or fields == ["*"]:
+            yield doc
+        else:
+            yield {f: _get_path(doc, f) for f in fields}

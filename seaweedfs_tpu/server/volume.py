@@ -108,6 +108,9 @@ class VolumeServer(EcHandlers):
         svc.unary("VolumeServerStatus")(self._grpc_status)
         svc.server_stream("CopyFile")(self._grpc_copy_file)
         svc.unary("VolumeCopy")(self._grpc_volume_copy)
+        svc.server_stream("VolumeIncrementalCopy")(self._grpc_incremental_copy)
+        svc.unary("VolumeSyncStatus")(self._grpc_sync_status)
+        svc.server_stream("Query")(self._grpc_query)
         self.register_ec_rpcs(svc)
         self._grpc_server = await serve(grpc_address(self.address), svc)
 
@@ -513,6 +516,52 @@ class VolumeServer(EcHandlers):
                 for loc in self.store.locations
                 for v in loc.volumes.values()
             ],
+        }
+
+    async def _grpc_query(self, req, context):
+        """S3-Select-style query over stored JSON objects
+        (ref volume_grpc_query.go, volume_server.proto:86)."""
+        from ..query import query_json
+
+        fields = req.get("selected_columns")
+        where = req.get("where", "")
+        for fid_str in req.get("from_file_ids", []):
+            try:
+                fid = FileId.parse(fid_str)
+                n = Needle(id=fid.key)
+                self.store.read_volume_needle(fid.volume_id, n)
+                if n.cookie != fid.cookie:
+                    continue
+                for row in query_json(bytes(n.data), fields, where):
+                    yield {"file_id": fid_str, "record": row}
+            except Exception as e:
+                yield {"file_id": fid_str, "error": str(e)}
+
+    async def _grpc_incremental_copy(self, req, context):
+        """Stream records appended after since_ns
+        (ref volume_grpc_copy_incremental.go + volume_backup.go)."""
+        vid = int(req["volume_id"])
+        since_ns = int(req.get("since_ns", 0))
+        v = self.store.find_volume(vid)
+        if v is None:
+            yield {"error": f"volume {vid} not found"}
+            return
+        from ..storage.volume_backup import incremental_changes
+
+        for chunk in incremental_changes(v, since_ns):
+            yield {"file_content": chunk}
+
+    async def _grpc_sync_status(self, req, context) -> dict:
+        vid = int(req["volume_id"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return {"error": f"volume {vid} not found"}
+        return {
+            "volume_id": vid,
+            "tail_offset": v.data_file_size(),
+            "compact_revision": v.super_block.compaction_revision,
+            "idx_file_size": v.index_file_size(),
+            "last_append_at_ns": v.last_append_at_ns,
         }
 
     async def _grpc_volume_copy(self, req, context) -> dict:

@@ -1,0 +1,156 @@
+"""WebDAV gateway over the filer (ref: weed/server/webdav_server.go).
+
+Implements the class-1 surface: OPTIONS, PROPFIND (depth 0/1), GET/HEAD,
+PUT, DELETE, MKCOL, MOVE, COPY. Shares the in-process FilerServer's Filer
+and chunk IO like the S3 gateway does.
+"""
+
+from __future__ import annotations
+
+import time
+import xml.etree.ElementTree as ET
+from typing import Optional
+from urllib.parse import unquote, urlparse
+
+from aiohttp import web
+
+from ..filer import (
+    Entry,
+    non_overlapping_visible_intervals,
+    read_from_visible_intervals,
+)
+
+_DAV = "DAV:"
+ET.register_namespace("D", _DAV)
+
+
+def _prop_elem(href: str, entry: Entry) -> ET.Element:
+    resp = ET.Element(f"{{{_DAV}}}response")
+    ET.SubElement(resp, f"{{{_DAV}}}href").text = href
+    propstat = ET.SubElement(resp, f"{{{_DAV}}}propstat")
+    prop = ET.SubElement(propstat, f"{{{_DAV}}}prop")
+    rtype = ET.SubElement(prop, f"{{{_DAV}}}resourcetype")
+    if entry.is_directory:
+        ET.SubElement(rtype, f"{{{_DAV}}}collection")
+    else:
+        ET.SubElement(prop, f"{{{_DAV}}}getcontentlength").text = str(entry.size())
+    ET.SubElement(prop, f"{{{_DAV}}}getlastmodified").text = time.strftime(
+        "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(entry.attr.mtime)
+    )
+    ET.SubElement(prop, f"{{{_DAV}}}displayname").text = entry.name
+    ET.SubElement(propstat, f"{{{_DAV}}}status").text = "HTTP/1.1 200 OK"
+    return resp
+
+
+class WebDavServer:
+    def __init__(self, filer_server, host: str = "127.0.0.1", port: int = 7333):
+        self.fs = filer_server
+        self.filer = filer_server.filer
+        self.host = host
+        self.port = port
+        self.address = f"{host}:{port}"
+        self._http_runner: Optional[web.AppRunner] = None
+
+    async def start(self) -> None:
+        app = web.Application(client_max_size=1024 << 20)
+        app.router.add_route("*", "/{tail:.*}", self._dispatch)
+        self._http_runner = web.AppRunner(app)
+        await self._http_runner.setup()
+        site = web.TCPSite(self._http_runner, self.host, self.port)
+        await site.start()
+
+    async def stop(self) -> None:
+        if self._http_runner is not None:
+            await self._http_runner.cleanup()
+
+    async def _dispatch(self, request: web.Request) -> web.Response:
+        path = "/" + unquote(request.match_info["tail"]).strip("/")
+        method = request.method
+        if method == "OPTIONS":
+            return web.Response(
+                headers={
+                    "DAV": "1",
+                    "Allow": "OPTIONS, PROPFIND, GET, HEAD, PUT, DELETE, MKCOL, MOVE, COPY",
+                }
+            )
+        if method == "PROPFIND":
+            return await self._propfind(request, path)
+        if method in ("GET", "HEAD"):
+            return await self._get(request, path)
+        if method == "PUT":
+            return await self._put(request, path)
+        if method == "DELETE":
+            self.filer.delete_entry(path, recursive=True)
+            return web.Response(status=204)
+        if method == "MKCOL":
+            from ..filer.entry import new_directory_entry
+
+            if self.filer.find_entry(path) is not None:
+                return web.Response(status=405)
+            self.filer.create_entry(new_directory_entry(path))
+            return web.Response(status=201)
+        if method in ("MOVE", "COPY"):
+            return await self._move_copy(request, path, copy=method == "COPY")
+        return web.Response(status=405)
+
+    async def _propfind(self, request: web.Request, path: str) -> web.Response:
+        entry = self.filer.find_entry(path)
+        if entry is None:
+            return web.Response(status=404)
+        depth = request.headers.get("Depth", "1")
+        multi = ET.Element(f"{{{_DAV}}}multistatus")
+        multi.append(_prop_elem(path, entry))
+        if entry.is_directory and depth != "0":
+            for child in self.filer.list_entries(path):
+                multi.append(_prop_elem(child.full_path, child))
+        body = b'<?xml version="1.0" encoding="utf-8"?>' + ET.tostring(multi)
+        return web.Response(
+            body=body, status=207, content_type="application/xml"
+        )
+
+    async def _get(self, request: web.Request, path: str) -> web.Response:
+        entry = self.filer.find_entry(path)
+        if entry is None or entry.is_directory:
+            return web.Response(status=404)
+        size = entry.size()
+        if request.method == "HEAD":
+            return web.Response(headers={"Content-Length": str(size)})
+        visibles = non_overlapping_visible_intervals(entry.chunks)
+        blobs = {}
+        for v in visibles:
+            if v.fid not in blobs:
+                blobs[v.fid] = await self.fs._fetch_chunk(v.fid)
+        body = read_from_visible_intervals(visibles, blobs.__getitem__, 0, size)
+        return web.Response(
+            body=body, content_type=entry.attr.mime or "application/octet-stream"
+        )
+
+    async def _put(self, request: web.Request, path: str) -> web.Response:
+        data = await request.read()
+        chunks = await self.fs._write_chunks(data)
+        self.filer.touch(path, request.headers.get("Content-Type", ""), chunks)
+        return web.Response(status=201)
+
+    async def _move_copy(
+        self, request: web.Request, path: str, copy: bool
+    ) -> web.Response:
+        dest_header = request.headers.get("Destination", "")
+        if not dest_header:
+            return web.Response(status=400)
+        dest = "/" + unquote(urlparse(dest_header).path).strip("/")
+        if copy:
+            entry = self.filer.find_entry(path)
+            if entry is None:
+                return web.Response(status=404)
+            clone = Entry(
+                full_path=dest,
+                attr=entry.attr,
+                chunks=entry.chunks,
+                extended=dict(entry.extended),
+            )
+            # chunk fids are shared; create without freeing anything
+            self.filer._ensure_parents(dest)
+            self.filer.store.insert_entry(clone)
+        else:
+            self.filer.rename(path, dest)
+        return web.Response(status=201)

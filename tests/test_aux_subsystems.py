@@ -1,0 +1,205 @@
+"""WebDAV gateway, notifications, replication, messaging broker, JSON query."""
+
+import asyncio
+import random
+
+import aiohttp
+import pytest
+
+from test_cluster import Cluster, free_port_pair
+
+
+# ---------------- query ----------------
+def test_query_json():
+    from seaweedfs_tpu.query import parse_where, query_json
+
+    data = b"""
+{"name": "alice", "age": 31, "addr": {"city": "sf"}}
+{"name": "bob", "age": 25, "addr": {"city": "nyc"}}
+{"name": "carol", "age": 41, "addr": {"city": "sf"}}
+"""
+    rows = list(query_json(data, ["name"], "addr.city = 'sf' AND age > 35"))
+    assert rows == [{"name": "carol"}]
+    rows = list(query_json(data, None, "age >= 31"))
+    assert {r["name"] for r in rows} == {"alice", "carol"}
+    rows = list(query_json(b'[{"a": 1}, {"a": 2}]', ["a"], "a != 1"))
+    assert rows == [{"a": 2}]
+    assert parse_where("") == []
+    with pytest.raises(ValueError):
+        parse_where("garbage without operator")
+
+
+# ---------------- notification + replication ----------------
+def test_notifier_sinks():
+    from seaweedfs_tpu.filer import Filer, MemoryFilerStore
+    from seaweedfs_tpu.notification import (
+        SINK_FACTORIES,
+        MemorySink,
+        Notifier,
+    )
+
+    sink = MemorySink()
+    f = Filer(MemoryFilerStore(), notifier=Notifier([sink]))
+    f.touch("/a/b.txt", "", [])
+    f.rename("/a/b.txt", "/a/c.txt")
+    f.delete_entry("/a/c.txt")
+    kinds = [e[0] for e in sink.events]
+    assert kinds == ["create", "rename", "delete"]
+    # external sinks are registered but refuse without connectivity
+    with pytest.raises(RuntimeError):
+        SINK_FACTORIES["kafka"]().send("create", "/x", None)
+
+
+def test_replication_between_filers(tmp_path):
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        from seaweedfs_tpu.notification import Notifier
+        from seaweedfs_tpu.replication import (
+            FilerHttpSink,
+            QueueingSink,
+            Replicator,
+        )
+        from seaweedfs_tpu.server.filer import FilerServer
+
+        src = FilerServer(master=cluster.master.address, port=free_port_pair())
+        dst = FilerServer(master=cluster.master.address, port=free_port_pair())
+        await src.start()
+        await dst.start()
+        queue_sink = QueueingSink()
+        src.filer.notifier = Notifier([queue_sink])
+        sink = FilerHttpSink(src.address, dst.address)
+        replicator = Replicator(queue_sink, sink)
+        await replicator.start()
+        try:
+            await src.master_client.wait_connected()
+            await dst.master_client.wait_connected()
+            async with aiohttp.ClientSession() as session:
+                payload = random.randbytes(20_000)
+                async with session.put(
+                    f"http://{src.address}/mirror/me.bin", data=payload
+                ) as resp:
+                    assert resp.status == 201
+                await replicator.drain()
+                async with session.get(
+                    f"http://{dst.address}/mirror/me.bin"
+                ) as resp:
+                    assert resp.status == 200
+                    assert await resp.read() == payload
+                # deletion replicates too
+                async with session.delete(
+                    f"http://{src.address}/mirror/me.bin"
+                ) as resp:
+                    assert resp.status == 204
+                await replicator.drain()
+                async with session.get(
+                    f"http://{dst.address}/mirror/me.bin"
+                ) as resp:
+                    assert resp.status == 404
+        finally:
+            await replicator.stop()
+            await sink.close()
+            await src.stop()
+            await dst.stop()
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+# ---------------- webdav ----------------
+def test_webdav(tmp_path):
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        from seaweedfs_tpu.server.filer import FilerServer
+        from seaweedfs_tpu.server.webdav import WebDavServer
+
+        fs = FilerServer(master=cluster.master.address, port=free_port_pair())
+        await fs.start()
+        dav = WebDavServer(fs, port=free_port_pair())
+        await dav.start()
+        try:
+            await fs.master_client.wait_connected()
+            base = f"http://{dav.address}"
+            async with aiohttp.ClientSession() as session:
+                async with session.request("MKCOL", f"{base}/folder") as resp:
+                    assert resp.status == 201
+                payload = random.randbytes(10_000)
+                async with session.put(f"{base}/folder/f.bin", data=payload) as resp:
+                    assert resp.status == 201
+                async with session.get(f"{base}/folder/f.bin") as resp:
+                    assert resp.status == 200
+                    assert await resp.read() == payload
+                async with session.request(
+                    "PROPFIND", f"{base}/folder", headers={"Depth": "1"}
+                ) as resp:
+                    assert resp.status == 207
+                    text = await resp.text()
+                    assert "f.bin" in text
+                    assert "collection" in text
+                async with session.request(
+                    "MOVE",
+                    f"{base}/folder/f.bin",
+                    headers={"Destination": f"{base}/folder/g.bin"},
+                ) as resp:
+                    assert resp.status == 201
+                async with session.get(f"{base}/folder/g.bin") as resp:
+                    assert resp.status == 200
+                async with session.delete(f"{base}/folder") as resp:
+                    assert resp.status == 204
+        finally:
+            await dav.stop()
+            await fs.stop()
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+# ---------------- messaging ----------------
+def test_messaging_broker():
+    from seaweedfs_tpu.messaging import pick_partition
+
+    # stable hashing
+    assert pick_partition(b"key-1", 4) == pick_partition(b"key-1", 4)
+    assert 0 <= pick_partition(b"anything", 4) < 4
+
+    async def body():
+        from seaweedfs_tpu.messaging import MessageBroker
+        from seaweedfs_tpu.pb import grpc_address
+        from seaweedfs_tpu.pb.rpc import Stub, close_all_channels
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        broker = MessageBroker(port=port)
+        await broker.start()
+        try:
+            stub = Stub(grpc_address(broker.address), "messaging")
+            await stub.call(
+                "ConfigureTopic", {"topic": "events", "partition_count": 2}
+            )
+            r1 = await stub.call(
+                "Publish", {"topic": "events", "key": b"k", "value": b"v1"}
+            )
+            r2 = await stub.call(
+                "Publish", {"topic": "events", "key": b"k", "value": b"v2"}
+            )
+            assert r1["partition"] == r2["partition"]  # same key, same partition
+            got = []
+            async for msg in stub.server_stream(
+                "Subscribe",
+                {"topic": "events", "partition": r1["partition"],
+                 "start_offset": 0},
+                timeout=5,
+            ):
+                if msg.get("keepalive"):
+                    break
+                got.append(msg["value"])
+                if len(got) == 2:
+                    break
+            assert got == [b"v1", b"v2"]
+        finally:
+            await broker.stop()
+
+    asyncio.run(body())

@@ -1,0 +1,113 @@
+"""Incremental backup by AppendAtNs (ref volume_backup_test.go) + the
+VolumeIncrementalCopy RPC."""
+
+import asyncio
+import random
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.storage.volume_backup import (
+    apply_incremental,
+    binary_search_append_at_ns,
+    incremental_changes,
+)
+
+
+def test_binary_search_append_at_ns(tmp_path):
+    v = Volume(str(tmp_path), "", 1)
+    stamps = []
+    for i in range(10):
+        n = Needle(cookie=1, id=i + 1, data=b"x" * 50)
+        v.write_needle(n)
+        stamps.append(v.last_append_at_ns)
+
+    # before everything -> first record's offset (just after super block)
+    assert binary_search_append_at_ns(v, 0) == v.super_block.block_size()
+    # after everything -> EOF
+    assert binary_search_append_at_ns(v, stamps[-1]) == v.data_file_size()
+    # middle: resumes at the first record strictly newer
+    mid_offset = binary_search_append_at_ns(v, stamps[4])
+    data = b"".join(incremental_changes(v, stamps[4]))
+    assert len(data) == v.data_file_size() - mid_offset
+    v.close()
+
+
+def test_incremental_backup_roundtrip(tmp_path):
+    src_dir = tmp_path / "src"
+    dst_dir = tmp_path / "dst"
+    src_dir.mkdir()
+    dst_dir.mkdir()
+    src = Volume(str(src_dir), "", 2)
+    dst = Volume(str(dst_dir), "", 2)
+
+    payloads = {}
+    for i in range(5):
+        n = Needle(cookie=7, id=i + 1, data=random.randbytes(100))
+        payloads[i + 1] = n.data
+        src.write_needle(n)
+
+    # full sync from scratch
+    applied = apply_incremental(dst, b"".join(incremental_changes(src, 0)))
+    assert applied == 5
+    checkpoint = dst.last_append_at_ns
+
+    # more writes + one delete on the source
+    for i in range(5, 8):
+        n = Needle(cookie=7, id=i + 1, data=random.randbytes(100))
+        payloads[i + 1] = n.data
+        src.write_needle(n)
+    src.delete_needle(Needle(id=2, cookie=7))
+    del payloads[2]
+
+    applied = apply_incremental(
+        dst, b"".join(incremental_changes(src, checkpoint))
+    )
+    assert applied == 4  # 3 writes + 1 tombstone
+
+    for nid, data in payloads.items():
+        got = Needle(id=nid)
+        dst.read_needle(got)
+        assert got.data == data
+    from seaweedfs_tpu.storage.volume import AlreadyDeleted
+
+    try:
+        dst.read_needle(Needle(id=2))
+        assert False, "deleted needle readable on the replica"
+    except AlreadyDeleted:
+        pass
+    src.close()
+    dst.close()
+
+
+def test_incremental_copy_rpc(tmp_path):
+    from test_cluster import Cluster
+
+    from seaweedfs_tpu.client import assign
+    from seaweedfs_tpu.client.operation import upload_data
+    from seaweedfs_tpu.pb import grpc_address
+    from seaweedfs_tpu.pb.rpc import Stub
+
+    import aiohttp
+
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                ar = await assign(cluster.master.address)
+                await upload_data(session, ar.url, ar.fid, b"incremental-rpc")
+                vid = int(ar.fid.split(",")[0])
+                stub = Stub(grpc_address(ar.url), "volume")
+                status = await stub.call("VolumeSyncStatus", {"volume_id": vid})
+                assert status["tail_offset"] > 8
+                buf = bytearray()
+                async for msg in stub.server_stream(
+                    "VolumeIncrementalCopy", {"volume_id": vid, "since_ns": 0}
+                ):
+                    assert not msg.get("error"), msg
+                    buf.extend(msg.get("file_content", b""))
+                assert b"incremental-rpc" in bytes(buf)
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
