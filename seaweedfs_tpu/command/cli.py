@@ -134,6 +134,122 @@ def cmd_server(argv: list[str]) -> int:
     return 0
 
 
+def cmd_filer(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="weed-tpu filer")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8888)
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-store", default="", help="sqlite path ('' = memory)")
+    p.add_argument("-maxMB", type=int, default=4, help="chunk size in MB")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    args = p.parse_args(argv)
+    from ..server.filer import FilerServer
+
+    fs = FilerServer(
+        master=args.master,
+        host=args.ip,
+        port=args.port,
+        store_path=args.store,
+        chunk_size=args.maxMB * 1024 * 1024,
+        collection=args.collection,
+        replication=args.replication,
+    )
+    print(f"filer listening on {args.ip}:{args.port}")
+    asyncio.run(_run_forever(fs))
+    return 0
+
+
+def cmd_s3(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="weed-tpu s3")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8333)
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-filerPort", type=int, default=8888)
+    p.add_argument("-store", default="")
+    args = p.parse_args(argv)
+    from ..s3.server import S3Server
+    from ..server.filer import FilerServer
+
+    fs = FilerServer(
+        master=args.master, host=args.ip, port=args.filerPort, store_path=args.store
+    )
+    s3 = S3Server(fs, host=args.ip, port=args.port)
+    print(f"s3 gateway on {args.ip}:{args.port} (filer on :{args.filerPort})")
+    asyncio.run(_run_forever(fs, s3))
+    return 0
+
+
+def cmd_webdav(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="weed-tpu webdav")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=7333)
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-filerPort", type=int, default=8888)
+    args = p.parse_args(argv)
+    from ..server.filer import FilerServer
+    from ..server.webdav import WebDavServer
+
+    fs = FilerServer(master=args.master, host=args.ip, port=args.filerPort)
+    dav = WebDavServer(fs, host=args.ip, port=args.port)
+    print(f"webdav on {args.ip}:{args.port} (filer on :{args.filerPort})")
+    asyncio.run(_run_forever(fs, dav))
+    return 0
+
+
+def cmd_msg_broker(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="weed-tpu msgBroker")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=17777)
+    args = p.parse_args(argv)
+    from ..messaging import MessageBroker
+
+    broker = MessageBroker(host=args.ip, port=args.port)
+    print(f"message broker gRPC on {args.ip}:{args.port + 10000}")
+    asyncio.run(_run_forever(broker))
+    return 0
+
+
+def cmd_backup(argv: list[str]) -> int:
+    """Incremental pull of a remote volume into a local directory
+    (ref command/backup.go)."""
+    p = argparse.ArgumentParser(prog="weed-tpu backup")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-dir", default=".")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    args = p.parse_args(argv)
+
+    async def go() -> None:
+        from ..client.operation import lookup
+        from ..pb import grpc_address
+        from ..pb.rpc import Stub, close_all_channels
+        from ..storage.volume import Volume
+        from ..storage.volume_backup import apply_incremental
+
+        locs = await lookup(args.master, args.volumeId, args.collection)
+        if not locs:
+            raise SystemExit(f"volume {args.volumeId} not found")
+        v = Volume(args.dir, args.collection, args.volumeId)
+        since = v.last_append_at_ns
+        stub = Stub(grpc_address(locs[0]), "volume")
+        buf = bytearray()
+        async for msg in stub.server_stream(
+            "VolumeIncrementalCopy",
+            {"volume_id": args.volumeId, "since_ns": since},
+        ):
+            if msg.get("error"):
+                raise SystemExit(msg["error"])
+            buf.extend(msg.get("file_content", b""))
+        applied = apply_incremental(v, bytes(buf))
+        print(f"volume {args.volumeId}: applied {applied} records since {since}")
+        v.close()
+        await close_all_channels()
+
+    asyncio.run(go())
+    return 0
+
+
 def cmd_shell(argv: list[str]) -> int:
     p = argparse.ArgumentParser(prog="weed-tpu shell")
     p.add_argument("-master", default="127.0.0.1:9333")
@@ -376,10 +492,15 @@ COMMANDS = {
     "master": cmd_master,
     "volume": cmd_volume,
     "server": cmd_server,
+    "filer": cmd_filer,
+    "s3": cmd_s3,
+    "webdav": cmd_webdav,
+    "msgBroker": cmd_msg_broker,
     "shell": cmd_shell,
     "benchmark": cmd_benchmark,
     "upload": cmd_upload,
     "download": cmd_download,
+    "backup": cmd_backup,
     "export": cmd_export,
     "fix": cmd_fix,
     "compact": cmd_compact,
