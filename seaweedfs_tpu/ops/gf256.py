@@ -17,6 +17,13 @@ and xtime runs byte-parallel inside each word with masks:
 the reference's table-driven SIMD GF multiply (klauspost/reedsolomon,
 ref: ec_encoder.go:198). All byte positions are independent so the uint32
 packing order never matters.
+
+Measured 65 GB/s data throughput on one v5e chip — VPU-compute-bound at
+~1.3e12 i32 ops/s. An MXU bit-slice formulation (GF(2) matmul of 80 bit
+planes by a static 32x80 bit matrix via int8 dot_general) was prototyped and
+is byte-correct but lands at the same ~63 GB/s: the bit unpack/repack is VPU
+work of the same magnitude as the xtime chains, so the VPU remains the
+bottleneck either way. Kept the packed formulation (simpler, no MXU).
 """
 
 from __future__ import annotations

@@ -74,6 +74,18 @@ class VolumeServer(EcHandlers):
         self._http_client: Optional[aiohttp.ClientSession] = None
         self._shutdown = False
         self._codec = None
+        self._group_committers: dict[int, object] = {}
+
+    def _group_committer(self, vid: int):
+        gc = self._group_committers.get(vid)
+        if gc is None:
+            from ..storage.group_commit import GroupCommitWorker
+
+            v = self.store.find_volume(vid)
+            gc = GroupCommitWorker(v)
+            gc.start()
+            self._group_committers[vid] = gc
+        return gc
 
     @property
     def codec(self):
@@ -118,6 +130,8 @@ class VolumeServer(EcHandlers):
 
     async def stop(self) -> None:
         self._shutdown = True
+        for gc in self._group_committers.values():
+            await gc.stop()
         if self._heartbeat_task is not None:
             self._heartbeat_task.cancel()
             try:
@@ -335,7 +349,11 @@ class VolumeServer(EcHandlers):
             n.set_ttl(TTL.read(ttl))
 
         is_replicate = request.query.get("type") == "replicate"
-        offset, size, unchanged = self.store.write_volume_needle(vid, n)
+        if request.query.get("fsync") == "true":
+            # group-commit path: one fsync amortized over concurrent writers
+            offset, size, unchanged = await self._group_committer(vid).write(n)
+        else:
+            offset, size, unchanged = self.store.write_volume_needle(vid, n)
 
         if not is_replicate:
             err = await self._replicate(request, vid, "POST", await self._raw_body(n))
