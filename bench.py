@@ -62,9 +62,12 @@ def measure_tpu(parity_matrix, packed_np: np.ndarray) -> float:
         return time.perf_counter() - t0
 
     run(2)  # warm the pull path
-    t_lo = min(run(4) for _ in range(3))
-    t_hi = min(run(20) for _ in range(3))
-    per_iter = (t_hi - t_lo) / 16
+    k_lo, k_hi = 8, 64
+    t_lo = min(run(k_lo) for _ in range(5))
+    t_hi = min(run(k_hi) for _ in range(5))
+    per_iter = (t_hi - t_lo) / (k_hi - k_lo)
+    if per_iter <= 0:  # RTT noise swamped the slope; fall back to bulk timing
+        per_iter = t_hi / k_hi
     return n_bytes / per_iter / 1e9
 
 
