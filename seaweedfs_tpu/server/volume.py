@@ -233,25 +233,33 @@ class VolumeServer(EcHandlers):
                 return await self._handle_delete(request)
         except (NotFound, NotFoundError, AlreadyDeleted, LookupError) as e:
             return web.json_response({"error": str(e)}, status=404)
+        except ValueError as e:
+            # unparsable file id (ref volume_server_handlers_read.go:35-39)
+            return web.json_response({"error": str(e)}, status=400)
         except CookieMismatch as e:
             return web.json_response({"error": str(e)}, status=403)
         return web.json_response({"error": "method not allowed"}, status=405)
 
-    def _parse_fid_path(self, path: str) -> tuple[FileId, str]:
+    def _parse_fid_path(self, path: str) -> tuple[FileId, str, str]:
         parts = path.lstrip("/").split("/")
         fid_part = parts[0]
-        filename = parts[1] if len(parts) > 1 else ""
+        if "," not in fid_part and len(parts) > 1:
+            # /vid/fid[/filename] form
+            fid_part = parts[0] + "," + parts[1]
+            filename = parts[2] if len(parts) > 2 else ""
+        else:
+            filename = parts[1] if len(parts) > 1 else ""
         ext = ""
         if "." in fid_part:
-            fid_part, _, ext = fid_part.partition(".")
-        if "," not in fid_part and len(parts) > 1 and "," in parts[1]:
-            # /vid/fid form
-            fid_part = parts[0] + "," + parts[1]
-        return FileId.parse(fid_part), filename
+            fid_part, _, tail = fid_part.rpartition(".")
+            ext = "." + tail
+        if not ext and "." in filename:
+            ext = "." + filename.rsplit(".", 1)[1]
+        return FileId.parse(fid_part), filename, ext
 
     # ---------------- read (ref volume_server_handlers_read.go) ----------------
     async def _handle_read(self, request: web.Request) -> web.StreamResponse:
-        fid, _filename = self._parse_fid_path(request.path)
+        fid, _filename, ext = self._parse_fid_path(request.path)
         vid = fid.volume_id
 
         if self.store.has_volume(vid):
@@ -259,7 +267,7 @@ class VolumeServer(EcHandlers):
             self.store.read_volume_needle(vid, n)
             if n.cookie != fid.cookie:
                 return web.json_response({"error": "cookie mismatch"}, status=404)
-            return self._needle_response(request, n)
+            return self._needle_response(request, n, ext)
 
         ev = self.store.find_ec_volume(vid)
         if ev is not None:
@@ -268,7 +276,7 @@ class VolumeServer(EcHandlers):
                 return web.json_response({"error": "not found"}, status=404)
             if n.cookie != fid.cookie:
                 return web.json_response({"error": "cookie mismatch"}, status=404)
-            return self._needle_response(request, n)
+            return self._needle_response(request, n, ext)
 
         # not local: redirect via master lookup (ref :41-53)
         result = await self._lookup_volume(vid)
@@ -280,14 +288,24 @@ class VolumeServer(EcHandlers):
                 )
         return web.json_response({"error": "volume not found"}, status=404)
 
-    def _needle_response(self, request: web.Request, n: Needle) -> web.Response:
-        headers = {"Etag": f'"{n.etag()}"'}
+    def _needle_response(
+        self, request: web.Request, n: Needle, ext: str = ""
+    ) -> web.Response:
+        headers = {"Etag": f'"{n.etag()}"', "Accept-Ranges": "bytes"}
         if n.last_modified:
             headers["Last-Modified-Ts"] = str(n.last_modified)
+        from .. import images
+
+        width, height, mode, do_resize = images.should_resize(
+            ext, request.query
+        )
+
         body = bytes(n.data)
         if n.is_compressed():
             accept = request.headers.get("Accept-Encoding", "")
-            if "gzip" in accept:
+            # resize requires plaintext regardless of what the client
+            # accepts (ref volume_server_handlers_read.go:210-238)
+            if "gzip" in accept and not do_resize:
                 headers["Content-Encoding"] = "gzip"
             else:
                 import gzip as _gzip
@@ -296,10 +314,64 @@ class VolumeServer(EcHandlers):
         content_type = (
             n.mime.decode() if n.mime else "application/octet-stream"
         )
+
+        # on-read image resizing (ref volume_server_handlers_read.go:210-238)
+        if do_resize:
+            body, _, _ = images.resized(ext, body, width, height, mode)
+
         if request.method == "HEAD":
             headers["Content-Length"] = str(len(body))
+            headers["Content-Type"] = content_type
             return web.Response(status=200, headers=headers)
+
+        # single-range requests (ref writeResponseContent / http.ServeContent);
+        # an unparsable Range header is ignored per RFC 9110
+        if_range = request.headers.get("If-Range", "")
+        if if_range and if_range != headers["Etag"]:
+            return web.Response(
+                body=body, content_type=content_type, headers=headers
+            )
+        range_span = self._parse_range(request.headers.get("Range", ""), len(body))
+        if range_span == "invalid-range":
+            return web.Response(
+                status=416,
+                headers={"Content-Range": f"bytes */{len(body)}"},
+            )
+        if range_span is not None:
+            start, end = range_span
+            headers["Content-Range"] = f"bytes {start}-{end}/{len(body)}"
+            return web.Response(
+                status=206,
+                body=body[start : end + 1],
+                content_type=content_type,
+                headers=headers,
+            )
         return web.Response(body=body, content_type=content_type, headers=headers)
+
+    @staticmethod
+    def _parse_range(rng: str, total: int):
+        """-> (start, end) | None (serve full body) | "invalid-range" (416)."""
+        if not rng.startswith("bytes=") or "," in rng:
+            return None
+        start_s, sep, end_s = rng[len("bytes="):].strip().partition("-")
+        if not sep:
+            return None
+        try:
+            if start_s == "":
+                if end_s == "":
+                    return None
+                start, end = max(0, total - int(end_s)), total - 1
+            else:
+                start = int(start_s)
+                end = int(end_s) if end_s else total - 1
+        except ValueError:
+            return None
+        if start < 0 or end < start:
+            # syntactically invalid byte-range-spec: ignore (RFC 9110 14.1.1)
+            return None
+        if start >= total:
+            return "invalid-range"
+        return min(start, total - 1), min(end, total - 1)
 
     # ---------------- write (ref volume_server_handlers_write.go) ----------------
     async def _parse_upload(self, request: web.Request) -> tuple[bytes, str, str]:
@@ -319,7 +391,7 @@ class VolumeServer(EcHandlers):
         return await request.read(), "", content_type
 
     async def _handle_write(self, request: web.Request) -> web.Response:
-        fid, _ = self._parse_fid_path(request.path)
+        fid, _, _ = self._parse_fid_path(request.path)
         vid = fid.volume_id
         if self.jwt_signing_key:
             from ..util.security import Guard
@@ -367,7 +439,7 @@ class VolumeServer(EcHandlers):
         return bytes(n.data)
 
     async def _handle_delete(self, request: web.Request) -> web.Response:
-        fid, _ = self._parse_fid_path(request.path)
+        fid, _, _ = self._parse_fid_path(request.path)
         vid = fid.volume_id
         is_replicate = request.query.get("type") == "replicate"
 

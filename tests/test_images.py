@@ -1,0 +1,212 @@
+"""Image subsystem tests (ref: weed/images/orientation_test.go and
+resize semantics of weed/images/resizing.go:18-56)."""
+
+import asyncio
+import io
+
+import numpy as np
+import pytest
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image
+
+from seaweedfs_tpu import images
+
+
+def make_png(w, h, color=(200, 30, 30)):
+    buf = io.BytesIO()
+    Image.new("RGB", (w, h), color).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def make_jpeg(w, h, orientation=None):
+    img = Image.new("RGB", (w, h), (10, 120, 240))
+    buf = io.BytesIO()
+    if orientation is not None:
+        exif = Image.Exif()
+        exif[0x0112] = orientation
+        img.save(buf, format="JPEG", exif=exif)
+    else:
+        img.save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def dims(data):
+    return Image.open(io.BytesIO(data)).size
+
+
+def test_resized_noop_when_no_dims():
+    data = make_png(100, 50)
+    out, w, h = images.resized(".png", data, 0, 0)
+    assert out == data and (w, h) == (0, 0)
+
+
+def test_resized_no_upscale():
+    # source already fits the requested box -> unchanged bytes, src dims
+    data = make_png(40, 30)
+    out, w, h = images.resized(".png", data, 100, 100)
+    assert out == data and (w, h) == (40, 30)
+
+
+def test_resized_default_aspect_preserving():
+    data = make_png(200, 100)
+    out, w, h = images.resized(".png", data, 50, 0)
+    assert (w, h) == (50, 25)
+    assert dims(out) == (50, 25)
+
+
+def test_resized_square_thumbnail():
+    # width == height on a non-square source -> center-cropped square
+    data = make_png(200, 100)
+    out, w, h = images.resized(".png", data, 64, 64)
+    assert (w, h) == (64, 64)
+    assert dims(out) == (64, 64)
+
+
+def test_resized_fit_mode():
+    data = make_png(200, 100)
+    out, w, h = images.resized(".png", data, 64, 64, "fit")
+    assert (w, h) == (64, 32)
+
+
+def test_resized_fill_mode():
+    data = make_png(200, 100)
+    out, w, h = images.resized(".png", data, 64, 32, "fill")
+    assert (w, h) == (64, 32)
+
+
+def test_resized_bad_bytes_passthrough():
+    out, w, h = images.resized(".png", b"not an image", 10, 10)
+    assert out == b"not an image" and (w, h) == (0, 0)
+
+
+def test_fix_jpg_orientation_rotates():
+    data = make_jpeg(80, 40, orientation=6)  # 90-degree CW stored
+    fixed = images.fix_jpg_orientation(data)
+    assert dims(fixed) == (40, 80)
+    # orientation 1 / no exif -> unchanged bytes
+    plain = make_jpeg(80, 40)
+    assert images.fix_jpg_orientation(plain) == plain
+    assert images.fix_jpg_orientation(b"junk") == b"junk"
+
+
+def test_maybe_preprocess_image():
+    data = make_jpeg(120, 60, orientation=3)
+    out, w, h = images.maybe_preprocess_image("photo.jpg", data, 60, 0)
+    assert (w, h) == (60, 30)
+    raw, w, h = images.maybe_preprocess_image("file.bin", b"xyz", 10, 10)
+    assert raw == b"xyz" and (w, h) == (0, 0)
+
+
+def test_should_resize_parsing():
+    w, h, mode, ok = images.should_resize(".jpg", {"width": "32", "mode": "fit"})
+    assert (w, h, mode, ok) == (32, 0, "fit", True)
+    w, h, mode, ok = images.should_resize(".bin", {"width": "32"})
+    assert not ok
+    w, h, mode, ok = images.should_resize(".png", {"width": "oops"})
+    assert not ok
+
+
+def test_resize_batch_jax_matches_shapes():
+    batch = np.random.randint(0, 255, size=(4, 32, 48, 3), dtype=np.uint8)
+    out = np.asarray(images.resize_batch(batch, 16, 24))
+    assert out.shape == (4, 16, 24, 3)
+    assert out.dtype == np.uint8
+    # constant image stays constant under linear resampling
+    const = np.full((2, 32, 32, 3), 77, dtype=np.uint8)
+    out2 = np.asarray(images.resize_batch(const, 8, 8))
+    assert np.all(out2 == 77)
+
+
+def test_volume_server_resizes_on_read(tmp_path):
+    from test_cluster import Cluster
+
+    async def body():
+        import aiohttp
+
+        from seaweedfs_tpu.client import assign
+        from seaweedfs_tpu.client.operation import lookup, upload_data
+
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                ar = await assign(cluster.master.address)
+                data = make_png(100, 80)
+                await upload_data(
+                    session, ar.url, ar.fid, data, filename="pic.png"
+                )
+                locs = await lookup(
+                    cluster.master.address, int(ar.fid.split(",")[0])
+                )
+                url = f"http://{locs[0]}/{ar.fid}.png?width=50"
+                async with session.get(url) as resp:
+                    assert resp.status == 200
+                    body_bytes = await resp.read()
+                assert dims(body_bytes) == (50, 40)
+                # range request on the unresized object
+                async with session.get(
+                    f"http://{locs[0]}/{ar.fid}.png",
+                    headers={"Range": "bytes=0-7"},
+                ) as resp:
+                    assert resp.status == 206
+                    assert await resp.read() == data[:8]
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_parse_range():
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    pr = VolumeServer._parse_range
+    assert pr("bytes=0-7", 100) == (0, 7)
+    assert pr("bytes=90-", 100) == (90, 99)
+    assert pr("bytes=-10", 100) == (90, 99)
+    assert pr("bytes=0-500", 100) == (0, 99)
+    assert pr("bytes=200-300", 100) == "invalid-range"
+    # malformed headers are ignored -> full 200 response
+    assert pr("bytes=abc-def", 100) is None
+    assert pr("bytes=-", 100) is None
+    assert pr("bytes=5-2", 100) is None
+    assert pr("bytes=0--5", 100) is None
+    assert pr("bytes=0-7,9-10", 100) is None
+    assert pr("chars=0-7", 100) is None
+
+
+def test_vid_slash_fid_url_form(tmp_path):
+    from test_cluster import Cluster
+
+    async def body():
+        import aiohttp
+
+        from seaweedfs_tpu.client import assign
+        from seaweedfs_tpu.client.operation import upload_data
+
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                ar = await assign(cluster.master.address)
+                await upload_data(session, ar.url, ar.fid, b"hello world")
+                vid, nid = ar.fid.split(",")
+                # /vid/fid and /vid/fid/filename forms (ref needle.ParsePath)
+                for path in (f"/{vid}/{nid}", f"/{vid}/{nid}/name.txt"):
+                    async with session.get(f"http://{ar.url}{path}") as resp:
+                        assert resp.status == 200, path
+                        assert await resp.read() == b"hello world"
+                # unparsable fid -> 400, not 500
+                async with session.get(f"http://{ar.url}/notafid") as resp:
+                    assert resp.status in (400, 404)
+                # stale If-Range -> full 200 body despite Range header
+                async with session.get(
+                    f"http://{ar.url}/{ar.fid}",
+                    headers={"Range": "bytes=0-3", "If-Range": '"deadbeef"'},
+                ) as resp:
+                    assert resp.status == 200
+                    assert await resp.read() == b"hello world"
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
