@@ -35,6 +35,12 @@ def _add_volume_flags(p: argparse.ArgumentParser) -> None:
         choices=["cpu", "tpu"],
         help="erasure-coding compute backend",
     )
+    p.add_argument(
+        "-index",
+        default="memory",
+        choices=["memory", "leveldb", "sorted"],
+        help="needle map kind (ref NeedleMapKind, weed/storage/needle_map.go:14)",
+    )
 
 
 def _build_volume_server(args, port_offset: int = 0):
@@ -51,6 +57,7 @@ def _build_volume_server(args, port_offset: int = 0):
         port=args.port + port_offset,
         public_url=args.publicUrl,
         max_volume_counts=maxes,
+        needle_map_kind=getattr(args, "index", "memory"),
         data_center=args.dataCenter,
         rack=args.rack,
         codec_backend=args.storageBackend,

@@ -154,7 +154,7 @@ def resize_batch(batch, out_h: int, out_w: int, method: str = "linear"):
     import jax.numpy as jnp
 
     key = (out_h, out_w, method, batch.shape[1:], str(batch.dtype))
-    fn = _resize_cache.get(key)
+    fn = _resize_cache.pop(key, None)
     if fn is None:
 
         def _impl(x):
@@ -165,5 +165,9 @@ def resize_batch(batch, out_h: int, out_w: int, method: str = "linear"):
             return jnp.clip(jnp.round(y), 0, 255).astype(jnp.uint8)
 
         fn = jax.jit(_impl)
-        _resize_cache[key] = fn
+    # LRU-bounded: each entry pins a compiled program, and heterogeneous
+    # source geometries would otherwise grow this for the process lifetime
+    _resize_cache[key] = fn
+    while len(_resize_cache) > 32:
+        _resize_cache.pop(next(iter(_resize_cache)))
     return fn(batch)

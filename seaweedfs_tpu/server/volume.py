@@ -49,6 +49,7 @@ class VolumeServer(EcHandlers):
         rack: str = "",
         codec_backend: str = "cpu",
         jwt_signing_key: str = "",
+        needle_map_kind: str = "memory",
     ):
         self.jwt_signing_key = jwt_signing_key
         self.master = master
@@ -66,6 +67,7 @@ class VolumeServer(EcHandlers):
             self.public_url,
             directories,
             max_volume_counts or [7] * len(directories),
+            needle_map_kind=needle_map_kind,
         )
         self.store.load()
         self._http_runner: Optional[web.AppRunner] = None
@@ -325,7 +327,13 @@ class VolumeServer(EcHandlers):
             return web.Response(status=200, headers=headers)
 
         # single-range requests (ref writeResponseContent / http.ServeContent);
-        # an unparsable Range header is ignored per RFC 9110
+        # an unparsable Range header is ignored per RFC 9110. Never slice the
+        # gzip representation: the ETag is shared with the identity variant,
+        # so a ranged gzip body could be spliced into an identity download.
+        if headers.get("Content-Encoding"):
+            return web.Response(
+                body=body, content_type=content_type, headers=headers
+            )
         if_range = request.headers.get("If-Range", "")
         if if_range and if_range != headers["Etag"]:
             return web.Response(

@@ -24,10 +24,16 @@ def parse_volume_file_name(name: str) -> Optional[tuple[str, int]]:
 
 
 class DiskLocation:
-    def __init__(self, directory: str, max_volume_count: int = 7):
+    def __init__(
+        self,
+        directory: str,
+        max_volume_count: int = 7,
+        needle_map_kind: str = "memory",
+    ):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.max_volume_count = max_volume_count
+        self.needle_map_kind = needle_map_kind
         self.volumes: Dict[int, Volume] = {}
         self.ec_volumes: Dict[int, EcVolume] = {}
         self._lock = threading.RLock()
@@ -44,7 +50,13 @@ class DiskLocation:
                 if vid in self.volumes:
                     continue
                 try:
-                    v = Volume(self.directory, collection, vid, create=False)
+                    v = Volume(
+                        self.directory,
+                        collection,
+                        vid,
+                        create=False,
+                        needle_map_kind=self.needle_map_kind,
+                    )
                 except Exception:
                     continue
                 self.volumes[vid] = v

@@ -22,12 +22,15 @@ class Store:
         public_url: str,
         directories: list[str],
         max_volume_counts: list[int],
+        needle_map_kind: str = "memory",
     ):
         self.ip = ip
         self.port = port
         self.public_url = public_url
+        self.needle_map_kind = needle_map_kind
         self.locations = [
-            DiskLocation(d, m) for d, m in zip(directories, max_volume_counts)
+            DiskLocation(d, m, needle_map_kind=needle_map_kind)
+            for d, m in zip(directories, max_volume_counts)
         ]
         self.volume_size_limit = 0  # set by master heartbeat response
         self._lock = threading.RLock()
@@ -77,6 +80,7 @@ class Store:
             vid,
             replica_placement=ReplicaPlacement.parse(replication),
             ttl=TTL.read(ttl_string),
+            needle_map_kind=self.needle_map_kind,
         )
         location.add_volume(v)
         with self._lock:

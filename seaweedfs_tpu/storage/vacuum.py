@@ -103,7 +103,13 @@ def commit_compact(v: Volume) -> Volume:
             raise
         os.rename(base + ".cpd", base + ".dat")
         os.rename(base + ".cpx", base + ".idx")
-    return Volume(v.dir, v.collection, v.id, create=False)
+    return Volume(
+        v.dir,
+        v.collection,
+        v.id,
+        create=False,
+        needle_map_kind=getattr(v, "needle_map_kind", "memory"),
+    )
 
 
 def cleanup_compact(v: Volume) -> None:

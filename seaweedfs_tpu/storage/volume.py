@@ -102,6 +102,7 @@ class Volume:
         replica_placement=None,
         ttl=None,
         create: bool = True,
+        needle_map_kind: str = "memory",
     ):
         self.dir = directory
         self.collection = collection
@@ -131,6 +132,7 @@ class Volume:
             )
             self.data_backend.write_at(self.super_block.to_bytes(), 0)
 
+        self.needle_map_kind = needle_map_kind
         self.nm: NeedleMap
         if os.path.exists(base + ".idx") and dat_exists:
             try:
@@ -139,9 +141,36 @@ class Volume:
                 )
             except Exception:
                 self.no_write_or_delete = True
-            self.nm = load_needle_map(base + ".idx")
+            self.nm = self._open_needle_map(base, needle_map_kind)
+            if needle_map_kind == "sorted":
+                # sorted-file maps can't Put; the reference only uses them
+                # on read-only volume loads (ref volume_loading.go:68-95)
+                self.no_write_or_delete = True
         else:
-            self.nm = new_needle_map(base + ".idx")
+            if needle_map_kind == "leveldb":
+                from .needle_map.disk_maps import SqliteNeedleMap
+
+                if os.path.exists(base + ".idx"):
+                    os.truncate(base + ".idx", 0)
+                self.nm = SqliteNeedleMap(base + ".idx")
+            else:
+                # "sorted" can't index a fresh writable volume; fall back
+                # to the in-memory map until a read-only reload
+                self.nm = new_needle_map(base + ".idx")
+
+    @staticmethod
+    def _open_needle_map(base: str, kind: str):
+        """Mapper selection (ref NeedleMapKind, weed/storage/needle_map.go:14-19):
+        memory=CompactMap replay, leveldb=disk B-tree, sorted=read-only .sdx."""
+        if kind == "leveldb":
+            from .needle_map.disk_maps import SqliteNeedleMap
+
+            return SqliteNeedleMap(base + ".idx")
+        if kind == "sorted":
+            from .needle_map.disk_maps import SortedFileNeedleMap
+
+            return SortedFileNeedleMap(base + ".idx")
+        return load_needle_map(base + ".idx")
 
     # --- basic accessors ---
     def file_name(self) -> str:

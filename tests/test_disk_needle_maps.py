@@ -1,0 +1,213 @@
+"""Disk-backed needle maps (ref: weed/storage/needle_map_leveldb.go,
+needle_map_sorted_file.go): same observable behavior as the in-memory map."""
+
+import os
+
+import pytest
+
+from seaweedfs_tpu.storage.idx import entry_to_bytes
+from seaweedfs_tpu.storage.needle_map.disk_maps import (
+    SortedFileNeedleMap,
+    SqliteNeedleMap,
+    metric_from_index_file,
+)
+from seaweedfs_tpu.storage.needle_map.mapper import load_needle_map
+from seaweedfs_tpu.types import TOMBSTONE_FILE_SIZE
+
+
+def write_idx(path, entries):
+    with open(path, "wb") as f:
+        for key, off, size in entries:
+            f.write(entry_to_bytes(key, off, size))
+
+
+ENTRIES = [(1, 8, 100), (5, 16, 200), (3, 24, 300), (9, 32, 400)]
+
+
+@pytest.fixture(params=["memory", "leveldb", "sorted"])
+def any_map(request, tmp_path):
+    idx = str(tmp_path / "1.idx")
+    write_idx(idx, ENTRIES)
+    if request.param == "memory":
+        m = load_needle_map(idx)
+    elif request.param == "leveldb":
+        m = SqliteNeedleMap(idx)
+    else:
+        m = SortedFileNeedleMap(idx)
+    yield request.param, m
+    m.close()
+
+
+def test_get_existing_and_missing(any_map):
+    kind, m = any_map
+    nv = m.get(5)
+    assert nv is not None and (nv.offset_units, nv.size) == (16, 200)
+    assert m.get(4) is None
+    assert m.get(9).size == 400
+
+
+def test_metrics_replayed(any_map):
+    kind, m = any_map
+    assert m.file_count == 4
+    assert m.max_file_key == 9
+    assert m.content_size == 1000
+
+
+def test_delete_tombstones(any_map):
+    kind, m = any_map
+    m.delete(3, 24)
+    # CompactMap surfaces the tombstone entry (callers check size);
+    # the disk maps drop the key entirely (ref LevelDbNeedleMap.Delete)
+    nv = m.get(3)
+    assert nv is None or nv.size == TOMBSTONE_FILE_SIZE
+    assert m.deleted_count >= 1
+    assert m.deleted_size == 300
+    # idx log grew by one tombstone entry
+    assert m.index_file_size() == 16 * (len(ENTRIES) + 1)
+
+
+def test_ascending_visit_sorted_order(any_map):
+    kind, m = any_map
+    keys = []
+    m.ascending_visit(lambda nv: keys.append(nv.key))
+    live = [k for k in keys]
+    assert [k for k in live if k in (1, 3, 5, 9)] == sorted(
+        k for k in live if k in (1, 3, 5, 9)
+    )
+
+
+def test_snapshot_columns(any_map):
+    kind, m = any_map
+    keys, offs, sizes = m.snapshot()
+    assert list(keys) == [1, 3, 5, 9]
+    assert list(sizes) == [100, 300, 200, 400]
+
+
+def test_sqlite_put_and_reload(tmp_path):
+    idx = str(tmp_path / "1.idx")
+    write_idx(idx, ENTRIES)
+    m = SqliteNeedleMap(idx)
+    m.put(20, 40, 500)
+    assert m.get(20).size == 500
+    m.close()
+    # reopen: db is fresh, entries survive
+    m2 = SqliteNeedleMap(idx)
+    assert m2.get(20).size == 500
+    assert m2.file_count == 5
+    m2.close()
+
+
+def test_sqlite_regenerates_from_idx(tmp_path):
+    idx = str(tmp_path / "1.idx")
+    write_idx(idx, ENTRIES)
+    m = SqliteNeedleMap(idx)
+    m.close()
+    # idx mutated behind the db's back -> stale db must be regenerated
+    write_idx(idx, ENTRIES + [(7, 48, 700)])
+    os.utime(idx)
+    m2 = SqliteNeedleMap(idx)
+    assert m2.get(7).size == 700
+    m2.close()
+
+
+def test_sorted_map_put_rejected(tmp_path):
+    idx = str(tmp_path / "1.idx")
+    write_idx(idx, ENTRIES)
+    m = SortedFileNeedleMap(idx)
+    with pytest.raises(OSError):
+        m.put(2, 8, 10)
+    m.close()
+
+
+def test_sorted_map_delete_persists(tmp_path):
+    idx = str(tmp_path / "1.idx")
+    write_idx(idx, ENTRIES)
+    m = SortedFileNeedleMap(idx)
+    m.delete(5, 16)
+    assert m.get(5) is None
+    m.close()
+    # tombstone wrote through to the .sdx AND the .idx log
+    m2 = SortedFileNeedleMap(idx)
+    assert m2.get(5) is None
+    assert m2.get(1) is not None
+    m2.close()
+
+
+def test_metric_from_index_file_overwrite_and_delete(tmp_path):
+    idx = str(tmp_path / "m.idx")
+    write_idx(
+        idx,
+        [(1, 8, 100), (1, 16, 150), (2, 24, 50), (2, 24, TOMBSTONE_FILE_SIZE)],
+    )
+    m = metric_from_index_file(idx)
+    # ref mapMetric.logPut: every put counts; an overwrite also counts a
+    # deletion of the old size (100), plus the explicit delete (50)
+    assert m.file_count == 3
+    assert m.deletion_count == 2
+    assert m.deleted_size == 150
+    assert m.maximum_file_key == 2
+
+
+def test_volume_with_disk_map_kinds(tmp_path):
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(str(tmp_path), "", 7)
+    payloads = {}
+    for i in range(1, 6):
+        n = Needle(cookie=0x11, id=i, data=b"x" * (10 * i))
+        v.write_needle(n)
+        payloads[i] = bytes(n.data)
+    v.close()
+
+    for kind in ("leveldb", "sorted"):
+        v2 = Volume(str(tmp_path), "", 7, create=False, needle_map_kind=kind)
+        for i, data in payloads.items():
+            n = Needle(id=i)
+            v2.read_needle(n)
+            assert bytes(n.data) == data, kind
+        v2.close()
+
+
+def test_sqlite_map_cross_thread_access(tmp_path):
+    import concurrent.futures
+
+    idx = str(tmp_path / "t.idx")
+    write_idx(idx, ENTRIES)
+    m = SqliteNeedleMap(idx)
+    with concurrent.futures.ThreadPoolExecutor(4) as ex:
+        futures = [
+            ex.submit(m.put, 100 + i, 8 * (i + 10), 50 + i) for i in range(40)
+        ]
+        futures += [ex.submit(m.get, 5) for _ in range(20)]
+        for f in futures:
+            f.result()  # raises on sqlite thread errors
+    assert m.get(120).size == 70
+    m.close()
+
+
+def test_fresh_volume_honors_leveldb_kind(tmp_path):
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.needle_map.disk_maps import SqliteNeedleMap
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(str(tmp_path), "", 11, needle_map_kind="leveldb")
+    assert isinstance(v.nm, SqliteNeedleMap)
+    n = Needle(cookie=1, id=42, data=b"fresh")
+    v.write_needle(n)
+    r = Needle(id=42)
+    v.read_needle(r)
+    assert bytes(r.data) == b"fresh"
+    v.close()
+
+
+def test_sorted_kind_marks_volume_readonly(tmp_path):
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(str(tmp_path), "", 12)
+    v.write_needle(Needle(cookie=1, id=1, data=b"a"))
+    v.close()
+    v2 = Volume(str(tmp_path), "", 12, create=False, needle_map_kind="sorted")
+    assert v2.no_write_or_delete
+    v2.close()
