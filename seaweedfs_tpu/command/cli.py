@@ -36,6 +36,12 @@ def _add_volume_flags(p: argparse.ArgumentParser) -> None:
         help="erasure-coding compute backend",
     )
     p.add_argument(
+        "-tierConfig",
+        default="",
+        help="JSON file configuring storage.backend tiers"
+        " (ref backend.go LoadConfiguration)",
+    )
+    p.add_argument(
         "-index",
         default="memory",
         choices=["memory", "leveldb", "sorted"],
@@ -45,6 +51,15 @@ def _add_volume_flags(p: argparse.ArgumentParser) -> None:
 
 def _build_volume_server(args, port_offset: int = 0):
     from ..server.volume import VolumeServer
+
+    tier_cfg = getattr(args, "tierConfig", "")
+    if tier_cfg:
+        import json
+
+        from ..storage.tier_backend import load_from_config
+
+        with open(tier_cfg) as f:
+            load_from_config(json.load(f))
 
     dirs = args.dir.split(",")
     maxes = [int(m) for m in args.max.split(",")]
@@ -113,9 +128,19 @@ def cmd_server(argv: list[str]) -> int:
     p.add_argument("-dataCenter", default="")
     p.add_argument("-rack", default="")
     p.add_argument("-storageBackend", default="cpu", choices=["cpu", "tpu"])
+    p.add_argument("-tierConfig", default="")
+    p.add_argument("-index", default="memory", choices=["memory", "leveldb", "sorted"])
     args = p.parse_args(argv)
     from ..server.master import MasterServer
     from ..server.volume import VolumeServer
+
+    if args.tierConfig:
+        import json
+
+        from ..storage.tier_backend import load_from_config
+
+        with open(args.tierConfig) as f:
+            load_from_config(json.load(f))
 
     ms = MasterServer(
         host=args.ip,
@@ -132,6 +157,7 @@ def cmd_server(argv: list[str]) -> int:
         data_center=args.dataCenter,
         rack=args.rack,
         codec_backend=args.storageBackend,
+        needle_map_kind=args.index,
     )
     print(
         f"server: master on {args.ip}:{args.port}, volume on "

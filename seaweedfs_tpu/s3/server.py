@@ -214,16 +214,48 @@ class S3Server:
         }
         if request.method == "HEAD":
             return web.Response(status=200, headers=headers)
+        from ..util.http_range import parse_range
+
         visibles = non_overlapping_visible_intervals(entry.chunks)
-        blobs = {}
-        for v in visibles:
-            if v.fid not in blobs:
-                blobs[v.fid] = await self.fs._fetch_chunk(v.fid)
-        body = read_from_visible_intervals(visibles, blobs.__getitem__, 0, size)
+        content_type = entry.attr.mime or "application/octet-stream"
+
+        # ranged GetObject (S3 supports RFC 9110 single ranges): parse the
+        # range FIRST and fetch only the chunks it covers
+        span = parse_range(request.headers.get("Range", ""), size)
+        if span == "invalid-range":
+            return web.Response(
+                status=416, headers={"Content-Range": f"bytes */{size}"}
+            )
+        if span is not None:
+            start, end = span
+            body = await self._read_span(visibles, start, end - start + 1)
+            return web.Response(
+                status=206,
+                body=body,
+                content_type=content_type,
+                headers={
+                    "ETag": headers["ETag"],
+                    "Content-Range": f"bytes {start}-{end}/{size}",
+                    "Accept-Ranges": "bytes",
+                },
+            )
+        body = await self._read_span(visibles, 0, size)
         return web.Response(
             body=body,
-            content_type=entry.attr.mime or "application/octet-stream",
-            headers={"ETag": headers["ETag"]},
+            content_type=content_type,
+            headers={"ETag": headers["ETag"], "Accept-Ranges": "bytes"},
+        )
+
+    async def _read_span(self, visibles, offset: int, length: int) -> bytes:
+        """Fetch exactly the chunks overlapping [offset, offset+length)."""
+        from ..filer.filechunks import view_from_visibles
+
+        blobs = {}
+        for view in view_from_visibles(visibles, offset, length):
+            if view.fid not in blobs:
+                blobs[view.fid] = await self.fs._fetch_chunk(view.fid)
+        return read_from_visible_intervals(
+            visibles, blobs.__getitem__, offset, length
         )
 
     async def _delete_object(self, bucket: str, key: str) -> web.Response:

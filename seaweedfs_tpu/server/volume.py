@@ -125,6 +125,10 @@ class VolumeServer(EcHandlers):
         svc.server_stream("VolumeIncrementalCopy")(self._grpc_incremental_copy)
         svc.unary("VolumeSyncStatus")(self._grpc_sync_status)
         svc.server_stream("Query")(self._grpc_query)
+        svc.server_stream("VolumeTierMoveDatToRemote")(self._grpc_tier_to_remote)
+        svc.server_stream("VolumeTierMoveDatFromRemote")(
+            self._grpc_tier_from_remote
+        )
         self.register_ec_rpcs(svc)
         self._grpc_server = await serve(grpc_address(self.address), svc)
 
@@ -266,7 +270,15 @@ class VolumeServer(EcHandlers):
 
         if self.store.has_volume(vid):
             n = Needle(id=fid.key)
-            self.store.read_volume_needle(vid, n)
+            v = self.store.find_volume(vid)
+            if v is not None and v.has_remote_file:
+                # tiered volume: the backend does blocking remote I/O —
+                # keep it off the event loop
+                await asyncio.get_event_loop().run_in_executor(
+                    None, self.store.read_volume_needle, vid, n
+                )
+            else:
+                self.store.read_volume_needle(vid, n)
             if n.cookie != fid.cookie:
                 return web.json_response({"error": "cookie mismatch"}, status=404)
             return self._needle_response(request, n, ext)
@@ -359,27 +371,9 @@ class VolumeServer(EcHandlers):
     @staticmethod
     def _parse_range(rng: str, total: int):
         """-> (start, end) | None (serve full body) | "invalid-range" (416)."""
-        if not rng.startswith("bytes=") or "," in rng:
-            return None
-        start_s, sep, end_s = rng[len("bytes="):].strip().partition("-")
-        if not sep:
-            return None
-        try:
-            if start_s == "":
-                if end_s == "":
-                    return None
-                start, end = max(0, total - int(end_s)), total - 1
-            else:
-                start = int(start_s)
-                end = int(end_s) if end_s else total - 1
-        except ValueError:
-            return None
-        if start < 0 or end < start:
-            # syntactically invalid byte-range-spec: ignore (RFC 9110 14.1.1)
-            return None
-        if start >= total:
-            return "invalid-range"
-        return min(start, total - 1), min(end, total - 1)
+        from ..util.http_range import parse_range
+
+        return parse_range(rng, total)
 
     # ---------------- write (ref volume_server_handlers_write.go) ----------------
     async def _parse_upload(self, request: web.Request) -> tuple[bytes, str, str]:
@@ -702,6 +696,92 @@ class VolumeServer(EcHandlers):
             return {}
         except Exception as e:
             return {"error": str(e)}
+
+    async def _grpc_tier_to_remote(self, req, context):
+        """Move a volume's .dat to a remote tier, streaming progress
+        (ref volume_grpc_tier_upload.go VolumeTierMoveDatToRemote)."""
+        from ..storage import tier_backend
+
+        vid = int(req["volume_id"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            yield {"error": f"volume {vid} not found"}
+            return
+        if req.get("collection", "") != v.collection:
+            yield {"error": f"existing collection '{v.collection}' unexpected"}
+            return
+        try:
+            async for msg in self._run_tier_op(
+                lambda fn: tier_backend.tier_upload(
+                    v,
+                    req["destination_backend_name"],
+                    fn,
+                    keep_local=bool(req.get("keep_local_dat_file")),
+                )
+            ):
+                if "result" in msg:
+                    key, size = msg["result"]
+                    yield {"key": key, "size": size}
+                else:
+                    yield msg
+        except (ValueError, OSError) as e:
+            yield {"error": str(e)}
+
+    async def _grpc_tier_from_remote(self, req, context):
+        """Bring a tiered volume's .dat back local
+        (ref volume_grpc_tier_download.go VolumeTierMoveDatFromRemote)."""
+        from ..storage import tier_backend
+
+        vid = int(req["volume_id"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            yield {"error": f"volume {vid} not found"}
+            return
+        try:
+            async for msg in self._run_tier_op(
+                lambda fn: tier_backend.tier_download(v, fn)
+            ):
+                if "result" in msg:
+                    yield {"size": msg["result"]}
+                else:
+                    yield msg
+        except (ValueError, OSError) as e:
+            yield {"error": str(e)}
+
+    async def _run_tier_op(self, op):
+        """Run a blocking tier transfer in an executor, streaming throttled
+        progress messages as they happen (ref the 1s-throttled stream.Send
+        in volume_grpc_tier_upload.go:53-64). Yields {"processed":..,
+        "processedPercentage":..} then {"result": <op return value>}."""
+        import time as _time
+
+        loop = asyncio.get_event_loop()
+        queue: asyncio.Queue = asyncio.Queue()
+        last_sent = [0.0]
+
+        def progress(done: int, pct: float) -> None:
+            now = _time.monotonic()
+            if now - last_sent[0] < 1.0:
+                return
+            last_sent[0] = now
+            loop.call_soon_threadsafe(
+                queue.put_nowait, {"processed": done, "processedPercentage": pct}
+            )
+
+        fut = loop.run_in_executor(None, op, progress)
+        while True:
+            done_task = asyncio.ensure_future(queue.get())
+            await asyncio.wait(
+                {done_task, fut}, return_when=asyncio.FIRST_COMPLETED
+            )
+            if done_task.done():
+                yield done_task.result()
+                continue
+            done_task.cancel()
+            break
+        while not queue.empty():
+            yield queue.get_nowait()
+        yield {"result": await fut}
 
     async def _grpc_copy_file(self, req, context):
         """Stream a volume file's bytes (ref volume_grpc_copy.go doCopyFile).

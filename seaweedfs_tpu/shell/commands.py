@@ -188,6 +188,59 @@ async def cmd_volume_move(env, argv) -> str:
     return f"volume {vid} moved {source} -> {target}"
 
 
+@command("volume.tier.upload")
+async def cmd_volume_tier_upload(env, argv) -> str:
+    """Move a volume's .dat to a remote tier
+    (ref command_volume_tier_upload.go): volume.tier.upload
+    -volumeId N -dest s3.default [-collection c] [-keepLocalDatFile]."""
+    env.confirm_is_locked()
+    flags = _parse_flags(argv)
+    vid = int(flags["volumeId"])
+    dest = flags.get("dest", "")
+    collection = flags.get("collection", "")
+    out = []
+    for dn in await env.collect_data_nodes():
+        if any(int(v["id"]) == vid for v in dn.get("volumes", [])):
+            async for msg in env.volume_stub(dn["url"]).server_stream(
+                "VolumeTierMoveDatToRemote",
+                {
+                    "volume_id": vid,
+                    "collection": collection,
+                    "destination_backend_name": dest,
+                    "keep_local_dat_file": "keepLocalDatFile" in flags,
+                },
+                timeout=600,
+            ):
+                if msg.get("error"):
+                    return f"tier upload failed: {msg['error']}"
+                if msg.get("key"):
+                    out.append(
+                        f"volume {vid} tiered to {dest} as {msg['key']}"
+                        f" ({msg.get('size', 0)} bytes)"
+                    )
+    return "\n".join(out) or f"volume {vid} not found"
+
+
+@command("volume.tier.download")
+async def cmd_volume_tier_download(env, argv) -> str:
+    """Bring a tiered volume's .dat back to local disk
+    (ref command_volume_tier_download.go): volume.tier.download -volumeId N."""
+    env.confirm_is_locked()
+    flags = _parse_flags(argv)
+    vid = int(flags["volumeId"])
+    out = []
+    for dn in await env.collect_data_nodes():
+        if any(int(v["id"]) == vid for v in dn.get("volumes", [])):
+            async for msg in env.volume_stub(dn["url"]).server_stream(
+                "VolumeTierMoveDatFromRemote", {"volume_id": vid}, timeout=600
+            ):
+                if msg.get("error"):
+                    return f"tier download failed: {msg['error']}"
+                if msg.get("size"):
+                    out.append(f"volume {vid} downloaded ({msg['size']} bytes)")
+    return "\n".join(out) or f"volume {vid} not found"
+
+
 @command("volume.vacuum")
 async def cmd_volume_vacuum(env, argv) -> str:
     flags = _parse_flags(argv)

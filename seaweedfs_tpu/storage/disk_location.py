@@ -13,6 +13,7 @@ from .erasure_coding.ec_volume import EcVolume, EcVolumeShard
 from .volume import Volume
 
 _DAT_RE = re.compile(r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.dat$")
+_VIF_RE = re.compile(r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.vif$")
 _EC_RE = re.compile(r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.ec(?P<shard>[0-9][0-9])$")
 
 
@@ -39,13 +40,25 @@ class DiskLocation:
         self._lock = threading.RLock()
 
     # --- normal volumes ---
+    def _discover_volume_names(self) -> list[tuple[str, int]]:
+        """Candidate (collection, vid) pairs: .dat files plus .vif sidecars —
+        a tiered volume has no local .dat (ref volume_tier.go), only
+        .idx + .vif naming the remote copy."""
+        found: list[tuple[str, int]] = []
+        seen: set[tuple[str, int]] = set()
+        for name in sorted(os.listdir(self.directory)):
+            m = _DAT_RE.match(name) or _VIF_RE.match(name)
+            if m is None:
+                continue
+            parsed = (m.group("collection") or "", int(m.group("vid")))
+            if parsed not in seen:
+                seen.add(parsed)
+                found.append(parsed)
+        return found
+
     def load_existing_volumes(self) -> int:
         count = 0
-        for name in sorted(os.listdir(self.directory)):
-            parsed = parse_volume_file_name(name)
-            if parsed is None:
-                continue
-            collection, vid = parsed
+        for collection, vid in self._discover_volume_names():
             with self._lock:
                 if vid in self.volumes:
                     continue
@@ -57,6 +70,8 @@ class DiskLocation:
                         create=False,
                         needle_map_kind=self.needle_map_kind,
                     )
+                except FileNotFoundError:
+                    continue
                 except Exception:
                     continue
                 self.volumes[vid] = v

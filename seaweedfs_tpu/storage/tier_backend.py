@@ -1,0 +1,358 @@
+"""Tiered (cloud) storage backends: move warm volume .dat files off local
+disk and serve reads through the remote store.
+
+Mirrors the reference's backend registry + S3 tiering
+(ref: weed/storage/backend/backend.go:25-101,
+weed/storage/backend/s3_backend/s3_backend.go): a `BackendStorage`
+produces read-only `BackendStorageFile`s addressed by key, and supports
+copy-in/download-out/delete with progress callbacks.
+
+Two backends ship:
+- "local": a directory standing in for a remote object store — the
+  fully-offline tier used in tests and single-host deployments.
+- "s3": any S3-compatible HTTP endpoint (including this framework's own
+  S3 gateway), via stdlib urllib so the synchronous volume read path can
+  call it without touching an event loop. Unsigned requests; for real
+  AWS put signing credentials in front (no egress in this environment).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Optional
+
+ProgressFn = Optional[Callable[[int, float], None]]
+
+_COPY_CHUNK = 1 << 20
+
+
+class BackendStorage:
+    storage_type = ""
+
+    def __init__(self, backend_id: str):
+        self.id = backend_id
+
+    @property
+    def name(self) -> str:
+        return f"{self.storage_type}.{self.id}"
+
+    def to_properties(self) -> dict:
+        raise NotImplementedError
+
+    def new_storage_file(self, key: str, tier_info=None):
+        raise NotImplementedError
+
+    def copy_file(self, path: str, attributes: dict, fn: ProgressFn = None):
+        """Upload a local file; returns (key, size)."""
+        raise NotImplementedError
+
+    def download_file(self, file_name: str, key: str, fn: ProgressFn = None) -> int:
+        raise NotImplementedError
+
+    def delete_file(self, key: str) -> None:
+        raise NotImplementedError
+
+
+def _progress_copy(src, dst, total: int, fn: ProgressFn) -> int:
+    done = 0
+    while True:
+        chunk = src.read(_COPY_CHUNK)
+        if not chunk:
+            break
+        dst.write(chunk)
+        done += len(chunk)
+        if fn is not None:
+            fn(done, 100.0 * done / total if total else 100.0)
+    return done
+
+
+class LocalTierBackend(BackendStorage):
+    """Directory-backed 'remote' store (offline tier)."""
+
+    storage_type = "local"
+
+    def __init__(self, backend_id: str, directory: str):
+        super().__init__(backend_id)
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+
+    def to_properties(self) -> dict:
+        return {"directory": self.directory}
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.directory, key.lstrip("/"))
+
+    def new_storage_file(self, key: str, tier_info=None):
+        from .backend import DiskFile
+
+        return DiskFile(self._path(key), create=False, read_only=True)
+
+    def copy_file(self, path: str, attributes: dict, fn: ProgressFn = None):
+        key = _tier_key(attributes, path)
+        dest = self._path(key)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        total = os.path.getsize(path)
+        with open(path, "rb") as src, open(dest, "wb") as dst:
+            done = _progress_copy(src, dst, total, fn)
+        return key, done
+
+    def download_file(self, file_name: str, key: str, fn: ProgressFn = None) -> int:
+        src_path = self._path(key)
+        total = os.path.getsize(src_path)
+        with open(src_path, "rb") as src, open(file_name, "wb") as dst:
+            return _progress_copy(src, dst, total, fn)
+
+    def delete_file(self, key: str) -> None:
+        p = self._path(key)
+        if os.path.exists(p):
+            os.remove(p)
+
+
+class S3File:
+    """Read-only BackendStorageFile over S3 ranged GETs
+    (ref: s3_backend/s3_backend.go S3BackendStorageFile.ReadAt)."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        bucket: str,
+        key: str,
+        known_size: Optional[int] = None,
+    ):
+        self._url = f"{endpoint.rstrip('/')}/{bucket}/{key.lstrip('/')}"
+        self._size: Optional[int] = known_size
+
+    @property
+    def name(self) -> str:
+        return self._url
+
+    def read_at(self, size: int, offset: int) -> bytes:
+        req = urllib.request.Request(
+            self._url, headers={"Range": f"bytes={offset}-{offset + size - 1}"}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 416:
+                return b""
+            raise
+
+    def write_at(self, data: bytes, offset: int) -> int:
+        raise OSError("remote tier file is read-only")
+
+    def truncate(self, size: int) -> None:
+        raise OSError("remote tier file is read-only")
+
+    def sync(self) -> None:
+        pass
+
+    def size(self) -> int:
+        if self._size is None:
+            req = urllib.request.Request(self._url, method="HEAD")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                self._size = int(resp.headers.get("Content-Length", 0))
+        return self._size
+
+    def close(self) -> None:
+        pass
+
+
+class S3Backend(BackendStorage):
+    storage_type = "s3"
+
+    def __init__(self, backend_id: str, endpoint: str, bucket: str):
+        super().__init__(backend_id)
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+
+    def to_properties(self) -> dict:
+        return {"endpoint": self.endpoint, "bucket": self.bucket}
+
+    def _url(self, key: str) -> str:
+        return f"{self.endpoint}/{self.bucket}/{key.lstrip('/')}"
+
+    def new_storage_file(self, key: str, tier_info=None):
+        # the .vif records the remote file's size; using it avoids a
+        # blocking HEAD on every heartbeat size collection
+        known_size = None
+        if tier_info is not None and getattr(tier_info, "files", None):
+            known_size = tier_info.files[0].file_size or None
+        return S3File(self.endpoint, self.bucket, key, known_size)
+
+    def copy_file(self, path: str, attributes: dict, fn: ProgressFn = None):
+        key = _tier_key(attributes, path)
+        total = os.path.getsize(path)
+        with open(path, "rb") as f:
+            data = f.read()
+        req = urllib.request.Request(self._url(key), data=data, method="PUT")
+        with urllib.request.urlopen(req, timeout=300):
+            pass
+        if fn is not None:
+            fn(total, 100.0)
+        return key, total
+
+    def download_file(self, file_name: str, key: str, fn: ProgressFn = None) -> int:
+        req = urllib.request.Request(self._url(key))
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            total = int(resp.headers.get("Content-Length", 0))
+            with open(file_name, "wb") as dst:
+                return _progress_copy(resp, dst, total, fn)
+
+    def delete_file(self, key: str) -> None:
+        req = urllib.request.Request(self._url(key), method="DELETE")
+        try:
+            with urllib.request.urlopen(req, timeout=30):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+
+def _tier_key(attributes: dict, path: str) -> str:
+    vid = attributes.get("volumeId", "")
+    collection = attributes.get("collection", "")
+    ext = attributes.get("ext", os.path.splitext(path)[1])
+    prefix = f"{collection}_" if collection else ""
+    return f"{prefix}{vid}{ext}" if vid else os.path.basename(path)
+
+
+# ---------------------------------------------------------------------------
+# Registry (ref backend.go:42-101)
+# ---------------------------------------------------------------------------
+
+BACKEND_STORAGE_FACTORIES: dict[str, Callable[..., BackendStorage]] = {
+    "local": lambda bid, props: LocalTierBackend(bid, props["directory"]),
+    "s3": lambda bid, props: S3Backend(
+        bid, props.get("endpoint", ""), props.get("bucket", "")
+    ),
+}
+
+BACKEND_STORAGES: dict[str, BackendStorage] = {}
+
+
+def register_backend(storage: BackendStorage) -> None:
+    BACKEND_STORAGES[storage.name] = storage
+    if storage.id == "default":
+        BACKEND_STORAGES[storage.storage_type] = storage
+
+
+def load_from_config(config: dict) -> None:
+    """config mirrors the `storage.backend` toml section:
+    {"s3": {"default": {"enabled": True, "endpoint": ..., "bucket": ...}},
+     "local": {"default": {"enabled": True, "directory": ...}}}
+    (ref backend.go LoadConfiguration)."""
+    for backend_type, instances in (config or {}).items():
+        factory = BACKEND_STORAGE_FACTORIES.get(backend_type)
+        if factory is None:
+            continue
+        for backend_id, props in instances.items():
+            if not props.get("enabled", True):
+                continue
+            register_backend(factory(backend_id, props))
+
+
+def load_from_pb_storage_backends(storage_backends: list[dict]) -> None:
+    """Volume-server side: backends pushed in the master heartbeat response
+    (ref backend.go:77-95)."""
+    for sb in storage_backends or []:
+        factory = BACKEND_STORAGE_FACTORIES.get(sb.get("type", ""))
+        if factory is None:
+            continue
+        register_backend(factory(sb.get("id", "default"), sb.get("properties", {})))
+
+
+def backend_name_to_type_id(name: str) -> tuple[str, str]:
+    if "." in name:
+        t, _, i = name.partition(".")
+        return t, i
+    return name, "default"
+
+
+def get_backend(name: str) -> Optional[BackendStorage]:
+    return BACKEND_STORAGES.get(name)
+
+
+# ---------------------------------------------------------------------------
+# Volume tiering operations (ref volume_tier.go, volume_grpc_tier_upload.go)
+# ---------------------------------------------------------------------------
+
+
+def tier_upload(volume, dest_backend_name: str, fn: ProgressFn = None, keep_local: bool = False):
+    """Move a volume's .dat to a remote backend; rewrites the .vif so future
+    loads read through the tier (ref VolumeTierMoveDatToRemote)."""
+    from .volume_info import RemoteFile, VolumeInfo, save_volume_info
+
+    storage = get_backend(dest_backend_name)
+    if storage is None:
+        raise ValueError(
+            f"destination {dest_backend_name} not found,"
+            f" supported: {sorted(BACKEND_STORAGES)}"
+        )
+    backend_type, backend_id = backend_name_to_type_id(dest_backend_name)
+    info = volume.volume_info or VolumeInfo(version=volume.version)
+    for rf in info.files:
+        if rf.backend_type == backend_type and rf.backend_id == backend_id:
+            raise ValueError(f"destination {dest_backend_name} already exists")
+
+    dat_path = volume.file_name() + ".dat"
+    attributes = {
+        "volumeId": str(volume.id),
+        "collection": volume.collection,
+        "ext": ".dat",
+    }
+    key, size = storage.copy_file(dat_path, attributes, fn)
+    info.files.append(
+        RemoteFile(
+            backend_type=backend_type,
+            backend_id=backend_id,
+            key=key,
+            file_size=size,
+            modified_time=int(time.time()),
+            extension=".dat",
+        )
+    )
+    info.version = volume.version
+    # swap the live backend under the volume lock: concurrent reads hold it
+    # during pread (ref VolumeTierMoveDatToRemote swaps after copy completes)
+    with volume._lock:
+        volume.volume_info = info
+        save_volume_info(volume.file_name() + ".vif", info)
+        volume.load_remote_file()
+        volume.no_write_or_delete = True
+        if not keep_local:
+            os.remove(dat_path)
+    return key, size
+
+
+def tier_download(volume, fn: ProgressFn = None):
+    """Bring a tiered volume's .dat back to local disk and drop the remote
+    file entry (ref VolumeTierMoveDatFromRemote)."""
+    from .backend import DiskFile
+    from .volume_info import VolumeInfo, save_volume_info
+
+    name_key = volume.remote_storage_name_key()
+    if name_key is None:
+        raise ValueError(f"volume {volume.id} is already on local disk")
+    storage_name, key = name_key
+    storage = get_backend(storage_name)
+    if storage is None:
+        raise ValueError(
+            f"remote storage {storage_name} not found,"
+            f" supported: {sorted(BACKEND_STORAGES)}"
+        )
+    dat_path = volume.file_name() + ".dat"
+    size = storage.download_file(dat_path, key, fn)
+    with volume._lock:
+        volume.data_backend.close()
+        volume.data_backend = DiskFile(dat_path, create=False)
+        volume.volume_info = VolumeInfo(version=volume.version)
+        save_volume_info(volume.file_name() + ".vif", volume.volume_info)
+        volume.has_remote_file = False
+        volume.no_write_or_delete = False
+    storage.delete_file(key)
+    return size

@@ -117,6 +117,22 @@ class Volume:
 
         base = self.file_name()
         dat_exists = os.path.exists(base + ".dat")
+
+        # tiered volumes have no local .dat; their .vif names the remote
+        # copy (ref volume_tier.go maybeLoadVolumeInfo/LoadRemoteFile)
+        self.volume_info = None
+        self.has_remote_file = False
+        self._maybe_load_volume_info()
+
+        if self.has_remote_file:
+            self.no_write_or_delete = True
+            self.data_backend: BackendStorageFile = None  # set below
+            self.load_remote_file()
+            self.super_block = read_super_block(self.data_backend)
+            self.needle_map_kind = needle_map_kind
+            self.nm = self._open_needle_map(base, needle_map_kind)
+            return
+
         if not dat_exists and not create:
             raise FileNotFoundError(f"Volume data file {base}.dat does not exist")
 
@@ -175,6 +191,35 @@ class Volume:
     # --- basic accessors ---
     def file_name(self) -> str:
         return volume_base_name(self.dir, self.collection, self.id)
+
+    # --- tiering (ref volume_tier.go) ---
+    def _maybe_load_volume_info(self) -> None:
+        from .volume_info import load_volume_info
+
+        info = load_volume_info(self.file_name() + ".vif")
+        if info is not None:
+            self.volume_info = info
+            self.has_remote_file = bool(info.files)
+
+    def remote_storage_name_key(self):
+        """-> (backend_name, key) of the tiered .dat, or None."""
+        if self.volume_info is None or not self.volume_info.files:
+            return None
+        rf = self.volume_info.files[0]
+        return f"{rf.backend_type}.{rf.backend_id}", rf.key
+
+    def load_remote_file(self) -> None:
+        """Point data_backend at the remote copy (ref LoadRemoteFile)."""
+        from .tier_backend import get_backend
+
+        name, key = self.remote_storage_name_key()
+        storage = get_backend(name)
+        if storage is None:
+            raise ValueError(f"backend storage {name} not configured")
+        if self.data_backend is not None:
+            self.data_backend.close()
+        self.data_backend = storage.new_storage_file(key, self.volume_info)
+        self.has_remote_file = True
 
     @property
     def version(self) -> int:

@@ -1,0 +1,39 @@
+"""RFC 9110 single-range parsing shared by the volume server and the S3
+gateway (ref: Go net/http ServeContent range handling used at
+weed/server/volume_server_handlers_read.go writeResponseContent)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+RangeResult = Union[Tuple[int, int], str, None]
+
+
+def parse_range(rng: str, total: int) -> RangeResult:
+    """-> (start, end) inclusive | None (serve full body) |
+    "invalid-range" (416 unsatisfiable).
+
+    Unparsable or syntactically invalid specs (including end < start,
+    RFC 9110 §14.1.1) are ignored -> None; only a well-formed range whose
+    start is past EOF yields 416.
+    """
+    if not rng.startswith("bytes=") or "," in rng:
+        return None
+    start_s, sep, end_s = rng[len("bytes="):].strip().partition("-")
+    if not sep:
+        return None
+    try:
+        if start_s == "":
+            if end_s == "":
+                return None
+            start, end = max(0, total - int(end_s)), total - 1
+        else:
+            start = int(start_s)
+            end = int(end_s) if end_s else total - 1
+    except ValueError:
+        return None
+    if start < 0 or end < start:
+        return None
+    if start >= total:
+        return "invalid-range"
+    return min(start, total - 1), min(end, total - 1)
