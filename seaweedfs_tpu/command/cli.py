@@ -514,6 +514,50 @@ backend = "tpu"   # route erasure coding through the TPU kernels
     return 0
 
 
+def cmd_watch(argv: list[str]) -> int:
+    """Follow recent metadata changes on a filer (ref command/watch.go)."""
+    p = argparse.ArgumentParser(prog="weed-tpu watch")
+    p.add_argument("-filer", default="localhost:8888")
+    p.add_argument("-pathPrefix", default="/")
+    p.add_argument(
+        "-timeAgoSeconds",
+        type=float,
+        default=0,
+        help="replay events starting this many seconds ago",
+    )
+    args = p.parse_args(argv)
+
+    async def run() -> None:
+        import json
+        import time as _time
+
+        from ..pb import grpc_address
+        from ..pb.rpc import Stub
+
+        # -1 = "from now" on the server clock (immune to client skew)
+        since_ns = (
+            int((_time.time() - args.timeAgoSeconds) * 1e9)
+            if args.timeAgoSeconds
+            else -1
+        )
+        stub = Stub(grpc_address(args.filer), "filer")
+        async for msg in stub.server_stream(
+            "SubscribeMetadata",
+            {
+                "client_name": "watch",
+                "path_prefix": args.pathPrefix,
+                "since_ns": since_ns,
+            },
+        ):
+            print(f"events: {json.dumps(msg)}", flush=True)
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_version(argv: list[str]) -> int:
     from .. import __version__
 
@@ -538,6 +582,7 @@ COMMANDS = {
     "fix": cmd_fix,
     "compact": cmd_compact,
     "scaffold": cmd_scaffold,
+    "watch": cmd_watch,
     "version": cmd_version,
 }
 

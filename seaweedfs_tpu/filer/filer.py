@@ -8,6 +8,7 @@ from typing import Callable, Optional
 
 from .entry import Attr, Entry, FileChunk, new_directory_entry
 from .filer_store import FilerStore
+from .meta_log import MetaLog
 
 
 class Filer:
@@ -20,15 +21,34 @@ class Filer:
         self.store = store
         self.on_delete_chunks = on_delete_chunks  # async fid-deletion queue hook
         self.notifier = notifier  # notification.Notifier (ref filer_notify.go)
+        # meta change log feeding SubscribeMetadata streams + `weed watch`
+        # (ref filer.go:38 LocalMetaLogBuffer)
+        self.meta_log = MetaLog()
         root = self.store.find_entry("/")
         if root is None:
             self.store.insert_entry(new_directory_entry("/", 0o775))
 
-    def _notify(self, event_type: str, path: str, entry: Optional[Entry]) -> None:
+    def _notify(
+        self,
+        event_type: str,
+        path: str,
+        entry: Optional[Entry],
+        old_entry: Optional[Entry] = None,
+    ) -> None:
+        entry_dict = entry.to_dict() if entry else None
+        old_dict = old_entry.to_dict() if old_entry else None
+        directory = path.rsplit("/", 1)[0] or "/"
+        from ..notification import EVENT_CREATE, EVENT_DELETE
+
+        if event_type == EVENT_CREATE:
+            old_dict = None
+        if event_type == EVENT_DELETE:
+            old_dict, entry_dict = old_dict or entry_dict, None
+        self.meta_log.append(
+            directory, event_type, old_entry=old_dict, new_entry=entry_dict
+        )
         if self.notifier is not None:
-            self.notifier.notify(
-                event_type, path, entry.to_dict() if entry else None
-            )
+            self.notifier.notify(event_type, path, entry_dict or old_dict)
 
     # --- mkdir -p for parents (ref filer.go CreateEntry ensuring dirs) ---
     def _ensure_parents(self, full_path: str) -> None:
@@ -59,6 +79,7 @@ class Filer:
             EVENT_UPDATE if existing is not None else EVENT_CREATE,
             entry.full_path,
             entry,
+            old_entry=existing,
         )
 
     def update_entry(self, entry: Entry) -> None:
@@ -76,12 +97,14 @@ class Filer:
         if entry is None:
             return []
         collected: list[FileChunk] = []
+        deleted_children: list[Entry] = []
         if entry.is_directory:
             children = self.store.list_directory_entries(full_path, "", True, 2)
             if children and not recursive:
                 raise OSError(f"directory {full_path} not empty")
             for child in self.list_entries_recursive(full_path):
                 collected.extend(child.chunks)
+                deleted_children.append(child)
             self.store.delete_folder_children(full_path)
         else:
             collected.extend(entry.chunks)
@@ -90,7 +113,12 @@ class Filer:
             self.on_delete_chunks(sorted({c.fid for c in collected}))
         from ..notification import EVENT_DELETE
 
-        self._notify(EVENT_DELETE, full_path, entry)
+        # per-child events so deeper-prefix subscribers see their deletions
+        # (ref filer_grpc_server_rename.go / filer_delete_entry.go notify
+        # per moved/removed entry)
+        for child in deleted_children:
+            self._notify(EVENT_DELETE, child.full_path, None, old_entry=child)
+        self._notify(EVENT_DELETE, full_path, None, old_entry=entry)
         return collected
 
     def list_entries(
@@ -125,6 +153,8 @@ class Filer:
         if entry is None:
             raise FileNotFoundError(old_path)
         self._ensure_parents(new_path)
+        from ..notification import EVENT_RENAME
+
         if entry.is_directory:
             for child in list(self.list_entries_recursive(old_path)):
                 suffix = child.full_path[len(old_path) :]
@@ -135,6 +165,9 @@ class Filer:
                     extended=child.extended,
                 )
                 self.store.insert_entry(moved)
+                self._notify(
+                    EVENT_RENAME, moved.full_path, moved, old_entry=child
+                )
             self.store.delete_folder_children(old_path)
         entry_new = Entry(
             full_path=new_path,
@@ -144,9 +177,7 @@ class Filer:
         )
         self.store.insert_entry(entry_new)
         self.store.delete_entry(old_path)
-        from ..notification import EVENT_RENAME
-
-        self._notify(EVENT_RENAME, new_path, entry_new)
+        self._notify(EVENT_RENAME, new_path, entry_new, old_entry=entry)
 
     def touch(self, full_path: str, mime: str, chunks: list[FileChunk], **attrs) -> Entry:
         now = time.time()

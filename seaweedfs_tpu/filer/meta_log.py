@@ -1,0 +1,132 @@
+"""Filer metadata change log + subscriptions.
+
+Host-side equivalent of the reference's in-memory meta log
+(ref: weed/util/log_buffer/log_buffer.go, weed/filer2/filer_notify.go,
+served by the filer's SubscribeMetadata stream, filer.proto:49-53):
+every namespace mutation appends an event; subscribers replay from a
+starting timestamp and then follow live, filtered by path prefix.
+
+The buffer is a bounded ring — subscribers that fall further behind than
+the ring capacity miss events (the reference's LogBuffer similarly only
+keeps a time window in memory; durable history rides the notification
+sinks / filer log files, not this buffer).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import AsyncIterator, Optional
+
+
+class MetaLogEvent:
+    __slots__ = ("ts_ns", "directory", "event_type", "old_entry", "new_entry")
+
+    def __init__(self, ts_ns, directory, event_type, old_entry, new_entry):
+        self.ts_ns = ts_ns
+        self.directory = directory
+        self.event_type = event_type
+        self.old_entry = old_entry  # dict | None
+        self.new_entry = new_entry  # dict | None
+
+    def to_dict(self) -> dict:
+        return {
+            "ts_ns": self.ts_ns,
+            "directory": self.directory,
+            "event_notification": {
+                "event_type": self.event_type,
+                "old_entry": self.old_entry,
+                "new_entry": self.new_entry,
+            },
+        }
+
+
+class MetaLog:
+    def __init__(self, capacity: int = 10000):
+        # ts-ordered parallel lists; bisect on _ts makes read_since
+        # O(log n + matches) instead of a full scan per subscriber poll
+        self._events: list[MetaLogEvent] = []
+        self._ts: list[int] = []
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        self._last_ts_ns = 0
+
+    @property
+    def last_ts_ns(self) -> int:
+        return self._last_ts_ns
+
+    def append(
+        self,
+        directory: str,
+        event_type: str,
+        old_entry: Optional[dict],
+        new_entry: Optional[dict],
+    ) -> MetaLogEvent:
+        with self._lock:
+            # strictly monotonic so since_ns resumption never duplicates
+            ts = max(time.time_ns(), self._last_ts_ns + 1)
+            self._last_ts_ns = ts
+            ev = MetaLogEvent(ts, directory, event_type, old_entry, new_entry)
+            self._events.append(ev)
+            self._ts.append(ts)
+            if len(self._events) > self._capacity * 2:
+                del self._events[: -self._capacity]
+                del self._ts[: -self._capacity]
+            return ev
+
+    def read_since(
+        self, since_ns: int, path_prefix: str = "/"
+    ) -> list[MetaLogEvent]:
+        return self.read_since_with_watermark(since_ns, path_prefix)[0]
+
+    def read_since_with_watermark(
+        self, since_ns: int, path_prefix: str = "/"
+    ) -> tuple[list[MetaLogEvent], int]:
+        """-> (matching events, ts scanned through). The watermark is taken
+        under the same lock as the slice, so resuming from it never skips
+        events appended concurrently."""
+        with self._lock:
+            lo = bisect.bisect_right(self._ts, since_ns)
+            tail = self._events[max(lo, len(self._events) - self._capacity):]
+            watermark = self._last_ts_ns
+        return [ev for ev in tail if _match_prefix(ev, path_prefix)], watermark
+
+    async def subscribe(
+        self,
+        since_ns: int = 0,
+        path_prefix: str = "/",
+        poll_interval: float = 0.05,
+        stopped=None,
+    ) -> AsyncIterator[MetaLogEvent]:
+        """Replay history after since_ns, then follow live
+        (ref filer_grpc_server_sub_meta.go SubscribeMetadata loop)."""
+        import asyncio
+
+        cursor = since_ns
+        while stopped is None or not stopped():
+            # O(1) idle check: nothing appended since our cursor
+            if self._last_ts_ns <= cursor:
+                await asyncio.sleep(poll_interval)
+                continue
+            batch, watermark = self.read_since_with_watermark(
+                cursor, path_prefix
+            )
+            cursor = max(cursor, watermark)
+            for ev in batch:
+                yield ev
+            if not batch:
+                await asyncio.sleep(poll_interval)
+
+
+def _match_prefix(ev: MetaLogEvent, path_prefix: str) -> bool:
+    if not path_prefix or path_prefix == "/":
+        return True
+    for entry in (ev.new_entry, ev.old_entry):
+        if entry:
+            full = entry.get("full_path") or (
+                f"{ev.directory.rstrip('/')}/{entry.get('name', '')}"
+            )
+            if full.startswith(path_prefix):
+                return True
+    return ev.directory.startswith(path_prefix)

@@ -84,6 +84,8 @@ class FilerServer:
         svc.unary("AssignVolume")(self._grpc_assign_volume)
         svc.unary("Statistics")(self._grpc_statistics)
         svc.unary("GetFilerConfiguration")(self._grpc_configuration)
+        svc.server_stream("SubscribeMetadata")(self._grpc_subscribe_metadata)
+        svc.server_stream("SubscribeLocalMetadata")(self._grpc_subscribe_metadata)
         self._grpc_server = await serve(grpc_address(self.address), svc)
 
     async def stop(self) -> None:
@@ -314,6 +316,20 @@ class FilerServer:
 
     async def _grpc_statistics(self, req, context) -> dict:
         return {"used_size": 0, "file_count": 0}
+
+    async def _grpc_subscribe_metadata(self, req, context):
+        """Stream namespace change events from since_ns onward
+        (ref filer.proto:49-53 SubscribeMetadata, command/watch.go)."""
+        since_ns = int(req.get("since_ns", 0))
+        if since_ns < 0:
+            # "from now" anchored to the SERVER clock: a skewed client clock
+            # can neither drop fresh events nor replay stale ones
+            import time as _time
+
+            since_ns = max(_time.time_ns(), self.filer.meta_log.last_ts_ns)
+        prefix = req.get("path_prefix", "/") or "/"
+        async for ev in self.filer.meta_log.subscribe(since_ns, prefix):
+            yield ev.to_dict()
 
     async def _grpc_configuration(self, req, context) -> dict:
         return {

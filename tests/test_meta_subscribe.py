@@ -1,0 +1,188 @@
+"""Filer metadata subscription: meta log, SubscribeMetadata stream, watch
+(ref: weed/util/log_buffer, filer.proto:49-53, command/watch.go)."""
+
+import asyncio
+
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.filer.filer_store import MemoryFilerStore
+from seaweedfs_tpu.filer.meta_log import MetaLog
+
+
+def test_meta_log_append_and_read_since():
+    log = MetaLog()
+    e1 = log.append("/d", "create", None, {"name": "a"})
+    e2 = log.append("/d", "update", {"name": "a"}, {"name": "a"})
+    e3 = log.append("/other", "delete", {"name": "b"}, None)
+    assert e1.ts_ns < e2.ts_ns < e3.ts_ns  # strictly monotonic
+
+    assert len(log.read_since(0)) == 3
+    assert len(log.read_since(e1.ts_ns)) == 2
+    assert [e.event_type for e in log.read_since(0, "/d")] == [
+        "create",
+        "update",
+    ]
+    assert [e.event_type for e in log.read_since(0, "/other")] == ["delete"]
+
+
+def test_meta_log_bounded():
+    log = MetaLog(capacity=10)
+    for i in range(25):
+        log.append("/d", "create", None, {"name": str(i)})
+    events = log.read_since(0)
+    assert len(events) == 10
+    assert events[-1].new_entry["name"] == "24"
+
+
+def test_filer_mutations_feed_meta_log():
+    from seaweedfs_tpu.filer.entry import Entry
+
+    filer = Filer(MemoryFilerStore())
+    e = Entry(full_path="/dir/f.txt")
+    filer.create_entry(e)
+    filer.delete_entry("/dir/f.txt")
+
+    events = filer.meta_log.read_since(0, "/dir")
+    types = [ev.event_type for ev in events]
+    assert "create" in types and "delete" in types
+    create = next(ev for ev in events if ev.event_type == "create")
+    assert create.directory == "/dir"
+    assert create.old_entry is None
+    assert create.new_entry["full_path"] == "/dir/f.txt"
+    delete = next(ev for ev in events if ev.event_type == "delete")
+    assert delete.new_entry is None and delete.old_entry is not None
+
+
+def test_subscribe_replays_then_follows():
+    filer = Filer(MemoryFilerStore())
+
+    async def body():
+        from seaweedfs_tpu.filer.entry import Entry
+
+        filer.create_entry(Entry(full_path="/a/1"))
+        got = []
+
+        async def consume():
+            async for ev in filer.meta_log.subscribe(0, "/a"):
+                got.append(ev.event_type)
+                if len(got) >= 2:
+                    return
+
+        task = asyncio.ensure_future(consume())
+        await asyncio.sleep(0.1)
+        filer.create_entry(Entry(full_path="/a/2"))
+        await asyncio.wait_for(task, timeout=5)
+        assert got == ["create", "create"]
+
+    asyncio.run(body())
+
+
+def test_subscribe_metadata_grpc_stream(tmp_path):
+    from test_cluster import Cluster, free_port_pair
+
+    async def body():
+        import aiohttp
+
+        from seaweedfs_tpu.pb import grpc_address
+        from seaweedfs_tpu.pb.rpc import Stub
+        from seaweedfs_tpu.server.filer import FilerServer
+
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        fs = FilerServer(master=cluster.master.address, port=free_port_pair())
+        await fs.start()
+        try:
+            await fs.master_client.wait_connected()
+            stub = Stub(grpc_address(fs.address), "filer")
+            events = []
+
+            async def consume():
+                async for msg in stub.server_stream(
+                    "SubscribeMetadata",
+                    {"client_name": "t", "path_prefix": "/w", "since_ns": 0},
+                    timeout=10,
+                ):
+                    events.append(msg)
+                    if len(events) >= 2:
+                        return
+
+            task = asyncio.ensure_future(consume())
+            await asyncio.sleep(0.2)
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    f"http://{fs.address}/w/hello.txt", data=b"watch me"
+                ) as resp:
+                    assert resp.status in (200, 201)
+                async with session.delete(
+                    f"http://{fs.address}/w/hello.txt"
+                ) as resp:
+                    assert resp.status in (200, 204)
+            await asyncio.wait_for(task, timeout=10)
+            kinds = [
+                e["event_notification"]["event_type"] for e in events
+            ]
+            assert kinds[0] == "create" and "delete" in kinds
+        finally:
+            await fs.stop()
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_update_and_rename_carry_old_entry():
+    from seaweedfs_tpu.filer.entry import Entry, FileChunk
+
+    filer = Filer(MemoryFilerStore())
+    filer.create_entry(
+        Entry(full_path="/a/x", chunks=[FileChunk(fid="1,ab", offset=0, size=3)])
+    )
+    filer.create_entry(
+        Entry(full_path="/a/x", chunks=[FileChunk(fid="2,cd", offset=0, size=5)])
+    )
+    update = [e for e in filer.meta_log.read_since(0) if e.event_type == "update"]
+    assert update, "overwrite must emit update"
+    assert update[0].old_entry["chunks"][0]["fid"] == "1,ab"
+    assert update[0].new_entry["chunks"][0]["fid"] == "2,cd"
+
+    filer.rename("/a/x", "/b/y")
+    renames = [e for e in filer.meta_log.read_since(0) if e.event_type == "rename"]
+    assert renames[-1].old_entry["full_path"] == "/a/x"
+    assert renames[-1].new_entry["full_path"] == "/b/y"
+    # a subscriber watching the OLD prefix still sees the move
+    assert any(
+        e.event_type == "rename" for e in filer.meta_log.read_since(0, "/a")
+    )
+
+
+def test_recursive_delete_emits_per_child_events():
+    from seaweedfs_tpu.filer.entry import Entry
+
+    filer = Filer(MemoryFilerStore())
+    filer.create_entry(Entry(full_path="/top/sub/f1"))
+    filer.create_entry(Entry(full_path="/top/sub/f2"))
+    mark = filer.meta_log.last_ts_ns
+    filer.delete_entry("/top", recursive=True)
+    # a subscriber scoped under the deleted tree still sees its deletions
+    deep = filer.meta_log.read_since(mark, "/top/sub")
+    deleted_paths = {
+        (e.old_entry or {}).get("full_path")
+        for e in deep
+        if e.event_type == "delete"
+    }
+    assert {"/top/sub/f1", "/top/sub/f2"} <= deleted_paths
+
+
+def test_directory_rename_emits_per_child_events():
+    from seaweedfs_tpu.filer.entry import Entry
+
+    filer = Filer(MemoryFilerStore())
+    filer.create_entry(Entry(full_path="/old/d/f1"))
+    mark = filer.meta_log.last_ts_ns
+    filer.rename("/old", "/new")
+    events = filer.meta_log.read_since(mark, "/old/d")
+    moved = [
+        e
+        for e in events
+        if e.event_type == "rename"
+        and (e.old_entry or {}).get("full_path") == "/old/d/f1"
+    ]
+    assert moved and moved[0].new_entry["full_path"] == "/new/d/f1"
