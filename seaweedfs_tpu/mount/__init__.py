@@ -1,0 +1,21 @@
+"""Mount client (ref: weed/filesys/ — `weed mount`).
+
+`WFS` is the filesystem layer (dirty pages, chunk cache, meta cache) and
+is independent of any kernel interface; the FUSE adapter in the CLI is a
+thin shim gated on a fuse binding being installed in the environment.
+"""
+
+from .chunk_cache import MemChunkCache, TieredChunkCache
+from .dirty_pages import ContinuousDirtyPages, ContinuousIntervals
+from .meta_cache import MetaCache
+from .wfs import WFS, FileHandle
+
+__all__ = [
+    "WFS",
+    "FileHandle",
+    "MetaCache",
+    "TieredChunkCache",
+    "MemChunkCache",
+    "ContinuousIntervals",
+    "ContinuousDirtyPages",
+]

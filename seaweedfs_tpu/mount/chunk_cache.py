@@ -1,0 +1,138 @@
+"""Tiered chunk cache for mounted reads: memory LRU over small chunks plus
+size-bucketed disk layers for larger ones
+(ref: weed/util/chunk_cache/chunk_cache.go:10-34 — 1MB mem limit,
+1MB/4MB disk buckets; chunk_cache_on_disk.go stores blobs in cache
+volume files; the Python disk layer uses one file per chunk which keeps
+eviction O(1) and survives restarts the same way).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from collections import OrderedDict
+from typing import Optional
+
+MEM_CACHE_SIZE_LIMIT = 1024 * 1024
+ON_DISK_LIMIT_0 = MEM_CACHE_SIZE_LIMIT
+ON_DISK_LIMIT_1 = 4 * MEM_CACHE_SIZE_LIMIT
+
+
+class MemChunkCache:
+    """LRU by chunk count (ref chunk_cache_in_memory.go)."""
+
+    def __init__(self, max_entries: int = 1024):
+        self.max_entries = max_entries
+        self._map: OrderedDict[str, bytes] = OrderedDict()
+
+    def get(self, fid: str) -> Optional[bytes]:
+        data = self._map.get(fid)
+        if data is not None:
+            self._map.move_to_end(fid)
+        return data
+
+    def set(self, fid: str, data: bytes) -> None:
+        self._map[fid] = data
+        self._map.move_to_end(fid)
+        while len(self._map) > self.max_entries:
+            self._map.popitem(last=False)
+
+
+class DiskChunkCacheLayer:
+    """Bounded directory of chunk blobs with LRU-by-mtime eviction
+    (ref on_disk_cache_layer.go)."""
+
+    def __init__(self, directory: str, name: str, size_limit_bytes: int):
+        self.dir = os.path.join(directory, name)
+        os.makedirs(self.dir, exist_ok=True)
+        self.size_limit = size_limit_bytes
+
+    def _path(self, fid: str) -> str:
+        return os.path.join(
+            self.dir, hashlib.sha1(fid.encode()).hexdigest()[:24]
+        )
+
+    def get(self, fid: str) -> Optional[bytes]:
+        p = self._path(fid)
+        try:
+            with open(p, "rb") as f:
+                data = f.read()
+            os.utime(p)  # refresh for LRU eviction
+            return data
+        except OSError:
+            return None
+
+    def set(self, fid: str, data: bytes) -> None:
+        with open(self._path(fid), "wb") as f:
+            f.write(data)
+        self._evict_if_needed()
+
+    def _evict_if_needed(self) -> None:
+        entries = []
+        total = 0
+        for name in os.listdir(self.dir):
+            p = os.path.join(self.dir, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            entries.append((st.st_mtime, st.st_size, p))
+            total += st.st_size
+        if total <= self.size_limit:
+            return
+        entries.sort()  # oldest first
+        for _, sz, p in entries:
+            try:
+                os.remove(p)
+            except OSError:
+                continue
+            total -= sz
+            if total <= self.size_limit:
+                break
+
+
+class TieredChunkCache:
+    """get/set routed by chunk size (ref chunk_cache.go doGetChunk):
+    <1MB -> memory + small disk layer; <4MB -> mid layer; else big layer."""
+
+    def __init__(
+        self,
+        max_mem_entries: int = 1024,
+        directory: Optional[str] = None,
+        disk_size_mb: int = 128,
+    ):
+        self.mem = MemChunkCache(max_mem_entries)
+        self.disk_layers: list[DiskChunkCacheLayer] = []
+        if directory:
+            budget = disk_size_mb * 1024 * 1024
+            self.disk_layers = [
+                DiskChunkCacheLayer(directory, "c0_1", budget // 4),
+                DiskChunkCacheLayer(directory, "c1_4", budget // 4),
+                DiskChunkCacheLayer(directory, "cache", budget // 2),
+            ]
+
+    def _disk_layer(self, size: int) -> Optional[DiskChunkCacheLayer]:
+        if not self.disk_layers:
+            return None
+        if size < ON_DISK_LIMIT_0:
+            return self.disk_layers[0]
+        if size < ON_DISK_LIMIT_1:
+            return self.disk_layers[1]
+        return self.disk_layers[2]
+
+    def get(self, fid: str, chunk_size: int) -> Optional[bytes]:
+        if chunk_size < MEM_CACHE_SIZE_LIMIT:
+            data = self.mem.get(fid)
+            if data is not None:
+                return data
+        layer = self._disk_layer(chunk_size)
+        if layer is not None:
+            return layer.get(fid)
+        return None
+
+    def set(self, fid: str, data: bytes) -> None:
+        if len(data) < MEM_CACHE_SIZE_LIMIT:
+            self.mem.set(fid, data)
+        layer = self._disk_layer(len(data))
+        if layer is not None:
+            layer.set(fid, data)

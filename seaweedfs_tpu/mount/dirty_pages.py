@@ -1,0 +1,104 @@
+"""Dirty-page interval buffering for mounted file writes.
+
+Mirrors the reference's ContinuousIntervals
+(ref: weed/filesys/dirty_page_interval.go:21-160,
+weed/filesys/dirty_pages.go): random writes accumulate as disjoint
+maximal runs of bytes; a new write splits/overwrites any overlap and
+merges with touching neighbors. When the buffered total exceeds the
+chunk size the largest run is flushed to storage as one chunk.
+
+The Python shape is a sorted list of (offset, bytearray) runs instead of
+linked lists of nodes — same observable semantics (newest data wins),
+simpler invariants.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Callable, List, Optional, Tuple
+
+
+class ContinuousIntervals:
+    """Disjoint, sorted, maximal dirty byte runs."""
+
+    def __init__(self):
+        self.runs: List[Tuple[int, bytearray]] = []  # sorted by offset
+
+    def total_size(self) -> int:
+        return sum(len(d) for _, d in self.runs)
+
+    def add_interval(self, data: bytes, offset: int) -> None:
+        if not data:
+            return
+        start, stop = offset, offset + len(data)
+        new_runs: List[Tuple[int, bytearray]] = []
+        merged = bytearray(data)
+        m_start, m_stop = start, stop
+        for r_off, r_data in self.runs:
+            r_stop = r_off + len(r_data)
+            if r_stop < start or r_off > stop:
+                new_runs.append((r_off, r_data))
+                continue
+            # overlapping or touching: old data survives only outside the
+            # new interval (newest write wins)
+            if r_off < m_start:
+                merged = bytearray(r_data[: m_start - r_off]) + merged
+                m_start = r_off
+            if r_stop > m_stop:
+                merged = merged + r_data[m_stop - r_off :]
+                m_stop = r_stop
+        bisect.insort(new_runs, (m_start, merged))
+        self.runs = new_runs
+
+    def read_data(self, offset: int, size: int) -> List[Tuple[int, bytes]]:
+        """-> [(logical_offset, bytes)] pieces of dirty data overlapping
+        [offset, offset+size)."""
+        out = []
+        stop = offset + size
+        for r_off, r_data in self.runs:
+            r_stop = r_off + len(r_data)
+            s, e = max(offset, r_off), min(stop, r_stop)
+            if s < e:
+                out.append((s, bytes(r_data[s - r_off : e - r_off])))
+        return out
+
+    def pop_largest(self) -> Optional[Tuple[int, bytes]]:
+        """Remove and return the largest run (the flush candidate,
+        ref dirty_pages.go saveExistingLargestPageToStorage)."""
+        if not self.runs:
+            return None
+        i = max(range(len(self.runs)), key=lambda j: len(self.runs[j][1]))
+        off, data = self.runs.pop(i)
+        return off, bytes(data)
+
+    def pop_all(self) -> List[Tuple[int, bytes]]:
+        runs, self.runs = self.runs, []
+        return [(off, bytes(d)) for off, d in runs]
+
+    def max_stop(self) -> int:
+        return max(
+            (off + len(d) for off, d in self.runs), default=0
+        )
+
+
+class ContinuousDirtyPages:
+    """Write buffer for one open file: accumulates intervals and flushes
+    the largest run through `save_fn(offset, data)` once the buffered
+    bytes exceed `limit` (ref dirty_pages.go AddPage)."""
+
+    def __init__(self, limit: int, save_fn: Callable[[int, bytes], None]):
+        self.intervals = ContinuousIntervals()
+        self.limit = limit
+        self.save_fn = save_fn
+
+    def add_page(self, offset: int, data: bytes) -> None:
+        self.intervals.add_interval(data, offset)
+        while self.intervals.total_size() >= self.limit:
+            popped = self.intervals.pop_largest()
+            if popped is None:
+                break
+            self.save_fn(popped[0], popped[1])
+
+    def flush(self) -> None:
+        for off, data in self.intervals.pop_all():
+            self.save_fn(off, data)

@@ -1,0 +1,62 @@
+"""Local metadata cache for the mount client, kept fresh by the filer's
+SubscribeMetadata stream (ref: weed/filesys/meta_cache/meta_cache.go,
+meta_cache_subscribe.go)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..filer.entry import Entry
+
+
+class MetaCache:
+    def __init__(self):
+        self._entries: Dict[str, Entry] = {}
+        self._listed_dirs: set[str] = set()
+        self._lock = threading.RLock()
+
+    def get(self, path: str) -> Optional[Entry]:
+        with self._lock:
+            return self._entries.get(path)
+
+    def put(self, entry: Entry) -> None:
+        with self._lock:
+            self._entries[entry.full_path] = entry
+
+    def delete(self, path: str) -> None:
+        with self._lock:
+            self._entries.pop(path, None)
+            prefix = path.rstrip("/") + "/"
+            for p in [p for p in self._entries if p.startswith(prefix)]:
+                del self._entries[p]
+
+    def mark_listed(self, dir_path: str) -> None:
+        with self._lock:
+            self._listed_dirs.add(dir_path)
+
+    def is_listed(self, dir_path: str) -> bool:
+        with self._lock:
+            return dir_path in self._listed_dirs
+
+    def list_dir(self, dir_path: str) -> List[Entry]:
+        prefix = dir_path.rstrip("/") + "/"
+        with self._lock:
+            return sorted(
+                (
+                    e
+                    for p, e in self._entries.items()
+                    if p.startswith(prefix) and "/" not in p[len(prefix):]
+                ),
+                key=lambda e: e.full_path,
+            )
+
+    # --- subscription applier (ref meta_cache_subscribe.go) ---
+    def apply_event(self, event: dict) -> None:
+        notification = event.get("event_notification", {})
+        old = notification.get("old_entry")
+        new = notification.get("new_entry")
+        if old and (not new or old.get("full_path") != new.get("full_path")):
+            self.delete(old["full_path"])
+        if new:
+            self.put(Entry.from_dict(new))

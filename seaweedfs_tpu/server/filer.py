@@ -322,11 +322,10 @@ class FilerServer:
         (ref filer.proto:49-53 SubscribeMetadata, command/watch.go)."""
         since_ns = int(req.get("since_ns", 0))
         if since_ns < 0:
-            # "from now" anchored to the SERVER clock: a skewed client clock
-            # can neither drop fresh events nor replay stale ones
-            import time as _time
-
-            since_ns = max(_time.time_ns(), self.filer.meta_log.last_ts_ns)
+            # "from now" anchored to the server-side event sequence: a skewed
+            # client clock can neither drop fresh events nor replay stale
+            # ones, and any event appended after this point has ts > anchor
+            since_ns = self.filer.meta_log.last_ts_ns
         prefix = req.get("path_prefix", "/") or "/"
         async for ev in self.filer.meta_log.subscribe(since_ns, prefix):
             yield ev.to_dict()

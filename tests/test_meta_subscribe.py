@@ -186,3 +186,17 @@ def test_directory_rename_emits_per_child_events():
         and (e.old_entry or {}).get("full_path") == "/old/d/f1"
     ]
     assert moved and moved[0].new_entry["full_path"] == "/new/d/f1"
+
+
+def test_update_entry_emits_event():
+    from seaweedfs_tpu.filer.entry import Entry
+
+    filer = Filer(MemoryFilerStore())
+    filer.create_entry(Entry(full_path="/u/f"))
+    mark = filer.meta_log.last_ts_ns
+    e = filer.find_entry("/u/f")
+    e.extended["k"] = "v"
+    filer.update_entry(e)
+    events = filer.meta_log.read_since(mark, "/u")
+    assert [ev.event_type for ev in events] == ["update"]
+    assert events[0].new_entry["extended"] == {"k": "v"}
