@@ -44,6 +44,7 @@ class MasterServer:
         pulse_seconds: float = 5.0,
         jwt_signing_key: str = "",
         jwt_expires_seconds: int = 10,
+        peers: Optional[list[str]] = None,
     ):
         self.jwt_signing_key = jwt_signing_key
         self.jwt_expires_seconds = jwt_expires_seconds
@@ -58,6 +59,14 @@ class MasterServer:
             sequencer=MemorySequencer(),
         )
         self.growth = VolumeGrowth()
+        from .raft import RaftLite
+
+        self.raft = RaftLite(
+            self.address,
+            peers,
+            get_max_volume_id=lambda: self.topo.max_volume_id,
+            adjust_max_volume_id=self.topo.adjust_max_volume_id,
+        )
         self._clients: dict[str, asyncio.Queue] = {}
         self._admin_token: Optional[tuple[int, float]] = None  # (token, ts)
         self._http_runner: Optional[web.AppRunner] = None
@@ -66,7 +75,11 @@ class MasterServer:
 
     @property
     def leader(self) -> str:
-        return self.address
+        return self.raft.leader_address or self.address
+
+    @property
+    def is_leader(self) -> bool:
+        return self.raft.is_leader
 
     # ---------------- lifecycle ----------------
     async def start(self) -> None:
@@ -98,10 +111,14 @@ class MasterServer:
         svc.unary("LeaseAdminToken")(self._grpc_lease_admin_token)
         svc.unary("ReleaseAdminToken")(self._grpc_release_admin_token)
         svc.unary("GetMasterConfiguration")(self._grpc_get_configuration)
+        svc.unary("RaftRequestVote")(self._grpc_raft_request_vote)
+        svc.unary("RaftAppendEntries")(self._grpc_raft_append_entries)
         self._grpc_server = await serve(grpc_address(self.address), svc)
+        self.raft.start()
 
     async def stop(self) -> None:
         self._shutdown = True
+        await self.raft.stop()
         if self._grpc_server is not None:
             await self._grpc_server.stop(0.5)
         if self._http_runner is not None:

@@ -1,0 +1,216 @@
+"""Raft-lite: leader election + max-volume-id consensus for multi-master.
+
+The reference embeds chrislusf/raft solely for (a) electing one leader
+among the masters and (b) agreeing on MaxVolumeId
+(ref: weed/server/raft_server.go, weed/topology/cluster_commands.go,
+weed/topology/topology.go:115-122). This module implements exactly that
+slice with raft's election rules — randomized follower timeouts, terms,
+majority votes — but no replicated log: the single piece of state
+(max volume id) is monotonic, so it rides leader heartbeats and vote
+replies instead of log entries.
+
+RPCs (registered on the master's gRPC service):
+  RaftRequestVote {term, candidate, max_volume_id}
+      -> {granted, term, max_volume_id}
+  RaftAppendEntries {term, leader, max_volume_id}     # leader heartbeat
+      -> {ok, term, max_volume_id}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Callable, List, Optional
+
+from ..pb import grpc_address
+from ..pb.rpc import Stub
+
+HEARTBEAT_INTERVAL = 0.15
+ELECTION_TIMEOUT_RANGE = (0.45, 0.9)
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+class RaftLite:
+    def __init__(
+        self,
+        self_address: str,
+        peers: Optional[List[str]] = None,
+        get_max_volume_id: Callable[[], int] = lambda: 0,
+        adjust_max_volume_id: Callable[[int], None] = lambda vid: None,
+    ):
+        self.address = self_address
+        # peers includes self (ref raft_server.go peers handling)
+        self.peers = sorted(set((peers or [])) | {self_address})
+        self.get_max_volume_id = get_max_volume_id
+        self.adjust_max_volume_id = adjust_max_volume_id
+
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.state = FOLLOWER if len(self.peers) > 1 else LEADER
+        self.leader_address: Optional[str] = (
+            self_address if len(self.peers) == 1 else None
+        )
+        self._last_heartbeat = time.monotonic()
+        self._task: Optional[asyncio.Task] = None
+        self._shutdown = False
+
+    # ---------------- public state ----------------
+    @property
+    def is_leader(self) -> bool:
+        return self.state == LEADER
+
+    @property
+    def single_node(self) -> bool:
+        return len(self.peers) == 1
+
+    def others(self) -> List[str]:
+        return [p for p in self.peers if p != self.address]
+
+    def majority(self) -> int:
+        return len(self.peers) // 2 + 1
+
+    # ---------------- lifecycle ----------------
+    def start(self) -> None:
+        if not self.single_node:
+            self._task = asyncio.ensure_future(self._run())
+
+    async def stop(self) -> None:
+        self._shutdown = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    # ---------------- main loop ----------------
+    async def _run(self) -> None:
+        while not self._shutdown:
+            try:
+                if self.state == LEADER:
+                    await self._lead()
+                else:
+                    await self._follow_or_campaign()
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                await asyncio.sleep(HEARTBEAT_INTERVAL)
+
+    async def _follow_or_campaign(self) -> None:
+        timeout = random.uniform(*ELECTION_TIMEOUT_RANGE)
+        await asyncio.sleep(HEARTBEAT_INTERVAL / 2)
+        if time.monotonic() - self._last_heartbeat < timeout:
+            return
+        await self._campaign()
+
+    async def _campaign(self) -> None:
+        self.state = CANDIDATE
+        self.term += 1
+        term = self.term
+        self.voted_for = self.address
+        self.leader_address = None
+        votes = 1
+        req = {
+            "term": term,
+            "candidate": self.address,
+            "max_volume_id": self.get_max_volume_id(),
+        }
+
+        async def ask(peer: str) -> Optional[dict]:
+            try:
+                return await Stub(grpc_address(peer), "master").call(
+                    "RaftRequestVote", req, timeout=1.0
+                )
+            except Exception:
+                return None
+
+        replies = await asyncio.gather(*(ask(p) for p in self.others()))
+        for resp in replies:
+            if resp is None:
+                continue
+            if int(resp.get("term", 0)) > term:
+                self._step_down(int(resp["term"]))
+                return
+            if resp.get("granted"):
+                votes += 1
+                # voters report their max so a new leader never regresses
+                self.adjust_max_volume_id(int(resp.get("max_volume_id", 0)))
+        if self.state != CANDIDATE or self.term != term:
+            return  # someone else won meanwhile
+        if votes >= self.majority():
+            self.state = LEADER
+            self.leader_address = self.address
+        else:
+            self.state = FOLLOWER
+            self._last_heartbeat = time.monotonic()  # back off before retry
+
+    async def _lead(self) -> None:
+        req = {
+            "term": self.term,
+            "leader": self.address,
+            "max_volume_id": self.get_max_volume_id(),
+        }
+
+        async def ping(peer: str) -> Optional[dict]:
+            try:
+                return await Stub(grpc_address(peer), "master").call(
+                    "RaftAppendEntries", req, timeout=1.0
+                )
+            except Exception:
+                return None
+
+        replies = await asyncio.gather(*(ping(p) for p in self.others()))
+        for resp in replies:
+            if resp is None:
+                continue
+            if int(resp.get("term", 0)) > self.term:
+                self._step_down(int(resp["term"]))
+                return
+            self.adjust_max_volume_id(int(resp.get("max_volume_id", 0)))
+        await asyncio.sleep(HEARTBEAT_INTERVAL)
+
+    def _step_down(self, term: int) -> None:
+        self.term = term
+        self.state = FOLLOWER
+        self.voted_for = None
+        self._last_heartbeat = time.monotonic()
+
+    # ---------------- RPC handlers ----------------
+    async def handle_request_vote(self, req: dict) -> dict:
+        term = int(req.get("term", 0))
+        candidate = req.get("candidate", "")
+        if term > self.term:
+            self._step_down(term)
+        granted = term >= self.term and self.voted_for in (None, candidate)
+        if granted:
+            self.term = term
+            self.voted_for = candidate
+            self._last_heartbeat = time.monotonic()
+        self.adjust_max_volume_id(int(req.get("max_volume_id", 0)))
+        return {
+            "granted": granted,
+            "term": self.term,
+            "max_volume_id": self.get_max_volume_id(),
+        }
+
+    async def handle_append_entries(self, req: dict) -> dict:
+        term = int(req.get("term", 0))
+        if term < self.term:
+            return {
+                "ok": False,
+                "term": self.term,
+                "max_volume_id": self.get_max_volume_id(),
+            }
+        if term > self.term or self.state != FOLLOWER:
+            self._step_down(term)
+        self.term = term
+        self.leader_address = req.get("leader", "")
+        self._last_heartbeat = time.monotonic()
+        self.adjust_max_volume_id(int(req.get("max_volume_id", 0)))
+        return {
+            "ok": True,
+            "term": self.term,
+            "max_volume_id": self.get_max_volume_id(),
+        }
