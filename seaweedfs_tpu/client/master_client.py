@@ -92,10 +92,13 @@ class MasterClient:
                 for vid in msg.get("deleted_vids", []):
                     self.vid_map.remove(int(vid), url)
             leader = msg.get("leader")
-            self._connected.set()
-            if leader and leader != master and leader not in ("",):
-                if leader not in self.masters:
-                    self.masters.append(leader)
+            if not leader or leader == master:
+                # only count as connected when talking to the actual
+                # leader — a follower's single redirect message must not
+                # satisfy wait_connected() with an empty vid cache
+                self._connected.set()
+            elif leader not in self.masters:
+                self.masters.append(leader)
 
     def lookup_file_id(self, fid: str) -> str:
         """fid -> full http url (ref vid_map.go:57-70)."""

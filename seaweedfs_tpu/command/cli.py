@@ -18,6 +18,12 @@ def _add_master_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("-volumeSizeLimitMB", type=int, default=30_000)
     p.add_argument("-defaultReplication", default="000")
     p.add_argument("-garbageThreshold", type=float, default=0.3)
+    p.add_argument(
+        "-peers",
+        default="",
+        help="comma-separated list of all master addresses (incl. self) "
+        "for a multi-master raft cluster (ref weed master -peers)",
+    )
 
 
 def _add_volume_flags(p: argparse.ArgumentParser) -> None:
@@ -66,7 +72,7 @@ def _build_volume_server(args, port_offset: int = 0):
     if len(maxes) == 1:
         maxes = maxes * len(dirs)
     return VolumeServer(
-        master=args.mserver,
+        master=[x for x in args.mserver.split(",") if x],
         directories=dirs,
         host=args.ip,
         port=args.port + port_offset,
@@ -102,6 +108,7 @@ def cmd_master(argv: list[str]) -> int:
         volume_size_limit_mb=args.volumeSizeLimitMB,
         default_replication=args.defaultReplication,
         garbage_threshold=args.garbageThreshold,
+        peers=[x for x in args.peers.split(",") if x] or None,
     )
     print(f"master listening on {args.ip}:{args.port}")
     asyncio.run(_run_forever(ms))
@@ -142,14 +149,16 @@ def cmd_server(argv: list[str]) -> int:
         with open(args.tierConfig) as f:
             load_from_config(json.load(f))
 
+    peers = [x for x in args.peers.split(",") if x] or None
     ms = MasterServer(
         host=args.ip,
         port=args.port,
         volume_size_limit_mb=args.volumeSizeLimitMB,
         default_replication=args.defaultReplication,
+        peers=peers,
     )
     vs = VolumeServer(
-        master=f"{args.ip}:{args.port}",
+        master=peers or f"{args.ip}:{args.port}",
         directories=args.dir.split(","),
         host=args.ip,
         port=args.volumePort,

@@ -9,10 +9,10 @@ gRPC (ref: weed/server/master_grpc_server*.go):
   LookupVolume, LookupEcVolume, CollectionList/Delete, VolumeList,
   LeaseAdminToken/ReleaseAdminToken.
 
-Single-master deployment this round: the leader is always self (the
-reference's raft backs only leader election + max-volume-id,
-ref: weed/topology/topology.go:115-122 — our max-volume-id is served by the
-same in-process topology the allocations go through).
+Multi-master: RaftLite (server/raft.py) elects one leader; followers
+proxy Assign/growth to it, redirect heartbeat + KeepConnected streams,
+and freshly assigned volume ids are majority-committed before use
+(ref: weed/server/raft_server.go, weed/topology/topology.go:115-122).
 """
 
 from __future__ import annotations
@@ -139,6 +139,9 @@ class MasterServer:
     async def _allocate_volume(self, vid: int, option: GrowOption, servers) -> bool:
         """AllocateVolume RPC to each chosen server (ref
         topology/allocate_volume.go)."""
+        # the vid must reach a raft majority before any server uses it
+        if not await self.raft.commit_max_volume_id(vid):
+            return False
         ok = True
         for dn in servers:
             stub = Stub(grpc_address(dn.url), "volume")
@@ -177,6 +180,12 @@ class MasterServer:
                 self._broadcast_location(dn, new_vids=[vid], deleted_vids=[])
 
     async def _do_assign(self, params) -> dict:
+        # Only the raft leader may assign/grow: followers proxy to the
+        # leader so concurrent masters never allocate colliding volume
+        # ids (ref master_server.go:159-189 proxy-to-leader wrapper).
+        proxied = await self._proxy_to_leader("Assign", dict(params))
+        if proxied is not None:
+            return proxied
         count = int(params.get("count", 1) or 1)
         option = self._parse_option(params)
         try:
@@ -228,6 +237,19 @@ class MasterServer:
             ],
         }
 
+    def _leader_gate_http(self, request: web.Request) -> Optional[web.Response]:
+        """None when this master may serve the request; otherwise a
+        503 (no leader yet) — or raises a redirect to the leader
+        (ref master_server.go:159-189 proxyToLeader)."""
+        if self.is_leader:
+            return None
+        leader = self.raft.leader_address
+        if not leader or leader == self.address:
+            return web.json_response(
+                {"error": "no leader elected yet"}, status=503
+            )
+        raise web.HTTPTemporaryRedirect(f"http://{leader}{request.path_qs}")
+
     # ---------------- HTTP handlers ----------------
     async def _dir_assign(self, request: web.Request) -> web.Response:
         params = dict(request.query)
@@ -236,6 +258,9 @@ class MasterServer:
         return web.json_response(await self._do_assign(params))
 
     async def _dir_lookup(self, request: web.Request) -> web.Response:
+        gate = self._leader_gate_http(request)
+        if gate is not None:
+            return gate
         params = dict(request.query)
         if request.method == "POST":
             params.update(dict(await request.post()))
@@ -250,6 +275,9 @@ class MasterServer:
         )
 
     async def _vol_grow(self, request: web.Request) -> web.Response:
+        gate = self._leader_gate_http(request)
+        if gate is not None:
+            return gate
         params = dict(request.query)
         option = self._parse_option(params)
         count = int(params.get("count", 1) or 1)
@@ -261,6 +289,9 @@ class MasterServer:
         return web.json_response({"count": grown})
 
     async def _vol_vacuum(self, request: web.Request) -> web.Response:
+        gate = self._leader_gate_http(request)
+        if gate is not None:
+            return gate
         threshold = float(
             request.query.get("garbageThreshold", self.garbage_threshold)
         )
@@ -268,6 +299,9 @@ class MasterServer:
         return web.json_response({"Result": results})
 
     async def _col_delete(self, request: web.Request) -> web.Response:
+        gate = self._leader_gate_http(request)
+        if gate is not None:
+            return gate
         collection = request.query.get("collection", "")
         for dn in self.topo.data_nodes():
             stub = Stub(grpc_address(dn.url), "volume")
@@ -285,10 +319,17 @@ class MasterServer:
 
     async def _cluster_status(self, request: web.Request) -> web.Response:
         return web.json_response(
-            {"IsLeader": True, "Leader": self.leader, "Peers": []}
+            {
+                "IsLeader": self.is_leader,
+                "Leader": self.leader,
+                "Peers": self.raft.others(),
+            }
         )
 
     async def _redirect(self, request: web.Request) -> web.Response:
+        gate = self._leader_gate_http(request)
+        if gate is not None:
+            return gate
         file_id = request.match_info["file_id"]
         result = self._do_lookup(file_id.split(",")[0])
         if "error" in result:
@@ -300,9 +341,20 @@ class MasterServer:
     async def _send_heartbeat(self, request_iterator, context):
         """Bidi heartbeat stream from one volume server
         (ref: master_grpc_server.go:20-178)."""
+        # Followers don't own topology state: hand the volume server the
+        # leader's address and end the stream so it redials
+        # (ref master_grpc_server.go heartbeat leader check).
+        if not self.is_leader:
+            yield {"leader": self.leader}
+            return
         dn = None
         try:
             async for hb in request_iterator:
+                if not self.is_leader:
+                    # demoted mid-stream: hand over and end the stream so
+                    # the volume server redials the new leader
+                    yield {"leader": self.leader}
+                    return
                 if dn is None and hb.get("ip"):
                     dc = self.topo.get_or_create_data_center(
                         hb.get("data_center") or "DefaultDataCenter"
@@ -421,6 +473,10 @@ class MasterServer:
     # ---------------- gRPC: client push ----------------
     async def _keep_connected(self, request_iterator, context):
         """vid-location push stream (ref master_grpc_server.go:182-235)."""
+        if not self.is_leader:
+            # point the client at the leader and end the stream
+            yield {"leader": self.leader}
+            return
         first = await request_iterator.__anext__()
         client_name = f"{first.get('name', 'client')}@{id(context)}"
         queue: asyncio.Queue = asyncio.Queue(maxsize=10_000)
@@ -448,8 +504,11 @@ class MasterServer:
         drainer = asyncio.ensure_future(drain_requests())
         try:
             while not self._shutdown:
+                if not self.is_leader:
+                    yield {"leader": self.leader}  # demoted: hand over
+                    return
                 try:
-                    msg = await asyncio.wait_for(queue.get(), timeout=5.0)
+                    msg = await asyncio.wait_for(queue.get(), timeout=1.0)
                     yield msg
                 except asyncio.TimeoutError:
                     yield {"leader": self.leader}  # keepalive tick
@@ -461,7 +520,25 @@ class MasterServer:
     async def _grpc_assign(self, req, context) -> dict:
         return await self._do_assign(req)
 
+    async def _proxy_to_leader(self, method: str, req) -> Optional[dict]:
+        """Forward a unary gRPC call to the leader when this master is a
+        follower; None means serve locally."""
+        if self.is_leader:
+            return None
+        leader = self.raft.leader_address
+        if not leader or leader == self.address:
+            return {"error": "no leader elected yet"}
+        try:
+            return await Stub(grpc_address(leader), "master").call(
+                method, dict(req), timeout=5.0
+            )
+        except Exception as e:
+            return {"error": f"proxy to leader {leader} failed: {e}"}
+
     async def _grpc_lookup_volume(self, req, context) -> dict:
+        proxied = await self._proxy_to_leader("LookupVolume", req)
+        if proxied is not None:
+            return proxied
         results = []
         for vid in req.get("volume_ids", []):
             results.append(self._do_lookup(str(vid), req.get("collection", "")))
@@ -469,6 +546,9 @@ class MasterServer:
 
     async def _grpc_lookup_ec_volume(self, req, context) -> dict:
         """(ref master_grpc_server_volume.go LookupEcVolume)"""
+        proxied = await self._proxy_to_leader("LookupEcVolume", req)
+        if proxied is not None:
+            return proxied
         vid = int(req["volume_id"])
         locs = self.topo.lookup_ec_shards(vid)
         if locs is None:
@@ -488,6 +568,9 @@ class MasterServer:
         return {"volume_id": vid, "shard_id_locations": shard_locations}
 
     async def _grpc_statistics(self, req, context) -> dict:
+        proxied = await self._proxy_to_leader("Statistics", req)
+        if proxied is not None:
+            return proxied
         return {
             "used_size": sum(
                 int(v.get("size", 0))
@@ -497,9 +580,15 @@ class MasterServer:
         }
 
     async def _grpc_collection_list(self, req, context) -> dict:
+        proxied = await self._proxy_to_leader("CollectionList", req)
+        if proxied is not None:
+            return proxied
         return {"collections": [{"name": c} for c in self.topo.collections]}
 
     async def _grpc_collection_delete(self, req, context) -> dict:
+        proxied = await self._proxy_to_leader("CollectionDelete", req)
+        if proxied is not None:
+            return proxied
         name = req.get("name", "")
         for dn in self.topo.data_nodes():
             stub = Stub(grpc_address(dn.url), "volume")
@@ -511,6 +600,9 @@ class MasterServer:
         return {}
 
     async def _grpc_volume_list(self, req, context) -> dict:
+        proxied = await self._proxy_to_leader("VolumeList", req)
+        if proxied is not None:
+            return proxied
         return {
             "topology_info": self.topo.to_info(),
             "volume_size_limit_mb": self.topo.volume_size_limit // (1024 * 1024),
@@ -541,6 +633,12 @@ class MasterServer:
             "metrics_address": "",
             "metrics_interval_seconds": 15,
         }
+
+    async def _grpc_raft_request_vote(self, req, context) -> dict:
+        return await self.raft.handle_request_vote(req)
+
+    async def _grpc_raft_append_entries(self, req, context) -> dict:
+        return await self.raft.handle_append_entries(req)
 
     # ---------------- vacuum driver (ref topology_vacuum.go) ----------------
     async def vacuum(self, garbage_threshold: float) -> list[dict]:

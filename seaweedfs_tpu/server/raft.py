@@ -53,6 +53,7 @@ class RaftLite:
             self_address if len(self.peers) == 1 else None
         )
         self._last_heartbeat = time.monotonic()
+        self._last_quorum_contact = time.monotonic()
         self._task: Optional[asyncio.Task] = None
         self._shutdown = False
 
@@ -105,6 +106,27 @@ class RaftLite:
             return
         await self._campaign()
 
+    async def _broadcast(self, method: str, req: dict) -> Optional[List[dict]]:
+        """Send a unary RPC to every other peer in parallel. Unreachable
+        peers are dropped; None means a peer reported a higher term and
+        we stepped down."""
+
+        async def one(peer: str) -> Optional[dict]:
+            try:
+                return await Stub(grpc_address(peer), "master").call(
+                    method, req, timeout=1.0
+                )
+            except Exception:
+                return None
+
+        replies = await asyncio.gather(*(one(p) for p in self.others()))
+        alive = [r for r in replies if r is not None]
+        for resp in alive:
+            if int(resp.get("term", 0)) > self.term:
+                self._step_down(int(resp["term"]))
+                return None
+        return alive
+
     async def _campaign(self) -> None:
         self.state = CANDIDATE
         self.term += 1
@@ -112,27 +134,17 @@ class RaftLite:
         self.voted_for = self.address
         self.leader_address = None
         votes = 1
-        req = {
-            "term": term,
-            "candidate": self.address,
-            "max_volume_id": self.get_max_volume_id(),
-        }
-
-        async def ask(peer: str) -> Optional[dict]:
-            try:
-                return await Stub(grpc_address(peer), "master").call(
-                    "RaftRequestVote", req, timeout=1.0
-                )
-            except Exception:
-                return None
-
-        replies = await asyncio.gather(*(ask(p) for p in self.others()))
+        replies = await self._broadcast(
+            "RaftRequestVote",
+            {
+                "term": term,
+                "candidate": self.address,
+                "max_volume_id": self.get_max_volume_id(),
+            },
+        )
+        if replies is None:
+            return  # stepped down
         for resp in replies:
-            if resp is None:
-                continue
-            if int(resp.get("term", 0)) > term:
-                self._step_down(int(resp["term"]))
-                return
             if resp.get("granted"):
                 votes += 1
                 # voters report their max so a new leader never regresses
@@ -142,34 +154,61 @@ class RaftLite:
         if votes >= self.majority():
             self.state = LEADER
             self.leader_address = self.address
+            self._last_quorum_contact = time.monotonic()
         else:
             self.state = FOLLOWER
             self._last_heartbeat = time.monotonic()  # back off before retry
 
     async def _lead(self) -> None:
-        req = {
-            "term": self.term,
-            "leader": self.address,
-            "max_volume_id": self.get_max_volume_id(),
-        }
-
-        async def ping(peer: str) -> Optional[dict]:
-            try:
-                return await Stub(grpc_address(peer), "master").call(
-                    "RaftAppendEntries", req, timeout=1.0
-                )
-            except Exception:
-                return None
-
-        replies = await asyncio.gather(*(ping(p) for p in self.others()))
+        replies = await self._broadcast(
+            "RaftAppendEntries",
+            {
+                "term": self.term,
+                "leader": self.address,
+                "max_volume_id": self.get_max_volume_id(),
+            },
+        )
+        if replies is None:
+            return  # stepped down
         for resp in replies:
-            if resp is None:
-                continue
-            if int(resp.get("term", 0)) > self.term:
-                self._step_down(int(resp["term"]))
-                return
             self.adjust_max_volume_id(int(resp.get("max_volume_id", 0)))
+        # A leader partitioned from the quorum must stop acting as one,
+        # or it would keep assigning fids alongside the new leader the
+        # majority elects (classic raft leader lease).
+        if 1 + len(replies) >= self.majority():
+            self._last_quorum_contact = time.monotonic()
+        elif (
+            time.monotonic() - self._last_quorum_contact
+            > ELECTION_TIMEOUT_RANGE[1]
+        ):
+            self.state = FOLLOWER
+            self.leader_address = None
+            self._last_heartbeat = time.monotonic()
         await asyncio.sleep(HEARTBEAT_INTERVAL)
+
+    async def commit_max_volume_id(self, vid: int) -> bool:
+        """Synchronously replicate a freshly assigned max volume id to a
+        majority before it is used, so a leader crash immediately after
+        allocation can never roll volume ids back (the reference commits
+        MaxVolumeIdCommand through the raft log before the id is handed
+        out — topology/cluster_commands.go, topology.go:115-122)."""
+        self.adjust_max_volume_id(vid)
+        if self.single_node:
+            return True
+        if not self.is_leader:
+            return False
+        replies = await self._broadcast(
+            "RaftAppendEntries",
+            {
+                "term": self.term,
+                "leader": self.address,
+                "max_volume_id": max(self.get_max_volume_id(), vid),
+            },
+        )
+        if replies is None:
+            return False  # stepped down
+        acks = 1 + sum(1 for r in replies if r.get("ok"))
+        return acks >= self.majority()
 
     def _step_down(self, term: int) -> None:
         self.term = term

@@ -52,7 +52,10 @@ class VolumeServer(EcHandlers):
         needle_map_kind: str = "memory",
     ):
         self.jwt_signing_key = jwt_signing_key
-        self.master = master
+        # seed master list with failover + leader-hint following
+        # (ref volume_grpc_client_to_master.go:35-57)
+        self.masters = [master] if isinstance(master, str) else list(master)
+        self.master = self.masters[0]
         self.host = host
         self.port = port
         self.address = f"{host}:{port}"
@@ -157,9 +160,19 @@ class VolumeServer(EcHandlers):
         while not self._shutdown:
             try:
                 await self._heartbeat_once()
+                # stream ended cleanly (e.g. follower redirect already
+                # switched self.master) — redial after a pulse
+                await asyncio.sleep(self.pulse_seconds / 2)
             except asyncio.CancelledError:
                 return
             except Exception:
+                # current master unreachable: rotate through the seed list
+                # (ref volume_grpc_client_to_master.go master failover)
+                if self.master in self.masters:
+                    i = self.masters.index(self.master)
+                    self.master = self.masters[(i + 1) % len(self.masters)]
+                else:
+                    self.master = self.masters[0]
                 await asyncio.sleep(self.pulse_seconds)
 
     async def _heartbeat_once(self) -> None:
@@ -176,8 +189,17 @@ class VolumeServer(EcHandlers):
                 resp = await call.read()
                 if resp is grpc.aio.EOF or resp is None:
                     return
-                if isinstance(resp, dict) and resp.get("volume_size_limit"):
+                if not isinstance(resp, dict):
+                    continue
+                if resp.get("volume_size_limit"):
                     self.store.volume_size_limit = int(resp["volume_size_limit"])
+                leader = resp.get("leader")
+                if leader and leader != self.master:
+                    # follow the leader hint; the redial targets it
+                    if leader not in self.masters:
+                        self.masters.append(leader)
+                    self.master = leader
+                    return
 
         reader_task = asyncio.ensure_future(reader())
         try:

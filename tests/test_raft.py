@@ -1,0 +1,160 @@
+"""Multi-master raft-lite: election, leader-kill failover, assign
+continuity with a monotonic max-volume-id
+(ref weed/server/raft_server.go, weed/topology/topology.go:115-122).
+"""
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from seaweedfs_tpu.pb.rpc import close_all_channels
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+
+from test_cluster import free_port_pair
+
+
+async def _wait_for(predicate, timeout=15.0, interval=0.1, msg="condition"):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if predicate():
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class MultiMasterCluster:
+    def __init__(self, tmp_path, n_masters=3, n_volume_servers=2):
+        self.tmp_path = tmp_path
+        self.n_masters = n_masters
+        self.n_vs = n_volume_servers
+        self.masters: list[MasterServer] = []
+        self.volume_servers: list[VolumeServer] = []
+
+    async def start(self):
+        ports = [free_port_pair() for _ in range(self.n_masters)]
+        addrs = [f"127.0.0.1:{p}" for p in ports]
+        for p in ports:
+            m = MasterServer(port=p, pulse_seconds=0.2, peers=addrs)
+            await m.start()
+            self.masters.append(m)
+        await _wait_for(
+            lambda: self.leader() is not None, msg="leader election"
+        )
+        for i in range(self.n_vs):
+            d = self.tmp_path / f"vol{i}"
+            d.mkdir(exist_ok=True)
+            vs = VolumeServer(
+                master=addrs,
+                directories=[str(d)],
+                port=free_port_pair(),
+                pulse_seconds=0.2,
+                max_volume_counts=[20],
+            )
+            await vs.start()
+            self.volume_servers.append(vs)
+        await _wait_for(
+            lambda: self.leader() is not None
+            and len(self.leader().topo.data_nodes()) == self.n_vs,
+            msg="volume servers registered with leader",
+        )
+
+    def leader(self):
+        leaders = [m for m in self.masters if m.raft.is_leader]
+        return leaders[0] if len(leaders) == 1 else None
+
+    def followers(self):
+        return [m for m in self.masters if not m.raft.is_leader]
+
+    async def stop(self):
+        for vs in self.volume_servers:
+            await vs.stop()
+        for m in self.masters:
+            await m.stop()
+        await close_all_channels()
+
+
+def test_election_failover_and_monotonic_assign(tmp_path):
+    async def body():
+        cluster = MultiMasterCluster(tmp_path)
+        try:
+            await cluster.start()
+            leader = cluster.leader()
+            assert leader is not None
+
+            # assign via a FOLLOWER's HTTP endpoint: must proxy to leader
+            follower = cluster.followers()[0]
+            async with aiohttp.ClientSession() as http:
+                async with http.get(
+                    f"http://{follower.address}/dir/assign"
+                ) as resp:
+                    a1 = await resp.json()
+            assert "fid" in a1, a1
+            vid_before = leader.topo.max_volume_id
+            assert vid_before >= 1
+
+            # kill the leader
+            dead = leader.address
+            cluster.masters.remove(leader)
+            await leader.stop()
+
+            # a new leader is elected among the remaining masters
+            await _wait_for(
+                lambda: cluster.leader() is not None, msg="re-election"
+            )
+            new_leader = cluster.leader()
+            assert new_leader.address != dead
+            # max-volume-id agreement survived the failover
+            assert new_leader.topo.max_volume_id >= vid_before
+
+            # volume servers re-register with the new leader
+            await _wait_for(
+                lambda: len(cluster.leader().topo.data_nodes())
+                == cluster.n_vs,
+                msg="volume servers re-registered",
+            )
+
+            # assign keeps working and never regresses volume ids
+            async with aiohttp.ClientSession() as http:
+                for m in cluster.masters:
+                    async with http.get(
+                        f"http://{m.address}/dir/assign"
+                    ) as resp:
+                        a2 = await resp.json()
+                    assert "fid" in a2, a2
+                    new_vid = int(a2["fid"].split(",")[0])
+                    assert new_vid >= 1
+            assert cluster.leader().topo.max_volume_id >= vid_before
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_follower_redirects_streams(tmp_path):
+    """A follower master must not accept heartbeats or KeepConnected
+    clients: it redirects both to the leader."""
+
+    async def body():
+        cluster = MultiMasterCluster(tmp_path, n_volume_servers=1)
+        try:
+            await cluster.start()
+            # only the leader's topology has the data node
+            for m in cluster.followers():
+                assert len(m.topo.data_nodes()) == 0
+            assert len(cluster.leader().topo.data_nodes()) == 1
+
+            # cluster status reflects raft state
+            async with aiohttp.ClientSession() as http:
+                f = cluster.followers()[0]
+                async with http.get(
+                    f"http://{f.address}/cluster/status"
+                ) as resp:
+                    st = await resp.json()
+            assert st["IsLeader"] is False
+            assert st["Leader"] == cluster.leader().address
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
