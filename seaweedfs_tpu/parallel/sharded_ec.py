@@ -26,9 +26,18 @@ from jax import shard_map
 from ..ops.gf256 import gf_matmul_expr, pack_bytes, unpack_bytes
 
 
-def make_mesh(n_devices: int | None = None, vol_axis: int | None = None) -> Mesh:
-    """2-D (vol, blk) mesh over the available devices."""
-    devices = jax.devices()
+def make_mesh(
+    n_devices: int | None = None,
+    vol_axis: int | None = None,
+    devices=None,
+) -> Mesh:
+    """2-D (vol, blk) mesh over the available devices.
+
+    `devices` overrides the default-backend device list — pass
+    jax.devices("cpu") to build a virtual host mesh regardless of which
+    accelerator backend is primary."""
+    if devices is None:
+        devices = jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]
     n = len(devices)

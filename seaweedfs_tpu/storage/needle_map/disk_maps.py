@@ -122,6 +122,12 @@ class SqliteNeedleMap(_MetricProperties):
                     if offset_units > 0 and size != TOMBSTONE_FILE_SIZE:
                         rows.append((key, offset_units, size))
                     else:
+                        # idx entries must apply strictly in order: flush
+                        # buffered puts before the delete, or a
+                        # put-then-delete of the same key inside one batch
+                        # would resurrect the deleted needle
+                        self._put_rows(rows)
+                        rows = []
                         self.db.execute(
                             "DELETE FROM needles WHERE key=?", (key,)
                         )

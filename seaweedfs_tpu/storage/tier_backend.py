@@ -136,7 +136,13 @@ class S3File:
         )
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:
-                return resp.read()
+                data = resp.read()
+                if resp.status == 206:
+                    return data
+                # 200: the endpoint ignored Range and sent the whole
+                # object — slice out the requested window instead of
+                # handing back the full body as if it started at offset
+                return data[offset : offset + size]
         except urllib.error.HTTPError as e:
             if e.code == 416:
                 return b""

@@ -26,6 +26,10 @@ def parse_range(rng: str, total: int) -> RangeResult:
         if start_s == "":
             if end_s == "":
                 return None
+            if int(end_s) == 0:
+                # 'bytes=-0' is a zero-length suffix: unsatisfiable per
+                # RFC 9110 (matches Go http.ServeContent)
+                return "invalid-range"
             start, end = max(0, total - int(end_s)), total - 1
         else:
             start = int(start_s)

@@ -110,6 +110,28 @@ def test_sqlite_regenerates_from_idx(tmp_path):
     m2.close()
 
 
+def test_sqlite_regenerate_applies_idx_strictly_in_order(tmp_path):
+    """A put followed by a delete of the same key within one rebuild
+    batch must not resurrect the deleted needle (regression: deletes
+    used to execute before the buffered put batch flushed)."""
+    idx = str(tmp_path / "1.idx")
+    entries = ENTRIES + [(42, 48, 700), (42, 0, TOMBSTONE_FILE_SIZE)]
+    write_idx(idx, entries)
+    m = SqliteNeedleMap(idx)
+    assert m.get(42) is None
+    m.close()
+    # and a delete-then-re-put keeps the re-put (close() stamps the db
+    # fresh, so rewrite + utime the idx only after closing)
+    write_idx(
+        idx,
+        entries + [(42, 56, 800)],
+    )
+    os.utime(idx)
+    m2 = SqliteNeedleMap(idx)
+    assert m2.get(42) is not None and m2.get(42).size == 800
+    m2.close()
+
+
 def test_sorted_map_put_rejected(tmp_path):
     idx = str(tmp_path / "1.idx")
     write_idx(idx, ENTRIES)

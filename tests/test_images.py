@@ -166,6 +166,8 @@ def test_parse_range():
     assert pr("bytes=-10", 100) == (90, 99)
     assert pr("bytes=0-500", 100) == (0, 99)
     assert pr("bytes=200-300", 100) == "invalid-range"
+    # zero-length suffix is unsatisfiable (RFC 9110, Go ServeContent)
+    assert pr("bytes=-0", 100) == "invalid-range"
     # malformed headers are ignored -> full 200 response
     assert pr("bytes=abc-def", 100) is None
     assert pr("bytes=-", 100) is None

@@ -171,6 +171,39 @@ def test_s3_backend_against_own_gateway(tmp_path):
     asyncio.run(body())
 
 
+def test_s3file_read_at_endpoint_without_range_support():
+    """An S3-compatible endpoint that ignores Range and replies 200 with
+    the full body must still yield exactly `size` bytes at `offset`."""
+    import http.server
+    import threading
+
+    from seaweedfs_tpu.storage.tier_backend import S3File
+
+    body = bytes(range(256)) * 4
+
+    class NoRangeHandler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)  # Range header deliberately ignored
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), NoRangeHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        f = S3File(
+            f"http://127.0.0.1:{srv.server_address[1]}", "bucket", "key"
+        )
+        assert f.read_at(10, 100) == body[100:110]
+        assert f.read_at(5, 0) == body[:5]
+    finally:
+        srv.shutdown()
+
+
 def test_tier_rpc_and_shell_commands(tmp_path):
     from test_cluster import Cluster
 
