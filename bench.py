@@ -1,18 +1,27 @@
-"""North-star benchmark: RS(10,4) ec.encode throughput on TPU vs CPU baseline.
+"""North-star benchmarks: RS(10,4) ec.encode throughput + bulk needle-index
+lookup QPS on TPU vs CPU baselines.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"},
+where "extra" carries the secondary metrics (BASELINE.json configs 3 & 4).
 
-- TPU number: steady-state Pallas GF(2^8) encode over HBM-resident packed
-  stripe batches (the BASELINE.json batched-multi-volume configuration).
-  Timing uses K-run slope with a host digest pull per measurement, because
-  block_until_ready on tunneled backends can return before execution
-  completes — the slope between K=4 and K=20 cancels the constant RTT.
-- CPU baseline: the same encode via the native C++ SSSE3 PSHUFB kernel,
-  single-threaded — the same technique as the reference's
+- ec.encode TPU number: steady-state Pallas GF(2^8) encode over HBM-resident
+  packed stripe batches (the BASELINE.json batched-multi-volume
+  configuration). Timing uses K-run slope with a host digest pull per
+  measurement, because block_until_ready on tunneled backends can return
+  before execution completes — the slope between K=8 and K=64 cancels the
+  constant RTT.
+- ec.encode CPU baseline: the same encode via the native C++ SSSE3 PSHUFB
+  kernel, single-threaded — the same technique as the reference's
   klauspost/reedsolomon pipeline (ref: ec_encoder.go:120-136; BASELINE.md
   notes the reference publishes no ec.encode number, so we measure the
   strongest honest equivalent on this host). Falls back to the numpy table
   path when no C++ toolchain is available.
+- needle_lookup TPU number: 10M fid probes against a 10M-entry device-
+  resident IndexSnapshot (the Volume.bulk_lookup serving path) as one
+  batched branchless binary search; slope-timed like the encode.
+- needle_lookup CPU baseline: the same probes through CompactMap.get — the
+  per-request search the reference serves reads from
+  (ref: compact_map.go:145-172), measured on a 1M-probe subset.
 """
 
 from __future__ import annotations
@@ -61,14 +70,109 @@ def measure_tpu(parity_matrix, packed_np: np.ndarray) -> float:
         _ = np.asarray(digest(out))  # forces the whole FIFO queue to drain
         return time.perf_counter() - t0
 
+    return n_bytes / _slope_time(run) / 1e9
+
+
+def _slope_time(run, k_lo: int = 8, k_hi: int = 64, reps: int = 5) -> float:
+    """Per-iteration seconds from the K-run slope (cancels constant RTT)."""
     run(2)  # warm the pull path
-    k_lo, k_hi = 8, 64
-    t_lo = min(run(k_lo) for _ in range(5))
-    t_hi = min(run(k_hi) for _ in range(5))
+    t_lo = min(run(k_lo) for _ in range(reps))
+    t_hi = min(run(k_hi) for _ in range(reps))
     per_iter = (t_hi - t_lo) / (k_hi - k_lo)
     if per_iter <= 0:  # RTT noise swamped the slope; fall back to bulk timing
         per_iter = t_hi / k_hi
-    return n_bytes / per_iter / 1e9
+    return per_iter
+
+
+def measure_lookup(
+    n_entries: int = 10_000_000, n_probes: int = 10_000_000
+) -> tuple[float, float]:
+    """-> (tpu_qps, cpu_qps) for bulk fid->(offset,size) probes."""
+    import jax
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ops.index_kernel import (
+        IndexSnapshot,
+        _bulk_lookup,
+        _bulk_lookup_bucketed,
+        _split_u64,
+    )
+    from seaweedfs_tpu.storage.needle_map import CompactMap
+
+    rng = np.random.default_rng(2)
+    gaps = rng.integers(1, 20, size=n_entries, dtype=np.uint64)
+    keys = np.cumsum(gaps).astype(np.uint64)  # sorted unique
+    offsets = rng.integers(1, 1 << 30, size=n_entries, dtype=np.uint64).astype(
+        np.uint32
+    )
+    sizes = rng.integers(1, 1 << 20, size=n_entries, dtype=np.uint64).astype(
+        np.uint32
+    )
+    probes = keys[rng.integers(0, n_entries, size=n_probes)]
+
+    # --- device path: table + probes HBM-resident, slope-timed ---
+    snap = IndexSnapshot(keys, offsets, sizes)
+    phi, plo = _split_u64(probes)
+    phi_d = jax.device_put(jnp.asarray(phi))
+    plo_d = jax.device_put(jnp.asarray(plo))
+    digest = jax.jit(lambda o, s, f: o.sum(dtype=jnp.uint32))
+
+    if snap.starts is not None:
+        b_d = jax.device_put(jnp.asarray(snap._bucket_of(probes)))
+
+        def encode_once():
+            return _bulk_lookup_bucketed(
+                snap.bsteps,
+                snap.khi,
+                snap.klo,
+                snap.offsets,
+                snap.sizes,
+                snap.starts,
+                phi_d,
+                plo_d,
+                b_d,
+            )
+
+    else:
+
+        def encode_once():
+            return _bulk_lookup(
+                snap.steps,
+                snap.khi,
+                snap.klo,
+                snap.offsets,
+                snap.sizes,
+                phi_d,
+                plo_d,
+            )
+
+    _ = np.asarray(digest(*encode_once()))  # compile + warm
+
+    def run(k: int) -> float:
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(k):
+            out = encode_once()
+        _ = np.asarray(digest(*out))
+        return time.perf_counter() - t0
+
+    tpu_qps = n_probes / _slope_time(run, k_lo=2, k_hi=10, reps=3)
+
+    # --- CPU baseline: CompactMap.get per probe (1M subset) ---
+    sub = min(n_entries, 1_000_000)
+    cm = CompactMap()
+    set_ = cm.set
+    for k, o, s in zip(
+        keys[:sub].tolist(), offsets[:sub].tolist(), sizes[:sub].tolist()
+    ):
+        set_(k, o, s)
+    cpu_probe_keys = [int(k) for k in keys[rng.integers(0, sub, size=sub)]]
+    get = cm.get
+    t0 = time.perf_counter()
+    for k in cpu_probe_keys:
+        get(k)
+    cpu_qps = len(cpu_probe_keys) / (time.perf_counter() - t0)
+    return tpu_qps, cpu_qps
 
 
 def main() -> None:
@@ -89,6 +193,20 @@ def main() -> None:
     packed = pack_bytes_host(data)
     tpu_gbps = measure_tpu(codec.parity_matrix, packed)
 
+    extra = []
+    try:
+        lookup_qps, lookup_cpu_qps = measure_lookup()
+        extra.append(
+            {
+                "metric": "needle_lookup_qps",
+                "value": round(lookup_qps),
+                "unit": "probes/s",
+                "vs_baseline": round(lookup_qps / lookup_cpu_qps, 2),
+            }
+        )
+    except Exception as e:  # never lose the headline metric to a new bench
+        extra.append({"metric": "needle_lookup_qps", "error": str(e)[:200]})
+
     print(
         json.dumps(
             {
@@ -96,6 +214,7 @@ def main() -> None:
                 "value": round(tpu_gbps, 3),
                 "unit": "GB/s",
                 "vs_baseline": round(tpu_gbps / cpu_gbps, 2),
+                "extra": extra,
             }
         )
     )

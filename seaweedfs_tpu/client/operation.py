@@ -102,6 +102,46 @@ async def lookup(master: str, vid: int, collection: str = "") -> list[str]:
     return []
 
 
+async def bulk_lookup(server: str, vid: int, keys) -> tuple:
+    """Batched fid -> (offset, size) probes against a volume server's
+    device-resident index snapshot (BulkLookup RPC; no reference
+    equivalent — the Go client probes one file id at a time).
+
+    Returns (offset_units u32[P], sizes u32[P], found bool[P]).
+    """
+    import numpy as np
+
+    keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64), dtype="<u8")
+    stub = Stub(grpc_address(server), "volume")
+    resp = await stub.call(
+        "BulkLookup", {"volume_id": vid, "keys": keys.tobytes()}
+    )
+    if resp.get("error"):
+        raise RuntimeError(f"bulk_lookup: {resp['error']}")
+    return (
+        np.frombuffer(resp["offsets"], dtype="<u4").astype(np.uint32),
+        np.frombuffer(resp["sizes"], dtype="<u4").astype(np.uint32),
+        np.frombuffer(resp["found"], dtype=np.uint8).astype(bool),
+    )
+
+
+async def batch_read(server: str, vid: int, keys) -> list[Optional[bytes]]:
+    """Bulk needle reads through the BatchRead stream; returns each probe's
+    data bytes in order (None for missing/deleted needles)."""
+    import numpy as np
+
+    keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64), dtype="<u8")
+    stub = Stub(grpc_address(server), "volume")
+    out: dict[int, Optional[bytes]] = {}
+    async for msg in stub.server_stream(
+        "BatchRead", {"volume_id": vid, "keys": keys.tobytes()}
+    ):
+        if msg.get("error") and "key" not in msg:
+            raise RuntimeError(f"batch_read: {msg['error']}")
+        out[int(msg["key"])] = msg.get("data") if msg.get("found") else None
+    return [out.get(int(k)) for k in keys]
+
+
 async def submit_file(
     session: aiohttp.ClientSession,
     master: str,

@@ -35,6 +35,16 @@ from ..storage import vacuum as vacuum_mod
 from .volume_ec import EcHandlers
 
 
+def _decode_keys(req: dict):
+    """BulkLookup/BatchRead probe keys: <u8-LE bytes or list[int] -> u64[P]."""
+    import numpy as np
+
+    raw = req.get("keys", b"")
+    if isinstance(raw, (bytes, bytearray)):
+        return np.frombuffer(raw, dtype="<u8").astype(np.uint64)
+    return np.asarray(raw, dtype=np.uint64)
+
+
 class VolumeServer(EcHandlers):
     def __init__(
         self,
@@ -122,6 +132,8 @@ class VolumeServer(EcHandlers):
         svc.unary("VacuumVolumeCommit")(self._grpc_vacuum_commit)
         svc.unary("VacuumVolumeCleanup")(self._grpc_vacuum_cleanup)
         svc.unary("BatchDelete")(self._grpc_batch_delete)
+        svc.unary("BulkLookup")(self._grpc_bulk_lookup)
+        svc.server_stream("BatchRead")(self._grpc_batch_read)
         svc.unary("VolumeServerStatus")(self._grpc_status)
         svc.server_stream("CopyFile")(self._grpc_copy_file)
         svc.unary("VolumeCopy")(self._grpc_volume_copy)
@@ -622,6 +634,99 @@ class VolumeServer(EcHandlers):
             except Exception as e:
                 results.append({"file_id": fid_str, "status": 500, "error": str(e)})
         return {"results": results}
+
+    async def _grpc_bulk_lookup(self, req, context) -> dict:
+        """Batched fid -> (offset, size) probes served from the
+        device-resident index snapshot (the TPU read north star — the
+        reference runs one CompactMap binary search per request,
+        ref compact_map.go:145-172; this RPC has no Go equivalent).
+
+        req:  {volume_id, keys: <u8-LE bytes | list[int]}
+        resp: {offsets: <u4-LE bytes, sizes: <u4-LE bytes, found: u8 bytes}
+        columns aligned with the probe order.
+        """
+        import numpy as np
+
+        vid = int(req["volume_id"])
+        keys = _decode_keys(req)
+        v = self.store.find_volume(vid)
+        loop = asyncio.get_event_loop()
+        if v is not None:
+            offsets, sizes, found = await loop.run_in_executor(
+                None, v.bulk_lookup, keys
+            )
+        else:
+            ev = self.store.find_ec_volume(vid)
+            if ev is None:
+                return {"error": f"volume {vid} not found"}
+            offsets, sizes, found = await loop.run_in_executor(
+                None, ev.bulk_locate, keys
+            )
+        return {
+            "offsets": np.ascontiguousarray(offsets, dtype="<u4").tobytes(),
+            "sizes": np.ascontiguousarray(sizes, dtype="<u4").tobytes(),
+            "found": np.ascontiguousarray(found, dtype=np.uint8).tobytes(),
+        }
+
+    async def _grpc_batch_read(self, req, context):
+        """Bulk needle reads: one device-batched index probe, then record
+        preads. Yields {key, found[, cookie, data, size]} per probe in order.
+
+        req: {volume_id, keys: <u8-LE bytes | list[int]}
+        """
+        vid = int(req["volume_id"])
+        keys = _decode_keys(req)
+        loop = asyncio.get_event_loop()
+        v = self.store.find_volume(vid)
+        if v is not None:
+            offsets, sizes, found = await loop.run_in_executor(
+                None, v.bulk_lookup, keys
+            )
+            for i, key in enumerate(keys):
+                if not found[i]:
+                    yield {"key": int(key), "found": False}
+                    continue
+                try:
+                    # locked pread + TTL check; a vacuum commit racing the
+                    # stream surfaces as a per-key miss, not a dead stream
+                    n = await loop.run_in_executor(
+                        None, v.read_needle_at, int(offsets[i]), int(sizes[i])
+                    )
+                except Exception as e:
+                    yield {"key": int(key), "found": False, "error": str(e)}
+                    continue
+                yield {
+                    "key": int(key),
+                    "found": True,
+                    "cookie": n.cookie,
+                    "size": int(sizes[i]),
+                    "data": bytes(n.data),
+                }
+            return
+        ev = self.store.find_ec_volume(vid)
+        if ev is None:
+            yield {"error": f"volume {vid} not found"}
+            return
+        offsets, sizes, found = await loop.run_in_executor(
+            None, ev.bulk_locate, keys
+        )
+        for i, key in enumerate(keys):
+            if not found[i]:
+                yield {"key": int(key), "found": False}
+                continue
+            n = await self.read_ec_needle_at(
+                ev, int(key), int(offsets[i]), int(sizes[i])
+            )
+            if n is None:
+                yield {"key": int(key), "found": False}
+                continue
+            yield {
+                "key": int(key),
+                "found": True,
+                "cookie": n.cookie,
+                "size": len(n.data),
+                "data": bytes(n.data),
+            }
 
     async def _grpc_status(self, req, context) -> dict:
         return {

@@ -408,7 +408,14 @@ class EcHandlers:
             return None
         if size == TOMBSTONE_FILE_SIZE:
             return None
-        _, _, intervals = ev.locate_needle(key)
+        return await self.read_ec_needle_at(ev, key, offset_units, size)
+
+    async def read_ec_needle_at(
+        self, ev: EcVolume, key: int, offset_units: int, size: int
+    ) -> Optional[Needle]:
+        """Interval reads for an already-located needle (the bulk path hands
+        in offsets from EcVolume.bulk_locate instead of re-searching)."""
+        intervals = ev.intervals_for(offset_units, size)
         chunks = []
         for iv in intervals:
             shard_id, shard_offset = iv.to_shard_id_and_offset(

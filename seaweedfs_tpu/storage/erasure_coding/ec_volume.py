@@ -179,6 +179,12 @@ class EcVolume:
         self.shard_locations: dict[int, list[str]] = {}
         self.shard_locations_lock = threading.RLock()
         self.shard_locations_refresh_time = 0.0
+        # device-resident .ecx snapshot for bulk probes; invalidated on
+        # tombstone writes (see bulk_locate)
+        self._ecx_accel = None
+        self._ecx_mutations = 0
+        self._ecx_accel_token = -1
+        self._ecx_accel_lock = threading.Lock()
 
     def file_name(self) -> str:
         return ec_shard_file_name(self.collection, self.dir, self.volume_id)
@@ -224,19 +230,80 @@ class EcVolume:
             self._ecx, self.ecx_file_size, needle_id
         )
 
-    def locate_needle(self, needle_id: int) -> tuple[int, int, list[Interval]]:
-        """-> (offset_units, size, intervals)
-        (ref LocateEcShardNeedle, ec_volume.go:190-206)."""
-        offset_units, size = self.find_needle_from_ecx(needle_id)
+    def ecx_snapshot(self):
+        """Live .ecx entries as sorted numpy columns
+        (keys u64[n], offset_units u32[n], sizes u32[n]) — the probe table
+        for the bulk-lookup kernel. Tombstoned entries are excluded."""
+        import numpy as np
+
+        raw = np.frombuffer(
+            os.pread(self._ecx.fileno(), self.ecx_file_size, 0),
+            dtype=np.dtype([("key", ">u8"), ("offset", ">u4"), ("size", ">u4")]),
+        )
+        live = raw["size"] != TOMBSTONE_FILE_SIZE
+        return (
+            raw["key"][live].astype(np.uint64),
+            raw["offset"][live].astype(np.uint32),
+            raw["size"][live].astype(np.uint32),
+        )
+
+    def bulk_locate(self, needle_ids, use_device: Optional[bool] = None):
+        """Batched .ecx probes -> (offset_units u32[P], sizes u32[P],
+        found bool[P]).
+
+        The bulk analogue of find_needle_from_ecx: one vectorized binary
+        search on a cached device-resident snapshot instead of P on-disk
+        searches (ref SearchNeedleFromSortedIndex, ec_volume.go:210-235).
+        """
+        import numpy as np
+
+        needle_ids = np.asarray(needle_ids, dtype=np.uint64)
+        if use_device is None:
+            # tiny batches aren't worth a device dispatch / first-use compile
+            from ..volume import _device_available
+
+            use_device = len(needle_ids) >= 64 and _device_available()
+        if not use_device:
+            offsets = np.zeros(len(needle_ids), dtype=np.uint32)
+            sizes = np.zeros(len(needle_ids), dtype=np.uint32)
+            found = np.zeros(len(needle_ids), dtype=bool)
+            for i, k in enumerate(needle_ids):
+                try:
+                    o, s = self.find_needle_from_ecx(int(k))
+                except NeedleNotFound:
+                    continue
+                if s != TOMBSTONE_FILE_SIZE:
+                    offsets[i], sizes[i], found[i] = o, s, True
+            return offsets, sizes, found
+
+        with self._ecx_accel_lock:
+            # capture the token BEFORE reading the file: a delete racing the
+            # read leaves token != mutations, forcing a rebuild next call
+            token = self._ecx_mutations
+            if self._ecx_accel is None or self._ecx_accel_token != token:
+                from ...ops.index_kernel import IndexSnapshot
+
+                self._ecx_accel = IndexSnapshot(*self.ecx_snapshot())
+                self._ecx_accel_token = token
+            accel = self._ecx_accel
+        return accel.lookup(needle_ids)
+
+    def intervals_for(self, offset_units: int, size: int) -> list[Interval]:
+        """Shard intervals for an already-located needle."""
         shard_size = self.shard_size()
-        intervals = locate_data(
+        return locate_data(
             EC_LARGE_BLOCK_SIZE,
             EC_SMALL_BLOCK_SIZE,
             DATA_SHARDS_COUNT * shard_size,
             to_actual_offset(offset_units),
             get_actual_size(size, self.version),
         )
-        return offset_units, size, intervals
+
+    def locate_needle(self, needle_id: int) -> tuple[int, int, list[Interval]]:
+        """-> (offset_units, size, intervals)
+        (ref LocateEcShardNeedle, ec_volume.go:190-206)."""
+        offset_units, size = self.find_needle_from_ecx(needle_id)
+        return offset_units, size, self.intervals_for(offset_units, size)
 
     # --- delete ---
     def delete_needle_from_ecx(self, needle_id: int) -> None:
@@ -248,6 +315,7 @@ class EcVolume:
             )
         except NeedleNotFound:
             return
+        self._ecx_mutations += 1
         with self._ecj_lock:
             self._ecj.seek(0, 2)
             self._ecj.write(needle_id_to_bytes(needle_id))

@@ -25,18 +25,20 @@ from .needle_value import NeedleValue
 
 
 class CompactMap:
-    __slots__ = ("_map", "_snapshot", "_dirty")
+    __slots__ = ("_map", "_snapshot", "_dirty", "_mutations")
 
     def __init__(self):
         self._map: dict[int, tuple[int, int]] = {}
         self._snapshot: Optional[tuple[np.ndarray, np.ndarray, np.ndarray]] = None
         self._dirty = True
+        self._mutations = 0
 
     def set(self, key: int, offset_units: int, size: int) -> tuple[int, int]:
         """Insert/overwrite; returns (old_offset_units, old_size) — (0, 0) if new."""
         old = self._map.get(key)
         self._map[key] = (offset_units, size)
         self._dirty = True
+        self._mutations += 1
         return old if old is not None else (0, 0)
 
     def delete(self, key: int) -> int:
@@ -47,9 +49,15 @@ class CompactMap:
         offset_units, size = old
         self._map[key] = (offset_units, TOMBSTONE_FILE_SIZE)
         self._dirty = True
+        self._mutations += 1
         if size == TOMBSTONE_FILE_SIZE:
             return 0
         return size
+
+    def snapshot_token(self) -> int:
+        """Monotonic mutation counter: equal tokens mean snapshot() would
+        return identical columns — the device-side cache key."""
+        return self._mutations
 
     def get(self, key: int) -> Optional[NeedleValue]:
         v = self._map.get(key)

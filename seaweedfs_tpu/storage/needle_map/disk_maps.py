@@ -112,6 +112,7 @@ class SqliteNeedleMap(_MetricProperties):
         if not fresh:
             self._generate_db_from_idx()
         self.metric = metric_from_index_file(idx_path)
+        self._mutations = 0
 
     def _generate_db_from_idx(self) -> None:
         self.db.execute("DELETE FROM needles")
@@ -151,6 +152,7 @@ class SqliteNeedleMap(_MetricProperties):
             self._idx.append(entry_to_bytes(key, offset_units, size))
             self._put_rows([(key, offset_units, size)])
             self.metric.log_put(key, old[0] if old else 0, size)
+            self._mutations += 1
 
     def get(self, key: int) -> Optional[NeedleValue]:
         with self._db_lock:
@@ -171,6 +173,7 @@ class SqliteNeedleMap(_MetricProperties):
             )
             self.db.execute("DELETE FROM needles WHERE key=?", (key,))
             self.metric.log_delete(row[0] if row else 0)
+            self._mutations += 1
 
     def ascending_visit(self, visit) -> None:
         with self._db_lock:
@@ -181,6 +184,9 @@ class SqliteNeedleMap(_MetricProperties):
             )
         for key, offset_units, size in rows:
             visit(NeedleValue(key=key, offset_units=offset_units, size=size))
+
+    def snapshot_token(self) -> int:
+        return self._mutations
 
     def index_file_size(self) -> int:
         return self._idx.size()
@@ -262,6 +268,7 @@ class SortedFileNeedleMap(_MetricProperties):
         )
         self._search(key, mark_needle_deleted)
         self.metric.log_delete(found[1])
+        self._mutations = getattr(self, "_mutations", 0) + 1
 
     def ascending_visit(self, visit) -> None:
         with open(self.sdx_path, "rb") as f:
@@ -269,6 +276,9 @@ class SortedFileNeedleMap(_MetricProperties):
                 visit(
                     NeedleValue(key=key, offset_units=offset_units, size=size)
                 )
+
+    def snapshot_token(self) -> int:
+        return getattr(self, "_mutations", 0)
 
     def index_file_size(self) -> int:
         return self._idx.size()

@@ -43,6 +43,9 @@ class NeedleMap:
     def snapshot(self):
         return self.m.snapshot()
 
+    def snapshot_token(self) -> int:
+        return self.m.snapshot_token()
+
     def index_file_size(self) -> int:
         return self._idx.size()
 

@@ -45,6 +45,23 @@ from .super_block import SUPER_BLOCK_SIZE, SuperBlock, read_super_block
 from .ttl import EMPTY_TTL
 
 
+_DEVICE_OK: Optional[bool] = None
+
+
+def _device_available() -> bool:
+    """True when jax can run the bulk-lookup program (any backend)."""
+    global _DEVICE_OK
+    if _DEVICE_OK is None:
+        try:
+            import jax
+
+            jax.devices()
+            _DEVICE_OK = True
+        except Exception:
+            _DEVICE_OK = False
+    return _DEVICE_OK
+
+
 class NotFound(Exception):
     pass
 
@@ -114,6 +131,10 @@ class Volume:
         self.last_compact_index_offset = 0
         self.last_compact_revision = 0
         self._lock = threading.RLock()
+        # device-resident index snapshot for bulk probes, keyed by the
+        # map's mutation token (see bulk_lookup)
+        self._index_accel = None
+        self._index_accel_token: Optional[int] = None
 
         base = self.file_name()
         dat_exists = os.path.exists(base + ".dat")
@@ -358,6 +379,83 @@ class Volume:
             if n.has_last_modified_date() and time.time() >= n.last_modified + n.ttl.minutes * 60:
                 raise NotFound(f"needle {n.id} expired")
         return len(n.data)
+
+    def bulk_lookup(self, keys, use_device: Optional[bool] = None):
+        """Batched fid -> (offset, size) index probes.
+
+        This is the TPU read north star: instead of one binary search per
+        request (ref: weed/storage/needle_map/compact_map.go:145-172), bulk
+        probes run as a single branchless batched binary search over the
+        device-resident IndexSnapshot (ops/index_kernel.py). The snapshot is
+        cached per volume and invalidated by the map's mutation token, so
+        steady-state serving costs no host->device transfer of the table.
+
+        Returns (offset_units u32[P], sizes u32[P], found bool[P]); a probe
+        of a deleted or absent needle reports found=False.
+        """
+        import numpy as _np
+
+        keys = _np.asarray(keys, dtype=_np.uint64)
+        snap_fn = getattr(self.nm, "snapshot", None)
+        if use_device is None:
+            # tiny batches aren't worth a device dispatch (or, on first
+            # use, a jit compile) — serve them from the host map
+            use_device = (
+                snap_fn is not None and len(keys) >= 64 and _device_available()
+            )
+        if not use_device or snap_fn is None:
+            offsets = _np.zeros(len(keys), dtype=_np.uint32)
+            sizes = _np.zeros(len(keys), dtype=_np.uint32)
+            found = _np.zeros(len(keys), dtype=bool)
+            for i, k in enumerate(keys):
+                nv = self.nm.get(int(k))
+                if (
+                    nv is not None
+                    and nv.offset_units != 0
+                    and nv.size != TOMBSTONE_FILE_SIZE
+                ):
+                    offsets[i] = nv.offset_units
+                    sizes[i] = nv.size
+                    found[i] = True
+            return offsets, sizes, found
+
+        cols = None
+        with self._lock:
+            token = self.nm.snapshot_token()
+            if self._index_accel is not None and self._index_accel_token == token:
+                accel = self._index_accel
+            else:
+                cols = self.nm.snapshot()  # consistent with token under lock
+        if cols is not None:
+            # device upload + bucket build happen OUTSIDE the lock so
+            # concurrent reads/writes aren't stalled behind it
+            from ..ops.index_kernel import IndexSnapshot
+
+            accel = IndexSnapshot(*cols)
+            with self._lock:
+                if (
+                    self._index_accel is None
+                    or self._index_accel_token is None
+                    or self._index_accel_token < token
+                ):
+                    self._index_accel = accel
+                    self._index_accel_token = token
+        return accel.lookup(keys)
+
+    def read_needle_at(self, offset_units: int, size: int) -> Needle:
+        """pread one record at a known index location, under the volume lock
+        and with the same TTL-expiry visibility as read_needle."""
+        with self._lock:
+            n = read_needle_data(
+                self.data_backend, to_actual_offset(offset_units), size, self.version
+            )
+        if n.has_ttl() and n.ttl is not None and n.ttl.minutes:
+            if (
+                n.has_last_modified_date()
+                and time.time() >= n.last_modified + n.ttl.minutes * 60
+            ):
+                raise NotFound(f"needle {n.id} expired")
+        return n
 
     def sync(self) -> None:
         self.nm.sync()

@@ -1,0 +1,158 @@
+"""Bulk index-lookup serving path: Volume.bulk_lookup, EcVolume.bulk_locate,
+and the BulkLookup / BatchRead volume-server RPCs.
+
+The device path runs the batched binary search of ops/index_kernel.py over a
+cached snapshot; these tests assert parity with the per-key map path
+(ref: weed/storage/needle_map/compact_map.go:145-172 — the search this
+replaces) plus cache invalidation on writes/deletes.
+"""
+
+import asyncio
+import random
+
+import aiohttp
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage.erasure_coding import (
+    write_ec_files,
+    write_sorted_file_from_idx,
+)
+from seaweedfs_tpu.storage.erasure_coding.ec_volume import EcVolume
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+
+LARGE_BLOCK = 1 << 14
+SMALL_BLOCK = 1 << 10
+
+
+def new_needle(nid: int, size: int = 100, cookie: int = 0x42) -> Needle:
+    n = Needle(cookie=cookie, id=nid)
+    n.data = random.randbytes(size)
+    return n
+
+
+def _fill_volume(v: Volume, n_keys: int = 200) -> list[int]:
+    keys = sorted(random.sample(range(1, 1 << 40), n_keys))
+    for k in keys:
+        v.write_needle(new_needle(k, size=random.randint(1, 300)))
+    return keys
+
+
+@pytest.mark.parametrize("use_device", [True, False])
+def test_volume_bulk_lookup_matches_per_key(tmp_path, use_device):
+    random.seed(17)
+    v = Volume(str(tmp_path), "", 1)
+    keys = _fill_volume(v)
+    deleted = keys[::5]
+    for k in deleted:
+        v.delete_needle(Needle(id=k, cookie=0x42))
+
+    probes = np.array(
+        keys + [7, 9, (1 << 41) + 3], dtype=np.uint64
+    )  # all keys + misses
+    offsets, sizes, found = v.bulk_lookup(probes, use_device=use_device)
+    for i, k in enumerate(keys):
+        nv = v.nm.get(k)
+        if k in deleted:
+            assert not found[i], k
+        else:
+            assert found[i], k
+            assert offsets[i] == nv.offset_units
+            assert sizes[i] == nv.size
+    assert not found[-3:].any()
+    v.close()
+
+
+def test_volume_bulk_lookup_cache_invalidation(tmp_path):
+    random.seed(5)
+    v = Volume(str(tmp_path), "", 2)
+    v.write_needle(new_needle(10))
+    probes = np.array([10, 11], dtype=np.uint64)
+    _, _, found = v.bulk_lookup(probes, use_device=True)
+    assert found[0] and not found[1]
+
+    # a write after the snapshot must be visible to the next bulk probe
+    v.write_needle(new_needle(11))
+    _, _, found = v.bulk_lookup(probes, use_device=True)
+    assert found.all()
+
+    # ... and so must a delete
+    v.delete_needle(Needle(id=10, cookie=0x42))
+    _, _, found = v.bulk_lookup(probes, use_device=True)
+    assert not found[0] and found[1]
+    v.close()
+
+
+def test_ec_bulk_locate_matches_disk_search(tmp_path):
+    random.seed(23)
+    v = Volume(str(tmp_path), "", 3)
+    keys = _fill_volume(v, 120)
+    v.close()
+    base = v.file_name()
+    write_ec_files(
+        base, large_block_size=LARGE_BLOCK, small_block_size=SMALL_BLOCK
+    )
+    write_sorted_file_from_idx(base)
+
+    ev = EcVolume(str(tmp_path), "", 3)
+    probes = np.array(keys + [3, 5], dtype=np.uint64)
+    off_dev, size_dev, found_dev = ev.bulk_locate(probes)
+    off_cpu, size_cpu, found_cpu = ev.bulk_locate(probes, use_device=False)
+    assert np.array_equal(found_dev, found_cpu)
+    assert np.array_equal(off_dev, off_cpu)
+    assert np.array_equal(size_dev, size_cpu)
+    assert found_dev[: len(keys)].all()
+    assert not found_dev[len(keys) :].any()
+
+    # tombstoning through the ecx must invalidate the device snapshot
+    ev.delete_needle_from_ecx(keys[0])
+    _, _, found = ev.bulk_locate(probes)
+    assert not found[0] and found[1]
+    ev.close()
+
+
+def test_volume_server_bulk_rpcs(tmp_path):
+    from tests.test_cluster import Cluster
+
+    from seaweedfs_tpu.client import assign
+    from seaweedfs_tpu.client.operation import (
+        batch_read,
+        bulk_lookup,
+        upload_data,
+    )
+
+    async def body():
+        random.seed(31)
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                payloads = {}
+                vid = None
+                server = None
+                from seaweedfs_tpu.storage.file_id import FileId
+
+                for i in range(20):
+                    ar = await assign(cluster.master.address)
+                    data = random.randbytes(64 + i)
+                    await upload_data(session, ar.url, ar.fid, data)
+                    fid = FileId.parse(ar.fid)
+                    if vid is None:
+                        vid, server = fid.volume_id, ar.url
+                    if fid.volume_id == vid:
+                        payloads[fid.key] = data
+
+                keys = sorted(payloads) + [999999999]
+                offsets, sizes, found = await bulk_lookup(server, vid, keys)
+                assert found[: len(payloads)].all()
+                assert not found[-1]
+
+                datas = await batch_read(server, vid, keys)
+                for i, k in enumerate(sorted(payloads)):
+                    assert datas[i] == payloads[k]
+                assert datas[-1] is None
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
