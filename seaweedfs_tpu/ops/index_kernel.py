@@ -19,6 +19,7 @@ in serving overlaps with device compute.
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -134,7 +135,13 @@ class IndexSnapshot:
             p = len(probe_keys)
             z = np.zeros(p, dtype=np.uint32)
             return z, z.copy(), np.zeros(p, dtype=bool)
-        probe_keys = np.asarray(probe_keys)
+        probe_keys = np.asarray(probe_keys, dtype=np.uint64)
+        p = len(probe_keys)
+        # pad the batch to a power of two so arbitrary client batch sizes
+        # don't each compile (and cache) a fresh executable
+        p2 = max(64, 1 << (p - 1).bit_length())
+        if p2 != p:
+            probe_keys = np.pad(probe_keys, (0, p2 - p))
         phi, plo = _split_u64(probe_keys)
         if self.starts is not None:
             off, size, found = _bulk_lookup_bucketed(
@@ -158,4 +165,40 @@ class IndexSnapshot:
                 jnp.asarray(phi),
                 jnp.asarray(plo),
             )
-        return np.asarray(off), np.asarray(size), np.asarray(found)
+        return (
+            np.asarray(off)[:p],
+            np.asarray(size)[:p],
+            np.asarray(found)[:p],
+        )
+
+
+class SnapshotCache:
+    """Token-keyed IndexSnapshot cache shared by Volume.bulk_lookup and
+    EcVolume.bulk_locate.
+
+    The token is captured BEFORE the columns are read, so a mutation racing
+    the read leaves token != current and forces a rebuild on the next call —
+    the cache can over-invalidate but never serve stale entries as current.
+    The device build (upload + bucket table) runs outside the guard lock so
+    concurrent probers and mutators aren't stalled behind it.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._accel: IndexSnapshot | None = None
+        self._token = None
+
+    def get(self, token_fn, cols_fn) -> IndexSnapshot:
+        """token_fn() -> monotonic mutation counter; cols_fn() -> sorted
+        (keys, offsets, sizes) columns consistent at-or-after the token."""
+        with self._lock:
+            token = token_fn()
+            if self._accel is not None and self._token == token:
+                return self._accel
+            cols = cols_fn()
+        accel = IndexSnapshot(*cols)
+        with self._lock:
+            if self._accel is None or self._token is None or self._token < token:
+                self._accel = accel
+                self._token = token
+        return accel

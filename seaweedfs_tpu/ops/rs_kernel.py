@@ -29,6 +29,12 @@ class TpuRSCodec:
     arrays (the storage pipeline writes them straight to shard files).
     """
 
+    # the EC file pipeline overlaps disk IO with device encode for this
+    # codec (upload + kernel + download per chunk are pipelined stages);
+    # large chunks amortize per-dispatch/transfer latency
+    prefers_pipeline = True
+    preferred_chunk = 16 * 1024 * 1024
+
     def __init__(
         self,
         data_shards: int = 10,

@@ -682,26 +682,47 @@ class VolumeServer(EcHandlers):
             offsets, sizes, found = await loop.run_in_executor(
                 None, v.bulk_lookup, keys
             )
-            for i, key in enumerate(keys):
-                if not found[i]:
-                    yield {"key": int(key), "found": False}
-                    continue
-                try:
-                    # locked pread + TTL check; a vacuum commit racing the
-                    # stream surfaces as a per-key miss, not a dead stream
-                    n = await loop.run_in_executor(
-                        None, v.read_needle_at, int(offsets[i]), int(sizes[i])
-                    )
-                except Exception as e:
-                    yield {"key": int(key), "found": False, "error": str(e)}
-                    continue
-                yield {
-                    "key": int(key),
-                    "found": True,
-                    "cookie": n.cookie,
-                    "size": int(sizes[i]),
-                    "data": bytes(n.data),
-                }
+
+            def read_slice(idxs: list[int]) -> list:
+                # one executor hop per slice of preads, not per needle; a
+                # vacuum commit racing the stream surfaces as a per-key
+                # miss, not a dead stream
+                out = []
+                for i in idxs:
+                    try:
+                        out.append(
+                            v.read_needle_at(int(offsets[i]), int(sizes[i]))
+                        )
+                    except Exception as e:
+                        out.append(e)
+                return out
+
+            batch = 256
+            for lo in range(0, len(keys), batch):
+                idxs = [
+                    i for i in range(lo, min(lo + batch, len(keys))) if found[i]
+                ]
+                results = (
+                    await loop.run_in_executor(None, read_slice, idxs)
+                    if idxs
+                    else []
+                )
+                by_idx = dict(zip(idxs, results))
+                for i in range(lo, min(lo + batch, len(keys))):
+                    key = int(keys[i])
+                    n = by_idx.get(i)
+                    if n is None:
+                        yield {"key": key, "found": False}
+                    elif isinstance(n, Exception):
+                        yield {"key": key, "found": False, "error": str(n)}
+                    else:
+                        yield {
+                            "key": key,
+                            "found": True,
+                            "cookie": n.cookie,
+                            "size": int(sizes[i]),
+                            "data": bytes(n.data),
+                        }
             return
         ev = self.store.find_ec_volume(vid)
         if ev is None:
@@ -714,9 +735,14 @@ class VolumeServer(EcHandlers):
             if not found[i]:
                 yield {"key": int(key), "found": False}
                 continue
-            n = await self.read_ec_needle_at(
-                ev, int(key), int(offsets[i]), int(sizes[i])
-            )
+            try:
+                n = await self.read_ec_needle_at(
+                    ev, int(key), int(offsets[i]), int(sizes[i])
+                )
+            except Exception as e:
+                # one corrupt needle must not kill the whole stream
+                yield {"key": int(key), "found": False, "error": str(e)}
+                continue
             if n is None:
                 yield {"key": int(key), "found": False}
                 continue

@@ -181,10 +181,8 @@ class EcVolume:
         self.shard_locations_refresh_time = 0.0
         # device-resident .ecx snapshot for bulk probes; invalidated on
         # tombstone writes (see bulk_locate)
-        self._ecx_accel = None
+        self._ecx_cache = None
         self._ecx_mutations = 0
-        self._ecx_accel_token = -1
-        self._ecx_accel_lock = threading.Lock()
 
     def file_name(self) -> str:
         return ec_shard_file_name(self.collection, self.dir, self.volume_id)
@@ -276,16 +274,13 @@ class EcVolume:
                     offsets[i], sizes[i], found[i] = o, s, True
             return offsets, sizes, found
 
-        with self._ecx_accel_lock:
-            # capture the token BEFORE reading the file: a delete racing the
-            # read leaves token != mutations, forcing a rebuild next call
-            token = self._ecx_mutations
-            if self._ecx_accel is None or self._ecx_accel_token != token:
-                from ...ops.index_kernel import IndexSnapshot
+        from ...ops.index_kernel import SnapshotCache
 
-                self._ecx_accel = IndexSnapshot(*self.ecx_snapshot())
-                self._ecx_accel_token = token
-            accel = self._ecx_accel
+        if self._ecx_cache is None:
+            self._ecx_cache = SnapshotCache()
+        accel = self._ecx_cache.get(
+            lambda: self._ecx_mutations, self.ecx_snapshot
+        )
         return accel.lookup(needle_ids)
 
     def intervals_for(self, offset_units: int, size: int) -> list[Interval]:

@@ -85,15 +85,105 @@ def _encode_rows(
             done += this
 
 
+def _encode_rows_pipelined(
+    dat_f,
+    outputs,
+    codec,
+    start_offset: int,
+    block_size: int,
+    rows: int,
+    chunk: int,
+    workers: int = 2,
+) -> None:
+    """Same bytes as _encode_rows, but the per-chunk encode (host pack ->
+    device upload -> kernel -> parity download) runs on a small worker pool
+    so disk reads/writes overlap device work, and chunk i+1's upload
+    overlaps chunk i's download. Shard writes stay strictly in stream order.
+
+    The reference pipeline is a synchronous 256KB loop
+    (ref: ec_encoder.go:120-136); this is the TPU-first replacement that
+    keeps the device fed.
+    """
+    import concurrent.futures as cf
+    from collections import deque
+
+    k = codec.data_shards
+    # small blocks are grouped G rows per device call (GF columns are
+    # independent, so encoding G concatenated blocks per shard equals G
+    # per-row encodes) — this amortizes per-dispatch latency that would
+    # otherwise dominate 1MB-block rows
+    group = max(1, chunk // block_size) if block_size < chunk else 1
+
+    # every device call uses the same buffer width (zero-padded tail, parity
+    # sliced on write): zero columns give zero parity, and a single shape
+    # means a single kernel compile for the whole stream
+    full_width = group * block_size if group > 1 else min(chunk, block_size)
+
+    def items():
+        row = 0
+        while row < rows:
+            if group > 1:
+                g = min(group, rows - row)
+                yield row, 0, block_size, g
+                row += g
+            else:
+                done = 0
+                while done < block_size:
+                    this = min(chunk, block_size - done)
+                    yield row, done, this, 1
+                    done += this
+                row += 1
+
+    def read_item(row: int, done: int, width: int, g: int) -> np.ndarray:
+        buf = np.zeros((k, full_width), dtype=np.uint8)
+        for gi in range(g):
+            row_start = start_offset + (row + gi) * block_size * k
+            sl = slice(gi * width, gi * width + width)
+            for i in range(k):
+                _read_into(dat_f, buf[i, sl], row_start + i * block_size + done)
+        return buf
+
+    def drain(entry) -> None:
+        width, g, buf, fut = entry
+        parity = fut.result()
+        for gi in range(g):
+            sl = slice(gi * width, gi * width + width)
+            for i in range(k):
+                outputs[i].write(buf[i, sl].tobytes())
+            for p in range(codec.parity_shards):
+                outputs[k + p].write(parity[p, sl].tobytes())
+
+    with cf.ThreadPoolExecutor(workers) as pool:
+        pending: deque = deque()
+        for row, done, width, g in items():
+            buf = read_item(row, done, width, g)
+            pending.append((width, g, buf, pool.submit(codec.encode, buf)))
+            while len(pending) > workers:
+                drain(pending.popleft())
+        while pending:
+            drain(pending.popleft())
+
+
 def write_ec_files(
     base_file_name: str,
     codec=None,
     large_block_size: int = EC_LARGE_BLOCK_SIZE,
     small_block_size: int = EC_SMALL_BLOCK_SIZE,
     chunk: int = DEFAULT_CHUNK,
+    pipeline: Optional[bool] = None,
 ) -> None:
-    """Generate .ec00-.ec13 from .dat (ref WriteEcFiles, ec_encoder.go:57)."""
+    """Generate .ec00-.ec13 from .dat (ref WriteEcFiles, ec_encoder.go:57).
+
+    pipeline=None follows the codec's preference: the TPU codec overlaps
+    disk IO with device encode (_encode_rows_pipelined); the CPU codec
+    keeps the reference's synchronous structure.
+    """
     codec = _get_codec(codec)
+    if pipeline is None:
+        pipeline = getattr(codec, "prefers_pipeline", False)
+    if pipeline and chunk == DEFAULT_CHUNK:
+        chunk = getattr(codec, "preferred_chunk", chunk)
+    encode_rows = _encode_rows_pipelined if pipeline else _encode_rows
     k = codec.data_shards
     dat_size = os.path.getsize(base_file_name + ".dat")
     outputs = [
@@ -109,7 +199,7 @@ def write_ec_files(
             n_large = 0
             while remaining - n_large * large_row > large_row:
                 n_large += 1
-            _encode_rows(
+            encode_rows(
                 dat_f, outputs, codec, processed, large_block_size, n_large, chunk
             )
             processed += n_large * large_row
@@ -121,9 +211,11 @@ def write_ec_files(
             while rem > 0:
                 n_small += 1
                 rem -= small_row
-            _encode_rows(
+            # the pipelined path groups multiple small rows per call, so it
+            # keeps the full chunk; the sync path clamps to one block
+            encode_rows(
                 dat_f, outputs, codec, processed, small_block_size, n_small,
-                min(chunk, small_block_size),
+                chunk if pipeline else min(chunk, small_block_size),
             )
     finally:
         for f in outputs:

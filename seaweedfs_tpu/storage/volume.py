@@ -133,8 +133,7 @@ class Volume:
         self._lock = threading.RLock()
         # device-resident index snapshot for bulk probes, keyed by the
         # map's mutation token (see bulk_lookup)
-        self._index_accel = None
-        self._index_accel_token: Optional[int] = None
+        self._index_cache = None
 
         base = self.file_name()
         dat_exists = os.path.exists(base + ".dat")
@@ -419,27 +418,16 @@ class Volume:
                     found[i] = True
             return offsets, sizes, found
 
-        cols = None
-        with self._lock:
-            token = self.nm.snapshot_token()
-            if self._index_accel is not None and self._index_accel_token == token:
-                accel = self._index_accel
-            else:
-                cols = self.nm.snapshot()  # consistent with token under lock
-        if cols is not None:
-            # device upload + bucket build happen OUTSIDE the lock so
-            # concurrent reads/writes aren't stalled behind it
-            from ..ops.index_kernel import IndexSnapshot
+        from ..ops.index_kernel import SnapshotCache
 
-            accel = IndexSnapshot(*cols)
-            with self._lock:
-                if (
-                    self._index_accel is None
-                    or self._index_accel_token is None
-                    or self._index_accel_token < token
-                ):
-                    self._index_accel = accel
-                    self._index_accel_token = token
+        if self._index_cache is None:
+            self._index_cache = SnapshotCache()
+
+        def locked_cols():
+            with self._lock:  # map mutations happen under the volume lock
+                return self.nm.snapshot()
+
+        accel = self._index_cache.get(self.nm.snapshot_token, locked_cols)
         return accel.lookup(keys)
 
     def read_needle_at(self, offset_units: int, size: int) -> Needle:

@@ -131,6 +131,41 @@ def test_write_ec_files_with_tpu_codec_byte_identical(tmp_path):
         assert cpu_bytes == tpu_bytes, f"shard {i} differs between backends"
 
 
+def test_write_ec_files_pipelined_many_chunks_byte_identical(tmp_path):
+    """The overlapped pipeline (several chunks in flight on the worker pool)
+    writes the same shard bytes as the synchronous reference-structure loop,
+    including odd block tails."""
+    from seaweedfs_tpu.storage.erasure_coding import to_ext, write_ec_files
+
+    rng = np.random.default_rng(13)
+    payload = rng.integers(0, 256, size=654_321, dtype=np.uint8).tobytes()
+
+    for sub, codec, pipeline in (
+        ("sync", CpuRSCodec(), False),
+        ("pipe", TpuRSCodec(), True),
+    ):
+        d = tmp_path / sub
+        d.mkdir()
+        base = str(d / "1")
+        with open(base + ".dat", "wb") as f:
+            f.write(payload)
+        write_ec_files(
+            base,
+            codec=codec,
+            large_block_size=40_000,
+            small_block_size=1_000,
+            chunk=4_096,  # forces many in-flight chunks per block
+            pipeline=pipeline,
+        )
+
+    for i in range(14):
+        with open(str(tmp_path / "sync" / "1") + to_ext(i), "rb") as f:
+            sync_bytes = f.read()
+        with open(str(tmp_path / "pipe" / "1") + to_ext(i), "rb") as f:
+            pipe_bytes = f.read()
+        assert sync_bytes == pipe_bytes, f"shard {i} differs"
+
+
 def test_native_codec_matches_oracle():
     from seaweedfs_tpu import native
 
