@@ -209,14 +209,25 @@ def cmd_s3(argv: list[str]) -> int:
     p.add_argument("-master", default="127.0.0.1:9333")
     p.add_argument("-filerPort", type=int, default=8888)
     p.add_argument("-store", default="")
+    p.add_argument(
+        "-config",
+        default="",
+        help="IAM identities JSON (ref s3api auth_credentials.go); "
+        "empty = anonymous",
+    )
     args = p.parse_args(argv)
     from ..s3.server import S3Server
     from ..server.filer import FilerServer
 
+    iam = None
+    if args.config:
+        from ..s3.auth import IdentityAccessManagement
+
+        iam = IdentityAccessManagement.from_file(args.config)
     fs = FilerServer(
         master=args.master, host=args.ip, port=args.filerPort, store_path=args.store
     )
-    s3 = S3Server(fs, host=args.ip, port=args.port)
+    s3 = S3Server(fs, host=args.ip, port=args.port, iam=iam)
     print(f"s3 gateway on {args.ip}:{args.port} (filer on :{args.filerPort})")
     asyncio.run(_run_forever(fs, s3))
     return 0

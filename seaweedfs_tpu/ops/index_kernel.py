@@ -19,7 +19,6 @@ in serving overlaps with device compute.
 from __future__ import annotations
 
 import functools
-import threading
 
 import jax
 import jax.numpy as jnp
@@ -172,33 +171,4 @@ class IndexSnapshot:
         )
 
 
-class SnapshotCache:
-    """Token-keyed IndexSnapshot cache shared by Volume.bulk_lookup and
-    EcVolume.bulk_locate.
-
-    The token is captured BEFORE the columns are read, so a mutation racing
-    the read leaves token != current and forces a rebuild on the next call —
-    the cache can over-invalidate but never serve stale entries as current.
-    The device build (upload + bucket table) runs outside the guard lock so
-    concurrent probers and mutators aren't stalled behind it.
-    """
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._accel: IndexSnapshot | None = None
-        self._token = None
-
-    def get(self, token_fn, cols_fn) -> IndexSnapshot:
-        """token_fn() -> monotonic mutation counter; cols_fn() -> sorted
-        (keys, offsets, sizes) columns consistent at-or-after the token."""
-        with self._lock:
-            token = token_fn()
-            if self._accel is not None and self._token == token:
-                return self._accel
-            cols = cols_fn()
-        accel = IndexSnapshot(*cols)
-        with self._lock:
-            if self._accel is None or self._token is None or self._token < token:
-                self._accel = accel
-                self._token = token
-        return accel
+from .snapshot_cache import SnapshotCache  # noqa: E402,F401  (re-export)

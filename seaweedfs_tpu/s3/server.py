@@ -5,8 +5,12 @@ Buckets are directories under /buckets in the filer namespace
 ListBuckets, Create/Delete bucket, Put/Get/Head/Delete object,
 ListObjectsV2, and multipart uploads (initiate / upload part / complete /
 abort) — completion is a metadata-only merge of the parts' chunk lists, no
-data copy. Anonymous auth (the reference allows anonymous without IAM
-config; V4 signatures are a later round).
+data copy.
+
+Auth: AWS V4 signatures (header + presigned) against configured identities
+(s3/auth.py; ref: weed/s3api/auth_signature_v4.go, auth_credentials.go).
+Without an IAM config everything is anonymous, matching the reference's
+disabled-IAM behavior.
 """
 
 from __future__ import annotations
@@ -53,12 +57,19 @@ class S3Server:
     mirroring the reference where s3api rides the filer's gRPC.
     """
 
-    def __init__(self, filer_server, host: str = "127.0.0.1", port: int = 8333):
+    def __init__(
+        self,
+        filer_server,
+        host: str = "127.0.0.1",
+        port: int = 8333,
+        iam=None,
+    ):
         self.fs = filer_server
         self.filer: Filer = filer_server.filer
         self.host = host
         self.port = port
         self.address = f"{host}:{port}"
+        self.iam = iam
         self._http_runner: Optional[web.AppRunner] = None
 
     async def start(self) -> None:
@@ -73,12 +84,64 @@ class S3Server:
         if self._http_runner is not None:
             await self._http_runner.cleanup()
 
+    # ---------------- auth (ref s3api_server.go router action mapping) ----------------
+    @staticmethod
+    def _required_action(method: str, bucket: str, key: str, query) -> str:
+        from .auth import ACTION_ADMIN, ACTION_READ, ACTION_WRITE
+
+        if not bucket:
+            return ACTION_ADMIN  # ListBuckets (s3api_server.go:109)
+        if not key:
+            if method == "PUT" or method == "HEAD":
+                return ACTION_ADMIN  # PutBucket/HeadBucket (:49,:71)
+            if method == "DELETE" or method == "POST":
+                return ACTION_WRITE  # DeleteBucket/DeleteMultiple (:76,:86)
+            return ACTION_READ  # ListObjects (:79,:83)
+        if method in ("GET", "HEAD"):
+            # multipart listing rides Write (:62,:64)
+            return ACTION_WRITE if "uploadId" in query else ACTION_READ
+        return ACTION_WRITE
+
+    async def _authenticate(self, request: web.Request, bucket: str, key: str):
+        """-> error Response or None. Reads the body only when the signed
+        payload hash isn't carried in headers."""
+        if self.iam is None or not self.iam.enabled:
+            return None
+        from .auth import AccessDenied
+
+        action = self._required_action(request.method, bucket, key, request.query)
+        payload_hash = ""
+        if "Authorization" in request.headers and not request.headers.get(
+            "x-amz-content-sha256"
+        ):
+            import hashlib
+
+            payload_hash = hashlib.sha256(await request.read()).hexdigest()
+        try:
+            ident = self.iam.authenticate(
+                {
+                    "method": request.method,
+                    "raw_path": request.url.raw_path.partition("?")[0],
+                    "query_pairs": [(k, v) for k, v in request.query.items()],
+                    "headers": request.headers,
+                    "payload_hash": payload_hash,
+                }
+            )
+        except AccessDenied as e:
+            return _error("AccessDenied", str(e), 403)
+        if not ident.can_do(action, bucket):
+            return _error("AccessDenied", f"not allowed: {action}", 403)
+        return None
+
     # ---------------- routing ----------------
     async def _dispatch(self, request: web.Request) -> web.Response:
         path = request.path.strip("/")
+        bucket, _, key = (path or "").partition("/")
+        denied = await self._authenticate(request, bucket, key)
+        if denied is not None:
+            return denied
         if not path:
             return await self._list_buckets(request)
-        bucket, _, key = path.partition("/")
         if not key:
             if request.method == "PUT":
                 return await self._create_bucket(bucket)

@@ -697,18 +697,27 @@ class VolumeServer(EcHandlers):
                         out.append(e)
                 return out
 
-            batch = 256
-            for lo in range(0, len(keys), batch):
-                idxs = [
-                    i for i in range(lo, min(lo + batch, len(keys))) if found[i]
-                ]
+            # slices are capped by accumulated payload bytes (not key count)
+            # so large needles can't pile up gigabytes before the first yield
+            max_slice_bytes = 8 << 20
+            lo = 0
+            while lo < len(keys):
+                hi = lo
+                span_bytes = 0
+                while hi < len(keys) and (
+                    hi == lo or span_bytes + int(sizes[hi]) <= max_slice_bytes
+                ):
+                    if found[hi]:
+                        span_bytes += int(sizes[hi])
+                    hi += 1
+                idxs = [i for i in range(lo, hi) if found[i]]
                 results = (
                     await loop.run_in_executor(None, read_slice, idxs)
                     if idxs
                     else []
                 )
                 by_idx = dict(zip(idxs, results))
-                for i in range(lo, min(lo + batch, len(keys))):
+                for i in range(lo, hi):
                     key = int(keys[i])
                     n = by_idx.get(i)
                     if n is None:
@@ -723,6 +732,7 @@ class VolumeServer(EcHandlers):
                             "size": int(sizes[i]),
                             "data": bytes(n.data),
                         }
+                lo = hi
             return
         ev = self.store.find_ec_volume(vid)
         if ev is None:

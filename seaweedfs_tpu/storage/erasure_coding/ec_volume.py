@@ -181,7 +181,9 @@ class EcVolume:
         self.shard_locations_refresh_time = 0.0
         # device-resident .ecx snapshot for bulk probes; invalidated on
         # tombstone writes (see bulk_locate)
-        self._ecx_cache = None
+        from ...ops.snapshot_cache import SnapshotCache
+
+        self._ecx_cache = SnapshotCache()
         self._ecx_mutations = 0
 
     def file_name(self) -> str:
@@ -274,10 +276,6 @@ class EcVolume:
                     offsets[i], sizes[i], found[i] = o, s, True
             return offsets, sizes, found
 
-        from ...ops.index_kernel import SnapshotCache
-
-        if self._ecx_cache is None:
-            self._ecx_cache = SnapshotCache()
         accel = self._ecx_cache.get(
             lambda: self._ecx_mutations, self.ecx_snapshot
         )

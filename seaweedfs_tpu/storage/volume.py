@@ -133,7 +133,9 @@ class Volume:
         self._lock = threading.RLock()
         # device-resident index snapshot for bulk probes, keyed by the
         # map's mutation token (see bulk_lookup)
-        self._index_cache = None
+        from ..ops.snapshot_cache import SnapshotCache
+
+        self._index_cache = SnapshotCache()
 
         base = self.file_name()
         dat_exists = os.path.exists(base + ".dat")
@@ -417,11 +419,6 @@ class Volume:
                     sizes[i] = nv.size
                     found[i] = True
             return offsets, sizes, found
-
-        from ..ops.index_kernel import SnapshotCache
-
-        if self._index_cache is None:
-            self._index_cache = SnapshotCache()
 
         def locked_cols():
             with self._lock:  # map mutations happen under the volume lock
