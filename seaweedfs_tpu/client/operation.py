@@ -59,10 +59,14 @@ async def upload_data(
     filename: str = "",
     mime: str = "",
     ttl: str = "",
+    params: Optional[dict] = None,
 ) -> dict:
     target = f"http://{url}/{fid}"
+    query = dict(params or {})
     if ttl:
-        target += f"?ttl={ttl}"
+        query["ttl"] = ttl
+    if query:
+        target += "?" + "&".join(f"{k}={v}" for k, v in query.items())
     form = aiohttp.FormData()
     form.add_field(
         "file", data, filename=filename or "file", content_type=mime or None
@@ -150,12 +154,72 @@ async def submit_file(
     collection: str = "",
     replication: str = "",
     ttl: str = "",
+    mime: str = "",
+    chunk_size: int = 0,
 ) -> tuple[str, dict]:
-    """assign + upload in one call (ref operation/submit.go:41)."""
+    """assign + upload in one call (ref operation/submit.go:41).
+
+    With chunk_size > 0 and a larger payload, the file is split into
+    chunk needles (each with its own assign) plus a JSON chunk manifest
+    stored under the primary fid with the cm=true flag — the path that
+    lets a file exceed one needle/volume (ref: submit.go:127-195,
+    operation/chunked_file.go:26-73).
+    """
     ar = await assign(
         master, collection=collection, replication=replication, ttl=ttl
     )
-    result = await upload_data(
-        session, ar.url, ar.fid, data, filename=filename, ttl=ttl
-    )
-    return ar.fid, result
+    if chunk_size <= 0 or len(data) <= chunk_size:
+        result = await upload_data(
+            session, ar.url, ar.fid, data, filename=filename, mime=mime, ttl=ttl
+        )
+        return ar.fid, result
+
+    chunks = []
+    try:
+        for i in range(0, -(-len(data) // chunk_size)):
+            part = data[i * chunk_size : (i + 1) * chunk_size]
+            car = await assign(
+                master, collection=collection, replication=replication, ttl=ttl
+            )
+            await upload_data(
+                session,
+                car.url,
+                car.fid,
+                part,
+                filename=f"{filename or 'file'}-{i + 1}",
+                ttl=ttl,
+            )
+            chunks.append(
+                {"fid": car.fid, "offset": i * chunk_size, "size": len(part)}
+            )
+        import json as _json
+
+        manifest = {
+            "name": filename,
+            "mime": mime,
+            "size": len(data),
+            "chunks": chunks,
+        }
+        result = await upload_data(
+            session,
+            ar.url,
+            ar.fid,
+            _json.dumps(manifest).encode(),
+            filename=filename,
+            ttl=ttl,
+            params={"cm": "true"},
+        )
+        result["size"] = len(data)
+        return ar.fid, result
+    except Exception:
+        # best-effort cleanup of already-uploaded chunks
+        # (ref submit.go cm.DeleteChunks on error)
+        for c in chunks:
+            try:
+                vid = int(c["fid"].split(",")[0])
+                locs = await lookup(master, vid)
+                if locs:
+                    await delete_file(session, locs[0], c["fid"])
+            except Exception:
+                pass
+        raise

@@ -372,6 +372,12 @@ def cmd_upload(argv: list[str]) -> int:
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")
     p.add_argument("-ttl", default="")
+    p.add_argument(
+        "-maxMB",
+        type=int,
+        default=0,
+        help="split larger files into chunks + manifest (0 = never split)",
+    )
     p.add_argument("files", nargs="+")
     args = p.parse_args(argv)
 
@@ -392,6 +398,7 @@ def cmd_upload(argv: list[str]) -> int:
                     collection=args.collection,
                     replication=args.replication,
                     ttl=args.ttl,
+                    chunk_size=args.maxMB * 1024 * 1024,
                 )
                 print(f"{path} -> fid {fid} ({result.get('size')} bytes)")
 

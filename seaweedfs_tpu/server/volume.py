@@ -315,6 +315,8 @@ class VolumeServer(EcHandlers):
                 self.store.read_volume_needle(vid, n)
             if n.cookie != fid.cookie:
                 return web.json_response({"error": "cookie mismatch"}, status=404)
+            if n.is_chunked_manifest() and request.query.get("cm") != "false":
+                return await self._chunked_manifest_response(request, n, ext)
             return self._needle_response(request, n, ext)
 
         ev = self.store.find_ec_volume(vid)
@@ -324,6 +326,8 @@ class VolumeServer(EcHandlers):
                 return web.json_response({"error": "not found"}, status=404)
             if n.cookie != fid.cookie:
                 return web.json_response({"error": "cookie mismatch"}, status=404)
+            if n.is_chunked_manifest() and request.query.get("cm") != "false":
+                return await self._chunked_manifest_response(request, n, ext)
             return self._needle_response(request, n, ext)
 
         # not local: redirect via master lookup (ref :41-53)
@@ -335,6 +339,133 @@ class VolumeServer(EcHandlers):
                     location=f"http://{url}{request.path_qs}"
                 )
         return web.json_response({"error": "volume not found"}, status=404)
+
+    # ---------------- chunked-file manifests ----------------
+    @staticmethod
+    def _load_manifest(n: Needle) -> dict:
+        """Manifest JSON from a cm-flagged needle
+        (ref: operation/chunked_file.go LoadChunkManifest)."""
+        import json
+
+        body = bytes(n.data)
+        if n.is_compressed():
+            import gzip
+
+            body = gzip.decompress(body)
+        m = json.loads(body)
+        m["chunks"] = sorted(m.get("chunks", []), key=lambda c: c["offset"])
+        return m
+
+    async def _fetch_chunk(self, fid: str) -> bytes:
+        """GET one chunk needle, local store first, else via master lookup."""
+        f = FileId.parse(fid)
+        v = self.store.find_volume(f.volume_id)
+        if v is not None:
+            n = Needle(id=f.key)
+            if v.has_remote_file:
+                # tiered: blocking remote I/O stays off the event loop
+                await asyncio.get_event_loop().run_in_executor(
+                    None, self.store.read_volume_needle, f.volume_id, n
+                )
+            else:
+                self.store.read_volume_needle(f.volume_id, n)
+            if n.cookie != f.cookie:
+                raise LookupError(f"chunk {fid}: cookie mismatch")
+            body = bytes(n.data)
+            if n.is_compressed():
+                import gzip
+
+                body = gzip.decompress(body)
+            return body
+        locs = await self._lookup_volume(f.volume_id)
+        if not locs:
+            raise LookupError(f"chunk {fid}: volume not found")
+        async with self._http_client.get(f"http://{locs[0]}/{fid}") as resp:
+            if resp.status != 200:
+                raise LookupError(f"chunk {fid}: status {resp.status}")
+            return await resp.read()
+
+    async def _chunked_manifest_response(
+        self, request: web.Request, n: Needle, ext: str = ""
+    ) -> web.Response:
+        """Resolve a chunk manifest into file bytes, honoring single ranges
+        by fetching only the chunks they cover
+        (ref: volume_server_handlers_read.go:170-207 tryHandleChunkedFile)."""
+        try:
+            manifest = self._load_manifest(n)
+        except Exception:
+            # unreadable manifest: fall back to serving the raw needle
+            # (ref tryHandleChunkedFile returns false on load error)
+            return self._needle_response(request, n, ext)
+        total = int(manifest.get("size", 0))
+        content_type = manifest.get("mime") or "application/octet-stream"
+        headers = {
+            "Accept-Ranges": "bytes",
+            "X-File-Store": "chunked",
+            "Etag": f'"{n.etag()}"',
+        }
+        if request.method == "HEAD":
+            headers["Content-Length"] = str(total)
+            headers["Content-Type"] = content_type
+            return web.Response(status=200, headers=headers)
+
+        span = self._parse_range(request.headers.get("Range", ""), total)
+        if span == "invalid-range":
+            return web.Response(
+                status=416, headers={"Content-Range": f"bytes */{total}"}
+            )
+        start, end = span if span is not None else (0, total - 1)
+
+        # stream chunk by chunk: memory stays bounded by one chunk no
+        # matter how large the whole file is
+        headers["Content-Type"] = content_type
+        headers["Content-Length"] = str(max(end - start + 1, 0))
+        if span is not None:
+            headers["Content-Range"] = f"bytes {start}-{end}/{total}"
+        resp = web.StreamResponse(
+            status=206 if span is not None else 200, headers=headers
+        )
+        await resp.prepare(request)
+        for c in manifest["chunks"]:
+            c_start, c_size = int(c["offset"]), int(c["size"])
+            c_end = c_start + c_size - 1
+            if c_end < start or c_start > end:
+                continue
+            blob = await self._fetch_chunk(c["fid"])
+            lo = max(start, c_start) - c_start
+            hi = min(end, c_end) - c_start + 1
+            await resp.write(blob[lo:hi])
+        await resp.write_eof()
+        return resp
+
+    async def _delete_manifest_chunks(self, n: Needle) -> None:
+        """Fan out deletes of a manifest's chunk needles
+        (ref: volume_server_handlers_write.go DeleteHandler + DeleteChunks)."""
+        try:
+            manifest = self._load_manifest(n)
+        except Exception:
+            return
+        for c in manifest.get("chunks", []):
+            try:
+                f = FileId.parse(c["fid"])
+                # always go through HTTP DELETE so the owning server's
+                # replication fan-out runs (a direct store delete would
+                # leave other replicas serving the chunk)
+                locs = await self._lookup_volume(f.volume_id)
+                if self.address in locs or self.public_url in locs:
+                    target = self.address
+                elif locs:
+                    target = locs[0]
+                elif self.store.has_volume(f.volume_id):
+                    target = self.address
+                else:
+                    continue
+                async with self._http_client.delete(
+                    f"http://{target}/{c['fid']}"
+                ):
+                    pass
+            except Exception:
+                pass  # best-effort, matching the reference's async delete
 
     def _needle_response(
         self, request: web.Request, n: Needle, ext: str = ""
@@ -455,6 +586,9 @@ class VolumeServer(EcHandlers):
             from ..storage.ttl import TTL
 
             n.set_ttl(TTL.read(ttl))
+        if request.query.get("cm") == "true":
+            # chunk manifest upload (ref needle_parse_upload.go:177)
+            n.set_is_chunk_manifest()
 
         is_replicate = request.query.get("type") == "replicate"
         if request.query.get("fsync") == "true":
@@ -488,6 +622,10 @@ class VolumeServer(EcHandlers):
                     return web.json_response({"error": "cookie mismatch"}, status=403)
             except (NotFound, AlreadyDeleted):
                 return web.json_response({"size": 0}, status=404)
+            if check.is_chunked_manifest():
+                # deleting a manifest also deletes its chunk needles
+                # (ref volume_server_handlers_write.go DeleteHandler)
+                await self._delete_manifest_chunks(check)
             size = self.store.delete_volume_needle(vid, n)
             if not is_replicate:
                 await self._replicate(request, vid, "DELETE", b"")
@@ -495,6 +633,10 @@ class VolumeServer(EcHandlers):
 
         ev = self.store.find_ec_volume(vid)
         if ev is not None:
+            check = await self.read_ec_needle(ev, fid.key)
+            if check is not None and check.is_chunked_manifest():
+                # manifest on an EC volume still owns its chunk needles
+                await self._delete_manifest_chunks(check)
             size = await self.delete_ec_needle(ev, fid.key)
             return web.json_response({"size": size}, status=202)
         return web.json_response({"error": "volume not found"}, status=404)
@@ -697,15 +839,22 @@ class VolumeServer(EcHandlers):
                         out.append(e)
                 return out
 
-            # slices are capped by accumulated payload bytes (not key count)
-            # so large needles can't pile up gigabytes before the first yield
+            # slices are capped by accumulated payload bytes AND key count
+            # so neither large needles nor huge key lists can pile up
+            # unbounded work before the first yield
             max_slice_bytes = 8 << 20
+            max_slice_keys = 256
             lo = 0
             while lo < len(keys):
                 hi = lo
                 span_bytes = 0
-                while hi < len(keys) and (
-                    hi == lo or span_bytes + int(sizes[hi]) <= max_slice_bytes
+                while (
+                    hi < len(keys)
+                    and hi - lo < max_slice_keys
+                    and (
+                        hi == lo
+                        or span_bytes + int(sizes[hi]) <= max_slice_bytes
+                    )
                 ):
                     if found[hi]:
                         span_bytes += int(sizes[hi])

@@ -47,12 +47,20 @@ class FileId:
 
     @staticmethod
     def parse(fid: str) -> "FileId":
+        # a "_delta" suffix addresses the delta-th key after the base fid —
+        # the chunked-upload convention for count-assigned ids
+        # (ref: weed/storage/needle/needle.go:123-135)
+        delta = 0
+        underscore = fid.rfind("_")
+        if underscore > 0:
+            fid, suffix = fid[:underscore], fid[underscore + 1 :]
+            delta = int(suffix)
         comma = fid.find(",")
         if comma <= 0:
             raise ValueError(f"wrong fid format: {fid!r}")
         vid = parse_volume_id(fid[:comma])
         key, cookie = parse_needle_id_cookie(fid[comma + 1 :])
-        return FileId(volume_id=vid, key=key, cookie=cookie)
+        return FileId(volume_id=vid, key=key + delta, cookie=cookie)
 
     def __str__(self) -> str:
         return f"{self.volume_id},{format_needle_id_cookie(self.key, self.cookie)}"

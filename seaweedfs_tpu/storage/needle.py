@@ -160,6 +160,11 @@ class Needle:
     def is_chunked_manifest(self) -> bool:
         return bool(self.flags & FLAG_IS_CHUNK_MANIFEST)
 
+    def set_is_chunk_manifest(self) -> None:
+        """Mark this needle as a chunk manifest (ref: needle.go SetIsChunkManifest,
+        set from the upload's cm=true form value, needle_parse_upload.go:177)."""
+        self.flags |= FLAG_IS_CHUNK_MANIFEST
+
     def etag(self) -> str:
         return u32_to_bytes(self.checksum).hex()
 

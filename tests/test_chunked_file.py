@@ -1,0 +1,86 @@
+"""Chunked-file manifests: client-side chunked submit + volume-server
+manifest resolution (ref: weed/operation/chunked_file.go:26-73,
+submit.go:127-195, volume_server_handlers_read.go:170-207)."""
+
+import asyncio
+import json
+import random
+
+import aiohttp
+
+from test_cluster import Cluster
+
+from seaweedfs_tpu.client.operation import lookup, submit_file
+
+
+def test_chunked_submit_read_range_delete(tmp_path):
+    async def body():
+        random.seed(47)
+        cluster = Cluster(tmp_path, n_volume_servers=2)
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                # a payload far larger than the chunk size: 7 chunks
+                payload = random.randbytes(7 * 32_000 - 123)
+                fid, result = await submit_file(
+                    session,
+                    cluster.master.address,
+                    payload,
+                    filename="big.bin",
+                    mime="application/x-test",
+                    chunk_size=32_000,
+                )
+                assert result["size"] == len(payload)
+
+                vid = int(fid.split(",")[0])
+                locs = await lookup(cluster.master.address, vid)
+                url = f"http://{locs[0]}/{fid}"
+
+                # the plain GET resolves the manifest to the original bytes
+                async with session.get(url) as resp:
+                    assert resp.status == 200
+                    assert resp.headers.get("X-File-Store") == "chunked"
+                    assert resp.content_type == "application/x-test"
+                    assert await resp.read() == payload
+
+                # cm=false returns the raw manifest JSON
+                async with session.get(url + "?cm=false") as resp:
+                    manifest = json.loads(await resp.read())
+                    assert manifest["size"] == len(payload)
+                    assert len(manifest["chunks"]) == 7
+
+                # HEAD reports the full size
+                async with session.head(url) as resp:
+                    assert int(resp.headers["Content-Length"]) == len(payload)
+
+                # ranged read spanning a chunk boundary
+                start, end = 31_000, 65_000
+                async with session.get(
+                    url, headers={"Range": f"bytes={start}-{end}"}
+                ) as resp:
+                    assert resp.status == 206
+                    assert await resp.read() == payload[start : end + 1]
+
+                # deleting the manifest deletes the chunks too
+                chunk_fids = [c["fid"] for c in manifest["chunks"]]
+                async with session.delete(url) as resp:
+                    assert resp.status == 202
+                for cfid in chunk_fids:
+                    cvid = int(cfid.split(",")[0])
+                    clocs = await lookup(cluster.master.address, cvid)
+                    async with session.get(f"http://{clocs[0]}/{cfid}") as resp:
+                        assert resp.status == 404, cfid
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_fid_delta_suffix():
+    from seaweedfs_tpu.storage.file_id import FileId
+
+    base = FileId.parse("3,01637037d6")
+    plus2 = FileId.parse("3,01637037d6_2")
+    assert plus2.volume_id == base.volume_id
+    assert plus2.key == base.key + 2
+    assert plus2.cookie == base.cookie
