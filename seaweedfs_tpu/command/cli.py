@@ -137,6 +137,11 @@ def cmd_server(argv: list[str]) -> int:
     p.add_argument("-storageBackend", default="cpu", choices=["cpu", "tpu"])
     p.add_argument("-tierConfig", default="")
     p.add_argument("-index", default="memory", choices=["memory", "leveldb", "sorted"])
+    p.add_argument("-filer", action="store_true", help="also run a filer")
+    p.add_argument("-filerPort", type=int, default=8888)
+    p.add_argument("-s3", action="store_true", help="also run an S3 gateway (implies -filer)")
+    p.add_argument("-s3Port", type=int, default=8333)
+    p.add_argument("-s3Config", default="", help="IAM identities JSON for the S3 gateway")
     args = p.parse_args(argv)
     from ..server.master import MasterServer
     from ..server.volume import VolumeServer
@@ -168,11 +173,31 @@ def cmd_server(argv: list[str]) -> int:
         codec_backend=args.storageBackend,
         needle_map_kind=args.index,
     )
-    print(
+    servers = [ms, vs]
+    desc = (
         f"server: master on {args.ip}:{args.port}, volume on "
         f"{args.ip}:{args.volumePort}"
     )
-    asyncio.run(_run_forever(ms, vs))
+    if args.filer or args.s3:
+        from ..server.filer import FilerServer
+
+        fs = FilerServer(
+            master=f"{args.ip}:{args.port}", host=args.ip, port=args.filerPort
+        )
+        servers.append(fs)
+        desc += f", filer on {args.ip}:{args.filerPort}"
+        if args.s3:
+            from ..s3.server import S3Server
+
+            iam = None
+            if args.s3Config:
+                from ..s3.auth import IdentityAccessManagement
+
+                iam = IdentityAccessManagement.from_file(args.s3Config)
+            servers.append(S3Server(fs, host=args.ip, port=args.s3Port, iam=iam))
+            desc += f", s3 on {args.ip}:{args.s3Port}"
+    print(desc)
+    asyncio.run(_run_forever(*servers))
     return 0
 
 

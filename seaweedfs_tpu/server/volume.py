@@ -356,8 +356,12 @@ class VolumeServer(EcHandlers):
         m["chunks"] = sorted(m.get("chunks", []), key=lambda c: c["offset"])
         return m
 
-    async def _fetch_chunk(self, fid: str) -> bytes:
-        """GET one chunk needle, local store first, else via master lookup."""
+    async def _fetch_chunk(
+        self, fid: str, start: int = 0, end: Optional[int] = None
+    ) -> bytes:
+        """Bytes [start, end] (inclusive; None = to the end) of one chunk
+        needle — local store first, else via master lookup with the range
+        forwarded so only the needed slice crosses the network."""
         f = FileId.parse(fid)
         v = self.store.find_volume(f.volume_id)
         if v is not None:
@@ -376,14 +380,23 @@ class VolumeServer(EcHandlers):
                 import gzip
 
                 body = gzip.decompress(body)
-            return body
+            return body[start : None if end is None else end + 1]
         locs = await self._lookup_volume(f.volume_id)
         if not locs:
             raise LookupError(f"chunk {fid}: volume not found")
-        async with self._http_client.get(f"http://{locs[0]}/{fid}") as resp:
-            if resp.status != 200:
+        headers = {}
+        if start != 0 or end is not None:
+            headers["Range"] = f"bytes={start}-{'' if end is None else end}"
+        async with self._http_client.get(
+            f"http://{locs[0]}/{fid}", headers=headers
+        ) as resp:
+            if resp.status not in (200, 206):
                 raise LookupError(f"chunk {fid}: status {resp.status}")
-            return await resp.read()
+            body = await resp.read()
+            if resp.status == 200 and headers:
+                # server ignored the range; slice locally
+                body = body[start : None if end is None else end + 1]
+            return body
 
     async def _chunked_manifest_response(
         self, request: web.Request, n: Needle, ext: str = ""
@@ -431,10 +444,9 @@ class VolumeServer(EcHandlers):
             c_end = c_start + c_size - 1
             if c_end < start or c_start > end:
                 continue
-            blob = await self._fetch_chunk(c["fid"])
             lo = max(start, c_start) - c_start
-            hi = min(end, c_end) - c_start + 1
-            await resp.write(blob[lo:hi])
+            hi = min(end, c_end) - c_start
+            await resp.write(await self._fetch_chunk(c["fid"], lo, hi))
         await resp.write_eof()
         return resp
 
@@ -622,9 +634,10 @@ class VolumeServer(EcHandlers):
                     return web.json_response({"error": "cookie mismatch"}, status=403)
             except (NotFound, AlreadyDeleted):
                 return web.json_response({"size": 0}, status=404)
-            if check.is_chunked_manifest():
-                # deleting a manifest also deletes its chunk needles
-                # (ref volume_server_handlers_write.go DeleteHandler)
+            if check.is_chunked_manifest() and not is_replicate:
+                # deleting a manifest also deletes its chunk needles; only
+                # the primary fans out, or every replica would re-issue the
+                # whole cascade (ref volume_server_handlers_write.go)
                 await self._delete_manifest_chunks(check)
             size = self.store.delete_volume_needle(vid, n)
             if not is_replicate:
@@ -634,7 +647,13 @@ class VolumeServer(EcHandlers):
         ev = self.store.find_ec_volume(vid)
         if ev is not None:
             check = await self.read_ec_needle(ev, fid.key)
-            if check is not None and check.is_chunked_manifest():
+            if check is not None and check.cookie != fid.cookie:
+                return web.json_response({"error": "cookie mismatch"}, status=403)
+            if (
+                check is not None
+                and check.is_chunked_manifest()
+                and not is_replicate
+            ):
                 # manifest on an EC volume still owns its chunk needles
                 await self._delete_manifest_chunks(check)
             size = await self.delete_ec_needle(ev, fid.key)

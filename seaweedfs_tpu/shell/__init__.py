@@ -7,5 +7,6 @@ mutating commands must hold the cluster-wide exclusive admin lease
 
 from .command_env import CommandEnv
 from .commands import COMMANDS, run_command
+from . import operator_commands  # noqa: F401  (registers volume.balance/fsck, fs.*, bucket.*)
 
 __all__ = ["CommandEnv", "COMMANDS", "run_command"]

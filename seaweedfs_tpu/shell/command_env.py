@@ -15,9 +15,10 @@ class NotLockedError(Exception):
 
 
 class CommandEnv:
-    def __init__(self, master: str):
+    def __init__(self, master: str, filer: str = ""):
         self.master = master
         self.master_stub = Stub(grpc_address(master), "master")
+        self.filer = filer  # sticky default for fs.*/bucket.* commands
         self._admin_token: Optional[int] = None
         self._renew_task: Optional[asyncio.Task] = None
 

@@ -1,0 +1,445 @@
+"""Operator shell suite: volume.balance, volume.fsck, fs.*, bucket.*
+(ref: weed/shell/command_volume_balance.go, command_volume_fsck.go,
+command_fs_ls.go, command_fs_du.go, command_fs_cat.go,
+command_bucket_list.go / _create.go / _delete.go).
+
+Registered into the same COMMANDS table as commands.py.
+"""
+
+from __future__ import annotations
+
+from ..pb import grpc_address
+from ..pb.rpc import Stub
+from ..storage.idx import parse_entry
+from ..types import NEEDLE_MAP_ENTRY_SIZE, TOMBSTONE_FILE_SIZE
+from .commands import COMMANDS, _parse_flags, command
+
+BUCKETS_ROOT = "/buckets"
+
+
+def _fs_args(argv: list[str], value_flags=("filer", "name")) -> tuple[dict, list]:
+    """Parse fs/bucket command args: only value_flags consume a value, so a
+    bare path after a boolean flag (`fs.ls -l /docs`) stays positional."""
+    flags: dict[str, str] = {}
+    positional: list[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a.startswith("-"):
+            key = a.lstrip("-")
+            if "=" in key:
+                key, _, val = key.partition("=")
+                flags[key] = val
+            elif key in value_flags and i + 1 < len(argv):
+                flags[key] = argv[i + 1]
+                i += 1
+            else:
+                flags[key] = "true"
+        else:
+            positional.append(a)
+        i += 1
+    return flags, positional
+
+
+def _filer_stub(env, flags) -> Stub:
+    addr = flags.get("filer") or getattr(env, "filer", None)
+    if not addr:
+        raise ValueError("need -filer host:port (or set one on the env)")
+    env.filer = addr  # sticky, like the reference's fs.ls path memory
+    return Stub(grpc_address(addr), "filer")
+
+
+async def _list_dir(stub: Stub, directory: str) -> list[dict]:
+    resp = await stub.call(
+        "ListEntries", {"directory": directory, "limit": 100_000}
+    )
+    return resp.get("entries", [])
+
+
+# ---------------- volume.balance (ref command_volume_balance.go:61) ----------------
+@command("volume.balance")
+async def cmd_volume_balance(env, argv) -> str:
+    """volume.balance [-collection ALL_COLLECTIONS|name] [-dataCenter dc]
+    [-force]
+
+    Even out volume counts across servers: nodes are grouped by their
+    configured capacity, writable and readonly volumes are balanced
+    separately toward the mean, moving volumes from the fullest node to
+    the emptiest (ref balanceSelectedVolume). Without -force only the
+    plan is printed.
+    """
+    env.confirm_is_locked()
+    flags = _parse_flags(argv)
+    collection = flags.get("collection", "ALL_COLLECTIONS")
+    dc_filter = flags.get("dataCenter", "")
+    apply_moves = "force" in flags
+
+    resp = await env.master_stub.call("VolumeList", {})
+    topo = resp.get("topology_info", {})
+    size_limit = int(resp.get("volume_size_limit_mb", 30_000)) * 1024 * 1024
+
+    by_capacity: dict[int, list[dict]] = {}
+    for dc in topo.get("data_centers", []):
+        if dc_filter and dc["id"] != dc_filter:
+            continue
+        for rack in dc.get("racks", []):
+            for dn in rack.get("data_nodes", []):
+                by_capacity.setdefault(
+                    int(dn.get("max_volume_count", 0)), []
+                ).append(dn)
+
+    out = []
+    moves = 0
+    for capacity, nodes in by_capacity.items():
+        if len(nodes) < 2:
+            out.append(
+                f"only 1 node is configured max {capacity} volumes,"
+                " skipping balancing"
+            )
+            continue
+        for writable in (True, False):
+            moves += await _balance_selected(
+                env, nodes, collection, size_limit, writable, apply_moves, out
+            )
+    verb = "moved" if apply_moves else "would move (use -force to apply)"
+    out.append(f"{verb}: {moves} volumes")
+    return "\n".join(out)
+
+
+def _selected_volumes(node: dict, collection: str, size_limit: int, writable: bool):
+    vols = []
+    for v in node.get("volumes", []):
+        if collection != "ALL_COLLECTIONS" and v.get("collection", "") != collection:
+            continue
+        is_writable = not v.get("read_only") and int(v.get("size", 0)) < size_limit
+        if is_writable == writable:
+            vols.append(v)
+    return vols
+
+
+async def _balance_selected(
+    env, nodes, collection, size_limit, writable, apply_moves, out
+) -> int:
+    """One fullest->emptiest pass per round until within the ideal count
+    (ref balanceSelectedVolume)."""
+    selected = {
+        dn["url"]: {int(v["id"]): v for v in _selected_volumes(dn, collection, size_limit, writable)}
+        for dn in nodes
+    }
+    # every volume id a node holds, selected or not — a move target must
+    # not already hold a replica (ref balance's targetNode.hasVolume gate)
+    node_vids = {
+        dn["url"]: {int(v["id"]) for v in dn.get("volumes", [])} for dn in nodes
+    }
+    total = sum(len(v) for v in selected.values())
+    ideal = -(-total // len(nodes))  # ceil
+    moves = 0
+    while True:
+        ordered = sorted(nodes, key=lambda dn: len(selected[dn["url"]]))
+        emptiest, fullest = ordered[0], ordered[-1]
+        if len(selected[fullest["url"]]) <= ideal:
+            break
+        if len(selected[emptiest["url"]]) + 1 > ideal:
+            break
+        # writable volumes move smallest-first, readonly lowest-id-first
+        # (ref sortWritableVolumes / sortReadOnlyVolumes)
+        candidates = sorted(
+            (
+                v
+                for vid, v in selected[fullest["url"]].items()
+                if vid not in node_vids[emptiest["url"]]
+            ),
+            key=(lambda v: int(v.get("size", 0))) if writable else (lambda v: int(v["id"])),
+        )
+        if not candidates:
+            break
+        v = candidates[0]
+        vid = int(v["id"])
+        out.append(
+            f"move volume {vid} {fullest['url']} -> {emptiest['url']}"
+            f" ({'writable' if writable else 'readonly'})"
+        )
+        if apply_moves:
+            r = await env.volume_stub(emptiest["url"]).call(
+                "VolumeCopy",
+                {
+                    "volume_id": vid,
+                    "collection": v.get("collection", ""),
+                    "source_data_node": fullest["url"],
+                },
+                timeout=600,
+            )
+            if r.get("error"):
+                out.append(f"  move failed: {r['error']}")
+                break
+            await env.volume_stub(fullest["url"]).call(
+                "VolumeDelete", {"volume_id": vid}
+            )
+        del selected[fullest["url"]][vid]
+        selected[emptiest["url"]][vid] = v
+        node_vids[fullest["url"]].discard(vid)
+        node_vids[emptiest["url"]].add(vid)
+        moves += 1
+    return moves
+
+
+# ---------------- volume.fsck (ref command_volume_fsck.go:25) ----------------
+async def _collect_volume_fids(env) -> dict[int, dict[int, int]]:
+    """vid -> {needle_key: size} of live entries, by streaming each
+    volume's .idx through the CopyFile RPC (set A in the reference's
+    algorithm)."""
+    volume_fids: dict[int, dict[int, int]] = {}
+    for dn in await env.collect_data_nodes():
+        for v in dn.get("volumes", []):
+            vid = int(v["id"])
+            live = volume_fids.setdefault(vid, {})
+            buf = b""
+            async for msg in env.volume_stub(dn["url"]).server_stream(
+                "CopyFile",
+                {
+                    "volume_id": vid,
+                    "collection": v.get("collection", ""),
+                    "ext": ".idx",
+                },
+                timeout=600,
+            ):
+                if msg.get("error"):
+                    break
+                buf += msg.get("file_content", b"")
+            for off in range(0, len(buf) - len(buf) % NEEDLE_MAP_ENTRY_SIZE, NEEDLE_MAP_ENTRY_SIZE):
+                key, offset_units, size = parse_entry(
+                    buf[off : off + NEEDLE_MAP_ENTRY_SIZE]
+                )
+                if offset_units == 0 or size == TOMBSTONE_FILE_SIZE:
+                    live.pop(key, None)
+                else:
+                    live[key] = size
+    return volume_fids
+
+
+async def _collect_filer_fids(stub: Stub, root: str = "/") -> set[tuple[int, int]]:
+    """(vid, needle_key) pairs referenced by any filer entry (set B)."""
+    from ..storage.file_id import FileId
+
+    refs: set[tuple[int, int]] = set()
+    stack = [root]
+    while stack:
+        directory = stack.pop()
+        for e in await _list_dir(stub, directory):
+            if e.get("is_directory"):
+                stack.append(e["full_path"])
+                continue
+            for c in e.get("chunks", []):
+                try:
+                    f = FileId.parse(c["fid"])
+                    refs.add((f.volume_id, f.key))
+                except ValueError:
+                    pass
+    return refs
+
+
+@command("volume.fsck")
+async def cmd_volume_fsck(env, argv) -> str:
+    """volume.fsck -filer host:port [-reallyDeleteFromVolume] [-v]
+
+    Finds volume entries not referenced by the filer: collects all file
+    ids from all volumes (set A) and from the filer namespace (set B),
+    reporting A - B (ref command_volume_fsck.go:41-48). With
+    -reallyDeleteFromVolume the orphans are purged via BatchDelete.
+    """
+    env.confirm_is_locked()
+    flags = _parse_flags(argv)
+    stub = _filer_stub(env, flags)
+    purge = "reallyDeleteFromVolume" in flags
+    verbose = "v" in flags
+
+    volume_fids = await _collect_volume_fids(env)
+    filer_refs = await _collect_filer_fids(stub)
+
+    out = []
+    total_orphans = 0
+    total_bytes = 0
+    total_entries = sum(len(m) for m in volume_fids.values())
+    for vid, live in sorted(volume_fids.items()):
+        orphans = [
+            (key, size) for key, size in live.items() if (vid, key) not in filer_refs
+        ]
+        if not orphans:
+            continue
+        total_orphans += len(orphans)
+        total_bytes += sum(size for _, size in orphans)
+        out.append(
+            f"volume {vid}: {len(orphans)}/{len(live)} entries not referenced"
+            f" by the filer ({sum(s for _, s in orphans)} bytes)"
+        )
+        if verbose:
+            out.extend(f"  {vid},{key:x}" for key, _ in orphans)
+        if purge:
+            fids = [f"{vid},{key:x}00000000" for key, _ in orphans]
+            # purge every replica: BatchDelete is a direct store delete
+            # with no replication fan-out of its own
+            for dn in await env.collect_data_nodes():
+                if any(int(v["id"]) == vid for v in dn.get("volumes", [])):
+                    await env.volume_stub(dn["url"]).call(
+                        "BatchDelete", {"file_ids": fids}
+                    )
+            out.append(f"  purged {len(orphans)} orphans from volume {vid}")
+    out.append(
+        f"total {total_entries} entries, {total_orphans} orphans"
+        f" ({total_bytes} bytes)"
+        + ("" if purge else " — use -reallyDeleteFromVolume to purge")
+    )
+    return "\n".join(out)
+
+
+# ---------------- fs.* (ref command_fs_ls.go / _du.go / _cat.go) ----------------
+@command("fs.ls")
+async def cmd_fs_ls(env, argv) -> str:
+    """fs.ls [-filer host:port] [-l] /dir"""
+    flags, positional = _fs_args(argv)
+    stub = _filer_stub(env, flags)
+    path = positional[0] if positional else "/"
+    entries = await _list_dir(stub, path.rstrip("/") or "/")
+    long_format = "l" in flags
+    lines = []
+    for e in sorted(entries, key=lambda e: e["full_path"]):
+        name = e["full_path"].rsplit("/", 1)[-1]
+        if e.get("is_directory"):
+            name += "/"
+        if long_format:
+            size = sum(int(c["size"]) for c in e.get("chunks", []))
+            mode = int(e.get("attr", {}).get("mode", 0))
+            lines.append(f"{mode:o}\t{size}\t{name}")
+        else:
+            lines.append(name)
+    return "\n".join(lines) if lines else f"(empty) {path}"
+
+
+@command("fs.du")
+async def cmd_fs_du(env, argv) -> str:
+    """fs.du [-filer host:port] /dir — recursive bytes + file/dir counts."""
+    flags, positional = _fs_args(argv)
+    stub = _filer_stub(env, flags)
+    path = (positional[0] if positional else "/").rstrip("/") or "/"
+
+    total_bytes = 0
+    n_files = 0
+    n_dirs = 0
+    stack = [path]
+    while stack:
+        directory = stack.pop()
+        for e in await _list_dir(stub, directory):
+            if e.get("is_directory"):
+                n_dirs += 1
+                stack.append(e["full_path"])
+            else:
+                n_files += 1
+                total_bytes += sum(int(c["size"]) for c in e.get("chunks", []))
+    return f"{total_bytes} bytes\t{n_files} files\t{n_dirs} dirs\t{path}"
+
+
+@command("fs.cat")
+async def cmd_fs_cat(env, argv) -> str:
+    """fs.cat [-filer host:port] /path/to/file — prints the content
+    (utf-8 with replacement; binary-safe callers should use HTTP)."""
+    flags, positional = _fs_args(argv)
+    stub = _filer_stub(env, flags)
+    if not positional:
+        return "usage: fs.cat [-filer host:port] /path/to/file"
+    path = positional[0]
+    directory, _, name = path.rstrip("/").rpartition("/")
+    resp = await stub.call(
+        "LookupDirectoryEntry", {"directory": directory or "/", "name": name}
+    )
+    if resp.get("error"):
+        return f"fs.cat: {path}: {resp['error']}"
+    entry = resp["entry"]
+    if entry.get("is_directory"):
+        return f"fs.cat: {path}: is a directory"
+
+    import aiohttp
+
+    from ..client.operation import lookup, read_url
+
+    chunks = sorted(entry.get("chunks", []), key=lambda c: int(c["offset"]))
+    parts = []
+    vid_locations: dict[int, list[str]] = {}
+    async with aiohttp.ClientSession() as session:
+        for c in chunks:
+            vid = int(c["fid"].split(",")[0])
+            if vid not in vid_locations:
+                vid_locations[vid] = await lookup(env.master, vid)
+            if not vid_locations[vid]:
+                return f"fs.cat: chunk {c['fid']}: volume {vid} not found"
+            parts.append(
+                await read_url(
+                    session, f"http://{vid_locations[vid][0]}/{c['fid']}"
+                )
+            )
+    return b"".join(parts).decode("utf-8", "replace")
+
+
+# ---------------- bucket.* (ref command_bucket_*.go) ----------------
+@command("bucket.list")
+async def cmd_bucket_list(env, argv) -> str:
+    """bucket.list [-filer host:port]"""
+    flags, _ = _fs_args(argv)
+    stub = _filer_stub(env, flags)
+    entries = await _list_dir(stub, BUCKETS_ROOT)
+    names = [
+        e["full_path"].rsplit("/", 1)[-1]
+        for e in entries
+        if e.get("is_directory") and not e["full_path"].rsplit("/", 1)[-1].startswith(".")
+    ]
+    return "\n".join(sorted(names)) if names else "(no buckets)"
+
+
+@command("bucket.create")
+async def cmd_bucket_create(env, argv) -> str:
+    """bucket.create -name bucketName [-filer host:port]"""
+    flags, _ = _fs_args(argv)
+    name = flags.get("name", "")
+    if not name:
+        return "usage: bucket.create -name bucketName [-filer host:port]"
+    stub = _filer_stub(env, flags)
+    import time
+
+    resp = await stub.call(
+        "CreateEntry",
+        {
+            "entry": {
+                "full_path": f"{BUCKETS_ROOT}/{name}",
+                "is_directory": True,
+                "attr": {
+                    "mode": 0o770 | 0o040000,
+                    "mtime": time.time(),
+                    "crtime": time.time(),
+                },
+            }
+        },
+    )
+    if resp.get("error"):
+        return f"bucket.create: {resp['error']}"
+    return f"created bucket {name}"
+
+
+@command("bucket.delete")
+async def cmd_bucket_delete(env, argv) -> str:
+    """bucket.delete -name bucketName [-filer host:port]"""
+    flags, _ = _fs_args(argv)
+    name = flags.get("name", "")
+    if not name:
+        return "usage: bucket.delete -name bucketName [-filer host:port]"
+    stub = _filer_stub(env, flags)
+    resp = await stub.call(
+        "DeleteEntry",
+        {
+            "directory": BUCKETS_ROOT,
+            "name": name,
+            "is_recursive": True,
+            "is_delete_data": True,
+        },
+    )
+    if resp.get("error"):
+        return f"bucket.delete: {resp['error']}"
+    return f"deleted bucket {name}"

@@ -1,0 +1,161 @@
+"""Operator shell suite end-to-end: volume.balance, volume.fsck, fs.*,
+bucket.* (ref: weed/shell/command_volume_balance.go:61,
+command_volume_fsck.go:25, command_fs_*.go, command_bucket_*.go)."""
+
+import asyncio
+import random
+
+import aiohttp
+
+from test_cluster import Cluster, free_port_pair
+
+from seaweedfs_tpu.client import assign
+from seaweedfs_tpu.client.operation import upload_data
+from seaweedfs_tpu.server.volume import VolumeServer
+from seaweedfs_tpu.shell import CommandEnv, run_command
+
+
+def test_volume_balance(tmp_path):
+    async def body():
+        random.seed(53)
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                # create volumes + data on the single server
+                async with session.get(
+                    f"http://{cluster.master.address}/vol/grow?count=6"
+                ) as resp:
+                    assert resp.status == 200, await resp.text()
+                ar = await assign(cluster.master.address)
+                await upload_data(session, ar.url, ar.fid, b"balance-me")
+
+                # a second, empty server joins
+                vport = free_port_pair()
+                d = tmp_path / "vol-late"
+                d.mkdir()
+                vs = VolumeServer(
+                    master=cluster.master.address,
+                    directories=[str(d)],
+                    port=vport,
+                    pulse_seconds=0.2,
+                    max_volume_counts=[20],
+                )
+                await vs.start()
+                cluster.volume_servers.append(vs)
+                for _ in range(100):
+                    if len(cluster.master.topo.data_nodes()) == 2:
+                        break
+                    await asyncio.sleep(0.1)
+
+                env = CommandEnv(cluster.master.address)
+                # plan only (no -force): nothing moves
+                await run_command(env, "lock")
+                plan = await run_command(env, "volume.balance")
+                assert "would move" in plan and "move volume" in plan
+
+                out = await run_command(env, "volume.balance -force")
+                assert "moved: " in out
+
+                # counts are now even within 1
+                await asyncio.sleep(1.0)  # let heartbeats refresh the topo
+                nodes = await env.collect_data_nodes()
+                counts = sorted(len(dn.get("volumes", [])) for dn in nodes)
+                assert counts[-1] - counts[0] <= 1, counts
+
+                # the uploaded blob is still readable wherever it moved
+                vid = int(ar.fid.split(",")[0])
+                resp = await env.master_stub.call(
+                    "LookupVolume", {"volume_ids": [str(vid)]}
+                )
+                locs = resp["volume_id_locations"][0]["locations"]
+                async with session.get(
+                    f"http://{locs[0]['url']}/{ar.fid}"
+                ) as r2:
+                    assert r2.status == 200
+                    assert await r2.read() == b"balance-me"
+                await run_command(env, "unlock")
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_fsck_fs_and_buckets(tmp_path):
+    async def body():
+        random.seed(59)
+        cluster = Cluster(tmp_path, n_volume_servers=2)
+        await cluster.start()
+        from seaweedfs_tpu.server.filer import FilerServer
+
+        fs = FilerServer(
+            master=cluster.master.address,
+            port=free_port_pair(),
+            chunk_size=32 * 1024,
+        )
+        await fs.start()
+        try:
+            await fs.master_client.wait_connected()
+            env = CommandEnv(cluster.master.address, filer=fs.address)
+            async with aiohttp.ClientSession() as session:
+                base = f"http://{fs.address}"
+                # files through the filer (referenced chunks)
+                doc = random.randbytes(80 * 1024)  # 3 chunks
+                async with session.put(f"{base}/docs/a.bin", data=doc) as r:
+                    assert r.status == 201
+                async with session.put(
+                    f"{base}/docs/sub/b.txt", data=b"hello shell"
+                ) as r:
+                    assert r.status == 201
+
+                # fs.ls / fs.du / fs.cat
+                out = await run_command(env, "fs.ls /docs")
+                assert "a.bin" in out and "sub/" in out
+                out = await run_command(env, "fs.ls -l /docs")
+                assert str(len(doc)) in out
+                out = await run_command(env, "fs.du /docs")
+                assert f"{len(doc) + len(b'hello shell')} bytes" in out
+                assert "2 files" in out and "1 dirs" in out
+                out = await run_command(env, "fs.cat /docs/sub/b.txt")
+                assert out == "hello shell"
+
+                # bucket.*
+                out = await run_command(env, "bucket.create -name mybkt")
+                assert "created" in out
+                out = await run_command(env, "bucket.list")
+                assert "mybkt" in out
+                assert fs.filer.find_entry("/buckets/mybkt") is not None
+                out = await run_command(env, "bucket.delete -name mybkt")
+                assert "deleted" in out
+                assert fs.filer.find_entry("/buckets/mybkt") is None
+
+                # an orphan: uploaded directly, unknown to the filer
+                ar = await assign(cluster.master.address)
+                await upload_data(session, ar.url, ar.fid, b"orphan-data")
+
+                await run_command(env, "lock")
+                # volume inventories reach the master via heartbeat deltas;
+                # poll until the orphan shows up
+                out = ""
+                for _ in range(50):
+                    out = await run_command(env, "volume.fsck")
+                    if "1 orphans" in out:
+                        break
+                    await asyncio.sleep(0.2)
+                assert "1 orphans" in out, out
+
+                out = await run_command(
+                    env, "volume.fsck -reallyDeleteFromVolume"
+                )
+                assert "purged 1 orphans" in out, out
+                async with session.get(f"http://{ar.url}/{ar.fid}") as r:
+                    assert r.status == 404
+
+                out = await run_command(env, "volume.fsck")
+                assert "0 orphans" in out, out
+                await run_command(env, "unlock")
+        finally:
+            await fs.stop()
+            await cluster.stop()
+
+    asyncio.run(body())
