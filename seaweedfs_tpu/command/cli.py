@@ -53,6 +53,75 @@ def _add_volume_flags(p: argparse.ArgumentParser) -> None:
         choices=["memory", "leveldb", "sorted"],
         help="needle map kind (ref NeedleMapKind, weed/storage/needle_map.go:14)",
     )
+    p.add_argument(
+        "-jwtSigningKey",
+        default="",
+        help="HS256 key gating uploads (ref security/jwt.go; usually set "
+        "via [security] in -config)",
+    )
+
+
+def _apply_config_defaults(
+    p: argparse.ArgumentParser,
+    argv: list[str],
+    sections: list[str],
+    renames: dict | None = None,
+) -> None:
+    """-config support (ref weed/util/config.go:19-51): load a scaffold-
+    emitted TOML (explicit path, or a name searched in ., ~/.seaweedfs-tpu,
+    /etc/seaweedfs-tpu), apply its sections as flag defaults (explicit CLI
+    flags still win), honor WEED_SECTION_KEY env overrides, and install
+    [security]/[grpc] side effects (JWT key, mTLS)."""
+    p.add_argument(
+        "-config",
+        default="",
+        help="TOML config file (or name searched in ., ~/.seaweedfs-tpu, "
+        "/etc/seaweedfs-tpu); CLI flags override file values",
+    )
+    pre = argparse.ArgumentParser(add_help=False)
+    pre.add_argument("-config", default="")
+    known, _ = pre.parse_known_args(argv)
+    if not known.config:
+        return
+    from ..util.config import load_configuration
+
+    cfg = load_configuration(known.config, required=True)
+    dests = {a.dest for a in p._actions}
+    defaults = {}
+    for section in sections:
+        for k, v in cfg.section(section).items():
+            if k in dests:
+                defaults[k] = v
+    # cross-section key remaps (e.g. the combined server command maps
+    # [volume] port to its -volumePort flag)
+    for dotted, dest in (renames or {}).items():
+        v = cfg.get(dotted)
+        if v is not None and dest in dests:
+            defaults[dest] = v
+    # [storage] backend -> -storageBackend (the tpu switch)
+    backend = cfg.get("storage.backend")
+    if backend and "storageBackend" in dests:
+        defaults["storageBackend"] = backend
+    # argparse applies type= only to string defaults; a numeric TOML value
+    # for a string-typed flag (e.g. `max = 7`) must become a string or the
+    # consumer's .split() crashes
+    actions_by_dest = {a.dest: a for a in p._actions}
+    for k, v in list(defaults.items()):
+        a = actions_by_dest.get(k)
+        if a is not None and isinstance(a.default, str) and not isinstance(v, str):
+            defaults[k] = str(v)
+    p.set_defaults(**defaults)
+
+    # [grpc] ca/cert/key -> process-wide mTLS (ref weed/security/tls.go)
+    grpc_sec = cfg.section("grpc")
+    if grpc_sec.get("ca") and grpc_sec.get("cert") and grpc_sec.get("key"):
+        from ..pb.rpc import TlsConfig, configure_tls
+
+        configure_tls(
+            TlsConfig.from_files(
+                grpc_sec["ca"], grpc_sec["cert"], grpc_sec["key"]
+            )
+        )
 
 
 def _build_volume_server(args, port_offset: int = 0):
@@ -82,6 +151,7 @@ def _build_volume_server(args, port_offset: int = 0):
         data_center=args.dataCenter,
         rack=args.rack,
         codec_backend=args.storageBackend,
+        jwt_signing_key=getattr(args, "jwtSigningKey", ""),
     )
 
 
@@ -99,6 +169,7 @@ async def _run_forever(*servers) -> None:
 def cmd_master(argv: list[str]) -> int:
     p = argparse.ArgumentParser(prog="weed-tpu master")
     _add_master_flags(p)
+    _apply_config_defaults(p, argv, ["master"])
     args = p.parse_args(argv)
     from ..server.master import MasterServer
 
@@ -118,6 +189,7 @@ def cmd_master(argv: list[str]) -> int:
 def cmd_volume(argv: list[str]) -> int:
     p = argparse.ArgumentParser(prog="weed-tpu volume")
     _add_volume_flags(p)
+    _apply_config_defaults(p, argv, ["volume", "security"])
     args = p.parse_args(argv)
     vs = _build_volume_server(args)
     print(f"volume server listening on {args.ip}:{args.port}")
@@ -137,11 +209,25 @@ def cmd_server(argv: list[str]) -> int:
     p.add_argument("-storageBackend", default="cpu", choices=["cpu", "tpu"])
     p.add_argument("-tierConfig", default="")
     p.add_argument("-index", default="memory", choices=["memory", "leveldb", "sorted"])
+    p.add_argument("-jwtSigningKey", default="")
     p.add_argument("-filer", action="store_true", help="also run a filer")
     p.add_argument("-filerPort", type=int, default=8888)
     p.add_argument("-s3", action="store_true", help="also run an S3 gateway (implies -filer)")
     p.add_argument("-s3Port", type=int, default=8333)
     p.add_argument("-s3Config", default="", help="IAM identities JSON for the S3 gateway")
+    _apply_config_defaults(
+        p,
+        argv,
+        ["master", "server", "security"],
+        renames={
+            "volume.port": "volumePort",
+            "volume.dir": "dir",
+            "volume.max": "max",
+            "volume.dataCenter": "dataCenter",
+            "volume.rack": "rack",
+            "volume.index": "index",
+        },
+    )
     args = p.parse_args(argv)
     from ..server.master import MasterServer
     from ..server.volume import VolumeServer
@@ -172,6 +258,7 @@ def cmd_server(argv: list[str]) -> int:
         rack=args.rack,
         codec_backend=args.storageBackend,
         needle_map_kind=args.index,
+        jwt_signing_key=args.jwtSigningKey,
     )
     servers = [ms, vs]
     desc = (
@@ -544,25 +631,73 @@ def cmd_compact(argv: list[str]) -> int:
     return 0
 
 
-def cmd_scaffold(argv: list[str]) -> int:
-    print(
-        """# seaweedfs-tpu example configuration (TOML)
+SCAFFOLD_TEMPLATES = {
+    # keys match the CLI flag names so -config can apply them as flag
+    # defaults directly (ref: weed/command/scaffold.go emits per-subsystem
+    # templates consumed by util.LoadConfiguration)
+    "config": """# seaweedfs-tpu configuration (TOML); load with -config config
+# (searched in ., ~/.seaweedfs-tpu, /etc/seaweedfs-tpu). Every value can be
+# overridden from the environment as WEED_<SECTION>_<KEY>, e.g.
+# WEED_MASTER_PORT=9444.
 [master]
 ip = "127.0.0.1"
 port = 9333
-volume_size_limit_mb = 30000
-default_replication = "000"
+volumeSizeLimitMB = 30000
+defaultReplication = "000"
+# peers = "host1:9333,host2:9333,host3:9333"
 
 [volume]
 port = 8080
 dir = "./data"
-max = 7
+max = "7"
 mserver = "127.0.0.1:9333"
+index = "memory"          # memory | leveldb | sorted
+
+[server]
+volumePort = 8080
+filerPort = 8888
 
 [storage]
-backend = "tpu"   # route erasure coding through the TPU kernels
-"""
+backend = "tpu"           # route erasure coding through the TPU kernels
+""",
+    "security": """# seaweedfs-tpu security configuration (TOML)
+# (ref: weed scaffold -config=security; weed/security/tls.go)
+[security]
+jwtSigningKey = ""        # non-empty gates uploads behind fid-scoped JWTs
+
+[grpc]
+# PEM files enabling mutual TLS on every gRPC surface when all three are set
+ca = ""
+cert = ""
+key = ""
+""",
+}
+
+
+def cmd_scaffold(argv: list[str]) -> int:
+    """Emit config templates (ref command/scaffold.go:37-45):
+    scaffold [-config config|security] [-output dir]."""
+    p = argparse.ArgumentParser(prog="weed-tpu scaffold")
+    p.add_argument(
+        "-config",
+        default="config",
+        choices=sorted(SCAFFOLD_TEMPLATES),
+        help="which template to generate",
     )
+    p.add_argument(
+        "-output",
+        default="",
+        help="directory to write <name>.toml into ('' = print to stdout)",
+    )
+    args = p.parse_args(argv)
+    text = SCAFFOLD_TEMPLATES[args.config]
+    if args.output:
+        path = os.path.join(args.output, args.config + ".toml")
+        with open(path, "w") as f:
+            f.write(text)
+        print(f"wrote {path}")
+    else:
+        print(text)
     return 0
 
 

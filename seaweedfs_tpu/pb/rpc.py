@@ -168,12 +168,57 @@ _channels: Dict[str, grpc.aio.Channel] = {}
 _channels_lock = threading.Lock()
 
 
+@dataclass
+class TlsConfig:
+    """mTLS material for every gRPC surface (ref: weed/security/tls.go:16-43
+    — the reference loads [grpc] ca/cert/key from security.toml and applies
+    it to all servers and dialers alike)."""
+
+    ca: bytes
+    cert: bytes
+    key: bytes
+
+    @classmethod
+    def from_files(cls, ca_path: str, cert_path: str, key_path: str) -> "TlsConfig":
+        with open(ca_path, "rb") as f:
+            ca = f.read()
+        with open(cert_path, "rb") as f:
+            cert = f.read()
+        with open(key_path, "rb") as f:
+            key = f.read()
+        return cls(ca=ca, cert=cert, key=key)
+
+
+_tls_config: TlsConfig | None = None
+
+
+def configure_tls(tls: TlsConfig | None) -> None:
+    """Install (or clear) the process-wide mTLS config. Existing cached
+    channels keep their old security mode — call close_all_channels()
+    first when switching on a live process."""
+    global _tls_config
+    _tls_config = tls
+
+
 def get_channel(address: str) -> grpc.aio.Channel:
-    """Cached insecure channel with keepalive (ref grpc_client_server.go:56)."""
+    """Cached channel with keepalive (ref grpc_client_server.go:56);
+    secure when a TlsConfig is installed, insecure otherwise."""
     with _channels_lock:
         ch = _channels.get(address)
         if ch is None:
-            ch = grpc.aio.insecure_channel(address, options=_KEEPALIVE_OPTIONS)
+            if _tls_config is not None:
+                creds = grpc.ssl_channel_credentials(
+                    root_certificates=_tls_config.ca,
+                    private_key=_tls_config.key,
+                    certificate_chain=_tls_config.cert,
+                )
+                ch = grpc.aio.secure_channel(
+                    address, creds, options=_KEEPALIVE_OPTIONS
+                )
+            else:
+                ch = grpc.aio.insecure_channel(
+                    address, options=_KEEPALIVE_OPTIONS
+                )
             _channels[address] = ch
         return ch
 
@@ -192,6 +237,14 @@ async def serve(
     server = grpc.aio.server(options=_KEEPALIVE_OPTIONS)
     for svc in services:
         server.add_generic_rpc_handlers((svc.build_handler(),))
-    server.add_insecure_port(bind_address)
+    if _tls_config is not None:
+        creds = grpc.ssl_server_credentials(
+            [(_tls_config.key, _tls_config.cert)],
+            root_certificates=_tls_config.ca,
+            require_client_auth=True,  # mutual TLS, like the reference
+        )
+        server.add_secure_port(bind_address, creds)
+    else:
+        server.add_insecure_port(bind_address)
     await server.start()
     return server

@@ -167,6 +167,23 @@ async def cmd_volume_mount(env, argv) -> str:
     return f"volume {vid} mounted on {node}"
 
 
+async def move_volume(
+    env, vid: int, collection: str, source: str, target: str, timeout: float = 600
+) -> str:
+    """Copy a volume to the target node, then delete the source copy;
+    returns '' on success (ref command_volume_move.go). Shared by
+    volume.move and volume.balance."""
+    r = await env.volume_stub(target).call(
+        "VolumeCopy",
+        {"volume_id": vid, "collection": collection, "source_data_node": source},
+        timeout=timeout,
+    )
+    if r.get("error"):
+        return r["error"]
+    await env.volume_stub(source).call("VolumeDelete", {"volume_id": vid})
+    return ""
+
+
 @command("volume.move")
 async def cmd_volume_move(env, argv) -> str:
     """Copy a volume to a target node, then delete the source copy
@@ -175,16 +192,9 @@ async def cmd_volume_move(env, argv) -> str:
     flags = _parse_flags(argv)
     vid = int(flags["volumeId"])
     source, target = flags["source"], flags["target"]
-    collection = flags.get("collection", "")
-    tstub = env.volume_stub(target)
-    r = await tstub.call(
-        "VolumeCopy",
-        {"volume_id": vid, "collection": collection, "source_data_node": source},
-        timeout=600,
-    )
-    if r.get("error"):
-        return f"move failed: {r['error']}"
-    await env.volume_stub(source).call("VolumeDelete", {"volume_id": vid})
+    err = await move_volume(env, vid, flags.get("collection", ""), source, target)
+    if err:
+        return f"move failed: {err}"
     return f"volume {vid} moved {source} -> {target}"
 
 

@@ -50,10 +50,25 @@ def _filer_stub(env, flags) -> Stub:
 
 
 async def _list_dir(stub: Stub, directory: str) -> list[dict]:
-    resp = await stub.call(
-        "ListEntries", {"directory": directory, "limit": 100_000}
-    )
-    return resp.get("entries", [])
+    """Full listing via pagination (the filer honors `limit`, so a single
+    capped call would silently truncate large directories)."""
+    entries: list[dict] = []
+    start = ""
+    while True:
+        resp = await stub.call(
+            "ListEntries",
+            {
+                "directory": directory,
+                "start_from_file_name": start,
+                "inclusive_start_from": not start,
+                "limit": 1024,
+            },
+        )
+        page = resp.get("entries", [])
+        entries.extend(page)
+        if len(page) < 1024:
+            return entries
+        start = page[-1]["full_path"].rsplit("/", 1)[-1]
 
 
 # ---------------- volume.balance (ref command_volume_balance.go:61) ----------------
@@ -160,21 +175,14 @@ async def _balance_selected(
             f" ({'writable' if writable else 'readonly'})"
         )
         if apply_moves:
-            r = await env.volume_stub(emptiest["url"]).call(
-                "VolumeCopy",
-                {
-                    "volume_id": vid,
-                    "collection": v.get("collection", ""),
-                    "source_data_node": fullest["url"],
-                },
-                timeout=600,
+            from .commands import move_volume
+
+            err = await move_volume(
+                env, vid, v.get("collection", ""), fullest["url"], emptiest["url"]
             )
-            if r.get("error"):
-                out.append(f"  move failed: {r['error']}")
+            if err:
+                out.append(f"  move failed: {err}")
                 break
-            await env.volume_stub(fullest["url"]).call(
-                "VolumeDelete", {"volume_id": vid}
-            )
         del selected[fullest["url"]][vid]
         selected[emptiest["url"]][vid] = v
         node_vids[fullest["url"]].discard(vid)
@@ -193,7 +201,7 @@ async def _collect_volume_fids(env) -> dict[int, dict[int, int]]:
         for v in dn.get("volumes", []):
             vid = int(v["id"])
             live = volume_fids.setdefault(vid, {})
-            buf = b""
+            parts = []
             async for msg in env.volume_stub(dn["url"]).server_stream(
                 "CopyFile",
                 {
@@ -205,7 +213,8 @@ async def _collect_volume_fids(env) -> dict[int, dict[int, int]]:
             ):
                 if msg.get("error"):
                     break
-                buf += msg.get("file_content", b"")
+                parts.append(msg.get("file_content", b""))
+            buf = b"".join(parts)
             for off in range(0, len(buf) - len(buf) % NEEDLE_MAP_ENTRY_SIZE, NEEDLE_MAP_ENTRY_SIZE):
                 key, offset_units, size = parse_entry(
                     buf[off : off + NEEDLE_MAP_ENTRY_SIZE]
@@ -255,6 +264,22 @@ async def cmd_volume_fsck(env, argv) -> str:
 
     volume_fids = await _collect_volume_fids(env)
     filer_refs = await _collect_filer_fids(stub)
+    # a filer PUT writes its chunks BEFORE creating the entry, so a chunk
+    # captured in set A can legitimately miss the first filer walk; re-walk
+    # after a grace period before calling anything an orphan (the reference
+    # excludes entries newer than a cutoff time for the same race,
+    # ref command_volume_fsck.go)
+    if any(
+        (vid, key) not in filer_refs
+        for vid, live in volume_fids.items()
+        for key in live
+    ):
+        grace = float(flags.get("grace", "2"))
+        if grace > 0:
+            import asyncio
+
+            await asyncio.sleep(grace)
+        filer_refs |= await _collect_filer_fids(stub)
 
     out = []
     total_orphans = 0

@@ -1,0 +1,102 @@
+"""TOML configuration with env overrides (ref: weed/util/config.go:19-51).
+
+Search path mirrors the reference's viper setup: the working directory,
+~/.seaweedfs-tpu, then /etc/seaweedfs-tpu — first hit wins per file name.
+Values can be overridden from the environment with the same convention as
+the reference's `WEED_` prefix: `WEED_<SECTION>_<KEY>` (dots become
+underscores, case-insensitive), e.g. `WEED_MASTER_PORT=9444` overrides
+`[master] port`.
+
+Files are produced by `weed-tpu scaffold` and consumed by the server
+commands via their -config flag.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from typing import Any, Optional
+
+SEARCH_PATHS = [".", os.path.expanduser("~/.seaweedfs-tpu"), "/etc/seaweedfs-tpu"]
+ENV_PREFIX = "WEED_"
+
+
+class Configuration:
+    """Parsed TOML + env-override lookup."""
+
+    def __init__(self, data: dict, source: str = ""):
+        self.data = data
+        self.source = source
+
+    def get(self, dotted_key: str, default: Any = None) -> Any:
+        """`section.key` lookup; `WEED_SECTION_KEY` env vars win
+        (ref GetViper's AutomaticEnv + SetEnvPrefix, config.go:44-51).
+        Env strings are coerced to the type of the file/default value."""
+        env_name = ENV_PREFIX + dotted_key.upper().replace(".", "_")
+        node: Any = self.data
+        for part in dotted_key.split("."):
+            if not isinstance(node, dict) or part not in node:
+                node = None
+                break
+            node = node[part]
+        if env_name in os.environ:
+            raw = os.environ[env_name]
+            model = node if node is not None else default
+            return _coerce(raw, model)
+        return node if node is not None else default
+
+    def section(self, name: str) -> dict:
+        """A whole section with env overrides applied per key."""
+        base = dict(self.data.get(name, {}))
+        prefix = ENV_PREFIX + name.upper() + "_"
+        for env_name, raw in os.environ.items():
+            if env_name.startswith(prefix):
+                key = env_name[len(prefix) :].lower()
+                # match an existing key case-insensitively (flag-style keys
+                # like volumeSizeLimitMB live lowercase in the env name)
+                target = next(
+                    (k for k in base if k.lower() == key), key
+                )
+                base[target] = _coerce(raw, base.get(target))
+        return base
+
+
+def _coerce(raw: str, model: Any) -> Any:
+    if isinstance(model, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(model, int):
+        try:
+            return int(raw)
+        except ValueError:
+            return raw
+    if isinstance(model, float):
+        try:
+            return float(raw)
+        except ValueError:
+            return raw
+    return raw
+
+
+def load_configuration(
+    name_or_path: str,
+    required: bool = False,
+    search_paths: Optional[list[str]] = None,
+) -> Optional[Configuration]:
+    """Load `<name>.toml` from the search path, or an explicit file path
+    (ref LoadConfiguration, config.go:19-42)."""
+    candidates = []
+    if name_or_path.endswith(".toml") or "/" in name_or_path:
+        candidates.append(name_or_path)
+    else:
+        for d in search_paths or SEARCH_PATHS:
+            candidates.append(os.path.join(d, name_or_path + ".toml"))
+    for path in candidates:
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                return Configuration(tomllib.load(f), source=path)
+    if required:
+        raise FileNotFoundError(
+            f"no {name_or_path}.toml found in {search_paths or SEARCH_PATHS}; "
+            "generate one with `weed-tpu scaffold -output .`"
+        )
+    return None

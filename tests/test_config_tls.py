@@ -1,0 +1,173 @@
+"""TOML config + env overrides (ref: weed/util/config.go:19-51) and
+mTLS on the msgpack-gRPC layer (ref: weed/security/tls.go)."""
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from test_cluster import free_port_pair
+
+from seaweedfs_tpu.util.config import Configuration, load_configuration
+
+
+def test_load_configuration_search_and_env(tmp_path, monkeypatch):
+    (tmp_path / "config.toml").write_text(
+        """
+[master]
+port = 9333
+defaultReplication = "000"
+
+[volume]
+dir = "./data"
+"""
+    )
+    cfg = load_configuration("config", search_paths=[str(tmp_path)])
+    assert cfg.get("master.port") == 9333
+    assert cfg.get("master.defaultReplication") == "000"
+    assert cfg.get("master.missing", "fallback") == "fallback"
+
+    # env override wins and is coerced to the file value's type
+    monkeypatch.setenv("WEED_MASTER_PORT", "9444")
+    assert cfg.get("master.port") == 9444
+    assert isinstance(cfg.get("master.port"), int)
+    sec = cfg.section("master")
+    assert sec["port"] == 9444
+
+    # env-only key (no file value) arrives as a string
+    monkeypatch.setenv("WEED_VOLUME_NEWKEY", "x")
+    assert cfg.get("volume.newkey") == "x"
+
+    assert load_configuration("nope", search_paths=[str(tmp_path)]) is None
+    with pytest.raises(FileNotFoundError):
+        load_configuration("nope", required=True, search_paths=[str(tmp_path)])
+
+
+def test_cluster_boots_from_config_file(tmp_path):
+    """`weed-tpu server -config file.toml` boots with the file's ports
+    (VERDICT item 8's acceptance)."""
+    mport = free_port_pair()
+    vport = free_port_pair()
+    (tmp_path / "config.toml").write_text(
+        f"""
+[master]
+port = {mport}
+volumeSizeLimitMB = 123
+
+[volume]
+dir = "{tmp_path}/data"
+
+[server]
+volumePort = {vport}
+"""
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "seaweedfs_tpu",
+            "server",
+            "-config",
+            str(tmp_path / "config.toml"),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        cwd="/root/repo",
+    )
+    try:
+        deadline = time.time() + 30
+        last_err = None
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{mport}/dir/assign", timeout=2
+                ) as resp:
+                    body = resp.read()
+                    assert b"fid" in body
+                    break
+            except Exception as e:
+                last_err = e
+                time.sleep(0.5)
+        else:
+            raise AssertionError(f"server never came up: {last_err}")
+        # the volume server from [server] volumePort answered the growth
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{vport}/status", timeout=2
+        ) as resp:
+            assert resp.status == 200
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def _make_certs(d) -> tuple[str, str, str]:
+    """Self-signed CA + localhost server/client cert (SAN IP:127.0.0.1)."""
+    def run(*args):
+        subprocess.run(args, check=True, capture_output=True, cwd=d)
+
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", "ca.key", "-out", "ca.crt", "-days", "1",
+        "-subj", "/CN=test-ca")
+    for name in ("server", "client"):
+        run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", f"{name}.key", "-out", f"{name}.csr",
+            "-subj", f"/CN={name}")
+        run("openssl", "x509", "-req", "-in", f"{name}.csr",
+            "-CA", "ca.crt", "-CAkey", "ca.key", "-CAcreateserial",
+            "-out", f"{name}.crt", "-days", "1", "-extfile", _san_file(d))
+    return (
+        os.path.join(d, "ca.crt"),
+        os.path.join(d, "server.crt"),
+        os.path.join(d, "server.key"),
+    )
+
+
+def _san_file(d) -> str:
+    path = os.path.join(d, "san.cnf")
+    with open(path, "w") as f:
+        f.write("subjectAltName=IP:127.0.0.1,DNS:localhost\n")
+    return path
+
+
+def test_mtls_grpc_roundtrip(tmp_path):
+    from seaweedfs_tpu.pb.rpc import (
+        Service,
+        Stub,
+        TlsConfig,
+        close_all_channels,
+        configure_tls,
+        serve,
+    )
+
+    ca, cert, key = _make_certs(str(tmp_path))
+
+    async def body():
+        port = free_port_pair()
+        addr = f"127.0.0.1:{port}"
+        svc = Service("echo")
+
+        @svc.unary("Echo")
+        async def echo(req, context):
+            return {"echo": req.get("msg", "")}
+
+        configure_tls(TlsConfig.from_files(ca, cert, key))
+        try:
+            server = await serve(addr, svc)
+            resp = await Stub(addr, "echo").call("Echo", {"msg": "secure"})
+            assert resp == {"echo": "secure"}
+
+            # a plaintext client must NOT get through
+            await close_all_channels()
+            configure_tls(None)
+            with pytest.raises(Exception):
+                await Stub(addr, "echo").call("Echo", {"msg": "x"}, timeout=3)
+            await server.stop(0.2)
+        finally:
+            configure_tls(None)
+            await close_all_channels()
+
+    asyncio.run(body())
