@@ -4,8 +4,14 @@ Topics are split into partitions; producers hash a key onto a partition
 (ref broker/consistent_distribution.go) and consumers subscribe per
 (namespace, topic, partition) with an offset. gRPC service "messaging":
 Publish (unary), Subscribe (server stream), GetTopicConfiguration.
-Messages persist in memory per broker this round (the reference journals to
-filer log files — durable storage lands with the log-buffer subsystem).
+
+Durability mirrors the reference's filer-journaled log buffer
+(ref: broker/broker_grpc_server_publish.go + weed/util/log_buffer): when a
+filer address is configured, publishes accumulate per partition and a
+flusher appends them as msgpack segment files under
+/topics/<ns>/<topic>/<partition>/<first_offset>.log through the filer's
+HTTP path; on startup the broker replays those segments, so a restart
+keeps every flushed message and offset numbering.
 """
 
 from __future__ import annotations
@@ -16,10 +22,13 @@ import time
 from collections import defaultdict
 from typing import Optional
 
+import msgpack
+
 from ..pb import grpc_address
 from ..pb.rpc import Service, serve
 
 DEFAULT_PARTITIONS = 4
+TOPICS_ROOT = "/topics"
 
 
 def pick_partition(key: bytes, partition_count: int) -> int:
@@ -33,20 +42,37 @@ def pick_partition(key: bytes, partition_count: int) -> int:
 class _Partition:
     def __init__(self):
         self.messages: list[dict] = []
+        self.flushed = 0  # messages[:flushed] are journaled to the filer
         self.new_message = asyncio.Event()
 
 
 class MessageBroker:
-    def __init__(self, host: str = "127.0.0.1", port: int = 17777):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 17777,
+        filer: str = "",
+        flush_interval: float = 1.0,
+    ):
         self.host = host
         self.port = port
         self.address = f"{host}:{port}"
+        self.filer = filer
+        self.flush_interval = flush_interval
         self._topics: dict[tuple[str, str], list[_Partition]] = {}
         self._configs: dict[tuple[str, str], dict] = {}
         self._grpc_server = None
+        self._http = None
+        self._flush_task: Optional[asyncio.Task] = None
+
+    @staticmethod
+    def _ns(namespace: str) -> str:
+        """Canonical namespace: '' and 'default' are the same journal dir,
+        so they must be the same topic key too."""
+        return namespace or "default"
 
     def _partitions(self, namespace: str, topic: str) -> list[_Partition]:
-        key = (namespace, topic)
+        key = (self._ns(namespace), topic)
         if key not in self._topics:
             count = self._configs.get(key, {}).get(
                 "partition_count", DEFAULT_PARTITIONS
@@ -55,6 +81,12 @@ class MessageBroker:
         return self._topics[key]
 
     async def start(self) -> None:
+        if self.filer:
+            import aiohttp
+
+            self._http = aiohttp.ClientSession()
+            await self._load_journal()
+            self._flush_task = asyncio.ensure_future(self._flush_loop())
         svc = Service("messaging")
         svc.unary("ConfigureTopic")(self._grpc_configure)
         svc.unary("GetTopicConfiguration")(self._grpc_get_configuration)
@@ -63,19 +95,145 @@ class MessageBroker:
         self._grpc_server = await serve(grpc_address(self.address), svc)
 
     async def stop(self) -> None:
+        if self._flush_task is not None:
+            self._flush_task.cancel()
+            try:
+                await self._flush_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            await self._flush_all()
         if self._grpc_server is not None:
             await self._grpc_server.stop(0.5)
+        if self._http is not None:
+            await self._http.close()
+
+    # ---------------- filer journal ----------------
+    def _partition_dir(self, namespace: str, topic: str, partition: int) -> str:
+        return f"{TOPICS_ROOT}/{self._ns(namespace)}/{topic}/{partition}"
+
+    async def _filer_list(self, directory: str) -> list[dict]:
+        """Paginated listing — a long-lived partition accumulates far more
+        segment files than one listing page."""
+        entries: list[dict] = []
+        last = ""
+        while True:
+            url = f"http://{self.filer}{directory}?limit=1000"
+            if last:
+                url += f"&lastFileName={last}"
+            async with self._http.get(
+                url, headers={"Accept": "application/json"}
+            ) as resp:
+                if resp.status != 200:
+                    return entries
+                body = await resp.json()
+                page = body.get("Entries") or []
+            entries.extend(page)
+            if len(page) < 1000:
+                return entries
+            last = page[-1]["FullPath"].rsplit("/", 1)[-1]
+
+    async def _load_journal(self) -> None:
+        """Replay segment files into memory so offsets continue where the
+        previous broker stopped (ref the reference's filer topic dirs)."""
+        for ns_entry in await self._filer_list(TOPICS_ROOT):
+            ns_path = ns_entry["FullPath"]
+            namespace = ns_path.rsplit("/", 1)[-1]
+            for topic_entry in await self._filer_list(ns_path):
+                topic_path = topic_entry["FullPath"]
+                topic = topic_path.rsplit("/", 1)[-1]
+                key = (namespace, topic)  # dir names are already canonical
+                # topic config rides along as topic.conf
+                parts: dict[int, list[dict]] = defaultdict(list)
+                for part_entry in await self._filer_list(topic_path):
+                    name = part_entry["FullPath"].rsplit("/", 1)[-1]
+                    if name == "topic.conf":
+                        async with self._http.get(
+                            f"http://{self.filer}{part_entry['FullPath']}"
+                        ) as resp:
+                            if resp.status == 200:
+                                import json
+
+                                self._configs[key] = json.loads(await resp.read())
+                        continue
+                    if not name.isdigit():
+                        continue
+                    partition = int(name)
+                    segments = sorted(
+                        e["FullPath"]
+                        for e in await self._filer_list(part_entry["FullPath"])
+                        if e["FullPath"].endswith(".log")
+                    )
+                    for seg in segments:
+                        async with self._http.get(
+                            f"http://{self.filer}{seg}"
+                        ) as resp:
+                            if resp.status != 200:
+                                continue
+                            unpacker = msgpack.Unpacker(raw=False)
+                            unpacker.feed(await resp.read())
+                            for msg in unpacker:
+                                parts[partition].append(msg)
+                if parts:
+                    count = self._configs.get(key, {}).get(
+                        "partition_count", max(parts) + 1
+                    )
+                    plist = [_Partition() for _ in range(max(count, max(parts) + 1))]
+                    for idx, msgs in parts.items():
+                        plist[idx].messages = msgs
+                        plist[idx].flushed = len(msgs)
+                    self._topics[key] = plist
+
+    async def _flush_all(self) -> None:
+        for (namespace, topic), plist in list(self._topics.items()):
+            for idx, p in enumerate(plist):
+                await self._flush_partition(namespace, topic, idx, p)
+
+    async def _flush_partition(
+        self, namespace: str, topic: str, idx: int, p: _Partition
+    ) -> None:
+        if self._http is None or p.flushed >= len(p.messages):
+            return
+        pending = p.messages[p.flushed :]
+        body = b"".join(
+            msgpack.packb(m, use_bin_type=True) for m in pending
+        )
+        path = f"{self._partition_dir(namespace, topic, idx)}/{p.flushed:020d}.log"
+        try:
+            async with self._http.put(
+                f"http://{self.filer}{path}", data=body
+            ) as resp:
+                if resp.status < 300:
+                    p.flushed += len(pending)
+        except Exception:
+            pass  # retried on the next tick
+
+    async def _flush_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.flush_interval)
+            await self._flush_all()
 
     # ---------------- RPCs ----------------
     async def _grpc_configure(self, req, context) -> dict:
-        key = (req.get("namespace", ""), req["topic"])
+        key = (self._ns(req.get("namespace", "")), req["topic"])
         self._configs[key] = {
             "partition_count": int(req.get("partition_count", DEFAULT_PARTITIONS))
         }
+        if self._http is not None:
+            import json
+
+            path = f"{TOPICS_ROOT}/{key[0]}/{req['topic']}/topic.conf"
+            try:
+                async with self._http.put(
+                    f"http://{self.filer}{path}",
+                    data=json.dumps(self._configs[key]).encode(),
+                ):
+                    pass
+            except Exception:
+                pass
         return {}
 
     async def _grpc_get_configuration(self, req, context) -> dict:
-        key = (req.get("namespace", ""), req["topic"])
+        key = (self._ns(req.get("namespace", "")), req["topic"])
         return self._configs.get(key, {"partition_count": DEFAULT_PARTITIONS})
 
     async def _grpc_publish(self, req, context) -> dict:

@@ -1,0 +1,94 @@
+"""Durable messaging: the broker journals topic partitions through the
+filer and a restarted broker serves every flushed message
+(ref: weed/messaging/broker/broker_grpc_server_publish.go,
+weed/util/log_buffer)."""
+
+import asyncio
+import random
+
+from test_cluster import Cluster, free_port_pair
+
+from seaweedfs_tpu.messaging import MessageBroker
+from seaweedfs_tpu.pb import grpc_address
+from seaweedfs_tpu.pb.rpc import Stub
+
+
+def test_broker_restart_keeps_messages(tmp_path):
+    async def body():
+        random.seed(61)
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        from seaweedfs_tpu.server.filer import FilerServer
+
+        fs = FilerServer(master=cluster.master.address, port=free_port_pair())
+        await fs.start()
+        try:
+            await fs.master_client.wait_connected()
+            bport = free_port_pair()
+            broker = MessageBroker(port=bport, filer=fs.address)
+            await broker.start()
+            stub = Stub(grpc_address(broker.address), "messaging")
+
+            await stub.call(
+                "ConfigureTopic", {"topic": "events", "partition_count": 2}
+            )
+            published = []
+            for i in range(10):
+                r = await stub.call(
+                    "Publish",
+                    {
+                        "topic": "events",
+                        "partition": i % 2,
+                        "key": f"k{i}".encode(),
+                        "value": f"v{i}".encode(),
+                    },
+                )
+                published.append((i % 2, r["offset"], f"v{i}".encode()))
+            # stop() flushes pending segments to the filer
+            await broker.stop()
+
+            # journal files exist under /topics in the filer namespace
+            conf = fs.filer.find_entry("/topics/default/events/topic.conf")
+            assert conf is not None
+
+            # a brand-new broker on the same filer serves it all
+            broker2 = MessageBroker(port=free_port_pair(), filer=fs.address)
+            await broker2.start()
+            try:
+                stub2 = Stub(grpc_address(broker2.address), "messaging")
+                cfg = await stub2.call(
+                    "GetTopicConfiguration", {"topic": "events"}
+                )
+                assert cfg["partition_count"] == 2
+                for partition in (0, 1):
+                    got = []
+                    async for msg in stub2.server_stream(
+                        "Subscribe",
+                        {
+                            "topic": "events",
+                            "partition": partition,
+                            "start_offset": 0,
+                        },
+                        timeout=5,
+                    ):
+                        if msg.get("keepalive"):
+                            continue
+                        got.append(msg["value"])
+                        if len(got) == 5:
+                            break
+                    want = [v for p, _, v in published if p == partition]
+                    assert got == want
+
+                # offsets continue where the old broker stopped
+                r = await stub2.call(
+                    "Publish",
+                    {"topic": "events", "partition": 0, "value": b"after"},
+                )
+                assert r["offset"] == 5
+            finally:
+                await broker2.stop()
+        finally:
+            await fs.stop()
+            await cluster.stop()
+
+    asyncio.run(body())
