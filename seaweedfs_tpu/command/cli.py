@@ -24,6 +24,12 @@ def _add_master_flags(p: argparse.ArgumentParser) -> None:
         help="comma-separated list of all master addresses (incl. self) "
         "for a multi-master raft cluster (ref weed master -peers)",
     )
+    p.add_argument(
+        "-jwtSigningKey",
+        default="",
+        help="HS256 key: the master issues fid-scoped upload JWTs and the "
+        "volume servers verify them (ref security/jwt.go)",
+    )
 
 
 def _add_volume_flags(p: argparse.ArgumentParser) -> None:
@@ -180,6 +186,7 @@ def cmd_master(argv: list[str]) -> int:
         default_replication=args.defaultReplication,
         garbage_threshold=args.garbageThreshold,
         peers=[x for x in args.peers.split(",") if x] or None,
+        jwt_signing_key=args.jwtSigningKey,
     )
     print(f"master listening on {args.ip}:{args.port}")
     asyncio.run(_run_forever(ms))
@@ -209,7 +216,6 @@ def cmd_server(argv: list[str]) -> int:
     p.add_argument("-storageBackend", default="cpu", choices=["cpu", "tpu"])
     p.add_argument("-tierConfig", default="")
     p.add_argument("-index", default="memory", choices=["memory", "leveldb", "sorted"])
-    p.add_argument("-jwtSigningKey", default="")
     p.add_argument("-filer", action="store_true", help="also run a filer")
     p.add_argument("-filerPort", type=int, default=8888)
     p.add_argument("-s3", action="store_true", help="also run an S3 gateway (implies -filer)")
@@ -247,6 +253,7 @@ def cmd_server(argv: list[str]) -> int:
         volume_size_limit_mb=args.volumeSizeLimitMB,
         default_replication=args.defaultReplication,
         peers=peers,
+        jwt_signing_key=args.jwtSigningKey,
     )
     vs = VolumeServer(
         master=peers or f"{args.ip}:{args.port}",
@@ -366,10 +373,15 @@ def cmd_msg_broker(argv: list[str]) -> int:
     p = argparse.ArgumentParser(prog="weed-tpu msgBroker")
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-port", type=int, default=17777)
+    p.add_argument(
+        "-filer",
+        default="",
+        help="filer host:port journaling topic partitions (durable restart)",
+    )
     args = p.parse_args(argv)
     from ..messaging import MessageBroker
 
-    broker = MessageBroker(host=args.ip, port=args.port)
+    broker = MessageBroker(host=args.ip, port=args.port, filer=args.filer)
     print(f"message broker gRPC on {args.ip}:{args.port + 10000}")
     asyncio.run(_run_forever(broker))
     return 0

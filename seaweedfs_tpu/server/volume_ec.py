@@ -59,6 +59,7 @@ class EcHandlers:
         svc.server_stream("VolumeEcShardRead")(self._grpc_ec_shard_read)
         svc.unary("VolumeEcBlobDelete")(self._grpc_ec_blob_delete)
         svc.unary("VolumeEcShardsToVolume")(self._grpc_ec_shards_to_volume)
+        svc.unary("VolumeEcShardsInfo")(self._grpc_ec_info)
 
     def _base_name(self, collection: str, vid: int) -> Optional[str]:
         v = self.store.find_volume(vid)
@@ -74,21 +75,38 @@ class EcHandlers:
 
     # ---------------- RPCs ----------------
     async def _grpc_ec_generate(self, req, context) -> dict:
-        """.dat/.idx -> .ec00-13 + .ecx + .vif (ref :39-75)."""
+        """.dat/.idx -> .ecNN + .ecx + .vif (ref :39-75).
+
+        Optional data_shards/parity_shards select an alternate RS geometry
+        (6.3 / 12.4); the geometry is persisted in the .vif so readers and
+        rebuilds recover it (our extension — the reference fixes 10.4 at
+        compile time, ec_encoder.go:17-23)."""
         vid = int(req["volume_id"])
         collection = req.get("collection", "")
+        data_shards = int(req.get("data_shards", 0))
+        parity_shards = int(req.get("parity_shards", 0))
         base = self._base_name(collection, vid)
         if base is None:
             return {"error": f"volume {vid} not found"}
+        codec = (
+            self.codec_for(data_shards, parity_shards)
+            if data_shards
+            else self.codec
+        )
         loop = asyncio.get_event_loop()
         try:
             await loop.run_in_executor(
-                None, lambda: write_ec_files(base, codec=self.codec)
+                None, lambda: write_ec_files(base, codec=codec)
             )
             await loop.run_in_executor(None, write_sorted_file_from_idx, base)
             v = self.store.find_volume(vid)
             save_volume_info(
-                base + ".vif", VolumeInfo(version=v.version if v else 3)
+                base + ".vif",
+                VolumeInfo(
+                    version=v.version if v else 3,
+                    data_shards=data_shards,
+                    parity_shards=parity_shards,
+                ),
             )
             return {}
         except Exception as e:
@@ -101,14 +119,44 @@ class EcHandlers:
         base = self._base_name(collection, vid)
         if base is None:
             return {"error": f"volume {vid} not found"}
+        codec = self._codec_from_vif(base)
         loop = asyncio.get_event_loop()
         try:
             rebuilt = await loop.run_in_executor(
-                None, lambda: rebuild_ec_files(base, codec=self.codec)
+                None, lambda: rebuild_ec_files(base, codec=codec)
             )
             return {"rebuilt_shard_ids": rebuilt}
         except Exception as e:
             return {"error": str(e)}
+
+    async def _grpc_ec_info(self, req, context) -> dict:
+        """RS geometry of a local EC volume from its .vif (our extension;
+        heartbeats carry only shard bitmaps, so geometry-aware shell
+        commands ask a shard holder)."""
+        vid = int(req["volume_id"])
+        collection = req.get("collection", "")
+        base = self._base_name(collection, vid)
+        if base is None:
+            return {"error": f"volume {vid} not found"}
+        from ..storage.volume_info import load_volume_info
+
+        info = load_volume_info(base + ".vif")
+        k = info.data_shards if info and info.data_shards else DATA_SHARDS_COUNT
+        m = (
+            info.parity_shards
+            if info and info.data_shards
+            else TOTAL_SHARDS_COUNT - DATA_SHARDS_COUNT
+        )
+        return {"data_shards": k, "parity_shards": m}
+
+    def _codec_from_vif(self, base: str):
+        """Codec matching the geometry persisted in the .vif (10.4 default)."""
+        from ..storage.volume_info import load_volume_info
+
+        info = load_volume_info(base + ".vif")
+        if info is not None and info.data_shards:
+            return self.codec_for(info.data_shards, info.parity_shards)
+        return self.codec
 
     async def _grpc_ec_copy(self, req, context) -> dict:
         """Pull shards (+ index files) from a source server via its CopyFile
@@ -170,9 +218,7 @@ class EcHandlers:
             except FileNotFoundError:
                 pass
         remaining = [
-            i
-            for i in range(TOTAL_SHARDS_COUNT)
-            if os.path.exists(base + to_ext(i))
+            i for i in range(32) if os.path.exists(base + to_ext(i))
         ]
         if not remaining:
             for ext in (".ecx", ".ecj", ".vif"):
@@ -268,9 +314,10 @@ class EcHandlers:
         base = self._base_name(collection, vid)
         if base is None or not os.path.exists(base + ".ecx"):
             return {"error": f"ec volume {vid} not found"}
+        codec = self._codec_from_vif(base)
         missing = [
             i
-            for i in range(DATA_SHARDS_COUNT)
+            for i in range(codec.data_shards)
             if not os.path.exists(base + to_ext(i))
         ]
         if missing:
@@ -278,7 +325,9 @@ class EcHandlers:
         loop = asyncio.get_event_loop()
         try:
             dat_size = await loop.run_in_executor(None, find_dat_file_size, base)
-            await loop.run_in_executor(None, write_dat_file, base, dat_size)
+            await loop.run_in_executor(
+                None, write_dat_file, base, dat_size, codec.data_shards
+            )
             await loop.run_in_executor(None, write_idx_file_from_ec_index, base)
             return {}
         except Exception as e:
@@ -364,12 +413,31 @@ class EcHandlers:
             ev, shard_id, offset, size, file_key
         )
 
+    def codec_for(self, data_shards: int, parity_shards: int):
+        """Geometry-specific codec on the configured backend, cached per
+        (k, m) — the default self.codec stays the 10.4 instance."""
+        if (
+            data_shards == self.codec.data_shards
+            and parity_shards == self.codec.parity_shards
+        ):
+            return self.codec
+        cache = getattr(self, "_geometry_codecs", None)
+        if cache is None:
+            cache = self._geometry_codecs = {}
+        key = (data_shards, parity_shards)
+        if key not in cache:
+            from ..tpu.coder import get_codec
+
+            cache[key] = get_codec(self.codec_backend, data_shards, parity_shards)
+        return cache[key]
+
     async def _recover_one_interval(
         self, ev: EcVolume, missing_shard: int, offset: int, size: int, file_key: int
     ) -> Optional[bytes]:
         import numpy as np
 
-        bufs: list[Optional[np.ndarray]] = [None] * TOTAL_SHARDS_COUNT
+        total = ev.total_shards
+        bufs: list[Optional[np.ndarray]] = [None] * total
 
         async def fetch(shard_id: int) -> None:
             shard = ev.find_shard(shard_id)
@@ -382,20 +450,21 @@ class EcHandlers:
             if b is not None and len(b) == size:
                 bufs[shard_id] = np.frombuffer(b, dtype=np.uint8)
 
-        candidates = [i for i in range(TOTAL_SHARDS_COUNT) if i != missing_shard]
+        candidates = [i for i in range(total) if i != missing_shard]
         await asyncio.gather(*(fetch(i) for i in candidates))
-        present = [i for i in range(TOTAL_SHARDS_COUNT) if bufs[i] is not None]
-        if len(present) < DATA_SHARDS_COUNT:
+        present = [i for i in range(total) if bufs[i] is not None]
+        if len(present) < ev.data_shards:
             return None
-        keep = present[:DATA_SHARDS_COUNT]
+        keep = present[: ev.data_shards]
         trimmed: list[Optional[np.ndarray]] = [
-            bufs[i] if i in keep else None for i in range(TOTAL_SHARDS_COUNT)
+            bufs[i] if i in keep else None for i in range(total)
         ]
+        codec = self.codec_for(ev.data_shards, ev.parity_shards)
         loop = asyncio.get_event_loop()
         full = await loop.run_in_executor(
             None,
-            lambda: self.codec.reconstruct(
-                trimmed, data_only=missing_shard < DATA_SHARDS_COUNT
+            lambda: codec.reconstruct(
+                trimmed, data_only=missing_shard < ev.data_shards
             ),
         )
         out = full[missing_shard]

@@ -319,13 +319,47 @@ async def _collect_ec_nodes(env) -> list[EcNode]:
     return nodes_from_topology(await env.collect_data_nodes())
 
 
+async def _ec_geometry(env, vid: int, collection: str, holders) -> tuple[int, int]:
+    """(data_shards, parity_shards) of an EC volume, asked from a shard
+    holder's .vif (VolumeEcShardsInfo); falls back to the standard 10.4."""
+    for url in holders:
+        try:
+            r = await env.volume_stub(url).call(
+                "VolumeEcShardsInfo", {"volume_id": vid, "collection": collection}
+            )
+            if not r.get("error"):
+                return (
+                    int(r.get("data_shards") or DATA_SHARDS_COUNT),
+                    int(
+                        r.get("parity_shards")
+                        or TOTAL_SHARDS_COUNT - DATA_SHARDS_COUNT
+                    ),
+                )
+        except Exception:
+            continue
+    return DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT - DATA_SHARDS_COUNT
+
+
 @command("ec.encode")
 async def cmd_ec_encode(env, argv) -> str:
     """Erasure-code volumes and spread shards
-    (ref command_ec_encode.go:55-264)."""
+    (ref command_ec_encode.go:55-264).
+
+    -shards k.m selects an alternate RS geometry (e.g. 6.3, 12.4); the
+    default is the reference's 10.4 (ec_encoder.go:17-23).
+    """
     env.confirm_is_locked()
     flags = _parse_flags(argv)
     collection = flags.get("collection", "")
+    data_shards = parity_shards = 0
+    if "shards" in flags:
+        try:
+            k, _, m = flags["shards"].partition(".")
+            data_shards, parity_shards = int(k), int(m)
+        except ValueError:
+            data_shards = parity_shards = 0
+        if data_shards < 1 or parity_shards < 1:
+            return f"bad -shards {flags['shards']!r}; want e.g. 10.4 or 6.3"
     vids: list[int] = []
     if "volumeId" in flags:
         vids = [int(flags["volumeId"])]
@@ -345,11 +379,15 @@ async def cmd_ec_encode(env, argv) -> str:
                     vids.append(vid)
     results = []
     for vid in vids:
-        results.append(await _do_ec_encode(env, vid, collection))
+        results.append(
+            await _do_ec_encode(env, vid, collection, data_shards, parity_shards)
+        )
     return "\n".join(results) or "no volumes to encode"
 
 
-async def _do_ec_encode(env, vid: int, collection: str) -> str:
+async def _do_ec_encode(
+    env, vid: int, collection: str, data_shards: int = 0, parity_shards: int = 0
+) -> str:
     nodes = await env.collect_data_nodes()
     source = None
     for dn in nodes:
@@ -360,17 +398,18 @@ async def _do_ec_encode(env, vid: int, collection: str) -> str:
         return f"volume {vid}: not found"
     sstub = env.volume_stub(source)
     await sstub.call("VolumeMarkReadonly", {"volume_id": vid})
-    r = await sstub.call(
-        "VolumeEcShardsGenerate",
-        {"volume_id": vid, "collection": collection},
-        timeout=3600,
-    )
+    gen_req = {"volume_id": vid, "collection": collection}
+    if data_shards:
+        gen_req["data_shards"] = data_shards
+        gen_req["parity_shards"] = parity_shards
+    r = await sstub.call("VolumeEcShardsGenerate", gen_req, timeout=3600)
     if r.get("error"):
         return f"volume {vid}: generate failed: {r['error']}"
 
+    total = (data_shards + parity_shards) or TOTAL_SHARDS_COUNT
     ec_nodes = await _collect_ec_nodes(env)
     assignment = plan_balanced_spread(
-        ec_nodes, vid, list(range(TOTAL_SHARDS_COUNT)), source
+        ec_nodes, vid, list(range(total)), source
     )
     for target, shard_ids in assignment.items():
         tstub = env.volume_stub(target)
@@ -404,7 +443,7 @@ async def _do_ec_encode(env, vid: int, collection: str) -> str:
         {
             "volume_id": vid,
             "collection": collection,
-            "shard_ids": [i for i in range(TOTAL_SHARDS_COUNT) if i not in own],
+            "shard_ids": [i for i in range(total) if i not in own],
         },
     )
     spread = {t: s for t, s in assignment.items()}
@@ -422,6 +461,9 @@ async def cmd_ec_decode(env, argv) -> str:
     ec_nodes = [n for n in await _collect_ec_nodes(env) if vid in n.shards]
     if not ec_nodes:
         return f"ec volume {vid} not found"
+    k, m = await _ec_geometry(
+        env, vid, collection, [n.url for n in ec_nodes]
+    )
     target = max(ec_nodes, key=lambda n: n.shards[vid].count())
     have = set(target.shards[vid].shard_ids())
     tstub = env.volume_stub(target.url)
@@ -447,7 +489,7 @@ async def cmd_ec_decode(env, argv) -> str:
         if r.get("error"):
             return f"copy shards {missing_here} from {n.url}: {r['error']}"
         have.update(missing_here)
-    if len([s for s in have if s < DATA_SHARDS_COUNT]) < DATA_SHARDS_COUNT:
+    if len([s for s in have if s < k]) < k:
         # rebuild missing data shards locally from parity
         r = await tstub.call(
             "VolumeEcShardsRebuild",
@@ -473,7 +515,7 @@ async def cmd_ec_decode(env, argv) -> str:
         await nstub.call(
             "VolumeEcShardsDelete",
             {"volume_id": vid, "collection": collection,
-             "shard_ids": list(range(TOTAL_SHARDS_COUNT))},
+             "shard_ids": list(range(k + m))},
         )
     await tstub.call("VolumeMount", {"volume_id": vid})
     return f"ec volume {vid} decoded back to a normal volume on {target.url}"
@@ -493,12 +535,12 @@ async def cmd_ec_rebuild(env, argv) -> str:
             by_vid[vid] = by_vid[vid].plus(bits)
     results = []
     for vid, bits in sorted(by_vid.items()):
-        missing = [
-            i for i in range(TOTAL_SHARDS_COUNT) if not bits.has(i)
-        ]
+        holders = [n.url for n in ec_nodes if vid in n.shards]
+        k, m = await _ec_geometry(env, vid, collection, holders)
+        missing = [i for i in range(k + m) if not bits.has(i)]
         if not missing:
             continue
-        if bits.count() < DATA_SHARDS_COUNT:
+        if bits.count() < k:
             results.append(f"volume {vid}: unrepairable ({bits.count()} shards)")
             continue
         rebuilder = max(ec_nodes, key=lambda n: n.free_slots)

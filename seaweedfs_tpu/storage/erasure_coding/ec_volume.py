@@ -50,7 +50,9 @@ def ec_shard_base_file_name(collection: str, vid: int) -> str:
 
 
 class ShardBits:
-    """uint32 bitmask of present shard ids (ref: ec_volume_info.go:61-110)."""
+    """uint32 bitmask of present shard ids (ref: ec_volume_info.go:61-110);
+    iteration spans the full 32 bits so alternate geometries with more than
+    14 shards (e.g. 12.4) are representable."""
 
     def __init__(self, bits: int = 0):
         self.bits = bits & 0xFFFFFFFF
@@ -65,7 +67,7 @@ class ShardBits:
         return bool(self.bits & (1 << shard_id))
 
     def shard_ids(self) -> list[int]:
-        return [i for i in range(TOTAL_SHARDS_COUNT) if self.has(i)]
+        return [i for i in range(32) if self.has(i)]
 
     def count(self) -> int:
         return bin(self.bits).count("1")
@@ -76,9 +78,11 @@ class ShardBits:
     def plus(self, other: "ShardBits") -> "ShardBits":
         return ShardBits(self.bits | other.bits)
 
-    def minus_parity_shards(self) -> "ShardBits":
+    def minus_parity_shards(
+        self, data_shards: int = DATA_SHARDS_COUNT
+    ) -> "ShardBits":
         b = self
-        for i in range(DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT):
+        for i in range(data_shards, 32):
             b = b.remove(i)
         return b
 
@@ -167,6 +171,9 @@ class EcVolume:
         self._ecj = open(base + ".ecj", "a+b")
         self._ecj_lock = threading.Lock()
         self.version = VERSION3
+        # RS geometry: default 10.4, overridable per volume via .vif
+        self.data_shards = DATA_SHARDS_COUNT
+        self.parity_shards = TOTAL_SHARDS_COUNT - DATA_SHARDS_COUNT
         vif = base + ".vif"
         if os.path.exists(vif):
             from ..volume_info import load_volume_info
@@ -174,6 +181,9 @@ class EcVolume:
             info = load_volume_info(vif)
             if info is not None and info.version:
                 self.version = info.version
+            if info is not None and info.data_shards:
+                self.data_shards = info.data_shards
+                self.parity_shards = info.parity_shards
         self.shards: list[EcVolumeShard] = []
         # shard_id -> list of server addresses, refreshed from master
         self.shard_locations: dict[int, list[str]] = {}
@@ -281,15 +291,20 @@ class EcVolume:
         )
         return accel.lookup(needle_ids)
 
+    @property
+    def total_shards(self) -> int:
+        return self.data_shards + self.parity_shards
+
     def intervals_for(self, offset_units: int, size: int) -> list[Interval]:
         """Shard intervals for an already-located needle."""
         shard_size = self.shard_size()
         return locate_data(
             EC_LARGE_BLOCK_SIZE,
             EC_SMALL_BLOCK_SIZE,
-            DATA_SHARDS_COUNT * shard_size,
+            self.data_shards * shard_size,
             to_actual_offset(offset_units),
             get_actual_size(size, self.version),
+            data_shards=self.data_shards,
         )
 
     def locate_needle(self, needle_id: int) -> tuple[int, int, list[Interval]]:

@@ -277,21 +277,23 @@ def rebuild_ec_files(
     return missing
 
 
-def write_dat_file(base_file_name: str, dat_file_size: int) -> None:
-    """Interleave-copy .ec00-.ec09 -> .dat (ref WriteDatFile,
+def write_dat_file(
+    base_file_name: str, dat_file_size: int, data_shards: int = DATA_SHARDS_COUNT
+) -> None:
+    """Interleave-copy the data shards -> .dat (ref WriteDatFile,
     ec_decoder.go:157-195)."""
     inputs = [
-        open(base_file_name + to_ext(i), "rb") for i in range(DATA_SHARDS_COUNT)
+        open(base_file_name + to_ext(i), "rb") for i in range(data_shards)
     ]
     try:
         with open(base_file_name + ".dat", "wb") as dat:
             remaining = dat_file_size
-            while remaining >= DATA_SHARDS_COUNT * EC_LARGE_BLOCK_SIZE:
-                for i in range(DATA_SHARDS_COUNT):
+            while remaining >= data_shards * EC_LARGE_BLOCK_SIZE:
+                for i in range(data_shards):
                     _copy_n(inputs[i], dat, EC_LARGE_BLOCK_SIZE)
                     remaining -= EC_LARGE_BLOCK_SIZE
             while remaining > 0:
-                for i in range(DATA_SHARDS_COUNT):
+                for i in range(data_shards):
                     to_read = min(remaining, EC_SMALL_BLOCK_SIZE)
                     if to_read <= 0:
                         break

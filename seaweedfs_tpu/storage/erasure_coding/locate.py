@@ -22,13 +22,14 @@ class Interval:
     size: int
     is_large_block: bool
     large_block_rows_count: int
+    data_shards: int = DATA_SHARDS_COUNT  # row width (k of the RS geometry)
 
     def to_shard_id_and_offset(
         self, large_block_size: int, small_block_size: int
     ) -> tuple[int, int]:
         """Ref ec_locate.go:73-83."""
         ec_file_offset = self.inner_block_offset
-        row_index = self.block_index // DATA_SHARDS_COUNT
+        row_index = self.block_index // self.data_shards
         if self.is_large_block:
             ec_file_offset += row_index * large_block_size
         else:
@@ -36,7 +37,7 @@ class Interval:
                 self.large_block_rows_count * large_block_size
                 + row_index * small_block_size
             )
-        shard_id = self.block_index % DATA_SHARDS_COUNT
+        shard_id = self.block_index % self.data_shards
         return shard_id, ec_file_offset
 
 
@@ -45,9 +46,13 @@ def _locate_offset_within_blocks(block_length: int, offset: int) -> tuple[int, i
 
 
 def _locate_offset(
-    large_block_length: int, small_block_length: int, dat_size: int, offset: int
+    large_block_length: int,
+    small_block_length: int,
+    dat_size: int,
+    offset: int,
+    data_shards: int = DATA_SHARDS_COUNT,
 ) -> tuple[int, bool, int]:
-    large_row_size = large_block_length * DATA_SHARDS_COUNT
+    large_row_size = large_block_length * data_shards
     n_large_block_rows = dat_size // large_row_size
     if offset < n_large_block_rows * large_row_size:
         block_index, inner = _locate_offset_within_blocks(large_block_length, offset)
@@ -63,15 +68,17 @@ def locate_data(
     dat_size: int,
     offset: int,
     size: int,
+    data_shards: int = DATA_SHARDS_COUNT,
 ) -> list[Interval]:
-    """Ref LocateData (ec_locate.go:11-48)."""
+    """Ref LocateData (ec_locate.go:11-48); data_shards parametrizes the
+    row width for alternate RS geometries (6.3 / 12.4)."""
     block_index, is_large_block, inner_block_offset = _locate_offset(
-        large_block_length, small_block_length, dat_size, offset
+        large_block_length, small_block_length, dat_size, offset, data_shards
     )
     # adding DataShardsCount*smallBlockLength ensures the large-row count can
     # be derived from a shard size (ec_locate.go:14-15)
-    n_large_block_rows = (dat_size + DATA_SHARDS_COUNT * small_block_length) // (
-        large_block_length * DATA_SHARDS_COUNT
+    n_large_block_rows = (dat_size + data_shards * small_block_length) // (
+        large_block_length * data_shards
     )
 
     intervals: list[Interval] = []
@@ -89,6 +96,7 @@ def locate_data(
                     size=size,
                     is_large_block=is_large_block,
                     large_block_rows_count=n_large_block_rows,
+                    data_shards=data_shards,
                 )
             )
             return intervals
@@ -99,11 +107,12 @@ def locate_data(
                 size=block_remaining,
                 is_large_block=is_large_block,
                 large_block_rows_count=n_large_block_rows,
+                data_shards=data_shards,
             )
         )
         size -= block_remaining
         block_index += 1
-        if is_large_block and block_index == n_large_block_rows * DATA_SHARDS_COUNT:
+        if is_large_block and block_index == n_large_block_rows * data_shards:
             is_large_block = False
             block_index = 0
         inner_block_offset = 0

@@ -28,6 +28,10 @@ class VolumeInfo:
     files: list[RemoteFile] = field(default_factory=list)
     version: int = 0
     replication: str = ""
+    # RS geometry of the EC shards (0 = the default 10.4); our extension —
+    # the reference fixes the geometry at compile time (ec_encoder.go:17-23)
+    data_shards: int = 0
+    parity_shards: int = 0
 
 
 def load_volume_info(path: str) -> VolumeInfo | None:
@@ -41,6 +45,8 @@ def load_volume_info(path: str) -> VolumeInfo | None:
     info = VolumeInfo(
         version=int(d.get("version", 0)),
         replication=d.get("replication", ""),
+        data_shards=int(d.get("dataShards", 0)),
+        parity_shards=int(d.get("parityShards", 0)),
     )
     for fd in d.get("files", []) or []:
         info.files.append(
@@ -74,5 +80,8 @@ def save_volume_info(path: str, info: VolumeInfo) -> None:
         "version": info.version,
         "replication": info.replication,
     }
+    if info.data_shards:
+        d["dataShards"] = info.data_shards
+        d["parityShards"] = info.parity_shards
     with open(path, "w") as f:
         json.dump(d, f, indent=2)

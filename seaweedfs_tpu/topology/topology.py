@@ -50,9 +50,9 @@ class EcShardLocations:
 
     def __init__(self, collection: str = ""):
         self.collection = collection
-        self.locations: list[list[DataNode]] = [
-            [] for _ in range(TOTAL_SHARDS_COUNT)
-        ]
+        # 32 slots (the ShardBits width) so alternate geometries with more
+        # than 14 shards (e.g. 12.4) register cleanly
+        self.locations: list[list[DataNode]] = [[] for _ in range(32)]
 
     def add_shard(self, shard_id: int, dn: DataNode) -> bool:
         if dn in self.locations[shard_id]:
