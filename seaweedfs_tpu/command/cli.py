@@ -65,6 +65,17 @@ def _add_volume_flags(p: argparse.ArgumentParser) -> None:
         help="HS256 key gating uploads (ref security/jwt.go; usually set "
         "via [security] in -config)",
     )
+    p.add_argument(
+        "-cpuprofile", default="", help="cpu profile output file (pstats)"
+    )
+    p.add_argument(
+        "-memprofile", default="", help="memory profile output file"
+    )
+    p.add_argument(
+        "-pprof",
+        action="store_true",
+        help="enable /debug/pprof/{profile,heap} HTTP handlers",
+    )
 
 
 def _apply_config_defaults(
@@ -158,6 +169,7 @@ def _build_volume_server(args, port_offset: int = 0):
         rack=args.rack,
         codec_backend=args.storageBackend,
         jwt_signing_key=getattr(args, "jwtSigningKey", ""),
+        pprof=getattr(args, "pprof", False),
     )
 
 
@@ -200,7 +212,10 @@ def cmd_volume(argv: list[str]) -> int:
     args = p.parse_args(argv)
     vs = _build_volume_server(args)
     print(f"volume server listening on {args.ip}:{args.port}")
-    asyncio.run(_run_forever(vs))
+    from ..util.profiling import Profiler
+
+    with Profiler(args.cpuprofile, args.memprofile):
+        asyncio.run(_run_forever(vs))
     return 0
 
 
@@ -216,6 +231,13 @@ def cmd_server(argv: list[str]) -> int:
     p.add_argument("-storageBackend", default="cpu", choices=["cpu", "tpu"])
     p.add_argument("-tierConfig", default="")
     p.add_argument("-index", default="memory", choices=["memory", "leveldb", "sorted"])
+    p.add_argument("-cpuprofile", default="", help="cpu profile output file")
+    p.add_argument("-memprofile", default="", help="memory profile output file")
+    p.add_argument(
+        "-pprof",
+        action="store_true",
+        help="enable /debug/pprof handlers on the volume server",
+    )
     p.add_argument("-filer", action="store_true", help="also run a filer")
     p.add_argument("-filerPort", type=int, default=8888)
     p.add_argument("-s3", action="store_true", help="also run an S3 gateway (implies -filer)")
@@ -266,6 +288,7 @@ def cmd_server(argv: list[str]) -> int:
         codec_backend=args.storageBackend,
         needle_map_kind=args.index,
         jwt_signing_key=args.jwtSigningKey,
+        pprof=args.pprof,
     )
     servers = [ms, vs]
     desc = (
@@ -291,7 +314,10 @@ def cmd_server(argv: list[str]) -> int:
             servers.append(S3Server(fs, host=args.ip, port=args.s3Port, iam=iam))
             desc += f", s3 on {args.ip}:{args.s3Port}"
     print(desc)
-    asyncio.run(_run_forever(*servers))
+    from ..util.profiling import Profiler
+
+    with Profiler(args.cpuprofile, args.memprofile):
+        asyncio.run(_run_forever(*servers))
     return 0
 
 
@@ -473,19 +499,25 @@ def cmd_benchmark(argv: list[str]) -> int:
     p.add_argument("-collection", default="")
     p.add_argument("-write", action="store_true", default=True)
     p.add_argument("-skipRead", action="store_true")
+    p.add_argument(
+        "-cpuprofile", default="", help="cpu profile output file (pstats)"
+    )
+    p.add_argument("-memprofile", default="", help="memory profile output file")
     args = p.parse_args(argv)
     from .benchmark import run_benchmark
+    from ..util.profiling import Profiler
 
-    out = asyncio.run(
-        run_benchmark(
-            args.master,
-            num_files=args.n,
-            file_size=args.size,
-            concurrency=args.c,
-            collection=args.collection,
-            do_read=not args.skipRead,
+    with Profiler(args.cpuprofile, args.memprofile):
+        out = asyncio.run(
+            run_benchmark(
+                args.master,
+                num_files=args.n,
+                file_size=args.size,
+                concurrency=args.c,
+                collection=args.collection,
+                do_read=not args.skipRead,
+            )
         )
-    )
     print(out)
     return 0
 

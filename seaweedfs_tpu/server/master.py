@@ -45,9 +45,11 @@ class MasterServer:
         jwt_signing_key: str = "",
         jwt_expires_seconds: int = 10,
         peers: Optional[list[str]] = None,
+        admin_lease_seconds: float = 10.0,
     ):
         self.jwt_signing_key = jwt_signing_key
         self.jwt_expires_seconds = jwt_expires_seconds
+        self.admin_lease_seconds = admin_lease_seconds
         self.host = host
         self.port = port
         self.address = f"{host}:{port}"
@@ -615,7 +617,7 @@ class MasterServer:
         prev = int(req.get("previous_token", 0))
         if self._admin_token is not None:
             token, ts = self._admin_token
-            if now - ts < 10 and token != prev:
+            if now - ts < self.admin_lease_seconds and token != prev:
                 return {"error": "already locked"}
         token = int(now * 1e9) & 0x7FFFFFFFFFFFFFFF
         self._admin_token = (token, now)

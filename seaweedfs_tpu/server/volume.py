@@ -60,8 +60,10 @@ class VolumeServer(EcHandlers):
         codec_backend: str = "cpu",
         jwt_signing_key: str = "",
         needle_map_kind: str = "memory",
+        pprof: bool = False,
     ):
         self.jwt_signing_key = jwt_signing_key
+        self.pprof = pprof
         # seed master list with failover + leader-hint following
         # (ref volume_grpc_client_to_master.go:35-57)
         self.masters = [master] if isinstance(master, str) else list(master)
@@ -254,6 +256,15 @@ class VolumeServer(EcHandlers):
             from ..util.metrics import REGISTRY
 
             return web.Response(text=REGISTRY.render(), content_type="text/plain")
+        if self.pprof and path.startswith("/debug/pprof"):
+            # live profiling handlers (ref -pprof, util/grace/pprof.go)
+            from ..util.profiling import handle_pprof_heap, handle_pprof_profile
+
+            if path.endswith("/profile"):
+                return await handle_pprof_profile(request)
+            if path.endswith("/heap"):
+                return await handle_pprof_heap(request)
+            return web.json_response({"error": "unknown profile"}, status=404)
         t0 = _time.perf_counter()
         try:
             return await self._dispatch_inner(request)

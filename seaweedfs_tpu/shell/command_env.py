@@ -15,10 +15,13 @@ class NotLockedError(Exception):
 
 
 class CommandEnv:
-    def __init__(self, master: str, filer: str = ""):
+    def __init__(self, master: str, filer: str = "", renew_interval: float = 4.0):
         self.master = master
         self.master_stub = Stub(grpc_address(master), "master")
         self.filer = filer  # sticky default for fs.*/bucket.* commands
+        # lease renewal cadence (ref exclusive_locker.go:14-18 — renewed
+        # every 4s against a 10s lease)
+        self.renew_interval = renew_interval
         self._admin_token: Optional[int] = None
         self._renew_task: Optional[asyncio.Task] = None
 
@@ -37,7 +40,7 @@ class CommandEnv:
 
     async def _renew_loop(self) -> None:
         while self._admin_token is not None:
-            await asyncio.sleep(4)
+            await asyncio.sleep(self.renew_interval)
             try:
                 resp = await self.master_stub.call(
                     "LeaseAdminToken", {"previous_token": self._admin_token}

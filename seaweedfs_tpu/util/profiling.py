@@ -1,0 +1,117 @@
+"""CPU/memory profiling hooks (ref: weed/command/volume.go:55-81 -cpuprofile/
+-memprofile/-pprof, weed/command/benchmark.go:119-126, util/grace/pprof.go).
+
+Python equivalents of the Go pprof flags: cProfile stats files for the CPU
+profile, tracemalloc snapshots for the memory profile, and on-demand HTTP
+handlers (/debug/pprof/...) for a live server.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import pstats
+from typing import Optional
+
+
+class Profiler:
+    """Process-wide profile collection started by CLI flags and dumped on
+    shutdown (the Go flags' start-at-boot, write-at-exit semantics)."""
+
+    def __init__(self, cpu_path: str = "", mem_path: str = ""):
+        self.cpu_path = cpu_path
+        self.mem_path = mem_path
+        self._cpu: Optional[cProfile.Profile] = None
+
+    def start(self) -> "Profiler":
+        if self.cpu_path:
+            self._cpu = cProfile.Profile()
+            self._cpu.enable()
+        if self.mem_path:
+            import tracemalloc
+
+            tracemalloc.start(10)
+        return self
+
+    def __enter__(self) -> "Profiler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop_and_dump()
+
+    def stop_and_dump(self) -> None:
+        if self._cpu is not None:
+            self._cpu.disable()
+            self._cpu.dump_stats(self.cpu_path)  # load with pstats.Stats
+            self._cpu = None
+        if self.mem_path:
+            import tracemalloc
+
+            snapshot = tracemalloc.take_snapshot()
+            with open(self.mem_path, "w") as f:
+                for stat in snapshot.statistics("lineno")[:200]:
+                    f.write(f"{stat}\n")
+            tracemalloc.stop()
+
+
+def profile_sorted_text(profile: cProfile.Profile, limit: int = 50) -> str:
+    """Human-readable cumulative-time report for HTTP handlers."""
+    buf = io.StringIO()
+    stats = pstats.Stats(profile, stream=buf)
+    stats.sort_stats(pstats.SortKey.CUMULATIVE)
+    stats.print_stats(limit)
+    return buf.getvalue()
+
+
+_profile_lock = None  # created lazily on the serving event loop
+
+
+async def handle_pprof_profile(request):
+    """GET /debug/pprof/profile?seconds=N — profile the event loop's
+    process for N seconds and return the report (ref util/grace/pprof.go).
+
+    cProfile is process-global, so requests serialize on a lock and the
+    profiler always disables (even on client disconnect); a boot-level
+    -cpuprofile already holds the C profiler, which surfaces as a 409.
+    """
+    import asyncio
+
+    from aiohttp import web
+
+    global _profile_lock
+    if _profile_lock is None:
+        _profile_lock = asyncio.Lock()
+
+    try:
+        seconds = min(float(request.query.get("seconds", 5)), 120.0)
+    except ValueError:
+        return web.Response(status=400, text="bad seconds parameter\n")
+    async with _profile_lock:
+        prof = cProfile.Profile()
+        try:
+            prof.enable()
+        except ValueError as e:  # another profiler (e.g. -cpuprofile) active
+            return web.Response(status=409, text=f"{e}\n")
+        try:
+            await asyncio.sleep(seconds)
+        finally:
+            prof.disable()
+    return web.Response(text=profile_sorted_text(prof), content_type="text/plain")
+
+
+async def handle_pprof_heap(request):
+    """GET /debug/pprof/heap — tracemalloc top allocations (starts
+    tracemalloc on first use)."""
+    import tracemalloc
+
+    from aiohttp import web
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(10)
+        return web.Response(
+            text="tracemalloc started; call again for a snapshot\n",
+            content_type="text/plain",
+        )
+    snapshot = tracemalloc.take_snapshot()
+    lines = [str(s) for s in snapshot.statistics("lineno")[:100]]
+    return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")

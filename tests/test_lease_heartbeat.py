@@ -1,0 +1,115 @@
+"""Admin lease semantics under contention (ref: wdclient/exclusive_locks/
+exclusive_locker.go:14-18 — 4s renewal against a 10s lease) and
+heartbeat-break failure detection with client-visible vid deletion
+(ref: master_grpc_server.go:24-52)."""
+
+import asyncio
+import random
+
+import aiohttp
+import pytest
+
+from test_cluster import Cluster, free_port_pair
+
+from seaweedfs_tpu.client import MasterClient, assign
+from seaweedfs_tpu.client.operation import upload_data
+from seaweedfs_tpu.pb import grpc_address
+from seaweedfs_tpu.pb.rpc import Stub
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.shell import CommandEnv
+
+
+def test_admin_lock_contention_with_renewal(tmp_path):
+    async def body():
+        mport = free_port_pair()
+        # short lease so expiry is testable; A renews well inside it
+        ms = MasterServer(port=mport, admin_lease_seconds=1.0)
+        await ms.start()
+        try:
+            env_a = CommandEnv(ms.address, renew_interval=0.3)
+            env_b = CommandEnv(ms.address, renew_interval=0.3)
+            await env_a.acquire_lock()
+
+            with pytest.raises(RuntimeError, match="already locked"):
+                await env_b.acquire_lock()
+
+            # past the ORIGINAL lease duration, A's renewals still hold it
+            await asyncio.sleep(1.6)
+            with pytest.raises(RuntimeError, match="already locked"):
+                await env_b.acquire_lock()
+
+            await env_a.release_lock()
+            await env_b.acquire_lock()  # now free
+            await env_b.release_lock()
+        finally:
+            await ms.stop()
+
+    asyncio.run(body())
+
+
+def test_admin_lock_expires_without_renewal(tmp_path):
+    async def body():
+        mport = free_port_pair()
+        ms = MasterServer(port=mport, admin_lease_seconds=0.5)
+        await ms.start()
+        try:
+            stub = Stub(grpc_address(ms.address), "master")
+            r = await stub.call("LeaseAdminToken", {"previous_token": 0})
+            assert r.get("token")
+
+            # nobody renews; a second client takes over after expiry
+            r2 = await stub.call("LeaseAdminToken", {"previous_token": 0})
+            assert r2.get("error") == "already locked"
+            await asyncio.sleep(0.7)
+            r3 = await stub.call("LeaseAdminToken", {"previous_token": 0})
+            assert r3.get("token"), r3
+        finally:
+            await ms.stop()
+
+    asyncio.run(body())
+
+
+def test_heartbeat_break_deletes_vids_from_clients(tmp_path):
+    """Killing a volume server must unregister it on heartbeat-stream break
+    and push the vid deletions to KeepConnected clients."""
+
+    async def body():
+        random.seed(71)
+        cluster = Cluster(tmp_path, n_volume_servers=2)
+        await cluster.start()
+        client = MasterClient("test-client", [cluster.master.address])
+        await client.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                ar = await assign(cluster.master.address)
+                await upload_data(session, ar.url, ar.fid, b"doomed")
+            vid = int(ar.fid.split(",")[0])
+            await client.wait_connected()
+            for _ in range(100):
+                if client.vid_map.lookup(vid):
+                    break
+                await asyncio.sleep(0.1)
+            assert ar.url in client.vid_map.lookup(vid)
+
+            # kill the server holding the vid
+            victim = cluster.server_for(ar.url)
+            await victim.stop()
+            cluster.volume_servers.remove(victim)
+
+            # the master's failure detector unregisters it and the client
+            # sees the vid location disappear
+            for _ in range(200):
+                if ar.url not in client.vid_map.lookup(vid):
+                    break
+                await asyncio.sleep(0.1)
+            assert ar.url not in client.vid_map.lookup(vid)
+
+            # the master's topology agrees
+            assert all(
+                n.url != ar.url for n in cluster.master.topo.data_nodes()
+            )
+        finally:
+            await client.stop()
+            await cluster.stop()
+
+    asyncio.run(body())
