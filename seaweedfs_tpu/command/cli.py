@@ -326,7 +326,12 @@ def cmd_filer(argv: list[str]) -> int:
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-port", type=int, default=8888)
     p.add_argument("-master", default="127.0.0.1:9333")
-    p.add_argument("-store", default="", help="sqlite path ('' = memory)")
+    p.add_argument(
+        "-store",
+        default="",
+        help="metadata store: '' = memory, *.flog = append-only log store, "
+        "else sqlite file",
+    )
     p.add_argument("-maxMB", type=int, default=4, help="chunk size in MB")
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")

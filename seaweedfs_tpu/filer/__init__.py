@@ -11,7 +11,12 @@ from .filechunks import (
     total_size,
 )
 from .filer import Filer
-from .filer_store import FilerStore, MemoryFilerStore, SqliteFilerStore
+from .filer_store import (
+    FilerStore,
+    LogFilerStore,
+    MemoryFilerStore,
+    SqliteFilerStore,
+)
 
 __all__ = [
     "Attr",
@@ -23,6 +28,7 @@ __all__ = [
     "total_size",
     "Filer",
     "FilerStore",
+    "LogFilerStore",
     "MemoryFilerStore",
     "SqliteFilerStore",
 ]

@@ -48,7 +48,12 @@ class Filer:
             directory, event_type, old_entry=old_dict, new_entry=entry_dict
         )
         if self.notifier is not None:
-            self.notifier.notify(event_type, path, entry_dict or old_dict)
+            sink_dict = entry_dict or old_dict
+            if event_type == "rename" and old_entry is not None and sink_dict:
+                # replication sinks need the source path to drop the old key
+                sink_dict = dict(sink_dict)
+                sink_dict["_old_path"] = old_entry.full_path
+            self.notifier.notify(event_type, path, sink_dict)
 
     # --- mkdir -p for parents (ref filer.go CreateEntry ensuring dirs) ---
     def _ensure_parents(self, full_path: str) -> None:

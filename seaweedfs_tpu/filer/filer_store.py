@@ -1,9 +1,11 @@
 """Pluggable filer stores (ref: weed/filer2/filerstore.go:12-31).
 
 Interface: insert/update/find/delete/delete_children/list by (directory,
-name). Two implementations: in-memory dict (ref memdb store) and sqlite
+name). Three implementations: in-memory dict (ref memdb store), sqlite
 (standing in for the reference's leveldb/mysql/postgres family — same
-abstract-sql shape, ref weed/filer2/abstract_sql/)."""
+abstract-sql shape, ref weed/filer2/abstract_sql/), and an append-only
+log store (WAL + memory index, standing in for the leveldb2 family —
+durable writes without a database dependency)."""
 
 from __future__ import annotations
 
@@ -146,3 +148,81 @@ class SqliteFilerStore:
                 (dir_path.rstrip("/") or "/", start_file_name, limit),
             ).fetchall()
         return [Entry.from_dict(json.loads(r[0])) for r in rows]
+
+
+class LogFilerStore(MemoryFilerStore):
+    """Append-only log store: every mutation appends a msgpack record to a
+    WAL; reads serve from the in-memory index. Open replays the log, then
+    compacts it to just the live entries (the leveldb2-class durability
+    role, ref weed/filer2/leveldb2, without a database dependency)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        import msgpack
+
+        self._path = path
+        self._packer = msgpack.Packer(use_bin_type=True)
+        # replay
+        import os
+
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                unpacker = msgpack.Unpacker(f, raw=False)
+                for rec in unpacker:
+                    op = rec.get("op")
+                    if op == "put":
+                        super().insert_entry(Entry.from_dict(rec["entry"]))
+                    elif op == "del":
+                        super().delete_entry(rec["path"])
+                    elif op == "delchildren":
+                        super().delete_folder_children(rec["path"])
+        self._compact()
+        self._f = open(path, "ab")
+
+    def _compact(self) -> None:
+        """Rewrite the log with only live entries (atomic replace)."""
+        import os
+
+        tmp = self._path + ".tmp"
+        with open(tmp, "wb") as f:
+            with self._lock:
+                for d in sorted(self._dirs):
+                    for name in sorted(self._dirs[d]):
+                        f.write(
+                            self._packer.pack(
+                                {
+                                    "op": "put",
+                                    "entry": self._dirs[d][name].to_dict(),
+                                }
+                            )
+                        )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path)
+
+    def _append(self, rec: dict) -> None:
+        import os
+
+        self._f.write(self._packer.pack(rec))
+        self._f.flush()
+        os.fsync(self._f.fileno())  # acknowledged mutations survive a crash
+
+    def insert_entry(self, entry: Entry) -> None:
+        with self._lock:
+            super().insert_entry(entry)
+            self._append({"op": "put", "entry": entry.to_dict()})
+
+    update_entry = insert_entry
+
+    def delete_entry(self, full_path: str) -> None:
+        with self._lock:
+            super().delete_entry(full_path)
+            self._append({"op": "del", "path": full_path})
+
+    def delete_folder_children(self, full_path: str) -> None:
+        with self._lock:
+            super().delete_folder_children(full_path)
+            self._append({"op": "delchildren", "path": full_path})
+
+    def close(self) -> None:
+        self._f.close()

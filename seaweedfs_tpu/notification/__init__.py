@@ -40,6 +40,51 @@ class MemorySink(NotificationSink):
             self.events.append((event_type, path, entry))
 
 
+class BrokerSink(NotificationSink):
+    """Publishes filer events to the in-cluster message broker (the
+    reference fans out to external queues like kafka,
+    ref notification/configuration.go; this rides our own msgBroker so it
+    works without egress). Events land on topic `filer` keyed by path."""
+
+    def __init__(self, broker: str, topic: str = "filer", namespace: str = ""):
+        self.broker = broker
+        self.topic = topic
+        self.namespace = namespace
+        # strong refs: the loop keeps only weak task references, so a
+        # pending publish could otherwise be garbage-collected unrun
+        self._tasks: set = set()
+
+    def send(self, event_type, path, entry) -> None:
+        import asyncio
+        import json
+
+        from ..pb import grpc_address
+        from ..pb.rpc import Stub
+
+        async def publish() -> None:
+            stub = Stub(grpc_address(self.broker), "messaging")
+            await stub.call(
+                "Publish",
+                {
+                    "namespace": self.namespace,
+                    "topic": self.topic,
+                    "key": path.encode(),
+                    "value": json.dumps(
+                        {"event": event_type, "path": path, "entry": entry}
+                    ).encode(),
+                },
+            )
+
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            asyncio.run(publish())  # sync caller (tests/tools)
+            return
+        task = loop.create_task(publish())
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+
 class UnavailableSink(NotificationSink):
     def __init__(self, name: str):
         self.name = name

@@ -7,5 +7,12 @@ JSON documents.
 """
 
 from .json_query import query_json, parse_where
+from .select import SelectQuery, rows_from_csv, select_rows
 
-__all__ = ["query_json", "parse_where"]
+__all__ = [
+    "query_json",
+    "parse_where",
+    "SelectQuery",
+    "rows_from_csv",
+    "select_rows",
+]

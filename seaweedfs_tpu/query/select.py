@@ -1,0 +1,135 @@
+"""S3-Select support: CSV input + a small SQL SELECT parser.
+
+Covers the S3 SelectObjectContent subset the gateways need:
+``SELECT <projection> FROM s3object [s] WHERE <conjunctions> [LIMIT n]``
+over JSON (documents or JSON-lines) and CSV objects. The reference declares
+CSV input in its Query RPC but never implemented it
+(ref: weed/server/volume_grpc_query.go:38-40 — the CsvInput branch is
+empty); here CSV rows become dicts via the header (or _1.._n column names)
+and flow through the same predicate/projection engine as JSON
+(ref: weed/query/json/query_json.go for the JSON semantics).
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import re
+from typing import Any, Iterator, Optional
+
+from .json_query import _get_path, parse_where, query_json
+
+_SELECT_RE = re.compile(
+    r"^\s*SELECT\s+(?P<fields>.+?)\s+FROM\s+(?P<source>\S+)(?:\s+(?P<alias>(?!WHERE\b|LIMIT\b)\w+))?"
+    r"(?:\s+WHERE\s+(?P<where>.+?))?(?:\s+LIMIT\s+(?P<limit>\d+))?\s*$",
+    re.IGNORECASE | re.DOTALL,
+)
+
+
+class SelectQuery:
+    """Parsed `SELECT ... FROM s3object ...` expression."""
+
+    def __init__(self, fields: Optional[list[str]], where: str, limit: int):
+        self.fields = fields  # None = SELECT *
+        self.where = where
+        self.limit = limit
+
+    @classmethod
+    def parse(cls, expression: str) -> "SelectQuery":
+        m = _SELECT_RE.match(expression)
+        if not m:
+            raise ValueError(f"cannot parse select expression: {expression!r}")
+        raw_fields = m.group("fields").strip()
+        alias = m.group("alias") or ""
+        prefixes = tuple(
+            p for p in (f"{m.group('source')}.", f"{alias}." if alias else "")
+            if p
+        )
+
+        def strip_alias(name: str) -> str:
+            name = name.strip().strip('"')
+            for p in prefixes:
+                if name.startswith(p):
+                    return name[len(p):]
+            return name
+
+        fields: Optional[list[str]]
+        if raw_fields == "*":
+            fields = None
+        else:
+            fields = [strip_alias(f) for f in raw_fields.split(",")]
+        where = m.group("where") or ""
+        if where:
+            # strip table aliases inside predicates too
+            for p in prefixes:
+                where = re.sub(
+                    rf"(^|[\s(]){re.escape(p)}", r"\1", where
+                )
+        parse_where(where)  # validate early
+        return cls(fields, where, int(m.group("limit") or 0))
+
+
+def rows_from_csv(
+    data: bytes,
+    delimiter: str = ",",
+    file_header_info: str = "USE",
+) -> Iterator[dict]:
+    """CSV bytes -> row dicts. file_header_info: USE (first row is the
+    header), IGNORE (skip it, columns _1.._n), NONE (no header row)."""
+    text = data.decode("utf-8", errors="replace")
+    reader = csv.reader(io.StringIO(text), delimiter=delimiter)
+    header: Optional[list[str]] = None
+    for i, row in enumerate(reader):
+        if not row:
+            continue
+        if i == 0 and file_header_info.upper() in ("USE", "IGNORE"):
+            if file_header_info.upper() == "USE":
+                header = row
+            continue
+        if header is not None:
+            yield {h: _typed(v) for h, v in zip(header, row)}
+        else:
+            yield {f"_{j + 1}": _typed(v) for j, v in enumerate(row)}
+
+
+def _typed(v: str) -> Any:
+    try:
+        return int(v)
+    except ValueError:
+        try:
+            return float(v)
+        except ValueError:
+            return v
+
+
+def select_rows(
+    data: bytes,
+    expression: str,
+    input_format: str = "json",
+    csv_delimiter: str = ",",
+    csv_header: str = "USE",
+) -> Iterator[dict]:
+    """Run a SELECT expression over a JSON or CSV object; yields projected
+    row dicts."""
+    q = SelectQuery.parse(expression)
+    count = 0
+    if input_format.lower() == "csv":
+        conds = parse_where(q.where)
+        from .json_query import _matches
+
+        for row in rows_from_csv(data, csv_delimiter, csv_header):
+            if not _matches(row, conds):
+                continue
+            if q.fields is None:
+                yield row
+            else:
+                yield {f: _get_path(row, f) for f in q.fields}
+            count += 1
+            if q.limit and count >= q.limit:
+                return
+    else:
+        for row in query_json(data, q.fields, q.where):
+            yield row
+            count += 1
+            if q.limit and count >= q.limit:
+                return

@@ -1,9 +1,10 @@
 """Cross-cluster async replication (ref: weed/replication/replicator.go:33).
 
-A Replicator consumes filer events and applies them to a sink. The reference
-ships filer/s3/gcs/azure/b2 sinks; here the filer-HTTP sink is implemented
-(replicate into another cluster's filer) and cloud sinks are stubs pending
-egress.
+A Replicator consumes filer events and applies them to a sink. Implemented
+sinks: filer-HTTP (replicate into another cluster's filer) and S3 (V4-signed
+puts/deletes against any S3-compatible endpoint — including another
+cluster's own gateway, ref: weed/replication/sink/s3sink/). gcs/azure/b2
+remain stubs pending egress.
 """
 
 from __future__ import annotations
@@ -40,22 +41,103 @@ class FilerHttpSink(ReplicationSink):
             self._session = aiohttp.ClientSession()
         return self._session
 
+    async def _copy(self, session, path: str, entry) -> None:
+        if entry and entry.get("is_directory"):
+            return
+        async with session.get(f"http://{self.source}{path}") as resp:
+            if resp.status != 200:
+                return
+            data = await resp.read()
+        async with session.put(f"http://{self.target}{path}", data=data) as resp:
+            await resp.read()
+
     async def apply(self, event_type, path, entry) -> None:
         session = await self._ensure_session()
         if event_type in (EVENT_CREATE, EVENT_UPDATE):
-            if entry and entry.get("is_directory"):
-                return
-            async with session.get(f"http://{self.source}{path}") as resp:
-                if resp.status != 200:
-                    return
-                data = await resp.read()
-            async with session.put(f"http://{self.target}{path}", data=data) as resp:
-                await resp.read()
+            await self._copy(session, path, entry)
+        elif event_type == EVENT_RENAME:
+            old_path = (entry or {}).get("_old_path")
+            if old_path:
+                async with session.delete(
+                    f"http://{self.target}{old_path}?recursive=true"
+                ) as resp:
+                    await resp.read()
+            await self._copy(session, path, entry)
         elif event_type == EVENT_DELETE:
             async with session.delete(
                 f"http://{self.target}{path}?recursive=true"
             ) as resp:
                 await resp.read()
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+
+
+class S3Sink(ReplicationSink):
+    """Replicates filer events into an S3-compatible endpoint with V4-signed
+    requests (ref: weed/replication/sink/s3sink/s3_sink.go). Object key =
+    <path without leading slash> inside the configured bucket; file content
+    is re-fetched from the source filer."""
+
+    def __init__(
+        self,
+        source_filer: str,
+        endpoint: str,
+        bucket: str,
+        access_key: str,
+        secret_key: str,
+        region: str = "us-east-1",
+        session=None,
+    ):
+        self.source = source_filer
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self._session = session
+
+    async def _ensure_session(self):
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    def _url(self, path: str) -> str:
+        return f"http://{self.endpoint}/{self.bucket}{path}"
+
+    async def _signed(self, method: str, url: str, payload: bytes):
+        from ..s3.auth import sign_request
+
+        session = await self._ensure_session()
+        headers = sign_request(
+            method, url, {}, payload, self.access_key, self.secret_key, self.region
+        )
+        return await session.request(method, url, data=payload, headers=headers)
+
+    async def _put_from_source(self, path: str, entry) -> None:
+        if entry and entry.get("is_directory"):
+            return
+        session = await self._ensure_session()
+        async with session.get(f"http://{self.source}{path}") as resp:
+            if resp.status != 200:
+                return
+            data = await resp.read()
+        resp = await self._signed("PUT", self._url(path), data)
+        resp.release()
+
+    async def apply(self, event_type, path, entry) -> None:
+        if event_type in (EVENT_CREATE, EVENT_UPDATE):
+            await self._put_from_source(path, entry)
+        elif event_type == EVENT_RENAME:
+            old_path = (entry or {}).get("_old_path")
+            if old_path:
+                resp = await self._signed("DELETE", self._url(old_path), b"")
+                resp.release()
+            await self._put_from_source(path, entry)
+        elif event_type == EVENT_DELETE:
+            resp = await self._signed("DELETE", self._url(path), b"")
+            resp.release()
 
     async def close(self) -> None:
         if self._session is not None:

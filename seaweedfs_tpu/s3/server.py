@@ -100,6 +100,8 @@ class S3Server:
         if method in ("GET", "HEAD"):
             # multipart listing rides Write (:62,:64)
             return ACTION_WRITE if "uploadId" in query else ACTION_READ
+        if method == "POST" and "select" in query:
+            return ACTION_READ  # SelectObjectContent reads
         return ACTION_WRITE
 
     async def _authenticate(self, request: web.Request, bucket: str, key: str):
@@ -150,6 +152,8 @@ class S3Server:
             if request.method in ("GET", "HEAD"):
                 return await self._list_objects(request, bucket)
             return _error("MethodNotAllowed", "method not allowed", 405)
+        if "select" in request.query and request.method == "POST":
+            return await self._select_object_content(request, bucket, key)
         if "uploads" in request.query and request.method == "POST":
             return await self._initiate_multipart(bucket, key)
         if "uploadId" in request.query:
@@ -320,6 +324,56 @@ class S3Server:
         return read_from_visible_intervals(
             visibles, blobs.__getitem__, offset, length
         )
+
+    async def _select_object_content(
+        self, request: web.Request, bucket: str, key: str
+    ) -> web.Response:
+        """SelectObjectContent (POST /bucket/key?select&select-type=2):
+        runs the SQL subset of query/select.py over a JSON or CSV object.
+        Results stream back as newline-delimited JSON — a documented
+        deviation from AWS's binary event-stream framing
+        (ref: weed/s3api has no select; this rides our query engine)."""
+        import json as _json
+
+        from ..filer import non_overlapping_visible_intervals
+        from ..query import select_rows
+
+        entry = self.filer.find_entry(self._object_path(bucket, key))
+        if entry is None or entry.is_directory:
+            return _error("NoSuchKey", f"key {key} not found", 404)
+        try:
+            req_xml = ET.fromstring(await request.read())
+        except ET.ParseError as e:
+            return _error("MalformedXML", str(e), 400)
+        expression = (req_xml.findtext("Expression") or "").strip()
+        if not expression:
+            return _error("MissingRequiredParameter", "Expression", 400)
+        input_format = "json"
+        csv_delimiter = ","
+        csv_header = "USE"
+        input_el = req_xml.find("InputSerialization")
+        if input_el is not None and input_el.find("CSV") is not None:
+            input_format = "csv"
+            csv_el = input_el.find("CSV")
+            csv_delimiter = csv_el.findtext("FieldDelimiter") or ","
+            csv_header = csv_el.findtext("FileHeaderInfo") or "USE"
+
+        visibles = non_overlapping_visible_intervals(entry.chunks)
+        data = await self._read_span(visibles, 0, entry.size())
+        try:
+            rows = list(
+                select_rows(
+                    data,
+                    expression,
+                    input_format=input_format,
+                    csv_delimiter=csv_delimiter,
+                    csv_header=csv_header,
+                )
+            )
+        except ValueError as e:
+            return _error("InvalidExpression", str(e), 400)
+        body = b"".join(_json.dumps(r).encode() + b"\n" for r in rows)
+        return web.Response(body=body, content_type="application/x-ndjson")
 
     async def _delete_object(self, bucket: str, key: str) -> web.Response:
         self.filer.delete_entry(self._object_path(bucket, key))

@@ -53,7 +53,14 @@ class FilerServer:
         self.chunk_size = chunk_size
         self.collection = collection
         self.replication = replication
-        store = SqliteFilerStore(store_path) if store_path else MemoryFilerStore()
+        if not store_path:
+            store = MemoryFilerStore()
+        elif store_path.endswith(".flog"):
+            from ..filer.filer_store import LogFilerStore
+
+            store = LogFilerStore(store_path)
+        else:
+            store = SqliteFilerStore(store_path)
         self.filer = Filer(store, on_delete_chunks=self._queue_chunk_deletion)
         self.master_client = MasterClient(f"filer@{self.address}", [master])
         self._deletion_queue: asyncio.Queue = asyncio.Queue()

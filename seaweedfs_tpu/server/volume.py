@@ -953,12 +953,20 @@ class VolumeServer(EcHandlers):
         }
 
     async def _grpc_query(self, req, context):
-        """S3-Select-style query over stored JSON objects
-        (ref volume_grpc_query.go, volume_server.proto:86)."""
-        from ..query import query_json
+        """S3-Select-style query over stored JSON/CSV objects
+        (ref volume_grpc_query.go, volume_server.proto:86; the reference
+        declares but never implements the CSV input — here it works).
+
+        Either {selected_columns, where} (JSON only, legacy) or
+        {expression: "SELECT ...", input_serialization: {format, csv_delimiter,
+        csv_header}}.
+        """
+        from ..query import query_json, select_rows
 
         fields = req.get("selected_columns")
         where = req.get("where", "")
+        expression = req.get("expression", "")
+        input_cfg = req.get("input_serialization") or {}
         for fid_str in req.get("from_file_ids", []):
             try:
                 fid = FileId.parse(fid_str)
@@ -966,7 +974,17 @@ class VolumeServer(EcHandlers):
                 self.store.read_volume_needle(fid.volume_id, n)
                 if n.cookie != fid.cookie:
                     continue
-                for row in query_json(bytes(n.data), fields, where):
+                if expression:
+                    rows = select_rows(
+                        bytes(n.data),
+                        expression,
+                        input_format=input_cfg.get("format", "json"),
+                        csv_delimiter=input_cfg.get("csv_delimiter", ","),
+                        csv_header=input_cfg.get("csv_header", "USE"),
+                    )
+                else:
+                    rows = query_json(bytes(n.data), fields, where)
+                for row in rows:
                     yield {"file_id": fid_str, "record": row}
             except Exception as e:
                 yield {"file_id": fid_str, "error": str(e)}

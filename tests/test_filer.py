@@ -5,6 +5,7 @@ from seaweedfs_tpu.filer import (
     FileChunk,
     Filer,
     MemoryFilerStore,
+    LogFilerStore,
     SqliteFilerStore,
     non_overlapping_visible_intervals,
     read_from_visible_intervals,
@@ -82,7 +83,17 @@ def test_view_from_visibles_offsets_into_chunks():
 
 
 # ---------- filer + stores ----------
-@pytest.mark.parametrize("store_cls", [MemoryFilerStore, SqliteFilerStore])
+def _fresh_log_store():
+    import os
+    import tempfile
+
+    return LogFilerStore(os.path.join(tempfile.mkdtemp(), "meta.flog"))
+
+
+
+@pytest.mark.parametrize(
+    "store_cls", [MemoryFilerStore, SqliteFilerStore, _fresh_log_store]
+)
 def test_filer_crud_and_tree(store_cls):
     f = Filer(store_cls())
     f.touch("/docs/readme.txt", "text/plain", [chunk("1,ab", 0, 10, 1)])
@@ -125,7 +136,9 @@ def test_filer_file_blocks_subdirectory():
         f.touch("/x/y", "", [])
 
 
-@pytest.mark.parametrize("store_cls", [MemoryFilerStore, SqliteFilerStore])
+@pytest.mark.parametrize(
+    "store_cls", [MemoryFilerStore, SqliteFilerStore, _fresh_log_store]
+)
 def test_store_pagination(store_cls):
     f = Filer(store_cls())
     for i in range(25):
@@ -137,3 +150,34 @@ def test_store_pagination(store_cls):
     assert page1[-1].name < page2[0].name
     page3 = f.list_entries("/dir", start_file_name=page2[-1].name, inclusive=False, limit=10)
     assert len(page3) == 5
+
+
+def test_log_store_survives_reopen(tmp_path):
+    """The WAL store replays its log and compacts on open
+    (the leveldb2-class durability role)."""
+    import os
+
+    path = str(tmp_path / "meta.flog")
+    store = LogFilerStore(path)
+    f = Filer(store)
+    f.touch("/keep/a.txt", "", [chunk("1,ab", 0, 10, 1)])
+    f.touch("/keep/b.txt", "", [chunk("2,cd", 0, 20, 1)])
+    f.delete_entry("/keep/b.txt")
+    store.close()
+
+    store2 = LogFilerStore(path)
+    f2 = Filer(store2)
+    assert f2.find_entry("/keep/a.txt") is not None
+    assert f2.find_entry("/keep/b.txt") is None
+    assert [e.name for e in f2.list_entries("/keep")] == ["a.txt"]
+
+    # compaction rewrote the log to live entries only: reopening after many
+    # overwrites keeps it bounded
+    for i in range(50):
+        f2.touch("/keep/a.txt", "", [chunk(f"3,{i:02x}", 0, 5, i + 10)])
+    size_before = os.path.getsize(path)
+    store2.close()
+    store3 = LogFilerStore(path)
+    assert os.path.getsize(path) < size_before
+    assert Filer(store3).find_entry("/keep/a.txt") is not None
+    store3.close()

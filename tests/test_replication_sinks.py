@@ -1,0 +1,152 @@
+"""Replication S3 sink + broker notification sink, end-to-end in-process
+(ref: weed/replication/sink/s3sink/, weed/notification/configuration.go)."""
+
+import asyncio
+import json
+import random
+
+import aiohttp
+
+from test_cluster import Cluster, free_port_pair
+
+from seaweedfs_tpu.messaging import MessageBroker
+from seaweedfs_tpu.notification import BrokerSink, Notifier
+from seaweedfs_tpu.pb import grpc_address
+from seaweedfs_tpu.pb.rpc import Stub
+from seaweedfs_tpu.replication import QueueingSink, Replicator, S3Sink
+from seaweedfs_tpu.s3.auth import IdentityAccessManagement, sign_request
+from seaweedfs_tpu.s3.server import S3Server
+from seaweedfs_tpu.server.filer import FilerServer
+
+
+def test_s3_replication_sink_and_broker_notifications(tmp_path):
+    async def body():
+        random.seed(79)
+        cluster = Cluster(tmp_path, n_volume_servers=2)
+        await cluster.start()
+
+        broker = MessageBroker(port=free_port_pair())
+        await broker.start()
+
+        # source filer publishes events to the replication queue AND broker
+        queue_sink = QueueingSink()
+        fs_src = FilerServer(master=cluster.master.address, port=free_port_pair())
+        fs_src.filer.notifier = Notifier(
+            [queue_sink, BrokerSink(broker.address)]
+        )
+        await fs_src.start()
+
+        # destination: a second filer namespace fronted by an IAM-gated S3
+        fs_dst = FilerServer(master=cluster.master.address, port=free_port_pair())
+        await fs_dst.start()
+        iam = IdentityAccessManagement.from_config(
+            {
+                "identities": [
+                    {
+                        "name": "repl",
+                        "credentials": [
+                            {"accessKey": "AKR", "secretKey": "SKR"}
+                        ],
+                        "actions": ["Admin"],
+                    }
+                ]
+            }
+        )
+        s3 = S3Server(fs_dst, port=free_port_pair(), iam=iam)
+        await s3.start()
+
+        sink = S3Sink(
+            source_filer=fs_src.address,
+            endpoint=s3.address,
+            bucket="mirror",
+            access_key="AKR",
+            secret_key="SKR",
+        )
+        replicator = Replicator(queue_sink, sink)
+        await replicator.start()
+        try:
+            await fs_src.master_client.wait_connected()
+            await fs_dst.master_client.wait_connected()
+            async with aiohttp.ClientSession() as session:
+                # destination bucket
+                url = f"http://{s3.address}/mirror"
+                headers = sign_request("PUT", url, {}, b"", "AKR", "SKR")
+                async with session.put(url, data=b"", headers=headers) as r:
+                    assert r.status == 200
+
+                # write on the SOURCE filer
+                payload = random.randbytes(9_000)
+                async with session.put(
+                    f"http://{fs_src.address}/site/logo.bin", data=payload
+                ) as r:
+                    assert r.status == 201
+                await replicator.drain()
+
+                # replicated object is served by the destination gateway
+                url = f"http://{s3.address}/mirror/site/logo.bin"
+                headers = sign_request("GET", url, {}, b"", "AKR", "SKR")
+                async with session.get(url, headers=headers) as r:
+                    assert r.status == 200, await r.text()
+                    assert await r.read() == payload
+
+                # delete propagates
+                async with session.delete(
+                    f"http://{fs_src.address}/site/logo.bin"
+                ) as r:
+                    assert r.status == 204
+                await replicator.drain()
+                headers = sign_request("GET", url, {}, b"", "AKR", "SKR")
+                async with session.get(url, headers=headers) as r:
+                    assert r.status == 404
+
+                # rename propagates: old key removed, new key appears
+                async with session.put(
+                    f"http://{fs_src.address}/site/old.bin", data=b"rrr"
+                ) as r:
+                    assert r.status == 201
+                await replicator.drain()
+                fs_src.filer.rename("/site/old.bin", "/site/new.bin")
+                await replicator.drain()
+                url_new = f"http://{s3.address}/mirror/site/new.bin"
+                headers = sign_request("GET", url_new, {}, b"", "AKR", "SKR")
+                async with session.get(url_new, headers=headers) as r:
+                    assert r.status == 200
+                    assert await r.read() == b"rrr"
+                url_old = f"http://{s3.address}/mirror/site/old.bin"
+                headers = sign_request("GET", url_old, {}, b"", "AKR", "SKR")
+                async with session.get(url_old, headers=headers) as r:
+                    assert r.status == 404
+
+                # the broker sink published the filer events (keyed by
+                # path, so both land on the same hashed partition)
+                from seaweedfs_tpu.messaging.broker import (
+                    DEFAULT_PARTITIONS,
+                    pick_partition,
+                )
+
+                partition = pick_partition(b"/site/logo.bin", DEFAULT_PARTITIONS)
+                stub = Stub(grpc_address(broker.address), "messaging")
+                events = []
+                async for msg in stub.server_stream(
+                    "Subscribe",
+                    {"topic": "filer", "partition": partition, "start_offset": 0},
+                    timeout=5,
+                ):
+                    if msg.get("keepalive"):
+                        continue
+                    events.append(json.loads(msg["value"]))
+                    if len(events) >= 2:
+                        break
+                kinds = {(e["event"], e["path"]) for e in events}
+                assert ("create", "/site/logo.bin") in kinds
+                assert ("delete", "/site/logo.bin") in kinds
+        finally:
+            await replicator.stop()
+            await sink.close()
+            await s3.stop()
+            await fs_dst.stop()
+            await fs_src.stop()
+            await broker.stop()
+            await cluster.stop()
+
+    asyncio.run(body())

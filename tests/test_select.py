@@ -1,0 +1,145 @@
+"""S3-Select subset: SQL parse, CSV input, Query RPC, SelectObjectContent
+(ref: weed/query/json/query_json.go; volume_grpc_query.go — whose CSV
+branch the reference left empty)."""
+
+import asyncio
+import json
+import random
+
+import aiohttp
+import pytest
+
+from test_cluster import Cluster, free_port_pair
+
+from seaweedfs_tpu.query import SelectQuery, rows_from_csv, select_rows
+
+CSV = b"name,age,city\nalice,31,oslo\nbob,17,rome\ncarol,45,oslo\n"
+JSONL = (
+    b'{"name": "alice", "age": 31, "addr": {"city": "oslo"}}\n'
+    b'{"name": "bob", "age": 17, "addr": {"city": "rome"}}\n'
+    b'{"name": "carol", "age": 45, "addr": {"city": "oslo"}}\n'
+)
+
+
+def test_select_parse():
+    q = SelectQuery.parse("SELECT s.name, s.age FROM s3object s WHERE s.age > 20 LIMIT 1")
+    assert q.fields == ["name", "age"]
+    assert q.where == "age > 20"
+    assert q.limit == 1
+    q = SelectQuery.parse("select * from s3object")
+    assert q.fields is None and q.where == "" and q.limit == 0
+    with pytest.raises(ValueError):
+        SelectQuery.parse("DROP TABLE users")
+
+
+def test_rows_from_csv_headers():
+    rows = list(rows_from_csv(CSV))
+    assert rows[0] == {"name": "alice", "age": 31, "city": "oslo"}
+    rows = list(rows_from_csv(CSV, file_header_info="IGNORE"))
+    assert rows[0] == {"_1": "alice", "_2": 31, "_3": "oslo"}
+    rows = list(rows_from_csv(b"1,2\n3,4\n", file_header_info="NONE"))
+    assert rows == [{"_1": 1, "_2": 2}, {"_1": 3, "_2": 4}]
+
+
+def test_select_rows_csv_and_json():
+    got = list(
+        select_rows(
+            CSV,
+            "SELECT s.name FROM s3object s WHERE s.city = 'oslo' AND s.age > 40",
+            input_format="csv",
+        )
+    )
+    assert got == [{"name": "carol"}]
+
+    got = list(
+        select_rows(JSONL, "SELECT name FROM s3object WHERE addr.city = 'oslo' LIMIT 1")
+    )
+    assert got == [{"name": "alice"}]
+
+
+def test_query_rpc_csv_and_s3_select(tmp_path):
+    async def body():
+        random.seed(73)
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        from seaweedfs_tpu.client import assign
+        from seaweedfs_tpu.client.operation import upload_data
+        from seaweedfs_tpu.pb import grpc_address
+        from seaweedfs_tpu.pb.rpc import Stub
+        from seaweedfs_tpu.s3.server import S3Server
+        from seaweedfs_tpu.server.filer import FilerServer
+
+        fs = FilerServer(master=cluster.master.address, port=free_port_pair())
+        await fs.start()
+        s3 = S3Server(fs, port=free_port_pair())
+        await s3.start()
+        try:
+            await fs.master_client.wait_connected()
+            async with aiohttp.ClientSession() as session:
+                # --- Query RPC over a CSV needle ---
+                ar = await assign(cluster.master.address)
+                await upload_data(session, ar.url, ar.fid, CSV)
+                stub = Stub(grpc_address(ar.url), "volume")
+                records = []
+                async for msg in stub.server_stream(
+                    "Query",
+                    {
+                        "from_file_ids": [ar.fid],
+                        "expression": "SELECT s.name FROM s3object s"
+                        " WHERE s.age > 20",
+                        "input_serialization": {"format": "csv"},
+                    },
+                ):
+                    assert not msg.get("error"), msg
+                    records.append(msg["record"])
+                assert records == [{"name": "alice"}, {"name": "carol"}]
+
+                # --- S3 SelectObjectContent over a JSON object ---
+                base = f"http://{s3.address}"
+                async with session.put(f"{base}/qb", data=b"") as r:
+                    assert r.status == 200
+                async with session.put(f"{base}/qb/data.jsonl", data=JSONL) as r:
+                    assert r.status == 200
+                body_xml = (
+                    "<SelectObjectContentRequest>"
+                    "<Expression>SELECT s.name FROM s3object s"
+                    " WHERE s.addr.city = 'oslo'</Expression>"
+                    "<ExpressionType>SQL</ExpressionType>"
+                    "<InputSerialization><JSON><Type>LINES</Type></JSON>"
+                    "</InputSerialization>"
+                    "</SelectObjectContentRequest>"
+                )
+                async with session.post(
+                    f"{base}/qb/data.jsonl?select&select-type=2", data=body_xml
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    lines = (await r.read()).decode().strip().splitlines()
+                    assert [json.loads(l) for l in lines] == [
+                        {"name": "alice"},
+                        {"name": "carol"},
+                    ]
+
+                # CSV select through S3 too
+                async with session.put(f"{base}/qb/data.csv", data=CSV) as r:
+                    assert r.status == 200
+                body_xml = (
+                    "<SelectObjectContentRequest>"
+                    "<Expression>SELECT s.city FROM s3object s"
+                    " WHERE s.name = 'bob'</Expression>"
+                    "<ExpressionType>SQL</ExpressionType>"
+                    "<InputSerialization><CSV>"
+                    "<FileHeaderInfo>USE</FileHeaderInfo>"
+                    "</CSV></InputSerialization>"
+                    "</SelectObjectContentRequest>"
+                )
+                async with session.post(
+                    f"{base}/qb/data.csv?select&select-type=2", data=body_xml
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    assert json.loads(await r.read()) == {"city": "rome"}
+        finally:
+            await s3.stop()
+            await fs.stop()
+            await cluster.stop()
+
+    asyncio.run(body())
