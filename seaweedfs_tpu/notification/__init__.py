@@ -59,26 +59,36 @@ class BrokerSink(NotificationSink):
         import json
 
         from ..pb import grpc_address
-        from ..pb.rpc import Stub
+        from ..pb.rpc import Stub, new_channel
+
+        request = {
+            "namespace": self.namespace,
+            "topic": self.topic,
+            "key": path.encode(),
+            "value": json.dumps(
+                {"event": event_type, "path": path, "entry": entry}
+            ).encode(),
+        }
 
         async def publish() -> None:
             stub = Stub(grpc_address(self.broker), "messaging")
-            await stub.call(
-                "Publish",
-                {
-                    "namespace": self.namespace,
-                    "topic": self.topic,
-                    "key": path.encode(),
-                    "value": json.dumps(
-                        {"event": event_type, "path": path, "entry": entry}
-                    ).encode(),
-                },
-            )
+            await stub.call("Publish", request)
 
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:
-            asyncio.run(publish())  # sync caller (tests/tools)
+            # sync caller (tests/tools): a private loop must not touch the
+            # process channel cache, or the cached channel dies with it
+            async def publish_once() -> None:
+                channel = new_channel(grpc_address(self.broker))
+                try:
+                    await Stub(
+                        grpc_address(self.broker), "messaging", channel=channel
+                    ).call("Publish", request)
+                finally:
+                    await channel.close()
+
+            asyncio.run(publish_once())
             return
         task = loop.create_task(publish())
         self._tasks.add(task)

@@ -14,10 +14,12 @@ from .sharded_ec import (
     sharded_verify,
     sharded_reconstruct_step,
 )
+from .sharded_lookup import sharded_bulk_lookup
 
 __all__ = [
     "make_mesh",
     "sharded_encode",
     "sharded_verify",
     "sharded_reconstruct_step",
+    "sharded_bulk_lookup",
 ]

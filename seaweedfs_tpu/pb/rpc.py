@@ -127,12 +127,17 @@ _KEEPALIVE_OPTIONS = [
 
 
 class Stub:
-    """Client for one Service over a (cached) channel."""
+    """Client for one Service over a (cached) channel.
 
-    def __init__(self, address: str, service_name: str):
+    Pass an explicit `channel` (see new_channel) to bypass the process
+    cache — needed when calling from a short-lived private event loop,
+    where a cached channel would outlive its loop and poison later users.
+    """
+
+    def __init__(self, address: str, service_name: str, channel=None):
         self.address = address
         self.service = service_name
-        self._channel = get_channel(address)
+        self._channel = channel if channel is not None else get_channel(address)
 
     def _path(self, method: str) -> str:
         return f"/{self.service}/{method}"
@@ -221,6 +226,19 @@ def get_channel(address: str) -> grpc.aio.Channel:
                 )
             _channels[address] = ch
         return ch
+
+
+def new_channel(address: str) -> grpc.aio.Channel:
+    """Uncached channel with the same security mode as get_channel; the
+    caller owns its lifecycle (close it on the loop that created it)."""
+    if _tls_config is not None:
+        creds = grpc.ssl_channel_credentials(
+            root_certificates=_tls_config.ca,
+            private_key=_tls_config.key,
+            certificate_chain=_tls_config.cert,
+        )
+        return grpc.aio.secure_channel(address, creds, options=_KEEPALIVE_OPTIONS)
+    return grpc.aio.insecure_channel(address, options=_KEEPALIVE_OPTIONS)
 
 
 async def close_all_channels() -> None:

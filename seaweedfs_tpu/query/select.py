@@ -60,11 +60,15 @@ class SelectQuery:
             fields = [strip_alias(f) for f in raw_fields.split(",")]
         where = m.group("where") or ""
         if where:
-            # strip table aliases inside predicates too
-            for p in prefixes:
-                where = re.sub(
-                    rf"(^|[\s(]){re.escape(p)}", r"\1", where
-                )
+            # strip table aliases inside predicates — but never inside
+            # quoted string literals
+            parts = re.split(r"('[^']*'|\"[^\"]*\")", where)
+            for i in range(0, len(parts), 2):
+                for p in prefixes:
+                    parts[i] = re.sub(
+                        rf"(^|[\s(]){re.escape(p)}", r"\1", parts[i]
+                    )
+            where = "".join(parts)
         parse_where(where)  # validate early
         return cls(fields, where, int(m.group("limit") or 0))
 
@@ -72,19 +76,23 @@ class SelectQuery:
 def rows_from_csv(
     data: bytes,
     delimiter: str = ",",
-    file_header_info: str = "USE",
+    file_header_info: str = "NONE",
 ) -> Iterator[dict]:
     """CSV bytes -> row dicts. file_header_info: USE (first row is the
-    header), IGNORE (skip it, columns _1.._n), NONE (no header row)."""
+    header), IGNORE (skip it, columns _1.._n), NONE (no header row — the
+    AWS SelectObjectContent default)."""
     text = data.decode("utf-8", errors="replace")
     reader = csv.reader(io.StringIO(text), delimiter=delimiter)
     header: Optional[list[str]] = None
-    for i, row in enumerate(reader):
+    # the header is the first NON-EMPTY row, not physical row 0
+    header_pending = file_header_info.upper() in ("USE", "IGNORE")
+    for row in reader:
         if not row:
             continue
-        if i == 0 and file_header_info.upper() in ("USE", "IGNORE"):
+        if header_pending:
             if file_header_info.upper() == "USE":
                 header = row
+            header_pending = False
             continue
         if header is not None:
             yield {h: _typed(v) for h, v in zip(header, row)}
@@ -107,7 +115,7 @@ def select_rows(
     expression: str,
     input_format: str = "json",
     csv_delimiter: str = ",",
-    csv_header: str = "USE",
+    csv_header: str = "NONE",
 ) -> Iterator[dict]:
     """Run a SELECT expression over a JSON or CSV object; yields projected
     row dicts."""

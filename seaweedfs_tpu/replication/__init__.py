@@ -104,7 +104,11 @@ class S3Sink(ReplicationSink):
         return self._session
 
     def _url(self, path: str) -> str:
-        return f"http://{self.endpoint}/{self.bucket}{path}"
+        import urllib.parse
+
+        # pre-encode so the signed canonical path matches what yarl sends
+        quoted = urllib.parse.quote(path, safe="/-_.~")
+        return f"http://{self.endpoint}/{self.bucket}{quoted}"
 
     async def _signed(self, method: str, url: str, payload: bytes):
         from ..s3.auth import sign_request

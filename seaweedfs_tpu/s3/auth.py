@@ -316,9 +316,11 @@ def sign_request(
     signed = sorted(h.lower() for h in ("Host", "x-amz-date", "x-amz-content-sha256"))
     query_pairs = urllib.parse.parse_qsl(u.query, keep_blank_values=True)
     lower_headers = {k.lower(): v for k, v in out.items()}
+    # the url must already be wire-encoded (quote special chars yourself);
+    # the path is signed verbatim — re-encoding here would double-encode
     canon = canonical_request(
         method,
-        _uri_encode(u.path or "/", encode_slash=False),
+        u.path or "/",
         query_pairs,
         lower_headers,
         signed,
@@ -360,9 +362,10 @@ def presign_url(
         ("X-Amz-Expires", str(expires)),
         ("X-Amz-SignedHeaders", "host"),
     ]
+    # wire-encoded path, signed verbatim (see sign_request)
     canon = canonical_request(
         method,
-        _uri_encode(u.path or "/", encode_slash=False),
+        u.path or "/",
         pairs,
         {"host": u.netloc},
         ["host"],

@@ -350,30 +350,39 @@ class S3Server:
             return _error("MissingRequiredParameter", "Expression", 400)
         input_format = "json"
         csv_delimiter = ","
-        csv_header = "USE"
+        csv_header = "NONE"  # the AWS SelectObjectContent default
         input_el = req_xml.find("InputSerialization")
         if input_el is not None and input_el.find("CSV") is not None:
             input_format = "csv"
             csv_el = input_el.find("CSV")
             csv_delimiter = csv_el.findtext("FieldDelimiter") or ","
-            csv_header = csv_el.findtext("FileHeaderInfo") or "USE"
+            csv_header = csv_el.findtext("FileHeaderInfo") or "NONE"
 
         visibles = non_overlapping_visible_intervals(entry.chunks)
         data = await self._read_span(visibles, 0, entry.size())
         try:
-            rows = list(
-                select_rows(
-                    data,
-                    expression,
-                    input_format=input_format,
-                    csv_delimiter=csv_delimiter,
-                    csv_header=csv_header,
-                )
+            rows = select_rows(
+                data,
+                expression,
+                input_format=input_format,
+                csv_delimiter=csv_delimiter,
+                csv_header=csv_header,
             )
+            # validate the expression before committing to a 200
+            first = next(rows, None)
         except ValueError as e:
             return _error("InvalidExpression", str(e), 400)
-        body = b"".join(_json.dumps(r).encode() + b"\n" for r in rows)
-        return web.Response(body=body, content_type="application/x-ndjson")
+        # stream the result rows instead of materializing the whole set
+        resp = web.StreamResponse(
+            status=200, headers={"Content-Type": "application/x-ndjson"}
+        )
+        await resp.prepare(request)
+        if first is not None:
+            await resp.write(_json.dumps(first).encode() + b"\n")
+            for r in rows:
+                await resp.write(_json.dumps(r).encode() + b"\n")
+        await resp.write_eof()
+        return resp
 
     async def _delete_object(self, bucket: str, key: str) -> web.Response:
         self.filer.delete_entry(self._object_path(bucket, key))

@@ -980,7 +980,7 @@ class VolumeServer(EcHandlers):
                         expression,
                         input_format=input_cfg.get("format", "json"),
                         csv_delimiter=input_cfg.get("csv_delimiter", ","),
-                        csv_header=input_cfg.get("csv_header", "USE"),
+                        csv_header=input_cfg.get("csv_header", "NONE"),
                     )
                 else:
                     rows = query_json(bytes(n.data), fields, where)

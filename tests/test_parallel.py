@@ -61,3 +61,25 @@ def test_sharded_reconstruct(mesh, codec):
     rec = np.asarray(sharded_reconstruct_step(dec[np.asarray([0, 3])], surv, mesh))
     assert np.array_equal(rec[:, 0, :], data[:, 0, :])
     assert np.array_equal(rec[:, 1, :], data[:, 3, :])
+
+
+def test_sharded_bulk_lookup(mesh):
+    from seaweedfs_tpu.parallel import sharded_bulk_lookup
+
+    rng = np.random.default_rng(3)
+    m = 5000
+    keys = np.cumsum(rng.integers(1, 9, size=m, dtype=np.uint64)).astype(
+        np.uint64
+    )
+    offsets = rng.integers(1, 1 << 30, size=m, dtype=np.uint64).astype(np.uint32)
+    sizes = rng.integers(1, 1 << 20, size=m, dtype=np.uint64).astype(np.uint32)
+    n_devices = mesh.devices.size
+    p = n_devices * 16
+    idx = rng.integers(0, m, size=p)
+    probes = keys[idx].copy()
+    probes[:2] = np.uint64(int(keys[-1]) + 5)  # misses
+    off, size, found = sharded_bulk_lookup(keys, offsets, sizes, probes, mesh)
+    assert not found[:2].any()
+    assert found[2:].all()
+    assert np.array_equal(off[2:], offsets[idx[2:]])
+    assert np.array_equal(size[2:], sizes[idx[2:]])

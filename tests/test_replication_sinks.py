@@ -99,6 +99,18 @@ def test_s3_replication_sink_and_broker_notifications(tmp_path):
                 async with session.get(url, headers=headers) as r:
                     assert r.status == 404
 
+                # keys needing URL-encoding still sign correctly
+                async with session.put(
+                    f"http://{fs_src.address}/site/my file.bin", data=b"sp"
+                ) as r:
+                    assert r.status == 201
+                await replicator.drain()
+                url_sp = f"http://{s3.address}/mirror/site/my%20file.bin"
+                headers = sign_request("GET", url_sp, {}, b"", "AKR", "SKR")
+                async with session.get(url_sp, headers=headers) as r:
+                    assert r.status == 200, await r.text()
+                    assert await r.read() == b"sp"
+
                 # rename propagates: old key removed, new key appears
                 async with session.put(
                     f"http://{fs_src.address}/site/old.bin", data=b"rrr"

@@ -28,17 +28,24 @@ def test_select_parse():
     assert q.limit == 1
     q = SelectQuery.parse("select * from s3object")
     assert q.fields is None and q.where == "" and q.limit == 0
+    # alias stripping must not touch quoted literals
+    q = SelectQuery.parse("SELECT * FROM s3object s WHERE name = 'acme s.r.o'")
+    assert q.where == "name = 'acme s.r.o'"
     with pytest.raises(ValueError):
         SelectQuery.parse("DROP TABLE users")
 
 
 def test_rows_from_csv_headers():
-    rows = list(rows_from_csv(CSV))
+    rows = list(rows_from_csv(CSV, file_header_info="USE"))
     assert rows[0] == {"name": "alice", "age": 31, "city": "oslo"}
     rows = list(rows_from_csv(CSV, file_header_info="IGNORE"))
     assert rows[0] == {"_1": "alice", "_2": 31, "_3": "oslo"}
-    rows = list(rows_from_csv(b"1,2\n3,4\n", file_header_info="NONE"))
+    # NONE is the AWS default: no header row consumed
+    rows = list(rows_from_csv(b"1,2\n3,4\n"))
     assert rows == [{"_1": 1, "_2": 2}, {"_1": 3, "_2": 4}]
+    # a leading blank line must not eat the real header
+    rows = list(rows_from_csv(b"\n" + CSV, file_header_info="USE"))
+    assert rows[0] == {"name": "alice", "age": 31, "city": "oslo"}
 
 
 def test_select_rows_csv_and_json():
@@ -47,6 +54,7 @@ def test_select_rows_csv_and_json():
             CSV,
             "SELECT s.name FROM s3object s WHERE s.city = 'oslo' AND s.age > 40",
             input_format="csv",
+            csv_header="USE",
         )
     )
     assert got == [{"name": "carol"}]
@@ -87,7 +95,10 @@ def test_query_rpc_csv_and_s3_select(tmp_path):
                         "from_file_ids": [ar.fid],
                         "expression": "SELECT s.name FROM s3object s"
                         " WHERE s.age > 20",
-                        "input_serialization": {"format": "csv"},
+                        "input_serialization": {
+                            "format": "csv",
+                            "csv_header": "USE",
+                        },
                     },
                 ):
                     assert not msg.get("error"), msg
