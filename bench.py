@@ -175,6 +175,49 @@ def measure_lookup(
     return tpu_qps, cpu_qps
 
 
+def measure_rebuild() -> tuple[float, float]:
+    """ec.rebuild throughput (BASELINE.json config 2): reconstruct 4 lost
+    shards (2 data + 2 parity) from 10 survivors — the same constant-matrix
+    GF(2^8) primitive as encode, with the survivor-inverse matrix
+    (ref ec_encoder.go:233-287). -> (tpu_gbps, cpu_gbps) over survivor
+    bytes processed."""
+    from seaweedfs_tpu.ops.gf256 import pack_bytes_host
+    from seaweedfs_tpu.storage.erasure_coding.galois import (
+        build_matrix,
+        mat_mul,
+        reconstruction_matrix,
+    )
+    from seaweedfs_tpu.tpu.coder import get_codec
+
+    matrix = build_matrix(10, 14)
+    missing = [0, 1, 11, 13]
+    survivors = [i for i in range(14) if i not in missing][:10]
+    dec = reconstruction_matrix(matrix, survivors)
+    rec_rows = np.concatenate(
+        [dec[np.asarray([0, 1])], mat_mul(matrix[np.asarray([11, 13])], dec)]
+    )
+
+    rng = np.random.default_rng(5)
+    cpu_data = rng.integers(0, 256, size=(10, 4 << 20), dtype=np.uint8)
+    cpu_codec = get_codec("cpu")
+    apply_fn = cpu_codec._mat_apply  # native SIMD (or numpy-table) matmul
+    apply_fn(rec_rows, cpu_data[:, : 1 << 16])  # warm
+    n_bytes = cpu_data.size
+    iters = 0
+    t0 = time.perf_counter()
+    while True:
+        apply_fn(rec_rows, cpu_data)
+        iters += 1
+        dt = time.perf_counter() - t0
+        if dt >= 1.0 and iters >= 2:
+            cpu_gbps = n_bytes * iters / dt / 1e9
+            break
+
+    data = rng.integers(0, 256, size=(10, 16 << 20), dtype=np.uint8)
+    tpu_gbps = measure_tpu(rec_rows, pack_bytes_host(data))
+    return tpu_gbps, cpu_gbps
+
+
 def measure_encode_e2e(
     size_bytes: int = 4 << 30,
 ) -> tuple[float, float, bool]:
@@ -286,6 +329,19 @@ def main() -> None:
         )
     except Exception as e:  # never lose the headline metric to a new bench
         extra.append({"metric": "needle_lookup_qps", "error": str(e)[:200]})
+
+    try:
+        rb_tpu, rb_cpu = measure_rebuild()
+        extra.append(
+            {
+                "metric": "ec.rebuild_throughput",
+                "value": round(rb_tpu, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(rb_tpu / rb_cpu, 2),
+            }
+        )
+    except Exception as e:
+        extra.append({"metric": "ec.rebuild_throughput", "error": str(e)[:200]})
 
     try:
         import os

@@ -22,6 +22,34 @@ from jax import shard_map
 from ..ops.index_kernel import _search_range, _split_u64
 
 
+@functools.lru_cache(maxsize=32)
+def _compiled_body(n: int, steps: int, mesh: Mesh):
+    """Jitted shard_map body cached by (table size, step count, mesh):
+    rebuilding the closure per call would miss jit's trace cache and pay a
+    full XLA compile on every serving request."""
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(("vol", "blk")), P(("vol", "blk"))),
+        out_specs=(
+            P(("vol", "blk")),
+            P(("vol", "blk")),
+            P(("vol", "blk")),
+        ),
+    )
+    def body(khi_g, klo_g, off_g, size_g, phi_l, plo_l):
+        # derive the carry init from the sharded input so the fori_loop
+        # carry has matching varying axes under shard_map
+        lo = (phi_l ^ phi_l).astype(jnp.int32)
+        hi = lo + n
+        return _search_range(
+            steps, khi_g, klo_g, off_g, size_g, phi_l, plo_l, lo, hi
+        )
+
+    return jax.jit(body)
+
+
 def sharded_bulk_lookup(
     keys: np.ndarray,
     offsets: np.ndarray,
@@ -43,26 +71,7 @@ def sharded_bulk_lookup(
     khi, klo = _split_u64(np.ascontiguousarray(keys, dtype=np.uint64))
     phi, plo = _split_u64(np.ascontiguousarray(probes, dtype=np.uint64))
 
-    @functools.partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(P(), P(), P(), P(), P(("vol", "blk")), P(("vol", "blk"))),
-        out_specs=(
-            P(("vol", "blk")),
-            P(("vol", "blk")),
-            P(("vol", "blk")),
-        ),
-    )
-    def body(khi_g, klo_g, off_g, size_g, phi_l, plo_l):
-        # derive the carry init from the sharded input so the fori_loop
-        # carry has matching varying axes under shard_map
-        lo = (phi_l ^ phi_l).astype(jnp.int32)
-        hi = lo + n
-        return _search_range(
-            steps, khi_g, klo_g, off_g, size_g, phi_l, plo_l, lo, hi
-        )
-
-    off, size, found = jax.jit(body)(
+    off, size, found = _compiled_body(n, steps, mesh)(
         jnp.asarray(khi),
         jnp.asarray(klo),
         jnp.asarray(offsets.astype(np.uint32)),

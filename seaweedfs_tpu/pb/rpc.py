@@ -211,19 +211,7 @@ def get_channel(address: str) -> grpc.aio.Channel:
     with _channels_lock:
         ch = _channels.get(address)
         if ch is None:
-            if _tls_config is not None:
-                creds = grpc.ssl_channel_credentials(
-                    root_certificates=_tls_config.ca,
-                    private_key=_tls_config.key,
-                    certificate_chain=_tls_config.cert,
-                )
-                ch = grpc.aio.secure_channel(
-                    address, creds, options=_KEEPALIVE_OPTIONS
-                )
-            else:
-                ch = grpc.aio.insecure_channel(
-                    address, options=_KEEPALIVE_OPTIONS
-                )
+            ch = new_channel(address)
             _channels[address] = ch
         return ch
 

@@ -377,10 +377,15 @@ class S3Server:
             status=200, headers={"Content-Type": "application/x-ndjson"}
         )
         await resp.prepare(request)
-        if first is not None:
-            await resp.write(_json.dumps(first).encode() + b"\n")
-            for r in rows:
-                await resp.write(_json.dumps(r).encode() + b"\n")
+        try:
+            if first is not None:
+                await resp.write(_json.dumps(first).encode() + b"\n")
+                for r in rows:
+                    await resp.write(_json.dumps(r).encode() + b"\n")
+        except ValueError as e:
+            # the 200 is already committed; surface mid-stream data errors
+            # as a terminal error record instead of a dead connection
+            await resp.write(_json.dumps({"__error__": str(e)}).encode() + b"\n")
         await resp.write_eof()
         return resp
 

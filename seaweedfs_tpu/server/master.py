@@ -94,6 +94,8 @@ class MasterServer:
         app.router.add_route("*", "/col/delete", self._col_delete)
         app.router.add_get("/cluster/status", self._cluster_status)
         app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/", self._ui)
+        app.router.add_get("/ui", self._ui)
         app.router.add_get("/{file_id:[0-9]+,.+}", self._redirect)
         self._http_runner = web.AppRunner(app)
         await self._http_runner.setup()
@@ -318,6 +320,42 @@ class MasterServer:
         from ..util.metrics import REGISTRY
 
         return web.Response(text=REGISTRY.render(), content_type="text/plain")
+
+    async def _ui(self, request: web.Request) -> web.Response:
+        """Minimal HTML status page (ref: weed/server/master_ui/)."""
+        from html import escape
+
+        info = self.topo.to_info()
+        rows = []
+        for dc in info["data_centers"]:
+            for rack in dc["racks"]:
+                for dn in rack["data_nodes"]:
+                    # dc/rack/url strings come from heartbeats — escape them
+                    url = escape(dn["url"], quote=True)
+                    rows.append(
+                        f"<tr><td>{escape(str(dc['id']))}</td>"
+                        f"<td>{escape(str(rack['id']))}</td>"
+                        f"<td><a href='http://{url}/ui'>{url}</a></td>"
+                        f"<td>{len(dn.get('volumes', []))}</td>"
+                        f"<td>{dn.get('max_volume_count', 0)}</td>"
+                        f"<td>{len(dn.get('ec_shards', []))}</td></tr>"
+                    )
+        html = f"""<!doctype html><html><head><title>seaweedfs-tpu master</title>
+<style>body{{font-family:sans-serif;margin:2em}}table{{border-collapse:collapse}}
+td,th{{border:1px solid #ccc;padding:4px 10px}}</style></head><body>
+<h1>seaweedfs-tpu master {self.address}</h1>
+<p>leader: <b>{escape(str(self.leader or "-"))}</b> (this node is
+{"the leader" if self.is_leader else "a follower"}) &middot;
+peers: {escape(", ".join(self.raft.others()) or "none")}</p>
+<p>volumes: {info["volume_count"]} / capacity {info["max_volume_count"]}
+&middot; max volume id: {info["max_volume_id"]}
+&middot; ec shards: {info["ec_shard_count"]}</p>
+<table><tr><th>data center</th><th>rack</th><th>volume server</th>
+<th>volumes</th><th>max</th><th>ec shards</th></tr>{"".join(rows)}</table>
+<p><a href="/dir/status">/dir/status</a> &middot;
+<a href="/cluster/status">/cluster/status</a> &middot;
+<a href="/metrics">/metrics</a></p></body></html>"""
+        return web.Response(text=html, content_type="text/html")
 
     async def _cluster_status(self, request: web.Request) -> web.Response:
         return web.json_response(

@@ -252,6 +252,8 @@ class VolumeServer(EcHandlers):
         path = request.path
         if path == "/status":
             return web.json_response({"Version": "seaweedfs-tpu", "Volumes": []})
+        if path in ("/ui", "/ui/"):
+            return self._ui_response()
         if path == "/metrics":
             from ..util.metrics import REGISTRY
 
@@ -273,6 +275,42 @@ class VolumeServer(EcHandlers):
             REQUEST_HISTOGRAM.observe(
                 _time.perf_counter() - t0, server="volume", operation=request.method
             )
+
+    def _ui_response(self) -> web.Response:
+        """Minimal HTML status page (ref: weed/server/volume_server_ui/)."""
+        from html import escape
+
+        vol_rows = []
+        ec_rows = []
+        for loc in self.store.locations:
+            for v in loc.volumes.values():
+                # collection names are client-supplied — escape them
+                vol_rows.append(
+                    f"<tr><td>{v.id}</td>"
+                    f"<td>{escape(v.collection) or '-'}</td>"
+                    f"<td>{v.data_file_size():,}</td><td>{v.file_count()}</td>"
+                    f"<td>{v.deleted_count()}</td>"
+                    f"<td>{'ro' if v.is_read_only() else 'rw'}</td>"
+                    f"<td>{escape(loc.directory)}</td></tr>"
+                )
+            for vid, ev in loc.ec_volumes.items():
+                ec_rows.append(
+                    f"<tr><td>{vid}</td><td>{escape(ev.collection) or '-'}</td>"
+                    f"<td>{ev.shard_ids()}</td>"
+                    f"<td>{ev.data_shards}.{ev.parity_shards}</td></tr>"
+                )
+        html = f"""<!doctype html><html><head><title>seaweedfs-tpu volume</title>
+<style>body{{font-family:sans-serif;margin:2em}}table{{border-collapse:collapse;margin-bottom:1.5em}}
+td,th{{border:1px solid #ccc;padding:4px 10px}}</style></head><body>
+<h1>seaweedfs-tpu volume server {self.address}</h1>
+<p>master: {escape(self.master)} &middot; rack: {escape(self.rack) or "-"} &middot;
+dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
+<table><tr><th>volume</th><th>collection</th><th>size</th><th>files</th>
+<th>deleted</th><th>mode</th><th>dir</th></tr>{"".join(vol_rows)}</table>
+<table><tr><th>ec volume</th><th>collection</th><th>local shards</th>
+<th>geometry</th></tr>{"".join(ec_rows)}</table>
+<p><a href="/metrics">/metrics</a></p></body></html>"""
+        return web.Response(text=html, content_type="text/html")
 
     async def _dispatch_inner(self, request: web.Request) -> web.StreamResponse:
         try:
