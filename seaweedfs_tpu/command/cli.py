@@ -76,6 +76,12 @@ def _add_volume_flags(p: argparse.ArgumentParser) -> None:
         action="store_true",
         help="enable /debug/pprof/{profile,heap} HTTP handlers",
     )
+    p.add_argument(
+        "-whiteList",
+        default="",
+        help="comma-separated IPs/CIDRs allowed to write (ref guard.go); "
+        "empty = everyone",
+    )
 
 
 def _apply_config_defaults(
@@ -83,7 +89,7 @@ def _apply_config_defaults(
     argv: list[str],
     sections: list[str],
     renames: dict | None = None,
-) -> None:
+):
     """-config support (ref weed/util/config.go:19-51): load a scaffold-
     emitted TOML (explicit path, or a name searched in ., ~/.seaweedfs-tpu,
     /etc/seaweedfs-tpu), apply its sections as flag defaults (explicit CLI
@@ -99,7 +105,7 @@ def _apply_config_defaults(
     pre.add_argument("-config", default="")
     known, _ = pre.parse_known_args(argv)
     if not known.config:
-        return
+        return None
     from ..util.config import load_configuration
 
     cfg = load_configuration(known.config, required=True)
@@ -139,6 +145,7 @@ def _apply_config_defaults(
                 grpc_sec["ca"], grpc_sec["cert"], grpc_sec["key"]
             )
         )
+    return cfg
 
 
 def _build_volume_server(args, port_offset: int = 0):
@@ -170,6 +177,9 @@ def _build_volume_server(args, port_offset: int = 0):
         codec_backend=args.storageBackend,
         jwt_signing_key=getattr(args, "jwtSigningKey", ""),
         pprof=getattr(args, "pprof", False),
+        white_list=tuple(
+            x for x in getattr(args, "whiteList", "").split(",") if x
+        ),
     )
 
 
@@ -184,10 +194,24 @@ async def _run_forever(*servers) -> None:
             await s.stop()
 
 
+def _maintenance_kwargs(cfg) -> dict:
+    """[master.maintenance] scripts / sleep_minutes + [master.filer] default
+    (ref scaffold.go master template)."""
+    if cfg is None:
+        return {}
+    return {
+        "maintenance_scripts": cfg.get("master.maintenance.scripts", "") or "",
+        "maintenance_sleep_minutes": float(
+            cfg.get("master.maintenance.sleep_minutes", 17)
+        ),
+        "maintenance_filer": cfg.get("master.filer.default", "") or "",
+    }
+
+
 def cmd_master(argv: list[str]) -> int:
     p = argparse.ArgumentParser(prog="weed-tpu master")
     _add_master_flags(p)
-    _apply_config_defaults(p, argv, ["master"])
+    cfg = _apply_config_defaults(p, argv, ["master"])
     args = p.parse_args(argv)
     from ..server.master import MasterServer
 
@@ -199,6 +223,7 @@ def cmd_master(argv: list[str]) -> int:
         garbage_threshold=args.garbageThreshold,
         peers=[x for x in args.peers.split(",") if x] or None,
         jwt_signing_key=args.jwtSigningKey,
+        **_maintenance_kwargs(cfg),
     )
     print(f"master listening on {args.ip}:{args.port}")
     asyncio.run(_run_forever(ms))
@@ -238,12 +263,17 @@ def cmd_server(argv: list[str]) -> int:
         action="store_true",
         help="enable /debug/pprof handlers on the volume server",
     )
+    p.add_argument(
+        "-whiteList",
+        default="",
+        help="comma-separated IPs/CIDRs allowed to write (ref guard.go)",
+    )
     p.add_argument("-filer", action="store_true", help="also run a filer")
     p.add_argument("-filerPort", type=int, default=8888)
     p.add_argument("-s3", action="store_true", help="also run an S3 gateway (implies -filer)")
     p.add_argument("-s3Port", type=int, default=8333)
     p.add_argument("-s3Config", default="", help="IAM identities JSON for the S3 gateway")
-    _apply_config_defaults(
+    cfg = _apply_config_defaults(
         p,
         argv,
         ["master", "server", "security"],
@@ -254,6 +284,7 @@ def cmd_server(argv: list[str]) -> int:
             "volume.dataCenter": "dataCenter",
             "volume.rack": "rack",
             "volume.index": "index",
+            "volume.whiteList": "whiteList",
         },
     )
     args = p.parse_args(argv)
@@ -276,6 +307,7 @@ def cmd_server(argv: list[str]) -> int:
         default_replication=args.defaultReplication,
         peers=peers,
         jwt_signing_key=args.jwtSigningKey,
+        **_maintenance_kwargs(cfg),
     )
     vs = VolumeServer(
         master=peers or f"{args.ip}:{args.port}",
@@ -289,6 +321,7 @@ def cmd_server(argv: list[str]) -> int:
         needle_map_kind=args.index,
         jwt_signing_key=args.jwtSigningKey,
         pprof=args.pprof,
+        white_list=tuple(x for x in args.whiteList.split(",") if x),
     )
     servers = [ms, vs]
     desc = (
@@ -708,6 +741,20 @@ filerPort = 8888
 
 [storage]
 backend = "tpu"           # route erasure coding through the TPU kernels
+
+# periodically run admin-shell scripts on the leader master
+# (ref weed scaffold master template)
+[master.maintenance]
+scripts = '''
+ec.encode -fullPercent 95
+ec.rebuild
+ec.balance
+volume.balance -force
+'''
+sleep_minutes = 17
+
+[master.filer]
+default = "localhost:8888"  # used when maintenance scripts need fs.* commands
 """,
     "security": """# seaweedfs-tpu security configuration (TOML)
 # (ref: weed scaffold -config=security; weed/security/tls.go)

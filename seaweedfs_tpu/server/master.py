@@ -46,10 +46,17 @@ class MasterServer:
         jwt_expires_seconds: int = 10,
         peers: Optional[list[str]] = None,
         admin_lease_seconds: float = 10.0,
+        maintenance_scripts: str = "",
+        maintenance_sleep_minutes: float = 17.0,
+        maintenance_filer: str = "",
     ):
         self.jwt_signing_key = jwt_signing_key
         self.jwt_expires_seconds = jwt_expires_seconds
         self.admin_lease_seconds = admin_lease_seconds
+        self.maintenance_scripts = maintenance_scripts
+        self.maintenance_sleep_minutes = maintenance_sleep_minutes
+        self.maintenance_filer = maintenance_filer
+        self._maintenance_task: Optional[asyncio.Task] = None
         self.host = host
         self.port = port
         self.address = f"{host}:{port}"
@@ -119,9 +126,48 @@ class MasterServer:
         svc.unary("RaftAppendEntries")(self._grpc_raft_append_entries)
         self._grpc_server = await serve(grpc_address(self.address), svc)
         self.raft.start()
+        if self.maintenance_scripts.strip():
+            self._maintenance_task = asyncio.ensure_future(
+                self._maintenance_loop()
+            )
+
+    async def _maintenance_loop(self) -> None:
+        """Leader-only periodic admin scripts (ref: master_server.go:191-246
+        startAdminScripts — [master.maintenance] scripts run through the
+        same shell command table on a timer; lock/unlock are auto-wrapped
+        when the script doesn't manage the lease itself)."""
+        from ..shell import CommandEnv, run_command
+        from ..util import log
+
+        lines = [
+            part.strip()
+            for line in self.maintenance_scripts.splitlines()
+            for part in line.split(";")
+            if part.strip()
+        ]
+        if not any(line.split()[0] == "lock" for line in lines):
+            lines = ["lock"] + lines + ["unlock"]
+        while not self._shutdown:
+            await asyncio.sleep(self.maintenance_sleep_minutes * 60)
+            if not self.is_leader or self._shutdown:
+                continue
+            env = CommandEnv(self.address, filer=self.maintenance_filer)
+            for line in lines:
+                try:
+                    out = await run_command(env, line)
+                    log.info("maintenance %r: %s", line, out)
+                except Exception as e:
+                    log.info("maintenance %r failed: %s", line, e)
+            await env.release_lock()
 
     async def stop(self) -> None:
         self._shutdown = True
+        if self._maintenance_task is not None:
+            self._maintenance_task.cancel()
+            try:
+                await self._maintenance_task
+            except (asyncio.CancelledError, Exception):
+                pass
         await self.raft.stop()
         if self._grpc_server is not None:
             await self._grpc_server.stop(0.5)

@@ -61,9 +61,17 @@ class VolumeServer(EcHandlers):
         jwt_signing_key: str = "",
         needle_map_kind: str = "memory",
         pprof: bool = False,
+        white_list: tuple = (),
     ):
         self.jwt_signing_key = jwt_signing_key
         self.pprof = pprof
+        from ..util.security import Guard
+
+        # one guard for writes/deletes (ref guard.go wraps the public mux's
+        # Post/Delete handlers, volume_server.go:74-90)
+        self.guard = Guard(
+            white_list=tuple(white_list), signing_key=jwt_signing_key
+        )
         # seed master list with failover + leader-hint following
         # (ref volume_grpc_client_to_master.go:35-57)
         self.masters = [master] if isinstance(master, str) else list(master)
@@ -621,11 +629,15 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
     async def _handle_write(self, request: web.Request) -> web.Response:
         fid, _, _ = self._parse_fid_path(request.path)
         vid = fid.volume_id
+        # replica fan-out traffic is exempt, mirroring the reference where
+        # the guard wraps only the PUBLIC mux and replication rides the
+        # unguarded admin port (volume_server.go:74-90)
+        if request.query.get("type") != "replicate" and not self.guard.check_whitelist(
+            request.remote or ""
+        ):
+            return web.json_response({"error": "forbidden"}, status=403)
         if self.jwt_signing_key:
-            from ..util.security import Guard
-
-            guard = Guard(signing_key=self.jwt_signing_key)
-            if not guard.check_jwt(
+            if not self.guard.check_jwt(
                 request.headers.get("Authorization", ""),
                 request.path.lstrip("/").split("/")[0],
             ):
@@ -673,6 +685,10 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
         fid, _, _ = self._parse_fid_path(request.path)
         vid = fid.volume_id
         is_replicate = request.query.get("type") == "replicate"
+        if not is_replicate and not self.guard.check_whitelist(
+            request.remote or ""
+        ):
+            return web.json_response({"error": "forbidden"}, status=403)
 
         if self.store.has_volume(vid):
             n = Needle(id=fid.key, cookie=fid.cookie)

@@ -80,10 +80,45 @@ class Guard:
     def is_active(self) -> bool:
         return bool(self.white_list or self.signing_key)
 
+    def _parsed_whitelist(self):
+        """(exact_ips, networks) parsed once — check_whitelist runs on the
+        hot write path."""
+        cached = getattr(self, "_whitelist_cache", None)
+        if cached is not None and cached[0] == self.white_list:
+            return cached[1]
+        import ipaddress
+
+        exact = set()
+        networks = []
+        for entry in self.white_list:
+            if "/" in entry:
+                try:
+                    networks.append(ipaddress.ip_network(entry, strict=False))
+                except ValueError:
+                    continue
+            else:
+                exact.add(entry)
+        object.__setattr__(
+            self, "_whitelist_cache", (self.white_list, (exact, networks))
+        )
+        return exact, networks
+
     def check_whitelist(self, peer_ip: str) -> bool:
+        """Exact IPs and CIDR networks (ref guard.go checkWhiteList)."""
         if not self.white_list:
             return True
-        return peer_ip in self.white_list
+        exact, networks = self._parsed_whitelist()
+        if peer_ip in exact:
+            return True
+        if not networks:
+            return False
+        import ipaddress
+
+        try:
+            ip = ipaddress.ip_address(peer_ip)
+        except ValueError:
+            return False
+        return any(ip in net for net in networks)
 
     def check_jwt(self, auth_header: str, fid: str) -> bool:
         if not self.signing_key:

@@ -1,0 +1,55 @@
+"""Leader-only maintenance-script runner (ref: weed/server/
+master_server.go:191-246 startAdminScripts)."""
+
+import asyncio
+
+from test_cluster import free_port_pair
+
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.filer import FilerServer
+from seaweedfs_tpu.server.volume import VolumeServer
+
+
+def test_maintenance_scripts_run_on_leader(tmp_path):
+    async def body():
+        mport = free_port_pair()
+        ms = MasterServer(
+            port=mport,
+            pulse_seconds=0.2,
+            # no explicit lock/unlock: the runner auto-wraps the script
+            maintenance_scripts="bucket.list\nbucket.create -name auto",
+            maintenance_sleep_minutes=0.005,  # ~0.3s ticks
+        )
+        d = tmp_path / "vol"
+        d.mkdir()
+        vs = VolumeServer(
+            master=ms.address,
+            directories=[str(d)],
+            port=free_port_pair(),
+            pulse_seconds=0.2,
+        )
+        fs = FilerServer(master=ms.address, port=free_port_pair())
+        ms.maintenance_filer = fs.address
+        await ms.start()
+        await vs.start()
+        await fs.start()
+        try:
+            # the runner fires on its timer and creates the bucket
+            for _ in range(100):
+                if fs.filer.find_entry("/buckets/auto") is not None:
+                    break
+                await asyncio.sleep(0.1)
+            assert fs.filer.find_entry("/buckets/auto") is not None
+
+            # the auto-wrapped unlock released the admin lease
+            for _ in range(50):
+                if ms._admin_token is None:
+                    break
+                await asyncio.sleep(0.1)
+            assert ms._admin_token is None
+        finally:
+            await fs.stop()
+            await vs.stop()
+            await ms.stop()
+
+    asyncio.run(body())

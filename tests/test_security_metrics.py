@@ -67,3 +67,62 @@ def test_metrics_render():
     assert 'test_seconds_bucket{le="1.0"} 2' in text
     assert 'test_seconds_bucket{le="+Inf"} 3' in text
     assert "test_seconds_count 3" in text
+
+
+def test_guard_whitelist_cidr():
+    from seaweedfs_tpu.util.security import Guard
+
+    g = Guard(white_list=("10.0.0.7", "192.168.0.0/24"))
+    assert g.check_whitelist("10.0.0.7")
+    assert g.check_whitelist("192.168.0.250")
+    assert not g.check_whitelist("10.0.0.8")
+    assert not g.check_whitelist("not-an-ip")
+    assert Guard().check_whitelist("1.2.3.4")  # empty list allows everyone
+
+
+def test_volume_server_whitelist(tmp_path):
+    import asyncio
+
+    import aiohttp
+
+    from test_cluster import Cluster, free_port_pair
+    from seaweedfs_tpu.client import assign
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=0)
+        await cluster.start()
+        d = tmp_path / "wl"
+        d.mkdir()
+        vs = VolumeServer(
+            master=cluster.master.address,
+            directories=[str(d)],
+            port=free_port_pair(),
+            pulse_seconds=0.2,
+            white_list=("10.9.9.9",),  # local client is NOT allowed
+        )
+        await vs.start()
+        cluster.volume_servers.append(vs)
+        for _ in range(100):
+            if cluster.master.topo.data_nodes():
+                break
+            await asyncio.sleep(0.1)
+        try:
+            ar = await assign(cluster.master.address)
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    f"http://{ar.url}/{ar.fid}", data=b"x"
+                ) as resp:
+                    assert resp.status == 403
+                # reads stay public (ref guard wraps only writes/deletes)
+                async with session.get(f"http://{ar.url}/{ar.fid}") as resp:
+                    assert resp.status == 404  # not forbidden
+
+                vs.guard.white_list = ("127.0.0.1",)
+                from seaweedfs_tpu.client.operation import upload_data
+
+                await upload_data(session, ar.url, ar.fid, b"allowed")
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
