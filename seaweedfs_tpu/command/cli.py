@@ -835,6 +835,101 @@ def cmd_mount(argv: list[str]) -> int:
     return 1
 
 
+def cmd_filer_replicate(argv: list[str]) -> int:
+    """Continuously replicate one filer's changes into another cluster
+    (ref command/filer_replication.go): subscribes to the source filer's
+    SubscribeMetadata stream and applies each event to a filer-HTTP or
+    V4-signed S3 sink."""
+    p = argparse.ArgumentParser(prog="weed-tpu filer.replicate")
+    p.add_argument("-filer", default="localhost:8888", help="source filer")
+    p.add_argument("-pathPrefix", default="/")
+    p.add_argument("-targetFiler", default="", help="destination filer host:port")
+    p.add_argument("-targetS3", default="", help="destination S3 endpoint host:port")
+    p.add_argument("-s3Bucket", default="")
+    p.add_argument("-s3AccessKey", default="")
+    p.add_argument("-s3SecretKey", default="")
+    p.add_argument("-s3Region", default="us-east-1")
+    p.add_argument(
+        "-timeAgoSeconds",
+        type=float,
+        default=0,
+        help="replay events starting this many seconds ago (0 = from now)",
+    )
+    args = p.parse_args(argv)
+    if not args.targetFiler and not args.targetS3:
+        p.error("need -targetFiler or -targetS3")
+
+    async def run() -> None:
+        import time as _time
+
+        from ..pb import grpc_address
+        from ..pb.rpc import Stub
+        from ..replication import FilerHttpSink, S3Sink
+
+        if args.targetS3:
+            sink = S3Sink(
+                source_filer=args.filer,
+                endpoint=args.targetS3,
+                bucket=args.s3Bucket,
+                access_key=args.s3AccessKey,
+                secret_key=args.s3SecretKey,
+                region=args.s3Region,
+            )
+        else:
+            sink = FilerHttpSink(args.filer, args.targetFiler)
+        since_ns = (
+            int((_time.time() - args.timeAgoSeconds) * 1e9)
+            if args.timeAgoSeconds
+            else -1
+        )
+        try:
+            # reconnect forever: a filer restart must not kill the daemon
+            # (ref filer_replication.go's indefinite retry loop)
+            while True:
+                stub = Stub(grpc_address(args.filer), "filer")
+                try:
+                    async for msg in stub.server_stream(
+                        "SubscribeMetadata",
+                        {
+                            "client_name": "filer.replicate",
+                            "path_prefix": args.pathPrefix,
+                            "since_ns": since_ns,
+                        },
+                    ):
+                        if msg.get("ts_ns"):
+                            since_ns = int(msg["ts_ns"])
+                        notif = msg.get("event_notification") or {}
+                        event_type = notif.get("event_type", "")
+                        new, old = notif.get("new_entry"), notif.get("old_entry")
+                        target = new or old
+                        if not target:
+                            continue
+                        path = target["full_path"]
+                        entry = new
+                        if event_type == "rename" and old and new:
+                            entry = dict(new)
+                            entry["_old_path"] = old["full_path"]
+                        try:
+                            await sink.apply(event_type, path, entry)
+                            print(f"replicated {event_type} {path}", flush=True)
+                        except Exception as e:
+                            print(
+                                f"replicate {event_type} {path} failed: {e}",
+                                flush=True,
+                            )
+                except Exception as e:
+                    print(f"subscribe lost ({e}); reconnecting", flush=True)
+                await asyncio.sleep(1.0)
+        finally:
+            await sink.close()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_watch(argv: list[str]) -> int:
     """Follow recent metadata changes on a filer (ref command/watch.go)."""
     p = argparse.ArgumentParser(prog="weed-tpu watch")
@@ -905,6 +1000,7 @@ COMMANDS = {
     "scaffold": cmd_scaffold,
     "mount": cmd_mount,
     "watch": cmd_watch,
+    "filer.replicate": cmd_filer_replicate,
     "version": cmd_version,
 }
 

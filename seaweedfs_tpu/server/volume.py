@@ -529,8 +529,16 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
                     target = self.address
                 else:
                     continue
+                headers = {}
+                if self.jwt_signing_key:
+                    # the cascade is server-initiated: sign its own token
+                    from ..util.security import gen_jwt
+
+                    headers["Authorization"] = "Bearer " + gen_jwt(
+                        self.jwt_signing_key, 10, c["fid"]
+                    )
                 async with self._http_client.delete(
-                    f"http://{target}/{c['fid']}"
+                    f"http://{target}/{c['fid']}", headers=headers
                 ):
                     pass
             except Exception:
@@ -626,22 +634,30 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
             return b"", "", ""
         return await request.read(), "", content_type
 
-    async def _handle_write(self, request: web.Request) -> web.Response:
-        fid, _, _ = self._parse_fid_path(request.path)
-        vid = fid.volume_id
-        # replica fan-out traffic is exempt, mirroring the reference where
-        # the guard wraps only the PUBLIC mux and replication rides the
-        # unguarded admin port (volume_server.go:74-90)
-        if request.query.get("type") != "replicate" and not self.guard.check_whitelist(
-            request.remote or ""
-        ):
-            return web.json_response({"error": "forbidden"}, status=403)
+    async def _check_write_auth(self, request: web.Request):
+        """Whitelist + JWT gate shared by writes and deletes; replicate
+        traffic from registered cluster peers bypasses the whitelist (the
+        reference puts replication on a separate admin mux) but never the
+        JWT check — the primary forwards the client's token."""
+        remote = request.remote or ""
+        if not self.guard.check_whitelist(remote):
+            is_replicate = request.query.get("type") == "replicate"
+            if not (is_replicate and await self._is_cluster_member(remote)):
+                return web.json_response({"error": "forbidden"}, status=403)
         if self.jwt_signing_key:
             if not self.guard.check_jwt(
                 request.headers.get("Authorization", ""),
                 request.path.lstrip("/").split("/")[0],
             ):
                 return web.json_response({"error": "unauthorized"}, status=401)
+        return None
+
+    async def _handle_write(self, request: web.Request) -> web.Response:
+        fid, _, _ = self._parse_fid_path(request.path)
+        vid = fid.volume_id
+        denied = await self._check_write_auth(request)
+        if denied is not None:
+            return denied
         if not self.store.has_volume(vid):
             return web.json_response({"error": f"volume {vid} not found"}, status=404)
 
@@ -685,10 +701,9 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
         fid, _, _ = self._parse_fid_path(request.path)
         vid = fid.volume_id
         is_replicate = request.query.get("type") == "replicate"
-        if not is_replicate and not self.guard.check_whitelist(
-            request.remote or ""
-        ):
-            return web.json_response({"error": "forbidden"}, status=403)
+        denied = await self._check_write_auth(request)
+        if denied is not None:
+            return denied
 
         if self.store.has_volume(vid):
             n = Needle(id=fid.key, cookie=fid.cookie)
@@ -725,6 +740,32 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
             return web.json_response({"size": size}, status=202)
         return web.json_response({"error": "volume not found"}, status=404)
 
+    async def _is_cluster_member(self, ip: str) -> bool:
+        """True when ip belongs to a registered volume server — replicate
+        traffic is only exempt from the whitelist for actual cluster peers
+        (the reference puts replication on a separate admin port; sharing
+        one port here means ?type=replicate must not be a free bypass)."""
+        import time as _time
+
+        now = _time.monotonic()
+        cache = getattr(self, "_member_ips", None)
+        if cache is None or now - cache[0] > 10.0:
+            ips: set[str] = set()
+            try:
+                stub = Stub(grpc_address(self.master), "master")
+                resp = await stub.call("VolumeList", {})
+                for dc in resp.get("topology_info", {}).get("data_centers", []):
+                    for rack in dc.get("racks", []):
+                        for dn in rack.get("data_nodes", []):
+                            ips.add(dn.get("url", "").rsplit(":", 1)[0])
+            except Exception:
+                if cache is not None:
+                    return ip in cache[1]
+                return False
+            cache = (now, ips)
+            self._member_ips = cache
+        return ip in cache[1]
+
     # ---------------- replication (ref store_replicate.go:20-121) ----------------
     async def _lookup_volume(self, vid: int) -> list[str]:
         try:
@@ -751,6 +792,12 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
             return f"replicating to {len(others)} replicas, need more"
         errs = []
 
+        # forward the client's JWT so replicas can run the same auth check
+        headers = {}
+        auth = request.headers.get("Authorization", "")
+        if auth:
+            headers["Authorization"] = auth
+
         async def one(url: str) -> None:
             target = f"http://{url}{request.path}?type=replicate"
             q = {k: v for k, v in request.query.items() if k != "type"}
@@ -760,11 +807,15 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
                 if method == "POST":
                     form = aiohttp.FormData()
                     form.add_field("file", body, filename="replica")
-                    async with self._http_client.post(target, data=form) as resp:
+                    async with self._http_client.post(
+                        target, data=form, headers=headers
+                    ) as resp:
                         if resp.status >= 300:
                             errs.append(f"{url}: status {resp.status}")
                 else:
-                    async with self._http_client.delete(target) as resp:
+                    async with self._http_client.delete(
+                        target, headers=headers
+                    ) as resp:
                         if resp.status >= 400 and resp.status != 404:
                             errs.append(f"{url}: status {resp.status}")
             except Exception as e:

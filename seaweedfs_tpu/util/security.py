@@ -98,9 +98,7 @@ class Guard:
                     continue
             else:
                 exact.add(entry)
-        object.__setattr__(
-            self, "_whitelist_cache", (self.white_list, (exact, networks))
-        )
+        self._whitelist_cache = (self.white_list, (exact, networks))
         return exact, networks
 
     def check_whitelist(self, peer_ip: str) -> bool:

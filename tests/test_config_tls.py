@@ -8,6 +8,8 @@ import sys
 import time
 import urllib.request
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 import pytest
 
 from test_cluster import free_port_pair
@@ -76,7 +78,7 @@ volumePort = {vport}
         ],
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
-        cwd="/root/repo",
+        cwd=REPO_ROOT,
     )
     try:
         deadline = time.time() + 30

@@ -1,0 +1,84 @@
+"""`weed-tpu filer.replicate`: continuous cross-cluster replication driven
+by the SubscribeMetadata stream (ref: weed/command/filer_replication.go)."""
+
+import asyncio
+import os
+import subprocess
+import sys
+
+import aiohttp
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from test_cluster import Cluster, free_port_pair
+
+from seaweedfs_tpu.server.filer import FilerServer
+
+
+def test_filer_replicate_command(tmp_path):
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=2)
+        await cluster.start()
+        src = FilerServer(master=cluster.master.address, port=free_port_pair())
+        dst = FilerServer(master=cluster.master.address, port=free_port_pair())
+        await src.start()
+        await dst.start()
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "seaweedfs_tpu",
+                "filer.replicate",
+                "-filer",
+                src.address,
+                "-targetFiler",
+                dst.address,
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            cwd=REPO_ROOT,
+        )
+        try:
+            await src.master_client.wait_connected()
+            await dst.master_client.wait_connected()
+            await asyncio.sleep(1.0)  # let the subscriber attach
+            async with aiohttp.ClientSession() as session:
+                payload = b"replicate me across clusters"
+                async with session.put(
+                    f"http://{src.address}/docs/x.bin", data=payload
+                ) as r:
+                    assert r.status == 201
+
+                got = None
+                for _ in range(100):
+                    async with session.get(
+                        f"http://{dst.address}/docs/x.bin"
+                    ) as r:
+                        if r.status == 200:
+                            got = await r.read()
+                            break
+                    await asyncio.sleep(0.2)
+                assert got == payload
+
+                # deletes follow too
+                async with session.delete(
+                    f"http://{src.address}/docs/x.bin"
+                ) as r:
+                    assert r.status == 204
+                for _ in range(100):
+                    async with session.get(
+                        f"http://{dst.address}/docs/x.bin"
+                    ) as r:
+                        if r.status == 404:
+                            break
+                    await asyncio.sleep(0.2)
+                else:
+                    raise AssertionError("delete never replicated")
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+            await src.stop()
+            await dst.stop()
+            await cluster.stop()
+
+    asyncio.run(body())

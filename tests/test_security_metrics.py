@@ -118,6 +118,11 @@ def test_volume_server_whitelist(tmp_path):
                 async with session.get(f"http://{ar.url}/{ar.fid}") as resp:
                     assert resp.status == 404  # not forbidden
 
+                # ?type=replicate is only exempt for registered cluster
+                # peers, not arbitrary callers
+                assert await vs._is_cluster_member("127.0.0.1")
+                assert not await vs._is_cluster_member("10.66.66.66")
+
                 vs.guard.white_list = ("127.0.0.1",)
                 from seaweedfs_tpu.client.operation import upload_data
 
