@@ -20,6 +20,7 @@ class AssignResult:
     url: str
     public_url: str
     count: int
+    auth: str = ""  # fid-scoped upload JWT when the master signs (jwt.go)
 
 
 async def assign(
@@ -48,6 +49,7 @@ async def assign(
         url=resp["url"],
         public_url=resp.get("publicUrl", resp["url"]),
         count=int(resp.get("count", count)),
+        auth=resp.get("auth", ""),
     )
 
 
@@ -60,6 +62,7 @@ async def upload_data(
     mime: str = "",
     ttl: str = "",
     params: Optional[dict] = None,
+    jwt: str = "",
 ) -> dict:
     target = f"http://{url}/{fid}"
     query = dict(params or {})
@@ -67,11 +70,12 @@ async def upload_data(
         query["ttl"] = ttl
     if query:
         target += "?" + "&".join(f"{k}={v}" for k, v in query.items())
+    headers = {"Authorization": f"Bearer {jwt}"} if jwt else {}
     form = aiohttp.FormData()
     form.add_field(
         "file", data, filename=filename or "file", content_type=mime or None
     )
-    async with session.post(target, data=form) as resp:
+    async with session.post(target, data=form, headers=headers) as resp:
         body = await resp.json()
         if resp.status >= 300 or body.get("error"):
             raise RuntimeError(f"upload {fid}: {resp.status} {body.get('error')}")
@@ -89,9 +93,10 @@ async def read_url(session: aiohttp.ClientSession, full_url: str) -> bytes:
 
 
 async def delete_file(
-    session: aiohttp.ClientSession, url: str, fid: str
+    session: aiohttp.ClientSession, url: str, fid: str, jwt: str = ""
 ) -> dict:
-    async with session.delete(f"http://{url}/{fid}") as resp:
+    headers = {"Authorization": f"Bearer {jwt}"} if jwt else {}
+    async with session.delete(f"http://{url}/{fid}", headers=headers) as resp:
         return await resp.json()
 
 
@@ -122,8 +127,11 @@ async def bulk_lookup(server: str, vid: int, keys) -> tuple:
     )
     if resp.get("error"):
         raise RuntimeError(f"bulk_lookup: {resp['error']}")
+    off_dtype = resp.get("offset_dtype", "<u4")
     return (
-        np.frombuffer(resp["offsets"], dtype="<u4").astype(np.uint32),
+        np.frombuffer(resp["offsets"], dtype=off_dtype).astype(
+            np.uint64 if off_dtype == "<u8" else np.uint32
+        ),
         np.frombuffer(resp["sizes"], dtype="<u4").astype(np.uint32),
         np.frombuffer(resp["found"], dtype=np.uint8).astype(bool),
     )
@@ -170,11 +178,19 @@ async def submit_file(
     )
     if chunk_size <= 0 or len(data) <= chunk_size:
         result = await upload_data(
-            session, ar.url, ar.fid, data, filename=filename, mime=mime, ttl=ttl
+            session,
+            ar.url,
+            ar.fid,
+            data,
+            filename=filename,
+            mime=mime,
+            ttl=ttl,
+            jwt=ar.auth,
         )
         return ar.fid, result
 
     chunks = []
+    chunk_auths: dict[str, str] = {}
     try:
         for i in range(0, -(-len(data) // chunk_size)):
             part = data[i * chunk_size : (i + 1) * chunk_size]
@@ -188,10 +204,12 @@ async def submit_file(
                 part,
                 filename=f"{filename or 'file'}-{i + 1}",
                 ttl=ttl,
+                jwt=car.auth,
             )
             chunks.append(
                 {"fid": car.fid, "offset": i * chunk_size, "size": len(part)}
             )
+            chunk_auths[car.fid] = car.auth
         import json as _json
 
         manifest = {
@@ -208,6 +226,7 @@ async def submit_file(
             filename=filename,
             ttl=ttl,
             params={"cm": "true"},
+            jwt=ar.auth,
         )
         result["size"] = len(data)
         return ar.fid, result
@@ -219,7 +238,9 @@ async def submit_file(
                 vid = int(c["fid"].split(",")[0])
                 locs = await lookup(master, vid)
                 if locs:
-                    await delete_file(session, locs[0], c["fid"])
+                    await delete_file(
+                        session, locs[0], c["fid"], jwt=chunk_auths.get(c["fid"], "")
+                    )
             except Exception:
                 pass
         raise

@@ -127,6 +127,7 @@ async def run_benchmark(
                                 ar.url,
                                 ar.fid,
                                 fake_payload(i, file_size),
+                                jwt=ar.auth,
                             )
                             stats.record(time.perf_counter() - t0, file_size)
                             fids.append(ar.fid)

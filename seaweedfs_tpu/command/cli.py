@@ -332,7 +332,10 @@ def cmd_server(argv: list[str]) -> int:
         from ..server.filer import FilerServer
 
         fs = FilerServer(
-            master=f"{args.ip}:{args.port}", host=args.ip, port=args.filerPort
+            master=f"{args.ip}:{args.port}",
+            host=args.ip,
+            port=args.filerPort,
+            jwt_signing_key=args.jwtSigningKey,
         )
         servers.append(fs)
         desc += f", filer on {args.ip}:{args.filerPort}"
@@ -368,6 +371,8 @@ def cmd_filer(argv: list[str]) -> int:
     p.add_argument("-maxMB", type=int, default=4, help="chunk size in MB")
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")
+    p.add_argument("-jwtSigningKey", default="")
+    _apply_config_defaults(p, argv, ["filer", "security"])
     args = p.parse_args(argv)
     from ..server.filer import FilerServer
 
@@ -379,6 +384,7 @@ def cmd_filer(argv: list[str]) -> int:
         chunk_size=args.maxMB * 1024 * 1024,
         collection=args.collection,
         replication=args.replication,
+        jwt_signing_key=args.jwtSigningKey,
     )
     print(f"filer listening on {args.ip}:{args.port}")
     asyncio.run(_run_forever(fs))
@@ -896,27 +902,37 @@ def cmd_filer_replicate(argv: list[str]) -> int:
                             "since_ns": since_ns,
                         },
                     ):
-                        if msg.get("ts_ns"):
-                            since_ns = int(msg["ts_ns"])
                         notif = msg.get("event_notification") or {}
                         event_type = notif.get("event_type", "")
                         new, old = notif.get("new_entry"), notif.get("old_entry")
                         target = new or old
-                        if not target:
-                            continue
-                        path = target["full_path"]
-                        entry = new
-                        if event_type == "rename" and old and new:
-                            entry = dict(new)
-                            entry["_old_path"] = old["full_path"]
-                        try:
-                            await sink.apply(event_type, path, entry)
-                            print(f"replicated {event_type} {path}", flush=True)
-                        except Exception as e:
-                            print(
-                                f"replicate {event_type} {path} failed: {e}",
-                                flush=True,
-                            )
+                        if target:
+                            path = target["full_path"]
+                            entry = new
+                            if event_type == "rename" and old and new:
+                                entry = dict(new)
+                                entry["_old_path"] = old["full_path"]
+                            # retry until the sink accepts the event; only
+                            # then advance the resume point — a transient
+                            # target outage must not drop events (ref
+                            # filer_replication.go's retry loop)
+                            while True:
+                                try:
+                                    await sink.apply(event_type, path, entry)
+                                    print(
+                                        f"replicated {event_type} {path}",
+                                        flush=True,
+                                    )
+                                    break
+                                except Exception as e:
+                                    print(
+                                        f"replicate {event_type} {path}"
+                                        f" failed ({e}); retrying",
+                                        flush=True,
+                                    )
+                                    await asyncio.sleep(1.0)
+                        if msg.get("ts_ns"):
+                            since_ns = int(msg["ts_ns"])
                 except Exception as e:
                     print(f"subscribe lost ({e}); reconnecting", flush=True)
                 await asyncio.sleep(1.0)

@@ -293,7 +293,9 @@ class WFS:
         fid, url = resp["file_id"], resp["url"]
         from ..client.operation import upload_data
 
-        result = await upload_data(self._http, url, fid, data)
+        result = await upload_data(
+            self._http, url, fid, data, jwt=resp.get("auth", "")
+        )
         self.chunk_cache.set(fid, data)
         import zlib
 

@@ -45,6 +45,7 @@ class FilerServer:
         chunk_size: int = 4 * 1024 * 1024,
         collection: str = "",
         replication: str = "",
+        jwt_signing_key: str = "",
     ):
         self.master = master
         self.host = host
@@ -53,6 +54,9 @@ class FilerServer:
         self.chunk_size = chunk_size
         self.collection = collection
         self.replication = replication
+        # shared cluster key: chunk uploads carry the master-issued token,
+        # and the GC deleter signs its own (ref security.toml jwt signing)
+        self.jwt_signing_key = jwt_signing_key
         if not store_path:
             store = MemoryFilerStore()
         elif store_path.endswith(".flog"):
@@ -120,7 +124,14 @@ class FilerServer:
             fid = await self._deletion_queue.get()
             try:
                 url = await self.master_client.lookup_file_id_async(fid)
-                async with self._session.delete(url) as resp:
+                headers = {}
+                if self.jwt_signing_key:
+                    from ..util.security import gen_jwt
+
+                    headers["Authorization"] = "Bearer " + gen_jwt(
+                        self.jwt_signing_key, 10, fid
+                    )
+                async with self._session.delete(url, headers=headers) as resp:
                     await resp.read()
             except Exception:
                 pass
@@ -144,7 +155,9 @@ class FilerServer:
                 replication=self.replication,
                 ttl=ttl,
             )
-            result = await upload_data(self._session, ar.url, ar.fid, piece, ttl=ttl)
+            result = await upload_data(
+                self._session, ar.url, ar.fid, piece, ttl=ttl, jwt=ar.auth
+            )
             chunks.append(
                 FileChunk(
                     fid=ar.fid,
@@ -317,6 +330,7 @@ class FilerServer:
                 "url": ar.url,
                 "public_url": ar.public_url,
                 "count": ar.count,
+                "auth": ar.auth,  # ref AssignVolumeResponse.Auth
             }
         except Exception as e:
             return {"error": str(e)}

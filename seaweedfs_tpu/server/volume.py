@@ -750,18 +750,30 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
         now = _time.monotonic()
         cache = getattr(self, "_member_ips", None)
         if cache is None or now - cache[0] > 10.0:
-            ips: set[str] = set()
+            hosts: set[str] = set()
             try:
                 stub = Stub(grpc_address(self.master), "master")
                 resp = await stub.call("VolumeList", {})
                 for dc in resp.get("topology_info", {}).get("data_centers", []):
                     for rack in dc.get("racks", []):
                         for dn in rack.get("data_nodes", []):
-                            ips.add(dn.get("url", "").rsplit(":", 1)[0])
+                            hosts.add(dn.get("url", "").rsplit(":", 1)[0])
             except Exception:
                 if cache is not None:
                     return ip in cache[1]
                 return False
+            # registered hosts may be DNS names or other-interface
+            # addresses — resolve them (off the event loop) so the TCP
+            # source IP matches
+            ips: set[str] = set()
+            loop = asyncio.get_event_loop()
+            for host in hosts:
+                ips.add(host)
+                try:
+                    for info in await loop.getaddrinfo(host, None):
+                        ips.add(info[4][0])
+                except OSError:
+                    pass
             cache = (now, ips)
             self._member_ips = cache
         return ip in cache[1]
@@ -939,8 +951,11 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
             offsets, sizes, found = await loop.run_in_executor(
                 None, ev.bulk_locate, keys
             )
+        # 5-byte-offset volumes need u64 columns on the wire
+        off_dtype = "<u8" if offsets.dtype.itemsize > 4 else "<u4"
         return {
-            "offsets": np.ascontiguousarray(offsets, dtype="<u4").tobytes(),
+            "offsets": np.ascontiguousarray(offsets, dtype=off_dtype).tobytes(),
+            "offset_dtype": off_dtype,
             "sizes": np.ascontiguousarray(sizes, dtype="<u4").tobytes(),
             "found": np.ascontiguousarray(found, dtype=np.uint8).tobytes(),
         }

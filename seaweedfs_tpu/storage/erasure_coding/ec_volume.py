@@ -151,10 +151,12 @@ def search_needle_from_sorted_index(
 def mark_needle_deleted(f, entry_offset: int) -> None:
     """Tombstone the size field of an .ecx entry in place
     (ref MarkNeedleDeleted, ec_volume_delete.go:13-25)."""
+    from ...types import OFFSET_SIZE
+
     os.pwrite(
         f.fileno(),
         u32_to_bytes(TOMBSTONE_FILE_SIZE),
-        entry_offset + NEEDLE_ID_SIZE + 4,  # key + offset come first
+        entry_offset + NEEDLE_ID_SIZE + OFFSET_SIZE,  # key + offset come first
     )
 
 
@@ -244,18 +246,13 @@ class EcVolume:
         """Live .ecx entries as sorted numpy columns
         (keys u64[n], offset_units u32[n], sizes u32[n]) — the probe table
         for the bulk-lookup kernel. Tombstoned entries are excluded."""
-        import numpy as np
+        from ..idx import parse_index_bytes
 
-        raw = np.frombuffer(
-            os.pread(self._ecx.fileno(), self.ecx_file_size, 0),
-            dtype=np.dtype([("key", ">u8"), ("offset", ">u4"), ("size", ">u4")]),
+        keys, offsets, sizes = parse_index_bytes(
+            os.pread(self._ecx.fileno(), self.ecx_file_size, 0)
         )
-        live = raw["size"] != TOMBSTONE_FILE_SIZE
-        return (
-            raw["key"][live].astype(np.uint64),
-            raw["offset"][live].astype(np.uint32),
-            raw["size"][live].astype(np.uint32),
-        )
+        live = sizes != TOMBSTONE_FILE_SIZE
+        return keys[live], offsets[live], sizes[live]
 
     def bulk_locate(self, needle_ids, use_device: Optional[bool] = None):
         """Batched .ecx probes -> (offset_units u32[P], sizes u32[P],
@@ -269,12 +266,21 @@ class EcVolume:
 
         needle_ids = np.asarray(needle_ids, dtype=np.uint64)
         if use_device is None:
-            # tiny batches aren't worth a device dispatch / first-use compile
+            # tiny batches aren't worth a device dispatch / first-use
+            # compile; 5-byte offsets exceed the kernel's u32 columns
+            from ...types import OFFSET_SIZE
             from ..volume import _device_available
 
-            use_device = len(needle_ids) >= 64 and _device_available()
+            use_device = (
+                OFFSET_SIZE == 4
+                and len(needle_ids) >= 64
+                and _device_available()
+            )
         if not use_device:
-            offsets = np.zeros(len(needle_ids), dtype=np.uint32)
+            from ...types import OFFSET_SIZE
+
+            off_dtype = np.uint64 if OFFSET_SIZE == 5 else np.uint32
+            offsets = np.zeros(len(needle_ids), dtype=off_dtype)
             sizes = np.zeros(len(needle_ids), dtype=np.uint32)
             found = np.zeros(len(needle_ids), dtype=bool)
             for i, k in enumerate(needle_ids):

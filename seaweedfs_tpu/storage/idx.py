@@ -15,6 +15,8 @@ import numpy as np
 
 from ..types import (
     NEEDLE_MAP_ENTRY_SIZE,
+    OFFSET_SIZE,
+    bytes_to_offset,
     bytes_to_u32,
     bytes_to_u64,
     offset_to_bytes,
@@ -31,7 +33,11 @@ def entry_to_bytes(key: int, offset_units: int, size: int) -> bytes:
 
 def parse_entry(b: bytes) -> tuple[int, int, int]:
     """-> (key, offset_units, size)"""
-    return bytes_to_u64(b[0:8]), bytes_to_u32(b[8:12]), bytes_to_u32(b[12:16])
+    return (
+        bytes_to_u64(b[0:8]),
+        bytes_to_offset(b[8 : 8 + OFFSET_SIZE]),
+        bytes_to_u32(b[8 + OFFSET_SIZE : NEEDLE_MAP_ENTRY_SIZE]),
+    )
 
 
 def iter_index(f: BinaryIO) -> Iterator[tuple[int, int, int]]:
@@ -56,14 +62,27 @@ def walk_index_file(
 
 
 def parse_index_bytes(data: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Vectorized parse: -> (keys u64[n], offset_units u32[n], sizes u32[n])."""
+    """Vectorized parse: -> (keys u64[n], offset_units u32|u64[n], sizes
+    u32[n]); offsets widen to u64 under the 5-byte variant."""
     n = len(data) // NEEDLE_MAP_ENTRY_SIZE
     arr = np.frombuffer(data[: n * NEEDLE_MAP_ENTRY_SIZE], dtype=np.uint8).reshape(
         n, NEEDLE_MAP_ENTRY_SIZE
     )
     keys = arr[:, 0:8].copy().view(">u8").reshape(n).astype(np.uint64)
-    offsets = arr[:, 8:12].copy().view(">u4").reshape(n).astype(np.uint32)
-    sizes = arr[:, 12:16].copy().view(">u4").reshape(n).astype(np.uint32)
+    low = arr[:, 8:12].copy().view(">u4").reshape(n)
+    if OFFSET_SIZE == 5:
+        offsets = low.astype(np.uint64) | (
+            arr[:, 12].astype(np.uint64) << np.uint64(32)
+        )
+    else:
+        offsets = low.astype(np.uint32)
+    sizes = (
+        arr[:, 8 + OFFSET_SIZE : NEEDLE_MAP_ENTRY_SIZE]
+        .copy()
+        .view(">u4")
+        .reshape(n)
+        .astype(np.uint32)
+    )
     return keys, offsets, sizes
 
 
@@ -74,6 +93,15 @@ def entries_to_bytes(
     n = len(keys)
     arr = np.empty((n, NEEDLE_MAP_ENTRY_SIZE), dtype=np.uint8)
     arr[:, 0:8] = np.ascontiguousarray(keys, dtype=">u8").view(np.uint8).reshape(n, 8)
-    arr[:, 8:12] = np.ascontiguousarray(offset_units, dtype=">u4").view(np.uint8).reshape(n, 4)
-    arr[:, 12:16] = np.ascontiguousarray(sizes, dtype=">u4").view(np.uint8).reshape(n, 4)
+    units = np.ascontiguousarray(offset_units, dtype=np.uint64)
+    arr[:, 8:12] = (
+        np.ascontiguousarray(units & np.uint64(0xFFFFFFFF), dtype=">u4")
+        .view(np.uint8)
+        .reshape(n, 4)
+    )
+    if OFFSET_SIZE == 5:
+        arr[:, 12] = (units >> np.uint64(32)).astype(np.uint8)
+    arr[:, 8 + OFFSET_SIZE : NEEDLE_MAP_ENTRY_SIZE] = (
+        np.ascontiguousarray(sizes, dtype=">u4").view(np.uint8).reshape(n, 4)
+    )
     return arr.tobytes()

@@ -400,12 +400,23 @@ class Volume:
         snap_fn = getattr(self.nm, "snapshot", None)
         if use_device is None:
             # tiny batches aren't worth a device dispatch (or, on first
-            # use, a jit compile) — serve them from the host map
+            # use, a jit compile) — serve them from the host map. The
+            # 5-byte-offset variant stays on the host: its offset units
+            # exceed the kernel's u32 columns.
+            from ..types import OFFSET_SIZE
+
             use_device = (
-                snap_fn is not None and len(keys) >= 64 and _device_available()
+                snap_fn is not None
+                and OFFSET_SIZE == 4
+                and len(keys) >= 64
+                and _device_available()
             )
         if not use_device or snap_fn is None:
-            offsets = _np.zeros(len(keys), dtype=_np.uint32)
+            from ..types import OFFSET_SIZE
+
+            # u64 offsets under the 5-byte variant (units exceed u32)
+            off_dtype = _np.uint64 if OFFSET_SIZE == 5 else _np.uint32
+            offsets = _np.zeros(len(keys), dtype=off_dtype)
             sizes = _np.zeros(len(keys), dtype=_np.uint32)
             found = _np.zeros(len(keys), dtype=bool)
             for i, k in enumerate(keys):

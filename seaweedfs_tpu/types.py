@@ -12,6 +12,7 @@ Byte-compatible with the reference formats:
 
 from __future__ import annotations
 
+import os
 import struct
 
 # --- sizes / limits (ref: weed/storage/types/needle_types.go:24-32) ---
@@ -19,17 +20,20 @@ SIZE_SIZE = 4
 COOKIE_SIZE = 4
 NEEDLE_ID_SIZE = 8
 NEEDLE_HEADER_SIZE = COOKIE_SIZE + NEEDLE_ID_SIZE + SIZE_SIZE  # 16
-OFFSET_SIZE = 4
-NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16
+# the reference's `5BytesOffset` build tag becomes an env switch here
+# (ref: weed/storage/types/offset_5bytes.go, Makefile:20): 5-byte offsets
+# extend the max volume from 32GB to 8TB with 17-byte idx entries
+OFFSET_SIZE = 5 if os.environ.get("WEED_5BYTES_OFFSET", "") in ("1", "true") else 4
+NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16 or 17
 TIMESTAMP_SIZE = 8
 NEEDLE_PADDING_SIZE = 8
 NEEDLE_CHECKSUM_SIZE = 4
 TOMBSTONE_FILE_SIZE = 0xFFFFFFFF
 NEEDLE_ID_EMPTY = 0
 
-# 4-byte offsets * 8-byte alignment => 32GB max volume
-# (ref: weed/storage/types/offset_4bytes.go:14)
-MAX_POSSIBLE_VOLUME_SIZE = 4 * 1024 * 1024 * 1024 * 8
+# offset bytes * 8-byte alignment => 32GB (4B) / 8TB (5B) max volume
+# (ref: weed/storage/types/offset_4bytes.go:14, offset_5bytes.go:15)
+MAX_POSSIBLE_VOLUME_SIZE = (1 << (8 * OFFSET_SIZE)) * NEEDLE_PADDING_SIZE
 
 _U64 = struct.Struct(">Q")
 _U32 = struct.Struct(">I")
@@ -75,11 +79,20 @@ def to_actual_offset(offset_units: int) -> int:
 
 
 def offset_to_bytes(offset_units: int) -> bytes:
-    return _U32.pack(offset_units & 0xFFFFFFFF)
+    """On-disk offset: lower 32 bits big-endian, then (5-byte variant) the
+    high byte last (ref: offset_4bytes.go OffsetToBytes, offset_5bytes.go:18
+    — bytes[4] carries bits 32-39)."""
+    low = _U32.pack(offset_units & 0xFFFFFFFF)
+    if OFFSET_SIZE == 4:
+        return low
+    return low + bytes([(offset_units >> 32) & 0xFF])
 
 
 def bytes_to_offset(b: bytes) -> int:
-    return _U32.unpack_from(b)[0]
+    v = _U32.unpack_from(b)[0]
+    if OFFSET_SIZE == 5:
+        v |= b[4] << 32
+    return v
 
 
 # --- needle id / cookie codecs ---

@@ -1,0 +1,91 @@
+"""The 5-byte offset variant (WEED_5BYTES_OFFSET=1 — the env equivalent of
+the reference's `5BytesOffset` build tag, ref: weed/storage/types/
+offset_5bytes.go, Makefile:20): 17-byte idx entries, 8TB max volume."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCRIPT = textwrap.dedent(
+    """
+    import numpy as np
+
+    from seaweedfs_tpu import types
+    from seaweedfs_tpu.storage import idx
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    assert types.OFFSET_SIZE == 5
+    assert types.NEEDLE_MAP_ENTRY_SIZE == 17
+    assert types.MAX_POSSIBLE_VOLUME_SIZE == 8 * 1024**4  # 8TB
+
+    # offset codec roundtrips beyond the 4-byte range, high byte LAST
+    units = (3 << 32) | 0xDEADBEEF
+    b = types.offset_to_bytes(units)
+    assert len(b) == 5
+    assert b[:4] == bytes.fromhex("deadbeef") and b[4] == 3
+    assert types.bytes_to_offset(b) == units
+
+    # entry codec (scalar + vectorized) roundtrips 17-byte entries
+    e = idx.entry_to_bytes(0x1122334455667788, units, 4096)
+    assert len(e) == 17
+    assert idx.parse_entry(e) == (0x1122334455667788, units, 4096)
+    keys = np.array([1, 2], dtype=np.uint64)
+    offs = np.array([units, 7], dtype=np.uint64)
+    sizes = np.array([10, 20], dtype=np.uint32)
+    blob = idx.entries_to_bytes(keys, offs, sizes)
+    assert len(blob) == 34
+    k2, o2, s2 = idx.parse_index_bytes(blob)
+    assert list(k2) == [1, 2] and list(o2) == [units, 7] and list(s2) == [10, 20]
+
+    # a volume writes/replays/reads with 17-byte idx entries
+    import sys, tempfile
+    d = tempfile.mkdtemp()
+    v = Volume(d, "", 1)
+    for i in range(1, 6):
+        n = Needle(cookie=0x11, id=i)
+        n.data = bytes([i]) * (100 + i)
+        v.write_needle(n)
+    v.delete_needle(Needle(id=3, cookie=0x11))
+    v.close()
+
+    import os as _os
+    assert _os.path.getsize(f"{d}/1.idx") % 17 == 0
+
+    v2 = Volume(d, "", 1, create=False)
+    got = Needle(id=2)
+    v2.read_needle(got)
+    assert got.data == bytes([2]) * 102
+    missing = Needle(id=3)
+    try:
+        v2.read_needle(missing)
+        raise SystemExit("deleted needle served")
+    except Exception:
+        pass
+    offs3, sizes3, found = v2.bulk_lookup(
+        np.array([1, 2, 3, 99], dtype=np.uint64)
+    )
+    assert list(found) == [True, True, False, False]
+    v2.close()
+    print("5-byte variant OK")
+    """
+)
+
+
+def test_5byte_offset_variant_subprocess():
+    env = dict(os.environ)
+    env["WEED_5BYTES_OFFSET"] = "1"
+    env.pop("JAX_PLATFORMS", None)
+    out = subprocess.run(
+        [sys.executable, "-c", SCRIPT],
+        env=env,
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "5-byte variant OK" in out.stdout

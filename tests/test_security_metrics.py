@@ -131,3 +131,99 @@ def test_volume_server_whitelist(tmp_path):
             await cluster.stop()
 
     asyncio.run(body())
+
+
+def test_jwt_cluster_end_to_end(tmp_path):
+    """Master issues fid-scoped tokens, signed writes/deletes pass,
+    unsigned ones are rejected, and the filer (sharing the key) writes and
+    GCs chunks through the same gate."""
+    import asyncio
+
+    import aiohttp
+
+    from test_cluster import free_port_pair
+    from seaweedfs_tpu.client.operation import (
+        assign,
+        delete_file,
+        upload_data,
+    )
+    from seaweedfs_tpu.server.filer import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    KEY = "cluster-secret"
+
+    async def body():
+        ms = MasterServer(
+            port=free_port_pair(), pulse_seconds=0.2, jwt_signing_key=KEY
+        )
+        await ms.start()
+        d = tmp_path / "vol"
+        d.mkdir()
+        vs = VolumeServer(
+            master=ms.address,
+            directories=[str(d)],
+            port=free_port_pair(),
+            pulse_seconds=0.2,
+            jwt_signing_key=KEY,
+        )
+        await vs.start()
+        fs = FilerServer(
+            master=ms.address, port=free_port_pair(), jwt_signing_key=KEY
+        )
+        await fs.start()
+        try:
+            for _ in range(100):
+                if ms.topo.data_nodes():
+                    break
+                await asyncio.sleep(0.1)
+            await fs.master_client.wait_connected()
+            async with aiohttp.ClientSession() as session:
+                ar = await assign(ms.address)
+                assert ar.auth, "master did not issue a token"
+
+                # unsigned write -> 401; signed write -> 201
+                async with session.post(
+                    f"http://{ar.url}/{ar.fid}", data=b"x"
+                ) as r:
+                    assert r.status == 401
+                await upload_data(session, ar.url, ar.fid, b"secret-doc", jwt=ar.auth)
+
+                # unsigned delete -> 401; signed delete works
+                async with session.delete(f"http://{ar.url}/{ar.fid}") as r:
+                    assert r.status == 401
+                resp = await delete_file(session, ar.url, ar.fid, jwt=ar.auth)
+                assert "size" in resp
+
+                # the filer path writes chunks (with master tokens) and its
+                # GC deletes them (self-signed) through the same gate
+                async with session.put(
+                    f"http://{fs.address}/j/a.bin", data=b"filer-data"
+                ) as r:
+                    assert r.status == 201, await r.text()
+                async with session.get(f"http://{fs.address}/j/a.bin") as r:
+                    assert await r.read() == b"filer-data"
+                entry = fs.filer.find_entry("/j/a.bin")
+                chunk_fid = entry.chunks[0].fid
+                async with session.delete(f"http://{fs.address}/j/a.bin") as r:
+                    assert r.status == 204
+                # the chunk eventually 404s (GC delete was accepted)
+                from seaweedfs_tpu.client.operation import lookup
+
+                cvid = int(chunk_fid.split(",")[0])
+                locs = await lookup(ms.address, cvid)
+                for _ in range(100):
+                    async with session.get(
+                        f"http://{locs[0]}/{chunk_fid}"
+                    ) as r:
+                        if r.status == 404:
+                            break
+                    await asyncio.sleep(0.1)
+                else:
+                    raise AssertionError("chunk never GC-deleted under JWT")
+        finally:
+            await fs.stop()
+            await vs.stop()
+            await ms.stop()
+
+    asyncio.run(body())
