@@ -41,6 +41,21 @@ def _xml(root: ET.Element) -> web.Response:
     )
 
 
+def _local(tag: str) -> str:
+    """Element tag without any XML namespace."""
+    return tag.rsplit("}", 1)[-1]
+
+def _findall_local(root: ET.Element, name: str) -> list[ET.Element]:
+    """Namespace-agnostic findall — AWS SDKs send the S3 xmlns."""
+    return [el for el in root if _local(el.tag) == name]
+
+def _findtext_local(root: ET.Element, name: str, default: str = "") -> str:
+    for el in root.iter():
+        if _local(el.tag) == name:
+            return el.text or default
+    return default
+
+
 def _error(code: str, message: str, status: int) -> web.Response:
     root = ET.Element("Error")
     ET.SubElement(root, "Code").text = code
@@ -104,14 +119,9 @@ class S3Server:
             return ACTION_READ  # SelectObjectContent reads
         return ACTION_WRITE
 
-    async def _authenticate(self, request: web.Request, bucket: str, key: str):
-        """-> error Response or None. Reads the body only when the signed
-        payload hash isn't carried in headers."""
-        if self.iam is None or not self.iam.enabled:
-            return None
-        from .auth import AccessDenied
-
-        action = self._required_action(request.method, bucket, key, request.query)
+    async def _request_identity(self, request: web.Request):
+        """Verified Identity for the request, or raises AccessDenied.
+        Reads the body only when the signed payload hash isn't in headers."""
         payload_hash = ""
         if "Authorization" in request.headers and not request.headers.get(
             "x-amz-content-sha256"
@@ -119,21 +129,42 @@ class S3Server:
             import hashlib
 
             payload_hash = hashlib.sha256(await request.read()).hexdigest()
+        return self.iam.authenticate(
+            {
+                "method": request.method,
+                "raw_path": request.url.raw_path.partition("?")[0],
+                "query_pairs": [(k, v) for k, v in request.query.items()],
+                "headers": request.headers,
+                "payload_hash": payload_hash,
+            }
+        )
+
+    async def _authenticate(self, request: web.Request, bucket: str, key: str):
+        """-> error Response or None."""
+        if self.iam is None or not self.iam.enabled:
+            return None
+        from .auth import AccessDenied
+
+        action = self._required_action(request.method, bucket, key, request.query)
         try:
-            ident = self.iam.authenticate(
-                {
-                    "method": request.method,
-                    "raw_path": request.url.raw_path.partition("?")[0],
-                    "query_pairs": [(k, v) for k, v in request.query.items()],
-                    "headers": request.headers,
-                    "payload_hash": payload_hash,
-                }
-            )
+            ident = await self._request_identity(request)
         except AccessDenied as e:
             return _error("AccessDenied", str(e), 403)
         if not ident.can_do(action, bucket):
             return _error("AccessDenied", f"not allowed: {action}", 403)
         return None
+
+    async def _source_read_allowed(self, request: web.Request, src_bucket: str) -> bool:
+        """Copy operations also need Read on the SOURCE bucket."""
+        if self.iam is None or not self.iam.enabled:
+            return True
+        from .auth import ACTION_READ, AccessDenied
+
+        try:
+            ident = await self._request_identity(request)
+        except AccessDenied:
+            return False
+        return ident.can_do(ACTION_READ, src_bucket)
 
     # ---------------- routing ----------------
     async def _dispatch(self, request: web.Request) -> web.Response:
@@ -149,6 +180,8 @@ class S3Server:
                 return await self._create_bucket(bucket)
             if request.method == "DELETE":
                 return await self._delete_bucket(bucket)
+            if request.method == "POST" and "delete" in request.query:
+                return await self._delete_multiple_objects(request, bucket)
             if request.method in ("GET", "HEAD"):
                 return await self._list_objects(request, bucket)
             return _error("MethodNotAllowed", "method not allowed", 405)
@@ -164,6 +197,8 @@ class S3Server:
             if request.method == "DELETE":
                 return await self._abort_multipart(request, bucket, key)
         if request.method == "PUT":
+            if request.headers.get("X-Amz-Copy-Source"):
+                return await self._copy_object(request, bucket, key)
             return await self._put_object(request, bucket, key)
         if request.method in ("GET", "HEAD"):
             return await self._get_object(request, bucket, key)
@@ -208,6 +243,13 @@ class S3Server:
         prefix = request.query.get("prefix", "")
         max_keys = int(request.query.get("max-keys", 1000))
         delimiter = request.query.get("delimiter", "")
+        # pagination: V2 continuation-token / start-after, V1 marker — all
+        # mean "strictly after this key" (ref s3api_objects_list_handlers.go)
+        after = (
+            request.query.get("continuation-token", "")
+            or request.query.get("start-after", "")
+            or request.query.get("marker", "")
+        )
 
         contents: list[tuple[str, Entry]] = []
         common: set[str] = set()
@@ -224,16 +266,31 @@ class S3Server:
                     contents.append((child_rel, e))
 
         walk(path, "")
-        contents.sort(key=lambda t: t[0])
+        # keys and common prefixes share one sorted stream and one
+        # max-keys budget (S3 semantics: prefixes count toward MaxKeys and
+        # paginate with the same marker)
+        merged: list[tuple[str, Optional[Entry]]] = [
+            (k, e) for k, e in contents
+        ] + [(p, None) for p in common]
+        merged.sort(key=lambda t: t[0])
+        if after:
+            merged = [t for t in merged if t[0] > after]
+        truncated = len(merged) > max_keys
+        page = merged[:max_keys]
         root = ET.Element("ListBucketResult")
         ET.SubElement(root, "Name").text = bucket
         ET.SubElement(root, "Prefix").text = prefix
         ET.SubElement(root, "MaxKeys").text = str(max_keys)
-        ET.SubElement(root, "KeyCount").text = str(min(len(contents), max_keys))
-        ET.SubElement(root, "IsTruncated").text = (
-            "true" if len(contents) > max_keys else "false"
-        )
-        for key, e in contents[:max_keys]:
+        ET.SubElement(root, "KeyCount").text = str(len(page))
+        ET.SubElement(root, "IsTruncated").text = "true" if truncated else "false"
+        if truncated and page:
+            ET.SubElement(root, "NextContinuationToken").text = page[-1][0]
+            ET.SubElement(root, "NextMarker").text = page[-1][0]
+        for key, e in page:
+            if e is None:
+                cp = ET.SubElement(root, "CommonPrefixes")
+                ET.SubElement(cp, "Prefix").text = key
+                continue
             c = ET.SubElement(root, "Contents")
             ET.SubElement(c, "Key").text = key
             ET.SubElement(c, "Size").text = str(e.size())
@@ -241,9 +298,95 @@ class S3Server:
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime(e.attr.mtime)
             )
             ET.SubElement(c, "ETag").text = '"%s"' % (e.extended.get("etag", ""))
-        for p in sorted(common):
-            cp = ET.SubElement(root, "CommonPrefixes")
-            ET.SubElement(cp, "Prefix").text = p
+        return _xml(root)
+
+    async def _delete_multiple_objects(
+        self, request: web.Request, bucket: str
+    ) -> web.Response:
+        """POST /bucket?delete (ref s3api DeleteMultipleObjectsHandler)."""
+        if self.filer.find_entry(f"{BUCKETS_ROOT}/{bucket}") is None:
+            return _error("NoSuchBucket", f"bucket {bucket} not found", 404)
+        try:
+            req_xml = ET.fromstring(await request.read())
+        except ET.ParseError as e:
+            return _error("MalformedXML", str(e), 400)
+        quiet = _findtext_local(req_xml, "Quiet").lower() == "true"
+        root = ET.Element("DeleteResult")
+        for obj in _findall_local(req_xml, "Object"):
+            key = _findtext_local(obj, "Key")
+            if not key:
+                continue
+            try:
+                self.filer.delete_entry(self._object_path(bucket, key))
+                if not quiet:
+                    d = ET.SubElement(root, "Deleted")
+                    ET.SubElement(d, "Key").text = key
+            except Exception as e:
+                err = ET.SubElement(root, "Error")
+                ET.SubElement(err, "Key").text = key
+                ET.SubElement(err, "Code").text = "InternalError"
+                ET.SubElement(err, "Message").text = str(e)
+        return _xml(root)
+
+    def _parse_copy_source(self, request: web.Request):
+        """-> (src_bucket, src_key, entry) or an error Response."""
+        import urllib.parse
+
+        src = urllib.parse.unquote(request.headers["X-Amz-Copy-Source"])
+        src_bucket, _, src_key = src.lstrip("/").partition("/")
+        if not src_key:
+            return _error("InvalidArgument", f"bad copy source {src!r}", 400)
+        entry = self.filer.find_entry(self._object_path(src_bucket, src_key))
+        if entry is None or entry.is_directory:
+            return _error("NoSuchKey", f"source {src} not found", 404)
+        return src_bucket, src_key, entry
+
+    async def _copy_chunks(self, entry, start: int, length: int):
+        """Re-chunk [start, start+length) of the source entry into fresh
+        needles, memory bounded by one chunk (fids are owned by exactly one
+        entry — the filer GC frees them on delete, so they can't be
+        shared). -> (chunks, md5hex)."""
+        import hashlib
+
+        from ..filer import FileChunk
+
+        visibles = non_overlapping_visible_intervals(entry.chunks)
+        md5 = hashlib.md5()
+        chunks: list[FileChunk] = []
+        offset = 0
+        while offset < length:
+            piece_len = min(self.fs.chunk_size, length - offset)
+            piece = await self._read_span(visibles, start + offset, piece_len)
+            md5.update(piece)
+            chunks.extend(
+                await self.fs._write_chunks(piece, base_offset=offset)
+            )
+            offset += piece_len
+        return chunks, md5.hexdigest()
+
+    async def _copy_object(
+        self, request: web.Request, bucket: str, key: str
+    ) -> web.Response:
+        """PUT with X-Amz-Copy-Source (ref s3api CopyObjectHandler)."""
+        parsed = self._parse_copy_source(request)
+        if isinstance(parsed, web.Response):
+            return parsed
+        src_bucket, _, entry = parsed
+        if not await self._source_read_allowed(request, src_bucket):
+            return _error("AccessDenied", f"no Read on {src_bucket}", 403)
+        if self.filer.find_entry(f"{BUCKETS_ROOT}/{bucket}") is None:
+            return _error("NoSuchBucket", f"bucket {bucket} not found", 404)
+        chunks, etag = await self._copy_chunks(entry, 0, entry.size())
+        new_entry = self.filer.touch(
+            self._object_path(bucket, key), entry.attr.mime, chunks
+        )
+        new_entry.extended["etag"] = etag
+        self.filer.update_entry(new_entry)
+        root = ET.Element("CopyObjectResult")
+        ET.SubElement(root, "ETag").text = f'"{etag}"'
+        ET.SubElement(root, "LastModified").text = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        )
         return _xml(root)
 
     # ---------------- objects ----------------
@@ -345,18 +488,18 @@ class S3Server:
             req_xml = ET.fromstring(await request.read())
         except ET.ParseError as e:
             return _error("MalformedXML", str(e), 400)
-        expression = (req_xml.findtext("Expression") or "").strip()
+        expression = _findtext_local(req_xml, "Expression").strip()
         if not expression:
             return _error("MissingRequiredParameter", "Expression", 400)
         input_format = "json"
         csv_delimiter = ","
         csv_header = "NONE"  # the AWS SelectObjectContent default
-        input_el = req_xml.find("InputSerialization")
-        if input_el is not None and input_el.find("CSV") is not None:
+        input_els = _findall_local(req_xml, "InputSerialization")
+        csv_els = _findall_local(input_els[0], "CSV") if input_els else []
+        if csv_els:
             input_format = "csv"
-            csv_el = input_el.find("CSV")
-            csv_delimiter = csv_el.findtext("FieldDelimiter") or ","
-            csv_header = csv_el.findtext("FileHeaderInfo") or "NONE"
+            csv_delimiter = _findtext_local(csv_els[0], "FieldDelimiter") or ","
+            csv_header = _findtext_local(csv_els[0], "FileHeaderInfo") or "NONE"
 
         visibles = non_overlapping_visible_intervals(entry.chunks)
         data = await self._read_span(visibles, 0, entry.size())
@@ -415,6 +558,37 @@ class S3Server:
         part_number = int(request.query.get("partNumber", 1))
         if self.filer.find_entry(self._upload_dir(upload_id)) is None:
             return _error("NoSuchUpload", upload_id, 404)
+
+        if request.headers.get("X-Amz-Copy-Source"):
+            # UploadPartCopy (ref s3api CopyObjectPartHandler): the part's
+            # bytes come from an existing object (optionally a range)
+            parsed = self._parse_copy_source(request)
+            if isinstance(parsed, web.Response):
+                return parsed
+            src_bucket, _, src_entry = parsed
+            if not await self._source_read_allowed(request, src_bucket):
+                return _error("AccessDenied", f"no Read on {src_bucket}", 403)
+            start, length = 0, src_entry.size()
+            rng = request.headers.get("x-amz-copy-source-range", "")
+            if rng.startswith("bytes="):
+                a, _, b = rng[len("bytes=") :].partition("-")
+                try:
+                    start = int(a)
+                    length = int(b) - start + 1
+                except ValueError:
+                    return _error("InvalidRange", rng, 400)
+            chunks, etag = await self._copy_chunks(src_entry, start, length)
+            entry = self.filer.touch(
+                f"{self._upload_dir(upload_id)}/{part_number:05d}.part",
+                "",
+                chunks,
+            )
+            entry.extended["etag"] = etag
+            self.filer.update_entry(entry)
+            root = ET.Element("CopyPartResult")
+            ET.SubElement(root, "ETag").text = f'"{etag}"'
+            return _xml(root)
+
         data = await request.read()
         chunks = await self.fs._write_chunks(data)
         import hashlib

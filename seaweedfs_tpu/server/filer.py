@@ -144,7 +144,12 @@ class FilerServer:
                 raise IOError(f"chunk {fid}: status {resp.status}")
             return await resp.read()
 
-    async def _write_chunks(self, data: bytes, ttl: str = "") -> list[FileChunk]:
+    async def _write_chunks(
+        self, data: bytes, ttl: str = "", base_offset: int = 0
+    ) -> list[FileChunk]:
+        """Store data as chunk needles; base_offset shifts the logical
+        chunk offsets (used when a caller streams a large object in
+        pieces, e.g. the S3 gateway's copy path)."""
         chunks = []
         now = time.time_ns()
         for offset in range(0, len(data), self.chunk_size):
@@ -161,7 +166,7 @@ class FilerServer:
             chunks.append(
                 FileChunk(
                     fid=ar.fid,
-                    offset=offset,
+                    offset=base_offset + offset,
                     size=len(piece),
                     mtime_ns=now,
                     etag=result.get("eTag", ""),

@@ -763,17 +763,22 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
                     return ip in cache[1]
                 return False
             # registered hosts may be DNS names or other-interface
-            # addresses — resolve them (off the event loop) so the TCP
-            # source IP matches
-            ips: set[str] = set()
+            # addresses — resolve them concurrently with a bound so a slow
+            # resolver can't stall the triggering request for long
+            ips: set[str] = set(hosts)
             loop = asyncio.get_event_loop()
-            for host in hosts:
-                ips.add(host)
+
+            async def resolve(host: str) -> None:
                 try:
-                    for info in await loop.getaddrinfo(host, None):
+                    infos = await asyncio.wait_for(
+                        loop.getaddrinfo(host, None), timeout=2.0
+                    )
+                    for info in infos:
                         ips.add(info[4][0])
-                except OSError:
+                except (OSError, asyncio.TimeoutError):
                     pass
+
+            await asyncio.gather(*(resolve(h) for h in hosts))
             cache = (now, ips)
             self._member_ips = cache
         return ip in cache[1]

@@ -96,9 +96,14 @@ class CompactMap:
             ]
             items.sort()
             if items:
+                from ...types import OFFSET_SIZE
+
                 arr = np.asarray(items, dtype=np.uint64)
                 keys = arr[:, 0].astype(np.uint64)
-                offsets = arr[:, 1].astype(np.uint32)
+                # u64 under the 5-byte-offset variant (units exceed u32)
+                offsets = arr[:, 1].astype(
+                    np.uint64 if OFFSET_SIZE == 5 else np.uint32
+                )
                 sizes = arr[:, 2].astype(np.uint32)
             else:
                 keys = np.empty(0, dtype=np.uint64)
