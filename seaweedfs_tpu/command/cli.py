@@ -30,6 +30,12 @@ def _add_master_flags(p: argparse.ArgumentParser) -> None:
         help="HS256 key: the master issues fid-scoped upload JWTs and the "
         "volume servers verify them (ref security/jwt.go)",
     )
+    p.add_argument(
+        "-sequencerFile",
+        default="",
+        help="persist the file-id sequencer to this path (the durable "
+        "role of the reference's etcd sequencer); '' = in-memory",
+    )
 
 
 def _add_volume_flags(p: argparse.ArgumentParser) -> None:
@@ -223,6 +229,7 @@ def cmd_master(argv: list[str]) -> int:
         garbage_threshold=args.garbageThreshold,
         peers=[x for x in args.peers.split(",") if x] or None,
         jwt_signing_key=args.jwtSigningKey,
+        sequencer_file=args.sequencerFile,
         **_maintenance_kwargs(cfg),
     )
     print(f"master listening on {args.ip}:{args.port}")
@@ -307,6 +314,7 @@ def cmd_server(argv: list[str]) -> int:
         default_replication=args.defaultReplication,
         peers=peers,
         jwt_signing_key=args.jwtSigningKey,
+        sequencer_file=args.sequencerFile,
         **_maintenance_kwargs(cfg),
     )
     vs = VolumeServer(

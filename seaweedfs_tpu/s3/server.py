@@ -152,18 +152,22 @@ class S3Server:
             return _error("AccessDenied", str(e), 403)
         if not ident.can_do(action, bucket):
             return _error("AccessDenied", f"not allowed: {action}", 403)
+        request["s3_identity"] = ident  # reused by copy source checks
         return None
 
     async def _source_read_allowed(self, request: web.Request, src_bucket: str) -> bool:
-        """Copy operations also need Read on the SOURCE bucket."""
+        """Copy operations also need Read on the SOURCE bucket; reuses the
+        identity _authenticate already verified for this request."""
         if self.iam is None or not self.iam.enabled:
             return True
         from .auth import ACTION_READ, AccessDenied
 
-        try:
-            ident = await self._request_identity(request)
-        except AccessDenied:
-            return False
+        ident = request.get("s3_identity")
+        if ident is None:
+            try:
+                ident = await self._request_identity(request)
+            except AccessDenied:
+                return False
         return ident.can_do(ACTION_READ, src_bucket)
 
     # ---------------- routing ----------------
@@ -261,8 +265,20 @@ class S3Server:
                     if delimiter == "/" and child_rel.startswith(prefix):
                         common.add(child_rel + "/")
                         continue
-                    walk(e.full_path, child_rel + "/")
+                    # prune subtrees that cannot contribute: every key
+                    # under child_rel+"/" sorts before child_rel+"0"
+                    # ("/" < "0"), and prefix mismatch is structural
+                    subtree = child_rel + "/"
+                    if prefix and not (
+                        subtree.startswith(prefix) or prefix.startswith(subtree)
+                    ):
+                        continue
+                    if after and child_rel + "0" <= after:
+                        continue
+                    walk(e.full_path, subtree)
                 elif child_rel.startswith(prefix):
+                    if after and child_rel <= after:
+                        continue
                     contents.append((child_rel, e))
 
         walk(path, "")
@@ -568,15 +584,22 @@ class S3Server:
             src_bucket, _, src_entry = parsed
             if not await self._source_read_allowed(request, src_bucket):
                 return _error("AccessDenied", f"no Read on {src_bucket}", 403)
-            start, length = 0, src_entry.size()
+            size = src_entry.size()
+            start, length = 0, size
             rng = request.headers.get("x-amz-copy-source-range", "")
-            if rng.startswith("bytes="):
+            if rng:
+                if not rng.startswith("bytes="):
+                    return _error("InvalidArgument", rng, 400)
                 a, _, b = rng[len("bytes=") :].partition("-")
                 try:
-                    start = int(a)
-                    length = int(b) - start + 1
+                    start, end = int(a), int(b)
                 except ValueError:
                     return _error("InvalidRange", rng, 400)
+                # bounds-check against the SOURCE (AWS rejects out-of-range
+                # copy ranges; zero-filling would silently corrupt parts)
+                if start > end or end >= size:
+                    return _error("InvalidRange", rng, 400)
+                length = end - start + 1
             chunks, etag = await self._copy_chunks(src_entry, start, length)
             entry = self.filer.touch(
                 f"{self._upload_dir(upload_id)}/{part_number:05d}.part",

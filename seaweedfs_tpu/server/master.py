@@ -49,6 +49,7 @@ class MasterServer:
         maintenance_scripts: str = "",
         maintenance_sleep_minutes: float = 17.0,
         maintenance_filer: str = "",
+        sequencer_file: str = "",
     ):
         self.jwt_signing_key = jwt_signing_key
         self.jwt_expires_seconds = jwt_expires_seconds
@@ -63,9 +64,15 @@ class MasterServer:
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
         self.pulse_seconds = pulse_seconds
+        if sequencer_file:
+            from ..sequence import FileSequencer
+
+            sequencer = FileSequencer(sequencer_file)
+        else:
+            sequencer = MemorySequencer()
         self.topo = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
-            sequencer=MemorySequencer(),
+            sequencer=sequencer,
         )
         self.growth = VolumeGrowth()
         from .raft import RaftLite

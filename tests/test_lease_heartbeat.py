@@ -113,3 +113,25 @@ def test_heartbeat_break_deletes_vids_from_clients(tmp_path):
             await cluster.stop()
 
     asyncio.run(body())
+
+
+def test_file_sequencer_survives_restart(tmp_path):
+    """FileSequencer leases id windows ahead of use, so a restarted master
+    never re-issues a file id (the etcd sequencer's durable role)."""
+    from seaweedfs_tpu.sequence import FileSequencer
+
+    path = str(tmp_path / "seq.dat")
+    s1 = FileSequencer(path)
+    first = s1.next_file_id(5)
+    second = s1.next_file_id(3)
+    assert second == first + 5
+
+    # a fresh instance (simulating a crash WITHOUT clean shutdown) starts
+    # past everything ever handed out
+    s2 = FileSequencer(path)
+    assert s2.next_file_id(1) > second + 2
+
+    # set_max advances durably too
+    s2.set_max(10_000_000)
+    s3 = FileSequencer(path)
+    assert s3.next_file_id(1) > 10_000_000
