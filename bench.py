@@ -27,6 +27,7 @@ where "extra" carries the secondary metrics (BASELINE.json configs 3 & 4).
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -298,6 +299,80 @@ def measure_encode_e2e(
         shutil.rmtree(d, ignore_errors=True)
 
 
+_E2E_NOTE = (
+    "tunnel transfer-bound (~0.5/0.03 GB/s up/down host<->device in this "
+    "env); see measure_encode_e2e"
+)
+
+
+def _clean_stale_e2e_dirs() -> None:
+    """A SIGKILLed child skips its finally-cleanup; reclaim its tmpfs files
+    so later runs aren't demoted off /dev/shm by the free-space check."""
+    import glob
+    import shutil
+    import tempfile
+
+    for base in ("/dev/shm", tempfile.gettempdir()):
+        for d in glob.glob(os.path.join(base, "bench_ec_e2e_*")):
+            shutil.rmtree(d, ignore_errors=True)
+
+
+def _e2e_result(tpu: float, cpu: float, parity: bool) -> dict:
+    return {
+        "metric": "ec.encode.e2e",
+        "value": round(tpu, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(tpu / cpu, 2),
+        "shards_byte_identical": parity,
+        "note": _E2E_NOTE,
+    }
+
+
+def _run_e2e_timeboxed() -> dict:
+    """Run measure_encode_e2e in a subprocess with a hard wall-clock box:
+    the tunnel's transfer rate swings 10x between runs, and a slow run must
+    cost this one metric, not the whole benchmark. On single-client TPU
+    backends (directly attached, device already held by this process) the
+    child cannot open the device, so we fall back to running inline
+    (untimeboxed)."""
+    import subprocess
+    import sys
+
+    try:
+        e2e_bytes = int(os.environ.get("BENCH_EC_E2E_BYTES", 4 << 30))
+        timeout = float(os.environ.get("BENCH_EC_E2E_TIMEOUT", 600))
+        _clean_stale_e2e_dirs()
+        script = (
+            "import json, bench\n"
+            f"t, c, ok = bench.measure_encode_e2e({e2e_bytes})\n"
+            "print(json.dumps({'tpu': t, 'cpu': c, 'parity': ok}))\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if out.returncode != 0:
+            err = (out.stderr or out.stdout)[-400:]
+            if "in use" in err or "already" in err.lower():
+                # device is single-client: run inline instead
+                return _e2e_result(*measure_encode_e2e(e2e_bytes))
+            return {"metric": "ec.encode.e2e", "error": err[-200:]}
+        r = json.loads(out.stdout.strip().splitlines()[-1])
+        return _e2e_result(r["tpu"], r["cpu"], r["parity"])
+    except subprocess.TimeoutExpired:
+        _clean_stale_e2e_dirs()
+        return {
+            "metric": "ec.encode.e2e",
+            "error": "timed out (tunnel-bound; rerun with "
+            "BENCH_EC_E2E_TIMEOUT/BENCH_EC_E2E_BYTES)",
+        }
+    except Exception as e:
+        return {"metric": "ec.encode.e2e", "error": str(e)[:200]}
+
+
 def main() -> None:
     from seaweedfs_tpu.ops.gf256 import pack_bytes_host
     from seaweedfs_tpu.storage.erasure_coding.coder_cpu import CpuRSCodec
@@ -343,24 +418,7 @@ def main() -> None:
     except Exception as e:
         extra.append({"metric": "ec.rebuild_throughput", "error": str(e)[:200]})
 
-    try:
-        import os
-
-        e2e_bytes = int(os.environ.get("BENCH_EC_E2E_BYTES", 4 << 30))
-        e2e_tpu, e2e_cpu, e2e_parity = measure_encode_e2e(e2e_bytes)
-        extra.append(
-            {
-                "metric": "ec.encode.e2e",
-                "value": round(e2e_tpu, 3),
-                "unit": "GB/s",
-                "vs_baseline": round(e2e_tpu / e2e_cpu, 2),
-                "shards_byte_identical": e2e_parity,
-                "note": "tunnel transfer-bound (~0.5/0.03 GB/s up/down "
-                "host<->device in this env); see measure_encode_e2e",
-            }
-        )
-    except Exception as e:
-        extra.append({"metric": "ec.encode.e2e", "error": str(e)[:200]})
+    extra.append(_run_e2e_timeboxed())
 
     print(
         json.dumps(
