@@ -529,7 +529,11 @@ def cmd_shell(argv: list[str]) -> int:
                     line = await loop.run_in_executor(None, input, "> ")
                 except EOFError:
                     break
-                out = await run_command(env, line)
+                try:
+                    out = await run_command(env, line)
+                except Exception as e:
+                    # one failing command must not kill the REPL
+                    out = f"error: {e}"
                 if out:
                     print(out)
         finally:

@@ -161,6 +161,10 @@ class Filer:
         entry = self.store.find_entry(old_path)
         if entry is None:
             raise FileNotFoundError(old_path)
+        if new_path == old_path or new_path.startswith(old_path + "/"):
+            # moving a directory into its own subtree would insert the
+            # moved children and then prefix-delete them with the source
+            raise OSError(f"cannot move {old_path} into itself")
         self._ensure_parents(new_path)
         from ..notification import EVENT_RENAME
 

@@ -292,11 +292,17 @@ class FilerServer:
         return {"entries": [e.to_dict() for e in entries]}
 
     async def _grpc_create_entry(self, req, context) -> dict:
-        self.filer.create_entry(Entry.from_dict(req["entry"]))
+        try:
+            self.filer.create_entry(Entry.from_dict(req["entry"]))
+        except OSError as e:
+            return {"error": str(e)}
         return {}
 
     async def _grpc_update_entry(self, req, context) -> dict:
-        self.filer.update_entry(Entry.from_dict(req["entry"]))
+        try:
+            self.filer.update_entry(Entry.from_dict(req["entry"]))
+        except OSError as e:
+            return {"error": str(e)}
         return {}
 
     async def _grpc_delete_entry(self, req, context) -> dict:
@@ -316,7 +322,7 @@ class FilerServer:
         new = req["new_directory"].rstrip("/") + "/" + req["new_name"]
         try:
             self.filer.rename(old, new)
-        except (FileNotFoundError, NotADirectoryError) as e:
+        except OSError as e:  # incl. FileNotFound / NotADirectory / self-move
             return {"error": str(e)}
         return {}
 

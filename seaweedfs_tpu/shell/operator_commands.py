@@ -404,6 +404,90 @@ async def cmd_fs_cat(env, argv) -> str:
     return b"".join(parts).decode("utf-8", "replace")
 
 
+async def _lookup_entry(stub: Stub, path: str):
+    directory, _, name = path.rstrip("/").rpartition("/")
+    resp = await stub.call(
+        "LookupDirectoryEntry", {"directory": directory or "/", "name": name}
+    )
+    return None if resp.get("error") else resp.get("entry")
+
+
+@command("fs.mkdir")
+async def cmd_fs_mkdir(env, argv) -> str:
+    """fs.mkdir [-filer host:port] /dir/path"""
+    flags, positional = _fs_args(argv)
+    stub = _filer_stub(env, flags)
+    if not positional:
+        return "usage: fs.mkdir [-filer host:port] /dir/path"
+    path = positional[0].rstrip("/")
+    existing = await _lookup_entry(stub, path)
+    if existing is not None:
+        # creating over an existing entry would replace it (and free a
+        # file's chunks) — refuse
+        return f"fs.mkdir: {path} already exists"
+    from ..filer.entry import new_directory_entry
+
+    resp = await stub.call(
+        "CreateEntry", {"entry": new_directory_entry(path).to_dict()}
+    )
+    if resp.get("error"):
+        return f"fs.mkdir: {resp['error']}"
+    return f"created {path}"
+
+
+@command("fs.mv")
+async def cmd_fs_mv(env, argv) -> str:
+    """fs.mv [-filer host:port] /src/path /dst/path — a directory
+    destination receives the source INSIDE it (ref command_fs_mv.go)."""
+    flags, positional = _fs_args(argv)
+    stub = _filer_stub(env, flags)
+    if len(positional) != 2:
+        return "usage: fs.mv [-filer host:port] /src /dst"
+    src, dst = (p.rstrip("/") for p in positional)
+    src_dir, _, src_name = src.rpartition("/")
+    dst_entry = await _lookup_entry(stub, dst)
+    if dst_entry is not None and dst_entry.get("is_directory"):
+        dst = f"{dst}/{src_name}"
+    dst_dir, _, dst_name = dst.rpartition("/")
+    resp = await stub.call(
+        "AtomicRenameEntry",
+        {
+            "old_directory": src_dir or "/",
+            "old_name": src_name,
+            "new_directory": dst_dir or "/",
+            "new_name": dst_name,
+        },
+    )
+    if resp.get("error"):
+        return f"fs.mv: {resp['error']}"
+    return f"moved {src} -> {dst}"
+
+
+@command("fs.rm")
+async def cmd_fs_rm(env, argv) -> str:
+    """fs.rm [-filer host:port] [-r] /path (ref command_fs_rm.go)"""
+    flags, positional = _fs_args(argv)
+    stub = _filer_stub(env, flags)
+    if not positional:
+        return "usage: fs.rm [-filer host:port] [-r] /path"
+    path = positional[0].rstrip("/")
+    if await _lookup_entry(stub, path) is None:
+        return f"fs.rm: {path}: no entry found"
+    directory, _, name = path.rpartition("/")
+    resp = await stub.call(
+        "DeleteEntry",
+        {
+            "directory": directory or "/",
+            "name": name,
+            "is_recursive": "r" in flags,
+            "is_delete_data": True,
+        },
+    )
+    if resp.get("error"):
+        return f"fs.rm: {resp['error']}"
+    return f"removed {path}"
+
+
 # ---------------- bucket.* (ref command_bucket_*.go) ----------------
 @command("bucket.list")
 async def cmd_bucket_list(env, argv) -> str:
@@ -427,21 +511,11 @@ async def cmd_bucket_create(env, argv) -> str:
     if not name:
         return "usage: bucket.create -name bucketName [-filer host:port]"
     stub = _filer_stub(env, flags)
-    import time
+    from ..filer.entry import new_directory_entry
 
     resp = await stub.call(
         "CreateEntry",
-        {
-            "entry": {
-                "full_path": f"{BUCKETS_ROOT}/{name}",
-                "is_directory": True,
-                "attr": {
-                    "mode": 0o770 | 0o040000,
-                    "mtime": time.time(),
-                    "crtime": time.time(),
-                },
-            }
-        },
+        {"entry": new_directory_entry(f"{BUCKETS_ROOT}/{name}").to_dict()},
     )
     if resp.get("error"):
         return f"bucket.create: {resp['error']}"

@@ -119,6 +119,38 @@ def test_fsck_fs_and_buckets(tmp_path):
                 out = await run_command(env, "fs.cat /docs/sub/b.txt")
                 assert out == "hello shell"
 
+                # fs.mkdir / fs.mv / fs.rm
+                out = await run_command(env, "fs.mkdir /made/deep")
+                assert "created" in out
+                assert fs.filer.find_entry("/made/deep").is_directory
+                out = await run_command(env, "fs.mv /docs/a.bin /made/a2.bin")
+                assert "moved" in out
+                assert fs.filer.find_entry("/docs/a.bin") is None
+                assert fs.filer.find_entry("/made/a2.bin") is not None
+                out = await run_command(env, "fs.cat /made/a2.bin")
+                assert len(out) > 0
+                # a directory destination receives the source inside it
+                out = await run_command(env, "fs.mv /made/a2.bin /made/deep")
+                assert "moved" in out
+                assert fs.filer.find_entry("/made/deep/a2.bin") is not None
+
+                # refusals: mkdir over a file, mv into own subtree, rm miss
+                out = await run_command(env, "fs.mkdir /made/deep/a2.bin")
+                assert "already exists" in out
+                assert not fs.filer.find_entry("/made/deep/a2.bin").is_directory
+                out = await run_command(env, "fs.mv /made /made/deep/sub")
+                assert "into itself" in out
+                assert fs.filer.find_entry("/made/deep/a2.bin") is not None
+                out = await run_command(env, "fs.rm /nope/missing.bin")
+                assert "no entry found" in out
+
+                out = await run_command(env, "fs.rm -r /made")
+                assert "removed" in out
+                assert fs.filer.find_entry("/made") is None
+                # put a.bin back for the fsck phase below
+                async with session.put(f"{base}/docs/a.bin", data=doc) as r:
+                    assert r.status == 201
+
                 # bucket.*
                 out = await run_command(env, "bucket.create -name mybkt")
                 assert "created" in out
