@@ -67,10 +67,15 @@ class Filer:
             elif not existing.is_directory:
                 raise NotADirectoryError(f"{path} is a file")
 
-    def create_entry(self, entry: Entry) -> None:
+    def create_entry(self, entry: Entry, exclusive: bool = False) -> None:
+        """exclusive=True is the O_EXCL analogue: refuse to replace any
+        existing entry (the replace path frees the old file's chunks, so
+        directory-creating callers must never race onto a file)."""
         if entry.full_path != "/":
             self._ensure_parents(entry.full_path)
         existing = self.store.find_entry(entry.full_path)
+        if exclusive and existing is not None:
+            raise FileExistsError(entry.full_path)
         if existing is not None and self.on_delete_chunks and existing.chunks:
             old_fids = {c.fid for c in existing.chunks} - {
                 c.fid for c in entry.chunks
@@ -182,6 +187,18 @@ class Filer:
                     EVENT_RENAME, moved.full_path, moved, old_entry=child
                 )
             self.store.delete_folder_children(old_path)
+        # an overwritten destination FILE must free its chunks (mirror of
+        # create_entry's replace path); overwriting a directory is refused
+        dest = self.store.find_entry(new_path)
+        if dest is not None:
+            if dest.is_directory:
+                raise IsADirectoryError(new_path)
+            if self.on_delete_chunks and dest.chunks:
+                old_fids = {c.fid for c in dest.chunks} - {
+                    c.fid for c in entry.chunks
+                }
+                if old_fids:
+                    self.on_delete_chunks(sorted(old_fids))
         entry_new = Entry(
             full_path=new_path,
             attr=entry.attr,

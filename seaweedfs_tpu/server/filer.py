@@ -293,7 +293,10 @@ class FilerServer:
 
     async def _grpc_create_entry(self, req, context) -> dict:
         try:
-            self.filer.create_entry(Entry.from_dict(req["entry"]))
+            self.filer.create_entry(
+                Entry.from_dict(req["entry"]),
+                exclusive=bool(req.get("o_excl", False)),
+            )
         except OSError as e:
             return {"error": str(e)}
         return {}

@@ -427,8 +427,11 @@ async def cmd_fs_mkdir(env, argv) -> str:
         return f"fs.mkdir: {path} already exists"
     from ..filer.entry import new_directory_entry
 
+    # o_excl makes the refusal atomic on the filer (the client-side lookup
+    # above only gives a friendlier message)
     resp = await stub.call(
-        "CreateEntry", {"entry": new_directory_entry(path).to_dict()}
+        "CreateEntry",
+        {"entry": new_directory_entry(path).to_dict(), "o_excl": True},
     )
     if resp.get("error"):
         return f"fs.mkdir: {resp['error']}"
@@ -445,9 +448,12 @@ async def cmd_fs_mv(env, argv) -> str:
         return "usage: fs.mv [-filer host:port] /src /dst"
     src, dst = (p.rstrip("/") for p in positional)
     src_dir, _, src_name = src.rpartition("/")
-    dst_entry = await _lookup_entry(stub, dst)
-    if dst_entry is not None and dst_entry.get("is_directory"):
-        dst = f"{dst}/{src_name}"
+    if not dst:  # destination "/" means "into the root directory"
+        dst = f"/{src_name}"
+    else:
+        dst_entry = await _lookup_entry(stub, dst)
+        if dst_entry is not None and dst_entry.get("is_directory"):
+            dst = f"{dst}/{src_name}"
     dst_dir, _, dst_name = dst.rpartition("/")
     resp = await stub.call(
         "AtomicRenameEntry",

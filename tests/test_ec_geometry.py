@@ -90,6 +90,27 @@ def test_ec_geometry_end_to_end(tmp_path, shards):
                     got = await read_url(session, f"http://{locs[0]}/{fid}")
                     assert got == data
 
+                # the bulk RPCs serve EC volumes too (BulkLookup probes the
+                # .ecx snapshot; BatchRead assembles interval reads)
+                from seaweedfs_tpu.client.operation import (
+                    batch_read,
+                    bulk_lookup,
+                )
+
+                keys = sorted(
+                    int(f.split(",")[1][:-8], 16) for f in payloads
+                ) + [987654321]
+                _, _, found = await bulk_lookup(locs[0], vid, keys)
+                assert found[:-1].all() and not found[-1]
+                datas = await batch_read(locs[0], vid, keys)
+                by_key = {
+                    int(f.split(",")[1][:-8], 16): d
+                    for f, d in payloads.items()
+                }
+                for probe_key, d in zip(keys[:-1], datas[:-1]):
+                    assert d == by_key[probe_key]
+                assert datas[-1] is None
+
                 # kill m shard files -> degraded reads still work
                 from seaweedfs_tpu.storage.erasure_coding.ec_volume import (
                     ShardBits,

@@ -181,3 +181,34 @@ def test_log_store_survives_reopen(tmp_path):
     assert os.path.getsize(path) < size_before
     assert Filer(store3).find_entry("/keep/a.txt") is not None
     store3.close()
+
+
+def test_rename_overwrites_file_and_frees_chunks():
+    collected = []
+    f = Filer(MemoryFilerStore(), on_delete_chunks=collected.extend)
+    f.touch("/a.bin", "", [chunk("1,aa", 0, 5, 1)])
+    f.touch("/b.bin", "", [chunk("2,bb", 0, 7, 1)])
+    f.rename("/a.bin", "/b.bin")
+    assert collected == ["2,bb"]  # the overwritten destination's chunks
+    assert f.find_entry("/a.bin") is None
+    assert {c.fid for c in f.find_entry("/b.bin").chunks} == {"1,aa"}
+
+    # overwriting a directory is refused
+    f.touch("/d/x.bin", "", [])
+    import pytest as _pytest
+
+    with _pytest.raises(IsADirectoryError):
+        f.rename("/b.bin", "/d")
+
+
+def test_create_entry_exclusive():
+    import pytest as _pytest
+
+    from seaweedfs_tpu.filer.entry import new_directory_entry
+
+    f = Filer(MemoryFilerStore())
+    f.touch("/x.bin", "", [chunk("1,aa", 0, 5, 1)])
+    with _pytest.raises(FileExistsError):
+        f.create_entry(new_directory_entry("/x.bin"), exclusive=True)
+    # the file survived untouched
+    assert not f.find_entry("/x.bin").is_directory
