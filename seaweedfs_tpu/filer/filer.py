@@ -170,6 +170,14 @@ class Filer:
             # moving a directory into its own subtree would insert the
             # moved children and then prefix-delete them with the source
             raise OSError(f"cannot move {old_path} into itself")
+        # validate the destination BEFORE any child is moved — failing
+        # mid-loop would leave half-migrated metadata behind
+        dest = self.store.find_entry(new_path)
+        if dest is not None:
+            if dest.is_directory:
+                raise IsADirectoryError(new_path)
+            if entry.is_directory:
+                raise NotADirectoryError(new_path)
         self._ensure_parents(new_path)
         from ..notification import EVENT_RENAME
 
@@ -188,17 +196,13 @@ class Filer:
                 )
             self.store.delete_folder_children(old_path)
         # an overwritten destination FILE must free its chunks (mirror of
-        # create_entry's replace path); overwriting a directory is refused
-        dest = self.store.find_entry(new_path)
-        if dest is not None:
-            if dest.is_directory:
-                raise IsADirectoryError(new_path)
-            if self.on_delete_chunks and dest.chunks:
-                old_fids = {c.fid for c in dest.chunks} - {
-                    c.fid for c in entry.chunks
-                }
-                if old_fids:
-                    self.on_delete_chunks(sorted(old_fids))
+        # create_entry's replace path)
+        if dest is not None and self.on_delete_chunks and dest.chunks:
+            old_fids = {c.fid for c in dest.chunks} - {
+                c.fid for c in entry.chunks
+            }
+            if old_fids:
+                self.on_delete_chunks(sorted(old_fids))
         entry_new = Entry(
             full_path=new_path,
             attr=entry.attr,

@@ -261,6 +261,10 @@ class IdentityAccessManagement:
             t = calendar.timegm(time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
         except (KeyError, ValueError, OverflowError) as e:
             raise AccessDenied(f"malformed presigned request: {e}")
+        # AWS caps presigned validity at 7 days (ref also rejects out-of-range
+        # X-Amz-Expires); without the cap a URL can be minted valid for decades
+        if expires <= 0 or expires > 604800:
+            raise AccessDenied("X-Amz-Expires must be in (0, 604800]")
         ident, cred = self.lookup_access_key(access_key)
         if ident is None:
             raise AccessDenied(f"unknown access key {access_key!r}")

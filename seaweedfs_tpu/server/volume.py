@@ -634,7 +634,7 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
             return b"", "", ""
         return await request.read(), "", content_type
 
-    async def _check_write_auth(self, request: web.Request):
+    async def _check_write_auth(self, request: web.Request, fid: str = ""):
         """Whitelist + JWT gate shared by writes and deletes; replicate
         traffic from registered cluster peers bypasses the whitelist (the
         reference puts replication on a separate admin mux) but never the
@@ -645,9 +645,15 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
             if not (is_replicate and await self._is_cluster_member(remote)):
                 return web.json_response({"error": "forbidden"}, status=403)
         if self.jwt_signing_key:
+            if not fid:
+                # canonical form so the /vid/fid slash-URL variant compares
+                # equal to the comma fid the token was minted for
+                try:
+                    fid = str(self._parse_fid_path(request.path)[0])
+                except ValueError:
+                    fid = request.path.lstrip("/").split("/")[0]
             if not self.guard.check_jwt(
-                request.headers.get("Authorization", ""),
-                request.path.lstrip("/").split("/")[0],
+                request.headers.get("Authorization", ""), fid
             ):
                 return web.json_response({"error": "unauthorized"}, status=401)
         return None
@@ -655,7 +661,7 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
     async def _handle_write(self, request: web.Request) -> web.Response:
         fid, _, _ = self._parse_fid_path(request.path)
         vid = fid.volume_id
-        denied = await self._check_write_auth(request)
+        denied = await self._check_write_auth(request, str(fid))
         if denied is not None:
             return denied
         if not self.store.has_volume(vid):
@@ -701,7 +707,7 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
         fid, _, _ = self._parse_fid_path(request.path)
         vid = fid.volume_id
         is_replicate = request.query.get("type") == "replicate"
-        denied = await self._check_write_auth(request)
+        denied = await self._check_write_auth(request, str(fid))
         if denied is not None:
             return denied
 

@@ -60,12 +60,25 @@ def decode_jwt(signing_key: str, token: str) -> dict:
 
 
 def verify_fid_token(signing_key: str, token: str, fid: str) -> None:
-    """Raise unless the token authorizes this exact fid (volumes ignore the
-    cookie part like the reference's write check)."""
+    """Raise unless the token authorizes this exact fid (ref
+    volume_server_handlers.go:90 requires sc.Fid == vid+","+fid; a
+    volume-prefix match would let one upload token write every needle on
+    the volume). An extension suffix on the requested fid is ignored."""
     claims = decode_jwt(signing_key, token)
     token_fid = claims.get("Fid", "")
-    if token_fid != fid and token_fid.split(",")[0] != fid.split(",")[0]:
-        raise TokenError("token fid mismatch")
+    if token_fid == fid.split(".")[0]:
+        return
+    # canonicalize both sides so "_delta" chunk fids and the /vid/fid URL
+    # form compare equal to the comma form the token was minted for —
+    # still an exact (vid, key, cookie) match, never a volume-prefix one
+    try:
+        from ..storage.file_id import FileId
+
+        if FileId.parse(token_fid) == FileId.parse(fid):
+            return
+    except ValueError:
+        pass
+    raise TokenError("token fid mismatch")
 
 
 @dataclass
@@ -83,8 +96,9 @@ class Guard:
     def _parsed_whitelist(self):
         """(exact_ips, networks) parsed once — check_whitelist runs on the
         hot write path."""
+        key = tuple(self.white_list)
         cached = getattr(self, "_whitelist_cache", None)
-        if cached is not None and cached[0] == self.white_list:
+        if cached is not None and cached[0] == key:
             return cached[1]
         import ipaddress
 
@@ -98,7 +112,7 @@ class Guard:
                     continue
             else:
                 exact.add(entry)
-        self._whitelist_cache = (self.white_list, (exact, networks))
+        self._whitelist_cache = (key, (exact, networks))
         return exact, networks
 
     def check_whitelist(self, peer_ip: str) -> bool:

@@ -201,6 +201,28 @@ def test_rename_overwrites_file_and_frees_chunks():
         f.rename("/b.bin", "/d")
 
 
+def test_rename_dir_onto_existing_is_refused_before_moving():
+    """Destination conflicts must be detected BEFORE any child moves, or a
+    failed rename leaves half-migrated metadata."""
+    f = Filer(MemoryFilerStore())
+    f.touch("/src/one.txt", "", [chunk("1,aa", 0, 5, 1)])
+    f.touch("/src/two.txt", "", [chunk("2,bb", 0, 5, 1)])
+    f.touch("/dst/other.txt", "", [])
+
+    with pytest.raises(IsADirectoryError):
+        f.rename("/src", "/dst")
+    # nothing moved: source intact, destination untouched
+    assert f.find_entry("/src/one.txt") is not None
+    assert f.find_entry("/src/two.txt") is not None
+    assert f.find_entry("/dst/one.txt") is None
+
+    # directory onto an existing FILE is a NotADirectoryError, also upfront
+    f.touch("/plain.bin", "", [])
+    with pytest.raises(NotADirectoryError):
+        f.rename("/src", "/plain.bin")
+    assert f.find_entry("/src/one.txt") is not None
+
+
 def test_create_entry_exclusive():
     import pytest as _pytest
 

@@ -216,6 +216,18 @@ def test_s3_v4_auth_end_to_end(tmp_path):
                 )
                 async with session.get(url) as resp:
                     assert resp.status == 403
+
+                # X-Amz-Expires beyond AWS's 7-day cap (or <= 0) is rejected
+                for bad_expiry in (604801, 10**9, 0, -5):
+                    url = presign_url(
+                        "GET",
+                        f"{base}/alpha/obj.bin",
+                        "AKREAD",
+                        "readsecret",
+                        expires=bad_expiry,
+                    )
+                    async with session.get(url) as resp:
+                        assert resp.status == 403, bad_expiry
         finally:
             await s3.stop()
             await fs.stop()

@@ -37,6 +37,25 @@ def test_jwt_fid_mismatch():
         verify_fid_token("secret", token, "4,01abcdef")
 
 
+def test_jwt_same_volume_different_needle_rejected():
+    """A token for one fid must not authorize other needles on the same
+    volume (ref volume_server_handlers.go:90 exact-match)."""
+    token = gen_jwt("secret", 60, "3,01abcdef")
+    with pytest.raises(TokenError):
+        verify_fid_token("secret", token, "3,99feedbeef")
+    # an extension suffix on the request path is fine
+    verify_fid_token("secret", token, "3,01abcdef.jpg")
+
+
+def test_whitelist_cache_tracks_inplace_mutation():
+    g = Guard(white_list=["10.0.0.1"])
+    assert g.check_whitelist("10.0.0.1")
+    assert not g.check_whitelist("10.0.0.2")
+    # mutate the SAME list object; cache must not serve the stale parse
+    g.white_list.append("10.0.0.2")
+    assert g.check_whitelist("10.0.0.2")
+
+
 def test_guard():
     g = Guard(signing_key="k")
     assert g.is_active
