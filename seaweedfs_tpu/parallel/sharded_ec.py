@@ -59,6 +59,19 @@ def _encode_packed(matrix: np.ndarray, packed):
     return jnp.stack(gf_matmul_expr(matrix, rows))
 
 
+def _pad_vol(data, vol: int):
+    """Zero-pad the volume axis up to a multiple of the mesh's vol axis so
+    uneven batches shard; GF(2^8) is linear, so zero stripes encode/verify
+    to zero and are simply stripped from the result."""
+    v = data.shape[0]
+    pad = (-v) % vol
+    if pad:
+        data = jnp.concatenate(
+            [data, jnp.zeros((pad,) + data.shape[1:], dtype=data.dtype)]
+        )
+    return data, v
+
+
 def sharded_encode(matrix: np.ndarray, data, mesh: Mesh):
     """data uint8[V, C, N] -> parity uint8[V, R, N], sharded (vol, -, blk).
 
@@ -69,6 +82,7 @@ def sharded_encode(matrix: np.ndarray, data, mesh: Mesh):
     blk = mesh.shape["blk"]
     assert n % (4 * blk) == 0, f"N={n} not divisible by {4*blk}"
     data = jnp.asarray(data, dtype=jnp.uint8)
+    data, v = _pad_vol(data, mesh.shape["vol"])
 
     @functools.partial(
         shard_map,
@@ -85,7 +99,7 @@ def sharded_encode(matrix: np.ndarray, data, mesh: Mesh):
             local.shape[0], matrix.shape[0], -1
         )
 
-    return jax.jit(body)(data)
+    return jax.jit(body)(data)[:v]
 
 
 def sharded_verify(matrix: np.ndarray, shards, mesh: Mesh):
@@ -93,6 +107,7 @@ def sharded_verify(matrix: np.ndarray, shards, mesh: Mesh):
     matrix = np.asarray(matrix, dtype=np.uint8)
     k = matrix.shape[1]
     shards = jnp.asarray(shards, dtype=jnp.uint8)
+    shards, _ = _pad_vol(shards, mesh.shape["vol"])
 
     @functools.partial(
         shard_map,
@@ -123,6 +138,7 @@ def sharded_reconstruct_step(
     dec_rows = np.asarray(dec_rows, dtype=np.uint8)
     survivors = jnp.asarray(survivors, dtype=jnp.uint8)
     k = dec_rows.shape[1]
+    survivors, v = _pad_vol(survivors, mesh.shape["vol"])
 
     @functools.partial(
         shard_map,
@@ -139,4 +155,4 @@ def sharded_reconstruct_step(
             local.shape[0], dec_rows.shape[0], -1
         )
 
-    return jax.jit(body)(survivors)
+    return jax.jit(body)(survivors)[:v]

@@ -60,16 +60,23 @@ def sharded_bulk_lookup(
     """(sorted keys u64[M], offsets u32[M], sizes u32[M], probes u64[P])
     -> (offset_units u32[P], sizes u32[P], found bool[P]).
 
-    P must divide evenly by the mesh size.
+    Probe batches that don't divide the mesh size are zero-padded and the
+    extras stripped from the result.
     """
     n = len(keys)
     n_devices = mesh.devices.size
+    probes = np.ascontiguousarray(probes, dtype=np.uint64)
     p = len(probes)
-    assert p % n_devices == 0, f"P={p} not divisible by {n_devices} devices"
+    pad = (-p) % n_devices
+    if pad:
+        # zero-pad so uneven probe batches shard; extras are stripped below
+        probes = np.concatenate(
+            [probes, np.zeros(pad, dtype=np.uint64)]
+        )
     steps = max(1, int(np.ceil(np.log2(max(n, 1)))) + 1)
 
     khi, klo = _split_u64(np.ascontiguousarray(keys, dtype=np.uint64))
-    phi, plo = _split_u64(np.ascontiguousarray(probes, dtype=np.uint64))
+    phi, plo = _split_u64(probes)
 
     off, size, found = _compiled_body(n, steps, mesh)(
         jnp.asarray(khi),
@@ -79,4 +86,4 @@ def sharded_bulk_lookup(
         jnp.asarray(phi),
         jnp.asarray(plo),
     )
-    return np.asarray(off), np.asarray(size), np.asarray(found)
+    return np.asarray(off)[:p], np.asarray(size)[:p], np.asarray(found)[:p]
