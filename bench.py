@@ -219,82 +219,156 @@ def measure_rebuild() -> tuple[float, float]:
     return tpu_gbps, cpu_gbps
 
 
-def measure_encode_e2e(
-    size_bytes: int = 4 << 30,
-) -> tuple[float, float, bool]:
-    """End-to-end `ec.encode` of one .dat through write_ec_files: disk reads,
-    host packing, device compute and shard writes included
-    (BASELINE.json config 1 at 4GB; ref ec_encoder.go:120-136).
+def _shard_samples(base: str, rng_seed: int = 1) -> dict:
+    """Sizes + sampled 1MB-block hashes of a shard set (then the caller can
+    delete the files, keeping only one set on disk at a time)."""
+    import hashlib
 
-    -> (tpu_gbps, cpu_gbps, shards_byte_identical). Files live on tmpfs when
-    available: this VM's block device is writeback-throttled to ~30-80MB/s,
-    which would turn both pipelines into a disk benchmark; tmpfs keeps the
-    comparison about the encode pipelines. NOTE: in the tunneled bench
-    environment host<->device moves measure ~0.5 GB/s up / ~0.03 GB/s down,
-    so the TPU e2e number is transfer-bound — the pipeline overlaps reads,
-    upload, kernel, download and writes, but cannot beat the tunnel; on a
-    directly-attached chip the same code is IO-bound instead.
+    from seaweedfs_tpu.storage.erasure_coding import to_ext
+
+    rng = np.random.default_rng(rng_seed)
+    shard_size = os.path.getsize(base + to_ext(0))
+    offs = rng.integers(0, max(shard_size - (1 << 20), 1), 8)
+    out = {"shard_size": shard_size, "hashes": []}
+    for i in range(14):
+        if os.path.getsize(base + to_ext(i)) != shard_size:
+            out["hashes"].append(None)
+            continue
+        h = []
+        with open(base + to_ext(i), "rb") as f:
+            for off in offs:
+                f.seek(int(off))
+                h.append(hashlib.md5(f.read(1 << 20)).hexdigest())
+        out["hashes"].append(h)
+    return out
+
+
+def _rm_shards(base: str) -> None:
+    from seaweedfs_tpu.storage.erasure_coding import to_ext
+
+    for i in range(14):
+        try:
+            os.remove(base + to_ext(i))
+        except OSError:
+            pass
+
+
+def measure_encode_e2e(size_bytes: int = 4 << 30, emit=None):
+    """End-to-end `ec.encode` of one .dat through write_ec_files: disk reads,
+    host packing, encode and shard writes included (BASELINE.json config 1;
+    ref ec_encoder.go:120-136). Three pipelines over the same .dat:
+
+    - `ref`: the reference's structure — single-threaded, synchronous, 256KB
+      buffer (ec_encoder.go:57-58,120-136) — over the native SIMD codec (the
+      klauspost-equivalent). This is the baseline to beat.
+    - `tpu`: the device pipeline (upload/kernel/download overlapped with file
+      IO). NOTE: on the tunneled bench backend host<->device moves at
+      ~0.5 GB/s up / ~0.03 GB/s down, so this leg is transfer-bound; on a
+      directly-attached chip the same code is IO-bound instead.
+    - `best`: the shipping adaptive route (tpu/coder.adaptive_codec) with the
+      pipelined multi-worker structure — large chunks, zero-copy writes,
+      encode parallelized across cores while the main thread streams IO.
+
+    Returns a dict; `emit`, when given, receives each leg's partial dict as
+    it completes so a timeboxed parent keeps whatever finished. Files live on
+    tmpfs when available (this VM's block device is writeback-throttled to
+    ~30-80MB/s, which would turn every pipeline into a disk benchmark) and
+    the working set is capped to fit: .dat + one shard set at a time.
     """
-    import os
     import shutil
     import tempfile
 
-    from seaweedfs_tpu.storage.erasure_coding import to_ext, write_ec_files
-    from seaweedfs_tpu.tpu.coder import get_codec
+    from seaweedfs_tpu.storage.erasure_coding import write_ec_files
+    from seaweedfs_tpu.tpu.coder import adaptive_codec, get_codec
 
-    shm_ok = (
-        os.path.isdir("/dev/shm")
-        and shutil.disk_usage("/dev/shm").free > 4 * size_bytes
+    shm_free = (
+        shutil.disk_usage("/dev/shm").free if os.path.isdir("/dev/shm") else 0
     )
-    d = tempfile.mkdtemp(
-        prefix="bench_ec_e2e_", dir="/dev/shm" if shm_ok else None
-    )
+    if shm_free > (256 << 20) * 3:
+        size_bytes = min(size_bytes, int(shm_free // 3))
+        use_dir = "/dev/shm"
+    else:
+        use_dir = None  # block device; honest but throttled — note carries it
+    size_bytes = max(size_bytes, 64 << 20)
+    result = {"size_bytes": size_bytes, "tmpfs": use_dir is not None}
+
+    d = tempfile.mkdtemp(prefix="bench_ec_e2e_", dir=use_dir)
     try:
-        os.makedirs(os.path.join(d, "t"))
-        os.makedirs(os.path.join(d, "c"))
-        base_t = os.path.join(d, "t", "1")
-        base_c = os.path.join(d, "c", "1")
+        base = os.path.join(d, "1")
         # 64MB of randomness repeated: content doesn't affect GF throughput
         block = np.random.default_rng(0).integers(
             0, 256, size=64 << 20, dtype=np.uint8
         ).tobytes()
-        with open(base_t + ".dat", "wb") as f:
+        with open(base + ".dat", "wb") as f:
             left = size_bytes
             while left > 0:
                 f.write(block[: min(left, len(block))])
                 left -= len(block)
-        os.link(base_t + ".dat", base_c + ".dat")
 
+        def timed(fn, reps: int = 2) -> float:
+            """Steady-state GB/s: best of `reps` full runs (the first run
+            pays tmpfs first-touch page allocation for every output file —
+            a property of the bench sandbox, not of either pipeline)."""
+            best_t = float("inf")
+            for rep in range(reps):
+                if rep:
+                    _rm_shards(base)
+                t0 = time.perf_counter()
+                fn()
+                best_t = min(best_t, time.perf_counter() - t0)
+            return size_bytes / best_t / 1e9
+
+        # --- reference-style baseline ---
+        cpu_codec = get_codec("cpu")
+        result["ref_gbps"] = timed(
+            lambda: write_ec_files(
+                base, codec=cpu_codec, chunk=256 * 1024,
+                pipeline=False, splice_data=False, mmap_input=False,
+            )
+        )
+        golden = _shard_samples(base)
+        _rm_shards(base)
+        if emit:
+            emit(result)
+
+        # --- best (shipping adaptive) path ---
+        best = adaptive_codec()
+        result["best_backend"] = {
+            "TpuRSCodec": "tpu",
+            "NativeRSCodec": "cpu-native",
+            "CpuRSCodec": "cpu-numpy",
+        }.get(type(best).__name__, type(best).__name__)
+        result["best_gbps"] = timed(lambda: write_ec_files(base, codec=best))
+        result["best_parity"] = _shard_samples(base) == golden
+        _rm_shards(base)
+        if emit:
+            emit(result)
+
+        # --- device pipeline (always measured, even when transfer-bound;
+        # smaller cap so a slow tunnel can't eat the whole timebox) ---
+        tpu_size = min(size_bytes, 1 << 30)
+        if tpu_size != size_bytes:
+            os.truncate(base + ".dat", tpu_size)
+            golden = None  # parity sampled against a fresh ref run below
         tpu_codec = get_codec("tpu")
-        # compile the fixed-width kernel outside the timed region
         tpu_codec.encode(np.zeros((10, tpu_codec.preferred_chunk), np.uint8))
         t0 = time.perf_counter()
-        write_ec_files(base_t, codec=tpu_codec)
-        tpu_gbps = size_bytes / (time.perf_counter() - t0) / 1e9
-
-        t0 = time.perf_counter()
-        write_ec_files(base_c, codec=get_codec("cpu"))
-        cpu_gbps = size_bytes / (time.perf_counter() - t0) / 1e9
-
-        # sampled byte parity between the two shard sets (full parity is
-        # asserted at test scale in tests/test_ops.py)
-        rng = np.random.default_rng(1)
-        shard_size = os.path.getsize(base_t + to_ext(0))
-        ok = True
-        for i in range(14):
-            if os.path.getsize(base_c + to_ext(i)) != shard_size:
-                ok = False
-                break
-            with open(base_t + to_ext(i), "rb") as ft, open(
-                base_c + to_ext(i), "rb"
-            ) as fc:
-                for off in rng.integers(0, max(shard_size - (1 << 20), 1), 8):
-                    ft.seek(off)
-                    fc.seek(off)
-                    if ft.read(1 << 20) != fc.read(1 << 20):
-                        ok = False
-                        break
-        return tpu_gbps, cpu_gbps, ok
+        write_ec_files(base, codec=tpu_codec)
+        result["tpu_gbps"] = tpu_size / (time.perf_counter() - t0) / 1e9
+        result["tpu_size_bytes"] = tpu_size
+        tpu_samples = _shard_samples(base)
+        _rm_shards(base)
+        if golden is None:
+            write_ec_files(
+                base, codec=cpu_codec, chunk=256 * 1024,
+                pipeline=False, splice_data=False, mmap_input=False,
+            )
+            golden = _shard_samples(base)
+            _rm_shards(base)
+        result["tpu_parity"] = tpu_samples == golden
+        if emit:
+            emit(result)
+        return result
     finally:
         shutil.rmtree(d, ignore_errors=True)
 
@@ -317,35 +391,85 @@ def _clean_stale_e2e_dirs() -> None:
             shutil.rmtree(d, ignore_errors=True)
 
 
-def _e2e_result(tpu: float, cpu: float, parity: bool) -> dict:
-    return {
-        "metric": "ec.encode.e2e",
-        "value": round(tpu, 3),
-        "unit": "GB/s",
-        "vs_baseline": round(tpu / cpu, 2),
-        "shards_byte_identical": parity,
-        "note": _E2E_NOTE,
-    }
+def _e2e_results(r: dict) -> list:
+    """Bench `extra` entries from a (possibly partial) measure_encode_e2e
+    result dict. vs_baseline is each pipeline over the reference-style leg
+    (single-thread 256KB loop, SIMD codec — the ec_encoder.go:120-136
+    stand-in measured on the same host and files)."""
+    out = []
+    ref = r.get("ref_gbps")
+    ref_info = {"baseline_gbps": round(ref, 3)} if ref else {}
+    if "tpu_gbps" in r:
+        out.append(
+            {
+                "metric": "ec.encode.e2e",
+                "value": round(r["tpu_gbps"], 3),
+                "unit": "GB/s",
+                "vs_baseline": round(r["tpu_gbps"] / ref, 2) if ref else None,
+                "shards_byte_identical": r.get("tpu_parity"),
+                "note": _E2E_NOTE,
+            }
+        )
+    elif "error" in r:
+        # the leg that died is the first one whose result is absent — keep
+        # the measured baseline so a partial run still records evidence
+        died = "best" if "best_gbps" not in r and ref else "device"
+        out.append(
+            {
+                "metric": "ec.encode.e2e",
+                "error": f"{died} leg failed: {r['error']}",
+                **ref_info,
+            }
+        )
+    if "best_gbps" in r:
+        out.append(
+            {
+                "metric": "ec.encode.e2e.best",
+                "value": round(r["best_gbps"], 3),
+                "unit": "GB/s",
+                "vs_baseline": round(r["best_gbps"] / ref, 2) if ref else None,
+                "shards_byte_identical": r.get("best_parity"),
+                "backend": r.get("best_backend"),
+                "baseline_gbps": round(ref, 3) if ref else None,
+                "size_bytes": r.get("size_bytes"),
+                "tmpfs": r.get("tmpfs"),
+                "note": "shipping adaptive route (tpu/coder.adaptive_codec) "
+                "vs the reference-structure single-thread 256KB pipeline",
+            }
+        )
+    return out
 
 
-def _run_e2e_timeboxed() -> dict:
+def _run_e2e_timeboxed() -> list:
     """Run measure_encode_e2e in a subprocess with a hard wall-clock box:
     the tunnel's transfer rate swings 10x between runs, and a slow run must
-    cost this one metric, not the whole benchmark. On single-client TPU
-    backends (directly attached, device already held by this process) the
-    child cannot open the device, so we fall back to running inline
-    (untimeboxed)."""
+    cost this one metric, not the whole benchmark. The child prints the
+    partial result dict after every leg, so a timeout keeps the completed
+    legs. On single-client TPU backends (directly attached, device already
+    held by this process) the child cannot open the device, so we fall back
+    to running inline (untimeboxed)."""
     import subprocess
     import sys
+
+    def parse_last(text: str):
+        for line in reversed((text or "").strip().splitlines()):
+            try:
+                d = json.loads(line)
+                if isinstance(d, dict) and "ref_gbps" in d:
+                    return d
+            except (json.JSONDecodeError, ValueError):
+                continue
+        return None
 
     try:
         e2e_bytes = int(os.environ.get("BENCH_EC_E2E_BYTES", 4 << 30))
         timeout = float(os.environ.get("BENCH_EC_E2E_TIMEOUT", 600))
         _clean_stale_e2e_dirs()
         script = (
-            "import json, bench\n"
-            f"t, c, ok = bench.measure_encode_e2e({e2e_bytes})\n"
-            "print(json.dumps({'tpu': t, 'cpu': c, 'parity': ok}))\n"
+            "import json, sys, bench\n"
+            "def emit(r):\n"
+            "    print(json.dumps(r)); sys.stdout.flush()\n"
+            f"bench.measure_encode_e2e({e2e_bytes}, emit=emit)\n"
         )
         out = subprocess.run(
             [sys.executable, "-c", script],
@@ -354,23 +478,36 @@ def _run_e2e_timeboxed() -> dict:
             timeout=timeout,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
+        r = parse_last(out.stdout)
         if out.returncode != 0:
             err = (out.stderr or out.stdout)[-400:]
-            if "in use" in err or "already" in err.lower():
-                # device is single-client: run inline instead
-                return _e2e_result(*measure_encode_e2e(e2e_bytes))
-            return {"metric": "ec.encode.e2e", "error": err[-200:]}
-        r = json.loads(out.stdout.strip().splitlines()[-1])
-        return _e2e_result(r["tpu"], r["cpu"], r["parity"])
-    except subprocess.TimeoutExpired:
+            if r is None:
+                if "in use" in err or "already" in err.lower():
+                    # device is single-client: run inline instead
+                    return _e2e_results(measure_encode_e2e(e2e_bytes))
+                return [{"metric": "ec.encode.e2e", "error": err[-200:]}]
+            # partial result + crash (e.g. device leg died): keep the
+            # completed legs but surface the failure on the device metric
+            r.setdefault("error", err[-200:])
+        return _e2e_results(r or {"error": "no output"})
+    except subprocess.TimeoutExpired as te:
         _clean_stale_e2e_dirs()
-        return {
-            "metric": "ec.encode.e2e",
-            "error": "timed out (tunnel-bound; rerun with "
-            "BENCH_EC_E2E_TIMEOUT/BENCH_EC_E2E_BYTES)",
-        }
+        stdout = te.stdout
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+        r = parse_last(stdout)
+        if r is not None:
+            r.setdefault("error", "timed out (tunnel-bound); partial result")
+            return _e2e_results(r)
+        return [
+            {
+                "metric": "ec.encode.e2e",
+                "error": "timed out (tunnel-bound; rerun with "
+                "BENCH_EC_E2E_TIMEOUT/BENCH_EC_E2E_BYTES)",
+            }
+        ]
     except Exception as e:
-        return {"metric": "ec.encode.e2e", "error": str(e)[:200]}
+        return [{"metric": "ec.encode.e2e", "error": str(e)[:200]}]
 
 
 def main() -> None:
@@ -418,7 +555,7 @@ def main() -> None:
     except Exception as e:
         extra.append({"metric": "ec.rebuild_throughput", "error": str(e)[:200]})
 
-    extra.append(_run_e2e_timeboxed())
+    extra.extend(_run_e2e_timeboxed())
 
     print(
         json.dumps(

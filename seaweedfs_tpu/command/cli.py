@@ -49,9 +49,10 @@ def _add_volume_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("-publicUrl", default="")
     p.add_argument(
         "-storageBackend",
-        default=os.environ.get("SEAWEEDFS_TPU_BACKEND", "cpu"),
-        choices=["cpu", "tpu"],
-        help="erasure-coding compute backend",
+        default=os.environ.get("SEAWEEDFS_TPU_BACKEND", "adaptive"),
+        choices=["adaptive", "cpu", "tpu", "numpy"],
+        help="erasure-coding compute backend ('adaptive' measures the device "
+        "round trip once and serves whichever of tpu/cpu is faster here)",
     )
     p.add_argument(
         "-tierConfig",
@@ -260,7 +261,13 @@ def cmd_server(argv: list[str]) -> int:
     p.add_argument("-volumePort", type=int, default=8080)
     p.add_argument("-dataCenter", default="")
     p.add_argument("-rack", default="")
-    p.add_argument("-storageBackend", default="cpu", choices=["cpu", "tpu"])
+    p.add_argument(
+        "-storageBackend",
+        default="adaptive",
+        choices=["adaptive", "cpu", "tpu", "numpy"],
+        help="EC codec route: 'adaptive' measures the device round trip once "
+        "and serves whichever of tpu/cpu is actually faster here",
+    )
     p.add_argument("-tierConfig", default="")
     p.add_argument("-index", default="memory", choices=["memory", "leveldb", "sorted"])
     p.add_argument("-cpuprofile", default="", help="cpu profile output file")

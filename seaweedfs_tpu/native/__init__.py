@@ -21,8 +21,30 @@ _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 
 
+def _cpu_flags() -> set:
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return set(line.split(":", 1)[1].split())
+    except OSError:
+        pass
+    return set()
+
+
 def _build() -> bool:
-    for flags in (["-mssse3"], []):  # fall back to scalar on non-x86
+    """Compile with the widest SIMD this CPU actually has (the flag alone
+    isn't enough — g++ accepts -mavx2 on any x86, then SIGILLs at runtime)."""
+    have = _cpu_flags()
+    candidates = []
+    if "avx2" in have:
+        candidates.append(["-mavx2"])
+    if "ssse3" in have or not have:
+        # no /proc/cpuinfo (macOS, masked /proc): SSSE3 is universal on
+        # x86-64, so keep attempting it rather than silently going scalar
+        candidates.append(["-mssse3"])
+    candidates.append([])  # scalar fallback (also the non-x86 path)
+    for flags in candidates:
         cmd = ["g++", "-O3", "-shared", "-fPIC", *flags, _SRC, "-o", _LIB]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -68,20 +90,27 @@ def available() -> bool:
 
 def gf_matmul_native(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
     """uint8[R,C] x uint8[C,N] -> uint8[R,N] via the native kernel."""
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    return gf_matmul_rows_native(matrix, list(data))
+
+
+def gf_matmul_rows_native(matrix: np.ndarray, rows_in) -> np.ndarray:
+    """Same matmul, but over C separately-allocated contiguous 1-D rows of
+    equal length (the kernel takes per-row pointers, so rows may be views
+    into an mmapped file — no gather copy)."""
     lib = load()
     if lib is None:
         raise RuntimeError("native gf256 library unavailable")
     matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
-    data = np.ascontiguousarray(data, dtype=np.uint8)
     rows, cols = matrix.shape
-    assert data.shape[0] == cols
-    n = data.shape[1]
+    assert len(rows_in) == cols
+    rows_in = [np.ascontiguousarray(r, dtype=np.uint8) for r in rows_in]
+    n = rows_in[0].shape[0]
+    assert all(r.shape == (n,) for r in rows_in)
     out = np.empty((rows, n), dtype=np.uint8)
 
     u8p = ctypes.POINTER(ctypes.c_uint8)
-    data_ptrs = (u8p * cols)(
-        *(row.ctypes.data_as(u8p) for row in data)
-    )
+    data_ptrs = (u8p * cols)(*(r.ctypes.data_as(u8p) for r in rows_in))
     out_ptrs = (u8p * rows)(*(row.ctypes.data_as(u8p) for row in out))
     lib.gf_matmul(
         matrix.ctypes.data_as(u8p), rows, cols, data_ptrs, out_ptrs, n

@@ -48,6 +48,13 @@ class CpuRSCodec:
         assert data.shape[0] == self.data_shards, data.shape
         return self._mat_apply(self.parity_matrix, data)
 
+    def encode_rows(self, rows: Sequence[np.ndarray]) -> np.ndarray:
+        """encode() over k separately-allocated 1-D rows (e.g. views into an
+        mmapped .dat) — the native codec consumes the row pointers without a
+        gather copy; this oracle stacks."""
+        assert len(rows) == self.data_shards
+        return self._mat_apply(self.parity_matrix, np.stack(rows))
+
     def encode_all(self, data: np.ndarray) -> np.ndarray:
         """data: uint8[k, N] -> all shards uint8[k+m, N] (data passthrough)."""
         return np.concatenate([data, self.encode(data)], axis=0)

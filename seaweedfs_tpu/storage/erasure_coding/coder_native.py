@@ -7,6 +7,7 @@ byte-stream matmul runs natively.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import numpy as np
@@ -15,6 +16,13 @@ from .coder_cpu import CpuRSCodec
 
 
 class NativeRSCodec(CpuRSCodec):
+    # ctypes releases the GIL for the duration of the native matmul, so the
+    # file pipeline's worker pool parallelizes encode across cores — the
+    # multi-core equivalent of klauspost/reedsolomon's WithAutoGoroutines
+    # (the reference's ec_encoder.go:120-136 stays single-threaded)
+    preferred_chunk = 4 * 1024 * 1024
+    zero_copy_rows = True  # encode_rows takes per-row pointers (mmap views)
+
     def __init__(self, data_shards: int = 10, parity_shards: int = 4):
         super().__init__(data_shards, parity_shards)
         from ... import native
@@ -22,6 +30,18 @@ class NativeRSCodec(CpuRSCodec):
         if not native.available():
             raise RuntimeError("native gf256 library unavailable")
         self._native = native
+        try:
+            ncpu = len(os.sched_getaffinity(0))  # cgroup/affinity-aware
+        except AttributeError:
+            ncpu = os.cpu_count() or 1
+        self.prefers_pipeline = ncpu > 1
+        self.pipeline_workers = max(2, min(8, ncpu))
 
     def _mat_apply(self, m: np.ndarray, data: np.ndarray) -> np.ndarray:
         return self._native.gf_matmul_native(m, data)
+
+    def encode_rows(self, rows) -> np.ndarray:
+        # per-row pointers straight into the kernel — mmap views encode
+        # without ever being copied into a stacked buffer
+        assert len(rows) == self.data_shards
+        return self._native.gf_matmul_rows_native(self.parity_matrix, rows)

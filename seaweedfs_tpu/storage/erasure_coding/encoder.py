@@ -49,12 +49,27 @@ def _get_codec(codec):
 
 
 def _read_into(f, out: np.ndarray, offset: int) -> None:
-    """Read len(out) bytes at offset, zero-filling past EOF."""
-    b = os.pread(f.fileno(), len(out), offset) if hasattr(f, "fileno") else b""
-    n = len(b)
-    if n:
-        out[:n] = np.frombuffer(b, dtype=np.uint8)
-    if n < len(out):
+    """Read len(out) bytes at offset directly into `out` (no intermediate
+    bytes allocation — preadv writes straight into the numpy buffer),
+    zero-filling past EOF."""
+    if not hasattr(f, "fileno"):
+        out[:] = 0
+        return
+    fd = f.fileno()
+    n = 0
+    want = len(out)
+    if hasattr(os, "preadv"):
+        while n < want:
+            got = os.preadv(fd, [memoryview(out)[n:]], offset + n)
+            if got <= 0:
+                break
+            n += got
+    else:  # macOS: no preadv — fall back to pread + copy
+        b = os.pread(fd, want, offset)
+        n = len(b)
+        if n:
+            out[:n] = np.frombuffer(b, dtype=np.uint8)
+    if n < want:
         out[n:] = 0
 
 
@@ -78,10 +93,61 @@ def _encode_rows(
             for i in range(k):
                 _read_into(dat_f, buf[i], row_start + i * block_size + done)
             parity = codec.encode(buf)
+            # contiguous-row memoryviews: BufferedWriter copies synchronously,
+            # so reusing `data` next iteration is safe and we skip a tobytes()
+            # copy of every byte written
             for i in range(k):
-                outputs[i].write(buf[i].tobytes())
+                if outputs[i] is not None:
+                    outputs[i].write(buf[i].data)
             for p in range(codec.parity_shards):
-                outputs[k + p].write(parity[p].tobytes())
+                outputs[k + p].write(np.ascontiguousarray(parity[p]).data)
+            done += this
+
+
+def _encode_rows_mmap(
+    arr: np.ndarray,
+    outputs,
+    codec,
+    start_offset: int,
+    block_size: int,
+    rows: int,
+    chunk: int,
+) -> None:
+    """Same bytes as _encode_rows, with the .dat mmapped: data rows are
+    zero-copy views into the page cache handed to the codec as row pointers
+    (NativeRSCodec.encode_rows), and data-shard writes (when not spliced)
+    stream straight from the map. Only EOF-straddling tails get copied into
+    a scratch row. The single-core replacement for the reference's
+    read-copy-everything loop (ref ec_encoder.go:120-136)."""
+    k = codec.data_shards
+    dat_size = arr.size
+    scratch = np.empty((k, chunk), dtype=np.uint8)
+    zeros = np.zeros(chunk, dtype=np.uint8)
+    for row in range(rows):
+        row_start = start_offset + row * block_size * k
+        done = 0
+        while done < block_size:
+            this = min(chunk, block_size - done)
+            rows_v = []
+            for i in range(k):
+                off = row_start + i * block_size + done
+                end = off + this
+                if off >= dat_size:
+                    rows_v.append(zeros[:this])
+                elif end <= dat_size:
+                    rows_v.append(arr[off:end])
+                else:
+                    s = scratch[i, :this]
+                    n = dat_size - off
+                    s[:n] = arr[off:dat_size]
+                    s[n:] = 0
+                    rows_v.append(s)
+            parity = np.ascontiguousarray(codec.encode_rows(rows_v))
+            for i in range(k):
+                if outputs[i] is not None:
+                    outputs[i].write(rows_v[i].data)
+            for p in range(codec.parity_shards):
+                outputs[k + p].write(parity[p].data)
             done += this
 
 
@@ -145,13 +211,14 @@ def _encode_rows_pipelined(
 
     def drain(entry) -> None:
         width, g, buf, fut = entry
-        parity = fut.result()
+        parity = np.ascontiguousarray(fut.result())
         for gi in range(g):
             sl = slice(gi * width, gi * width + width)
             for i in range(k):
-                outputs[i].write(buf[i, sl].tobytes())
+                if outputs[i] is not None:
+                    outputs[i].write(buf[i, sl].data)
             for p in range(codec.parity_shards):
-                outputs[k + p].write(parity[p, sl].tobytes())
+                outputs[k + p].write(parity[p, sl].data)
 
     with cf.ThreadPoolExecutor(workers) as pool:
         pending: deque = deque()
@@ -164,6 +231,76 @@ def _encode_rows_pipelined(
             drain(pending.popleft())
 
 
+def _splice_data_shards(
+    dat_path: str,
+    base_file_name: str,
+    k: int,
+    n_large: int,
+    large_block: int,
+    n_small: int,
+    small_block: int,
+) -> bool:
+    """Assemble the k data-shard files as kernel-side copies of the .dat
+    (copy_file_range) — their content is a pure interleaving of the source,
+    so it never needs to transit user space; only parity does. Zero padding
+    past EOF becomes file holes (byte-identical content, no page traffic).
+
+    Returns False (with any partial files removed) when the kernel/filesystem
+    refuses the splice; the caller then writes data shards inline. The
+    reference streams every data byte back out through its user-space buffer
+    (ref ec_encoder.go:120-136); this is the host-side analogue of keeping
+    the MXU fed only with bytes that need compute.
+    """
+    if not hasattr(os, "copy_file_range"):
+        return False
+    shard_size = n_large * large_block + n_small * small_block
+    dat_size = os.path.getsize(dat_path)
+    written = []
+    try:
+        with open(dat_path, "rb") as src:
+            sfd = src.fileno()
+            for i in range(k):
+                path = base_file_name + to_ext(i)
+                with open(path, "wb") as out:
+                    written.append(path)
+                    ofd = out.fileno()
+                    out_pos = 0
+
+                    def copy_block(src_off: int, length: int) -> None:
+                        nonlocal out_pos
+                        avail = max(0, min(length, dat_size - src_off))
+                        done = 0
+                        while done < avail:
+                            got = os.copy_file_range(
+                                sfd, ofd, avail - done, src_off + done,
+                                out_pos + done,
+                            )
+                            if got <= 0:
+                                raise OSError("copy_file_range stalled")
+                            done += got
+                        out_pos += length  # hole for the zero tail
+
+                    for row in range(n_large):
+                        copy_block(
+                            (row * k + i) * large_block, large_block
+                        )
+                    small_base = n_large * k * large_block
+                    for row in range(n_small):
+                        copy_block(
+                            small_base + (row * k + i) * small_block,
+                            small_block,
+                        )
+                    os.ftruncate(ofd, shard_size)
+        return True
+    except OSError:
+        for path in written:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        return False
+
+
 def write_ec_files(
     base_file_name: str,
     codec=None,
@@ -171,55 +308,111 @@ def write_ec_files(
     small_block_size: int = EC_SMALL_BLOCK_SIZE,
     chunk: int = DEFAULT_CHUNK,
     pipeline: Optional[bool] = None,
+    splice_data: Optional[bool] = None,
+    mmap_input: Optional[bool] = None,
 ) -> None:
     """Generate .ec00-.ec13 from .dat (ref WriteEcFiles, ec_encoder.go:57).
 
     pipeline=None follows the codec's preference: the TPU codec overlaps
     disk IO with device encode (_encode_rows_pipelined); the CPU codec
-    keeps the reference's synchronous structure.
+    keeps the reference's synchronous structure. splice_data=None tries the
+    kernel-side data-shard splice and falls back to inline writes.
+    mmap_input=None picks the zero-copy mmapped-read path automatically
+    (row-pointer host codec, no pipeline); True forces it for a non-pipelined
+    host codec, False disables it.
     """
     codec = _get_codec(codec)
     if pipeline is None:
         pipeline = getattr(codec, "prefers_pipeline", False)
+    # zero-copy views of the mmapped .dat: the single-core host structure
+    if mmap_input is None:
+        use_mmap = not pipeline and getattr(codec, "zero_copy_rows", False)
+    else:
+        use_mmap = (
+            mmap_input and not pipeline and hasattr(codec, "encode_rows")
+        )
     if pipeline and chunk == DEFAULT_CHUNK:
         chunk = getattr(codec, "preferred_chunk", chunk)
-    encode_rows = _encode_rows_pipelined if pipeline else _encode_rows
+    if pipeline:
+        workers = getattr(codec, "pipeline_workers", 2)
+
+        def encode_rows(*a):
+            _encode_rows_pipelined(*a, workers=workers)
+
+    else:
+        encode_rows = _encode_rows
     k = codec.data_shards
-    dat_size = os.path.getsize(base_file_name + ".dat")
+    dat_path = base_file_name + ".dat"
+    dat_size = os.path.getsize(dat_path)
+    if dat_size == 0:
+        use_mmap = False
+
+    remaining = dat_size
+    large_row = large_block_size * k
+    # large rows while MORE than one full row remains (strict >,
+    # ref ec_encoder.go:214)
+    n_large = 0
+    while remaining - n_large * large_row > large_row:
+        n_large += 1
+    remaining -= n_large * large_row
+    # small rows while any data remains (ref ec_encoder.go:222)
+    small_row = small_block_size * k
+    n_small = 0
+    while remaining > 0:
+        n_small += 1
+        remaining -= small_row
+
+    spliced = False
+    if splice_data is None or splice_data:
+        spliced = _splice_data_shards(
+            dat_path, base_file_name, k,
+            n_large, large_block_size, n_small, small_block_size,
+        )
+
     outputs = [
-        open(base_file_name + to_ext(i), "wb") for i in range(codec.total_shards)
+        None if (spliced and i < k) else open(base_file_name + to_ext(i), "wb")
+        for i in range(codec.total_shards)
     ]
     try:
-        with open(base_file_name + ".dat", "rb") as dat_f:
-            remaining = dat_size
-            processed = 0
-            large_row = large_block_size * k
-            # large rows while MORE than one full row remains (strict >,
-            # ref ec_encoder.go:214)
-            n_large = 0
-            while remaining - n_large * large_row > large_row:
-                n_large += 1
-            encode_rows(
-                dat_f, outputs, codec, processed, large_block_size, n_large, chunk
-            )
-            processed += n_large * large_row
-            remaining -= n_large * large_row
-            # small rows while any data remains (ref ec_encoder.go:222)
-            small_row = small_block_size * k
-            n_small = 0
-            rem = remaining
-            while rem > 0:
-                n_small += 1
-                rem -= small_row
-            # the pipelined path groups multiple small rows per call, so it
-            # keeps the full chunk; the sync path clamps to one block
-            encode_rows(
-                dat_f, outputs, codec, processed, small_block_size, n_small,
-                chunk if pipeline else min(chunk, small_block_size),
-            )
+        with open(dat_path, "rb") as dat_f:
+            small_chunk = chunk if pipeline else min(chunk, small_block_size)
+            if use_mmap:
+                import mmap as mmap_mod
+
+                mm = None
+                arr = None
+                try:
+                    mm = mmap_mod.mmap(
+                        dat_f.fileno(), 0, access=mmap_mod.ACCESS_READ
+                    )
+                    arr = np.frombuffer(mm, dtype=np.uint8)
+                    _encode_rows_mmap(
+                        arr, outputs, codec, 0,
+                        large_block_size, n_large, chunk,
+                    )
+                    _encode_rows_mmap(
+                        arr, outputs, codec, n_large * large_row,
+                        small_block_size, n_small, small_chunk,
+                    )
+                finally:
+                    # drop the exported view before closing the map
+                    arr = None
+                    if mm is not None:
+                        mm.close()
+            else:
+                encode_rows(
+                    dat_f, outputs, codec, 0, large_block_size, n_large, chunk
+                )
+                # the pipelined path groups multiple small rows per call, so
+                # it keeps the full chunk; the sync path clamps to one block
+                encode_rows(
+                    dat_f, outputs, codec, n_large * large_row,
+                    small_block_size, n_small, small_chunk,
+                )
     finally:
         for f in outputs:
-            f.close()
+            if f is not None:
+                f.close()
 
 
 def write_sorted_file_from_idx(base_file_name: str, ext: str = ".ecx") -> None:

@@ -1,0 +1,128 @@
+"""Native-codec encode structures: mmap zero-copy rows, kernel-side data
+splice, pipelined workers, and the adaptive route — all byte-identical to the
+CpuRSCodec oracle (ref semantics: weed/storage/erasure_coding/ec_encoder.go).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage.erasure_coding import to_ext, write_ec_files
+from seaweedfs_tpu.storage.erasure_coding.coder_cpu import CpuRSCodec
+
+native = pytest.importorskip("seaweedfs_tpu.native")
+if not native.available():
+    pytest.skip("native gf256 library unavailable", allow_module_level=True)
+
+from seaweedfs_tpu.storage.erasure_coding.coder_native import NativeRSCodec
+
+LARGE, SMALL = 8192, 1024  # scaled-down 1GB/1MB geometry
+
+
+def _write_dat(path: str, size: int) -> None:
+    data = np.random.default_rng(size).integers(0, 256, size, dtype=np.uint8)
+    with open(path, "wb") as f:
+        f.write(data.tobytes())
+
+
+def _read_shards(base: str) -> list:
+    out = []
+    for i in range(14):
+        with open(base + to_ext(i), "rb") as f:
+            out.append(f.read())
+    return out
+
+
+# sizes hitting: large rows + small rows + EOF mid-block + EOF mid-row
+SIZES = [LARGE * 10 * 2 + SMALL * 10 * 3 + 700, SMALL * 4 + 17, 0, SMALL * 10]
+
+
+@pytest.mark.parametrize("size", SIZES)
+def test_mmap_and_splice_match_oracle(tmp_path, size):
+    oracle = tmp_path / "o"
+    oracle.mkdir()
+    _write_dat(str(oracle / "1.dat"), size)
+    write_ec_files(
+        str(oracle / "1"), codec=CpuRSCodec(),
+        large_block_size=LARGE, small_block_size=SMALL,
+    )
+    golden = _read_shards(str(oracle / "1"))
+
+    for label, kw in [
+        ("auto", {}),  # mmap (+ splice when the fs allows) on 1 core
+        ("mmap", {"pipeline": False, "mmap_input": True}),
+        ("mmap-no-splice", {"pipeline": False, "mmap_input": True,
+                            "splice_data": False}),
+        ("sync", {"pipeline": False, "splice_data": False,
+                  "mmap_input": False}),
+        ("pipelined", {"pipeline": True}),
+    ]:
+        d = tmp_path / label
+        d.mkdir()
+        os.link(str(oracle / "1.dat"), str(d / "1.dat"))
+        write_ec_files(
+            str(d / "1"), codec=NativeRSCodec(),
+            large_block_size=LARGE, small_block_size=SMALL, **kw,
+        )
+        assert _read_shards(str(d / "1")) == golden, (label, size)
+
+
+def test_encode_rows_pointer_api_matches_stacked():
+    c = NativeRSCodec()
+    oracle = CpuRSCodec()
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, (10, 4096), dtype=np.uint8)
+    rows = [np.ascontiguousarray(r) for r in data]
+    assert np.array_equal(c.encode_rows(rows), oracle.encode(data))
+    # read-only views (the mmap case) must work too
+    ro = [r.copy() for r in rows]
+    for r in ro:
+        r.flags.writeable = False
+    assert np.array_equal(c.encode_rows(ro), oracle.encode(data))
+
+
+def test_adaptive_codec_falls_back_on_poisoned_device(monkeypatch):
+    from seaweedfs_tpu.tpu import coder
+
+    coder.reset_adaptive_cache()
+
+    class _Dev:
+        platform = "tpu"
+
+    def boom(*a, **k):
+        raise RuntimeError("device backend poisoned")
+
+    import jax
+
+    monkeypatch.setattr(jax, "devices", lambda *a: [_Dev()])
+    monkeypatch.setattr(coder, "probe_roundtrip_seconds", boom)
+    try:
+        c = coder.adaptive_codec()
+        assert isinstance(c, CpuRSCodec)  # NativeRSCodec subclasses it
+    finally:
+        coder.reset_adaptive_cache()
+
+
+def test_adaptive_codec_cpu_platform_short_circuits(monkeypatch):
+    from seaweedfs_tpu.tpu import coder
+
+    coder.reset_adaptive_cache()
+
+    class _Dev:
+        platform = "cpu"
+
+    import jax
+
+    monkeypatch.setattr(jax, "devices", lambda *a: [_Dev()])
+
+    def no_probe(*a, **k):  # must not be consulted on the cpu platform
+        raise AssertionError("probe should not run")
+
+    monkeypatch.setattr(coder, "probe_roundtrip_seconds", no_probe)
+    try:
+        c = coder.adaptive_codec()
+        assert isinstance(c, CpuRSCodec)
+        assert coder.adaptive_codec() is c  # cached
+    finally:
+        coder.reset_adaptive_cache()
