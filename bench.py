@@ -373,6 +373,106 @@ def measure_encode_e2e(size_bytes: int = 4 << 30, emit=None):
         shutil.rmtree(d, ignore_errors=True)
 
 
+def measure_serving_qps(
+    num_files: int = 3000, concurrency: int = 16
+) -> dict:
+    """Write + random-read QPS of 1KB files through the full HTTP serving
+    stack — in-process master + volume server on tmpfs, the `weed benchmark`
+    workload (BASELINE.json config 4; reference numbers: 15,708 write /
+    47,019 read #/sec, ref README.md:483-530).
+
+    Reads are measured twice: per-request index lookups (the reference's
+    structure), then with the BatchLookupGate micro-batching concurrent
+    probes through one vectorized bulk_lookup per tick (north-star #2's
+    serving path; `-batchLookup` on the CLI). Set BENCH_QPS_DEVICE=1 to
+    force the gate's batches onto the device kernel as a third leg
+    (meaningful on directly-attached chips; over the bench tunnel the
+    per-batch RTT dominates and the auto policy correctly serves from the
+    host snapshot instead)."""
+    import asyncio
+    import shutil
+    import socket
+    import tempfile
+
+    d = tempfile.mkdtemp(
+        prefix="bench_qps_", dir="/dev/shm" if os.path.isdir("/dev/shm") else None
+    )
+    out: dict = {"num_files": num_files, "concurrency": concurrency}
+
+    def free_port_pair() -> int:
+        for p in range(18200, 19200):
+            try:
+                with socket.socket() as s:
+                    s.bind(("127.0.0.1", p))
+                with socket.socket() as s:
+                    s.bind(("127.0.0.1", p + 10000))
+                return p
+            except OSError:
+                continue
+        raise RuntimeError("no free port pair")
+
+    async def body() -> None:
+        from seaweedfs_tpu.command.benchmark import run_benchmark
+        from seaweedfs_tpu.pb.rpc import close_all_channels
+        from seaweedfs_tpu.server.lookup_gate import BatchLookupGate
+        from seaweedfs_tpu.server.master import MasterServer
+        from seaweedfs_tpu.server.volume import VolumeServer
+
+        ms = MasterServer(port=free_port_pair(), pulse_seconds=0.2)
+        await ms.start()
+        vs = VolumeServer(
+            master=ms.address,
+            directories=[d],
+            port=free_port_pair(),
+            pulse_seconds=0.2,
+            max_volume_counts=[20],
+        )
+        await vs.start()
+        try:
+            for _ in range(100):
+                if ms.topo.data_nodes():
+                    break
+                await asyncio.sleep(0.1)
+
+            s1: dict = {}
+            await run_benchmark(
+                ms.address, num_files=num_files, file_size=1024,
+                concurrency=concurrency, stats_out=s1,
+            )
+            out["write_qps"] = round(s1.get("write_qps", 0))
+            out["read_qps"] = round(s1.get("read_qps", 0))
+            out["failed"] = s1.get("write_failed", 0) + s1.get("read_failed", 0)
+
+            vs.lookup_gate = BatchLookupGate(vs.store, use_device=False)
+            s2: dict = {}
+            await run_benchmark(
+                ms.address, num_files=num_files, file_size=1024,
+                concurrency=concurrency, stats_out=s2,
+            )
+            out["read_qps_batched"] = round(s2.get("read_qps", 0))
+            out["batched_failed"] = s2.get("read_failed", 0)
+            out["largest_batch"] = vs.lookup_gate.stats["largest_batch"]
+
+            if os.environ.get("BENCH_QPS_DEVICE"):
+                vs.lookup_gate = BatchLookupGate(vs.store, use_device=True)
+                s3: dict = {}
+                await run_benchmark(
+                    ms.address, num_files=num_files, file_size=1024,
+                    concurrency=concurrency, stats_out=s3,
+                )
+                out["read_qps_batched_device"] = round(s3.get("read_qps", 0))
+        finally:
+            await vs.stop()
+            await ms.stop()
+            await close_all_channels()
+
+    try:
+        asyncio.run(body())
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 _E2E_NOTE = (
     "tunnel transfer-bound (~0.5/0.03 GB/s up/down host<->device in this "
     "env); see measure_encode_e2e"
@@ -413,7 +513,12 @@ def _e2e_results(r: dict) -> list:
     elif "error" in r:
         # the leg that died is the first one whose result is absent — keep
         # the measured baseline so a partial run still records evidence
-        died = "best" if "best_gbps" not in r and ref else "device"
+        if not ref:
+            died = "baseline"
+        elif "best_gbps" not in r:
+            died = "best"
+        else:
+            died = "device"
         out.append(
             {
                 "metric": "ec.encode.e2e",
@@ -554,6 +659,32 @@ def main() -> None:
         )
     except Exception as e:
         extra.append({"metric": "ec.rebuild_throughput", "error": str(e)[:200]})
+
+    try:
+        qps = measure_serving_qps(
+            num_files=int(os.environ.get("BENCH_QPS_FILES", 3000))
+        )
+        best_read = max(qps.get("read_qps", 0), qps.get("read_qps_batched", 0))
+        extra.append(
+            {
+                "metric": "serving_read_qps",
+                "value": best_read,
+                "unit": "#/sec",
+                # ref `weed benchmark` random reads, README.md:511-518
+                "vs_baseline": round(best_read / 47019.38, 3),
+                "write_qps": qps.get("write_qps"),
+                # ref writes 15,708.23 #/sec, README.md:483-492
+                "write_vs_baseline": round(
+                    (qps.get("write_qps") or 0) / 15708.23, 3
+                ),
+                "detail": qps,
+                "note": "in-process aiohttp cluster on tmpfs, 1KB x "
+                f"{qps.get('num_files')} files, c={qps.get('concurrency')}; "
+                "read_qps_batched = BatchLookupGate micro-batched probes",
+            }
+        )
+    except Exception as e:
+        extra.append({"metric": "serving_read_qps", "error": str(e)[:200]})
 
     extra.extend(_run_e2e_timeboxed())
 

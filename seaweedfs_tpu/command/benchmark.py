@@ -12,6 +12,7 @@ import asyncio
 import random
 import time
 from dataclasses import dataclass, field
+from typing import Optional
 
 import aiohttp
 
@@ -98,7 +99,11 @@ async def run_benchmark(
     collection: str = "",
     do_write: bool = True,
     do_read: bool = True,
+    stats_out: Optional[dict] = None,
 ) -> str:
+    """Returns the human report; when `stats_out` is given it also receives
+    {write_qps, write_failed, read_qps, read_failed} for machine use
+    (bench.py's serving-QPS north-star entry)."""
     out = []
     mc = MasterClient("benchmark", [master])
     await mc.start()
@@ -138,6 +143,11 @@ async def run_benchmark(
                 await asyncio.gather(*(writer() for _ in range(concurrency)))
                 stats.end = time.perf_counter()
             out.append(stats.report(concurrency))
+            if stats_out is not None:
+                stats_out["write_qps"] = stats.completed / max(
+                    stats.end - stats.start, 1e-9
+                )
+                stats_out["write_failed"] = stats.failed
 
         if do_read and fids:
             stats = Stats("Randomly Reading Benchmark")
@@ -156,7 +166,10 @@ async def run_benchmark(
                             return
                         t0 = time.perf_counter()
                         try:
-                            url = mc.lookup_file_id(fid)
+                            # cache hit normally; falls back to a master RPC
+                            # when the vid cache hasn't learned a
+                            # freshly-grown volume yet
+                            url = await mc.lookup_file_id_async(fid)
                             data = await read_url(session, url)
                             stats.record(time.perf_counter() - t0, len(data))
                         except Exception:
@@ -166,6 +179,11 @@ async def run_benchmark(
                 await asyncio.gather(*(reader() for _ in range(concurrency)))
                 stats.end = time.perf_counter()
             out.append(stats.report(concurrency))
+            if stats_out is not None:
+                stats_out["read_qps"] = stats.completed / max(
+                    stats.end - stats.start, 1e-9
+                )
+                stats_out["read_failed"] = stats.failed
     finally:
         await mc.stop()
     return "\n".join(out)

@@ -55,6 +55,13 @@ def _add_volume_flags(p: argparse.ArgumentParser) -> None:
         "round trip once and serves whichever of tpu/cpu is faster here)",
     )
     p.add_argument(
+        "-batchLookup",
+        default="off",
+        choices=["off", "auto", "host", "device"],
+        help="micro-batch concurrent read index probes through one "
+        "vectorized bulk lookup (device IndexSnapshot when attached)",
+    )
+    p.add_argument(
         "-tierConfig",
         default="",
         help="JSON file configuring storage.backend tiers"
@@ -187,6 +194,7 @@ def _build_volume_server(args, port_offset: int = 0):
         white_list=tuple(
             x for x in getattr(args, "whiteList", "").split(",") if x
         ),
+        batch_lookup=getattr(args, "batchLookup", "off"),
     )
 
 
@@ -263,10 +271,17 @@ def cmd_server(argv: list[str]) -> int:
     p.add_argument("-rack", default="")
     p.add_argument(
         "-storageBackend",
-        default="adaptive",
+        default=os.environ.get("SEAWEEDFS_TPU_BACKEND", "adaptive"),
         choices=["adaptive", "cpu", "tpu", "numpy"],
         help="EC codec route: 'adaptive' measures the device round trip once "
         "and serves whichever of tpu/cpu is actually faster here",
+    )
+    p.add_argument(
+        "-batchLookup",
+        default="off",
+        choices=["off", "auto", "host", "device"],
+        help="micro-batch concurrent read index probes through one "
+        "vectorized bulk lookup (device IndexSnapshot when attached)",
     )
     p.add_argument("-tierConfig", default="")
     p.add_argument("-index", default="memory", choices=["memory", "leveldb", "sorted"])
@@ -337,6 +352,7 @@ def cmd_server(argv: list[str]) -> int:
         jwt_signing_key=args.jwtSigningKey,
         pprof=args.pprof,
         white_list=tuple(x for x in args.whiteList.split(",") if x),
+        batch_lookup=getattr(args, "batchLookup", "off"),
     )
     servers = [ms, vs]
     desc = (

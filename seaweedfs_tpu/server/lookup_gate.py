@@ -1,0 +1,104 @@
+"""Cross-request micro-batching of needle-index probes.
+
+The reference serves every read with its own CompactMap binary search inside
+the request handler (ref: weed/server/volume_server_handlers_read.go:28-39 →
+weed/storage/needle_map/compact_map.go:145-172). The TPU-first shape is the
+opposite: concurrent GETs landing within a sub-millisecond window pool their
+(vid, key) probes, one vectorized `Volume.bulk_lookup` serves the whole
+batch — riding the device-resident IndexSnapshot kernel when a device is
+attached, or the numpy sorted-column snapshot otherwise — and each waiting
+request resumes with its (offset, size). This is north-star #2's serving
+path: lookups become batched data-parallel work instead of per-request
+pointer chasing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+import numpy as np
+
+
+class BatchLookupGate:
+    """Collects concurrent fid probes for up to `window_ms`, then flushes
+    them per-volume through Volume.bulk_lookup.
+
+    use_device: None = Volume.bulk_lookup's own policy (device when attached
+    and the batch is worth a dispatch), True/False force it.
+    """
+
+    def __init__(
+        self,
+        store,
+        window_ms: float = 0.5,
+        max_batch: int = 4096,
+        use_device: Optional[bool] = None,
+    ):
+        self.store = store
+        self.window = window_ms / 1000.0
+        self.max_batch = max_batch
+        self.use_device = use_device
+        self._pending: dict = {}  # vid -> list[(key, future)]
+        self._count = 0
+        self._timer = None
+        self.stats = {"probes": 0, "batches": 0, "largest_batch": 0}
+
+    async def lookup(self, vid: int, key: int):
+        """-> (offset_units, size) or None when absent/deleted."""
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+        self._pending.setdefault(vid, []).append((key, fut))
+        self._count += 1
+        if self._count >= self.max_batch:
+            self._flush()
+        elif self._timer is None:
+            self._timer = loop.call_later(self.window, self._flush)
+        return await fut
+
+    def _flush(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        pending, self._pending, self._count = self._pending, {}, 0
+        for vid, items in pending.items():
+            self.stats["probes"] += len(items)
+            self.stats["batches"] += 1
+            self.stats["largest_batch"] = max(
+                self.stats["largest_batch"], len(items)
+            )
+            asyncio.ensure_future(self._run_batch(vid, items))
+
+    async def _run_batch(self, vid: int, items: list) -> None:
+        try:
+            v = self.store.find_volume(vid)
+            if v is None:
+                raise LookupError(f"volume {vid} not found")
+            keys = np.array([k for k, _ in items], dtype=np.uint64)
+            loop = asyncio.get_event_loop()
+            offsets, sizes, found = await loop.run_in_executor(
+                None, v.bulk_lookup, keys, self.use_device
+            )
+            for i, (_k, fut) in enumerate(items):
+                if fut.done():
+                    continue
+                fut.set_result(
+                    (int(offsets[i]), int(sizes[i])) if found[i] else None
+                )
+        except Exception as e:
+            # surface the original error to every waiter (a LookupError maps
+            # to 404 in the handler; anything else becomes a 500 there)
+            for _k, fut in items:
+                if not fut.done():
+                    fut.set_exception(e)
+
+    def close(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        for _vid, items in self._pending.items():
+            for _k, fut in items:
+                if not fut.done():
+                    fut.set_exception(LookupError("gate closed"))
+        self._pending = {}
+        self._count = 0

@@ -62,6 +62,7 @@ class VolumeServer(EcHandlers):
         needle_map_kind: str = "memory",
         pprof: bool = False,
         white_list: tuple = (),
+        batch_lookup: str = "off",
     ):
         self.jwt_signing_key = jwt_signing_key
         self.pprof = pprof
@@ -100,6 +101,18 @@ class VolumeServer(EcHandlers):
         self._shutdown = False
         self._codec = None
         self._group_committers: dict[int, object] = {}
+        # cross-request probe batching (north-star #2 serving path):
+        # off | auto (bulk_lookup's device policy) | host | device
+        self.lookup_gate = None
+        if batch_lookup not in ("off", "", None):
+            from .lookup_gate import BatchLookupGate
+
+            self.lookup_gate = BatchLookupGate(
+                self.store,
+                use_device={"auto": None, "host": False, "device": True}[
+                    batch_lookup
+                ],
+            )
 
     def _group_committer(self, vid: int):
         gc = self._group_committers.get(vid)
@@ -161,6 +174,8 @@ class VolumeServer(EcHandlers):
 
     async def stop(self) -> None:
         self._shutdown = True
+        if self.lookup_gate is not None:
+            self.lookup_gate.close()
         for gc in self._group_committers.values():
             await gc.stop()
         if self._heartbeat_task is not None:
@@ -362,7 +377,34 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
         if self.store.has_volume(vid):
             n = Needle(id=fid.key)
             v = self.store.find_volume(vid)
-            if v is not None and v.has_remote_file:
+            gated = (
+                self.lookup_gate is not None
+                and v is not None
+                and not v.has_remote_file
+            )
+            if gated:
+                # batched serving path: the index probe joins the gate's
+                # current micro-batch (one vectorized bulk_lookup for all
+                # concurrent requests) and only the pread stays per-request
+                loc = await self.lookup_gate.lookup(vid, fid.key)
+                if loc is None:
+                    return web.json_response(
+                        {"error": "not found"}, status=404
+                    )
+                offset_units, size = loc
+                try:
+                    if size > 0:
+                        n = v.read_needle_at(offset_units, size)
+                    stale = size > 0 and n.cookie != fid.cookie
+                except Exception:
+                    stale = True
+                if stale:
+                    # a vacuum commit may have rewritten the .dat between
+                    # the batched probe and the pread — re-resolve through
+                    # the locked per-request path, which is atomic
+                    n = Needle(id=fid.key)
+                    self.store.read_volume_needle(vid, n)
+            elif v is not None and v.has_remote_file:
                 # tiered volume: the backend does blocking remote I/O —
                 # keep it off the event loop
                 await asyncio.get_event_loop().run_in_executor(

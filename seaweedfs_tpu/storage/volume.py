@@ -436,6 +436,8 @@ class Volume:
                 return self.nm.snapshot()
 
         accel = self._index_cache.get(self.nm.snapshot_token, locked_cols)
+        # IndexSnapshot.lookup pads probe batches to power-of-two buckets
+        # itself, so variable micro-batch sizes don't each jit-compile
         return accel.lookup(keys)
 
     def read_needle_at(self, offset_units: int, size: int) -> Needle:

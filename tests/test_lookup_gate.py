@@ -1,0 +1,125 @@
+"""BatchLookupGate: micro-batched read serving through a live cluster
+(north-star #2 e2e; ref read path: volume_server_handlers_read.go:28-39)."""
+
+import asyncio
+import random
+import socket
+
+import aiohttp
+import pytest
+
+from seaweedfs_tpu.client import assign
+from seaweedfs_tpu.client.operation import read_url, upload_data
+from seaweedfs_tpu.pb.rpc import close_all_channels
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+
+
+def _free_port() -> int:
+    for p in range(21000, 22000):
+        try:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", p))
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", p + 10000))
+            return p
+        except OSError:
+            continue
+    raise RuntimeError("no free port pair")
+
+
+@pytest.mark.parametrize("mode", ["host", "auto"])
+def test_batched_reads_serve_correct_bytes(tmp_path, mode):
+    async def body():
+        ms = MasterServer(port=_free_port(), pulse_seconds=0.2)
+        await ms.start()
+        vs = VolumeServer(
+            master=ms.address,
+            directories=[str(tmp_path)],
+            port=_free_port(),
+            pulse_seconds=0.2,
+            max_volume_counts=[10],
+            batch_lookup=mode,
+        )
+        await vs.start()
+        try:
+            for _ in range(100):
+                if ms.topo.data_nodes():
+                    break
+                await asyncio.sleep(0.1)
+            assert vs.lookup_gate is not None
+
+            payloads = {}
+            async with aiohttp.ClientSession() as session:
+                for i in range(40):
+                    ar = await assign(ms.address)
+                    data = random.randbytes(500 + i)
+                    await upload_data(session, ar.url, ar.fid, data)
+                    payloads[ar.fid] = (ar.url, data)
+
+                # concurrent reads land in shared micro-batches
+                async def read_one(fid, url, want):
+                    got = await read_url(session, f"http://{url}/{fid}")
+                    assert got == want, fid
+
+                await asyncio.gather(
+                    *(
+                        read_one(fid, url, data)
+                        for fid, (url, data) in payloads.items()
+                    )
+                )
+                assert vs.lookup_gate.stats["probes"] >= len(payloads)
+                assert vs.lookup_gate.stats["largest_batch"] > 1
+                assert (
+                    vs.lookup_gate.stats["batches"]
+                    < vs.lookup_gate.stats["probes"]
+                )
+
+                # absent needle and wrong cookie both 404 through the gate
+                some_fid, (url, _) = next(iter(payloads.items()))
+                vid = some_fid.split(",")[0]
+                async with session.get(
+                    f"http://{url}/{vid},ffffffffffffffff"
+                ) as resp:
+                    assert resp.status in (400, 404)
+                wrong_cookie = some_fid[:-8] + (
+                    "00000001"
+                    if some_fid[-8:] != "00000001"
+                    else "00000002"
+                )
+                async with session.get(
+                    f"http://{url}/{wrong_cookie}"
+                ) as resp:
+                    assert resp.status == 404
+
+                # delete, then the gate must report it gone
+                async with session.delete(
+                    f"http://{url}/{some_fid}"
+                ) as resp:
+                    assert resp.status in (200, 202)
+                async with session.get(f"http://{url}/{some_fid}") as resp:
+                    assert resp.status == 404
+        finally:
+            await vs.stop()
+            await ms.stop()
+            await close_all_channels()
+
+    asyncio.run(body())
+
+
+def test_gate_close_cancels_waiters(tmp_path):
+    from seaweedfs_tpu.server.lookup_gate import BatchLookupGate
+
+    class _Store:
+        def find_volume(self, vid):
+            return None
+
+    async def body():
+        gate = BatchLookupGate(_Store(), window_ms=1000)
+        task = asyncio.ensure_future(gate.lookup(1, 42))
+        await asyncio.sleep(0.01)
+        gate.close()
+        with pytest.raises(LookupError):
+            await task
+
+    asyncio.run(body())
