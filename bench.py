@@ -373,6 +373,73 @@ def measure_encode_e2e(size_bytes: int = 4 << 30, emit=None):
         shutil.rmtree(d, ignore_errors=True)
 
 
+def measure_multi_encode(
+    n_volumes: int = 8, vol_bytes: int = 32 << 20
+) -> dict:
+    """Aggregate GB/s of encoding `n_volumes` concurrently through
+    write_ec_files_multi vs the same volumes sequentially through the
+    single-volume pipeline, same (adaptive) codec — BASELINE.json config 3.
+    Device codecs stream shared wide batches; host codecs run volumes across
+    cores. Steady-state: best of 2 runs each."""
+    import shutil
+    import tempfile
+
+    from seaweedfs_tpu.storage.erasure_coding import (
+        write_ec_files,
+        write_ec_files_multi,
+    )
+    from seaweedfs_tpu.tpu.coder import adaptive_codec
+
+    shm_ok = (
+        os.path.isdir("/dev/shm")
+        and shutil.disk_usage("/dev/shm").free > 4 * n_volumes * vol_bytes
+    )
+    d = tempfile.mkdtemp(
+        prefix="bench_multi_", dir="/dev/shm" if shm_ok else None
+    )
+    total = n_volumes * vol_bytes
+    try:
+        block = np.random.default_rng(2).integers(
+            0, 256, size=min(vol_bytes, 64 << 20), dtype=np.uint8
+        ).tobytes()
+        bases = []
+        for v in range(n_volumes):
+            os.makedirs(os.path.join(d, str(v)))
+            base = os.path.join(d, str(v), "1")
+            with open(base + ".dat", "wb") as f:
+                left = vol_bytes
+                while left > 0:
+                    f.write(block[: min(left, len(block))])
+                    left -= len(block)
+            bases.append(base)
+
+        codec = adaptive_codec()
+
+        def run_seq() -> None:
+            for base in bases:
+                write_ec_files(base, codec=codec)
+
+        def run_multi() -> None:
+            write_ec_files_multi(bases, codec=codec)
+
+        out = {
+            "n_volumes": n_volumes,
+            "vol_bytes": vol_bytes,
+            "tmpfs": shm_ok,
+            "backend": type(codec).__name__,
+        }
+        for name, fn in (("seq_gbps", run_seq), ("multi_gbps", run_multi)):
+            best_t = float("inf")
+            for _rep in range(2):
+                t0 = time.perf_counter()
+                fn()
+                best_t = min(best_t, time.perf_counter() - t0)
+            out[name] = total / best_t / 1e9
+        return out
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def measure_serving_qps(
     num_files: int = 3000, concurrency: int = 16
 ) -> dict:
@@ -685,6 +752,26 @@ def main() -> None:
         )
     except Exception as e:
         extra.append({"metric": "serving_read_qps", "error": str(e)[:200]})
+
+    try:
+        m = measure_multi_encode(
+            n_volumes=int(os.environ.get("BENCH_MULTI_VOLS", 8)),
+            vol_bytes=int(os.environ.get("BENCH_MULTI_MB", 32)) << 20,
+        )
+        extra.append(
+            {
+                "metric": "ec.encode.multi",
+                "value": round(m["multi_gbps"], 3),
+                "unit": "GB/s",
+                # vs the same volumes encoded one at a time, same codec
+                "vs_baseline": round(m["multi_gbps"] / m["seq_gbps"], 2),
+                "detail": m,
+                "note": f"{m['n_volumes']} volumes encoded concurrently "
+                "(write_ec_files_multi) vs sequentially, adaptive codec",
+            }
+        )
+    except Exception as e:
+        extra.append({"metric": "ec.encode.multi", "error": str(e)[:200]})
 
     extra.extend(_run_e2e_timeboxed())
 

@@ -34,6 +34,7 @@ class TpuRSCodec:
     # large chunks amortize per-dispatch/transfer latency
     prefers_pipeline = True
     preferred_chunk = 16 * 1024 * 1024
+    is_device = True  # multi-volume encode batches pieces into wide dispatches
 
     def __init__(
         self,

@@ -362,7 +362,8 @@ async def cmd_ec_encode(env, argv) -> str:
             return f"bad -shards {flags['shards']!r}; want e.g. 10.4 or 6.3"
     vids: list[int] = []
     if "volumeId" in flags:
-        vids = [int(flags["volumeId"])]
+        # comma-separated ids allowed: co-located ones encode as one batch
+        vids = [int(x) for x in str(flags["volumeId"]).split(",") if x]
     else:
         full_pct = float(flags.get("fullPercent", 95))
         nodes = await env.collect_data_nodes()
@@ -378,24 +379,75 @@ async def cmd_ec_encode(env, argv) -> str:
                     seen.add(vid)
                     vids.append(vid)
     results = []
+    # volumes co-located on one node encode as a single shared batch
+    # (VolumeEcShardsGenerateBatch -> write_ec_files_multi): one device
+    # dispatch per round serves every volume instead of encoding serially
+    nodes = await env.collect_data_nodes()
+    by_source: dict = {}
     for vid in vids:
-        results.append(
-            await _do_ec_encode(env, vid, collection, data_shards, parity_shards)
-        )
+        source = None
+        for dn in nodes:
+            if any(int(v["id"]) == vid for v in dn.get("volumes", [])):
+                source = dn["url"]
+                break
+        by_source.setdefault(source, []).append(vid)
+    for source, group in by_source.items():
+        if source is None:
+            results.extend(f"volume {v}: not found" for v in group)
+        elif len(group) == 1:
+            results.append(
+                await _do_ec_encode(
+                    env, group[0], collection, data_shards, parity_shards,
+                    source=source,
+                )
+            )
+        else:
+            sstub = env.volume_stub(source)
+            for v in group:
+                await sstub.call("VolumeMarkReadonly", {"volume_id": v})
+            gen_req = {"volume_ids": group, "collection": collection}
+            if data_shards:
+                gen_req["data_shards"] = data_shards
+                gen_req["parity_shards"] = parity_shards
+            r = await sstub.call(
+                "VolumeEcShardsGenerateBatch", gen_req, timeout=3600
+            )
+            errs = (
+                {str(v): r["error"] for v in group}
+                if r.get("error")
+                else r.get("errors", {})
+            )
+            for v in group:
+                if str(v) in errs:
+                    results.append(
+                        f"volume {v}: generate failed: {errs[str(v)]}"
+                    )
+                else:
+                    results.append(
+                        await _ec_spread(
+                            env, v, collection, data_shards,
+                            parity_shards, source,
+                        )
+                    )
     return "\n".join(results) or "no volumes to encode"
 
 
 async def _do_ec_encode(
-    env, vid: int, collection: str, data_shards: int = 0, parity_shards: int = 0
+    env,
+    vid: int,
+    collection: str,
+    data_shards: int = 0,
+    parity_shards: int = 0,
+    source: str = "",
 ) -> str:
-    nodes = await env.collect_data_nodes()
-    source = None
-    for dn in nodes:
-        if any(int(v["id"]) == vid for v in dn.get("volumes", [])):
-            source = dn["url"]
-            break
-    if source is None:
-        return f"volume {vid}: not found"
+    if not source:
+        nodes = await env.collect_data_nodes()
+        for dn in nodes:
+            if any(int(v["id"]) == vid for v in dn.get("volumes", [])):
+                source = dn["url"]
+                break
+        if not source:
+            return f"volume {vid}: not found"
     sstub = env.volume_stub(source)
     await sstub.call("VolumeMarkReadonly", {"volume_id": vid})
     gen_req = {"volume_id": vid, "collection": collection}
@@ -405,7 +457,22 @@ async def _do_ec_encode(
     r = await sstub.call("VolumeEcShardsGenerate", gen_req, timeout=3600)
     if r.get("error"):
         return f"volume {vid}: generate failed: {r['error']}"
+    return await _ec_spread(
+        env, vid, collection, data_shards, parity_shards, source
+    )
 
+
+async def _ec_spread(
+    env,
+    vid: int,
+    collection: str,
+    data_shards: int,
+    parity_shards: int,
+    source: str,
+) -> str:
+    """Spread freshly-generated shards, mount them, drop the source volume
+    (the tail of ref command_ec_encode.go:110-135)."""
+    sstub = env.volume_stub(source)
     total = (data_shards + parity_shards) or TOTAL_SHARDS_COUNT
     ec_nodes = await _collect_ec_nodes(env)
     assignment = plan_balanced_spread(

@@ -27,6 +27,7 @@ from .locate import Interval, locate_data  # noqa: E402
 from .coder_cpu import CpuRSCodec  # noqa: E402
 from .encoder import (  # noqa: E402
     write_ec_files,
+    write_ec_files_multi,
     rebuild_ec_files,
     write_sorted_file_from_idx,
     write_dat_file,
@@ -46,6 +47,7 @@ __all__ = [
     "locate_data",
     "CpuRSCodec",
     "write_ec_files",
+    "write_ec_files_multi",
     "rebuild_ec_files",
     "write_sorted_file_from_idx",
     "write_dat_file",

@@ -1,0 +1,238 @@
+"""Batched multi-volume EC encode (BASELINE config #3): write_ec_files_multi
+byte-parity vs the per-volume pipeline, and the VolumeEcShardsGenerateBatch
+RPC end-to-end (ref per-volume semantics: ec_encoder.go:57,120-136)."""
+
+import asyncio
+import os
+import random
+
+import aiohttp
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage.erasure_coding import (
+    to_ext,
+    write_ec_files,
+    write_ec_files_multi,
+)
+from seaweedfs_tpu.storage.erasure_coding.coder_cpu import CpuRSCodec
+
+LARGE, SMALL = 8192, 1024
+
+
+def _mk_dat(path: str, size: int) -> None:
+    data = np.random.default_rng(size + 7).integers(
+        0, 256, size, dtype=np.uint8
+    )
+    with open(path, "wb") as f:
+        f.write(data.tobytes())
+
+
+def _shards(base: str) -> list:
+    out = []
+    for i in range(14):
+        with open(base + to_ext(i), "rb") as f:
+            out.append(f.read())
+    return out
+
+
+def test_multi_device_batch_path_matches_oracle(tmp_path):
+    """The shared-wide-batch streaming path (is_device codecs) must be
+    byte-identical to per-volume encodes across mixed geometries."""
+    from seaweedfs_tpu.ops.rs_kernel import TpuRSCodec
+
+    sizes = [
+        LARGE * 10 * 2 + SMALL * 10 * 2 + 333,
+        SMALL * 10 * 5,
+        SMALL * 3 + 17,
+        0,
+        LARGE * 10 + 1,
+    ]
+    singles, multis = [], []
+    for j, size in enumerate(sizes):
+        for sub, acc in (("ds", singles), ("dm", multis)):
+            d = tmp_path / f"{sub}{j}"
+            d.mkdir()
+            _mk_dat(str(d / "1.dat"), size)
+            acc.append(str(d / "1"))
+    for base in singles:
+        write_ec_files(
+            base, codec=CpuRSCodec(),
+            large_block_size=LARGE, small_block_size=SMALL,
+        )
+    codec = TpuRSCodec()
+    assert getattr(codec, "is_device", False)
+    write_ec_files_multi(
+        multis, codec=codec,
+        large_block_size=LARGE, small_block_size=SMALL,
+    )
+    for s, m, size in zip(singles, multis, sizes):
+        assert _shards(m) == _shards(s), size
+
+
+def test_multi_matches_per_volume_oracle(tmp_path):
+    # varied geometries: large+small rows, small-only, sub-row tail, empty
+    sizes = [
+        LARGE * 10 * 2 + SMALL * 10 * 2 + 333,
+        SMALL * 10 * 5,
+        SMALL * 3 + 17,
+        0,
+        LARGE * 10 + 1,
+    ]
+    singles, multis = [], []
+    for j, size in enumerate(sizes):
+        for sub, acc in (("s", singles), ("m", multis)):
+            d = tmp_path / f"{sub}{j}"
+            d.mkdir()
+            _mk_dat(str(d / "1.dat"), size)
+            acc.append(str(d / "1"))
+    codec = CpuRSCodec()
+    for base in singles:
+        write_ec_files(
+            base, codec=codec,
+            large_block_size=LARGE, small_block_size=SMALL,
+        )
+    write_ec_files_multi(
+        multis, codec=codec,
+        large_block_size=LARGE, small_block_size=SMALL,
+    )
+    for s, m, size in zip(singles, multis, sizes):
+        assert _shards(m) == _shards(s), size
+
+
+def test_multi_with_native_codec(tmp_path):
+    native = pytest.importorskip("seaweedfs_tpu.native")
+    if not native.available():
+        pytest.skip("native gf256 library unavailable")
+    from seaweedfs_tpu.storage.erasure_coding.coder_native import NativeRSCodec
+
+    sizes = [SMALL * 10 * 3 + 100, SMALL * 10 * 3 + 100, SMALL * 2]
+    oracle, multis = [], []
+    for j, size in enumerate(sizes):
+        for sub, acc in (("o", oracle), ("m", multis)):
+            d = tmp_path / f"{sub}{j}"
+            d.mkdir()
+            _mk_dat(str(d / "1.dat"), size)
+            acc.append(str(d / "1"))
+    for base in oracle:
+        write_ec_files(
+            base, codec=CpuRSCodec(),
+            large_block_size=LARGE, small_block_size=SMALL,
+        )
+    write_ec_files_multi(
+        multis, codec=NativeRSCodec(),
+        large_block_size=LARGE, small_block_size=SMALL, workers=3,
+    )
+    for o, m in zip(oracle, multis):
+        assert _shards(m) == _shards(o)
+
+
+def test_shell_ec_encode_batches_colocated_volumes(tmp_path):
+    """`ec.encode -volumeId a,b` with both volumes on one node goes through
+    VolumeEcShardsGenerateBatch, then spreads and serves reads as usual."""
+    from seaweedfs_tpu.pb.rpc import close_all_channels
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
+
+    from tests.test_cluster import Cluster
+    from seaweedfs_tpu.client import assign
+    from seaweedfs_tpu.client.operation import read_url, upload_data
+
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                ar0 = await assign(cluster.master.address)
+                url = ar0.url
+                vid0 = int(ar0.fid.split(",")[0])
+                vids = [vid0, vid0 + 1]
+                payloads = {}
+                for vid in vids:
+                    for i in range(1, 6):
+                        fid = f"{vid},{format_needle_id_cookie(i, 0xEE00 + i)}"
+                        data = random.randbytes(1200 + 17 * i)
+                        await upload_data(session, url, fid, data)
+                        payloads[fid] = data
+
+                env = CommandEnv(cluster.master.address)
+                for _ in range(100):
+                    nodes = await env.collect_data_nodes()
+                    have = {
+                        int(v["id"])
+                        for dn in nodes
+                        for v in dn.get("volumes", [])
+                    }
+                    if set(vids) <= have:
+                        break
+                    await asyncio.sleep(0.1)
+                assert (await run_command(env, "lock")) == "locked"
+                out = await run_command(
+                    env, f"ec.encode -volumeId {vids[0]},{vids[1]}"
+                )
+                assert out.count("encoded") == 2, out
+
+                for fid, want in payloads.items():
+                    got = await read_url(session, f"http://{url}/{fid}")
+                    assert got == want, fid
+        finally:
+            await cluster.stop()
+            await close_all_channels()
+
+    asyncio.run(body())
+
+
+def test_generate_batch_rpc_and_read_back(tmp_path):
+    from seaweedfs_tpu.pb import grpc_address
+    from seaweedfs_tpu.pb.rpc import Stub, close_all_channels
+    from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
+
+    from tests.test_cluster import Cluster
+    from seaweedfs_tpu.client import assign
+    from seaweedfs_tpu.client.operation import read_url, upload_data
+
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                ar0 = await assign(cluster.master.address)
+                url = ar0.url
+                vid0 = int(ar0.fid.split(",")[0])
+                vids = [vid0, vid0 + 1]
+                payloads = {}
+                for vid in vids:
+                    for i in range(1, 8):
+                        fid = f"{vid},{format_needle_id_cookie(i, 0xCD00 + i)}"
+                        data = random.randbytes(1500 + 31 * i)
+                        await upload_data(session, url, fid, data)
+                        payloads[fid] = data
+
+                stub = Stub(grpc_address(url), "volume")
+                for vid in vids:
+                    await stub.call("VolumeMarkReadonly", {"volume_id": vid})
+                r = await stub.call(
+                    "VolumeEcShardsGenerateBatch",
+                    {"volume_ids": vids},
+                    timeout=120,
+                )
+                assert not r.get("error"), r
+                assert not r.get("errors"), r
+
+                # serve from EC shards only: mount, drop the plain volumes
+                for vid in vids:
+                    r = await stub.call(
+                        "VolumeEcShardsMount",
+                        {"volume_id": vid, "shard_ids": list(range(14))},
+                    )
+                    assert not r.get("error"), r
+                    await stub.call("VolumeUnmount", {"volume_id": vid})
+                    await stub.call("VolumeDelete", {"volume_id": vid})
+                for fid, want in payloads.items():
+                    got = await read_url(session, f"http://{url}/{fid}")
+                    assert got == want, fid
+        finally:
+            await cluster.stop()
+            await close_all_channels()
+
+    asyncio.run(body())
