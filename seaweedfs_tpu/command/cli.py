@@ -839,12 +839,13 @@ def cmd_scaffold(argv: list[str]) -> int:
 
 
 def cmd_mount(argv: list[str]) -> int:
-    """Mount the filer as a FUSE filesystem (ref command/mount.go).
+    """Mount the filer as a FUSE filesystem (ref command/mount.go,
+    weed/filesys/wfs.go:55-61).
 
-    The filesystem layer (seaweedfs_tpu.mount.WFS) is kernel-agnostic;
-    actually attaching it to a mountpoint requires a FUSE binding
-    (`fusepy`), which this environment does not ship — in that case the
-    command explains how to use the WFS API directly.
+    Speaks the FUSE kernel protocol natively over /dev/fuse
+    (mount.fuse_lowlevel — the same no-libfuse approach as the reference's
+    bazil.org/fuse), serving the kernel-agnostic WFS layer. Requires a
+    fuse-capable host (/dev/fuse + either CAP_SYS_ADMIN or fusermount).
     """
     p = argparse.ArgumentParser(prog="weed-tpu mount")
     p.add_argument("-filer", default="localhost:8888")
@@ -855,25 +856,39 @@ def cmd_mount(argv: list[str]) -> int:
     p.add_argument("-replication", default="")
     p.add_argument("-chunkSizeLimitMB", type=int, default=4)
     args = p.parse_args(argv)
-    try:
-        import fuse  # noqa: F401
-    except ImportError:
-        print(
-            "FUSE binding not available (pip package `fusepy`).\n"
-            "The filesystem layer is importable directly:\n"
-            "  from seaweedfs_tpu.mount import WFS\n"
-            f"  wfs = WFS({args.filer!r},\n"
-            f"            chunk_size={args.chunkSizeLimitMB} * 1024 * 1024,\n"
-            f"            cache_dir={args.cacheDir!r},\n"
-            f"            cache_size_mb={args.cacheSizeMB},\n"
-            f"            collection={args.collection!r},\n"
-            f"            replication={args.replication!r})\n"
-            "  # await wfs.start(); h = await wfs.open('/path'); ...",
-            file=sys.stderr,
-        )
+    if not os.path.exists("/dev/fuse"):
+        print("no /dev/fuse on this host — cannot mount", file=sys.stderr)
         return 2
-    print("FUSE adapter wiring is gated on fusepy API availability")
-    return 1
+    if not os.path.isdir(args.dir):
+        print(f"mount point {args.dir} is not a directory", file=sys.stderr)
+        return 2
+
+    async def run() -> None:
+        from ..mount import WFS
+        from ..mount.fuse_adapter import mount_and_serve
+
+        wfs = WFS(
+            args.filer,
+            chunk_size=args.chunkSizeLimitMB * 1024 * 1024,
+            cache_dir=args.cacheDir or None,
+            cache_size_mb=args.cacheSizeMB,
+            collection=args.collection,
+            replication=args.replication,
+        )
+        await wfs.start()
+        conn = await mount_and_serve(wfs, args.dir)
+        print(f"mounted {args.filer} at {args.dir}")
+        try:
+            await conn.serve()
+        finally:
+            conn.unmount()
+            await wfs.stop()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
 
 
 def cmd_filer_replicate(argv: list[str]) -> int:

@@ -1,0 +1,269 @@
+"""WFS -> FUSE operations adapter: inode table + op dispatch.
+
+Pairs the kernel-agnostic mount client (wfs.py — the analogue of
+weed/filesys/wfs.go) with the native /dev/fuse transport
+(fuse_lowlevel.py). Inodes are assigned lazily per path, like the
+reference's Dir/File node map (ref weed/filesys/dir.go:34-52).
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+from typing import Dict, Optional
+
+from ..filer.entry import Entry
+from .fuse_lowlevel import (
+    FATTR_ATIME,
+    FATTR_GID,
+    FATTR_MODE,
+    FATTR_MTIME,
+    FATTR_SIZE,
+    FATTR_UID,
+    FuseConn,
+    FuseError,
+    S_IFDIR,
+    S_IFREG,
+)
+from .wfs import WFS
+
+
+class WfsFuseOps:
+    def __init__(self, wfs: WFS):
+        self.wfs = wfs
+        self._ino_to_path: Dict[int, str] = {1: "/"}
+        self._path_to_ino: Dict[str, int] = {"/": 1}
+        self._next_ino = 2
+
+    # ---------------- inode table ----------------
+    def ino_of(self, path: str) -> int:
+        ino = self._path_to_ino.get(path)
+        if ino is None:
+            ino = self._next_ino
+            self._next_ino += 1
+            self._path_to_ino[path] = ino
+            self._ino_to_path[ino] = path
+        return ino
+
+    def _path(self, ino: int) -> str:
+        path = self._ino_to_path.get(ino)
+        if path is None:
+            raise FuseError(errno.ESTALE)
+        return path
+
+    def _child(self, parent_ino: int, name: str) -> str:
+        parent = self._path(parent_ino)
+        return (parent.rstrip("/") or "") + "/" + name
+
+    def _drop_subtree(self, path: str) -> None:
+        doomed = [
+            p
+            for p in self._path_to_ino
+            if p == path or p.startswith(path.rstrip("/") + "/")
+        ]
+        for p in doomed:
+            ino = self._path_to_ino.pop(p)
+            self._ino_to_path.pop(ino, None)
+
+    def _rebind_subtree(self, old_path: str, new_path: str) -> None:
+        """Inodes persist across rename (POSIX): keep every ino, rewrite its
+        path; bindings previously at the destination are overwritten."""
+        self._drop_subtree(new_path)
+        old_prefix = old_path.rstrip("/") + "/"
+        moved = [
+            p
+            for p in self._path_to_ino
+            if p == old_path or p.startswith(old_prefix)
+        ]
+        for p in moved:
+            ino = self._path_to_ino.pop(p)
+            np = new_path + p[len(old_path):]
+            self._path_to_ino[np] = ino
+            self._ino_to_path[ino] = np
+
+    # ---------------- attrs ----------------
+    def _attr(self, entry: Entry, ino: int, size: Optional[int] = None) -> dict:
+        mode = entry.attr.mode
+        mode |= S_IFDIR if entry.is_directory else S_IFREG
+        if size is None:
+            size = entry.size()
+            # an open handle may hold newer (dirty) bytes
+            for h in self.wfs.handles.values():
+                if h.entry.full_path == entry.full_path:
+                    size = max(size, h.size())
+        return {
+            "ino": ino,
+            "size": 0 if entry.is_directory else size,
+            "mode": mode,
+            "nlink": 2 if entry.is_directory else 1,
+            "uid": entry.attr.uid,
+            "gid": entry.attr.gid,
+            "mtime": entry.attr.mtime,
+            "atime": entry.attr.mtime,
+            "ctime": entry.attr.crtime or entry.attr.mtime,
+        }
+
+    async def _entry(self, path: str) -> Entry:
+        if path == "/":
+            from ..filer.entry import Attr
+
+            return Entry(full_path="/", attr=Attr(mode=0o755 | 0o40000))
+        entry = await self.wfs.lookup(path)
+        if entry is None:
+            # created-but-unflushed files live only in their open handle
+            for h in self.wfs.handles.values():
+                if h.entry.full_path == path and not h.unlinked:
+                    return h.entry
+            raise FuseError(errno.ENOENT)
+        return entry
+
+    # ---------------- ops (called by fuse_lowlevel handlers) ----------------
+    async def lookup(self, parent_ino: int, name: str):
+        path = self._child(parent_ino, name)
+        entry = await self._entry(path)
+        return self.ino_of(path), self._attr(entry, self.ino_of(path))
+
+    async def getattr(self, ino: int) -> dict:
+        path = self._path(ino)
+        try:
+            return self._attr(await self._entry(path), ino)
+        except FuseError:
+            # open-unlinked file: attrs live on in the handle until release
+            for h in self.wfs.handles.values():
+                if h.entry.full_path == path:
+                    return self._attr(h.entry, ino, size=h.size())
+            raise
+
+    async def setattr(self, ino: int, valid: int, **f) -> dict:
+        path = self._path(ino)
+        entry = await self._entry(path)
+        if valid & FATTR_SIZE:
+            size = f["size"]
+            if size == 0:
+                entry.chunks = []
+                for h in self.wfs.handles.values():
+                    if h.entry.full_path == path:
+                        h.entry.chunks = []
+                        h.dirty = type(h.dirty)()
+                        h.dirty_metadata = True
+            elif size != entry.size():
+                raise FuseError(errno.EOPNOTSUPP)  # sparse resize
+        if valid & FATTR_MODE:
+            entry.attr.mode = (entry.attr.mode & 0o170000) | (
+                f["mode"] & 0o7777
+            )
+        if valid & FATTR_UID:
+            entry.attr.uid = f["uid"]
+        if valid & FATTR_GID:
+            entry.attr.gid = f["gid"]
+        if valid & (FATTR_MTIME | FATTR_ATIME):
+            if valid & FATTR_MTIME:
+                entry.attr.mtime = float(f["mtime"])
+        await self.wfs.save_entry(entry)
+        return self._attr(entry, ino)
+
+    async def readdir(self, ino: int):
+        path = self._path(ino)
+        if path != "/":
+            await self._entry(path)  # ENOENT on stale dirs
+        out = [(ino, ".", 4), (1 if path == "/" else ino, "..", 4)]
+        for e in await self.wfs.list_dir(path):
+            child = self.ino_of(e.full_path)
+            out.append((child, e.name, 4 if e.is_directory else 8))
+        return out
+
+    async def mkdir(self, parent_ino: int, name: str, mode: int):
+        path = self._child(parent_ino, name)
+        if await self.wfs.lookup(path) is not None:
+            raise FuseError(errno.EEXIST)
+        entry = await self.wfs.mkdir(path, mode & 0o7777)
+        return self.ino_of(path), self._attr(entry, self.ino_of(path))
+
+    async def unlink(self, parent_ino: int, name: str) -> None:
+        path = self._child(parent_ino, name)
+        entry = await self._entry(path)
+        if entry.is_directory:
+            raise FuseError(errno.EISDIR)
+        # keep the ino binding: open fds still fstat it (getattr falls back
+        # to the handle); the kernel retires the ino via FORGET
+        await self.wfs.unlink(path)
+
+    async def rmdir(self, parent_ino: int, name: str) -> None:
+        path = self._child(parent_ino, name)
+        entry = await self._entry(path)
+        if not entry.is_directory:
+            raise FuseError(errno.ENOTDIR)
+        if await self.wfs.list_dir(path):
+            raise FuseError(errno.ENOTEMPTY)
+        await self.wfs.unlink(path)
+        self._drop_subtree(path)
+
+    async def rename(
+        self, parent_ino: int, old: str, newdir_ino: int, new: str
+    ) -> None:
+        old_path = self._child(parent_ino, old)
+        new_path = self._child(newdir_ino, new)
+        await self._entry(old_path)
+        await self.wfs.rename(old_path, new_path)
+        self._rebind_subtree(old_path, new_path)
+        # open handles follow the rename, else their flush resurrects the
+        # old path (ref filehandle keeps the moved node, dir.go Rename)
+        old_prefix = old_path.rstrip("/") + "/"
+        for h in self.wfs.handles.values():
+            hp = h.entry.full_path
+            if hp == old_path or hp.startswith(old_prefix):
+                h.entry.full_path = new_path + hp[len(old_path):]
+
+    async def create(self, parent_ino: int, name: str, mode: int, flags: int):
+        path = self._child(parent_ino, name)
+        if flags & os.O_EXCL and await self.wfs.lookup(path) is not None:
+            raise FuseError(errno.EEXIST)
+        fh = await self.wfs.open(path, create=True)
+        h = self.wfs.handle(fh)
+        h.entry.attr.mode = mode & 0o7777
+        h.dirty_metadata = True
+        ino = self.ino_of(path)
+        return ino, self._attr(h.entry, ino, size=h.size()), fh
+
+    async def open(self, ino: int, flags: int) -> int:
+        path = self._path(ino)
+        try:
+            fh = await self.wfs.open(path, create=False)
+        except FileNotFoundError:
+            raise FuseError(errno.ENOENT)
+        if flags & os.O_TRUNC:
+            h = self.wfs.handle(fh)
+            h.entry.chunks = []
+            h.dirty = type(h.dirty)()
+            h.dirty_metadata = True
+        return fh
+
+    async def read(self, ino: int, fh: int, offset: int, size: int) -> bytes:
+        try:
+            h = self.wfs.handle(fh)
+        except KeyError:
+            raise FuseError(errno.EBADF)
+        return await h.read(offset, size)
+
+    async def write(self, ino: int, fh: int, offset: int, data: bytes) -> int:
+        try:
+            h = self.wfs.handle(fh)
+        except KeyError:
+            raise FuseError(errno.EBADF)
+        return await h.write(offset, data)
+
+    async def flush(self, ino: int, fh: int) -> None:
+        h = self.wfs.handles.get(fh)
+        if h is not None:
+            await h.flush()
+
+    async def release(self, ino: int, fh: int) -> None:
+        await self.wfs.release(fh)
+
+
+async def mount_and_serve(wfs: WFS, mountpoint: str) -> FuseConn:
+    """Attach `wfs` at `mountpoint` and return the serving connection; the
+    caller awaits conn.serve() (or keeps the returned task)."""
+    conn = FuseConn(WfsFuseOps(wfs), mountpoint)
+    conn.mount()
+    return conn

@@ -15,6 +15,32 @@ class MetaCache:
         self._entries: Dict[str, Entry] = {}
         self._listed_dirs: set[str] = set()
         self._lock = threading.RLock()
+        # path -> time_ns of the last LOCAL mutation: the subscribe stream
+        # echoes our own writes back with the server's (earlier) ts, and a
+        # late echo must not resurrect state we've already superseded
+        self._local_ns: Dict[str, int] = {}
+
+    def note_local(self, path: str, ts_ns: Optional[int] = None) -> None:
+        """ts_ns should be the SERVER's meta-log watermark for the mutation
+        (mutation RPCs return it): the suppression compare is then within one
+        clock. The client-clock fallback only covers old servers."""
+        import time
+
+        with self._lock:
+            self._local_ns[path] = ts_ns or time.time_ns()
+
+    def note_local_subtree(self, path: str, ts_ns: Optional[int] = None) -> None:
+        """Stamp a path and every cached descendant (directory unlink or
+        rename: child echoes must not resurrect the old names)."""
+        import time
+
+        now = ts_ns or time.time_ns()
+        with self._lock:
+            self._local_ns[path] = now
+            prefix = path.rstrip("/") + "/"
+            for p in self._entries:
+                if p.startswith(prefix):
+                    self._local_ns[p] = now
 
     def get(self, path: str) -> Optional[Entry]:
         with self._lock:
@@ -56,7 +82,20 @@ class MetaCache:
         notification = event.get("event_notification", {})
         old = notification.get("old_entry")
         new = notification.get("new_entry")
+        ts = int(event.get("ts_ns", 0))
+
+        def fresh(path: str) -> bool:
+            # suppress only when WE touched the path more recently than the
+            # event; untouched paths always apply (remote writers). A newer
+            # event retires the stamp, bounding _local_ns growth.
+            with self._lock:
+                stamp = self._local_ns.get(path, 0)
+                if stamp and ts > stamp:
+                    del self._local_ns[path]
+            return stamp == 0 or ts > stamp
+
         if old and (not new or old.get("full_path") != new.get("full_path")):
-            self.delete(old["full_path"])
-        if new:
+            if fresh(old["full_path"]):
+                self.delete(old["full_path"])
+        if new and fresh(new["full_path"]):
             self.put(Entry.from_dict(new))

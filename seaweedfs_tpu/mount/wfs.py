@@ -45,6 +45,7 @@ class FileHandle:
         self.entry = entry
         self.dirty = ContinuousIntervals()
         self.dirty_metadata = False
+        self.unlinked = False  # deleted while open: flush must not recreate
 
     @property
     def path(self) -> str:
@@ -96,6 +97,8 @@ class FileHandle:
     async def flush(self) -> None:
         """Persist dirty pages + entry metadata
         (ref filehandle.go doFlush)."""
+        if self.unlinked:
+            return  # open-unlinked file: bytes die with the handle
         for off, data in self.dirty.pop_all():
             await self._save_page(off, data)
         if self.dirty_metadata:
@@ -188,6 +191,7 @@ class WFS:
         if resp.get("error"):
             raise OSError(resp["error"])
         self.meta_cache.put(entry)
+        self.meta_cache.note_local(entry.full_path, resp.get("ts_ns"))
 
     async def mkdir(self, path: str, mode: int = 0o755) -> Entry:
         now = time.time()
@@ -209,9 +213,23 @@ class WFS:
                 "is_recursive": True,
             },
         )
+        open_here = [
+            h
+            for h in self.handles.values()
+            if h.entry.full_path == path
+            or h.entry.full_path.startswith(path.rstrip("/") + "/")
+        ]
         if resp.get("error"):
-            raise OSError(resp["error"])
+            # a created-but-never-flushed file exists only in its handle;
+            # deleting it is purely local
+            if not open_here:
+                raise OSError(resp["error"])
+        self.meta_cache.note_local_subtree(path, resp.get("ts_ns"))
         self.meta_cache.delete(path)
+        # an open handle over the deleted file must neither resurrect it on
+        # flush nor lose its in-memory bytes (POSIX open-unlinked semantics)
+        for h in open_here:
+            h.unlinked = True
 
     async def rename(self, old_path: str, new_path: str) -> None:
         old_dir, _, old_name = old_path.rpartition("/")
@@ -227,7 +245,16 @@ class WFS:
         )
         if resp.get("error"):
             raise OSError(resp["error"])
+        ts = resp.get("ts_ns")
+        self.meta_cache.note_local_subtree(old_path, ts)
         self.meta_cache.delete(old_path)
+        # the destination may hold a stale pre-rename entry (rename-over-
+        # existing): evict it so the lookup below refetches from the filer
+        self.meta_cache.delete(new_path)
+        self.meta_cache.note_local(new_path, ts)
+        # re-learn the renamed entry now rather than waiting on the
+        # subscribe stream, so a readdir right after rename sees it
+        await self.lookup(new_path)
 
     # ---- open files ----
     async def open(self, path: str, create: bool = True) -> int:

@@ -299,7 +299,7 @@ class FilerServer:
             )
         except OSError as e:
             return {"error": str(e)}
-        return {}
+        return {"ts_ns": self.filer.meta_log.last_ts_ns}
 
     async def _grpc_update_entry(self, req, context) -> dict:
         try:
@@ -318,7 +318,7 @@ class FilerServer:
             )
         except OSError as e:
             return {"error": str(e)}
-        return {}
+        return {"ts_ns": self.filer.meta_log.last_ts_ns}
 
     async def _grpc_rename(self, req, context) -> dict:
         old = req["old_directory"].rstrip("/") + "/" + req["old_name"]
@@ -327,7 +327,7 @@ class FilerServer:
             self.filer.rename(old, new)
         except OSError as e:  # incl. FileNotFound / NotADirectory / self-move
             return {"error": str(e)}
-        return {}
+        return {"ts_ns": self.filer.meta_log.last_ts_ns}
 
     async def _grpc_assign_volume(self, req, context) -> dict:
         try:
