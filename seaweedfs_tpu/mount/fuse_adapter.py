@@ -34,6 +34,9 @@ class WfsFuseOps:
         self._ino_to_path: Dict[int, str] = {1: "/"}
         self._path_to_ino: Dict[str, int] = {"/": 1}
         self._next_ino = 2
+        # inos whose path was unlinked while possibly open: they answer from
+        # open handles only, and a new file at the same path gets a new ino
+        self._ghost_inos: set = set()
 
     # ---------------- inode table ----------------
     def ino_of(self, path: str) -> int:
@@ -125,6 +128,13 @@ class WfsFuseOps:
 
     async def getattr(self, ino: int) -> dict:
         path = self._path(ino)
+        if ino in self._ghost_inos:
+            # unlinked-while-open: only its own handles may answer — a new
+            # file recreated at the same path has a different ino
+            for h in self.wfs.handles.values():
+                if h.entry.full_path == path and h.unlinked:
+                    return self._attr(h.entry, ino, size=h.size())
+            raise FuseError(errno.ESTALE)
         try:
             return self._attr(await self._entry(path), ino)
         except FuseError:
@@ -184,9 +194,12 @@ class WfsFuseOps:
         entry = await self._entry(path)
         if entry.is_directory:
             raise FuseError(errno.EISDIR)
-        # keep the ino binding: open fds still fstat it (getattr falls back
-        # to the handle); the kernel retires the ino via FORGET
         await self.wfs.unlink(path)
+        # the ino lives on for open fds (ghost; kernel retires it via
+        # FORGET), but the path is free for a new file with a fresh ino
+        ino = self._path_to_ino.pop(path, None)
+        if ino is not None:
+            self._ghost_inos.add(ino)
 
     async def rmdir(self, parent_ino: int, name: str) -> None:
         path = self._child(parent_ino, name)
@@ -213,6 +226,10 @@ class WfsFuseOps:
             hp = h.entry.full_path
             if hp == old_path or hp.startswith(old_prefix):
                 h.entry.full_path = new_path + hp[len(old_path):]
+
+    async def rename_noreplace_check(self, newdir_ino: int, new: str) -> None:
+        if await self.wfs.lookup(self._child(newdir_ino, new)) is not None:
+            raise FuseError(errno.EEXIST)
 
     async def create(self, parent_ino: int, name: str, mode: int, flags: int):
         path = self._child(parent_ino, name)
@@ -259,6 +276,15 @@ class WfsFuseOps:
 
     async def release(self, ino: int, fh: int) -> None:
         await self.wfs.release(fh)
+
+    def forget(self, ino: int) -> None:
+        """Kernel dropped its references: retire the ino binding."""
+        if ino == 1:
+            return
+        path = self._ino_to_path.pop(ino, None)
+        self._ghost_inos.discard(ino)
+        if path is not None and self._path_to_ino.get(path) == ino:
+            del self._path_to_ino[path]
 
 
 async def mount_and_serve(wfs: WFS, mountpoint: str) -> FuseConn:

@@ -207,6 +207,9 @@ class FuseConn:
         os.set_blocking(self.fd, False)
 
     def unmount(self) -> None:
+        # order matters: wake serve() first so it can't be parked on a
+        # reader registration for an fd we're about to close
+        self._closed.set()
         for cmd in (
             ["fusermount", "-u", "-z", "--", self.mountpoint],
             ["umount", "-l", self.mountpoint],
@@ -222,7 +225,6 @@ class FuseConn:
             except OSError:
                 pass
             self.fd = None
-        self._closed.set()
 
     # ---------------- serve loop ----------------
     async def serve(self) -> None:
@@ -230,24 +232,39 @@ class FuseConn:
         loop = asyncio.get_event_loop()
         bufsize = self.max_write + (1 << 16)
         readable = asyncio.Event()
-        loop.add_reader(self.fd, readable.set)
+        fd = self.fd
+        loop.add_reader(fd, readable.set)
         try:
-            while True:
+            while not self._closed.is_set():
                 try:
-                    data = os.read(self.fd, bufsize)
+                    data = os.read(fd, bufsize)
                 except BlockingIOError:
                     readable.clear()
-                    await readable.wait()
+                    # also wake on unmount(), which may fire while parked
+                    waiters = [
+                        asyncio.ensure_future(readable.wait()),
+                        asyncio.ensure_future(self._closed.wait()),
+                    ]
+                    try:
+                        await asyncio.wait(
+                            waiters, return_when=asyncio.FIRST_COMPLETED
+                        )
+                    finally:
+                        for w in waiters:
+                            w.cancel()
                     continue
                 except OSError as e:
-                    if e.errno == errno.ENODEV:  # unmounted
+                    if e.errno in (errno.ENODEV, errno.EBADF):  # unmounted
                         return
                     raise
                 if not data:
                     return
                 asyncio.ensure_future(self._dispatch(data))
         finally:
-            loop.remove_reader(self.fd)
+            try:
+                loop.remove_reader(fd)
+            except (OSError, ValueError):
+                pass
             self._closed.set()
 
     def _reply(self, unique: int, err: int, body: bytes = b"") -> None:
@@ -268,7 +285,20 @@ class FuseConn:
             self._handle_init(unique, body)
             return
         if opcode in (FUSE_FORGET, FUSE_BATCH_FORGET):
-            return  # never replied to
+            # never replied to; retire ino bindings so the table is bounded
+            forget = getattr(self.ops, "forget", None)
+            if forget is not None:
+                try:
+                    if opcode == FUSE_FORGET:
+                        forget(nodeid)
+                    else:
+                        count = struct.unpack_from("<I", body)[0]
+                        for i in range(count):
+                            nid = struct.unpack_from("<Q", body, 8 + 16 * i)[0]
+                            forget(nid)
+                except Exception:
+                    pass
+            return
         if opcode == FUSE_INTERRUPT:
             return
         if opcode == FUSE_DESTROY:
@@ -301,7 +331,19 @@ class FuseConn:
 
 
 def _name_from(body: bytes, offset: int = 0) -> str:
-    return body[offset:].split(b"\0", 1)[0].decode("utf-8", "replace")
+    # surrogateescape round-trips arbitrary filename bytes through str
+    return body[offset:].split(b"\0", 1)[0].decode("utf-8", "surrogateescape")
+
+
+def _two_names(rest: bytes) -> tuple[str, str]:
+    """old\\0new\\0 — offsets computed on the RAW bytes (a lossy decode must
+    not shift where the second name starts)."""
+    raw_old, tail = rest.split(b"\0", 1)
+    raw_new = tail.split(b"\0", 1)[0]
+    return (
+        raw_old.decode("utf-8", "surrogateescape"),
+        raw_new.decode("utf-8", "surrogateescape"),
+    )
 
 
 # ---------------- per-op adapters: wire format <-> ops object ----------------
@@ -367,20 +409,26 @@ async def _op_rmdir(ops, nodeid, body, conn):
     return b""
 
 
+RENAME_NOREPLACE = 1
+RENAME_EXCHANGE = 2
+
+
 async def _op_rename(ops, nodeid, body, conn):
     (newdir,) = struct.unpack_from("<Q", body)
-    rest = body[8:]
-    old = _name_from(rest)
-    new = _name_from(rest, len(old.encode()) + 1)
+    old, new = _two_names(body[8:])
     await ops.rename(nodeid, old, newdir, new)
     return b""
 
 
 async def _op_rename2(ops, nodeid, body, conn):
-    newdir, _flags, _pad = _RENAME2_IN.unpack_from(body)
-    rest = body[_RENAME2_IN.size :]
-    old = _name_from(rest)
-    new = _name_from(rest, len(old.encode()) + 1)
+    newdir, flags, _pad = _RENAME2_IN.unpack_from(body)
+    old, new = _two_names(body[_RENAME2_IN.size :])
+    if flags & ~RENAME_NOREPLACE:
+        raise FuseError(errno.EINVAL)  # EXCHANGE/WHITEOUT unsupported
+    if flags & RENAME_NOREPLACE:
+        noreplace = getattr(ops, "rename_noreplace_check", None)
+        if noreplace is not None:
+            await noreplace(newdir, new)
     await ops.rename(nodeid, old, newdir, new)
     return b""
 

@@ -10,9 +10,9 @@ committed chunks (through the tiered chunk cache) with unflushed dirty
 bytes. A background task follows SubscribeMetadata to keep the local
 MetaCache coherent with other writers.
 
-The FUSE wire-up itself is a thin adapter in command/cli.py `mount`,
-gated on a fuse binding being installed; this layer is fully testable
-without a kernel mount.
+The FUSE wire-up is the native /dev/fuse kernel-protocol server in
+mount/fuse_lowlevel.py + mount/fuse_adapter.py (`weed mount`); this
+layer stays kernel-agnostic and fully testable without a mount.
 """
 
 from __future__ import annotations
@@ -213,23 +213,20 @@ class WFS:
                 "is_recursive": True,
             },
         )
-        open_here = [
-            h
-            for h in self.handles.values()
-            if h.entry.full_path == path
-            or h.entry.full_path.startswith(path.rstrip("/") + "/")
-        ]
         if resp.get("error"):
-            # a created-but-never-flushed file exists only in its handle;
-            # deleting it is purely local
-            if not open_here:
-                raise OSError(resp["error"])
+            # (a created-but-never-flushed file doesn't exist server-side;
+            # the filer treats deleting a missing entry as success, so any
+            # error here is a real failure)
+            raise OSError(resp["error"])
         self.meta_cache.note_local_subtree(path, resp.get("ts_ns"))
         self.meta_cache.delete(path)
         # an open handle over the deleted file must neither resurrect it on
         # flush nor lose its in-memory bytes (POSIX open-unlinked semantics)
-        for h in open_here:
-            h.unlinked = True
+        for h in self.handles.values():
+            if h.entry.full_path == path or h.entry.full_path.startswith(
+                path.rstrip("/") + "/"
+            ):
+                h.unlinked = True
 
     async def rename(self, old_path: str, new_path: str) -> None:
         old_dir, _, old_name = old_path.rpartition("/")

@@ -299,6 +299,8 @@ class FilerServer:
             )
         except OSError as e:
             return {"error": str(e)}
+        # safe watermark: the mutation and this read run in one synchronous
+        # block (no await between), so no other event can interleave
         return {"ts_ns": self.filer.meta_log.last_ts_ns}
 
     async def _grpc_update_entry(self, req, context) -> dict:

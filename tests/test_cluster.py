@@ -298,3 +298,115 @@ def test_cluster_ec_encode_spread_read_degraded(tmp_path):
             await cluster.stop()
 
     asyncio.run(body())
+
+
+def test_cluster_ec_rebuild_balance_lifecycle(tmp_path):
+    """Full operator lifecycle through real servers (past what the reference
+    can test in-tree, ref command_ec_rebuild.go:97-244, command_ec_balance.go:
+    29-95): shell ec.encode -> kill a shard-holding node -> shell ec.rebuild
+    reconstructs its shards on survivors -> shell ec.balance -> every needle
+    still reads back."""
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
+
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=4)
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                ar0 = await assign(cluster.master.address)
+                vid = int(ar0.fid.split(",")[0])
+                payloads = {}
+                for i in range(1, 20):
+                    fid = f"{vid},{format_needle_id_cookie(i, 0xFA000 + i)}"
+                    data = random.randbytes(2500 + 41 * i)
+                    await upload_data(session, ar0.url, fid, data)
+                    payloads[fid] = data
+
+                env = CommandEnv(cluster.master.address)
+                for _ in range(100):
+                    nodes = await env.collect_data_nodes()
+                    if any(
+                        int(v["id"]) == vid
+                        for dn in nodes
+                        for v in dn.get("volumes", [])
+                    ):
+                        break
+                    await asyncio.sleep(0.1)
+                assert (await run_command(env, "lock")) == "locked"
+                out = await run_command(env, f"ec.encode -volumeId {vid}")
+                assert "encoded" in out, out
+
+                # wait until all 14 shards are registered
+                for _ in range(100):
+                    locs = cluster.master.topo.lookup_ec_shards(vid)
+                    if locs is not None and sum(
+                        1 for l in locs.locations if l
+                    ) == 14:
+                        break
+                    await asyncio.sleep(0.1)
+
+                # kill a node that holds shards (not the one we read from)
+                def holder_urls():
+                    locs = cluster.master.topo.lookup_ec_shards(vid)
+                    return [
+                        {dn.url for dn in l} for l in locs.locations
+                    ]
+
+                holders = [
+                    vs
+                    for vs in cluster.volume_servers
+                    if any(vs.address in urls for urls in holder_urls())
+                ]
+                victim = holders[-1]
+                lost = [
+                    i
+                    for i, urls in enumerate(holder_urls())
+                    if victim.address in urls
+                ]
+                assert lost, "victim held no shards"
+                await victim.stop()
+                cluster.volume_servers.remove(victim)
+
+                # master drops the node when its heartbeat stream breaks
+                for _ in range(100):
+                    alive = {
+                        dn.url for dn in cluster.master.topo.data_nodes()
+                    }
+                    if victim.address not in alive:
+                        break
+                    await asyncio.sleep(0.1)
+                assert victim.address not in {
+                    dn.url for dn in cluster.master.topo.data_nodes()
+                }
+
+                out = await run_command(env, "ec.rebuild")
+                assert "rebuilt" in out, out
+
+                # all 14 shard ids must be held again
+                for _ in range(100):
+                    locs = cluster.master.topo.lookup_ec_shards(vid)
+                    if locs is not None and sum(
+                        1 for l in locs.locations if l
+                    ) == 14:
+                        break
+                    await asyncio.sleep(0.1)
+                locs = cluster.master.topo.lookup_ec_shards(vid)
+                held = sum(1 for l in locs.locations if l)
+                assert held == 14, f"only {held} shards after rebuild"
+
+                out = await run_command(env, "ec.balance")
+                assert "error" not in out.lower(), out
+                await asyncio.sleep(0.6)  # heartbeat deltas settle
+
+                # every needle reads back through every surviving server
+                for fid, data in payloads.items():
+                    for vs in cluster.volume_servers:
+                        got = await read_url(
+                            session, f"http://{vs.address}/{fid}"
+                        )
+                        assert got == data, f"{fid} via {vs.address}"
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
