@@ -396,8 +396,8 @@ def cmd_filer(argv: list[str]) -> int:
     p.add_argument(
         "-store",
         default="",
-        help="metadata store: '' = memory, *.flog = append-only log store, "
-        "else sqlite file",
+        help="metadata store: '' = memory, *.flog = append-log, "
+        "*.lsm = LSM segments+WAL, anything else = sqlite",
     )
     p.add_argument("-maxMB", type=int, default=4, help="chunk size in MB")
     p.add_argument("-collection", default="")
@@ -428,7 +428,12 @@ def cmd_s3(argv: list[str]) -> int:
     p.add_argument("-port", type=int, default=8333)
     p.add_argument("-master", default="127.0.0.1:9333")
     p.add_argument("-filerPort", type=int, default=8888)
-    p.add_argument("-store", default="")
+    p.add_argument(
+        "-store",
+        default="",
+        help="metadata store: '' = memory, *.flog = append-log, "
+        "*.lsm = LSM segments+WAL, anything else = sqlite",
+    )
     p.add_argument(
         "-config",
         default="",

@@ -1,0 +1,350 @@
+"""LSM-tree filer store: WAL + memtable + sorted segment files + compaction.
+
+Fills the reference's embedded-database store role (leveldb/leveldb2,
+ref weed/filer2/filerstore.go:12-31, weed/filer2/leveldb2/) with the same
+architecture LevelDB itself uses, built natively: acknowledged mutations
+land in a fsynced write-ahead log and an in-memory memtable; when the
+memtable fills it flushes to an immutable sorted segment file (keys
+in memory, values read from disk on demand); lookups consult memtable
+then segments newest-first; deletes are tombstones; when segments pile
+up they merge into one (newest wins, tombstones dropped). Directory
+listings are range scans over the (dir, name) key order.
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import msgpack
+
+from .entry import Entry
+from .filer_store import _split as _key  # same (dir, name) rule as every store
+
+_FRAME = struct.Struct("<II")  # key-bytes length, value-bytes length
+
+
+def _group_sorted(it):
+    """Group a key-sorted (key, payload) iterator into (key, [payloads])."""
+    cur_key = None
+    group: list = []
+    for key, payload in it:
+        if key != cur_key:
+            if group:
+                yield cur_key, group
+            cur_key, group = key, [payload]
+        else:
+            group.append(payload)
+    if group:
+        yield cur_key, group
+
+
+def _fsync_dir(path: str) -> None:
+    """Persist directory entries (a rename is only durable once the dir is
+    fsynced — without this a crash can lose a just-written segment)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class _Segment:
+    """One immutable sorted file: keys + value offsets in memory, values on
+    disk. Records are [klen][vlen][key-msgpack][value-msgpack]."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.keys: List[Tuple[str, str]] = []
+        self._offsets: List[Tuple[int, int]] = []  # (value offset, vlen)
+        with open(path, "rb") as f:
+            while True:
+                hdr = f.read(_FRAME.size)
+                if len(hdr) < _FRAME.size:
+                    break
+                klen, vlen = _FRAME.unpack(hdr)
+                key = msgpack.unpackb(f.read(klen), raw=False)
+                self.keys.append((key[0], key[1]))
+                self._offsets.append((f.tell(), vlen))
+                f.seek(vlen, 1)
+        self._f = open(path, "rb")
+
+    def get(self, key: Tuple[str, str]) -> Optional[Tuple[bool, Optional[dict]]]:
+        """-> (found, entry_dict_or_None-for-tombstone) or None if absent."""
+        import bisect
+
+        i = bisect.bisect_left(self.keys, key)
+        if i >= len(self.keys) or self.keys[i] != key:
+            return None
+        return True, self._value(i)
+
+    def _value(self, i: int) -> Optional[dict]:
+        off, vlen = self._offsets[i]
+        self._f.seek(off)
+        raw = self._f.read(vlen)
+        v = msgpack.unpackb(raw, raw=False)
+        return v  # None == tombstone
+
+    def scan(self, lo: Tuple[str, str], hi: Tuple[str, str]):
+        """Yield (key, entry_dict_or_None) for lo <= key < hi."""
+        import bisect
+
+        i = bisect.bisect_left(self.keys, lo)
+        while i < len(self.keys) and self.keys[i] < hi:
+            yield self.keys[i], self._value(i)
+            i += 1
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def _write_segment(path: str, items: List[Tuple[Tuple[str, str], Optional[dict]]]) -> None:
+    packer = msgpack.Packer(use_bin_type=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        for key, value in items:
+            kb = packer.pack(list(key))
+            vb = packer.pack(value)
+            f.write(_FRAME.pack(len(kb), len(vb)))
+            f.write(kb)
+            f.write(vb)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class LsmFilerStore:
+    """FilerStore over a directory of WAL + segment files."""
+
+    def __init__(
+        self,
+        directory: str,
+        memtable_limit: int = 512,
+        max_segments: int = 4,
+        fsync: bool = True,
+    ):
+        os.makedirs(directory, exist_ok=True)
+        self.dir = directory
+        self.memtable_limit = memtable_limit
+        self.max_segments = max_segments
+        self.fsync = fsync
+        self._lock = threading.RLock()
+        self._mem: Dict[Tuple[str, str], Optional[dict]] = {}
+        self._packer = msgpack.Packer(use_bin_type=True)
+
+        # the MANIFEST names the live segments; files it doesn't list are
+        # leftovers from an interrupted compaction and are ignored + swept
+        # (so a failed old-segment delete can never resurrect entries)
+        self._manifest_path = os.path.join(directory, "MANIFEST")
+        if os.path.exists(self._manifest_path):
+            with open(self._manifest_path) as f:
+                seqs = [int(x) for x in f.read().split() if x]
+        else:
+            seqs = sorted(
+                int(fn[4:-4])
+                for fn in os.listdir(directory)
+                if fn.startswith("seg-") and fn.endswith(".sst")
+            )
+        self._segments: List[_Segment] = [  # oldest .. newest
+            _Segment(os.path.join(directory, f"seg-{seq}.sst"))
+            for seq in seqs
+        ]
+        self._seqs = list(seqs)
+        self._next_seq = (max(seqs) + 1) if seqs else 1
+        self._sweep_unlisted()
+
+        # WAL replay: mutations acknowledged but not yet flushed
+        self._wal_path = os.path.join(directory, "wal.log")
+        if os.path.exists(self._wal_path):
+            with open(self._wal_path, "rb") as f:
+                for rec in msgpack.Unpacker(f, raw=False):
+                    self._mem[(rec["d"], rec["n"])] = rec["e"]
+        self._wal = open(self._wal_path, "ab")
+
+    # ---------------- write path ----------------
+    def _log(self, key: Tuple[str, str], value: Optional[dict]) -> None:
+        self._wal.write(
+            self._packer.pack({"d": key[0], "n": key[1], "e": value})
+        )
+        self._wal.flush()
+        if self.fsync:
+            os.fsync(self._wal.fileno())
+        self._mem[key] = value
+        if len(self._mem) >= self.memtable_limit:
+            self._flush_memtable()
+
+    def _write_manifest(self) -> None:
+        tmp = self._manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("\n".join(str(s) for s in self._seqs))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._manifest_path)
+        _fsync_dir(self.dir)
+
+    def _sweep_unlisted(self) -> None:
+        listed = {f"seg-{s}.sst" for s in self._seqs}
+        for fn in os.listdir(self.dir):
+            if fn.startswith("seg-") and fn not in listed:
+                try:
+                    os.remove(os.path.join(self.dir, fn))
+                except OSError:
+                    pass
+
+    def _flush_memtable(self) -> None:
+        if not self._mem:
+            return
+        seq = self._next_seq
+        path = os.path.join(self.dir, f"seg-{seq}.sst")
+        _write_segment(path, sorted(self._mem.items()))
+        _fsync_dir(self.dir)  # the segment must survive before the WAL goes
+        self._segments.append(_Segment(path))
+        self._seqs.append(seq)
+        self._next_seq += 1
+        self._write_manifest()
+        self._mem = {}
+        self._wal.close()
+        self._wal = open(self._wal_path, "wb")  # truncate: flushed == durable
+        if len(self._segments) > self.max_segments:
+            self._compact()
+
+    def _compact(self) -> None:
+        """Merge every segment into one, newest wins, tombstones dropped
+        (a full merge is leveldb's major compaction, sized for this store).
+        Crash-safe via the MANIFEST: the new segment becomes live only when
+        the manifest points at it, and unlisted leftovers are swept."""
+        merged: Dict[Tuple[str, str], Optional[dict]] = {}
+        for seg in self._segments:  # oldest -> newest, later puts overwrite
+            for i, key in enumerate(seg.keys):
+                merged[key] = seg._value(i)
+        live = sorted(
+            (k, v) for k, v in merged.items() if v is not None
+        )
+        seq = self._next_seq
+        path = os.path.join(self.dir, f"seg-{seq}.sst")
+        _write_segment(path, live)
+        _fsync_dir(self.dir)
+        old = self._segments
+        self._segments = [_Segment(path)]
+        self._seqs = [seq]
+        self._next_seq += 1
+        self._write_manifest()
+        for seg in old:
+            seg.close()
+        self._sweep_unlisted()
+
+    # ---------------- FilerStore interface ----------------
+    def insert_entry(self, entry: Entry) -> None:
+        with self._lock:
+            self._log(_key(entry.full_path), entry.to_dict())
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Optional[Entry]:
+        key = _key(full_path)
+        with self._lock:
+            if key in self._mem:
+                v = self._mem[key]
+                return Entry.from_dict(v) if v is not None else None
+            for seg in reversed(self._segments):
+                hit = seg.get(key)
+                if hit is not None:
+                    v = hit[1]
+                    return Entry.from_dict(v) if v is not None else None
+        return None
+
+    def delete_entry(self, full_path: str) -> None:
+        with self._lock:
+            self._log(_key(full_path), None)
+
+    def delete_folder_children(self, full_path: str) -> None:
+        prefix = full_path.rstrip("/")
+        with self._lock:
+            for d, name in self._subtree_keys(prefix):
+                self._log((d, name), None)
+
+    def _subtree_keys(self, prefix: str) -> List[Tuple[str, str]]:
+        """Every live key whose directory is prefix or below it."""
+        out = set()
+        deep = prefix + "/"
+
+        def in_scope(d: str) -> bool:
+            return d == prefix or d.startswith(deep)
+
+        for key, v in self._mem.items():
+            if v is not None and in_scope(key[0]):
+                out.add(key)
+        for seg in self._segments:
+            for key in seg.keys:
+                if in_scope(key[0]):
+                    out.add(key)
+        # drop keys already dead at the current view
+        return [
+            k
+            for k in sorted(out)
+            if self._current(k) is not None
+        ]
+
+    def _current(self, key: Tuple[str, str]) -> Optional[dict]:
+        if key in self._mem:
+            return self._mem[key]
+        for seg in reversed(self._segments):
+            hit = seg.get(key)
+            if hit is not None:
+                return hit[1]
+        return None
+
+    def list_directory_entries(
+        self, dir_path: str, start_file_name: str, inclusive: bool, limit: int
+    ) -> List[Entry]:
+        d = dir_path.rstrip("/") or "/"
+        # resume the scan AT the pagination cursor (every source bisects to
+        # it) and stop as soon as `limit` live names have merged — a page
+        # costs O(page), not O(directory)
+        lo = (d, start_file_name or "")
+        hi = (d + "\x00", "")  # first key of any later directory
+        with self._lock:
+
+            def tagged(seg, rank):  # bind rank NOW, not at generation time
+                return ((key, (rank, v)) for key, v in seg.scan(lo, hi))
+
+            sources = [
+                tagged(seg, rank)
+                for rank, seg in enumerate(self._segments)
+            ]
+            mem_rank = len(self._segments)  # memtable is newest
+            sources.append(
+                (
+                    (key, (mem_rank, v))
+                    for key, v in sorted(self._mem.items())
+                    if lo <= key < hi
+                )
+            )
+            out: List[Entry] = []
+            for key, group in _group_sorted(heapq.merge(*sources)):
+                name = key[1]
+                if start_file_name:
+                    if inclusive and name < start_file_name:
+                        continue
+                    if not inclusive and name <= start_file_name:
+                        continue
+                v = max(group)[1]  # highest rank = newest version
+                if v is None:
+                    continue
+                out.append(Entry.from_dict(v))
+                if len(out) >= limit:
+                    break
+            return out
+
+    def close(self) -> None:
+        with self._lock:
+            self._flush_memtable()
+            self._wal.close()
+            for seg in self._segments:
+                seg.close()

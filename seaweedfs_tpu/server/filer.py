@@ -63,6 +63,10 @@ class FilerServer:
             from ..filer.filer_store import LogFilerStore
 
             store = LogFilerStore(store_path)
+        elif store_path.endswith(".lsm"):
+            from ..filer.lsm_store import LsmFilerStore
+
+            store = LsmFilerStore(store_path)
         else:
             store = SqliteFilerStore(store_path)
         self.filer = Filer(store, on_delete_chunks=self._queue_chunk_deletion)

@@ -90,9 +90,20 @@ def _fresh_log_store():
     return LogFilerStore(os.path.join(tempfile.mkdtemp(), "meta.flog"))
 
 
+def _fresh_lsm_store():
+    import tempfile
+
+    from seaweedfs_tpu.filer.lsm_store import LsmFilerStore
+
+    # tiny memtable + low segment cap so ordinary tests exercise flush and
+    # compaction, not just the memtable
+    return LsmFilerStore(tempfile.mkdtemp(), memtable_limit=4, max_segments=2)
+
+
 
 @pytest.mark.parametrize(
-    "store_cls", [MemoryFilerStore, SqliteFilerStore, _fresh_log_store]
+    "store_cls",
+    [MemoryFilerStore, SqliteFilerStore, _fresh_log_store, _fresh_lsm_store],
 )
 def test_filer_crud_and_tree(store_cls):
     f = Filer(store_cls())
@@ -137,7 +148,8 @@ def test_filer_file_blocks_subdirectory():
 
 
 @pytest.mark.parametrize(
-    "store_cls", [MemoryFilerStore, SqliteFilerStore, _fresh_log_store]
+    "store_cls",
+    [MemoryFilerStore, SqliteFilerStore, _fresh_log_store, _fresh_lsm_store],
 )
 def test_store_pagination(store_cls):
     f = Filer(store_cls())

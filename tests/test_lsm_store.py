@@ -1,0 +1,132 @@
+"""LSM filer store: WAL crash-replay, segment flush, compaction, tombstones
+(the leveldb2-class embedded store, ref weed/filer2/leveldb2/)."""
+
+import os
+
+from seaweedfs_tpu.filer.entry import Attr, Entry
+from seaweedfs_tpu.filer.lsm_store import LsmFilerStore
+
+
+def _e(path: str, tag: int = 0) -> Entry:
+    return Entry(full_path=path, attr=Attr(mtime=float(tag), mode=0o644))
+
+
+def test_wal_replay_after_crash(tmp_path):
+    d = str(tmp_path / "lsm")
+    s = LsmFilerStore(d, memtable_limit=1000)  # nothing flushes
+    for i in range(10):
+        s.insert_entry(_e(f"/a/f{i:02}", i))
+    s.delete_entry("/a/f03")
+    # crash: no close(), no flush — only the WAL survives
+    del s
+
+    s2 = LsmFilerStore(d, memtable_limit=1000)
+    assert s2.find_entry("/a/f00") is not None
+    assert s2.find_entry("/a/f03") is None
+    names = [e.name for e in s2.list_directory_entries("/a", "", True, 100)]
+    assert names == [f"f{i:02}" for i in range(10) if i != 3]
+    s2.close()
+
+
+def test_segments_flush_and_reopen(tmp_path):
+    d = str(tmp_path / "lsm")
+    s = LsmFilerStore(d, memtable_limit=3, max_segments=50)
+    for i in range(20):
+        s.insert_entry(_e(f"/x/f{i:02}", i))
+    assert any(fn.endswith(".sst") for fn in os.listdir(d))
+    s.close()
+
+    s2 = LsmFilerStore(d, memtable_limit=3, max_segments=50)
+    got = [e.name for e in s2.list_directory_entries("/x", "", True, 100)]
+    assert got == [f"f{i:02}" for i in range(20)]
+    # newest version wins across segments
+    s2.insert_entry(_e("/x/f05", 999))
+    assert s2.find_entry("/x/f05").attr.mtime == 999.0
+    s2.close()
+
+
+def test_compaction_merges_and_drops_tombstones(tmp_path):
+    d = str(tmp_path / "lsm")
+    s = LsmFilerStore(d, memtable_limit=2, max_segments=2)
+    for i in range(12):
+        s.insert_entry(_e(f"/c/f{i:02}", i))
+    for i in range(0, 12, 2):
+        s.delete_entry(f"/c/f{i:02}")
+    s.close()
+
+    s2 = LsmFilerStore(d, memtable_limit=2, max_segments=2)
+    # compaction collapsed everything into few segments
+    segs = [fn for fn in os.listdir(d) if fn.endswith(".sst")]
+    assert len(segs) <= 3
+    names = [e.name for e in s2.list_directory_entries("/c", "", True, 100)]
+    assert names == [f"f{i:02}" for i in range(1, 12, 2)]
+    for i in range(0, 12, 2):
+        assert s2.find_entry(f"/c/f{i:02}") is None
+    s2.close()
+
+
+def test_delete_folder_children_across_segments(tmp_path):
+    d = str(tmp_path / "lsm")
+    s = LsmFilerStore(d, memtable_limit=2, max_segments=10)
+    s.insert_entry(_e("/top"))
+    for i in range(6):
+        s.insert_entry(_e(f"/top/f{i}", i))
+        s.insert_entry(_e(f"/top/sub/g{i}", i))
+    s.insert_entry(_e("/other/keep"))
+    s.delete_folder_children("/top")
+    assert s.list_directory_entries("/top", "", True, 100) == []
+    assert s.list_directory_entries("/top/sub", "", True, 100) == []
+    assert s.find_entry("/other/keep") is not None
+    s.close()
+
+
+def test_pagination_merges_memtable_and_segments(tmp_path):
+    d = str(tmp_path / "lsm")
+    s = LsmFilerStore(d, memtable_limit=4, max_segments=50)
+    for i in range(9):
+        s.insert_entry(_e(f"/p/f{i}", i))
+    # page through with limit 4, exclusive resume (the filer's pattern)
+    page1 = s.list_directory_entries("/p", "", True, 4)
+    page2 = s.list_directory_entries("/p", page1[-1].name, False, 4)
+    page3 = s.list_directory_entries("/p", page2[-1].name, False, 4)
+    names = [e.name for e in page1 + page2 + page3]
+    assert names == [f"f{i}" for i in range(9)]
+    s.close()
+
+
+def test_filer_server_lsm_selection(tmp_path):
+    import asyncio
+
+    from seaweedfs_tpu.filer.lsm_store import LsmFilerStore as L
+    from seaweedfs_tpu.server.filer import FilerServer
+
+    fs = FilerServer(
+        master="127.0.0.1:1", store_path=str(tmp_path / "meta.lsm")
+    )
+    assert isinstance(fs.filer.store, L)
+    fs.filer.store.close()
+
+
+def test_manifest_ignores_interrupted_compaction_leftovers(tmp_path):
+    """A segment left behind by a crashed compaction (present on disk, not
+    in MANIFEST) must be ignored and swept — not resurrect deleted data."""
+    from seaweedfs_tpu.filer.lsm_store import _write_segment
+
+    d = str(tmp_path / "lsm")
+    s = LsmFilerStore(d, memtable_limit=2, max_segments=50)
+    s.insert_entry(_e("/m/live"))
+    s.insert_entry(_e("/m/gone"))
+    s.delete_entry("/m/gone")
+    s.close()
+
+    # forge a leftover: an old pre-tombstone segment the compaction failed
+    # to delete, with a seq not listed in the MANIFEST
+    _write_segment(
+        os.path.join(d, "seg-999.sst"),
+        [(("/m", "gone"), _e("/m/gone").to_dict())],
+    )
+    s2 = LsmFilerStore(d, memtable_limit=2, max_segments=50)
+    assert s2.find_entry("/m/gone") is None
+    assert s2.find_entry("/m/live") is not None
+    assert not os.path.exists(os.path.join(d, "seg-999.sst"))
+    s2.close()
