@@ -247,17 +247,9 @@ class LsmFilerStore:
     update_entry = insert_entry
 
     def find_entry(self, full_path: str) -> Optional[Entry]:
-        key = _key(full_path)
         with self._lock:
-            if key in self._mem:
-                v = self._mem[key]
-                return Entry.from_dict(v) if v is not None else None
-            for seg in reversed(self._segments):
-                hit = seg.get(key)
-                if hit is not None:
-                    v = hit[1]
-                    return Entry.from_dict(v) if v is not None else None
-        return None
+            v = self._current(_key(full_path))
+        return Entry.from_dict(v) if v is not None else None
 
     def delete_entry(self, full_path: str) -> None:
         with self._lock:

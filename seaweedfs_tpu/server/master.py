@@ -467,9 +467,14 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
 
                 new_vids, deleted_vids = [], []
                 if hb.get("volumes") is not None or hb.get("has_no_volumes"):
-                    new_infos, deleted_infos = dn.update_volumes(
-                        hb.get("volumes") or []
+                    new_infos, deleted_infos, changed_infos = (
+                        dn.update_volumes(hb.get("volumes") or [])
                     )
+                    # an in-place layout change (volume.configure.replication)
+                    # must move the volume between VolumeLayouts, or assigns
+                    # keep serving the old placement forever
+                    for old_info, _new_info in changed_infos:
+                        self.topo.unregister_volume(old_info, dn)
                     for info in hb.get("volumes") or []:
                         self.topo.register_volume(info, dn)
                     for info in deleted_infos:
@@ -542,7 +547,7 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
         for vid, bits in list(dn.ec_shards.items()):
             self.topo.unregister_ec_shards(vid, "", bits, dn)
             deleted.append(vid)
-        dn.update_volumes([])
+        dn.update_volumes([])  # -> ([], all, []) clears the node
         dn.update_ec_shards([])
         if dn.parent:
             dn.parent.unlink_child(dn.id)

@@ -149,6 +149,7 @@ class VolumeServer(EcHandlers):
         svc.unary("VolumeUnmount")(self._grpc_volume_unmount)
         svc.unary("VolumeDelete")(self._grpc_volume_delete)
         svc.unary("VolumeMarkReadonly")(self._grpc_volume_mark_readonly)
+        svc.unary("VolumeConfigure")(self._grpc_volume_configure)
         svc.unary("DeleteCollection")(self._grpc_delete_collection)
         svc.unary("VacuumVolumeCheck")(self._grpc_vacuum_check)
         svc.unary("VacuumVolumeCompact")(self._grpc_vacuum_compact)
@@ -917,6 +918,33 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
 
     async def _grpc_volume_mark_readonly(self, req, context) -> dict:
         self.store.mark_volume_readonly(int(req["volume_id"]))
+        return {}
+
+    async def _grpc_volume_configure(self, req, context) -> dict:
+        """Rewrite a live volume's replica placement in its super block
+        (ref volume_grpc_admin.go VolumeConfigure, super_block byte 1);
+        heartbeats then carry the new placement to the master."""
+        vid = int(req["volume_id"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return {"error": f"volume {vid} not found"}
+        from ..storage.super_block import ReplicaPlacement, SuperBlock
+
+        try:
+            rp = ReplicaPlacement.parse(req.get("replication", ""))
+        except ValueError as e:
+            return {"error": str(e)}
+        with v._lock:
+            sb = v.super_block
+            v.super_block = SuperBlock(
+                version=sb.version,
+                replica_placement=rp,
+                ttl=sb.ttl,
+                compaction_revision=sb.compaction_revision,
+                extra=sb.extra,
+            )
+            v.data_backend.write_at(v.super_block.to_bytes(), 0)
+            v.data_backend.sync()
         return {}
 
     async def _grpc_delete_collection(self, req, context) -> dict:

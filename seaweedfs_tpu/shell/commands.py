@@ -198,6 +198,90 @@ async def cmd_volume_move(env, argv) -> str:
     return f"volume {vid} moved {source} -> {target}"
 
 
+@command("volume.copy")
+async def cmd_volume_copy(env, argv) -> str:
+    """volume.copy <source host:port> <target host:port> <volume id> —
+    copy a volume between volume servers (ref command_volume_copy.go;
+    usually unmount it first)."""
+    env.confirm_is_locked()
+    # positionals = tokens that are neither flags nor a flag's value
+    args = []
+    flags = {}
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a.startswith("-"):
+            key = a.lstrip("-")
+            if "=" in key:
+                key, _, val = key.partition("=")
+                flags[key] = val
+            elif i + 1 < len(argv):
+                flags[key] = argv[i + 1]
+                i += 1
+        else:
+            args.append(a)
+        i += 1
+    if len(args) != 3:
+        return (
+            "usage: volume.copy <source host:port> <target host:port> "
+            "<volume id>"
+        )
+    source, target, vid_s = args
+    try:
+        vid = int(vid_s)
+    except ValueError:
+        return f"wrong volume id format {vid_s!r}"
+    if source == target:
+        return "source and target volume servers are the same!"
+    r = await env.volume_stub(target).call(
+        "VolumeCopy",
+        {
+            "volume_id": vid,
+            "collection": flags.get("collection", ""),
+            "source_data_node": source,
+        },
+        timeout=3600,
+    )
+    if r.get("error"):
+        return f"copy failed: {r['error']}"
+    return f"volume {vid} copied {source} -> {target}"
+
+
+@command("volume.configure.replication")
+async def cmd_volume_configure_replication(env, argv) -> str:
+    """Change a volume's replica placement in place
+    (ref command_volume_configure_replication.go): every server holding
+    the volume rewrites its super block; heartbeats propagate the change."""
+    env.confirm_is_locked()
+    flags = _parse_flags(argv)
+    vid = int(flags["volumeId"])
+    replication = flags.get("replication", "")
+    from ..storage.super_block import ReplicaPlacement
+
+    try:
+        rp = ReplicaPlacement.parse(replication)
+    except ValueError as e:
+        return f"replication format: {e}"
+    holders = []
+    for dn in await env.collect_data_nodes():
+        for v in dn.get("volumes", []):
+            if int(v["id"]) == vid and int(
+                v.get("replica_placement", 0)
+            ) != rp.to_byte():
+                holders.append(dn["url"])
+    if not holders:
+        return "no volume needs change"
+    for url in holders:
+        r = await env.volume_stub(url).call(
+            "VolumeConfigure", {"volume_id": vid, "replication": replication}
+        )
+        if r.get("error"):
+            return f"configure on {url} failed: {r['error']}"
+    return (
+        f"volume {vid}: replication -> {rp} on {len(holders)} server(s)"
+    )
+
+
 @command("volume.tier.upload")
 async def cmd_volume_tier_upload(env, argv) -> str:
     """Move a volume's .dat to a remote tier

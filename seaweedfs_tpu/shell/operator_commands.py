@@ -41,6 +41,16 @@ def _fs_args(argv: list[str], value_flags=("filer", "name")) -> tuple[dict, list
     return flags, positional
 
 
+def _abs(env, path: str) -> str:
+    """Resolve a path against the shell's working directory (fs.cd)."""
+    cwd = getattr(env, "cwd", "/")
+    if not path or path == ".":
+        return cwd
+    if not path.startswith("/"):
+        path = (cwd.rstrip("/") or "") + "/" + path
+    return path
+
+
 def _filer_stub(env, flags) -> Stub:
     addr = flags.get("filer") or getattr(env, "filer", None)
     if not addr:
@@ -323,7 +333,7 @@ async def cmd_fs_ls(env, argv) -> str:
     """fs.ls [-filer host:port] [-l] /dir"""
     flags, positional = _fs_args(argv)
     stub = _filer_stub(env, flags)
-    path = positional[0] if positional else "/"
+    path = _abs(env, positional[0] if positional else "")
     entries = await _list_dir(stub, path.rstrip("/") or "/")
     long_format = "l" in flags
     lines = []
@@ -345,7 +355,7 @@ async def cmd_fs_du(env, argv) -> str:
     """fs.du [-filer host:port] /dir — recursive bytes + file/dir counts."""
     flags, positional = _fs_args(argv)
     stub = _filer_stub(env, flags)
-    path = (positional[0] if positional else "/").rstrip("/") or "/"
+    path = _abs(env, positional[0] if positional else "").rstrip("/") or "/"
 
     total_bytes = 0
     n_files = 0
@@ -371,7 +381,7 @@ async def cmd_fs_cat(env, argv) -> str:
     stub = _filer_stub(env, flags)
     if not positional:
         return "usage: fs.cat [-filer host:port] /path/to/file"
-    path = positional[0]
+    path = _abs(env, positional[0])
     directory, _, name = path.rstrip("/").rpartition("/")
     resp = await stub.call(
         "LookupDirectoryEntry", {"directory": directory or "/", "name": name}
@@ -495,6 +505,142 @@ async def cmd_fs_rm(env, argv) -> str:
 
 
 # ---------------- bucket.* (ref command_bucket_*.go) ----------------
+@command("fs.tree")
+async def cmd_fs_tree(env, argv) -> str:
+    """fs.tree [-filer host:port] /dir — recursive tree listing
+    (ref command_fs_tree.go)."""
+    flags, positional = _fs_args(argv)
+    stub = _filer_stub(env, flags)
+    root = _abs(env, positional[0] if positional else "").rstrip("/") or "/"
+    lines = [root]
+    n_dirs = 0
+    n_files = 0
+    # explicit stack (depth-unbounded, like fs.du): "expand" frames list a
+    # directory and push its children; "emit" frames print one entry and,
+    # for directories, queue their own expansion right after their line
+    stack: list = [("expand", root, "")]
+    while stack:
+        kind, *frame = stack.pop()
+        if kind == "expand":
+            directory, prefix = frame
+            entries = sorted(
+                await _list_dir(stub, directory),
+                key=lambda e: e["full_path"],
+            )
+            for i in range(len(entries) - 1, -1, -1):
+                stack.append(
+                    ("emit", entries[i], prefix, i == len(entries) - 1)
+                )
+        else:
+            e, prefix, last = frame
+            name = e["full_path"].rsplit("/", 1)[-1]
+            lines.append(prefix + ("└── " if last else "├── ") + name)
+            if e.get("is_directory"):
+                n_dirs += 1
+                stack.append(
+                    (
+                        "expand",
+                        e["full_path"],
+                        prefix + ("    " if last else "│   "),
+                    )
+                )
+            else:
+                n_files += 1
+    lines.append(f"\n{n_dirs} directories, {n_files} files")
+    return "\n".join(lines)
+
+
+@command("fs.cd")
+async def cmd_fs_cd(env, argv) -> str:
+    """fs.cd [-filer host:port] /dir — set the shell's working directory
+    (ref command_fs_cd.go)."""
+    flags, positional = _fs_args(argv)
+    stub = _filer_stub(env, flags)
+    target = _abs(env, positional[0] if positional else "/").rstrip("/") or "/"
+    if target != "/":
+        entry = await _lookup_entry(stub, target)
+        if entry is None or not entry.get("is_directory"):
+            return f"fs.cd: {target}: no such directory"
+    env.cwd = target
+    return target
+
+
+@command("fs.pwd")
+async def cmd_fs_pwd(env, argv) -> str:
+    """Print the shell's working directory (ref command_fs_pwd.go)."""
+    return getattr(env, "cwd", "/")
+
+
+@command("fs.meta.save")
+async def cmd_fs_meta_save(env, argv) -> str:
+    """fs.meta.save [-filer host:port] [-o file.meta] /dir — snapshot the
+    subtree's metadata into a local file (ref command_fs_meta_save.go):
+    one msgpack record per entry, directories before their children."""
+    import time as _time
+
+    import msgpack
+
+    flags, positional = _fs_args(argv, value_flags=("filer", "o"))
+    stub = _filer_stub(env, flags)
+    root = _abs(env, positional[0] if positional else "").rstrip("/") or "/"
+    out_path = flags.get("o") or (
+        f"{(root.strip('/') or 'root').replace('/', '-')}-"
+        f"{_time.strftime('%Y-%m-%d-%H-%M')}.meta"
+    )
+    packer = msgpack.Packer(use_bin_type=True)
+    count = 0
+    with open(out_path, "wb") as f:
+        stack = [root]
+        while stack:
+            directory = stack.pop()
+            for e in await _list_dir(stub, directory):
+                f.write(packer.pack(e))
+                count += 1
+                if e.get("is_directory"):
+                    stack.append(e["full_path"])
+    return f"saved {count} meta entries to {out_path}"
+
+
+@command("fs.meta.load")
+async def cmd_fs_meta_load(env, argv) -> str:
+    """fs.meta.load [-filer host:port] file.meta — restore entries saved by
+    fs.meta.save into the filer (ref command_fs_meta_load.go)."""
+    import msgpack
+
+    flags, positional = _fs_args(argv)
+    stub = _filer_stub(env, flags)
+    if not positional:
+        return "usage: fs.meta.load [-filer host:port] file.meta"
+    count = 0
+    with open(positional[0], "rb") as f:
+        for rec in msgpack.Unpacker(f, raw=False):
+            resp = await stub.call("CreateEntry", {"entry": rec})
+            if resp.get("error"):
+                return (
+                    f"load failed at {rec.get('full_path')}: {resp['error']} "
+                    f"({count} entries restored)"
+                )
+            count += 1
+    return f"restored {count} meta entries from {positional[0]}"
+
+
+@command("fs.meta.cat")
+async def cmd_fs_meta_cat(env, argv) -> str:
+    """fs.meta.cat [-filer host:port] /path — print one entry's raw
+    metadata (ref command_fs_meta_cat.go)."""
+    import json
+
+    flags, positional = _fs_args(argv)
+    stub = _filer_stub(env, flags)
+    if not positional:
+        return "usage: fs.meta.cat [-filer host:port] /path"
+    path = _abs(env, positional[0])
+    entry = await _lookup_entry(stub, path)
+    if entry is None:
+        return f"fs.meta.cat: {path}: not found"
+    return json.dumps(entry, indent=2, sort_keys=True, default=str)
+
+
 @command("bucket.list")
 async def cmd_bucket_list(env, argv) -> str:
     """bucket.list [-filer host:port]"""

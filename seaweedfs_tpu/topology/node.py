@@ -107,11 +107,20 @@ class DataNode(Node):
     def data_center(self) -> Optional["DataCenter"]:
         return self.parent.parent if self.parent else None  # type: ignore
 
-    def update_volumes(self, volume_infos: list[dict]) -> tuple[list[dict], list[dict]]:
-        """Full-state sync; returns (new, deleted) volume infos
+    def update_volumes(
+        self, volume_infos: list[dict]
+    ) -> tuple[list[dict], list[dict], list[tuple[dict, dict]]]:
+        """Full-state sync; returns (new, deleted, changed) volume infos —
+        changed as (old, new) pairs whose layout key (replication/ttl/
+        collection) moved, e.g. after volume.configure.replication
         (ref data_node.go UpdateVolumes)."""
         incoming = {int(v["id"]): v for v in volume_infos}
-        new, deleted = [], []
+        new, deleted, changed = [], [], []
+        layout_key = lambda v: (
+            v.get("collection", ""),
+            v.get("replica_placement", 0),
+            v.get("ttl", 0),
+        )
         with self._lock:
             for vid in list(self.volumes):
                 if vid not in incoming:
@@ -122,8 +131,10 @@ class DataNode(Node):
                     new.append(info)
                     self.adjust_volume_count(1)
                     self.adjust_max_volume_id(vid)
+                elif layout_key(self.volumes[vid]) != layout_key(info):
+                    changed.append((self.volumes[vid], info))
                 self.volumes[vid] = info
-        return new, deleted
+        return new, deleted, changed
 
     def delta_update_volumes(
         self, new_volumes: list[dict], deleted_volumes: list[dict]

@@ -191,3 +191,142 @@ def test_fsck_fs_and_buckets(tmp_path):
             await cluster.stop()
 
     asyncio.run(body())
+
+
+def test_shell_long_tail_commands(tmp_path):
+    """fs.tree / fs.cd / fs.pwd / fs.meta.save|load|cat, volume.copy and
+    volume.configure.replication against live servers (ref
+    command_fs_tree.go, command_fs_meta_save.go, command_volume_copy.go,
+    command_volume_configure_replication.go)."""
+
+    async def body():
+        random.seed(61)
+        cluster = Cluster(tmp_path, n_volume_servers=2)
+        await cluster.start()
+        from seaweedfs_tpu.server.filer import FilerServer
+
+        fs = FilerServer(
+            master=cluster.master.address,
+            port=free_port_pair(),
+            chunk_size=32 * 1024,
+        )
+        await fs.start()
+        try:
+            await fs.master_client.wait_connected()
+            env = CommandEnv(cluster.master.address, filer=fs.address)
+            async with aiohttp.ClientSession() as session:
+                base = f"http://{fs.address}"
+                for path, payload in [
+                    ("/proj/readme.md", b"hello"),
+                    ("/proj/src/main.py", b"print(1)"),
+                    ("/proj/src/util.py", b"pass"),
+                ]:
+                    async with session.put(f"{base}{path}", data=payload) as r:
+                        assert r.status == 201
+
+                # fs.tree
+                out = await run_command(env, "fs.tree /proj")
+                assert "src" in out and "main.py" in out, out
+                assert "2 directories" not in out.split("\n")[0]
+                assert "directories" in out and "files" in out
+
+                # fs.cd / fs.pwd (relative + absolute + missing)
+                assert await run_command(env, "fs.pwd") == "/"
+                assert await run_command(env, "fs.cd /proj") == "/proj"
+                assert await run_command(env, "fs.pwd") == "/proj"
+                assert await run_command(env, "fs.cd src") == "/proj/src"
+                # relative paths resolve against the working directory
+                out = await run_command(env, "fs.ls .")
+                assert "main.py" in out and "util.py" in out, out
+                assert await run_command(env, "fs.cd /proj") == "/proj"
+                out = await run_command(env, "fs.ls src")
+                assert "main.py" in out, out
+                out = await run_command(env, "fs.cd /nope")
+                assert "no such directory" in out
+
+                # fs.meta.cat
+                out = await run_command(env, "fs.meta.cat /proj/readme.md")
+                assert '"full_path"' in out and "readme.md" in out
+
+                # fs.meta.save -> wipe -> fs.meta.load -> listing restored
+                meta_file = str(tmp_path / "snap.meta")
+                out = await run_command(
+                    env, f"fs.meta.save -o {meta_file} /proj"
+                )
+                assert "saved" in out and "meta entries" in out, out
+                out = await run_command(env, "fs.rm -r /proj")
+                assert "removed" in out, out
+                out = await run_command(env, "fs.ls /proj")
+                assert "empty" in out or "error" in out
+                out = await run_command(env, f"fs.meta.load {meta_file}")
+                assert "restored" in out, out
+                out = await run_command(env, "fs.tree /proj")
+                assert "main.py" in out and "util.py" in out, out
+
+                # ---- volume.copy + volume.configure.replication ----
+                ar = await assign(cluster.master.address)
+                await upload_data(
+                    session, ar.url, ar.fid, b"copy-me", filename="c.bin"
+                )
+                vid = int(ar.fid.split(",")[0])
+                source = ar.url
+                target = next(
+                    vs.address
+                    for vs in cluster.volume_servers
+                    if vs.address != source
+                )
+                await run_command(env, "lock")
+                out = await run_command(
+                    env, f"volume.copy {source} {target} {vid}"
+                )
+                assert "copied" in out, out
+                # the copy serves reads directly
+                from seaweedfs_tpu.client.operation import read_url
+
+                got = await read_url(session, f"http://{target}/{ar.fid}")
+                assert got == b"copy-me"
+                # copying onto a holder refuses
+                out = await run_command(
+                    env, f"volume.copy {target} {target} {vid}"
+                )
+                assert "same" in out
+
+                # configure must see BOTH holders at the master first
+                for _ in range(100):
+                    holders = {
+                        dn["url"]
+                        for dn in await env.collect_data_nodes()
+                        if any(
+                            int(v["id"]) == vid
+                            for v in dn.get("volumes", [])
+                        )
+                    }
+                    if {source, target} <= holders:
+                        break
+                    await asyncio.sleep(0.1)
+                assert {source, target} <= holders, holders
+
+                out = await run_command(
+                    env,
+                    f"volume.configure.replication -volumeId {vid} "
+                    "-replication 001",
+                )
+                assert "replication" in out, out
+                for vs in cluster.volume_servers:
+                    v = vs.store.find_volume(vid)
+                    if v is not None:
+                        assert (
+                            v.super_block.replica_placement.to_byte() == 1
+                        ), vs.address
+                out = await run_command(
+                    env,
+                    f"volume.configure.replication -volumeId {vid} "
+                    "-replication abc",
+                )
+                assert "replication format" in out
+                await run_command(env, "unlock")
+        finally:
+            await fs.stop()
+            await cluster.stop()
+
+    asyncio.run(body())
