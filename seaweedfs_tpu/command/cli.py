@@ -403,15 +403,42 @@ def cmd_filer(argv: list[str]) -> int:
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")
     p.add_argument("-jwtSigningKey", default="")
-    _apply_config_defaults(p, argv, ["filer", "security"])
+    p.add_argument(
+        "-notifySink",
+        default="",
+        choices=["", "none", "log", "memory", "broker", "webhook", "s3"],
+        help="publish filer mutation events (ref notification.toml): "
+        "webhook POSTs JSON to -notifyUrl; s3 writes signed event objects "
+        "to -notifyEndpoint/-notifyBucket; broker publishes to -notifyBroker",
+    )
+    p.add_argument("-notifyUrl", default="", help="webhook sink target URL")
+    p.add_argument("-notifyBroker", default="", help="broker sink host:port")
+    p.add_argument("-notifyTopic", default="filer")
+    p.add_argument("-notifyEndpoint", default="", help="s3 sink host:port")
+    p.add_argument("-notifyBucket", default="")
+    p.add_argument("-notifyAccessKey", default="")
+    p.add_argument("-notifySecretKey", default="")
+    _apply_config_defaults(p, argv, ["filer", "security", "notification"])
     args = p.parse_args(argv)
+    from ..notification import Notifier, build_sink
     from ..server.filer import FilerServer
 
+    sink = build_sink(
+        args.notifySink,
+        url=args.notifyUrl,
+        broker=args.notifyBroker,
+        topic=args.notifyTopic,
+        endpoint=args.notifyEndpoint,
+        bucket=args.notifyBucket,
+        access_key=args.notifyAccessKey,
+        secret_key=args.notifySecretKey,
+    )
     fs = FilerServer(
         master=args.master,
         host=args.ip,
         port=args.port,
         store_path=args.store,
+        notifier=Notifier([sink]) if sink is not None else None,
         chunk_size=args.maxMB * 1024 * 1024,
         collection=args.collection,
         replication=args.replication,

@@ -95,6 +95,131 @@ class BrokerSink(NotificationSink):
         task.add_done_callback(self._tasks.discard)
 
 
+class _AsyncPostingSink(NotificationSink):
+    """Base for sinks that deliver via an async HTTP request: schedules the
+    coroutine on the running loop (strong task refs), or runs it on a
+    private loop for sync callers. One pooled ClientSession serves all
+    loop-scheduled events (the filer mutation path is hot); sync callers
+    get a throwaway session since theirs dies with the private loop."""
+
+    _tasks: set
+    _session = None
+
+    async def _deliver(self, event_type, path, entry) -> None:
+        raise NotImplementedError
+
+    async def _http(self):
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    def send(self, event_type, path, entry) -> None:
+        import asyncio
+
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+
+            async def once():
+                try:
+                    await self._deliver(event_type, path, entry)
+                finally:
+                    if self._session is not None:
+                        await self._session.close()
+                        self._session = None
+
+            asyncio.run(once())
+            return
+        task = loop.create_task(self._deliver(event_type, path, entry))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    @staticmethod
+    def _payload(event_type, path, entry) -> bytes:
+        import json
+        import time
+
+        return json.dumps(
+            {
+                "event": event_type,
+                "path": path,
+                "entry": entry,
+                "ts_ns": time.time_ns(),
+            },
+            default=str,
+        ).encode()
+
+
+class WebhookSink(_AsyncPostingSink):
+    """POST each event as JSON to an HTTP endpoint — the generic plugin
+    shape (the reference's gocdk/http-topic role,
+    ref notification/configuration.go) provable against any loopback
+    listener."""
+
+    def __init__(self, url: str, timeout: float = 10.0):
+        self.url = url
+        self.timeout = timeout
+        self._tasks = set()
+
+    async def _deliver(self, event_type, path, entry) -> None:
+        import aiohttp
+
+        session = await self._http()
+        async with session.post(
+            self.url,
+            data=self._payload(event_type, path, entry),
+            headers={"Content-Type": "application/json"},
+            timeout=aiohttp.ClientTimeout(total=self.timeout),
+        ) as resp:
+            await resp.read()
+
+
+class S3EventSink(_AsyncPostingSink):
+    """Write each event as a V4-signed object into an S3 bucket (the
+    aws-queue plugin seam made loopback-testable: point it at the
+    in-process S3 gateway). Object key: <prefix><ts_ns>-<event>.json."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        bucket: str,
+        access_key: str = "",
+        secret_key: str = "",
+        region: str = "us-east-1",
+        prefix: str = "filer-events/",
+    ):
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.prefix = prefix
+        self._tasks = set()
+
+    async def _deliver(self, event_type, path, entry) -> None:
+        import time
+
+        from ..s3.auth import sign_request
+
+        key = f"{self.prefix}{time.time_ns()}-{event_type}.json"
+        url = f"http://{self.endpoint}/{self.bucket}/{key}"
+        payload = self._payload(event_type, path, entry)
+        headers = sign_request(
+            "PUT", url, {}, payload,
+            self.access_key, self.secret_key, self.region,
+        )
+        session = await self._http()
+        async with session.put(url, data=payload, headers=headers) as resp:
+            await resp.read()
+
+
 class UnavailableSink(NotificationSink):
     def __init__(self, name: str):
         self.name = name
@@ -115,6 +240,39 @@ SINK_FACTORIES: dict[str, Callable[[], NotificationSink]] = {
     "google_pub_sub": lambda: UnavailableSink("google_pub_sub"),
     "gocdk_pub_sub": lambda: UnavailableSink("gocdk_pub_sub"),
 }
+
+
+def build_sink(kind: str, **params) -> Optional[NotificationSink]:
+    """Config-driven sink construction (the filer's -notifySink flags /
+    [notification] TOML section; ref notification/configuration.go
+    LoadConfiguration)."""
+    kind = (kind or "").strip()
+    if not kind or kind == "none":
+        return None
+    if kind == "broker":
+        if not params.get("broker"):
+            raise ValueError("broker sink needs a broker host:port")
+        return BrokerSink(
+            params["broker"], topic=params.get("topic", "filer")
+        )
+    if kind == "webhook":
+        if not params.get("url"):
+            raise ValueError("webhook sink needs a url")
+        return WebhookSink(params["url"])
+    if kind == "s3":
+        if not params.get("endpoint") or not params.get("bucket"):
+            raise ValueError("s3 sink needs endpoint and bucket")
+        return S3EventSink(
+            params["endpoint"],
+            params["bucket"],
+            access_key=params.get("access_key", ""),
+            secret_key=params.get("secret_key", ""),
+            region=params.get("region", "us-east-1"),
+            prefix=params.get("prefix", "filer-events/"),
+        )
+    if kind in SINK_FACTORIES:
+        return SINK_FACTORIES[kind]()
+    raise ValueError(f"unknown notification sink {kind!r}")
 
 
 class Notifier:

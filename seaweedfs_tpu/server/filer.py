@@ -46,6 +46,7 @@ class FilerServer:
         collection: str = "",
         replication: str = "",
         jwt_signing_key: str = "",
+        notifier=None,
     ):
         self.master = master
         self.host = host
@@ -69,7 +70,11 @@ class FilerServer:
             store = LsmFilerStore(store_path)
         else:
             store = SqliteFilerStore(store_path)
-        self.filer = Filer(store, on_delete_chunks=self._queue_chunk_deletion)
+        self.filer = Filer(
+            store,
+            on_delete_chunks=self._queue_chunk_deletion,
+            notifier=notifier,
+        )
         self.master_client = MasterClient(f"filer@{self.address}", [master])
         self._deletion_queue: asyncio.Queue = asyncio.Queue()
         self._deletion_task: Optional[asyncio.Task] = None

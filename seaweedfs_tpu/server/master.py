@@ -481,16 +481,19 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
                         self.topo.unregister_volume(info, dn)
                     new_vids += [int(i["id"]) for i in new_infos]
                     deleted_vids += [int(i["id"]) for i in deleted_infos]
-                if hb.get("new_volumes"):
-                    dn.delta_update_volumes(hb["new_volumes"], [])
-                    for info in hb["new_volumes"]:
-                        self.topo.register_volume(info, dn)
-                        new_vids.append(int(info["id"]))
+                # deletions first: a changed volume arrives as a
+                # (deleted=old-info, new=new-info) pair and must leave its
+                # old layout before (re)registering in the new one
                 if hb.get("deleted_volumes"):
                     dn.delta_update_volumes([], hb["deleted_volumes"])
                     for info in hb["deleted_volumes"]:
                         self.topo.unregister_volume(info, dn)
                         deleted_vids.append(int(info["id"]))
+                if hb.get("new_volumes"):
+                    dn.delta_update_volumes(hb["new_volumes"], [])
+                    for info in hb["new_volumes"]:
+                        self.topo.register_volume(info, dn)
+                        new_vids.append(int(info["id"]))
 
                 if hb.get("ec_shards") is not None or hb.get("has_no_ec_shards"):
                     new_ec, deleted_ec = dn.update_ec_shards(

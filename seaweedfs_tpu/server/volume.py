@@ -934,6 +934,7 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
             rp = ReplicaPlacement.parse(req.get("replication", ""))
         except ValueError as e:
             return {"error": str(e)}
+        old_msg = self.store._volume_message(v)
         with v._lock:
             sb = v.super_block
             v.super_block = SuperBlock(
@@ -945,6 +946,13 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
             )
             v.data_backend.write_at(v.super_block.to_bytes(), 0)
             v.data_backend.sync()
+        # steady-state propagation: the next heartbeat tick carries the
+        # change as a deleted(old)+new(new) delta pair, moving the volume
+        # between the master's VolumeLayouts without a stream reconnect
+        new_msg = self.store._volume_message(v)
+        with self.store._lock:
+            self.store.deleted_volumes.append(old_msg)
+            self.store.new_volumes.append(new_msg)
         return {}
 
     async def _grpc_delete_collection(self, req, context) -> dict:

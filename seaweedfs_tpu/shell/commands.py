@@ -204,23 +204,9 @@ async def cmd_volume_copy(env, argv) -> str:
     copy a volume between volume servers (ref command_volume_copy.go;
     usually unmount it first)."""
     env.confirm_is_locked()
-    # positionals = tokens that are neither flags nor a flag's value
-    args = []
-    flags = {}
-    i = 0
-    while i < len(argv):
-        a = argv[i]
-        if a.startswith("-"):
-            key = a.lstrip("-")
-            if "=" in key:
-                key, _, val = key.partition("=")
-                flags[key] = val
-            elif i + 1 < len(argv):
-                flags[key] = argv[i + 1]
-                i += 1
-        else:
-            args.append(a)
-        i += 1
+    from .operator_commands import _fs_args
+
+    flags, args = _fs_args(argv, value_flags=("collection",))
     if len(args) != 3:
         return (
             "usage: volume.copy <source host:port> <target host:port> "

@@ -42,13 +42,16 @@ def _fs_args(argv: list[str], value_flags=("filer", "name")) -> tuple[dict, list
 
 
 def _abs(env, path: str) -> str:
-    """Resolve a path against the shell's working directory (fs.cd)."""
+    """Resolve a path against the shell's working directory (fs.cd),
+    normalizing '.'/'..' components."""
+    import posixpath
+
     cwd = getattr(env, "cwd", "/")
-    if not path or path == ".":
+    if not path:
         return cwd
     if not path.startswith("/"):
         path = (cwd.rstrip("/") or "") + "/" + path
-    return path
+    return posixpath.normpath(path)
 
 
 def _filer_stub(env, flags) -> Stub:

@@ -1,0 +1,182 @@
+"""Loopback notification sinks: filer events landing in our own S3 gateway
+(S3EventSink) and an HTTP listener (WebhookSink) — the plugin seam of
+ref weed/notification/configuration.go proven without egress."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from tests.test_cluster import Cluster, free_port_pair
+
+from seaweedfs_tpu.notification import (
+    Notifier,
+    S3EventSink,
+    WebhookSink,
+    build_sink,
+)
+from seaweedfs_tpu.pb.rpc import close_all_channels
+from seaweedfs_tpu.s3.auth import IdentityAccessManagement
+from seaweedfs_tpu.s3.server import S3Server
+from seaweedfs_tpu.server.filer import FilerServer
+
+
+def test_s3_event_sink_loopback(tmp_path):
+    """Filer mutations become signed event objects in the in-process S3
+    gateway's bucket."""
+
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        iam = IdentityAccessManagement.from_config(
+            {
+                "identities": [
+                    {
+                        "name": "events",
+                        "credentials": [
+                            {"accessKey": "AKE", "secretKey": "SKE"}
+                        ],
+                        "actions": ["Admin"],
+                    }
+                ]
+            }
+        )
+        # gateway filer (receives event objects)
+        fs_gw = FilerServer(
+            master=cluster.master.address, port=free_port_pair()
+        )
+        await fs_gw.start()
+        s3 = S3Server(fs_gw, port=free_port_pair(), iam=iam)
+        await s3.start()
+
+        # the events bucket must exist (normal S3 operator step)
+        from seaweedfs_tpu.s3.auth import sign_request
+
+        burl = f"http://{s3.address}/events"
+        async with aiohttp.ClientSession() as session:
+            headers = sign_request("PUT", burl, {}, b"", "AKE", "SKE")
+            async with session.put(burl, headers=headers) as r:
+                assert r.status in (200, 201), await r.text()
+
+        sink = S3EventSink(
+            s3.address, "events", access_key="AKE", secret_key="SKE"
+        )
+        # source filer publishes its mutations through the sink
+        fs_src = FilerServer(
+            master=cluster.master.address,
+            port=free_port_pair(),
+            notifier=Notifier([sink]),
+        )
+        await fs_src.start()
+        try:
+            await fs_gw.master_client.wait_connected()
+            await fs_src.master_client.wait_connected()
+            async with aiohttp.ClientSession() as session:
+                base = f"http://{fs_src.address}"
+                async with session.put(
+                    f"{base}/inbox/hello.txt", data=b"notify me"
+                ) as r:
+                    assert r.status == 201
+                async with session.delete(
+                    f"{base}/inbox/hello.txt"
+                ) as r:
+                    assert r.status in (200, 202, 204)
+
+                # poll the gateway bucket for the event objects
+                events = []
+                for _ in range(100):
+                    entries = fs_gw.filer.list_entries(
+                        "/buckets/events/filer-events"
+                    )
+                    if len(entries) >= 2:
+                        for e in entries:
+                            body_resp = await session.get(
+                                f"http://{fs_gw.address}"
+                                f"/buckets/events/filer-events/{e.name}"
+                            )
+                            events.append(json.loads(await body_resp.read()))
+                        break
+                    await asyncio.sleep(0.1)
+                kinds = {e["event"] for e in events}
+                paths = {e["path"] for e in events}
+                assert "create" in kinds and "delete" in kinds, events
+                assert "/inbox/hello.txt" in paths
+        finally:
+            await fs_src.stop()
+            await s3.stop()
+            await fs_gw.stop()
+            await cluster.stop()
+            await close_all_channels()
+
+    asyncio.run(body())
+
+
+def test_webhook_sink_loopback(tmp_path):
+    """Filer mutations POST JSON to a local HTTP listener."""
+
+    async def body():
+        received = []
+
+        async def hook(request: web.Request) -> web.Response:
+            received.append(json.loads(await request.read()))
+            return web.Response(text="ok")
+
+        app = web.Application()
+        app.router.add_post("/hook", hook)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        port = free_port_pair()
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        await site.start()
+
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        fs = FilerServer(
+            master=cluster.master.address,
+            port=free_port_pair(),
+            notifier=Notifier(
+                [WebhookSink(f"http://127.0.0.1:{port}/hook")]
+            ),
+        )
+        await fs.start()
+        try:
+            await fs.master_client.wait_connected()
+            async with aiohttp.ClientSession() as session:
+                async with session.put(
+                    f"http://{fs.address}/w/a.txt", data=b"x"
+                ) as r:
+                    assert r.status == 201
+            for _ in range(100):
+                if any(e["path"] == "/w/a.txt" for e in received):
+                    break
+                await asyncio.sleep(0.05)
+            assert any(
+                e["event"] == "create" and e["path"] == "/w/a.txt"
+                for e in received
+            ), received
+        finally:
+            await fs.stop()
+            await cluster.stop()
+            await runner.cleanup()
+            await close_all_channels()
+
+    asyncio.run(body())
+
+
+def test_build_sink_validation():
+    assert build_sink("") is None
+    assert build_sink("none") is None
+    assert isinstance(
+        build_sink("webhook", url="http://x/"), WebhookSink
+    )
+    assert isinstance(
+        build_sink("s3", endpoint="h:1", bucket="b"), S3EventSink
+    )
+    with pytest.raises(ValueError):
+        build_sink("webhook")
+    with pytest.raises(ValueError):
+        build_sink("s3", endpoint="h:1")
+    with pytest.raises(ValueError):
+        build_sink("wat")

@@ -241,6 +241,11 @@ def test_shell_long_tail_commands(tmp_path):
                 assert await run_command(env, "fs.cd /proj") == "/proj"
                 out = await run_command(env, "fs.ls src")
                 assert "main.py" in out, out
+                # '..' navigation normalizes
+                assert await run_command(env, "fs.cd src") == "/proj/src"
+                assert await run_command(env, "fs.cd ..") == "/proj"
+                out = await run_command(env, "fs.ls ../proj/src")
+                assert "main.py" in out, out
                 out = await run_command(env, "fs.cd /nope")
                 assert "no such directory" in out
 
@@ -318,6 +323,18 @@ def test_shell_long_tail_commands(tmp_path):
                         assert (
                             v.super_block.replica_placement.to_byte() == 1
                         ), vs.address
+                # the change reaches the master via heartbeat deltas: once
+                # there, a re-run finds nothing left to configure
+                for _ in range(100):
+                    out = await run_command(
+                        env,
+                        f"volume.configure.replication -volumeId {vid} "
+                        "-replication 001",
+                    )
+                    if out == "no volume needs change":
+                        break
+                    await asyncio.sleep(0.1)
+                assert out == "no volume needs change", out
                 out = await run_command(
                     env,
                     f"volume.configure.replication -volumeId {vid} "
