@@ -215,8 +215,13 @@ class S3EventSink(_AsyncPostingSink):
             "PUT", url, {}, payload,
             self.access_key, self.secret_key, self.region,
         )
+        import aiohttp
+
         session = await self._http()
-        async with session.put(url, data=payload, headers=headers) as resp:
+        async with session.put(
+            url, data=payload, headers=headers,
+            timeout=aiohttp.ClientTimeout(total=10),
+        ) as resp:
             await resp.read()
 
 
@@ -288,3 +293,12 @@ class Notifier:
                 sink.send(event_type, path, entry)
             except Exception:
                 pass
+
+    async def close(self) -> None:
+        for sink in self.sinks:
+            closer = getattr(sink, "close", None)
+            if closer is not None:
+                try:
+                    await closer()
+                except Exception:
+                    pass

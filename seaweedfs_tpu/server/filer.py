@@ -122,6 +122,10 @@ class FilerServer:
         await self.master_client.stop()
         if self._session is not None:
             await self._session.close()
+        if self.filer.notifier is not None:
+            closer = getattr(self.filer.notifier, "close", None)
+            if closer is not None:
+                await closer()
 
     # ---------------- async chunk GC (ref filer2/filer_deletion.go) ----------------
     def _queue_chunk_deletion(self, fids: list[str]) -> None:

@@ -949,10 +949,7 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
         # steady-state propagation: the next heartbeat tick carries the
         # change as a deleted(old)+new(new) delta pair, moving the volume
         # between the master's VolumeLayouts without a stream reconnect
-        new_msg = self.store._volume_message(v)
-        with self.store._lock:
-            self.store.deleted_volumes.append(old_msg)
-            self.store.new_volumes.append(new_msg)
+        self.store.note_volume_changed(old_msg, self.store._volume_message(v))
         return {}
 
     async def _grpc_delete_collection(self, req, context) -> dict:

@@ -209,11 +209,35 @@ class Store:
             "has_no_ec_shards": len(shard_messages) == 0,
         }
 
+    def note_volume_changed(self, old_msg: dict, new_msg: dict) -> None:
+        """Queue an in-place layout change (e.g. replica placement rewrite)
+        as a deleted(old)+new(new) delta pair; the master moves the volume
+        between VolumeLayouts on the next pulse."""
+        with self._lock:
+            self.deleted_volumes.append(old_msg)
+            self.new_volumes.append(new_msg)
+
     def drain_deltas(self) -> dict:
         with self._lock:
+            # collapse same-vid churn within one pulse so the master's
+            # delete-then-add processing can't resurrect ghosts:
+            # - a volume we no longer hold must not appear as new
+            #   (created+deleted within the tick)
+            # - keep only the FIRST deleted msg (the layout the master has
+            #   registered) and the LAST new msg (the current layout)
+            held = {
+                vid for loc in self.locations for vid in loc.volumes
+            }
+            new_by_vid: dict = {}
+            for msg in self.new_volumes:
+                if int(msg["id"]) in held:
+                    new_by_vid[int(msg["id"])] = msg
+            deleted_by_vid: dict = {}
+            for msg in self.deleted_volumes:
+                deleted_by_vid.setdefault(int(msg["id"]), msg)
             out = {
-                "new_volumes": self.new_volumes,
-                "deleted_volumes": self.deleted_volumes,
+                "new_volumes": list(new_by_vid.values()),
+                "deleted_volumes": list(deleted_by_vid.values()),
                 "new_ec_shards": self.new_ec_shards,
                 "deleted_ec_shards": self.deleted_ec_shards,
             }

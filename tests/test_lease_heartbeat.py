@@ -135,3 +135,51 @@ def test_file_sequencer_survives_restart(tmp_path):
     s2.set_max(10_000_000)
     s3 = FileSequencer(path)
     assert s3.next_file_id(1) > 10_000_000
+
+
+def test_drain_deltas_collapses_same_vid_churn(tmp_path):
+    """Created+deleted within one pulse must not re-register as a ghost;
+    an in-place layout change drains as deleted(old)+new(current)."""
+    from seaweedfs_tpu.storage.store import Store
+
+    s = Store("127.0.0.1", 0, "127.0.0.1:0", [str(tmp_path)], [10])
+    s.load()
+
+    # create + delete inside one tick -> vid must not appear as new
+    v = s.add_volume(3, "", "000", "")
+    s.delete_volume(3)
+    d = s.drain_deltas()
+    assert [int(m["id"]) for m in d["new_volumes"]] == []
+    assert [int(m["id"]) for m in d["deleted_volumes"]] == [3]
+
+    # layout change: deleted carries the ORIGINAL layout, new the latest
+    v = s.add_volume(4, "", "000", "")
+    s.drain_deltas()  # flush the create
+    old_msg = s._volume_message(v)
+    from seaweedfs_tpu.storage.super_block import (
+        ReplicaPlacement,
+        SuperBlock,
+    )
+
+    sb = v.super_block
+    v.super_block = SuperBlock(
+        version=sb.version,
+        replica_placement=ReplicaPlacement.parse("001"),
+        ttl=sb.ttl,
+        compaction_revision=sb.compaction_revision,
+        extra=sb.extra,
+    )
+    mid_msg = s._volume_message(v)
+    s.note_volume_changed(old_msg, mid_msg)
+    # a second change in the same tick: keep FIRST deleted, LAST new
+    v.super_block = SuperBlock(
+        version=sb.version,
+        replica_placement=ReplicaPlacement.parse("010"),
+        ttl=sb.ttl,
+        compaction_revision=sb.compaction_revision,
+        extra=sb.extra,
+    )
+    s.note_volume_changed(mid_msg, s._volume_message(v))
+    d = s.drain_deltas()
+    assert [m["replica_placement"] for m in d["deleted_volumes"]] == [0]
+    assert [m["replica_placement"] for m in d["new_volumes"]] == [10]
