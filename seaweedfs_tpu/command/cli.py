@@ -36,6 +36,12 @@ def _add_master_flags(p: argparse.ArgumentParser) -> None:
         help="persist the file-id sequencer to this path (the durable "
         "role of the reference's etcd sequencer); '' = in-memory",
     )
+    p.add_argument(
+        "-raftStateFile",
+        default="",
+        help="persist raft term/vote/max-volume-id to this path so a "
+        "restarted master cannot double-vote in its term; '' = in-memory",
+    )
 
 
 def _add_volume_flags(p: argparse.ArgumentParser) -> None:
@@ -239,6 +245,7 @@ def cmd_master(argv: list[str]) -> int:
         peers=[x for x in args.peers.split(",") if x] or None,
         jwt_signing_key=args.jwtSigningKey,
         sequencer_file=args.sequencerFile,
+        raft_state_file=getattr(args, "raftStateFile", ""),
         **_maintenance_kwargs(cfg),
     )
     print(f"master listening on {args.ip}:{args.port}")
@@ -337,6 +344,7 @@ def cmd_server(argv: list[str]) -> int:
         peers=peers,
         jwt_signing_key=args.jwtSigningKey,
         sequencer_file=args.sequencerFile,
+        raft_state_file=getattr(args, "raftStateFile", ""),
         **_maintenance_kwargs(cfg),
     )
     vs = VolumeServer(

@@ -50,6 +50,7 @@ class MasterServer:
         maintenance_sleep_minutes: float = 17.0,
         maintenance_filer: str = "",
         sequencer_file: str = "",
+        raft_state_file: str = "",
     ):
         self.jwt_signing_key = jwt_signing_key
         self.jwt_expires_seconds = jwt_expires_seconds
@@ -82,6 +83,7 @@ class MasterServer:
             peers,
             get_max_volume_id=lambda: self.topo.max_volume_id,
             adjust_max_volume_id=self.topo.adjust_max_volume_id,
+            state_file=raft_state_file,
         )
         self._clients: dict[str, asyncio.Queue] = {}
         self._admin_token: Optional[tuple[int, float]] = None  # (token, ts)
@@ -92,6 +94,14 @@ class MasterServer:
     @property
     def leader(self) -> str:
         return self.raft.leader_address or self.address
+
+    @property
+    def known_leader(self) -> str:
+        """The elected leader, or "" while none is known — a deposed or
+        mid-election master must not hint clients back to itself."""
+        if self.raft.is_leader:
+            return self.address
+        return self.raft.leader_address or ""
 
     @property
     def is_leader(self) -> bool:
@@ -438,7 +448,7 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
         # leader's address and end the stream so it redials
         # (ref master_grpc_server.go heartbeat leader check).
         if not self.is_leader:
-            yield {"leader": self.leader}
+            yield {"leader": self.known_leader}
             return
         dn = None
         try:
@@ -446,7 +456,7 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
                 if not self.is_leader:
                     # demoted mid-stream: hand over and end the stream so
                     # the volume server redials the new leader
-                    yield {"leader": self.leader}
+                    yield {"leader": self.known_leader}
                     return
                 if dn is None and hb.get("ip"):
                     dc = self.topo.get_or_create_data_center(
@@ -576,7 +586,7 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
         """vid-location push stream (ref master_grpc_server.go:182-235)."""
         if not self.is_leader:
             # point the client at the leader and end the stream
-            yield {"leader": self.leader}
+            yield {"leader": self.known_leader}
             return
         first = await request_iterator.__anext__()
         client_name = f"{first.get('name', 'client')}@{id(context)}"
@@ -606,7 +616,7 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
         try:
             while not self._shutdown:
                 if not self.is_leader:
-                    yield {"leader": self.leader}  # demoted: hand over
+                    yield {"leader": self.known_leader}  # demoted: hand over
                     return
                 try:
                     msg = await asyncio.wait_for(queue.get(), timeout=1.0)

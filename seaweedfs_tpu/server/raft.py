@@ -39,6 +39,7 @@ class RaftLite:
         peers: Optional[List[str]] = None,
         get_max_volume_id: Callable[[], int] = lambda: 0,
         adjust_max_volume_id: Callable[[int], None] = lambda vid: None,
+        state_file: str = "",
     ):
         self.address = self_address
         # peers includes self (ref raft_server.go peers handling)
@@ -48,6 +49,13 @@ class RaftLite:
 
         self.term = 0
         self.voted_for: Optional[str] = None
+        # durable (term, voted_for, max_volume_id): raft's persistence
+        # contract — a restarted node must not vote twice in one term or
+        # regress the committed id (ref raft's currentTerm/votedFor rules;
+        # the reference persists them via its raft log + snapshot dir)
+        self.state_file = state_file
+        if state_file:
+            self._load_state()
         self.state = FOLLOWER if len(self.peers) > 1 else LEADER
         self.leader_address: Optional[str] = (
             self_address if len(self.peers) == 1 else None
@@ -56,6 +64,56 @@ class RaftLite:
         self._last_quorum_contact = time.monotonic()
         self._task: Optional[asyncio.Task] = None
         self._shutdown = False
+
+    # ---------------- durable state ----------------
+    def _load_state(self) -> None:
+        import json
+
+        try:
+            with open(self.state_file) as f:
+                st = json.load(f)
+            self.term = int(st.get("term", 0))
+            self.voted_for = st.get("voted_for") or None
+            self.adjust_max_volume_id(int(st.get("max_volume_id", 0)))
+        except (OSError, ValueError, TypeError, AttributeError):
+            return  # unreadable/foreign file: start from fresh state
+
+    def _persist(self) -> None:
+        """Write (term, voted_for, max_volume_id) if anything changed.
+        Cheap to call from hot paths: no-op when the snapshot is current."""
+        if not self.state_file:
+            return
+        snap = (self.term, self.voted_for, self.get_max_volume_id())
+        if snap == getattr(self, "_persisted_snap", None):
+            return
+        import json
+        import os
+
+        tmp = self.state_file + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(
+                    {
+                        "term": snap[0],
+                        "voted_for": snap[1],
+                        "max_volume_id": snap[2],
+                    },
+                    f,
+                )
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.state_file)
+            self._persisted_snap = snap
+        except OSError as e:
+            # degraded to in-memory; say so once, not per heartbeat
+            if not getattr(self, "_persist_warned", False):
+                self._persist_warned = True
+                from ..util import log
+
+                log.info(
+                    "raft state persistence to %s failed (%s); running "
+                    "in-memory", self.state_file, e,
+                )
 
     # ---------------- public state ----------------
     @property
@@ -133,6 +191,7 @@ class RaftLite:
         term = self.term
         self.voted_for = self.address
         self.leader_address = None
+        self._persist()
         votes = 1
         replies = await self._broadcast(
             "RaftRequestVote",
@@ -194,6 +253,7 @@ class RaftLite:
         out — topology/cluster_commands.go, topology.go:115-122)."""
         self.adjust_max_volume_id(vid)
         if self.single_node:
+            self._persist()
             return True
         if not self.is_leader:
             return False
@@ -208,13 +268,17 @@ class RaftLite:
         if replies is None:
             return False  # stepped down
         acks = 1 + sum(1 for r in replies if r.get("ok"))
-        return acks >= self.majority()
+        if acks >= self.majority():
+            self._persist()  # the committed id must survive a full restart
+            return True
+        return False
 
     def _step_down(self, term: int) -> None:
         self.term = term
         self.state = FOLLOWER
         self.voted_for = None
         self._last_heartbeat = time.monotonic()
+        self._persist()
 
     # ---------------- RPC handlers ----------------
     async def handle_request_vote(self, req: dict) -> dict:
@@ -223,11 +287,12 @@ class RaftLite:
         if term > self.term:
             self._step_down(term)
         granted = term >= self.term and self.voted_for in (None, candidate)
+        self.adjust_max_volume_id(int(req.get("max_volume_id", 0)))
         if granted:
             self.term = term
             self.voted_for = candidate
             self._last_heartbeat = time.monotonic()
-        self.adjust_max_volume_id(int(req.get("max_volume_id", 0)))
+            self._persist()  # after adjust: the snapshot carries the max id
         return {
             "granted": granted,
             "term": self.term,
@@ -248,6 +313,7 @@ class RaftLite:
         self.leader_address = req.get("leader", "")
         self._last_heartbeat = time.monotonic()
         self.adjust_max_volume_id(int(req.get("max_volume_id", 0)))
+        self._persist()  # no-op unless term/vote/max-id advanced
         return {
             "ok": True,
             "term": self.term,

@@ -231,13 +231,23 @@ class VolumeServer(EcHandlers):
                     continue
                 if resp.get("volume_size_limit"):
                     self.store.volume_size_limit = int(resp["volume_size_limit"])
-                leader = resp.get("leader")
-                if leader and leader != self.master:
-                    # follow the leader hint; the redial targets it
-                    if leader not in self.masters:
-                        self.masters.append(leader)
-                    self.master = leader
-                    return
+                if "leader" in resp:
+                    leader = resp.get("leader")
+                    if leader and leader != self.master:
+                        # follow the leader hint; the redial targets it
+                        if leader not in self.masters:
+                            self.masters.append(leader)
+                        self.master = leader
+                        return
+                    if not leader:
+                        # this master has no known leader (deposed or
+                        # mid-election): rotate instead of re-dialing it
+                        if self.master in self.masters:
+                            i = self.masters.index(self.master)
+                            self.master = self.masters[
+                                (i + 1) % len(self.masters)
+                            ]
+                        return
 
         reader_task = asyncio.ensure_future(reader())
         try:

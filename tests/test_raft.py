@@ -158,3 +158,188 @@ def test_follower_redirects_streams(tmp_path):
             await cluster.stop()
 
     asyncio.run(body())
+
+
+def _partition(master):
+    """Isolate a master: its raft can neither reach peers nor be reached
+    (instance-attr shadowing intercepts both directions). Returns a heal()."""
+    raft = master.raft
+
+    async def broadcast_dropped(method, req):
+        return []  # nobody reachable; not a step-down
+
+    async def vote_dropped(req):
+        raise ConnectionError("partitioned")
+
+    async def append_dropped(req):
+        raise ConnectionError("partitioned")
+
+    orig = (raft._broadcast, raft.handle_request_vote, raft.handle_append_entries)
+    raft._broadcast = broadcast_dropped
+    raft.handle_request_vote = vote_dropped
+    raft.handle_append_entries = append_dropped
+
+    def heal():
+        raft._broadcast, raft.handle_request_vote, raft.handle_append_entries = orig
+
+    return heal
+
+
+def test_partition_leader_steps_down_and_heals(tmp_path):
+    """Classic partition: the isolated leader loses its quorum lease and
+    stops acting as leader (no split brain); the majority elects a new
+    leader at a higher term; after healing the old leader rejoins as a
+    follower of the new one."""
+
+    async def body():
+        cluster = MultiMasterCluster(tmp_path, n_volume_servers=1)
+        try:
+            await cluster.start()
+            old = cluster.leader()
+            old_term = old.raft.term
+            heal = _partition(old)
+
+            # majority side elects a new leader at a higher term
+            await _wait_for(
+                lambda: any(
+                    m.raft.is_leader and m is not old for m in cluster.masters
+                ),
+                msg="majority re-election",
+            )
+            # the partitioned leader loses its lease and steps down: at no
+            # point after that do two masters answer assigns as leader
+            await _wait_for(
+                lambda: not old.raft.is_leader, msg="old leader steps down"
+            )
+            new = next(
+                m for m in cluster.masters if m.raft.is_leader and m is not old
+            )
+            assert new.raft.term > old_term
+
+            # volume servers re-register with the new leader, then assigns
+            # flow through it
+            await _wait_for(
+                lambda: len(new.topo.data_nodes()) == cluster.n_vs,
+                msg="volume servers re-registered with new leader",
+            )
+            async with aiohttp.ClientSession() as http:
+                async with http.get(
+                    f"http://{new.address}/dir/assign"
+                ) as resp:
+                    assert "fid" in await resp.json()
+
+            heal()
+            # the healed node converges: same term, follows the new leader
+            await _wait_for(
+                lambda: old.raft.term == new.raft.term
+                and not old.raft.is_leader
+                and old.raft.leader_address == new.address,
+                msg="healed node follows new leader",
+            )
+            assert sum(1 for m in cluster.masters if m.raft.is_leader) == 1
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_leader_flapping_converges(tmp_path):
+    """Repeatedly partition whoever leads; every round the survivors elect
+    exactly one replacement, assigns keep working, and the max volume id
+    never regresses."""
+
+    async def body():
+        cluster = MultiMasterCluster(tmp_path, n_volume_servers=1)
+        try:
+            await cluster.start()
+            max_vid_seen = 0
+            for _round in range(3):
+                leader = cluster.leader()
+                heal = _partition(leader)
+                await _wait_for(
+                    lambda: any(
+                        m.raft.is_leader and not m.raft is leader.raft
+                        for m in cluster.masters
+                    )
+                    and not leader.raft.is_leader,
+                    msg=f"round {_round} re-election",
+                )
+                heal()
+                await _wait_for(
+                    lambda: cluster.leader() is not None
+                    and len(
+                        {m.raft.term for m in cluster.masters}
+                    ) == 1,
+                    msg=f"round {_round} convergence",
+                )
+                new_leader = cluster.leader()
+                await _wait_for(
+                    lambda: len(cluster.leader().topo.data_nodes())
+                    == cluster.n_vs,
+                    msg=f"round {_round} volume servers re-registered",
+                )
+                async with aiohttp.ClientSession() as http:
+                    async with http.get(
+                        f"http://{new_leader.address}/dir/assign"
+                    ) as resp:
+                        a = await resp.json()
+                assert "fid" in a, a
+                vid = int(a["fid"].split(",")[0])
+                assert vid >= 1
+                assert new_leader.topo.max_volume_id >= max_vid_seen
+                max_vid_seen = new_leader.topo.max_volume_id
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_raft_state_persistence(tmp_path):
+    """A restarted node reloads (term, voted_for, max_volume_id): it cannot
+    grant a second vote in the same term, and the committed id survives."""
+    from seaweedfs_tpu.server.raft import RaftLite
+
+    async def body():
+        state = str(tmp_path / "raft.json")
+        seen_vid = {"v": 0}
+        r1 = RaftLite(
+            "127.0.0.1:1",
+            peers=["127.0.0.1:1", "127.0.0.1:2", "127.0.0.1:3"],
+            get_max_volume_id=lambda: seen_vid["v"],
+            adjust_max_volume_id=lambda v: seen_vid.update(
+                v=max(seen_vid["v"], v)
+            ),
+            state_file=state,
+        )
+        resp = await r1.handle_request_vote(
+            {"term": 7, "candidate": "127.0.0.1:2", "max_volume_id": 41}
+        )
+        assert resp["granted"] and r1.term == 7
+        assert seen_vid["v"] == 41
+
+        # crash + restart: state reloads from disk
+        seen_vid2 = {"v": 0}
+        r2 = RaftLite(
+            "127.0.0.1:1",
+            peers=["127.0.0.1:1", "127.0.0.1:2", "127.0.0.1:3"],
+            get_max_volume_id=lambda: seen_vid2["v"],
+            adjust_max_volume_id=lambda v: seen_vid2.update(
+                v=max(seen_vid2["v"], v)
+            ),
+            state_file=state,
+        )
+        assert r2.term == 7
+        assert r2.voted_for == "127.0.0.1:2"
+        assert seen_vid2["v"] == 41
+        # a DIFFERENT candidate in the same term is refused (no double vote)
+        resp = await r2.handle_request_vote(
+            {"term": 7, "candidate": "127.0.0.1:3", "max_volume_id": 0}
+        )
+        assert not resp["granted"]
+        # the original candidate may retry and is re-granted
+        resp = await r2.handle_request_vote(
+            {"term": 7, "candidate": "127.0.0.1:2", "max_volume_id": 0}
+        )
+        assert resp["granted"]
+
+    asyncio.run(body())
