@@ -92,6 +92,11 @@ class MasterClient:
                 for vid in msg.get("deleted_vids", []):
                     self.vid_map.remove(int(vid), url)
             leader = msg.get("leader")
+            if "leader" in msg and not leader:
+                # this master knows no leader (deposed / mid-election): the
+                # stream is about to end; rotate rather than count as
+                # connected with an empty vid cache
+                return
             if not leader or leader == master:
                 # only count as connected when talking to the actual
                 # leader — a follower's single redirect message must not

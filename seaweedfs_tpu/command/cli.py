@@ -245,7 +245,7 @@ def cmd_master(argv: list[str]) -> int:
         peers=[x for x in args.peers.split(",") if x] or None,
         jwt_signing_key=args.jwtSigningKey,
         sequencer_file=args.sequencerFile,
-        raft_state_file=getattr(args, "raftStateFile", ""),
+        raft_state_file=args.raftStateFile,
         **_maintenance_kwargs(cfg),
     )
     print(f"master listening on {args.ip}:{args.port}")
@@ -344,7 +344,7 @@ def cmd_server(argv: list[str]) -> int:
         peers=peers,
         jwt_signing_key=args.jwtSigningKey,
         sequencer_file=args.sequencerFile,
-        raft_state_file=getattr(args, "raftStateFile", ""),
+        raft_state_file=args.raftStateFile,
         **_maintenance_kwargs(cfg),
     )
     vs = VolumeServer(

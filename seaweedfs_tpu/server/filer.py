@@ -89,7 +89,7 @@ class FilerServer:
         self._deletion_task = asyncio.ensure_future(self._deletion_loop())
         app = web.Application(client_max_size=1024 << 20)
         app.router.add_route("*", "/{tail:.*}", self._dispatch)
-        self._http_runner = web.AppRunner(app)
+        self._http_runner = web.AppRunner(app, access_log=None)
         await self._http_runner.setup()
         site = web.TCPSite(self._http_runner, self.host, self.port)
         await site.start()

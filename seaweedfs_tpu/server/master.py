@@ -121,7 +121,7 @@ class MasterServer:
         app.router.add_get("/", self._ui)
         app.router.add_get("/ui", self._ui)
         app.router.add_get("/{file_id:[0-9]+,.+}", self._redirect)
-        self._http_runner = web.AppRunner(app)
+        self._http_runner = web.AppRunner(app, access_log=None)
         await self._http_runner.setup()
         site = web.TCPSite(self._http_runner, self.host, self.port)
         await site.start()

@@ -72,11 +72,16 @@ class RaftLite:
         try:
             with open(self.state_file) as f:
                 st = json.load(f)
-            self.term = int(st.get("term", 0))
-            self.voted_for = st.get("voted_for") or None
-            self.adjust_max_volume_id(int(st.get("max_volume_id", 0)))
+            # parse everything before assigning anything: a malformed file
+            # must leave state fully fresh, not half-loaded
+            term = int(st.get("term", 0))
+            voted_for = st.get("voted_for") or None
+            max_vid = int(st.get("max_volume_id", 0))
         except (OSError, ValueError, TypeError, AttributeError):
             return  # unreadable/foreign file: start from fresh state
+        self.term = term
+        self.voted_for = voted_for
+        self.adjust_max_volume_id(max_vid)
 
     def _persist(self) -> None:
         """Write (term, voted_for, max_volume_id) if anything changed.

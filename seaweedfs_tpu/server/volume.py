@@ -138,7 +138,7 @@ class VolumeServer(EcHandlers):
         self._http_client = aiohttp.ClientSession()
         app = web.Application(client_max_size=256 << 20)
         app.router.add_route("*", "/{tail:.*}", self._dispatch)
-        self._http_runner = web.AppRunner(app)
+        self._http_runner = web.AppRunner(app, access_log=None)
         await self._http_runner.setup()
         site = web.TCPSite(self._http_runner, self.host, self.port)
         await site.start()

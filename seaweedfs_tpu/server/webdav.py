@@ -54,7 +54,7 @@ class WebDavServer:
     async def start(self) -> None:
         app = web.Application(client_max_size=1024 << 20)
         app.router.add_route("*", "/{tail:.*}", self._dispatch)
-        self._http_runner = web.AppRunner(app)
+        self._http_runner = web.AppRunner(app, access_log=None)
         await self._http_runner.setup()
         site = web.TCPSite(self._http_runner, self.host, self.port)
         await site.start()
