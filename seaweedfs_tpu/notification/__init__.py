@@ -136,7 +136,17 @@ class _AsyncPostingSink(NotificationSink):
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
 
+    async def drain(self) -> None:
+        """Wait for every in-flight delivery task."""
+        import asyncio
+
+        pending = list(self._tasks)
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
+
     async def close(self) -> None:
+        # never close the session under in-flight deliveries
+        await self.drain()
         if self._session is not None:
             await self._session.close()
             self._session = None

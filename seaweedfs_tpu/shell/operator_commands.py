@@ -627,6 +627,67 @@ async def cmd_fs_meta_load(env, argv) -> str:
     return f"restored {count} meta entries from {positional[0]}"
 
 
+@command("fs.meta.notify")
+async def cmd_fs_meta_notify(env, argv) -> str:
+    """fs.meta.notify [-filer host:port] -sink <kind> [sink flags] /dir —
+    re-publish every entry under /dir as a create event through a
+    notification sink (ref command_fs_meta_notify.go; useful to seed a
+    fresh subscriber). Sink kinds/flags match the filer's -notifySink:
+    webhook (-url), s3 (-endpoint -bucket -accessKey -secretKey),
+    broker (-broker -topic), log."""
+    from ..notification import build_sink
+
+    flags, positional = _fs_args(
+        argv,
+        value_flags=(
+            "filer", "sink", "url", "endpoint", "bucket",
+            "accessKey", "secretKey", "broker", "topic",
+        ),
+    )
+    stub = _filer_stub(env, flags)
+    kind = flags.get("sink", "")
+    if kind not in ("log", "broker", "webhook", "s3"):
+        return "fs.meta.notify: need -sink <log|broker|webhook|s3>"
+    try:
+        sink = build_sink(
+            kind,
+            url=flags.get("url", ""),
+            endpoint=flags.get("endpoint", ""),
+            bucket=flags.get("bucket", ""),
+            access_key=flags.get("accessKey", ""),
+            secret_key=flags.get("secretKey", ""),
+            broker=flags.get("broker", ""),
+            topic=flags.get("topic", "filer"),
+        )
+    except ValueError as e:
+        return f"fs.meta.notify: {e}"
+    root = _abs(env, positional[0] if positional else "").rstrip("/") or "/"
+    n_dirs = 0
+    n_files = 0
+    sent = 0
+    stack = [root]
+    while stack:
+        directory = stack.pop()
+        for e in await _list_dir(stub, directory):
+            if e.get("is_directory"):
+                n_dirs += 1
+                stack.append(e["full_path"])
+            else:
+                n_files += 1
+            sink.send("create", e["full_path"], e)
+            sent += 1
+            if sent % 256 == 0:
+                # bound in-flight deliveries on large trees, or late sends
+                # time out waiting for pool slots while we report success
+                drainer = getattr(sink, "drain", None)
+                if drainer is not None:
+                    await drainer()
+    closer = getattr(sink, "close", None)
+    if closer is not None:
+        await closer()
+    return f"total notified {n_dirs} directories, {n_files} files"
+
+
 @command("fs.meta.cat")
 async def cmd_fs_meta_cat(env, argv) -> str:
     """fs.meta.cat [-filer host:port] /path — print one entry's raw

@@ -165,6 +165,59 @@ def test_webhook_sink_loopback(tmp_path):
     asyncio.run(body())
 
 
+def test_fs_meta_notify_replays_subtree(tmp_path):
+    """Shell fs.meta.notify re-publishes a subtree through a webhook sink —
+    seeding a fresh subscriber (ref command_fs_meta_notify.go)."""
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+
+    async def body():
+        received = []
+
+        async def hook(request: web.Request) -> web.Response:
+            received.append(json.loads(await request.read()))
+            return web.Response(text="ok")
+
+        app = web.Application()
+        app.router.add_post("/hook", hook)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        port = free_port_pair()
+        await web.TCPSite(runner, "127.0.0.1", port).start()
+
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        fs = FilerServer(master=cluster.master.address, port=free_port_pair())
+        await fs.start()
+        try:
+            await fs.master_client.wait_connected()
+            async with aiohttp.ClientSession() as session:
+                for p in ("/seed/a.txt", "/seed/sub/b.txt"):
+                    async with session.put(
+                        f"http://{fs.address}{p}", data=b"x"
+                    ) as r:
+                        assert r.status == 201
+            env = CommandEnv(cluster.master.address, filer=fs.address)
+            out = await run_command(
+                env,
+                f"fs.meta.notify -sink webhook "
+                f"-url http://127.0.0.1:{port}/hook /seed",
+            )
+            assert "total notified" in out, out
+            for _ in range(100):
+                if len(received) >= 3:  # sub dir + 2 files
+                    break
+                await asyncio.sleep(0.05)
+            paths = {e["path"] for e in received}
+            assert {"/seed/a.txt", "/seed/sub", "/seed/sub/b.txt"} <= paths
+        finally:
+            await fs.stop()
+            await cluster.stop()
+            await runner.cleanup()
+            await close_all_channels()
+
+    asyncio.run(body())
+
+
 def test_build_sink_validation():
     assert build_sink("") is None
     assert build_sink("none") is None
