@@ -50,9 +50,22 @@ class BrokerSink(NotificationSink):
         self.broker = broker
         self.topic = topic
         self.namespace = namespace
+        self.delivered = 0
+        self.failed = 0
         # strong refs: the loop keeps only weak task references, so a
         # pending publish could otherwise be garbage-collected unrun
         self._tasks: set = set()
+
+    async def drain(self) -> None:
+        """Wait for every in-flight publish (bounds fs.meta.notify)."""
+        import asyncio
+
+        pending = list(self._tasks)
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
+
+    async def close(self) -> None:
+        await self.drain()
 
     def send(self, event_type, path, entry) -> None:
         import asyncio
@@ -71,8 +84,12 @@ class BrokerSink(NotificationSink):
         }
 
         async def publish() -> None:
-            stub = Stub(grpc_address(self.broker), "messaging")
-            await stub.call("Publish", request)
+            try:
+                stub = Stub(grpc_address(self.broker), "messaging")
+                await stub.call("Publish", request)
+                self.delivered += 1
+            except Exception:
+                self.failed += 1
 
         try:
             loop = asyncio.get_running_loop()
@@ -104,9 +121,20 @@ class _AsyncPostingSink(NotificationSink):
 
     _tasks: set
     _session = None
+    delivered = 0
+    failed = 0
 
     async def _deliver(self, event_type, path, entry) -> None:
         raise NotImplementedError
+
+    async def _counted(self, event_type, path, entry) -> None:
+        # best-effort like the reference's queue: outcomes land in the
+        # delivered/failed counters instead of unretrieved task exceptions
+        try:
+            await self._deliver(event_type, path, entry)
+            self.delivered += 1
+        except Exception:
+            self.failed += 1
 
     async def _http(self):
         import aiohttp
@@ -124,7 +152,7 @@ class _AsyncPostingSink(NotificationSink):
 
             async def once():
                 try:
-                    await self._deliver(event_type, path, entry)
+                    await self._counted(event_type, path, entry)
                 finally:
                     if self._session is not None:
                         await self._session.close()
@@ -132,7 +160,7 @@ class _AsyncPostingSink(NotificationSink):
 
             asyncio.run(once())
             return
-        task = loop.create_task(self._deliver(event_type, path, entry))
+        task = loop.create_task(self._counted(event_type, path, entry))
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
 

@@ -685,7 +685,9 @@ async def cmd_fs_meta_notify(env, argv) -> str:
     closer = getattr(sink, "close", None)
     if closer is not None:
         await closer()
-    return f"total notified {n_dirs} directories, {n_files} files"
+    failed = getattr(sink, "failed", 0)
+    tail = f"; {failed} deliveries FAILED" if failed else ""
+    return f"total notified {n_dirs} directories, {n_files} files{tail}"
 
 
 @command("fs.meta.cat")

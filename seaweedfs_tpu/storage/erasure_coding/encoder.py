@@ -231,6 +231,29 @@ def _encode_rows_pipelined(
             drain(pending.popleft())
 
 
+def _fs_type_of(path: str) -> str:
+    """Filesystem type of the mount containing `path` (Linux mountinfo);
+    "" when undeterminable."""
+    try:
+        target = os.path.realpath(os.path.dirname(os.path.abspath(path)))
+        best = ("", "")
+        with open("/proc/self/mountinfo") as f:
+            for line in f:
+                parts = line.split(" - ")
+                if len(parts) != 2:
+                    continue
+                mount_point = parts[0].split()[4]
+                fstype = parts[1].split()[0]
+                if (
+                    target == mount_point
+                    or target.startswith(mount_point.rstrip("/") + "/")
+                ) and len(mount_point) > len(best[0]):
+                    best = (mount_point, fstype)
+        return best[1]
+    except OSError:
+        return ""
+
+
 def _splice_data_shards(
     dat_path: str,
     base_file_name: str,
@@ -252,6 +275,10 @@ def _splice_data_shards(
     the MXU fed only with bytes that need compute.
     """
     if not hasattr(os, "copy_file_range"):
+        return False
+    if _fs_type_of(dat_path) in ("tmpfs", "ramfs"):
+        # tmpfs has no reflink and its copy_file_range degrades to a pipe
+        # splice — pure overhead over writing from the buffer we hold
         return False
     shard_size = n_large * large_block + n_small * small_block
     dat_size = os.path.getsize(dat_path)
@@ -490,6 +517,10 @@ def write_ec_files_multi(
                 chunk=chunk, pipeline=False,
             )
 
+        if n_workers == 1:  # no pool indirection when there's no parallelism
+            for base in base_file_names:
+                one(base)
+            return
         with cf.ThreadPoolExecutor(n_workers) as pool:
             for _ in pool.map(one, base_file_names):
                 pass

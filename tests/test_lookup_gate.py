@@ -107,6 +107,59 @@ def test_batched_reads_serve_correct_bytes(tmp_path, mode):
     asyncio.run(body())
 
 
+def test_gate_stale_offset_falls_back_to_locked_read(tmp_path):
+    """If a vacuum commit rewrites the volume between the gate's batched
+    probe and the pread, the handler re-resolves through the locked
+    per-request path instead of serving garbage or a spurious 404."""
+
+    async def body():
+        ms = MasterServer(port=_free_port(), pulse_seconds=0.2)
+        await ms.start()
+        vs = VolumeServer(
+            master=ms.address,
+            directories=[str(tmp_path)],
+            port=_free_port(),
+            pulse_seconds=0.2,
+            max_volume_counts=[10],
+            batch_lookup="host",
+        )
+        await vs.start()
+        try:
+            for _ in range(100):
+                if ms.topo.data_nodes():
+                    break
+                await asyncio.sleep(0.1)
+            async with aiohttp.ClientSession() as session:
+                ar = await assign(ms.address)
+                data = random.randbytes(1234)
+                await upload_data(session, ar.url, ar.fid, data)
+                vid = int(ar.fid.split(",")[0])
+                v = vs.store.find_volume(vid)
+
+                # poison the offset-based read ONCE, as a post-compaction
+                # stale offset would: the handler must retry via the
+                # authoritative locked path
+                real = v.read_needle_at
+                calls = {"n": 0}
+
+                def poisoned(offset_units, size):
+                    calls["n"] += 1
+                    if calls["n"] == 1:
+                        raise IOError("stale offset after vacuum commit")
+                    return real(offset_units, size)
+
+                v.read_needle_at = poisoned
+                got = await read_url(session, f"http://{ar.url}/{ar.fid}")
+                assert got == data
+                assert calls["n"] >= 1
+        finally:
+            await vs.stop()
+            await ms.stop()
+            await close_all_channels()
+
+    asyncio.run(body())
+
+
 def test_gate_close_cancels_waiters(tmp_path):
     from seaweedfs_tpu.server.lookup_gate import BatchLookupGate
 
