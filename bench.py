@@ -288,7 +288,10 @@ def measure_encode_e2e(size_bytes: int = 4 << 30, emit=None):
         size_bytes = min(size_bytes, int(shm_free // 3))
         use_dir = "/dev/shm"
     else:
-        use_dir = None  # block device; honest but throttled — note carries it
+        use_dir = None  # block device; honest but throttled — note carries
+        # it. Writeback-throttled disks run ~0.1 GB/s; keep the interleaved
+        # rep loop inside the timebox
+        size_bytes = min(size_bytes, 1 << 30)
     size_bytes = max(size_bytes, 64 << 20)
     result = {"size_bytes": size_bytes, "tmpfs": use_dir is not None}
 
@@ -341,13 +344,15 @@ def measure_encode_e2e(size_bytes: int = 4 << 30, emit=None):
                     golden = _shard_samples(base)
                 if name == "best" and best_samples is None:
                     best_samples = _shard_samples(base)
-            # partials after every rep: a timebox kill mid-loop still
-            # leaves the parent the best-so-far numbers
-            result["ref_gbps"] = size_bytes / times["ref"] / 1e9
-            result["best_gbps"] = size_bytes / times["best"] / 1e9
-            result["best_parity"] = best_samples == golden
-            if emit:
-                emit(result)
+                # partials after EVERY leg: a timebox kill even during
+                # rep 0's second leg still leaves the first leg's number
+                if times["ref"] != float("inf"):
+                    result["ref_gbps"] = size_bytes / times["ref"] / 1e9
+                if times["best"] != float("inf"):
+                    result["best_gbps"] = size_bytes / times["best"] / 1e9
+                    result["best_parity"] = best_samples == golden
+                if emit:
+                    emit(result)
         _rm_shards(base)
 
         # --- device pipeline (always measured, even when transfer-bound;

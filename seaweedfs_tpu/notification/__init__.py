@@ -40,78 +40,6 @@ class MemorySink(NotificationSink):
             self.events.append((event_type, path, entry))
 
 
-class BrokerSink(NotificationSink):
-    """Publishes filer events to the in-cluster message broker (the
-    reference fans out to external queues like kafka,
-    ref notification/configuration.go; this rides our own msgBroker so it
-    works without egress). Events land on topic `filer` keyed by path."""
-
-    def __init__(self, broker: str, topic: str = "filer", namespace: str = ""):
-        self.broker = broker
-        self.topic = topic
-        self.namespace = namespace
-        self.delivered = 0
-        self.failed = 0
-        # strong refs: the loop keeps only weak task references, so a
-        # pending publish could otherwise be garbage-collected unrun
-        self._tasks: set = set()
-
-    async def drain(self) -> None:
-        """Wait for every in-flight publish (bounds fs.meta.notify)."""
-        import asyncio
-
-        pending = list(self._tasks)
-        if pending:
-            await asyncio.gather(*pending, return_exceptions=True)
-
-    async def close(self) -> None:
-        await self.drain()
-
-    def send(self, event_type, path, entry) -> None:
-        import asyncio
-        import json
-
-        from ..pb import grpc_address
-        from ..pb.rpc import Stub, new_channel
-
-        request = {
-            "namespace": self.namespace,
-            "topic": self.topic,
-            "key": path.encode(),
-            "value": json.dumps(
-                {"event": event_type, "path": path, "entry": entry}
-            ).encode(),
-        }
-
-        async def publish() -> None:
-            try:
-                stub = Stub(grpc_address(self.broker), "messaging")
-                await stub.call("Publish", request)
-                self.delivered += 1
-            except Exception:
-                self.failed += 1
-
-        try:
-            loop = asyncio.get_running_loop()
-        except RuntimeError:
-            # sync caller (tests/tools): a private loop must not touch the
-            # process channel cache, or the cached channel dies with it
-            async def publish_once() -> None:
-                channel = new_channel(grpc_address(self.broker))
-                try:
-                    await Stub(
-                        grpc_address(self.broker), "messaging", channel=channel
-                    ).call("Publish", request)
-                finally:
-                    await channel.close()
-
-            asyncio.run(publish_once())
-            return
-        task = loop.create_task(publish())
-        self._tasks.add(task)
-        task.add_done_callback(self._tasks.discard)
-
-
 class _AsyncPostingSink(NotificationSink):
     """Base for sinks that deliver via an async HTTP request: schedules the
     coroutine on the running loop (strong task refs), or runs it on a
@@ -127,14 +55,20 @@ class _AsyncPostingSink(NotificationSink):
     async def _deliver(self, event_type, path, entry) -> None:
         raise NotImplementedError
 
-    async def _counted(self, event_type, path, entry) -> None:
+    async def _counted(self, event_type, path, entry, oneshot=False) -> None:
         # best-effort like the reference's queue: outcomes land in the
         # delivered/failed counters instead of unretrieved task exceptions
+        fn = self._deliver_oneshot if oneshot else self._deliver
         try:
-            await self._deliver(event_type, path, entry)
+            await fn(event_type, path, entry)
             self.delivered += 1
         except Exception:
             self.failed += 1
+
+    async def _deliver_oneshot(self, event_type, path, entry) -> None:
+        """Sync-caller variant (private event loop); overridable when the
+        normal path relies on loop-cached resources."""
+        await self._deliver(event_type, path, entry)
 
     async def _http(self):
         import aiohttp
@@ -152,7 +86,7 @@ class _AsyncPostingSink(NotificationSink):
 
             async def once():
                 try:
-                    await self._counted(event_type, path, entry)
+                    await self._counted(event_type, path, entry, oneshot=True)
                 finally:
                     if self._session is not None:
                         await self._session.close()
@@ -261,6 +195,55 @@ class S3EventSink(_AsyncPostingSink):
             timeout=aiohttp.ClientTimeout(total=10),
         ) as resp:
             await resp.read()
+
+
+class BrokerSink(_AsyncPostingSink):
+    """Publishes filer events to the in-cluster message broker (the
+    reference fans out to external queues like kafka,
+    ref notification/configuration.go; this rides our own msgBroker so it
+    works without egress). Events land on topic `filer` keyed by path.
+    Task tracking / draining / delivery accounting come from the shared
+    async-sink base; only the transport differs (gRPC, no HTTP session)."""
+
+    def __init__(self, broker: str, topic: str = "filer", namespace: str = ""):
+        self.broker = broker
+        self.topic = topic
+        self.namespace = namespace
+        self._tasks: set = set()
+
+    def _request(self, event_type, path, entry) -> dict:
+        import json
+
+        return {
+            "namespace": self.namespace,
+            "topic": self.topic,
+            "key": path.encode(),
+            "value": json.dumps(
+                {"event": event_type, "path": path, "entry": entry}
+            ).encode(),
+        }
+
+    async def _deliver(self, event_type, path, entry) -> None:
+        from ..pb import grpc_address
+        from ..pb.rpc import Stub
+
+        await Stub(grpc_address(self.broker), "messaging").call(
+            "Publish", self._request(event_type, path, entry)
+        )
+
+    async def _deliver_oneshot(self, event_type, path, entry) -> None:
+        # sync caller (tests/tools): a private loop must not touch the
+        # process channel cache, or the cached channel dies with it
+        from ..pb import grpc_address
+        from ..pb.rpc import Stub, new_channel
+
+        channel = new_channel(grpc_address(self.broker))
+        try:
+            await Stub(
+                grpc_address(self.broker), "messaging", channel=channel
+            ).call("Publish", self._request(event_type, path, entry))
+        finally:
+            await channel.close()
 
 
 class UnavailableSink(NotificationSink):

@@ -250,8 +250,8 @@ def _fs_type_of(path: str) -> str:
                 ) and len(mount_point) > len(best[0]):
                     best = (mount_point, fstype)
         return best[1]
-    except OSError:
-        return ""
+    except (OSError, IndexError, ValueError):
+        return ""  # unparsable mount table: let the splice heuristic pass
 
 
 def _splice_data_shards(
