@@ -384,6 +384,23 @@ def measure_encode_e2e(size_bytes: int = 4 << 30, emit=None):
         shutil.rmtree(d, ignore_errors=True)
 
 
+def measure_geometries() -> dict:
+    """Kernel encode throughput at the alternate RS geometries
+    (BASELINE.json config 5: 6.3 / 12.4 alongside the default 10.4)."""
+    from seaweedfs_tpu.ops.gf256 import pack_bytes_host
+    from seaweedfs_tpu.storage.erasure_coding.galois import build_matrix
+
+    rng = np.random.default_rng(9)
+    out = {}
+    for k, m in ((6, 3), (12, 4)):
+        matrix = build_matrix(k, k + m)[k:]
+        data = rng.integers(0, 256, size=(k, 8 << 20), dtype=np.uint8)
+        out[f"{k}.{m}"] = round(
+            measure_tpu(matrix, pack_bytes_host(data)), 3
+        )
+    return out
+
+
 def measure_multi_encode(
     n_volumes: int = 8, vol_bytes: int = 32 << 20
 ) -> dict:
@@ -558,6 +575,10 @@ def measure_serving_qps(
     return out
 
 
+class _Skip(Exception):
+    """Secondary metric skipped: bench budget spent."""
+
+
 _E2E_NOTE = (
     "tunnel transfer-bound (~0.5/0.03 GB/s up/down host<->device in this "
     "env); see measure_encode_e2e"
@@ -630,7 +651,7 @@ def _e2e_results(r: dict) -> list:
     return out
 
 
-def _run_e2e_timeboxed() -> list:
+def _run_e2e_timeboxed(time_left: float = 600.0) -> list:
     """Run measure_encode_e2e in a subprocess with a hard wall-clock box:
     the tunnel's transfer rate swings 10x between runs, and a slow run must
     cost this one metric, not the whole benchmark. The child prints the
@@ -653,7 +674,11 @@ def _run_e2e_timeboxed() -> list:
 
     try:
         e2e_bytes = int(os.environ.get("BENCH_EC_E2E_BYTES", 4 << 30))
-        timeout = float(os.environ.get("BENCH_EC_E2E_TIMEOUT", 600))
+        # stay INSIDE the caller's remaining budget (margin for the final
+        # print); an env override still wins for manual runs
+        timeout = float(
+            os.environ.get("BENCH_EC_E2E_TIMEOUT", max(40.0, time_left - 15))
+        )
         _clean_stale_e2e_dirs()
         script = (
             "import json, sys, bench\n"
@@ -673,8 +698,17 @@ def _run_e2e_timeboxed() -> list:
             err = (out.stderr or out.stdout)[-400:]
             if r is None:
                 if "in use" in err or "already" in err.lower():
-                    # device is single-client: run inline instead
-                    return _e2e_results(measure_encode_e2e(e2e_bytes))
+                    # device is single-client: run inline instead — but only
+                    # with real budget left, since inline has no timebox
+                    if time_left > 180:
+                        return _e2e_results(measure_encode_e2e(e2e_bytes))
+                    return [
+                        {
+                            "metric": "ec.encode.e2e",
+                            "error": "single-client device and bench budget "
+                            "too low for an untimeboxed inline run",
+                        }
+                    ]
                 return [{"metric": "ec.encode.e2e", "error": err[-200:]}]
             # partial result + crash (e.g. device leg died): keep the
             # completed legs but surface the failure on the device metric
@@ -705,6 +739,15 @@ def main() -> None:
     from seaweedfs_tpu.storage.erasure_coding.coder_cpu import CpuRSCodec
     from seaweedfs_tpu.tpu.coder import get_codec
 
+    # global wall-clock budget: a driver-side kill before the final print
+    # would lose EVERY number, so each secondary metric checks the budget
+    # and is skipped (recorded as such) once it runs out
+    t_start = time.perf_counter()
+    budget = float(os.environ.get("BENCH_TOTAL_BUDGET", 900))
+
+    def remaining() -> float:
+        return budget - (time.perf_counter() - t_start)
+
     codec = CpuRSCodec()
     rng = np.random.default_rng(0)
 
@@ -719,6 +762,13 @@ def main() -> None:
     tpu_gbps = measure_tpu(codec.parity_matrix, packed)
 
     extra = []
+
+    def budgeted(metric: str, min_seconds: float) -> bool:
+        if remaining() < min_seconds:
+            extra.append({"metric": metric, "skipped": "bench budget spent"})
+            return False
+        return True
+
     try:
         lookup_qps, lookup_cpu_qps = measure_lookup()
         extra.append(
@@ -733,6 +783,8 @@ def main() -> None:
         extra.append({"metric": "needle_lookup_qps", "error": str(e)[:200]})
 
     try:
+        if not budgeted("ec.rebuild_throughput", 60):
+            raise _Skip()
         rb_tpu, rb_cpu = measure_rebuild()
         extra.append(
             {
@@ -742,10 +794,14 @@ def main() -> None:
                 "vs_baseline": round(rb_tpu / rb_cpu, 2),
             }
         )
+    except _Skip:
+        pass
     except Exception as e:
         extra.append({"metric": "ec.rebuild_throughput", "error": str(e)[:200]})
 
     try:
+        if not budgeted("serving_read_qps", 60):
+            raise _Skip()
         qps = measure_serving_qps(
             num_files=int(os.environ.get("BENCH_QPS_FILES", 3000))
         )
@@ -768,10 +824,34 @@ def main() -> None:
                 "read_qps_batched = BatchLookupGate micro-batched probes",
             }
         )
+    except _Skip:
+        pass
     except Exception as e:
         extra.append({"metric": "serving_read_qps", "error": str(e)[:200]})
 
     try:
+        if not budgeted("ec.encode_throughput.geometries", 90):
+            raise _Skip()
+        geo = measure_geometries()
+        extra.append(
+            {
+                "metric": "ec.encode_throughput.geometries",
+                "value": geo,
+                "unit": "GB/s",
+                "note": "kernel encode at alternate RS geometries "
+                "(BASELINE config 5); 10.4 is the headline metric",
+            }
+        )
+    except _Skip:
+        pass
+    except Exception as e:
+        extra.append(
+            {"metric": "ec.encode_throughput.geometries", "error": str(e)[:200]}
+        )
+
+    try:
+        if not budgeted("ec.encode.multi", 60):
+            raise _Skip()
         m = measure_multi_encode(
             n_volumes=int(os.environ.get("BENCH_MULTI_VOLS", 8)),
             vol_bytes=int(os.environ.get("BENCH_MULTI_MB", 32)) << 20,
@@ -788,10 +868,13 @@ def main() -> None:
                 "(write_ec_files_multi) vs sequentially, adaptive codec",
             }
         )
+    except _Skip:
+        pass
     except Exception as e:
         extra.append({"metric": "ec.encode.multi", "error": str(e)[:200]})
 
-    extra.extend(_run_e2e_timeboxed())
+    if budgeted("ec.encode.e2e", 45):
+        extra.extend(_run_e2e_timeboxed(time_left=remaining()))
 
     print(
         json.dumps(
