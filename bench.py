@@ -662,6 +662,11 @@ def _run_e2e_timeboxed(time_left: float = 600.0) -> list:
     import subprocess
     import sys
 
+    t_enter = time.perf_counter()
+
+    def left_now() -> float:
+        return time_left - (time.perf_counter() - t_enter)
+
     def parse_last(text: str):
         for line in reversed((text or "").strip().splitlines()):
             try:
@@ -699,8 +704,9 @@ def _run_e2e_timeboxed(time_left: float = 600.0) -> list:
             if r is None:
                 if "in use" in err or "already" in err.lower():
                     # device is single-client: run inline instead — but only
-                    # with real budget left, since inline has no timebox
-                    if time_left > 180:
+                    # with real budget left NOW (the subprocess may have
+                    # burned most of it), since inline has no timebox
+                    if left_now() > 180:
                         return _e2e_results(measure_encode_e2e(e2e_bytes))
                     return [
                         {
@@ -875,6 +881,10 @@ def main() -> None:
 
     if budgeted("ec.encode.e2e", 45):
         extra.extend(_run_e2e_timeboxed(time_left=remaining()))
+    else:
+        extra.append(
+            {"metric": "ec.encode.e2e.best", "skipped": "bench budget spent"}
+        )
 
     print(
         json.dumps(

@@ -132,16 +132,24 @@ def test_filer_crud_and_tree(store_cls):
     assert f.find_entry("/archive/readme.txt") is None
 
 
-def test_filer_overwrite_collects_old_chunks():
+@pytest.mark.parametrize(
+    "store_cls",
+    [MemoryFilerStore, SqliteFilerStore, _fresh_log_store, _fresh_lsm_store],
+)
+def test_filer_overwrite_collects_old_chunks(store_cls):
     collected = []
-    f = Filer(MemoryFilerStore(), on_delete_chunks=collected.extend)
+    f = Filer(store_cls(), on_delete_chunks=collected.extend)
     f.touch("/a.txt", "", [chunk("1,aa", 0, 5, 1)])
     f.touch("/a.txt", "", [chunk("2,bb", 0, 7, 2)])
     assert collected == ["1,aa"]
 
 
-def test_filer_file_blocks_subdirectory():
-    f = Filer(MemoryFilerStore())
+@pytest.mark.parametrize(
+    "store_cls",
+    [MemoryFilerStore, SqliteFilerStore, _fresh_log_store, _fresh_lsm_store],
+)
+def test_filer_file_blocks_subdirectory(store_cls):
+    f = Filer(store_cls())
     f.touch("/x", "", [])
     with pytest.raises(NotADirectoryError):
         f.touch("/x/y", "", [])
@@ -195,9 +203,13 @@ def test_log_store_survives_reopen(tmp_path):
     store3.close()
 
 
-def test_rename_overwrites_file_and_frees_chunks():
+@pytest.mark.parametrize(
+    "store_cls",
+    [MemoryFilerStore, SqliteFilerStore, _fresh_log_store, _fresh_lsm_store],
+)
+def test_rename_overwrites_file_and_frees_chunks(store_cls):
     collected = []
-    f = Filer(MemoryFilerStore(), on_delete_chunks=collected.extend)
+    f = Filer(store_cls(), on_delete_chunks=collected.extend)
     f.touch("/a.bin", "", [chunk("1,aa", 0, 5, 1)])
     f.touch("/b.bin", "", [chunk("2,bb", 0, 7, 1)])
     f.rename("/a.bin", "/b.bin")
@@ -213,10 +225,14 @@ def test_rename_overwrites_file_and_frees_chunks():
         f.rename("/b.bin", "/d")
 
 
-def test_rename_dir_onto_existing_is_refused_before_moving():
+@pytest.mark.parametrize(
+    "store_cls",
+    [MemoryFilerStore, SqliteFilerStore, _fresh_log_store, _fresh_lsm_store],
+)
+def test_rename_dir_onto_existing_is_refused_before_moving(store_cls):
     """Destination conflicts must be detected BEFORE any child moves, or a
     failed rename leaves half-migrated metadata."""
-    f = Filer(MemoryFilerStore())
+    f = Filer(store_cls())
     f.touch("/src/one.txt", "", [chunk("1,aa", 0, 5, 1)])
     f.touch("/src/two.txt", "", [chunk("2,bb", 0, 5, 1)])
     f.touch("/dst/other.txt", "", [])
@@ -235,12 +251,16 @@ def test_rename_dir_onto_existing_is_refused_before_moving():
     assert f.find_entry("/src/one.txt") is not None
 
 
-def test_create_entry_exclusive():
+@pytest.mark.parametrize(
+    "store_cls",
+    [MemoryFilerStore, SqliteFilerStore, _fresh_log_store, _fresh_lsm_store],
+)
+def test_create_entry_exclusive(store_cls):
     import pytest as _pytest
 
     from seaweedfs_tpu.filer.entry import new_directory_entry
 
-    f = Filer(MemoryFilerStore())
+    f = Filer(store_cls())
     f.touch("/x.bin", "", [chunk("1,aa", 0, 5, 1)])
     with _pytest.raises(FileExistsError):
         f.create_entry(new_directory_entry("/x.bin"), exclusive=True)
