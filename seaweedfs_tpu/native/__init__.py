@@ -32,11 +32,16 @@ def _cpu_flags() -> set:
     return set()
 
 
-def _build() -> bool:
-    """Compile with the widest SIMD this CPU actually has (the flag alone
-    isn't enough — g++ accepts -mavx2 on any x86, then SIGILLs at runtime)."""
+def _flag_candidates(max_tier: str = "best") -> list:
+    """Compiler-flag candidates for the SIMD this CPU actually has (the flag
+    alone isn't enough — g++ accepts -mavx2 on any x86, then SIGILLs at
+    runtime). max_tier="avx2" caps at the PSHUFB tier — the technique of the
+    reference's vendored klauspost/reedsolomon v1.9.2 (pre-GFNI), used for
+    honest baseline measurement."""
     have = _cpu_flags()
     candidates = []
+    if max_tier == "best" and {"gfni", "avx512f", "avx512bw"} <= have:
+        candidates.append(["-mgfni", "-mavx512f", "-mavx512bw", "-mavx2"])
     if "avx2" in have:
         candidates.append(["-mavx2"])
     if "ssse3" in have or not have:
@@ -44,8 +49,12 @@ def _build() -> bool:
         # x86-64, so keep attempting it rather than silently going scalar
         candidates.append(["-mssse3"])
     candidates.append([])  # scalar fallback (also the non-x86 path)
-    for flags in candidates:
-        cmd = ["g++", "-O3", "-shared", "-fPIC", *flags, _SRC, "-o", _LIB]
+    return candidates
+
+
+def _build(src: str = _SRC, lib: str = _LIB, max_tier: str = "best") -> bool:
+    for flags in _flag_candidates(max_tier):
+        cmd = ["g++", "-O3", "-shared", "-fPIC", *flags, src, "-o", lib]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
             return True
@@ -80,12 +89,118 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_size_t,  # n
         ]
         lib.gf_matmul.restype = None
+        try:
+            lib.gf_encode_copy.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8),  # matrix
+                ctypes.c_int,  # parity rows
+                ctypes.c_int,  # data cols
+                ctypes.POINTER(ctypes.c_void_p),  # src rows (NULL = zeros)
+                ctypes.POINTER(ctypes.c_void_p),  # data dst (NULL = skip)
+                ctypes.POINTER(ctypes.c_void_p),  # parity dst
+                ctypes.c_size_t,  # n
+                ctypes.c_int,  # nt stores
+            ]
+            lib.gf_encode_copy.restype = ctypes.c_int
+        except AttributeError:  # stale .so without the symbol
+            pass
         _lib = lib
         return _lib
 
 
 def available() -> bool:
     return load() is not None
+
+
+_BASE_LIB = os.path.join(_HERE, "libgf256_avx2.so")
+_base_lib = None
+_base_failed = False
+
+
+def load_baseline():
+    """The PSHUFB-tier (AVX2-capped) build of the same kernel source — the
+    technique of the reference's vendored klauspost/reedsolomon v1.9.2,
+    which predates GFNI support. Bench CPU baselines measure against this
+    so the GFNI tier registers as the technique win it is."""
+    global _base_lib, _base_failed
+    with _lock:
+        if _base_lib is not None or _base_failed:
+            return _base_lib
+        if not os.path.exists(_BASE_LIB) or os.path.getmtime(
+            _BASE_LIB
+        ) < os.path.getmtime(_SRC):
+            if not _build(lib=_BASE_LIB, max_tier="avx2"):
+                _base_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_BASE_LIB)
+        except OSError:
+            _base_failed = True
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.gf_matmul.argtypes = [
+            u8p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(u8p), ctypes.POINTER(u8p), ctypes.c_size_t,
+        ]
+        lib.gf_matmul.restype = None
+        _base_lib = lib
+        return _base_lib
+
+
+def gf_matmul_baseline(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """uint8[R,C] x uint8[C,N] -> uint8[R,N] via the PSHUFB-tier library."""
+    lib = load_baseline()
+    if lib is None:
+        raise RuntimeError("baseline gf256 library unavailable")
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    return _matmul_rows(lib, matrix, list(data))
+
+
+def encode_copy_available() -> bool:
+    """True when the fused single-pass encode+copy (GFNI tier) is usable."""
+    lib = load()
+    if lib is None or not hasattr(lib, "gf_encode_copy"):
+        return False
+    # probe: the C entry returns 0 when built without GFNI
+    z = np.zeros(64, np.uint8)
+    out = np.empty(64, np.uint8)
+    m = np.ones((1, 1), np.uint8)
+    return bool(
+        gf_encode_copy_native(m, [z.ctypes.data], [None], [out.ctypes.data], 64)
+    )
+
+
+def gf_encode_copy_native(
+    matrix: np.ndarray,
+    src_addrs,
+    dst_addrs,
+    parity_addrs,
+    n: int,
+    nt: bool = True,
+) -> bool:
+    """Fused one-pass encode+copy over raw buffer addresses.
+
+    src_addrs: data-row addresses (None = implicit zero row — no copy, no
+    parity contribution); dst_addrs: where each data row is copied (None =
+    skip the copy); parity_addrs: where each parity row lands. With nt and
+    64B-aligned destinations, all stores are non-temporal (no RFO traffic).
+    Returns False when the library lacks the GFNI fused path.
+    """
+    lib = load()
+    if lib is None or not hasattr(lib, "gf_encode_copy"):
+        return False
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    prows, cols = matrix.shape
+    assert len(src_addrs) == cols and len(dst_addrs) == cols
+    assert len(parity_addrs) == prows
+    src = (ctypes.c_void_p * cols)(*(a or None for a in src_addrs))
+    dst = (ctypes.c_void_p * cols)(*(a or None for a in dst_addrs))
+    pdst = (ctypes.c_void_p * prows)(*(a or None for a in parity_addrs))
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    rc = lib.gf_encode_copy(
+        matrix.ctypes.data_as(u8p), prows, cols, src, dst, pdst,
+        ctypes.c_size_t(n), 1 if nt else 0,
+    )
+    return bool(rc)
 
 
 def gf_matmul_native(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
@@ -101,6 +216,11 @@ def gf_matmul_rows_native(matrix: np.ndarray, rows_in) -> np.ndarray:
     lib = load()
     if lib is None:
         raise RuntimeError("native gf256 library unavailable")
+    return _matmul_rows(lib, matrix, rows_in)
+
+
+def _matmul_rows(lib, matrix: np.ndarray, rows_in) -> np.ndarray:
+    """Shared ctypes marshalling for gf_matmul against any loaded tier."""
     matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
     rows, cols = matrix.shape
     assert len(rows_in) == cols

@@ -1,4 +1,5 @@
-"""Native-SIMD RS codec: CpuRSCodec's interface over the C++ PSHUFB kernel.
+"""Native-SIMD RS codec: CpuRSCodec's interface over the C++ GF(2^8) kernel
+(GFNI VGF2P8AFFINEQB where the CPU has it, PSHUFB nibble tables otherwise).
 
 The production host-side codec (the numpy table path stays as the oracle);
 decode matrices still come from the numpy galois module — only the bulk
@@ -30,10 +31,9 @@ class NativeRSCodec(CpuRSCodec):
         if not native.available():
             raise RuntimeError("native gf256 library unavailable")
         self._native = native
-        try:
-            ncpu = len(os.sched_getaffinity(0))  # cgroup/affinity-aware
-        except AttributeError:
-            ncpu = os.cpu_count() or 1
+        from ...util import available_cpus
+
+        ncpu = available_cpus()
         self.prefers_pipeline = ncpu > 1
         self.pipeline_workers = max(2, min(8, ncpu))
 

@@ -254,6 +254,215 @@ def _fs_type_of(path: str) -> str:
         return ""  # unparsable mount table: let the splice heuristic pass
 
 
+_ONEPASS_VIABLE: Optional[bool] = None
+
+
+def _onepass_viable() -> bool:
+    """One-time probe: is faulting fresh writable pages in fast enough for
+    the mmapped-output one-pass encoder to beat the write() path?
+
+    The fused encoder stores through output mmaps, so every fresh page costs
+    a fault + zero-fill; write() instead takes the kernel's buffered fast
+    path (large folios, no per-page fault). On bare metal both run at
+    memory speed, but some hypervisors lazy-allocate guest memory and
+    page-population crawls (measured 0.37 GB/s on this class of VM vs
+    7.75 GB/s for write()-style population). Probe 4MB of anonymous mapping
+    with MADV_POPULATE_WRITE (value 23; pre-5.14 kernels reject it and we
+    fall back to touching pages) and require ≥1.5 GB/s."""
+    global _ONEPASS_VIABLE
+    if _ONEPASS_VIABLE is not None:
+        return _ONEPASS_VIABLE
+    import ctypes
+    import mmap as mmap_mod
+    import time
+
+    size = 4 << 20
+    try:
+        mm = mmap_mod.mmap(-1, size)
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(mm))
+        t0 = time.perf_counter()
+        rc = -1
+        try:
+            libc = ctypes.CDLL(None, use_errno=True)
+            rc = libc.madvise(
+                ctypes.c_void_p(addr), ctypes.c_size_t(size), 23
+            )
+        except (OSError, AttributeError):
+            pass
+        if rc != 0:  # no MADV_POPULATE_WRITE: touch a byte per page
+            step = mmap_mod.PAGESIZE
+            for off in range(0, size, step):
+                mm[off] = 1
+        dt = time.perf_counter() - t0
+        mm.close()
+        _ONEPASS_VIABLE = size / max(dt, 1e-9) >= 1.5e9
+    except (OSError, ValueError, BufferError):
+        _ONEPASS_VIABLE = False
+    return _ONEPASS_VIABLE
+
+
+def _encode_onepass(
+    base_file_name: str,
+    dat_path: str,
+    codec,
+    dat_size: int,
+    n_large: int,
+    large_block: int,
+    n_small: int,
+    small_block: int,
+    chunk: int = 4 * 1024 * 1024,
+    force: bool = False,
+) -> bool:
+    """Fused single-pass encode: ONE streaming read of the .dat produces all
+    14 shards — each 64-byte column is copied to its data-shard file AND
+    folded into the four parity accumulators in the same pass, with
+    non-temporal stores straight into the mmapped outputs (no RFO traffic,
+    no user->kernel write copies). Memory traffic per source byte drops from
+    ~4.8 (read + buffered data write + parity read-modify-write) to ~2.4,
+    which is the difference on bandwidth-bound hosts.
+
+    Source regions past EOF become file holes (zeros — byte-identical to
+    the written form). Returns False when the native fused kernel is
+    unavailable; the caller falls back to the split read/encode/write paths.
+    The reference streams every byte through a user-space 256KB buffer
+    instead (ref ec_encoder.go:57-58,120-136).
+
+    Multicore hosts split the chunk list across a small thread pool — the
+    native call releases the GIL and every (row, chunk) region is disjoint.
+    """
+    from ... import native
+
+    if not native.encode_copy_available():
+        return False
+    k = codec.data_shards
+    p = codec.parity_shards
+    if p > 8 or k > 32:
+        # the C kernel's register blocking caps the fused path (gf256.cpp
+        # kRowBlock / mats[]); wider geometries take the split paths
+        return False
+    if not force and not _onepass_viable():
+        return False
+    matrix = np.ascontiguousarray(codec.parity_matrix, dtype=np.uint8)
+    shard_size = n_large * large_block + n_small * small_block
+    if shard_size == 0 or dat_size == 0:
+        return False
+
+    import mmap as mmap_mod
+
+    # (src_file_off, shard_off, block, length) per fused kernel call —
+    # shard j's source lives at src_off + j*block; the shard-local offset
+    # is row_start//k + done because every term of row_start carries a *k
+    def calls():
+        for row_start, block, done, width in _piece_iter(
+            n_large, large_block, n_small, small_block, chunk, k
+        ):
+            yield row_start + done, row_start // k + done, block, width
+
+    out_files = []
+    out_maps = []
+    aborted = False
+    dat_f = open(dat_path, "rb")
+    try:
+        dat_mm = mmap_mod.mmap(dat_f.fileno(), 0, access=mmap_mod.ACCESS_READ)
+        dat_arr = np.frombuffer(dat_mm, dtype=np.uint8)
+        src_base = int(dat_arr.ctypes.data)
+        out_arrs = []
+        for i in range(k + p):
+            f = open(base_file_name + to_ext(i), "wb+")
+            out_files.append(f)
+            # NT stores into the map fault pages in; without backing blocks
+            # that's a SIGBUS, not a catchable ENOSPC — reserve everything
+            # up front and fall back to the write() paths (which surface
+            # ENOSPC as OSError) when the reservation fails
+            try:
+                os.posix_fallocate(f.fileno(), 0, shard_size)
+            except OSError:
+                aborted = True
+                return False
+            mm = mmap_mod.mmap(
+                f.fileno(), shard_size, access=mmap_mod.ACCESS_WRITE
+            )
+            out_maps.append(mm)
+            out_arrs.append(np.frombuffer(mm, dtype=np.uint8))
+        out_base = [int(a.ctypes.data) for a in out_arrs]
+
+        def run_call(item):
+            src_off, dst_off, block, this = item
+            srcs = []
+            dsts = []
+            keep = []  # scratch rows alive across the native call
+            any_data = False
+            for j in range(k):
+                off = src_off + j * block
+                end = off + this
+                if off >= dat_size:
+                    srcs.append(None)
+                    dsts.append(None)
+                    continue
+                any_data = True
+                dsts.append(out_base[j] + dst_off)
+                if end <= dat_size:
+                    srcs.append(src_base + off)
+                else:  # EOF-straddling: zero-padded scratch row (rare —
+                    # at most one chunk per geometry section)
+                    s = np.zeros(this, dtype=np.uint8)
+                    nn = dat_size - off
+                    s[:nn] = dat_arr[off:dat_size]
+                    keep.append(s)
+                    srcs.append(int(s.ctypes.data))
+            if not any_data:
+                return  # all-zero columns: parity holes are correct zeros
+            parity = [out_base[k + r] + dst_off for r in range(p)]
+            ok = native.gf_encode_copy_native(matrix, srcs, dsts, parity, this)
+            if not ok:  # unreachable: geometry gated above, build probed
+                raise RuntimeError("fused encode kernel refused the call")
+
+        from ...util import available_cpus
+
+        ncpu = available_cpus()
+        items = list(calls())
+        if ncpu > 1 and len(items) > 1:
+            import concurrent.futures as cf
+
+            with cf.ThreadPoolExecutor(min(ncpu, 8)) as pool:
+                for f in [pool.submit(run_call, it) for it in items]:
+                    f.result()
+        else:
+            for item in items:
+                run_call(item)
+        return True
+    except Exception as e:
+        # anything unexpected mid-flight (mmap/scratch allocation under
+        # memory pressure, a SIGBUS-adjacent OSError...): remove the
+        # partial shards and let the proven split paths do the encode
+        from ...util.log import warning
+
+        warning("onepass encode aborted (%s); using split paths", e)
+        aborted = True
+        return False
+    finally:
+        out_arrs = None
+        dat_arr = None
+        for mm in out_maps:
+            try:
+                mm.close()
+            except (BufferError, ValueError):
+                pass
+        for f in out_files:
+            f.close()
+        try:
+            dat_mm.close()
+        except (BufferError, ValueError, NameError):
+            pass
+        dat_f.close()
+        if aborted:
+            for i in range(k + p):
+                try:
+                    os.remove(base_file_name + to_ext(i))
+                except OSError:
+                    pass
+
+
 def _splice_data_shards(
     dat_path: str,
     base_file_name: str,
@@ -337,6 +546,7 @@ def write_ec_files(
     pipeline: Optional[bool] = None,
     splice_data: Optional[bool] = None,
     mmap_input: Optional[bool] = None,
+    onepass: Optional[bool] = None,
 ) -> None:
     """Generate .ec00-.ec13 from .dat (ref WriteEcFiles, ec_encoder.go:57).
 
@@ -347,8 +557,22 @@ def write_ec_files(
     mmap_input=None picks the zero-copy mmapped-read path automatically
     (row-pointer host codec, no pipeline); True forces it for a non-pipelined
     host codec, False disables it.
+
+    onepass=None routes a zero-copy host codec through the fused
+    single-pass native encoder (_encode_onepass: one .dat read, NT stores,
+    all 14 shards in one sweep) when nothing else was explicitly
+    configured; True forces the attempt, False disables it. Falls back to
+    the split paths when the fused kernel is unavailable.
     """
     codec = _get_codec(codec)
+    onepass_forced = onepass is True
+    if onepass is None:
+        onepass = (
+            pipeline is None
+            and splice_data is None
+            and mmap_input is None
+            and getattr(codec, "zero_copy_rows", False)
+        )
     if pipeline is None:
         pipeline = getattr(codec, "prefers_pipeline", False)
     # zero-copy views of the mmapped .dat: the single-core host structure
@@ -378,6 +602,14 @@ def write_ec_files(
     n_large, n_small = _row_counts(
         dat_size, k, large_block_size, small_block_size
     )
+
+    if onepass and dat_size > 0:
+        if _encode_onepass(
+            base_file_name, dat_path, codec, dat_size,
+            n_large, large_block_size, n_small, small_block_size,
+            chunk=chunk, force=onepass_forced,
+        ):
+            return
 
     spliced = False
     if splice_data is None or splice_data:
@@ -503,11 +735,11 @@ def write_ec_files_multi(
     k = codec.data_shards
 
     if not getattr(codec, "is_device", False):
-        try:
-            ncpu = len(os.sched_getaffinity(0))
-        except AttributeError:
-            ncpu = os.cpu_count() or 1
-        n_workers = max(1, min(len(base_file_names), workers or ncpu))
+        from ...util import available_cpus
+
+        n_workers = max(
+            1, min(len(base_file_names), workers or available_cpus())
+        )
 
         def one(base: str) -> None:
             write_ec_files(

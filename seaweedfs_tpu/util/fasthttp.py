@@ -1,0 +1,510 @@
+"""Minimal HTTP/1.1 data-plane machinery: a raw asyncio.Protocol server and
+a keep-alive client pool.
+
+Why this exists: the serving north star (BASELINE.json config 4 — the
+reference's `weed benchmark`, README.md:483-530) is bounded by per-request
+framework overhead, not by storage. The reference's data plane is Go
+net/http (weed/server/volume_server_handlers_read.go); the Python-general
+equivalent (aiohttp) spends ~200µs/request on routing, header objects,
+multidicts and response assembly — an order of magnitude more than the
+needle read itself. This module is the TPU-framework analogue of the
+reference's thin handler loop: a byte-level parser feeding registered fast
+handlers, with EVERY other request transparently proxied to the full
+aiohttp application (which keeps the long-tail surface: UIs, pprof, tiered
+reads, ranges, resizing...). One listening port, two tiers.
+
+Design rules:
+- hot handlers may return FALLBACK at any point; the raw request bytes are
+  then replayed verbatim against the internal aiohttp listener, so the two
+  tiers can never disagree about semantics — the fast tier only ever serves
+  requests it fully understands.
+- parsing is bytes-only and allocation-light: no multidicts, no URL
+  objects, headers lazily split into a plain dict of lower-cased names.
+- responses are assembled as one writev-style bytes join with pre-rendered
+  static fragments.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Optional
+
+FALLBACK = object()  # sentinel: "proxy this request to the full app"
+
+_MAX_HEADER = 64 * 1024
+_MAX_BODY = 256 << 20  # matches the aiohttp client_max_size
+
+_STATUS_LINES = {
+    200: b"HTTP/1.1 200 OK\r\n",
+    201: b"HTTP/1.1 201 Created\r\n",
+    202: b"HTTP/1.1 202 Accepted\r\n",
+    204: b"HTTP/1.1 204 No Content\r\n",
+    206: b"HTTP/1.1 206 Partial Content\r\n",
+    304: b"HTTP/1.1 304 Not Modified\r\n",
+    400: b"HTTP/1.1 400 Bad Request\r\n",
+    401: b"HTTP/1.1 401 Unauthorized\r\n",
+    403: b"HTTP/1.1 403 Forbidden\r\n",
+    404: b"HTTP/1.1 404 Not Found\r\n",
+    405: b"HTTP/1.1 405 Method Not Allowed\r\n",
+    416: b"HTTP/1.1 416 Range Not Satisfiable\r\n",
+    500: b"HTTP/1.1 500 Internal Server Error\r\n",
+}
+
+
+class FastRequest:
+    """One parsed request. Header names are lower-case byte strings."""
+
+    __slots__ = ("method", "target", "path", "query", "headers", "body", "peer",
+                 "raw_head")
+
+    def __init__(self, method, target, headers, body, peer, raw_head):
+        self.method = method  # str: "GET"
+        self.target = target  # str: "/3,0144b9f3d1?x=1" (raw)
+        self.headers = headers  # dict[bytes, bytes] lower-cased names
+        self.body = body  # bytes
+        self.peer = peer  # str remote ip
+        self.raw_head = raw_head  # bytes: request line + headers + CRLFCRLF
+        q = target.find("?")
+        if q < 0:
+            self.path = target
+            self.query = ""
+        else:
+            self.path = target[:q]
+            self.query = target[q + 1:]
+
+
+def render_response(
+    status: int,
+    body: bytes = b"",
+    content_type: bytes = b"application/json",
+    extra: bytes = b"",
+    keep_alive: bool = True,
+    head_only: bool = False,
+) -> bytes:
+    """One response byte string. `extra` is pre-rendered \r\n-terminated
+    header lines."""
+    return b"".join(
+        (
+            _STATUS_LINES.get(status) or (
+                b"HTTP/1.1 %d X\r\n" % status
+            ),
+            b"Content-Type: ", content_type, b"\r\n",
+            b"Content-Length: %d\r\n" % len(body),
+            extra,
+            b"Connection: keep-alive\r\n\r\n"
+            if keep_alive
+            else b"Connection: close\r\n\r\n",
+            b"" if head_only else body,
+        )
+    )
+
+
+Handler = Callable[[FastRequest], Awaitable[object]]
+
+
+class FastHTTPProtocol(asyncio.Protocol):
+    """HTTP/1.1 server protocol: sequential requests per connection,
+    Content-Length bodies (chunked uploads fall back), keep-alive."""
+
+    def __init__(self, server: "FastHTTPServer"):
+        self.server = server
+        self.transport: Optional[asyncio.Transport] = None
+        self.buf = bytearray()
+        self.peer = ""
+        self._task: Optional[asyncio.Task] = None
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._paused = False
+        self._closed = False
+
+    # -- transport events --
+    def connection_made(self, transport):
+        self.transport = transport
+        transport.set_write_buffer_limits(high=1 << 20)
+        peer = transport.get_extra_info("peername")
+        self.peer = peer[0] if peer else ""
+        self._task = asyncio.ensure_future(self._run())
+        self.server._conns.add(self)
+
+    def connection_lost(self, exc):
+        self._closed = True
+        self._queue.put_nowait(None)
+        self.server._conns.discard(self)
+        if self._task is not None:
+            self._task.cancel()
+
+    def data_received(self, data: bytes):
+        self.buf += data
+        self._pump()
+        # backpressure: stop reading while too much is queued
+        if len(self.buf) > _MAX_BODY and not self._paused:
+            self._paused = True
+            self.transport.pause_reading()
+
+    def _pump(self):
+        """Slice complete requests out of the buffer into the queue."""
+        while True:
+            req = self._try_parse()
+            if req is None:
+                return
+            self._queue.put_nowait(req)
+
+    def _try_parse(self):
+        buf = self.buf
+        end = buf.find(b"\r\n\r\n")
+        if end < 0:
+            if len(buf) > _MAX_HEADER:
+                self._fail(400)
+            return None
+        head = bytes(buf[: end + 4])
+        try:
+            line_end = head.index(b"\r\n")
+            method, _, rest = head[:line_end].partition(b" ")
+            target, _, _version = rest.rpartition(b" ")
+            headers: dict = {}
+            pos = line_end + 2
+            while pos < end:
+                nl = head.index(b"\r\n", pos)
+                colon = head.index(b":", pos, nl)
+                name = head[pos:colon].lower()
+                headers[name] = head[colon + 1: nl].strip()
+                pos = nl + 2
+        except ValueError:
+            self._fail(400)
+            return None
+        if b"transfer-encoding" in headers:
+            # no chunked request bodies on the fast tier; the proxy tier
+            # can't replay what we haven't framed either -> reject (the
+            # full app is reachable via Content-Length requests)
+            self._fail(400)
+            return None
+        clen = int(headers.get(b"content-length", b"0") or 0)
+        if clen > _MAX_BODY:
+            self._fail(400)
+            return None
+        total = end + 4 + clen
+        if len(buf) < total:
+            return None
+        body = bytes(buf[end + 4: total])
+        del buf[:total]
+        if self._paused and len(buf) < _MAX_BODY:
+            self._paused = False
+            self.transport.resume_reading()
+        return FastRequest(
+            method.decode("latin1"),
+            target.decode("latin1"),
+            headers,
+            body,
+            self.peer,
+            head,
+        )
+
+    def _fail(self, status: int):
+        if self.transport is not None:
+            try:
+                self.transport.write(
+                    render_response(status, b'{"error":"bad request"}',
+                                    keep_alive=False)
+                )
+            except Exception:
+                pass
+            self.transport.close()
+        self._closed = True
+        self._queue.put_nowait(None)
+
+    # -- request loop --
+    async def _run(self):
+        try:
+            while True:
+                req = await self._queue.get()
+                if req is None or self._closed:
+                    return
+                try:
+                    out = await self.server.handler(req)
+                except Exception:
+                    out = None
+                if out is FALLBACK:
+                    ok = await self._proxy(req)
+                    if not ok:
+                        return
+                    continue
+                if out is None:
+                    self.transport.write(
+                        render_response(
+                            500, b'{"error":"internal error"}')
+                    )
+                    continue
+                self.transport.write(out)
+                if self.transport.is_closing():
+                    return
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            if self.transport is not None:
+                self.transport.close()
+
+    async def _proxy(self, req: FastRequest) -> bool:
+        """Replay the request against the internal full-featured listener
+        and relay the response. Connection: close on the backend leg keeps
+        framing trivial; the client-side connection stays keep-alive when
+        the backend response is well-formed with a Content-Length."""
+        backend = self.server.backend
+        if backend is None:
+            self.transport.write(
+                render_response(500, b'{"error":"no fallback app"}')
+            )
+            return True
+        try:
+            r, w = await asyncio.open_connection(*backend)
+            # rewrite Connection header to close on the backend leg
+            head = req.raw_head
+            # strip any connection header, append ours
+            lines = head.split(b"\r\n")
+            lines = [
+                ln for ln in lines[:-2]
+                if not ln.lower().startswith(b"connection:")
+            ]
+            lines.append(b"Connection: close")
+            w.write(b"\r\n".join(lines) + b"\r\n\r\n" + req.body)
+            await w.drain()
+            resp = await r.read(-1)  # backend closes when done
+            w.close()
+        except Exception:
+            self.transport.write(
+                render_response(500, b'{"error":"fallback proxy failed"}')
+            )
+            return True
+        if not resp:
+            self.transport.write(
+                render_response(500, b'{"error":"empty fallback response"}')
+            )
+            return True
+        # the backend replied Connection: close framing; if it declared a
+        # Content-Length we can keep our client connection alive, else we
+        # must close to delimit
+        head_end = resp.find(b"\r\n\r\n")
+        has_len = head_end > 0 and b"content-length:" in resp[:head_end].lower()
+        self.transport.write(resp)
+        if not has_len:
+            self.transport.close()
+            return False
+        return True
+
+
+class FastHTTPServer:
+    """Owns the public listening socket; `handler` is the fast tier,
+    `backend` (host, port) the full aiohttp app for everything else."""
+
+    def __init__(self, handler: Handler, backend=None):
+        self.handler = handler
+        self.backend = backend
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()
+
+    async def start(self, host: str, port: int):
+        loop = asyncio.get_event_loop()
+        self._server = await loop.create_server(
+            lambda: FastHTTPProtocol(self), host, port, reuse_address=True
+        )
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+        for conn in list(self._conns):
+            try:
+                if conn.transport is not None:
+                    conn.transport.close()
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------- client --
+
+
+class _Conn:
+    __slots__ = ("reader", "writer")
+
+    def __init__(self, reader, writer):
+        self.reader = reader
+        self.writer = writer
+
+
+class FastHTTPClient:
+    """Keep-alive HTTP/1.1 client pool. request() -> (status, body).
+
+    Built for the data plane's shapes: small JSON/payload responses framed
+    by Content-Length. Responses without a Content-Length are read to EOF
+    and the connection retired."""
+
+    def __init__(self, pool_per_host: int = 32):
+        self._pool: dict = {}
+        self._limit = pool_per_host
+
+    async def _get(self, hostport: str) -> _Conn:
+        conns = self._pool.setdefault(hostport, [])
+        while conns:
+            c = conns.pop()
+            if not c.writer.is_closing():
+                return c
+        host, _, port = hostport.rpartition(":")
+        reader, writer = await asyncio.open_connection(host, int(port))
+        return _Conn(reader, writer)
+
+    def _put(self, hostport: str, conn: _Conn):
+        conns = self._pool.setdefault(hostport, [])
+        if len(conns) < self._limit and not conn.writer.is_closing():
+            conns.append(conn)
+        else:
+            conn.writer.close()
+
+    async def request(
+        self,
+        method: str,
+        hostport: str,
+        target: str,
+        body: bytes = b"",
+        content_type: str = "",
+        headers: Optional[dict] = None,
+        retried: bool = False,
+    ) -> tuple[int, bytes]:
+        conn = await self._get(hostport)
+        parts = [
+            f"{method} {target} HTTP/1.1\r\nHost: {hostport}\r\n".encode()
+        ]
+        if content_type:
+            parts.append(f"Content-Type: {content_type}\r\n".encode())
+        if body or method in ("POST", "PUT"):
+            parts.append(b"Content-Length: %d\r\n" % len(body))
+        if headers:
+            for k, v in headers.items():
+                parts.append(f"{k}: {v}\r\n".encode())
+        parts.append(b"\r\n")
+        if body:
+            parts.append(body)
+        try:
+            conn.writer.write(b"".join(parts))
+            await conn.writer.drain()
+            status, resp_body, reusable = await self._read_response(conn)
+        except (ConnectionError, asyncio.IncompleteReadError, OSError):
+            conn.writer.close()
+            if retried:
+                raise
+            # stale pooled connection: one clean retry on a fresh one
+            return await self.request(
+                method, hostport, target, body, content_type, headers,
+                retried=True,
+            )
+        if reusable:
+            self._put(hostport, conn)
+        else:
+            conn.writer.close()
+        return status, resp_body
+
+    async def _read_response(self, conn: _Conn):
+        reader = conn.reader
+        head = await reader.readuntil(b"\r\n\r\n")
+        line_end = head.index(b"\r\n")
+        status = int(head[9:line_end].split(b" ", 1)[0] or 500)
+        lower = head.lower()
+        clen = -1
+        idx = lower.find(b"content-length:")
+        if idx >= 0:
+            nl = lower.index(b"\r\n", idx)
+            clen = int(head[idx + 15: nl].strip())
+        chunked = b"transfer-encoding: chunked" in lower
+        keep = b"connection: close" not in lower
+        if chunked:
+            body = await self._read_chunked(reader)
+            return status, body, keep
+        if clen >= 0:
+            body = await reader.readexactly(clen) if clen else b""
+            return status, body, keep
+        body = await reader.read(-1)
+        return status, body, False
+
+    @staticmethod
+    async def _read_chunked(reader) -> bytes:
+        out = bytearray()
+        while True:
+            line = await reader.readuntil(b"\r\n")
+            size = int(line.strip().split(b";")[0], 16)
+            if size == 0:
+                await reader.readuntil(b"\r\n")
+                return bytes(out)
+            out += await reader.readexactly(size)
+            await reader.readexactly(2)  # CRLF
+
+    async def close(self):
+        for conns in self._pool.values():
+            for c in conns:
+                try:
+                    c.writer.close()
+                except Exception:
+                    pass
+        self._pool.clear()
+
+
+def build_multipart(
+    field: str, data: bytes, filename: str = "file", mime: str = ""
+) -> tuple[bytes, str]:
+    """(body, content_type) for a single-part multipart/form-data upload."""
+    boundary = "seaweedtpu-boundary-7f29a1"
+    ct = f"Content-Type: {mime}\r\n" if mime else ""
+    head = (
+        f"--{boundary}\r\nContent-Disposition: form-data; "
+        f'name="{field}"; filename="{filename}"\r\n{ct}\r\n'
+    ).encode()
+    tail = f"\r\n--{boundary}--\r\n".encode()
+    return head + data + tail, f"multipart/form-data; boundary={boundary}"
+
+
+def parse_multipart(body: bytes, content_type: bytes):
+    """Single-pass parse of a multipart/form-data body: the first part
+    whose disposition names file/upload (or carries a filename) ->
+    (data, filename, mime) — or None when the shape is unexpected (caller
+    falls back to the full parser)."""
+    idx = content_type.find(b"boundary=")
+    if idx < 0:
+        return None
+    boundary = content_type[idx + 9:].split(b";")[0].strip().strip(b'"')
+    delim = b"--" + boundary
+    pos = body.find(delim)
+    while pos >= 0:
+        pos += len(delim)
+        if body[pos: pos + 2] == b"--":
+            return None  # closing delimiter before a usable part
+        head_start = pos + 2  # skip CRLF
+        head_end = body.find(b"\r\n\r\n", head_start)
+        if head_end < 0:
+            return None
+        head = body[head_start:head_end].lower()
+        orig_head = body[head_start:head_end]
+        data_start = head_end + 4
+        nxt = body.find(b"\r\n" + delim, data_start)
+        if nxt < 0:
+            return None
+        if (
+            b'name="file"' in head
+            or b'name="upload"' in head
+            or b"filename=" in head
+        ):
+            filename = ""
+            fi = orig_head.find(b"filename=")
+            if fi >= 0:
+                fn = orig_head[fi + 9:].split(b"\r\n")[0].split(b";")[0]
+                filename = fn.strip().strip(b'"').decode("utf-8", "replace")
+            mime = ""
+            mi = head.find(b"content-type:")
+            if mi >= 0:
+                mime = (
+                    orig_head[mi + 13:]
+                    .split(b"\r\n")[0]
+                    .strip()
+                    .decode("latin1")
+                )
+            return body[data_start:nxt], filename, mime
+        pos = body.find(delim, nxt)
+    return None

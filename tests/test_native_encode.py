@@ -57,6 +57,9 @@ def test_mmap_and_splice_match_oracle(tmp_path, size):
         ("sync", {"pipeline": False, "splice_data": False,
                   "mmap_input": False}),
         ("pipelined", {"pipeline": True}),
+        # forced (bypasses the page-population viability probe): the fused
+        # GFNI one-pass NT-store path, when this build carries it
+        ("onepass", {"onepass": True}),
     ]:
         d = tmp_path / label
         d.mkdir()
