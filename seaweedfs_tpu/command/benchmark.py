@@ -1,23 +1,25 @@
 """Load benchmark: concurrent writers then readers of small files, with the
 reference's stats report (ref: weed/command/benchmark.go:109-541).
 
-Writers assign a fid from the master and POST a deterministic payload to the
-returned volume server; readers look up cached vid locations and GET.
-Latencies land in a 0.1ms-bucket histogram with the same percentile table.
+Writers assign a fid from the master (HTTP /dir/assign on the fast tier)
+and POST a deterministic payload to the returned volume server; readers
+look up cached vid locations and GET. All data-plane requests ride the
+keep-alive FastHTTPClient — the Python equivalent of the reference
+benchmark's pooled net/http client (benchmark.go:281-311). Latencies land
+in a 0.1ms-bucket histogram with the same percentile table.
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
 import random
 import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-import aiohttp
-
-from ..client import MasterClient, assign
-from ..client.operation import read_url, upload_data
+from ..client import MasterClient
+from ..util.fasthttp import FastHTTPClient, build_multipart
 
 
 def fake_payload(seed_id: int, size: int) -> bytes:
@@ -100,54 +102,79 @@ async def run_benchmark(
     do_write: bool = True,
     do_read: bool = True,
     stats_out: Optional[dict] = None,
+    fids_in: Optional[list] = None,
 ) -> str:
     """Returns the human report; when `stats_out` is given it also receives
-    {write_qps, write_failed, read_qps, read_failed} for machine use
-    (bench.py's serving-QPS north-star entry)."""
+    {write_qps, write_failed, read_qps, read_failed, write_stats,
+    read_stats, fids} for machine use (bench.py's serving-QPS north-star
+    entry). `fids_in` seeds the read phase so read-only passes
+    (do_write=False) can re-read a previously written set."""
     out = []
     mc = MasterClient("benchmark", [master])
     await mc.start()
     try:
         await mc.wait_connected()
-        fids: list[str] = []
+        fids: list[str] = list(fids_in) if fids_in else []
+        http = FastHTTPClient(pool_per_host=concurrency + 4)
+        assign_target = (
+            "/dir/assign?collection=" + collection if collection
+            else "/dir/assign"
+        )
         if do_write:
             stats = Stats("Writing Benchmark")
             queue: asyncio.Queue = asyncio.Queue()
             for i in range(num_files):
                 queue.put_nowait(i)
 
-            async with aiohttp.ClientSession() as session:
-
-                async def writer() -> None:
-                    while True:
-                        try:
-                            i = queue.get_nowait()
-                        except asyncio.QueueEmpty:
-                            return
-                        t0 = time.perf_counter()
-                        try:
-                            ar = await assign(master, collection=collection)
-                            await upload_data(
-                                session,
-                                ar.url,
-                                ar.fid,
-                                fake_payload(i, file_size),
-                                jwt=ar.auth,
+            async def writer() -> None:
+                while True:
+                    try:
+                        i = queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        return
+                    t0 = time.perf_counter()
+                    try:
+                        st, body = await http.request(
+                            "GET", master, assign_target
+                        )
+                        ar = json.loads(body)
+                        if st != 200 or ar.get("error"):
+                            raise RuntimeError(f"assign: {st} {ar}")
+                        payload, ctype = build_multipart(
+                            "file", fake_payload(i, file_size)
+                        )
+                        headers = (
+                            {"Authorization": "Bearer " + ar["auth"]}
+                            if ar.get("auth")
+                            else None
+                        )
+                        st, rbody = await http.request(
+                            "POST",
+                            ar["url"],
+                            "/" + ar["fid"],
+                            body=payload,
+                            content_type=ctype,
+                            headers=headers,
+                        )
+                        if st >= 300:
+                            raise RuntimeError(
+                                f"upload: {st} {rbody[:120]!r}"
                             )
-                            stats.record(time.perf_counter() - t0, file_size)
-                            fids.append(ar.fid)
-                        except Exception:
-                            stats.failed += 1
+                        stats.record(time.perf_counter() - t0, file_size)
+                        fids.append(ar["fid"])
+                    except Exception:
+                        stats.failed += 1
 
-                stats.start = time.perf_counter()
-                await asyncio.gather(*(writer() for _ in range(concurrency)))
-                stats.end = time.perf_counter()
+            stats.start = time.perf_counter()
+            await asyncio.gather(*(writer() for _ in range(concurrency)))
+            stats.end = time.perf_counter()
             out.append(stats.report(concurrency))
             if stats_out is not None:
                 stats_out["write_qps"] = stats.completed / max(
                     stats.end - stats.start, 1e-9
                 )
                 stats_out["write_failed"] = stats.failed
+                stats_out["write_stats"] = stats
 
         if do_read and fids:
             stats = Stats("Randomly Reading Benchmark")
@@ -156,34 +183,43 @@ async def run_benchmark(
             for fid in reads:
                 queue.put_nowait(fid)
 
-            async with aiohttp.ClientSession() as session:
+            async def reader() -> None:
+                while True:
+                    try:
+                        fid = queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        return
+                    t0 = time.perf_counter()
+                    try:
+                        # cache hit normally; falls back to a master RPC
+                        # when the vid cache hasn't learned a
+                        # freshly-grown volume yet
+                        url = await mc.lookup_file_id_async(fid)
+                        hostport, _, path = url.removeprefix(
+                            "http://"
+                        ).partition("/")
+                        st, data = await http.request(
+                            "GET", hostport, "/" + path
+                        )
+                        if st != 200:
+                            raise RuntimeError(f"read {fid}: {st}")
+                        stats.record(time.perf_counter() - t0, len(data))
+                    except Exception:
+                        stats.failed += 1
 
-                async def reader() -> None:
-                    while True:
-                        try:
-                            fid = queue.get_nowait()
-                        except asyncio.QueueEmpty:
-                            return
-                        t0 = time.perf_counter()
-                        try:
-                            # cache hit normally; falls back to a master RPC
-                            # when the vid cache hasn't learned a
-                            # freshly-grown volume yet
-                            url = await mc.lookup_file_id_async(fid)
-                            data = await read_url(session, url)
-                            stats.record(time.perf_counter() - t0, len(data))
-                        except Exception:
-                            stats.failed += 1
-
-                stats.start = time.perf_counter()
-                await asyncio.gather(*(reader() for _ in range(concurrency)))
-                stats.end = time.perf_counter()
+            stats.start = time.perf_counter()
+            await asyncio.gather(*(reader() for _ in range(concurrency)))
+            stats.end = time.perf_counter()
             out.append(stats.report(concurrency))
             if stats_out is not None:
                 stats_out["read_qps"] = stats.completed / max(
                     stats.end - stats.start, 1e-9
                 )
                 stats_out["read_failed"] = stats.failed
+                stats_out["read_stats"] = stats
+        if stats_out is not None:
+            stats_out["fids"] = fids
+        await http.close()
     finally:
         await mc.stop()
     return "\n".join(out)

@@ -131,6 +131,25 @@ class LsmFilerStore:
     ):
         os.makedirs(directory, exist_ok=True)
         self.dir = directory
+        # exclusive directory lock: two processes appending the same
+        # wal.log / racing MANIFEST rewrites would corrupt the store (the
+        # sqlite-backed stores get this from their engine; LevelDB itself
+        # uses a LOCK file) — fail fast instead
+        self._lock_fd = os.open(
+            os.path.join(directory, "LOCK"), os.O_CREAT | os.O_RDWR, 0o644
+        )
+        try:
+            import fcntl
+
+            fcntl.flock(self._lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except (ImportError, AttributeError):
+            pass  # non-POSIX: no advisory locking available
+        except OSError:
+            os.close(self._lock_fd)
+            raise RuntimeError(
+                f"lsm store directory {directory!r} is locked by another "
+                "process"
+            )
         self.memtable_limit = memtable_limit
         self.max_segments = max_segments
         self.fsync = fsync
@@ -340,3 +359,6 @@ class LsmFilerStore:
             self._wal.close()
             for seg in self._segments:
                 seg.close()
+            if self._lock_fd is not None:
+                os.close(self._lock_fd)  # releases the flock
+                self._lock_fd = None

@@ -178,7 +178,12 @@ class WfsFuseOps:
             await self._entry(path)  # ENOENT on stale dirs
         out = [(ino, ".", 4), (1 if path == "/" else ino, "..", 4)]
         for e in await self.wfs.list_dir(path):
-            child = self.ino_of(e.full_path)
+            # never ALLOCATE an ino here: the kernel only FORGETs nodes it
+            # looked up, so dirent-only bindings would leak forever on big
+            # or churning trees. Reuse a live binding when one exists, else
+            # the FUSE_UNKNOWN_INO sentinel (kernel ignores dirent inos
+            # without -o use_ino); the real ino binds at lookup()
+            child = self._path_to_ino.get(e.full_path, 0xFFFFFFFF)
             out.append((child, e.name, 4 if e.is_directory else 8))
         return out
 

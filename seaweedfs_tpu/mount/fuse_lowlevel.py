@@ -317,14 +317,25 @@ class FuseConn:
             self._reply(unique, errno.EIO)
 
     def _handle_init(self, unique: int, body: bytes) -> None:
-        major, minor, _ra, _flags = _INIT_IN.unpack_from(body)
+        major, minor, _ra, kernel_flags = _INIT_IN.unpack_from(body)
         self.proto_minor = min(minor, 31)
+        # without FUSE_MAX_PAGES the kernel silently caps writes at 32
+        # pages (128KB) regardless of max_write; negotiate it (proto 7.28+)
+        # so the advertised 1MB max_write is actually honored
+        FUSE_MAX_PAGES = 1 << 22
+        flags = 0
+        max_pages = 0
+        if self.proto_minor >= 28 and (kernel_flags & FUSE_MAX_PAGES):
+            flags |= FUSE_MAX_PAGES
+            max_pages = (self.max_write + 4095) // 4096
+        else:
+            self.max_write = min(self.max_write, 32 * 4096)
         out = _INIT_OUT.pack(
             7, self.proto_minor, 1 << 20,  # major minor max_readahead
-            0,  # flags: no extras; kernel serializes conservatively
+            flags,
             16, 12,  # max_background, congestion_threshold
             self.max_write, 1,  # max_write, time_gran (ns)
-            0, 0, 0,  # max_pages, map_alignment, flags2
+            max_pages, 0, 0,  # max_pages, map_alignment, flags2
             *([0] * 7),
         )
         self._reply(unique, 0, out)

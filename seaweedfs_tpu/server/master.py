@@ -123,8 +123,19 @@ class MasterServer:
         app.router.add_get("/{file_id:[0-9]+,.+}", self._redirect)
         self._http_runner = web.AppRunner(app, access_log=None)
         await self._http_runner.setup()
-        site = web.TCPSite(self._http_runner, self.host, self.port)
+        # full app on an internal loopback port; the public port is the
+        # byte-level fast tier (util/fasthttp.py) which serves /dir/assign
+        # and /dir/lookup itself and proxies the rest here
+        site = web.TCPSite(self._http_runner, "127.0.0.1", 0)
         await site.start()
+        internal_port = site._server.sockets[0].getsockname()[1]
+
+        from ..util.fasthttp import FastHTTPServer
+
+        self._fast_server = FastHTTPServer(
+            self._fast_dispatch, backend=("127.0.0.1", internal_port)
+        )
+        await self._fast_server.start(self.host, self.port)
 
         svc = Service("master")
         svc.bidi_stream("SendHeartbeat")(self._send_heartbeat)
@@ -179,6 +190,8 @@ class MasterServer:
 
     async def stop(self) -> None:
         self._shutdown = True
+        if getattr(self, "_fast_server", None) is not None:
+            await self._fast_server.stop()
         if self._maintenance_task is not None:
             self._maintenance_task.cancel()
             try:
@@ -190,6 +203,43 @@ class MasterServer:
             await self._grpc_server.stop(0.5)
         if self._http_runner is not None:
             await self._http_runner.cleanup()
+
+    # ---------------- fast-tier HTTP dispatch (util/fasthttp.py) ----------------
+    async def _fast_dispatch(self, req):
+        """Hot client-facing lookups: /dir/assign and /dir/lookup with plain
+        query parameters. Anything else (percent-encoded queries, form
+        bodies, admin/UI/status routes) proxies to the full app."""
+        from ..util.fasthttp import FALLBACK, render_response
+
+        if req.method not in ("GET", "POST") or (
+            req.method == "POST" and req.body
+        ):
+            return FALLBACK
+        if req.path not in ("/dir/assign", "/dir/lookup"):
+            return FALLBACK
+        q = req.query
+        if "%" in q or "+" in q:
+            return FALLBACK  # encoded values: use the full URL parser
+        params = {}
+        if q:
+            for pair in q.split("&"):
+                k, _, v = pair.partition("=")
+                params[k] = v
+        import json as _json
+
+        if req.path == "/dir/assign":
+            if not params.keys() <= {
+                "count", "collection", "replication", "ttl", "dataCenter",
+            }:
+                return FALLBACK
+            result = await self._do_assign(params)
+        else:
+            if not self.raft.is_leader:
+                return FALLBACK  # follower: full app serves the leader gate
+            result = self._do_lookup(
+                params.get("volumeId", ""), params.get("collection", "")
+            )
+        return render_response(200, _json.dumps(result).encode())
 
     # ---------------- assignment core ----------------
     def _parse_option(self, params) -> GrowOption:

@@ -32,7 +32,19 @@ from ..storage.needle import Needle, NotFoundError
 from ..storage.store import Store
 from ..storage.volume import AlreadyDeleted, CookieMismatch, NotFound
 from ..storage import vacuum as vacuum_mod
+from ..util.fasthttp import (
+    DETACHED,
+    FALLBACK,
+    finish_detached,
+    finish_detached_proxy,
+    parse_multipart,
+    render_response,
+)
+from ..util.metrics import REQUEST_COUNTER
 from .volume_ec import EcHandlers
+
+
+_NEEDS_FULL_APP = object()  # needle shape the fast tier doesn't serve
 
 
 def _decode_keys(req: dict):
@@ -140,8 +152,21 @@ class VolumeServer(EcHandlers):
         app.router.add_route("*", "/{tail:.*}", self._dispatch)
         self._http_runner = web.AppRunner(app, access_log=None)
         await self._http_runner.setup()
-        site = web.TCPSite(self._http_runner, self.host, self.port)
+        # the full aiohttp surface listens on an internal loopback port; the
+        # public port is owned by the byte-level fast tier, which serves the
+        # hot data plane itself and transparently proxies everything else
+        # here (util/fasthttp.py — the reference's thin Go handler loop
+        # equivalent, volume_server_handlers_read.go)
+        site = web.TCPSite(self._http_runner, "127.0.0.1", 0)
         await site.start()
+        internal_port = site._server.sockets[0].getsockname()[1]
+
+        from ..util.fasthttp import FastHTTPServer
+
+        self._fast_server = FastHTTPServer(
+            self._fast_dispatch, backend=("127.0.0.1", internal_port)
+        )
+        await self._fast_server.start(self.host, self.port)
 
         svc = Service("volume")
         svc.unary("AllocateVolume")(self._grpc_allocate_volume)
@@ -187,6 +212,8 @@ class VolumeServer(EcHandlers):
                 pass
         if self._grpc_server is not None:
             await self._grpc_server.stop(0.5)
+        if getattr(self, "_fast_server", None) is not None:
+            await self._fast_server.stop()
         if self._http_runner is not None:
             await self._http_runner.cleanup()
         if self._http_client is not None:
@@ -276,6 +303,176 @@ class VolumeServer(EcHandlers):
                 call.cancel()
             except Exception:
                 pass
+
+    # ---------------- fast-tier HTTP dispatch (util/fasthttp.py) ----------------
+    async def _fast_dispatch(self, req):
+        """Byte-level hot handlers for the data plane. Any request shape
+        outside the fully-understood fast cases returns FALLBACK, which the
+        protocol replays against the internal aiohttp app — semantics can
+        never diverge, the fast tier only short-circuits what it completely
+        covers. Reads may fall back at ANY point (no side effects); writes
+        only before the needle append."""
+        method = req.method
+        if method in ("GET", "HEAD"):
+            out = await self._fast_read(req)
+        elif method == "POST":
+            out = self._fast_write(req)
+        else:
+            return FALLBACK
+        if out is not FALLBACK:
+            REQUEST_COUNTER.inc(server="volume", operation=method)
+        return out
+
+    async def _fast_read(self, req):
+        if req.query or not req.path or req.path == "/" or "debug" in req.path:
+            return FALLBACK
+        head_only = req.method == "HEAD"
+        h = req.headers
+        if b"range" in h or b"if-range" in h:
+            return FALLBACK
+        try:
+            fid, _filename, ext = self._parse_fid_path(req.path)
+        except Exception:
+            return FALLBACK  # /status, /ui, /metrics, bad fids...
+        vid = fid.volume_id
+        v = self.store.find_volume(vid)
+        if v is None or v.has_remote_file:
+            return FALLBACK  # EC / tiered / redirect paths
+        if self.lookup_gate is not None:
+            # batched serving path (north-star #2): the index probe joins
+            # the gate's micro-batch, and the WHOLE continuation (pread ->
+            # render -> socket write) runs inside the flush callback — a
+            # batch of N coalesced reads costs one event-loop callback,
+            # zero per-request task resumes (DETACHED protocol mode)
+            def done(loc, exc) -> None:
+                out = self._render_gated(v, vid, fid, head_only, loc, exc)
+                if out is None:  # complex needle: full app takes over
+                    finish_detached_proxy(self._fast_server, req)
+                else:
+                    finish_detached(req, out)
+
+            self.lookup_gate.lookup_cb(vid, fid.key, done)
+            return DETACHED
+        n = Needle(id=fid.key)
+        try:
+            self.store.read_volume_needle(vid, n)
+        except (NotFound, NotFoundError, AlreadyDeleted, LookupError):
+            return render_response(
+                404, b'{"error": "not found"}', head_only=head_only
+            )
+        except Exception:
+            return FALLBACK
+        out = self._render_needle(n, fid, head_only)
+        return FALLBACK if out is _NEEDS_FULL_APP else out
+
+    def _render_gated(self, v, vid, fid, head_only, loc, exc) -> bytes:
+        """Response bytes for a gated read, run inside the gate's flush."""
+        try:
+            if exc is not None:
+                if isinstance(exc, LookupError):
+                    return render_response(
+                        404, b'{"error": "not found"}', head_only=head_only
+                    )
+                return render_response(
+                    500, b'{"error": "lookup failed"}', head_only=head_only
+                )
+            if loc is None:
+                return render_response(
+                    404, b'{"error": "not found"}', head_only=head_only
+                )
+            offset_units, size = loc
+            n = Needle(id=fid.key)
+            stale = False
+            try:
+                if size > 0:
+                    n = v.read_needle_at(offset_units, size)
+                stale = size > 0 and n.cookie != fid.cookie
+            except Exception:
+                stale = True
+            if stale:
+                # vacuum may have rewritten the .dat between probe and
+                # pread; the locked per-request path is atomic
+                n = Needle(id=fid.key)
+                self.store.read_volume_needle(vid, n)
+            out = self._render_needle(n, fid, head_only)
+            return None if out is _NEEDS_FULL_APP else out
+        except (NotFound, NotFoundError, AlreadyDeleted, LookupError):
+            return render_response(
+                404, b'{"error": "not found"}', head_only=head_only
+            )
+        except Exception:
+            return render_response(
+                500, b'{"error": "internal error"}', head_only=head_only
+            )
+
+    def _render_needle(self, n, fid, head_only):
+        if n.cookie != fid.cookie:
+            return render_response(
+                404, b'{"error": "cookie mismatch"}',
+                head_only=head_only,
+            )
+        if n.is_chunked_manifest() or n.is_compressed():
+            # manifest resolution / content negotiation: full app territory
+            return _NEEDS_FULL_APP
+        ctype = bytes(n.mime) if n.mime else b"application/octet-stream"
+        extra = b'Etag: "%s"\r\nAccept-Ranges: bytes\r\n' % n.etag().encode()
+        if n.last_modified:
+            extra += b"Last-Modified-Ts: %d\r\n" % n.last_modified
+        return render_response(
+            200, bytes(n.data), content_type=ctype, extra=extra,
+            head_only=head_only,
+        )
+
+    def _fast_write(self, req):
+        if req.query:
+            return FALLBACK  # ts/ttl/cm/fsync/type=replicate...
+        try:
+            fid, _, _ = self._parse_fid_path(req.path)
+        except Exception:
+            return FALLBACK
+        if not self.guard.check_whitelist(req.peer):
+            return FALLBACK  # replicate-membership exemption lives there
+        if self.jwt_signing_key:
+            auth = req.headers.get(b"authorization", b"").decode("latin1")
+            if not self.guard.check_jwt(auth, str(fid)):
+                return render_response(401, b'{"error": "unauthorized"}')
+        vid = fid.volume_id
+        v = self.store.find_volume(vid)
+        if v is None:
+            if self.store.has_volume(vid):
+                return FALLBACK
+            return render_response(
+                404, (b'{"error": "volume %d not found"}' % vid)
+            )
+        if v.super_block.replica_placement.copy_count() > 1:
+            return FALLBACK  # synchronous replication fan-out
+        ct = req.headers.get(b"content-type", b"")
+        if ct.startswith(b"multipart/form-data"):
+            parsed = parse_multipart(req.body, ct)
+            if parsed is None:
+                return FALLBACK
+            data, filename, mime = parsed
+        else:
+            data, filename, mime = req.body, "", ct.decode("latin1")
+        n = Needle(cookie=fid.cookie, id=fid.key, data=bytes(data))
+        if filename:
+            n.set_name(filename.encode())
+        if mime and mime != "application/octet-stream":
+            n.set_mime(mime.encode())
+        import json as _json
+
+        try:
+            _off, size, _unchanged = self.store.write_volume_needle(vid, n)
+        except Exception as e:
+            # the append may or may not have landed: NEVER fall back (a
+            # replay could double-write); report like the slow path does
+            return render_response(
+                500, _json.dumps({"error": str(e)}).encode()
+            )
+        body = _json.dumps(
+            {"name": filename, "size": size, "eTag": n.etag()}
+        ).encode()
+        return render_response(201, body)
 
     # ---------------- HTTP dispatch ----------------
     async def _dispatch(self, request: web.Request) -> web.StreamResponse:
@@ -692,7 +889,9 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
         traffic from registered cluster peers bypasses the whitelist (the
         reference puts replication on a separate admin mux) but never the
         JWT check — the primary forwards the client's token."""
-        remote = request.remote or ""
+        from ..util.security import real_remote
+
+        remote = real_remote(request)
         if not self.guard.check_whitelist(remote):
             is_replicate = request.query.get("type") == "replicate"
             if not (is_replicate and await self._is_cluster_member(remote)):

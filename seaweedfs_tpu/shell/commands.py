@@ -257,12 +257,35 @@ async def cmd_volume_configure_replication(env, argv) -> str:
                 holders.append(dn["url"])
     if not holders:
         return "no volume needs change"
+    # keep going through every holder even after a failure: stopping at the
+    # first error would leave replicas with silently divergent placements
+    # and no pointer to which servers still carry the old one
+    ok, failed = [], []
     for url in holders:
-        r = await env.volume_stub(url).call(
-            "VolumeConfigure", {"volume_id": vid, "replication": replication}
+        try:
+            r = await env.volume_stub(url).call(
+                "VolumeConfigure",
+                {"volume_id": vid, "replication": replication},
+            )
+            err = r.get("error")
+        except Exception as e:
+            err = str(e)
+        if err:
+            failed.append((url, err))
+        else:
+            ok.append(url)
+    if failed:
+        lines = [
+            f"volume {vid}: replication -> {rp} on {len(ok)}/{len(holders)} "
+            "server(s)"
+        ]
+        lines += [f"  FAILED {url}: {err}" for url, err in failed]
+        lines.append(
+            "  placement now DIVERGES across replicas; re-run "
+            "volume.configure.replication after fixing the failed servers: "
+            + ", ".join(url for url, _ in failed)
         )
-        if r.get("error"):
-            return f"configure on {url} failed: {r['error']}"
+        return "\n".join(lines)
     return (
         f"volume {vid}: replication -> {rp} on {len(holders)} server(s)"
     )

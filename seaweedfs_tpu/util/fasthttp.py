@@ -30,6 +30,9 @@ import asyncio
 from typing import Awaitable, Callable, Optional
 
 FALLBACK = object()  # sentinel: "proxy this request to the full app"
+DETACHED = object()  # sentinel: "the handler will write the response itself
+# (via req.transport) from a later callback" — used by batch continuations
+# so N coalesced responses cost one callback, not N task resumes
 
 _MAX_HEADER = 64 * 1024
 _MAX_BODY = 256 << 20  # matches the aiohttp client_max_size
@@ -55,7 +58,7 @@ class FastRequest:
     """One parsed request. Header names are lower-case byte strings."""
 
     __slots__ = ("method", "target", "path", "query", "headers", "body", "peer",
-                 "raw_head")
+                 "raw_head", "transport", "done")
 
     def __init__(self, method, target, headers, body, peer, raw_head):
         self.method = method  # str: "GET"
@@ -71,6 +74,23 @@ class FastRequest:
         else:
             self.path = target[:q]
             self.query = target[q + 1:]
+
+
+def finish_detached(req: FastRequest, response: bytes) -> None:
+    """Write a DETACHED request's response and release its connection's
+    request loop (see FastHTTPProtocol._run). Idempotent: a second call
+    for the same request is a no-op, never a second response on the
+    wire."""
+    d = req.done
+    if d is True or (d is not None and d is not True and d.done()):
+        return
+    t = req.transport
+    if t is not None and not t.is_closing():
+        t.write(response)
+    if d is None:
+        req.done = True
+    else:
+        d.set_result(None)
 
 
 def render_response(
@@ -189,7 +209,7 @@ class FastHTTPProtocol(asyncio.Protocol):
         if self._paused and len(buf) < _MAX_BODY:
             self._paused = False
             self.transport.resume_reading()
-        return FastRequest(
+        req = FastRequest(
             method.decode("latin1"),
             target.decode("latin1"),
             headers,
@@ -197,6 +217,9 @@ class FastHTTPProtocol(asyncio.Protocol):
             self.peer,
             head,
         )
+        req.transport = self.transport
+        req.done = None
+        return req
 
     def _fail(self, status: int):
         if self.transport is not None:
@@ -213,15 +236,33 @@ class FastHTTPProtocol(asyncio.Protocol):
 
     # -- request loop --
     async def _run(self):
+        detached_prev = None  # last DETACHED request, possibly in flight
         try:
             while True:
                 req = await self._queue.get()
                 if req is None or self._closed:
                     return
+                if detached_prev is not None:
+                    # a previous request's response is written from a later
+                    # callback; never start the next one before it lands
+                    # (pipelining clients would see reordered responses)
+                    if detached_prev.done is not True:
+                        if detached_prev.done is None:
+                            detached_prev.done = (
+                                asyncio.get_event_loop().create_future()
+                            )
+                        try:
+                            await detached_prev.done
+                        except Exception:
+                            pass
+                    detached_prev = None
                 try:
                     out = await self.server.handler(req)
                 except Exception:
                     out = None
+                if out is DETACHED:
+                    detached_prev = req
+                    continue
                 if out is FALLBACK:
                     ok = await self._proxy(req)
                     if not ok:
@@ -243,51 +284,66 @@ class FastHTTPProtocol(asyncio.Protocol):
                 self.transport.close()
 
     async def _proxy(self, req: FastRequest) -> bool:
-        """Replay the request against the internal full-featured listener
-        and relay the response. Connection: close on the backend leg keeps
-        framing trivial; the client-side connection stays keep-alive when
-        the backend response is well-formed with a Content-Length."""
-        backend = self.server.backend
-        if backend is None:
-            self.transport.write(
-                render_response(500, b'{"error":"no fallback app"}')
-            )
-            return True
-        try:
-            r, w = await asyncio.open_connection(*backend)
-            # rewrite Connection header to close on the backend leg
-            head = req.raw_head
-            # strip any connection header, append ours
-            lines = head.split(b"\r\n")
-            lines = [
-                ln for ln in lines[:-2]
-                if not ln.lower().startswith(b"connection:")
-            ]
-            lines.append(b"Connection: close")
-            w.write(b"\r\n".join(lines) + b"\r\n\r\n" + req.body)
-            await w.drain()
-            resp = await r.read(-1)  # backend closes when done
-            w.close()
-        except Exception:
-            self.transport.write(
-                render_response(500, b'{"error":"fallback proxy failed"}')
-            )
-            return True
-        if not resp:
-            self.transport.write(
-                render_response(500, b'{"error":"empty fallback response"}')
-            )
-            return True
-        # the backend replied Connection: close framing; if it declared a
-        # Content-Length we can keep our client connection alive, else we
-        # must close to delimit
-        head_end = resp.find(b"\r\n\r\n")
-        has_len = head_end > 0 and b"content-length:" in resp[:head_end].lower()
+        resp, has_len = await proxy_request(self.server.backend, req)
         self.transport.write(resp)
         if not has_len:
             self.transport.close()
             return False
         return True
+
+
+async def proxy_request(backend, req: FastRequest) -> tuple[bytes, bool]:
+    """Replay `req` verbatim against the internal full-featured listener.
+    -> (response_bytes, has_content_length). Connection: close on the
+    backend leg keeps framing trivial; callers keep their client-side
+    connection alive only when the response is Content-Length-framed."""
+    if backend is None:
+        return render_response(500, b'{"error":"no fallback app"}'), True
+    try:
+        r, w = await asyncio.open_connection(*backend)
+        # strip any connection header, pin close framing on the backend leg
+        lines = req.raw_head.split(b"\r\n")
+        lines = [
+            ln for ln in lines[:-2]
+            if not ln.lower().startswith(
+                (b"connection:", b"x-forwarded-for:")
+            )
+        ]
+        # the backend sees our loopback socket, not the client: carry the
+        # real peer so remote-address checks (whitelist, replicate
+        # membership) keep working — util.security.real_remote() trusts
+        # this header only on loopback-originated requests
+        lines.append(b"X-Forwarded-For: " + req.peer.encode("latin1"))
+        lines.append(b"Connection: close")
+        w.write(b"\r\n".join(lines) + b"\r\n\r\n" + req.body)
+        await w.drain()
+        resp = await r.read(-1)  # backend closes when done
+        w.close()
+    except Exception:
+        return render_response(500, b'{"error":"fallback proxy failed"}'), True
+    if not resp:
+        return (
+            render_response(500, b'{"error":"empty fallback response"}'),
+            True,
+        )
+    head_end = resp.find(b"\r\n\r\n")
+    has_len = head_end > 0 and b"content-length:" in resp[:head_end].lower()
+    return resp, has_len
+
+
+def finish_detached_proxy(server: "FastHTTPServer", req: FastRequest) -> None:
+    """From a DETACHED continuation that discovered it can't finish the
+    request after all: replay it against the full app asynchronously."""
+
+    async def run() -> None:
+        resp, has_len = await proxy_request(server.backend, req)
+        finish_detached(req, resp)
+        if not has_len and req.transport is not None:
+            req.transport.close()
+
+    t = asyncio.ensure_future(run())
+    server._detached_tasks.add(t)
+    t.add_done_callback(server._detached_tasks.discard)
 
 
 class FastHTTPServer:
@@ -299,6 +355,7 @@ class FastHTTPServer:
         self.backend = backend
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
+        self._detached_tasks: set = set()  # strong refs (loop holds weak)
 
     async def start(self, host: str, port: int):
         loop = asyncio.get_event_loop()

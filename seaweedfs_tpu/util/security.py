@@ -142,3 +142,21 @@ class Guard:
             return True
         except TokenError:
             return False
+
+
+def real_remote(request) -> str:
+    """The client address behind the fast-tier fallback proxy.
+
+    The byte-level data-plane front (util/fasthttp.py) replays cold
+    requests to the internal aiohttp listener over loopback, carrying the
+    original peer in X-Forwarded-For. Trust that header ONLY when the
+    direct peer is loopback (i.e. the proxy itself — anything local is
+    already inside the trust boundary); a remote client's spoofed header
+    is ignored.
+    """
+    remote = request.remote or ""
+    if remote in ("127.0.0.1", "::1"):
+        fwd = request.headers.get("X-Forwarded-For", "")
+        if fwd:
+            return fwd.split(",")[0].strip()
+    return remote

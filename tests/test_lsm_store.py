@@ -17,7 +17,13 @@ def test_wal_replay_after_crash(tmp_path):
     for i in range(10):
         s.insert_entry(_e(f"/a/f{i:02}", i))
     s.delete_entry("/a/f03")
-    # crash: no close(), no flush — only the WAL survives
+    # crash: no close(), no flush — only the WAL survives. Release the
+    # directory flock the way a dying process would (fd close), nothing
+    # else.
+    import os
+
+    os.close(s._lock_fd)
+    s._lock_fd = None
     del s
 
     s2 = LsmFilerStore(d, memtable_limit=1000)
@@ -129,4 +135,17 @@ def test_manifest_ignores_interrupted_compaction_leftovers(tmp_path):
     assert s2.find_entry("/m/gone") is None
     assert s2.find_entry("/m/live") is not None
     assert not os.path.exists(os.path.join(d, "seg-999.sst"))
+    s2.close()
+
+
+def test_directory_lock_excludes_second_opener(tmp_path):
+    import pytest
+
+    d = str(tmp_path / "lsm")
+    s = LsmFilerStore(d)
+    with pytest.raises(RuntimeError, match="locked"):
+        LsmFilerStore(d)
+    s.close()
+    # released on close: reopening now works
+    s2 = LsmFilerStore(d)
     s2.close()
