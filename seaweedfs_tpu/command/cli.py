@@ -412,6 +412,20 @@ def cmd_filer(argv: list[str]) -> int:
     p.add_argument("-replication", default="")
     p.add_argument("-jwtSigningKey", default="")
     p.add_argument(
+        "-peers",
+        default="",
+        help="comma-separated peer filers (host:port) whose metadata "
+        "streams this filer follows and aggregates (ref -peers, "
+        "weed/filer2/meta_aggregator.go)",
+    )
+    p.add_argument(
+        "-encryptVolumeData",
+        action="store_true",
+        help="encrypt chunk content before it reaches volume servers "
+        "(AES-256-GCM, per-chunk keys in entry metadata; ref filer "
+        "-encryptVolumeData)",
+    )
+    p.add_argument(
         "-notifySink",
         default="",
         choices=["", "none", "log", "memory", "broker", "webhook", "s3"],
@@ -451,6 +465,10 @@ def cmd_filer(argv: list[str]) -> int:
         collection=args.collection,
         replication=args.replication,
         jwt_signing_key=args.jwtSigningKey,
+        peers=tuple(
+            x.strip() for x in args.peers.split(",") if x.strip()
+        ),
+        cipher=args.encryptVolumeData,
     )
     print(f"filer listening on {args.ip}:{args.port}")
     asyncio.run(_run_forever(fs))
@@ -895,6 +913,12 @@ def cmd_mount(argv: list[str]) -> int:
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")
     p.add_argument("-chunkSizeLimitMB", type=int, default=4)
+    p.add_argument(
+        "-cipher",
+        action="store_true",
+        help="encrypt uploaded chunk content client-side (AES-256-GCM, "
+        "per-chunk keys in entry metadata; ref mount -cipher)",
+    )
     args = p.parse_args(argv)
     if not os.path.exists("/dev/fuse"):
         print("no /dev/fuse on this host — cannot mount", file=sys.stderr)
@@ -914,6 +938,7 @@ def cmd_mount(argv: list[str]) -> int:
             cache_size_mb=args.cacheSizeMB,
             collection=args.collection,
             replication=args.replication,
+            cipher=args.cipher,
         )
         await wfs.start()
         conn = await mount_and_serve(wfs, args.dir)

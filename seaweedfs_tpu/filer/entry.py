@@ -10,27 +10,42 @@ from dataclasses import dataclass, field
 class FileChunk:
     fid: str
     offset: int
-    size: int
+    size: int  # LOGICAL (plaintext) size; the stored needle may be larger
     mtime_ns: int = 0  # modification stamp deciding overwrite precedence
     etag: str = ""
+    # per-chunk AES-256-GCM key when the content is encrypted client-side
+    # (ref filer.proto FileChunk.cipher_key, upload_content.go:30); empty =
+    # plaintext chunk
+    cipher_key: bytes = b""
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "fid": self.fid,
             "offset": self.offset,
             "size": self.size,
             "mtime_ns": self.mtime_ns,
             "etag": self.etag,
         }
+        if self.cipher_key:
+            import base64
+
+            d["cipher_key"] = base64.b64encode(self.cipher_key).decode()
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "FileChunk":
+        ck = d.get("cipher_key") or b""
+        if isinstance(ck, str):
+            import base64
+
+            ck = base64.b64decode(ck)
         return FileChunk(
             fid=d["fid"],
             offset=int(d["offset"]),
             size=int(d["size"]),
             mtime_ns=int(d.get("mtime_ns", 0)),
             etag=d.get("etag", ""),
+            cipher_key=bytes(ck),
         )
 
 

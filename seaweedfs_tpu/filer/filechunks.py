@@ -36,6 +36,7 @@ class VisibleInterval:
     fid: str
     mtime_ns: int
     chunk_offset: int  # start of the owning chunk in the file
+    cipher_key: bytes = b""  # owning chunk's content key ('' = plaintext)
 
 
 def non_overlapping_visible_intervals(
@@ -59,7 +60,10 @@ def non_overlapping_visible_intervals(
         if winner is None:
             continue
         segments.append(
-            VisibleInterval(lo, hi, winner.fid, winner.mtime_ns, winner.offset)
+            VisibleInterval(
+                lo, hi, winner.fid, winner.mtime_ns, winner.offset,
+                winner.cipher_key,
+            )
         )
     # merge adjacent segments owned by the same chunk
     merged: list[VisibleInterval] = []
@@ -76,6 +80,7 @@ def non_overlapping_visible_intervals(
                 seg.fid,
                 seg.mtime_ns,
                 seg.chunk_offset,
+                seg.cipher_key,
             )
         else:
             merged.append(seg)
@@ -88,6 +93,7 @@ class ChunkView:
     offset_in_chunk: int  # where to start reading inside the chunk blob
     size: int
     logical_offset: int  # position in the file
+    cipher_key: bytes = b""
 
 
 def view_from_visibles(
@@ -107,6 +113,7 @@ def view_from_visibles(
                 offset_in_chunk=lo - v.chunk_offset,
                 size=hi - lo,
                 logical_offset=lo,
+                cipher_key=v.cipher_key,
             )
         )
     return views

@@ -74,15 +74,13 @@ class FileHandle:
         buf = bytearray(size)
         visibles = non_overlapping_visible_intervals(self.entry.chunks)
         chunk_sizes = {c.fid: c.size for c in self.entry.chunks}
-        needed = [
-            v.fid
-            for v in view_from_visibles(visibles, offset, size)
-        ]
         blobs = {}
-        for fid in needed:
-            if fid not in blobs:
-                blobs[fid] = await self.wfs.fetch_chunk(
-                    fid, chunk_sizes.get(fid, 0)
+        for view in view_from_visibles(visibles, offset, size):
+            if view.fid not in blobs:
+                blobs[view.fid] = await self.wfs.fetch_chunk(
+                    view.fid,
+                    chunk_sizes.get(view.fid, 0),
+                    view.cipher_key,
                 )
         committed = read_from_visible_intervals(
             visibles, blobs.__getitem__, offset, size
@@ -116,11 +114,16 @@ class WFS:
         cache_size_mb: int = 128,
         collection: str = "",
         replication: str = "",
+        cipher: bool = False,
     ):
         self.filer_address = filer_address
         self.chunk_size = chunk_size
         self.collection = collection
         self.replication = replication
+        # client-side chunk encryption (ref weed mount -cipher): uploads
+        # encrypt under fresh per-chunk keys; reads decrypt any chunk that
+        # carries a key, regardless of this flag
+        self.cipher = cipher
         self.stub = Stub(grpc_address(filer_address), "filer")
         self.meta_cache = MetaCache()
         self.chunk_cache = TieredChunkCache(
@@ -277,7 +280,9 @@ class WFS:
             await fh.flush()
 
     # ---- chunk IO (ref filehandle reads / wfs chunk cache) ----
-    async def fetch_chunk(self, fid: str, chunk_size: int = 0) -> bytes:
+    async def fetch_chunk(
+        self, fid: str, chunk_size: int = 0, cipher_key: bytes = b""
+    ) -> bytes:
         cached = self.chunk_cache.get(fid, chunk_size)
         if cached is not None:
             return cached
@@ -286,6 +291,11 @@ class WFS:
             if resp.status != 200:
                 raise OSError(f"fetch chunk {fid}: HTTP {resp.status}")
             data = await resp.read()
+        if cipher_key:
+            from ..util.cipher import decrypt
+
+            data = decrypt(data, cipher_key)
+        # the cache holds PLAINTEXT — keys never leave the entry metadata
         self.chunk_cache.set(fid, data)
         return data
 
@@ -317,8 +327,15 @@ class WFS:
         fid, url = resp["file_id"], resp["url"]
         from ..client.operation import upload_data
 
+        key = b""
+        payload = data
+        if self.cipher:
+            from ..util.cipher import encrypt, gen_cipher_key
+
+            key = gen_cipher_key()
+            payload = encrypt(data, key)
         result = await upload_data(
-            self._http, url, fid, data, jwt=resp.get("auth", "")
+            self._http, url, fid, payload, jwt=resp.get("auth", "")
         )
         self.chunk_cache.set(fid, data)
         import zlib
@@ -329,4 +346,5 @@ class WFS:
             size=len(data),
             mtime_ns=time.time_ns(),
             etag=result.get("eTag", "") or f"{zlib.crc32(data):08x}",
+            cipher_key=key,
         )

@@ -183,12 +183,76 @@ class IdentityAccessManagement:
                 return self._verify_signed_header(request_info, auth_header)
             if query.get("X-Amz-Algorithm") == ALGORITHM:
                 return self._verify_presigned(request_info)
+            if auth_header.startswith(SIGN_V2_ALGORITHM + " "):
+                return self._verify_v2_header(request_info, auth_header)
+            if (
+                "AWSAccessKeyId" in query
+                and "Signature" in query
+                and "Expires" in query
+            ):
+                return self._verify_v2_presigned(request_info)
         except AccessDenied:
             raise
         except (ValueError, KeyError, TypeError) as e:
             # client-controlled garbage must deny, not 500
             raise AccessDenied(f"malformed auth: {e}")
         raise AccessDenied("anonymous or unsupported auth")
+
+    def _v2_queries(self, ri: dict) -> list:
+        """Unescaped query parts in CLIENT order (ref unescapeQueries)."""
+        raw = ri.get("raw_query", "")
+        if not raw:
+            return []
+        return [urllib.parse.unquote(q) for q in raw.split("&")]
+
+    def _verify_v2_header(self, ri: dict, auth_header: str) -> Identity:
+        """'AWS AccessKeyId:Base64(HMAC-SHA1(...))' (ref
+        doesSignV2Match, auth_signature_v2.go:64-119)."""
+        fields = auth_header.split(" ", 1)
+        if len(fields) != 2 or ":" not in fields[1]:
+            raise AccessDenied("v2: missing fields")
+        access_key, _, got = fields[1].strip().partition(":")
+        ident, cred = self.lookup_access_key(access_key)
+        if ident is None:
+            raise AccessDenied(f"unknown access key {access_key!r}")
+        sts = _string_to_sign_v2(
+            ri["method"], ri["raw_path"], self._v2_queries(ri),
+            ri["headers"], "",
+        )
+        want = calculate_signature_v2(sts, cred.secret_key)
+        if not hmac.compare_digest(got, want):
+            raise AccessDenied("v2 signature mismatch")
+        return ident
+
+    def _verify_v2_presigned(self, ri: dict) -> Identity:
+        """Query-string auth: ?AWSAccessKeyId&Expires&Signature (ref
+        doesPresignV2SignatureMatch, auth_signature_v2.go:161-237)."""
+        filtered = []
+        access_key = got = expires = ""
+        for q in self._v2_queries(ri):
+            k, _, v = q.partition("=")
+            if k == "AWSAccessKeyId":
+                access_key = v
+            elif k == "Signature":
+                got = v
+            elif k == "Expires":
+                expires = v
+            else:
+                filtered.append(q)
+        if not (access_key and got and expires):
+            raise AccessDenied("v2 presign: missing query params")
+        ident, cred = self.lookup_access_key(access_key)
+        if ident is None:
+            raise AccessDenied(f"unknown access key {access_key!r}")
+        if int(expires) < int(time.time()):
+            raise AccessDenied("v2 presigned URL expired")
+        sts = _string_to_sign_v2(
+            ri["method"], ri["raw_path"], filtered, ri["headers"], expires
+        )
+        want = calculate_signature_v2(sts, cred.secret_key)
+        if not hmac.compare_digest(got, want):
+            raise AccessDenied("v2 presign signature mismatch")
+        return ident
 
     def _parse_credential(self, credential: str):
         """'AK/20230101/us-east-1/s3/aws4_request' -> parts."""
@@ -382,3 +446,113 @@ def presign_url(
     pairs.append(("X-Amz-Signature", sig))
     query = urllib.parse.urlencode(pairs, quote_via=urllib.parse.quote)
     return urllib.parse.urlunsplit((u.scheme, u.netloc, u.path, query, ""))
+
+
+# ---------------- Signature V2 (ref auth_signature_v2.go) ----------------
+
+SIGN_V2_ALGORITHM = "AWS"
+
+# subresources included in the V2 canonical resource, pre-sorted
+# (ref auth_signature_v2.go:30-61 resourceList)
+_RESOURCE_LIST_V2 = [
+    "acl", "delete", "lifecycle", "location", "logging", "notification",
+    "partNumber", "policy", "requestPayment", "response-cache-control",
+    "response-content-disposition", "response-content-encoding",
+    "response-content-language", "response-content-type",
+    "response-expires", "torrent", "uploadId", "uploads", "versionId",
+    "versioning", "versions", "website",
+]
+
+
+def _canonicalized_amz_headers_v2(headers) -> str:
+    """Sorted lowercase x-amz-* 'key:value' lines
+    (ref canonicalizedAmzHeadersV2)."""
+    amz = {}
+    for k in headers:
+        lk = k.lower()
+        if lk.startswith("x-amz-"):
+            vals = headers.getall(k) if hasattr(headers, "getall") else [
+                headers[k]
+            ]
+            amz[lk] = ",".join(vals)
+    return "\n".join(f"{k}:{amz[k]}" for k in sorted(amz))
+
+
+def _canonicalized_resource_v2(encoded_resource: str, queries: list) -> str:
+    """Resource plus any present signed subresources in resourceList order
+    (ref canonicalizedResourceV2)."""
+    keyval = {}
+    for q in queries:
+        k, _, v = q.partition("=")
+        keyval[k] = v
+    canon = []
+    for key in _RESOURCE_LIST_V2:
+        if key not in keyval:
+            continue
+        canon.append(f"{key}={keyval[key]}" if keyval[key] else key)
+    return (
+        encoded_resource + "?" + "&".join(canon) if canon else encoded_resource
+    )
+
+
+def _string_to_sign_v2(
+    method: str, encoded_resource: str, queries: list, headers, expires: str
+) -> str:
+    """ref getStringToSignV2: Verb\\nContent-MD5\\nContent-Type\\n
+    Date-or-Expires\\nCanonicalizedAmzHeaders + CanonicalizedResource."""
+    canonical_headers = _canonicalized_amz_headers_v2(headers)
+    if canonical_headers:
+        canonical_headers += "\n"
+    date = expires or headers.get("Date", "")
+    return "\n".join(
+        [
+            method,
+            headers.get("Content-MD5", ""),
+            headers.get("Content-Type", ""),
+            date,
+            canonical_headers,
+        ]
+    ) + _canonicalized_resource_v2(encoded_resource, queries)
+
+
+def calculate_signature_v2(string_to_sign: str, secret: str) -> str:
+    """Base64(HMAC-SHA1(secret, string_to_sign)) (ref
+    calculateSignatureV2)."""
+    import base64
+
+    return base64.b64encode(
+        hmac.new(secret.encode(), string_to_sign.encode(), hashlib.sha1)
+        .digest()
+    ).decode()
+
+
+def sign_request_v2(
+    method: str,
+    path: str,
+    query: str,
+    headers: dict,
+    access_key: str,
+    secret_key: str,
+) -> str:
+    """Client-side V2 signer -> Authorization header value
+    ('AWS AccessKeyId:Signature')."""
+    queries = [
+        urllib.parse.unquote(q) for q in query.split("&")
+    ] if query else []
+
+    class _H(dict):
+        def get(self, k, d=""):
+            for kk, vv in self.items():
+                if kk.lower() == k.lower():
+                    return vv
+            return d
+
+        def __iter__(self):
+            return iter(list(dict.keys(self)))
+
+    h = _H(headers)
+    sts = _string_to_sign_v2(method, path, queries, h, "")
+    return (
+        f"{SIGN_V2_ALGORITHM} {access_key}:"
+        f"{calculate_signature_v2(sts, secret_key)}"
+    )

@@ -123,8 +123,11 @@ class S3Server:
         """Verified Identity for the request, or raises AccessDenied.
         Reads the body only when the signed payload hash isn't in headers."""
         payload_hash = ""
-        if "Authorization" in request.headers and not request.headers.get(
-            "x-amz-content-sha256"
+        auth_header = request.headers.get("Authorization", "")
+        if (
+            auth_header
+            and not auth_header.startswith("AWS ")  # V2 never hashes bodies
+            and not request.headers.get("x-amz-content-sha256")
         ):
             import hashlib
 
@@ -134,6 +137,8 @@ class S3Server:
                 "method": request.method,
                 "raw_path": request.url.raw_path.partition("?")[0],
                 "query_pairs": [(k, v) for k, v in request.query.items()],
+                # V2 signatures canonicalize the query in CLIENT order
+                "raw_query": request.query_string,
                 "headers": request.headers,
                 "payload_hash": payload_hash,
             }
@@ -479,7 +484,9 @@ class S3Server:
         blobs = {}
         for view in view_from_visibles(visibles, offset, length):
             if view.fid not in blobs:
-                blobs[view.fid] = await self.fs._fetch_chunk(view.fid)
+                blobs[view.fid] = await self.fs._fetch_chunk(
+                    view.fid, view.cipher_key
+                )
         return read_from_visible_intervals(
             visibles, blobs.__getitem__, offset, length
         )
@@ -645,6 +652,7 @@ class S3Server:
                         size=c.size,
                         mtime_ns=c.mtime_ns,
                         etag=c.etag,
+                        cipher_key=c.cipher_key,
                     )
                 )
             offset += part.size()

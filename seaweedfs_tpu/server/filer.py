@@ -47,6 +47,8 @@ class FilerServer:
         replication: str = "",
         jwt_signing_key: str = "",
         notifier=None,
+        peers: tuple = (),
+        cipher: bool = False,
     ):
         self.master = master
         self.host = host
@@ -58,6 +60,9 @@ class FilerServer:
         # shared cluster key: chunk uploads carry the master-issued token,
         # and the GC deleter signs its own (ref security.toml jwt signing)
         self.jwt_signing_key = jwt_signing_key
+        # client-side chunk encryption (ref filer -encryptVolumeData):
+        # volume servers store only ciphertext; keys live in chunk metadata
+        self.cipher = cipher
         if not store_path:
             store = MemoryFilerStore()
         elif store_path.endswith(".flog"):
@@ -81,6 +86,21 @@ class FilerServer:
         self._http_runner: Optional[web.AppRunner] = None
         self._grpc_server = None
         self._session: Optional[aiohttp.ClientSession] = None
+        # peer filers: follow their local meta streams and merge into the
+        # aggregate log served by SubscribeMetadata
+        # (ref weed/filer2/meta_aggregator.go)
+        self.meta_aggregator = None
+        if peers:
+            from ..filer.meta_aggregator import MetaAggregator
+
+            self.meta_aggregator = MetaAggregator(
+                self.filer,
+                self.address,
+                list(peers),
+                offsets_path=(store_path + ".peers.json")
+                if store_path
+                else "",
+            )
 
     # ---------------- lifecycle ----------------
     async def start(self) -> None:
@@ -105,10 +125,16 @@ class FilerServer:
         svc.unary("Statistics")(self._grpc_statistics)
         svc.unary("GetFilerConfiguration")(self._grpc_configuration)
         svc.server_stream("SubscribeMetadata")(self._grpc_subscribe_metadata)
-        svc.server_stream("SubscribeLocalMetadata")(self._grpc_subscribe_metadata)
+        svc.server_stream("SubscribeLocalMetadata")(
+            self._grpc_subscribe_local_metadata
+        )
         self._grpc_server = await serve(grpc_address(self.address), svc)
+        if self.meta_aggregator is not None:
+            self.meta_aggregator.start()
 
     async def stop(self) -> None:
+        if self.meta_aggregator is not None:
+            await self.meta_aggregator.stop()
         if self._grpc_server is not None:
             await self._grpc_server.stop(0.5)
         if self._http_runner is not None:
@@ -150,23 +176,38 @@ class FilerServer:
                 pass
 
     # ---------------- chunk IO ----------------
-    async def _fetch_chunk(self, fid: str) -> bytes:
+    async def _fetch_chunk(self, fid: str, cipher_key: bytes = b"") -> bytes:
         url = await self.master_client.lookup_file_id_async(fid)
         async with self._session.get(url) as resp:
             if resp.status != 200:
                 raise IOError(f"chunk {fid}: status {resp.status}")
-            return await resp.read()
+            data = await resp.read()
+        if cipher_key:
+            from ..util.cipher import decrypt
+
+            data = decrypt(data, cipher_key)
+        return data
 
     async def _write_chunks(
         self, data: bytes, ttl: str = "", base_offset: int = 0
     ) -> list[FileChunk]:
         """Store data as chunk needles; base_offset shifts the logical
         chunk offsets (used when a caller streams a large object in
-        pieces, e.g. the S3 gateway's copy path)."""
+        pieces, e.g. the S3 gateway's copy path). With self.cipher, each
+        chunk is AES-256-GCM-encrypted under a fresh key carried in its
+        metadata (ref upload_content.go:135-150); chunk sizes/offsets stay
+        logical."""
         chunks = []
         now = time.time_ns()
         for offset in range(0, len(data), self.chunk_size):
             piece = data[offset : offset + self.chunk_size]
+            key = b""
+            payload = piece
+            if self.cipher:
+                from ..util.cipher import encrypt, gen_cipher_key
+
+                key = gen_cipher_key()
+                payload = encrypt(piece, key)
             ar = await assign(
                 self.master,
                 collection=self.collection,
@@ -174,7 +215,7 @@ class FilerServer:
                 ttl=ttl,
             )
             result = await upload_data(
-                self._session, ar.url, ar.fid, piece, ttl=ttl, jwt=ar.auth
+                self._session, ar.url, ar.fid, payload, ttl=ttl, jwt=ar.auth
             )
             chunks.append(
                 FileChunk(
@@ -183,6 +224,7 @@ class FilerServer:
                     size=len(piece),
                     mtime_ns=now,
                     etag=result.get("eTag", ""),
+                    cipher_key=key,
                 )
             )
         return chunks
@@ -235,7 +277,9 @@ class FilerServer:
             async def fetch_all():
                 for v in visibles:
                     if v.fid not in blobs:
-                        blobs[v.fid] = await self._fetch_chunk(v.fid)
+                        blobs[v.fid] = await self._fetch_chunk(
+                            v.fid, v.cipher_key
+                        )
 
             await fetch_all()
             body = read_from_visible_intervals(visibles, blobs.__getitem__, 0, size)
@@ -368,16 +412,33 @@ class FilerServer:
         return {"used_size": 0, "file_count": 0}
 
     async def _grpc_subscribe_metadata(self, req, context):
-        """Stream namespace change events from since_ns onward
-        (ref filer.proto:49-53 SubscribeMetadata, command/watch.go)."""
+        """Stream namespace change events from since_ns onward — the
+        AGGREGATE stream (this filer + followed peers) when peers are
+        configured (ref filer.proto:49-53 SubscribeMetadata,
+        filer_grpc_server_sub_meta.go serving the MetaAggregator buffer)."""
+        log = (
+            self.meta_aggregator.log
+            if self.meta_aggregator is not None
+            else self.filer.meta_log
+        )
+        async for out in self._subscribe(log, req):
+            yield out
+
+    async def _grpc_subscribe_local_metadata(self, req, context):
+        """Stream only THIS filer's own changes — what peer aggregators
+        follow (ref SubscribeLocalMetadata, meta_aggregator.go:100)."""
+        async for out in self._subscribe(self.filer.meta_log, req):
+            yield out
+
+    async def _subscribe(self, log, req):
         since_ns = int(req.get("since_ns", 0))
         if since_ns < 0:
             # "from now" anchored to the server-side event sequence: a skewed
             # client clock can neither drop fresh events nor replay stale
             # ones, and any event appended after this point has ts > anchor
-            since_ns = self.filer.meta_log.last_ts_ns
+            since_ns = log.last_ts_ns
         prefix = req.get("path_prefix", "/") or "/"
-        async for ev in self.filer.meta_log.subscribe(since_ns, prefix):
+        async for ev in log.subscribe(since_ns, prefix):
             yield ev.to_dict()
 
     async def _grpc_configuration(self, req, context) -> dict:

@@ -50,6 +50,10 @@ class WebDavServer:
         self.port = port
         self.address = f"{host}:{port}"
         self._http_runner: Optional[web.AppRunner] = None
+        # class-2 locking (ref webdav_server.go:59 webdav.NewMemLS())
+        from .webdav_lock import MemLockSystem
+
+        self.locks = MemLockSystem()
 
     async def start(self) -> None:
         app = web.Application(client_max_size=1024 << 20)
@@ -69,10 +73,31 @@ class WebDavServer:
         if method == "OPTIONS":
             return web.Response(
                 headers={
-                    "DAV": "1",
-                    "Allow": "OPTIONS, PROPFIND, GET, HEAD, PUT, DELETE, MKCOL, MOVE, COPY",
+                    "DAV": "1, 2",
+                    "Allow": "OPTIONS, PROPFIND, GET, HEAD, PUT, DELETE, "
+                    "MKCOL, MOVE, COPY, LOCK, UNLOCK",
                 }
             )
+        if method == "LOCK":
+            return await self._lock(request, path)
+        if method == "UNLOCK":
+            return self._unlock(request, path)
+        # mutations must pass the lock gate (RFC 4918 §7; the reference
+        # gets this from x/net/webdav's confirm() wrapper). COPY only
+        # reads its source, so it is gated on the DESTINATION alone;
+        # MOVE mutates both ends.
+        if method in ("PUT", "DELETE", "MKCOL", "MOVE"):
+            if not self.locks.confirm(
+                path, request.headers.get("If", "")
+            ):
+                return web.Response(status=423)  # Locked
+        if method in ("MOVE", "COPY"):
+            dest_header = request.headers.get("Destination", "")
+            dest = "/" + unquote(urlparse(dest_header).path).strip("/")
+            if dest_header and not self.locks.confirm(
+                dest, request.headers.get("If", "")
+            ):
+                return web.Response(status=423)
         if method == "PROPFIND":
             return await self._propfind(request, path)
         if method in ("GET", "HEAD"):
@@ -92,6 +117,67 @@ class WebDavServer:
         if method in ("MOVE", "COPY"):
             return await self._move_copy(request, path, copy=method == "COPY")
         return web.Response(status=405)
+
+    # ---------------- class-2 locking ----------------
+    async def _lock(self, request: web.Request, path: str) -> web.Response:
+        body = await request.read()
+        timeout = self.locks.parse_timeout(request.headers.get("Timeout", ""))
+        if not body:
+            # refresh (RFC 4918 §9.10.2): empty body + If carrying a token
+            token = self.locks.lock_token_header(
+                request.headers.get("If", "")
+            ).strip("()")
+            lk = self.locks.refresh(path, token.strip("<>"), timeout)
+            if lk is None:
+                return web.Response(status=412)
+            return self._lock_response(lk, created=False)
+        owner = ""
+        try:
+            root = ET.fromstring(body)
+            owner_el = root.find(f"{{{_DAV}}}owner")
+            if owner_el is not None:
+                owner = "".join(
+                    ET.tostring(c, encoding="unicode") for c in owner_el
+                ) or (owner_el.text or "")
+        except ET.ParseError:
+            return web.Response(status=400)
+        depth_inf = request.headers.get("Depth", "infinity") != "0"
+        lk = self.locks.lock(
+            path, owner, timeout=timeout, depth_infinity=depth_inf
+        )
+        if lk is None:
+            return web.Response(status=423)
+        # locking an unmapped URL creates an empty resource (RFC 4918
+        # §9.10.4 lock-null); macOS clients LOCK before first PUT
+        created = False
+        if self.filer.find_entry(path) is None:
+            self.filer.touch(path, "", [])
+            created = True
+        return self._lock_response(lk, created=created)
+
+    def _lock_response(self, lk, created: bool) -> web.Response:
+        xml = (
+            '<?xml version="1.0" encoding="utf-8"?>'
+            '<D:prop xmlns:D="DAV:"><D:lockdiscovery>'
+            + self.locks.active_lock_xml(lk)
+            + "</D:lockdiscovery></D:prop>"
+        )
+        return web.Response(
+            status=201 if created else 200,
+            body=xml.encode(),
+            content_type="application/xml",
+            headers={"Lock-Token": f"<{lk.token}>"},
+        )
+
+    def _unlock(self, request: web.Request, path: str) -> web.Response:
+        token = self.locks.lock_token_header(
+            request.headers.get("Lock-Token", "")
+        )
+        if not token:
+            return web.Response(status=400)
+        if not self.locks.unlock(path, token):
+            return web.Response(status=409)
+        return web.Response(status=204)
 
     async def _propfind(self, request: web.Request, path: str) -> web.Response:
         entry = self.filer.find_entry(path)
@@ -119,7 +205,9 @@ class WebDavServer:
         blobs = {}
         for v in visibles:
             if v.fid not in blobs:
-                blobs[v.fid] = await self.fs._fetch_chunk(v.fid)
+                blobs[v.fid] = await self.fs._fetch_chunk(
+                    v.fid, v.cipher_key
+                )
         body = read_from_visible_intervals(visibles, blobs.__getitem__, 0, size)
         return web.Response(
             body=body, content_type=entry.attr.mime or "application/octet-stream"

@@ -1,0 +1,49 @@
+"""Client-side chunk content encryption.
+
+Mirrors the reference's util/cipher.go: AES-256-GCM with a fresh random
+32-byte key per chunk and the 12-byte nonce prefixed to the ciphertext
+(ref: weed/util/cipher.go:15-60; used by the upload path
+weed/operation/upload_content.go:30,66-95 with the key carried in the
+chunk metadata, and decrypted on the filer/mount read path). The volume
+server only ever sees ciphertext; possession of the filer metadata is
+what grants plaintext access.
+"""
+
+from __future__ import annotations
+
+import os
+
+_NONCE_SIZE = 12  # GCM standard nonce
+
+
+def _aesgcm(key: bytes):
+    try:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    except ImportError as e:  # pragma: no cover - baked into this image
+        raise RuntimeError(
+            "content cipher requires the 'cryptography' package"
+        ) from e
+    return AESGCM(key)
+
+
+def gen_cipher_key() -> bytes:
+    """Fresh random 256-bit chunk key (ref GenCipherKey)."""
+    return os.urandom(32)
+
+
+def encrypt(plaintext: bytes, key: bytes) -> bytes:
+    """nonce || AES-256-GCM(ciphertext+tag) (ref Encrypt)."""
+    nonce = os.urandom(_NONCE_SIZE)
+    return nonce + _aesgcm(key).encrypt(nonce, bytes(plaintext), None)
+
+
+def decrypt(ciphertext: bytes, key: bytes) -> bytes:
+    """Inverse of encrypt (ref Decrypt); raises ValueError on a short
+    buffer or authentication failure."""
+    if len(ciphertext) < _NONCE_SIZE:
+        raise ValueError("ciphertext too short")
+    nonce, body = ciphertext[:_NONCE_SIZE], ciphertext[_NONCE_SIZE:]
+    try:
+        return _aesgcm(key).decrypt(nonce, bytes(body), None)
+    except Exception as e:
+        raise ValueError(f"chunk decrypt failed: {e}") from e

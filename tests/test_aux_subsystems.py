@@ -203,3 +203,117 @@ def test_messaging_broker():
             await broker.stop()
 
     asyncio.run(body())
+
+
+def test_webdav_class2_locks(tmp_path):
+    """macOS/Windows-native write sequence: OPTIONS advertises class 2,
+    LOCK -> PUT (with token) -> UNLOCK; writes without the token are 423
+    (ref webdav_server.go:59 webdav.NewMemLS())."""
+
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        from seaweedfs_tpu.server.filer import FilerServer
+        from seaweedfs_tpu.server.webdav import WebDavServer
+
+        fs = FilerServer(master=cluster.master.address, port=free_port_pair())
+        await fs.start()
+        dav = WebDavServer(fs, port=free_port_pair())
+        await dav.start()
+        try:
+            await fs.master_client.wait_connected()
+            base = f"http://{dav.address}"
+            lockinfo = (
+                '<?xml version="1.0" encoding="utf-8"?>'
+                '<D:lockinfo xmlns:D="DAV:">'
+                "<D:lockscope><D:exclusive/></D:lockscope>"
+                "<D:locktype><D:write/></D:locktype>"
+                "<D:owner>finder</D:owner></D:lockinfo>"
+            )
+            async with aiohttp.ClientSession() as session:
+                async with session.options(base + "/") as resp:
+                    assert "2" in resp.headers.get("DAV", "")
+                    assert "LOCK" in resp.headers.get("Allow", "")
+
+                # LOCK an unmapped URL: creates empty resource + 201
+                async with session.request(
+                    "LOCK",
+                    f"{base}/doc.txt",
+                    data=lockinfo,
+                    headers={"Timeout": "Second-600"},
+                ) as resp:
+                    assert resp.status == 201
+                    token = resp.headers["Lock-Token"].strip("<>")
+                    body_text = await resp.text()
+                    assert "lockdiscovery" in body_text
+                    assert token in body_text
+
+                # write WITHOUT the token -> 423 Locked
+                async with session.put(
+                    f"{base}/doc.txt", data=b"no token"
+                ) as resp:
+                    assert resp.status == 423
+
+                # write WITH the token (If header) succeeds
+                async with session.put(
+                    f"{base}/doc.txt",
+                    data=b"locked write",
+                    headers={"If": f"(<{token}>)"},
+                ) as resp:
+                    assert resp.status == 201
+
+                # refresh: empty-body LOCK carrying the token
+                async with session.request(
+                    "LOCK",
+                    f"{base}/doc.txt",
+                    headers={
+                        "If": f"(<{token}>)",
+                        "Timeout": "Second-1200",
+                    },
+                ) as resp:
+                    assert resp.status == 200
+
+                # a second client cannot lock it
+                async with session.request(
+                    "LOCK", f"{base}/doc.txt", data=lockinfo
+                ) as resp:
+                    assert resp.status == 423
+
+                # UNLOCK, then plain writes flow again
+                async with session.request(
+                    "UNLOCK",
+                    f"{base}/doc.txt",
+                    headers={"Lock-Token": f"<{token}>"},
+                ) as resp:
+                    assert resp.status == 204
+                async with session.put(
+                    f"{base}/doc.txt", data=b"free again"
+                ) as resp:
+                    assert resp.status == 201
+                async with session.get(f"{base}/doc.txt") as resp:
+                    assert await resp.read() == b"free again"
+
+                # depth-infinity lock on a collection covers children
+                async with session.request("MKCOL", f"{base}/dir") as resp:
+                    assert resp.status == 201
+                async with session.request(
+                    "LOCK", f"{base}/dir", data=lockinfo
+                ) as resp:
+                    assert resp.status == 200
+                    dtoken = resp.headers["Lock-Token"].strip("<>")
+                async with session.put(
+                    f"{base}/dir/child.txt", data=b"x"
+                ) as resp:
+                    assert resp.status == 423
+                async with session.put(
+                    f"{base}/dir/child.txt",
+                    data=b"x",
+                    headers={"If": f"(<{dtoken}>)"},
+                ) as resp:
+                    assert resp.status == 201
+        finally:
+            await dav.stop()
+            await fs.stop()
+            await cluster.stop()
+
+    asyncio.run(body())

@@ -200,3 +200,73 @@ def test_update_entry_emits_event():
     events = filer.meta_log.read_since(mark, "/u")
     assert [ev.event_type for ev in events] == ["update"]
     assert events[0].new_entry["extended"] == {"k": "v"}
+
+
+def test_meta_aggregator_two_filers(tmp_path):
+    """Peer aggregation (ref weed/filer2/meta_aggregator.go): an entry
+    created on filer A (1) streams out of B's aggregate SubscribeMetadata
+    and (2) is replayed into B's own store, so the two embedded stores
+    converge; B's SubscribeLocalMetadata stays A-silent (no echo loop)."""
+    from test_cluster import Cluster, free_port_pair
+
+    async def body():
+        from seaweedfs_tpu.pb import grpc_address
+        from seaweedfs_tpu.pb.rpc import Stub
+        from seaweedfs_tpu.server.filer import FilerServer
+
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        fa = FilerServer(master=cluster.master.address, port=free_port_pair())
+        await fa.start()
+        fb = FilerServer(
+            master=cluster.master.address,
+            port=free_port_pair(),
+            peers=(fa.address,),
+            store_path=str(tmp_path / "b.lsm"),
+        )
+        await fb.start()
+        try:
+            await fa.master_client.wait_connected()
+            events = []
+
+            async def consume():
+                stub = Stub(grpc_address(fb.address), "filer")
+                async for msg in stub.server_stream(
+                    "SubscribeMetadata",
+                    {"client_name": "t", "path_prefix": "/agg", "since_ns": 0},
+                    timeout=15,
+                ):
+                    events.append(msg)
+                    return
+
+            task = asyncio.ensure_future(consume())
+            await asyncio.sleep(0.3)
+            from seaweedfs_tpu.filer.entry import Attr, Entry
+
+            fa.filer.create_entry(
+                Entry(
+                    full_path="/agg/from-a.txt",
+                    attr=Attr(mtime=1.0, mode=0o644),
+                )
+            )
+            await asyncio.wait_for(task, timeout=15)
+            assert events and events[0]["event_notification"][
+                "new_entry"
+            ]["full_path"] == "/agg/from-a.txt"
+
+            # replay: B's own store converges on A's entry
+            for _ in range(100):
+                if fb.filer.find_entry("/agg/from-a.txt") is not None:
+                    break
+                await asyncio.sleep(0.05)
+            assert fb.filer.find_entry("/agg/from-a.txt") is not None
+
+            # and B's LOCAL stream never carries A's event (echo guard)
+            local = fb.filer.meta_log.read_since(0, "/agg")
+            assert local == []
+        finally:
+            await fb.stop()
+            await fa.stop()
+            await cluster.stop()
+
+    asyncio.run(body())
