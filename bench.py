@@ -107,6 +107,93 @@ def measure_tpu(parity_matrix, packed_np: np.ndarray) -> float:
     return n_bytes / _slope_time(run) / 1e9
 
 
+def measure_multi_device(
+    n_volumes: int = 64, shard_bytes: int = 128 << 10
+) -> dict:
+    """Device-side multi-volume batching (BASELINE.json config 3's core
+    claim): encoding V volumes as ONE wide [10, V*W] dispatch — GF columns
+    are independent, so concatenating volumes along the stripe axis is
+    byte-exact (the same trick write_ec_files_multi's device path uses) —
+    vs V separate [10, W] dispatches of the same kernel. HBM-resident both
+    ways; slope-timed. The default shape is the launch-bound regime
+    (many small volumes — the EC small-block world) where batching is
+    the difference between ~3 and ~65+ GB/s; at >=20MB per dispatch the
+    per-volume leg already amortizes launches and batching is ~1x.
+    (A vmapped [V,10,W] formulation was measured ~2x SLOWER than either
+    — vmap tiles the kernel worse — and a sliced `packed[v]` per-volume
+    leg pays a hidden gather dispatch per volume; both pitfalls are
+    avoided here.)"""
+    import jax
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ops.gf256 import gf_matmul_packed, pack_bytes_host
+    from seaweedfs_tpu.storage.erasure_coding.galois import build_matrix
+
+    parity_matrix = build_matrix(10, 14)[10:]
+    rng = np.random.default_rng(7)
+    data = rng.integers(
+        0, 256, size=(n_volumes, 10, shard_bytes), dtype=np.uint8
+    )
+    packed_np = np.stack([pack_bytes_host(v) for v in data])
+    # volumes side by side along the packed-word axis: one wide dispatch
+    wide_np = np.concatenate(list(packed_np), axis=1)
+    wide_dev = jax.device_put(jnp.asarray(wide_np))
+    n_bytes = packed_np.size * 4
+
+    one = jax.jit(lambda p: gf_matmul_packed(parity_matrix, p))
+    digest = jax.jit(lambda x: x.sum(dtype=jnp.uint32))
+
+    _ = np.asarray(digest(one(wide_dev)))  # compile + warm (wide shape)
+    vols = [
+        jax.device_put(jnp.asarray(packed_np[v])) for v in range(n_volumes)
+    ]
+    _ = np.asarray(digest(one(vols[0])))  # compile + warm (narrow shape)
+
+    def run_wide(k: int) -> float:
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(k):
+            out = one(wide_dev)
+        _ = np.asarray(digest(out))
+        return time.perf_counter() - t0
+
+    def run_seq(k: int) -> float:
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(k):
+            for v in vols:
+                out = one(v)
+        _ = np.asarray(digest(out))
+        return time.perf_counter() - t0
+
+    wide_gbps = n_bytes / _slope_time(run_wide) / 1e9
+    seq_gbps = n_bytes / _slope_time(run_seq) / 1e9
+    return {
+        "n_volumes": n_volumes,
+        "bytes": n_bytes,
+        "wide_gbps": round(wide_gbps, 3),
+        "per_volume_dispatch_gbps": round(seq_gbps, 3),
+        "batch_speedup": round(wide_gbps / max(seq_gbps, 1e-9), 2),
+    }
+
+
+def measure_memcpy_roofline(size_mb: int = 256) -> float:
+    """Host one-way memcpy GB/s — the bandwidth roofline every host-side
+    e2e pipeline divides into (read + data write + parity write per
+    source byte)."""
+    a = np.random.default_rng(3).integers(
+        0, 256, size_mb << 20, dtype=np.uint8
+    )
+    b = np.empty_like(a)
+    b[:] = a  # fault pages
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        b[:] = a
+        best = min(best, time.perf_counter() - t0)
+    return len(a) / best / 1e9
+
+
 def _slope_time(run, k_lo: int = 8, k_hi: int = 64, reps: int = 5) -> float:
     """Per-iteration seconds from the K-run slope (cancels constant RTT)."""
     run(2)  # warm the pull path
@@ -386,6 +473,12 @@ def measure_encode_e2e(size_bytes: int = 4 << 30, emit=None):
                 if emit:
                     emit(result)
         _rm_shards(base)
+        try:
+            # bandwidth context for the ratio (formatted by _e2e_results);
+            # measured here so it stays inside the e2e timebox accounting
+            result["host_memcpy_gbps"] = round(measure_memcpy_roofline(), 2)
+        except Exception:
+            pass
 
         # --- device pipeline (always measured, even when transfer-bound;
         # smaller cap so a slow tunnel can't eat the whole timebox) ---
@@ -752,21 +845,33 @@ def _e2e_results(r: dict) -> list:
             }
         )
     if "best_gbps" in r:
-        out.append(
-            {
-                "metric": "ec.encode.e2e.best",
-                "value": round(r["best_gbps"], 3),
-                "unit": "GB/s",
-                "vs_baseline": round(r["best_gbps"] / ref, 2) if ref else None,
-                "shards_byte_identical": r.get("best_parity"),
-                "backend": r.get("best_backend"),
-                "baseline_gbps": round(ref, 3) if ref else None,
-                "size_bytes": r.get("size_bytes"),
-                "tmpfs": r.get("tmpfs"),
-                "note": "shipping adaptive route (tpu/coder.adaptive_codec) "
-                "vs the reference-structure single-thread 256KB pipeline",
-            }
-        )
+        entry = {
+            "metric": "ec.encode.e2e.best",
+            "value": round(r["best_gbps"], 3),
+            "unit": "GB/s",
+            "vs_baseline": round(r["best_gbps"] / ref, 2) if ref else None,
+            "shards_byte_identical": r.get("best_parity"),
+            "backend": r.get("best_backend"),
+            "baseline_gbps": round(ref, 3) if ref else None,
+            "size_bytes": r.get("size_bytes"),
+            "tmpfs": r.get("tmpfs"),
+            "note": "shipping adaptive route (tpu/coder.adaptive_codec) "
+            "vs the reference-structure single-thread 256KB pipeline",
+        }
+        # bandwidth context: memcpy/best = how many memcpy-equivalents of
+        # work the route spends per source byte (a memcpy itself moves
+        # each byte over the bus twice, so the floor for a pipeline that
+        # reads the source once and materializes 1.4 bytes of shards is
+        # ~1.2 memcpy-equivalents). Values near the floor mean the route
+        # is memory-bandwidth-bound on this host, not compute- or
+        # structure-bound. Measured inside measure_encode_e2e's timebox.
+        mem = r.get("host_memcpy_gbps")
+        if mem:
+            entry["host_memcpy_gbps"] = mem
+            entry["memcpy_equiv_per_byte"] = round(
+                mem / max(r["best_gbps"], 1e-9), 2
+            )
+        out.append(entry)
     return out
 
 
@@ -1038,6 +1143,36 @@ def main() -> None:
         pass
     except Exception as e:
         extra.append({"metric": "ec.encode.multi", "error": str(e)[:200]})
+
+    try:
+        if not budgeted("ec.encode.multi.device", 60):
+            raise _Skip()
+        md = measure_multi_device(
+            n_volumes=int(os.environ.get("BENCH_MULTI_DEV_VOLS", 64))
+        )
+        extra.append(
+            {
+                "metric": "ec.encode.multi.device",
+                "value": md["wide_gbps"],
+                "unit": "GB/s",
+                # the batch dimension's win: one wide dispatch vs V
+                # per-volume dispatches of the same kernel
+                "vs_baseline": md["batch_speedup"],
+                "detail": md,
+                "note": f"{md['n_volumes']} small volumes as ONE wide "
+                "[10, V*W] device dispatch vs per-volume dispatches "
+                "(BASELINE config 3's batch dimension in the launch-bound "
+                "small-volume regime; HBM-resident, slope-timed; at "
+                ">=20MB/dispatch batching is ~1x because launches already "
+                "amortize)",
+            }
+        )
+    except _Skip:
+        pass
+    except Exception as e:
+        extra.append(
+            {"metric": "ec.encode.multi.device", "error": str(e)[:200]}
+        )
 
     if budgeted("ec.encode.e2e", 45):
         extra.extend(_run_e2e_timeboxed(time_left=remaining()))

@@ -19,6 +19,7 @@ unit shipped to the TPU.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Optional
 
 import numpy as np
@@ -254,51 +255,112 @@ def _fs_type_of(path: str) -> str:
         return ""  # unparsable mount table: let the splice heuristic pass
 
 
-_ONEPASS_VIABLE: Optional[bool] = None
+_HOST_ROUTE: Optional[str] = None
+_ROUTE_LOCK = threading.Lock()
+_CALIBRATING = False
 
 
-def _onepass_viable() -> bool:
-    """One-time probe: is faulting fresh writable pages in fast enough for
-    the mmapped-output one-pass encoder to beat the write() path?
+def _calibrate_host_route(codec) -> Optional[str]:
+    """Race the host encode structures once per process and remember the
+    winner: 'onepass' (fused NT-store mmap outputs), 'mmap' (zero-copy
+    mmapped source + write() outputs), or 'sync' (pread + write()).
 
-    The fused encoder stores through output mmaps, so every fresh page costs
-    a fault + zero-fill; write() instead takes the kernel's buffered fast
-    path (large folios, no per-page fault). On bare metal both run at
-    memory speed, but some hypervisors lazy-allocate guest memory and
-    page-population crawls (measured 0.37 GB/s on this class of VM vs
-    7.75 GB/s for write()-style population). Probe 4MB of anonymous mapping
-    with MADV_POPULATE_WRITE (value 23; pre-5.14 kernels reject it and we
-    fall back to touching pages) and require ≥1.5 GB/s."""
-    global _ONEPASS_VIABLE
-    if _ONEPASS_VIABLE is not None:
-        return _ONEPASS_VIABLE
-    import ctypes
-    import mmap as mmap_mod
+    Why measure instead of infer: the ranking is hardware-dependent in
+    ways no cheap probe predicts — on bare metal the one-pass route's
+    halved memory traffic wins; on hypervisors with a slow guest fault
+    path, anything mmap-backed degrades (measured 0.37-5 GB/s page
+    population ON THE SAME VM depending on load) while pread stays flat.
+    One ~100MB interleaved race (<1s, cached for the process) picks
+    reliably where a point probe flip-flops. Serialized by a lock so
+    write_ec_files_multi's thread pool cannot run N contending races and
+    cache a contention-skewed winner; returns None (caller defaults to
+    plain flags) from a re-entrant call — the race's own legs must not
+    re-calibrate."""
+    global _HOST_ROUTE, _CALIBRATING
+    if _HOST_ROUTE is not None:
+        return _HOST_ROUTE
+    if _CALIBRATING:
+        return None  # a calibration leg re-entered (e.g. onepass's own
+        # mmap-flag resolution): run with plain defaults
+    with _ROUTE_LOCK:
+        if _HOST_ROUTE is not None:
+            return _HOST_ROUTE
+        _CALIBRATING = True
+        try:
+            return _run_route_race(codec)
+        finally:
+            _CALIBRATING = False
+
+
+def _run_route_race(codec) -> str:
+    global _HOST_ROUTE
+    import shutil
+    import tempfile
     import time
 
-    size = 4 << 20
-    try:
-        mm = mmap_mod.mmap(-1, size)
-        addr = ctypes.addressof(ctypes.c_char.from_buffer(mm))
-        t0 = time.perf_counter()
-        rc = -1
+    from ... import native
+
+    size = 96 << 20
+    # peak usage: the .dat + one route's full shard set
+    needed = size * 5 // 2
+    use_dir = None
+    if os.path.isdir("/dev/shm"):
         try:
-            libc = ctypes.CDLL(None, use_errno=True)
-            rc = libc.madvise(
-                ctypes.c_void_p(addr), ctypes.c_size_t(size), 23
-            )
-        except (OSError, AttributeError):
+            if shutil.disk_usage("/dev/shm").free >= needed:
+                use_dir = "/dev/shm"
+        except OSError:
             pass
-        if rc != 0:  # no MADV_POPULATE_WRITE: touch a byte per page
-            step = mmap_mod.PAGESIZE
-            for off in range(0, size, step):
-                mm[off] = 1
-        dt = time.perf_counter() - t0
-        mm.close()
-        _ONEPASS_VIABLE = size / max(dt, 1e-9) >= 1.5e9
-    except (OSError, ValueError, BufferError):
-        _ONEPASS_VIABLE = False
-    return _ONEPASS_VIABLE
+    if use_dir is None:
+        # constrained /dev/shm (e.g. Docker's 64MB default): race on the
+        # default tmp dir instead of silently pinning a slow route
+        try:
+            if shutil.disk_usage(tempfile.gettempdir()).free < needed:
+                size = 16 << 20  # still measure, just smaller
+        except OSError:
+            pass
+    # each leg runs exactly the structure production would (splice left to
+    # its own try-and-fall-back default, so spliced shards count for the
+    # routes that can splice)
+    routes = {
+        "sync": dict(pipeline=False, mmap_input=False, onepass=False),
+        "mmap": dict(pipeline=False, mmap_input=True, onepass=False),
+    }
+    if native.encode_copy_available():
+        routes["onepass"] = dict(onepass=True)
+    d = None
+    try:
+        d = tempfile.mkdtemp(prefix="ec_route_cal_", dir=use_dir)
+        base = os.path.join(d, "c")
+        block = b"\xa5\x5a\xc3" * (1 << 20)
+        with open(base + ".dat", "wb") as f:
+            left = size
+            while left > 0:
+                f.write(block[: min(left, len(block))])
+                left -= len(block)
+        best = ("sync", 0.0)
+        names = list(routes)
+        for rep in range(2):
+            for name in names if rep % 2 == 0 else names[::-1]:
+                for i in range(codec.total_shards):
+                    try:
+                        os.remove(base + to_ext(i))
+                    except OSError:
+                        pass
+                t0 = time.perf_counter()
+                try:
+                    write_ec_files(base, codec=codec, **routes[name])
+                except Exception:
+                    continue
+                g = size / max(time.perf_counter() - t0, 1e-9)
+                if g > best[1]:
+                    best = (name, g)
+        _HOST_ROUTE = best[0]
+    except Exception:
+        _HOST_ROUTE = "sync"
+    finally:
+        if d is not None:
+            shutil.rmtree(d, ignore_errors=True)
+    return _HOST_ROUTE
 
 
 def _encode_onepass(
@@ -311,7 +373,6 @@ def _encode_onepass(
     n_small: int,
     small_block: int,
     chunk: int = 4 * 1024 * 1024,
-    force: bool = False,
 ) -> bool:
     """Fused single-pass encode: ONE streaming read of the .dat produces all
     14 shards — each 64-byte column is copied to its data-shard file AND
@@ -339,8 +400,6 @@ def _encode_onepass(
     if p > 8 or k > 32:
         # the C kernel's register blocking caps the fused path (gf256.cpp
         # kRowBlock / mats[]); wider geometries take the split paths
-        return False
-    if not force and not _onepass_viable():
         return False
     matrix = np.ascontiguousarray(codec.parity_matrix, dtype=np.uint8)
     shard_size = n_large * large_block + n_small * small_block
@@ -563,21 +622,30 @@ def write_ec_files(
     all 14 shards in one sweep) when nothing else was explicitly
     configured; True forces the attempt, False disables it. Falls back to
     the split paths when the fused kernel is unavailable.
+
+    With everything left at None on a zero-copy host codec, the structure
+    (onepass vs mmap vs pread) is picked by a one-time measured race on
+    this host (_calibrate_host_route) — the ranking is
+    hardware-dependent and point probes proved unreliable.
     """
     codec = _get_codec(codec)
-    onepass_forced = onepass is True
-    if onepass is None:
-        onepass = (
-            pipeline is None
-            and splice_data is None
-            and mmap_input is None
-            and getattr(codec, "zero_copy_rows", False)
-        )
+    # structure flags left None = "pick for me", resolved PER FLAG from
+    # the calibrated route — an explicit pipeline=False or splice_data
+    # (e.g. write_ec_files_multi's per-volume host path) still gets the
+    # calibrated structure for the flags it didn't set
     if pipeline is None:
         pipeline = getattr(codec, "prefers_pipeline", False)
-    # zero-copy views of the mmapped .dat: the single-core host structure
+    route = None
+    if (
+        (mmap_input is None or onepass is None)
+        and not pipeline
+        and getattr(codec, "zero_copy_rows", False)
+    ):
+        route = _calibrate_host_route(codec)
+    if onepass is None:
+        onepass = route == "onepass"
     if mmap_input is None:
-        use_mmap = not pipeline and getattr(codec, "zero_copy_rows", False)
+        use_mmap = route == "mmap"
     else:
         use_mmap = (
             mmap_input and not pipeline and hasattr(codec, "encode_rows")
@@ -607,7 +675,7 @@ def write_ec_files(
         if _encode_onepass(
             base_file_name, dat_path, codec, dat_size,
             n_large, large_block_size, n_small, small_block_size,
-            chunk=chunk, force=onepass_forced,
+            chunk=chunk,
         ):
             return
 

@@ -47,6 +47,22 @@ def free_port_pair() -> int:
     raise RuntimeError("no free port pair")
 
 
+async def assign_retry(master: str, attempts: int = 30, **kw):
+    """assign() with retries: right after cluster start the first assign
+    races volume growth, and under full-suite load on a throttled box the
+    grow RPCs can transiently time out or report no free volumes."""
+    from seaweedfs_tpu.client import assign
+
+    last: Exception = RuntimeError("assign_retry: no attempts")
+    for _ in range(attempts):
+        try:
+            return await assign(master, **kw)
+        except Exception as e:
+            last = e
+            await asyncio.sleep(0.25)
+    raise last
+
+
 class Cluster:
     def __init__(self, tmp_path, n_volume_servers: int = 3):
         self.tmp_path = tmp_path

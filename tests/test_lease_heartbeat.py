@@ -81,7 +81,9 @@ def test_heartbeat_break_deletes_vids_from_clients(tmp_path):
         await client.start()
         try:
             async with aiohttp.ClientSession() as session:
-                ar = await assign(cluster.master.address)
+                from tests.test_cluster import assign_retry
+
+                ar = await assign_retry(cluster.master.address)
                 await upload_data(session, ar.url, ar.fid, b"doomed")
             vid = int(ar.fid.split(",")[0])
             await client.wait_connected()

@@ -143,7 +143,8 @@ def test_shell_ec_encode_batches_colocated_volumes(tmp_path):
         await cluster.start()
         try:
             async with aiohttp.ClientSession() as session:
-                ar0 = await assign(cluster.master.address)
+                from tests.test_cluster import assign_retry
+                ar0 = await assign_retry(cluster.master.address)
                 url = ar0.url
                 vid0 = int(ar0.fid.split(",")[0])
                 vids = [vid0, vid0 + 1]
@@ -196,7 +197,8 @@ def test_generate_batch_rpc_and_read_back(tmp_path):
         await cluster.start()
         try:
             async with aiohttp.ClientSession() as session:
-                ar0 = await assign(cluster.master.address)
+                from tests.test_cluster import assign_retry
+                ar0 = await assign_retry(cluster.master.address)
                 url = ar0.url
                 vid0 = int(ar0.fid.split(",")[0])
                 vids = [vid0, vid0 + 1]
