@@ -760,8 +760,9 @@ def measure_serving_qps(
                         read_leg(
                             concurrency,
                             BatchLookupGate(vs.store, use_device=True),
-                            nf=max(200, num_files // 10),  # RTT-bound on a
-                            # tunneled backend: keep the leg in the budget
+                            nf=200,  # fixed small sample: each batch pays
+                            # the tunnel RTT, so the leg records tunnel
+                            # latency honestly without eating the budget
                         ),
                         timeout=60,
                     )
