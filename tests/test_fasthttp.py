@@ -1,0 +1,261 @@
+"""Byte-level HTTP fast tier (util/fasthttp.py): parser framing, keep-alive,
+fallback proxying, DETACHED response ordering under a pipelining client,
+and the single-pass multipart parser — the machinery under the serving
+data plane (volume/master public ports)."""
+
+import asyncio
+
+import pytest
+
+from seaweedfs_tpu.util.fasthttp import (
+    DETACHED,
+    FALLBACK,
+    FastHTTPClient,
+    FastHTTPServer,
+    build_multipart,
+    finish_detached,
+    parse_multipart,
+    render_response,
+)
+
+
+def free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------- multipart parser ----------------
+def test_parse_multipart_roundtrip():
+    body, ctype = build_multipart("file", b"hello bytes", "a.txt", "text/x")
+    got = parse_multipart(body, ctype.encode())
+    assert got is not None
+    data, filename, mime = got
+    assert data == b"hello bytes"
+    assert filename == "a.txt"
+    assert mime == "text/x"
+
+
+def test_parse_multipart_unknown_field_falls_back():
+    boundary = "bbb"
+    body = (
+        b"--bbb\r\nContent-Disposition: form-data; name=\"other\"\r\n\r\n"
+        b"nope\r\n--bbb--\r\n"
+    )
+    assert (
+        parse_multipart(body, b"multipart/form-data; boundary=bbb") is None
+    )
+
+
+def test_parse_multipart_binary_payload_with_boundary_like_bytes():
+    # payload containing CRLF and dashes must not confuse the scan
+    payload = b"\r\n--not-the-boundary\r\nbinary\x00\xff" * 3
+    body, ctype = build_multipart("file", payload)
+    got = parse_multipart(body, ctype.encode())
+    assert got is not None and got[0] == payload
+
+
+# ---------------- server protocol ----------------
+def _run(coro):
+    asyncio.run(coro)
+
+
+def test_keepalive_sequential_and_bad_request(tmp_path):
+    async def body():
+        seen = []
+
+        async def handler(req):
+            seen.append((req.method, req.path, req.query, bytes(req.body)))
+            return render_response(200, b"ok:" + req.path.encode())
+
+        srv = FastHTTPServer(handler)
+        port = free_port()
+        await srv.start("127.0.0.1", port)
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            for i in range(3):
+                w.write(
+                    f"GET /x{i}?q={i} HTTP/1.1\r\nHost: h\r\n\r\n".encode()
+                )
+                await w.drain()
+                head = await r.readuntil(b"\r\n\r\n")
+                assert b"200" in head.split(b"\r\n")[0]
+                n = int(
+                    [
+                        ln.split(b":")[1]
+                        for ln in head.lower().split(b"\r\n")
+                        if ln.startswith(b"content-length")
+                    ][0]
+                )
+                assert (await r.readexactly(n)) == f"ok:/x{i}".encode()
+            assert [s[1] for s in seen] == ["/x0", "/x1", "/x2"]
+            assert seen[0][2] == "q=0"
+
+            # chunked request bodies are rejected with 400
+            w.write(
+                b"POST /y HTTP/1.1\r\nHost: h\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n0\r\n\r\n"
+            )
+            await w.drain()
+            head = await r.readuntil(b"\r\n\r\n")
+            assert b"400" in head.split(b"\r\n")[0]
+            w.close()
+        finally:
+            await srv.stop()
+
+    _run(body())
+
+
+def test_fallback_proxy_replays_against_backend(tmp_path):
+    async def body():
+        # backend: a trivial asyncio server speaking close-framed HTTP
+        backend_seen = []
+
+        async def backend_conn(r, w):
+            data = await r.readuntil(b"\r\n\r\n")
+            clen = 0
+            for ln in data.lower().split(b"\r\n"):
+                if ln.startswith(b"content-length:"):
+                    clen = int(ln.split(b":")[1])
+            body_bytes = await r.readexactly(clen) if clen else b""
+            backend_seen.append((data, body_bytes))
+            payload = b"from-backend:" + body_bytes
+            w.write(
+                b"HTTP/1.1 201 Created\r\nContent-Length: %d\r\n"
+                b"Connection: close\r\n\r\n%s" % (len(payload), payload)
+            )
+            await w.drain()
+            w.close()
+
+        bport = free_port()
+        backend = await asyncio.start_server(
+            backend_conn, "127.0.0.1", bport
+        )
+
+        async def handler(req):
+            if req.path == "/hot":
+                return render_response(200, b"hot")
+            return FALLBACK
+
+        srv = FastHTTPServer(handler, backend=("127.0.0.1", bport))
+        port = free_port()
+        await srv.start("127.0.0.1", port)
+        try:
+            cl = FastHTTPClient()
+            st, resp = await cl.request("GET", f"127.0.0.1:{port}", "/hot")
+            assert (st, resp) == (200, b"hot")
+            st, resp = await cl.request(
+                "POST", f"127.0.0.1:{port}", "/cold?x=1", body=b"PAYLOAD",
+                content_type="text/p",
+            )
+            assert st == 201
+            assert resp == b"from-backend:PAYLOAD"
+            # the replayed head reaches the backend verbatim-ish: method,
+            # target, content-type survive; X-Forwarded-For carries the peer
+            head = backend_seen[0][0]
+            assert head.startswith(b"POST /cold?x=1 HTTP/1.1")
+            assert b"text/p" in head
+            assert b"x-forwarded-for: 127.0.0.1" in head.lower()
+            # connection still usable for hot requests after a proxied one
+            st, resp = await cl.request("GET", f"127.0.0.1:{port}", "/hot")
+            assert (st, resp) == (200, b"hot")
+            await cl.close()
+        finally:
+            await srv.stop()
+            backend.close()
+
+    _run(body())
+
+
+def test_detached_ordering_under_pipelining():
+    """A pipelining client sends request B while A's DETACHED response is
+    still pending; the protocol must hold B until A's response is written
+    (responses must never reorder on one connection)."""
+
+    async def body():
+        release_a = asyncio.get_event_loop().create_future()
+        order = []
+
+        async def handler(req):
+            if req.path == "/a":
+                async def later():
+                    await release_a
+                    order.append("a-written")
+                    finish_detached(req, render_response(200, b"AAA"))
+
+                asyncio.ensure_future(later())
+                return DETACHED
+            order.append("b-handled")
+            return render_response(200, b"BBB")
+
+        srv = FastHTTPServer(handler)
+        port = free_port()
+        await srv.start("127.0.0.1", port)
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            # pipeline both requests back to back
+            w.write(
+                b"GET /a HTTP/1.1\r\nHost: h\r\n\r\n"
+                b"GET /b HTTP/1.1\r\nHost: h\r\n\r\n"
+            )
+            await w.drain()
+            await asyncio.sleep(0.1)
+            release_a.set_result(None)
+            head_a = await r.readuntil(b"\r\n\r\n")
+            body_a = await r.readexactly(3)
+            head_b = await r.readuntil(b"\r\n\r\n")
+            body_b = await r.readexactly(3)
+            assert body_a == b"AAA" and body_b == b"BBB"
+            assert order[0] == "a-written"  # B never overtook A
+            w.close()
+        finally:
+            await srv.stop()
+
+    _run(body())
+
+
+def test_detached_finish_is_idempotent():
+    async def body():
+        async def handler(req):
+            finish_detached(req, render_response(200, b"one"))
+            finish_detached(req, render_response(200, b"two"))  # no-op
+            return DETACHED
+
+        srv = FastHTTPServer(handler)
+        port = free_port()
+        await srv.start("127.0.0.1", port)
+        try:
+            cl = FastHTTPClient()
+            st, resp = await cl.request("GET", f"127.0.0.1:{port}", "/x")
+            assert (st, resp) == (200, b"one")
+            # connection must not carry a stray second response
+            st, resp = await cl.request("GET", f"127.0.0.1:{port}", "/x")
+            assert (st, resp) == (200, b"one")
+            await cl.close()
+        finally:
+            await srv.stop()
+
+    _run(body())
+
+
+def test_client_reads_chunked_responses():
+    async def body():
+        async def conn(r, w):
+            await r.readuntil(b"\r\n\r\n")
+            w.write(
+                b"HTTP/1.1 200 OK\r\nTransfer-Encoding: chunked\r\n\r\n"
+                b"5\r\nhello\r\n6\r\n world\r\n0\r\n\r\n"
+            )
+            await w.drain()
+
+        port = free_port()
+        server = await asyncio.start_server(conn, "127.0.0.1", port)
+        cl = FastHTTPClient()
+        st, resp = await cl.request("GET", f"127.0.0.1:{port}", "/")
+        assert (st, resp) == (200, b"hello world")
+        await cl.close()
+        server.close()
+
+    _run(body())
