@@ -956,6 +956,173 @@ def cmd_mount(argv: list[str]) -> int:
     return 0
 
 
+def cmd_filer_copy(argv: list[str]) -> int:
+    """Bulk-copy local files/directories into the filer namespace
+    (ref command/filer_copy.go): chunks are assigned and uploaded straight
+    to volume servers, then one CreateEntry per file lands the metadata —
+    bytes never round-trip through the filer process."""
+    p = argparse.ArgumentParser(
+        prog="weed-tpu filer.copy",
+        usage="weed-tpu filer.copy [options] file_or_dir... dest_filer_path",
+    )
+    p.add_argument("-filer", default="localhost:8888")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    p.add_argument("-ttl", default="")
+    p.add_argument("-maxMB", type=int, default=4, help="chunk size in MB")
+    p.add_argument("-concurrency", type=int, default=8)
+    p.add_argument(
+        "-include", default="",
+        help="fnmatch pattern; only matching basenames copy (ref -include)",
+    )
+    p.add_argument("paths", nargs="+", help="sources... then /dest/dir/")
+    args = p.parse_args(argv)
+    if args.maxMB < 1:
+        # unlike `upload -maxMB 0` (never split), a zero chunk size here
+        # would read nothing — reject instead of silently copying empties
+        print("-maxMB must be >= 1", file=sys.stderr)
+        return 2
+    if len(args.paths) < 2:
+        print("need at least one source and a destination path", file=sys.stderr)
+        return 2
+    sources, dest = args.paths[:-1], args.paths[-1]
+    if not dest.startswith("/"):
+        print(f"destination {dest!r} must be an absolute filer path",
+              file=sys.stderr)
+        return 2
+
+    import fnmatch
+    import mimetypes
+    import time as _time
+
+    chunk_size = args.maxMB * 1024 * 1024
+
+    missing_sources = []
+
+    def walk():
+        """(local_path, filer_path) pairs."""
+        for src in sources:
+            if os.path.isdir(src):
+                root = os.path.abspath(src)
+                base = os.path.basename(root.rstrip("/"))
+                for dirpath, _dirs, files in os.walk(root):
+                    rel = os.path.relpath(dirpath, root)
+                    for fn in sorted(files):
+                        if args.include and not fnmatch.fnmatch(
+                            fn, args.include
+                        ):
+                            continue
+                        sub = fn if rel == "." else f"{rel}/{fn}"
+                        yield (
+                            os.path.join(dirpath, fn),
+                            f"{dest.rstrip('/')}/{base}/{sub}",
+                        )
+            elif os.path.isfile(src):
+                if args.include and not fnmatch.fnmatch(
+                    os.path.basename(src), args.include
+                ):
+                    continue
+                yield src, f"{dest.rstrip('/')}/{os.path.basename(src)}"
+            else:
+                missing_sources.append(src)
+                print(f"cannot copy {src!r}: not a file or directory",
+                      file=sys.stderr)
+
+    async def run() -> int:
+        import aiohttp
+
+        from ..client.operation import upload_data
+        from ..filer.entry import Attr, Entry, FileChunk
+        from ..pb import grpc_address
+        from ..pb.rpc import Stub, close_all_channels
+
+        stub = Stub(grpc_address(args.filer), "filer")
+        session = aiohttp.ClientSession()
+        sem = asyncio.Semaphore(args.concurrency)
+        stats = {"files": 0, "bytes": 0, "failed": 0}
+
+        async def upload_chunk(data: bytes) -> FileChunk:
+            resp = await stub.call(
+                "AssignVolume",
+                {
+                    "count": 1,
+                    "collection": args.collection,
+                    "replication": args.replication,
+                    "ttl": args.ttl,
+                },
+            )
+            if resp.get("error"):
+                raise RuntimeError(resp["error"])
+            # shared chunk-upload helper: multipart, JWT, the ttl query the
+            # volume server stamps the needle TTL from, error-body checks
+            result = await upload_data(
+                session, resp["url"], resp["file_id"], data,
+                ttl=args.ttl, jwt=resp.get("auth", ""),
+            )
+            return FileChunk(
+                fid=resp["file_id"], offset=0, size=len(data),
+                mtime_ns=_time.time_ns(),
+                etag=result.get("eTag", ""),
+            )
+
+        async def copy_one(local: str, remote: str) -> None:
+            async with sem:
+                try:
+                    st = os.stat(local)
+                    chunks = []
+                    with open(local, "rb") as f:
+                        offset = 0
+                        while True:
+                            data = f.read(chunk_size)
+                            if not data:
+                                break  # empty file -> chunkless entry
+                            c = await upload_chunk(data)
+                            c.offset = offset
+                            chunks.append(c)
+                            offset += len(data)
+                    mime = mimetypes.guess_type(local)[0] or ""
+                    ttl_seconds = 0
+                    if args.ttl:
+                        from ..storage.ttl import TTL
+
+                        ttl_seconds = TTL.read(args.ttl).minutes * 60
+                    entry = Entry(
+                        full_path=remote,
+                        attr=Attr(
+                            mtime=st.st_mtime,
+                            crtime=st.st_mtime,
+                            mode=st.st_mode & 0o7777,
+                            mime=mime,
+                            collection=args.collection,
+                            replication=args.replication,
+                            ttl_seconds=ttl_seconds,
+                        ),
+                        chunks=chunks,
+                    )
+                    resp = await stub.call(
+                        "CreateEntry", {"entry": entry.to_dict()}
+                    )
+                    if resp.get("error"):
+                        raise RuntimeError(resp["error"])
+                    stats["files"] += 1
+                    stats["bytes"] += st.st_size
+                except Exception as e:
+                    stats["failed"] += 1
+                    print(f"copy {local} -> {remote}: {e}", file=sys.stderr)
+
+        await asyncio.gather(*(copy_one(l, r) for l, r in walk()))
+        await session.close()
+        await close_all_channels()
+        stats["failed"] += len(missing_sources)
+        print(
+            f"copied {stats['files']} files, {stats['bytes']:,} bytes"
+            + (f", {stats['failed']} FAILED" if stats["failed"] else "")
+        )
+        return 1 if stats["failed"] else 0
+
+    return asyncio.run(run())
+
+
 def cmd_filer_replicate(argv: list[str]) -> int:
     """Continuously replicate one filer's changes into another cluster
     (ref command/filer_replication.go): subscribes to the source filer's
@@ -1131,6 +1298,7 @@ COMMANDS = {
     "scaffold": cmd_scaffold,
     "mount": cmd_mount,
     "watch": cmd_watch,
+    "filer.copy": cmd_filer_copy,
     "filer.replicate": cmd_filer_replicate,
     "version": cmd_version,
 }

@@ -79,3 +79,135 @@ def test_export_lists_and_extracts(tmp_path):
     assert "key=1" in listing and "f1.bin" in listing
     for key, data in payloads.items():
         assert (out_dir / f"f{key}.bin").read_bytes() == data
+
+
+def test_filer_copy_tree(tmp_path):
+    """weed-tpu filer.copy walks a local tree, uploads chunks straight to
+    volume servers, and lands entries via CreateEntry
+    (ref command/filer_copy.go)."""
+    import asyncio
+
+    from tests.test_cluster import Cluster, free_port_pair
+
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.txt").write_bytes(b"alpha" * 100)
+    (src / "sub" / "b.bin").write_bytes(bytes(range(256)) * 30)  # 7680 B
+    (src / "sub" / "skip.log").write_bytes(b"nope")
+    (src / "empty.txt").write_bytes(b"")
+
+    async def body():
+        import aiohttp
+
+        from seaweedfs_tpu.server.filer import FilerServer
+
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        fs = FilerServer(
+            master=cluster.master.address,
+            port=free_port_pair(),
+            chunk_size=4096,
+        )
+        await fs.start()
+        try:
+            await fs.master_client.wait_connected()
+            from seaweedfs_tpu.command.cli import cmd_filer_copy
+
+            # run the command in a thread: it owns its own event loop
+            rc = await asyncio.to_thread(
+                cmd_filer_copy,
+                [
+                    "-filer", fs.address,
+                    "-maxMB", "1",
+                    str(src), str(src / "empty.txt"),
+                    "/in",
+                ],
+            )
+            assert rc == 0
+            async with aiohttp.ClientSession() as session:
+                async with session.get(
+                    f"http://{fs.address}/in/src/a.txt"
+                ) as r:
+                    assert r.status == 200
+                    assert await r.read() == b"alpha" * 100
+                async with session.get(
+                    f"http://{fs.address}/in/src/sub/b.bin"
+                ) as r:
+                    assert await r.read() == bytes(range(256)) * 30
+                async with session.get(
+                    f"http://{fs.address}/in/empty.txt"
+                ) as r:
+                    assert r.status == 200
+                    assert await r.read() == b""
+
+            # -include filters by basename
+            rc = await asyncio.to_thread(
+                cmd_filer_copy,
+                [
+                    "-filer", fs.address,
+                    "-include", "*.txt",
+                    str(src), "/filtered",
+                ],
+            )
+            assert rc == 0
+            async with aiohttp.ClientSession() as session:
+                async with session.get(
+                    f"http://{fs.address}/filtered/src/a.txt"
+                ) as r:
+                    assert r.status == 200
+                async with session.get(
+                    f"http://{fs.address}/filtered/src/sub/skip.log"
+                ) as r:
+                    assert r.status == 404
+        finally:
+            await fs.stop()
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_filer_copy_ttl_applied(tmp_path):
+    """-ttl must reach both the needle (upload query) and the entry attr
+    (regression: the first cut only passed it to AssignVolume)."""
+    import asyncio
+
+    from tests.test_cluster import Cluster, free_port_pair
+
+    f = tmp_path / "t.txt"
+    f.write_bytes(b"expiring")
+
+    async def body():
+        from seaweedfs_tpu.server.filer import FilerServer
+
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        fs = FilerServer(master=cluster.master.address, port=free_port_pair())
+        await fs.start()
+        try:
+            await fs.master_client.wait_connected()
+            from seaweedfs_tpu.command.cli import cmd_filer_copy
+
+            rc = await asyncio.to_thread(
+                cmd_filer_copy,
+                ["-filer", fs.address, "-ttl", "5m", str(f), "/ttl"],
+            )
+            assert rc == 0
+            entry = fs.filer.find_entry("/ttl/t.txt")
+            assert entry is not None
+            assert entry.attr.ttl_seconds == 300
+            # the needle itself carries the TTL (volume stamped it from
+            # the upload query)
+            fid = entry.chunks[0].fid
+            from seaweedfs_tpu.storage.file_id import FileId
+            from seaweedfs_tpu.storage.needle import Needle
+
+            fi = FileId.parse(fid)
+            vs = cluster.volume_servers[0]
+            n = Needle(id=fi.key)
+            vs.store.read_volume_needle(fi.volume_id, n)
+            assert n.ttl is not None and str(n.ttl) == "5m"
+        finally:
+            await fs.stop()
+            await cluster.stop()
+
+    asyncio.run(body())
