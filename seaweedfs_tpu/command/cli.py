@@ -1034,12 +1034,22 @@ def cmd_filer_copy(argv: list[str]) -> int:
         from ..client.operation import upload_data
         from ..filer.entry import Attr, Entry, FileChunk
         from ..pb import grpc_address
-        from ..pb.rpc import Stub, close_all_channels
+        from ..pb.rpc import Stub, new_channel
 
-        stub = Stub(grpc_address(args.filer), "filer")
+        # private channel: this command runs its own short-lived event
+        # loop, so the process-global channel cache must not be touched
+        # (rpc.Stub docstring) — close exactly what we opened
+        channel = new_channel(grpc_address(args.filer))
+        stub = Stub(grpc_address(args.filer), "filer", channel=channel)
         session = aiohttp.ClientSession()
         sem = asyncio.Semaphore(args.concurrency)
         stats = {"files": 0, "bytes": 0, "failed": 0}
+        ttl_seconds = 0
+        if args.ttl:
+            # parse ONCE, and fail before any chunk is uploaded
+            from ..storage.ttl import TTL
+
+            ttl_seconds = TTL.read(args.ttl).minutes * 60
 
         async def upload_chunk(data: bytes) -> FileChunk:
             resp = await stub.call(
@@ -1068,12 +1078,16 @@ def cmd_filer_copy(argv: list[str]) -> int:
         async def copy_one(local: str, remote: str) -> None:
             async with sem:
                 try:
-                    st = os.stat(local)
+                    st = await asyncio.to_thread(os.stat, local)
                     chunks = []
                     with open(local, "rb") as f:
                         offset = 0
                         while True:
-                            data = f.read(chunk_size)
+                            # file IO off the loop: a slow disk must not
+                            # stall the other in-flight uploads
+                            data = await asyncio.to_thread(
+                                f.read, chunk_size
+                            )
                             if not data:
                                 break  # empty file -> chunkless entry
                             c = await upload_chunk(data)
@@ -1081,11 +1095,6 @@ def cmd_filer_copy(argv: list[str]) -> int:
                             chunks.append(c)
                             offset += len(data)
                     mime = mimetypes.guess_type(local)[0] or ""
-                    ttl_seconds = 0
-                    if args.ttl:
-                        from ..storage.ttl import TTL
-
-                        ttl_seconds = TTL.read(args.ttl).minutes * 60
                     entry = Entry(
                         full_path=remote,
                         attr=Attr(
@@ -1112,7 +1121,7 @@ def cmd_filer_copy(argv: list[str]) -> int:
 
         await asyncio.gather(*(copy_one(l, r) for l, r in walk()))
         await session.close()
-        await close_all_channels()
+        await channel.close()
         stats["failed"] += len(missing_sources)
         print(
             f"copied {stats['files']} files, {stats['bytes']:,} bytes"

@@ -135,6 +135,9 @@ class FastHTTPProtocol(asyncio.Protocol):
         self._queue: asyncio.Queue = asyncio.Queue()
         self._paused = False
         self._closed = False
+        self._continued = False  # 100 Continue sent for the pending request
+        self._processing = False  # a request's response is still pending
+        self._want_continue = False  # 100 deferred until the conn is idle
 
     # -- transport events --
     def connection_made(self, transport):
@@ -203,9 +206,30 @@ class FastHTTPProtocol(asyncio.Protocol):
             return None
         total = end + 4 + clen
         if len(buf) < total:
+            # curl (and other clients) gate large bodies on a 100 Continue;
+            # answering immediately avoids their ~1s expectation timeout.
+            # Only when the connection is otherwise idle — with an earlier
+            # response still pending, an interim 1xx now would land BEFORE
+            # that response and desync the client's attribution
+            if (
+                clen
+                and headers.get(b"expect", b"").lower() == b"100-continue"
+                and not self._continued
+            ):
+                if not self._processing and self._queue.empty():
+                    self._continued = True
+                    self.transport.write(b"HTTP/1.1 100 Continue\r\n\r\n")
+                else:
+                    # an earlier response is still pending: defer (sent by
+                    # _maybe_continue once the connection drains) so the
+                    # client neither misattributes the 1xx nor deadlocks
+                    self._want_continue = True
             return None
         body = bytes(buf[end + 4: total])
         del buf[:total]
+        # next request gets its own 100 Continue
+        self._continued = False
+        self._want_continue = False
         if self._paused and len(buf) < _MAX_BODY:
             self._paused = False
             self.transport.resume_reading()
@@ -235,13 +259,30 @@ class FastHTTPProtocol(asyncio.Protocol):
         self._queue.put_nowait(None)
 
     # -- request loop --
+    def _maybe_continue(self) -> None:
+        """Fire a deferred 100 Continue now that the connection drained
+        (the body the client is withholding is the only way forward)."""
+        if (
+            self._want_continue
+            and not self._continued
+            and self.transport is not None
+            and not self.transport.is_closing()
+        ):
+            self._continued = True
+            self._want_continue = False
+            self.transport.write(b"HTTP/1.1 100 Continue\r\n\r\n")
+
     async def _run(self):
         detached_prev = None  # last DETACHED request, possibly in flight
         try:
             while True:
+                self._processing = False
+                if self._queue.empty() and detached_prev is None:
+                    self._maybe_continue()
                 req = await self._queue.get()
                 if req is None or self._closed:
                     return
+                self._processing = True
                 if detached_prev is not None:
                     # a previous request's response is written from a later
                     # callback; never start the next one before it lands
@@ -303,10 +344,12 @@ async def proxy_request(backend, req: FastRequest) -> tuple[bytes, bool]:
         r, w = await asyncio.open_connection(*backend)
         # strip any connection header, pin close framing on the backend leg
         lines = req.raw_head.split(b"\r\n")
+        # drop Expect too: the body is already in hand, and relaying the
+        # backend's own "100 Continue" would give the client a second one
         lines = [
             ln for ln in lines[:-2]
             if not ln.lower().startswith(
-                (b"connection:", b"x-forwarded-for:")
+                (b"connection:", b"x-forwarded-for:", b"expect:")
             )
         ]
         # the backend sees our loopback socket, not the client: carry the

@@ -92,7 +92,8 @@ def test_filer_copy_tree(tmp_path):
     src = tmp_path / "src"
     (src / "sub").mkdir(parents=True)
     (src / "a.txt").write_bytes(b"alpha" * 100)
-    (src / "sub" / "b.bin").write_bytes(bytes(range(256)) * 30)  # 7680 B
+    # 2.5MB: with -maxMB 1 this exercises the multi-chunk stitching loop
+    (src / "sub" / "b.bin").write_bytes(bytes(range(256)) * 10240)
     (src / "sub" / "skip.log").write_bytes(b"nope")
     (src / "empty.txt").write_bytes(b"")
 
@@ -133,7 +134,13 @@ def test_filer_copy_tree(tmp_path):
                 async with session.get(
                     f"http://{fs.address}/in/src/sub/b.bin"
                 ) as r:
-                    assert await r.read() == bytes(range(256)) * 30
+                    assert await r.read() == bytes(range(256)) * 10240
+                # the 2.5MB file really was split into 1MB chunks
+                entry = fs.filer.find_entry("/in/src/sub/b.bin")
+                assert len(entry.chunks) == 3
+                assert [c.offset for c in entry.chunks] == [
+                    0, 1 << 20, 2 << 20
+                ]
                 async with session.get(
                     f"http://{fs.address}/in/empty.txt"
                 ) as r:

@@ -259,3 +259,97 @@ def test_client_reads_chunked_responses():
         server.close()
 
     _run(body())
+
+
+def test_expect_100_continue():
+    """curl gates large POST bodies on a 100 Continue; the parser must
+    answer it as soon as headers arrive, once per request."""
+
+    async def body():
+        async def handler(req):
+            return render_response(200, b"got:%d" % len(req.body))
+
+        srv = FastHTTPServer(handler)
+        port = free_port()
+        await srv.start("127.0.0.1", port)
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.write(
+                b"POST /up HTTP/1.1\r\nHost: h\r\nContent-Length: 5\r\n"
+                b"Expect: 100-continue\r\n\r\n"
+            )
+            await w.drain()
+            interim = await r.readuntil(b"\r\n\r\n")
+            assert interim.startswith(b"HTTP/1.1 100 Continue")
+            w.write(b"hello")
+            await w.drain()
+            head = await r.readuntil(b"\r\n\r\n")
+            assert b"200" in head.split(b"\r\n")[0]
+            n = int(
+                [
+                    ln.split(b":")[1]
+                    for ln in head.lower().split(b"\r\n")
+                    if ln.startswith(b"content-length")
+                ][0]
+            )
+            assert (await r.readexactly(n)) == b"got:5"
+            w.close()
+        finally:
+            await srv.stop()
+
+    _run(body())
+
+
+def test_expect_100_continue_deferred_behind_pipelined_response():
+    """With an earlier response still pending, the interim 100 must wait
+    until the connection drains (never land before that response), then
+    still arrive so the expecting client is not deadlocked."""
+
+    async def body():
+        release = asyncio.get_event_loop().create_future()
+
+        async def handler(req):
+            if req.path == "/slow":
+                await release
+                return render_response(200, b"SLOW")
+            return render_response(200, b"got:%d" % len(req.body))
+
+        srv = FastHTTPServer(handler)
+        port = free_port()
+        await srv.start("127.0.0.1", port)
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            # request 1 (response held), then pipeline request 2's HEADERS
+            # with Expect — body withheld until the 100 arrives
+            w.write(
+                b"GET /slow HTTP/1.1\r\nHost: h\r\n\r\n"
+                b"POST /up HTTP/1.1\r\nHost: h\r\nContent-Length: 3\r\n"
+                b"Expect: 100-continue\r\n\r\n"
+            )
+            await w.drain()
+            await asyncio.sleep(0.1)
+            release.set_result(None)
+            # FIRST bytes on the wire must be request 1's response
+            head1 = await r.readuntil(b"\r\n\r\n")
+            assert head1.startswith(b"HTTP/1.1 200")
+            assert (await r.readexactly(4)) == b"SLOW"
+            # then the deferred interim 100
+            interim = await r.readuntil(b"\r\n\r\n")
+            assert interim.startswith(b"HTTP/1.1 100 Continue")
+            w.write(b"abc")
+            await w.drain()
+            head2 = await r.readuntil(b"\r\n\r\n")
+            assert b"200" in head2.split(b"\r\n")[0]
+            n = int(
+                [
+                    ln.split(b":")[1]
+                    for ln in head2.lower().split(b"\r\n")
+                    if ln.startswith(b"content-length")
+                ][0]
+            )
+            assert (await r.readexactly(n)) == b"got:3"
+            w.close()
+        finally:
+            await srv.stop()
+
+    _run(body())
