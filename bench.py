@@ -1182,18 +1182,66 @@ def main() -> None:
             {"metric": "ec.encode.e2e.best", "skipped": "bench budget spent"}
         )
 
-    print(
-        json.dumps(
-            {
-                "metric": "ec.encode_throughput",
-                "value": round(tpu_gbps, 3),
-                "unit": "GB/s",
-                "vs_baseline": round(tpu_gbps / cpu_gbps, 2),
-                "extra": extra,
-            }
+    headline = {
+        "metric": "ec.encode_throughput",
+        "value": round(tpu_gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(tpu_gbps / cpu_gbps, 2),
+        "extra": extra,
+    }
+    if os.environ.get("GRAFT_BENCH_CPU_FALLBACK"):
+        headline["note"] = (
+            "DEVICE UNREACHABLE this run (tunnel/relay down at bench "
+            "time): device legs measured on the pure-CPU stand-in; "
+            "host-side metrics (serving, e2e, host_kernel, multi) are "
+            "unaffected"
         )
-    )
+    print(json.dumps(headline))
+
+
+def _device_backend_usable(timeout: float = 120.0) -> bool:
+    """Out-of-process probe with a deadline: the tunneled backend can HANG
+    (not raise) at init when its relay is down — observed live — and a hung
+    bench records nothing at all."""
+    import subprocess
+
+    try:
+        return (
+            subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import jax, numpy as np; "
+                    "jax.device_put(np.zeros(8, np.uint8))"
+                    ".block_until_ready()",
+                ],
+                capture_output=True,
+                timeout=timeout,
+            ).returncode
+            == 0
+        )
+    except Exception:
+        return False
 
 
 if __name__ == "__main__":
+    if (
+        not os.environ.get("GRAFT_BENCH_CPU_FALLBACK")
+        and not _device_backend_usable()
+    ):
+        # the device is unreachable: losing the WHOLE bench to a hang would
+        # record nothing — re-exec onto pure CPU (axon hook disarmed) so
+        # the host-side numbers (serving, e2e, host kernel, multi) still
+        # land; device-kernel legs then honestly measure the CPU stand-in
+        print(
+            "bench: device backend unusable (probe failed/hung); "
+            "re-exec on pure CPU — device legs are CPU stand-ins this run",
+            file=sys.stderr,
+            flush=True,
+        )
+        env = dict(os.environ)
+        env["GRAFT_BENCH_CPU_FALLBACK"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        os.execve(sys.executable, [sys.executable, *sys.argv], env)
     sys.exit(main())
