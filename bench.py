@@ -107,8 +107,84 @@ def measure_tpu(parity_matrix, packed_np: np.ndarray) -> float:
     return n_bytes / _slope_time(run) / 1e9
 
 
+def measure_kernel_roofline(parity_matrix, packed_np: np.ndarray) -> dict:
+    """Write the kernel's ceiling DOWN instead of asserting it (VERDICT r4
+    item 5): measure both xtime formulations on the same HBM-resident
+    stripe batch, convert to i32 ops/s via the statically-counted op count,
+    and compare against the machine's nominal roofs.
+
+    v5e nominal roofs (public spec): ~819 GB/s HBM; VPU ~= 8 sublanes x
+    128 lanes x 4 ALUs x ~0.94 GHz ~= 3.9e12 i32 ops/s. HBM traffic per
+    input byte at RS(10,4) is 1.4 (read 10 rows, write 4)."""
+    import jax
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ops.gf256 import count_expr_ops, gf_matmul_packed
+
+    # a 4MB-per-row slice (40MB batch) is plenty for a steady-state ratio
+    # and keeps the CPU stand-in path from eating minutes of bench budget
+    packed_np = packed_np[:, : min(packed_np.shape[1], 1 << 20)]
+    packed = jax.device_put(jnp.asarray(packed_np))
+    n_bytes = packed_np.size * 4
+    digest = jax.jit(lambda x: x.sum(dtype=jnp.uint32))
+
+    VPU_PEAK = 3.9e12
+    HBM_PEAK = 819e9
+    out: dict = {
+        "vpu_nominal_ops_per_s": VPU_PEAK,
+        "hbm_nominal_gbps": HBM_PEAK / 1e9,
+        # the roofs are v5e's: fractions are only meaningful when the
+        # legs actually ran on the TPU, not a CPU stand-in
+        "valid": jax.devices()[0].platform == "tpu",
+    }
+    best_mode, best_gbps = None, 0.0
+    for mode in ("mul", "shift"):
+        encode = jax.jit(
+            lambda p, m=mode: gf_matmul_packed(
+                parity_matrix, p, xtime_mode=m
+            )
+        )
+        _ = np.asarray(digest(encode(packed)))  # compile + warm
+
+        def run(k: int) -> float:
+            t0 = time.perf_counter()
+            o = None
+            for _ in range(k):
+                o = encode(packed)
+            _ = np.asarray(digest(o))
+            return time.perf_counter() - t0
+
+        gbps = n_bytes / _slope_time(run, k_lo=4, k_hi=16, reps=3) / 1e9
+        ops_per_word_col = count_expr_ops(parity_matrix, mode)
+        ops_per_input_byte = ops_per_word_col / (
+            4 * parity_matrix.shape[1]
+        )
+        ops_per_s = gbps * 1e9 * ops_per_input_byte
+        out[mode] = {
+            "gbps": round(gbps, 3),
+            "ops_per_input_byte": round(ops_per_input_byte, 2),
+            "i32_ops_per_s": round(ops_per_s / 1e12, 3),  # tera-ops
+            "vpu_fraction": round(ops_per_s / VPU_PEAK, 3),
+            "hbm_fraction": round(gbps * 1.4 * 1e9 / HBM_PEAK, 3),
+        }
+        if gbps > best_gbps:
+            best_mode, best_gbps = mode, gbps
+    m = out[best_mode]
+    out["bottleneck"] = (
+        "VPU" if m["vpu_fraction"] > m["hbm_fraction"] else "HBM"
+    )
+    out["best_mode"] = best_mode
+    out["mul_vs_shift"] = round(
+        out["mul"]["gbps"] / max(out["shift"]["gbps"], 1e-9), 2
+    )
+    return out
+
+
 def measure_multi_device(
-    n_volumes: int = 64, shard_bytes: int = 128 << 10
+    n_volumes: int = 64,
+    shard_bytes: int = 128 << 10,
+    k_lo: int = 8,
+    k_hi: int = 64,
 ) -> dict:
     """Device-side multi-volume batching (BASELINE.json config 3's core
     claim): encoding V volumes as ONE wide [10, V*W] dispatch — GF columns
@@ -166,8 +242,8 @@ def measure_multi_device(
         _ = np.asarray(digest(out))
         return time.perf_counter() - t0
 
-    wide_gbps = n_bytes / _slope_time(run_wide) / 1e9
-    seq_gbps = n_bytes / _slope_time(run_seq) / 1e9
+    wide_gbps = n_bytes / _slope_time(run_wide, k_lo, k_hi) / 1e9
+    seq_gbps = n_bytes / _slope_time(run_seq, k_lo, k_hi) / 1e9
     return {
         "n_volumes": n_volumes,
         "bytes": n_bytes,
@@ -294,6 +370,122 @@ def measure_lookup(
         get(k)
     cpu_qps = len(cpu_probe_keys) / (time.perf_counter() - t0)
     return tpu_qps, cpu_qps
+
+
+def measure_lookup_gate_decomposition(n_entries: int = 1_000_000) -> dict:
+    """Separate per-dispatch RTT from on-device kernel time for the
+    serving lookup gate (VERDICT r4 item 6).
+
+    The honest tunnel number (read_qps_batched_device ~7 QPS in r4) says
+    nothing about whether the DESIGN works on a locally-attached chip,
+    because every batch pays the tunnel's RTT and its ~0.03 GB/s download
+    leg. This measures, per batch size B in {64, 1k, 64k}:
+      - t_e2e: one full host->device->host `IndexSnapshot.lookup` dispatch
+        (the serving path, best-of-N: single dispatches are RTT-noisy)
+      - t_kern: device-resident probes, scalar digest pull, slope-timed —
+        the kernel's own time without transfers
+    and derives rtt (t_e2e - t_kern at B=64), the kernel's us/1k-probe
+    slope, and a PROJECTED locally-attached QPS under stated assumptions
+    (100us local dispatch overhead, 8 GB/s host link) — clearly labelled a
+    projection, not a measurement."""
+    import jax
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ops.index_kernel import IndexSnapshot, _split_u64
+
+    rng = np.random.default_rng(5)
+    gaps = rng.integers(1, 20, size=n_entries, dtype=np.uint64)
+    keys = np.cumsum(gaps).astype(np.uint64)
+    offsets = rng.integers(1, 1 << 30, size=n_entries, dtype=np.uint64).astype(
+        np.uint32
+    )
+    sizes = rng.integers(1, 1 << 20, size=n_entries, dtype=np.uint64).astype(
+        np.uint32
+    )
+    snap = IndexSnapshot(keys, offsets, sizes)
+    digest = jax.jit(lambda o, s, f: o.sum(dtype=jnp.uint32))
+
+    batches: dict = {}
+    sizes_b = (64, 1024, 65536)
+    for B in sizes_b:
+        probes = keys[rng.integers(0, n_entries, size=B)]
+        snap.lookup(probes)  # compile + warm this padded shape
+        t_e2e = float("inf")
+        for _ in range(7):
+            t0 = time.perf_counter()
+            snap.lookup(probes)
+            t_e2e = min(t_e2e, time.perf_counter() - t0)
+
+        phi, plo = _split_u64(probes)
+        phi_d = jax.device_put(jnp.asarray(phi))
+        plo_d = jax.device_put(jnp.asarray(plo))
+        if snap.starts is not None:
+            from seaweedfs_tpu.ops.index_kernel import _bulk_lookup_bucketed
+
+            b_d = jax.device_put(jnp.asarray(snap._bucket_of(probes)))
+
+            def enc():
+                return _bulk_lookup_bucketed(
+                    snap.bsteps, snap.khi, snap.klo, snap.offsets,
+                    snap.sizes, snap.starts, phi_d, plo_d, b_d,
+                )
+
+        else:
+            from seaweedfs_tpu.ops.index_kernel import _bulk_lookup
+
+            def enc():
+                return _bulk_lookup(
+                    snap.steps, snap.khi, snap.klo, snap.offsets,
+                    snap.sizes, phi_d, plo_d,
+                )
+
+        _ = np.asarray(digest(*enc()))  # warm
+
+        def run(k: int) -> float:
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(k):
+                out = enc()
+            _ = np.asarray(digest(*out))
+            return time.perf_counter() - t0
+
+        t_kern = _slope_time(run, k_lo=4, k_hi=32, reps=3)
+        batches[B] = {
+            "t_e2e_ms": round(t_e2e * 1e3, 3),
+            "t_kernel_ms": round(t_kern * 1e3, 3),
+        }
+
+    b_lo, b_hi = sizes_b[0], sizes_b[-1]
+    kern_per_probe = (
+        batches[b_hi]["t_kernel_ms"] - batches[b_lo]["t_kernel_ms"]
+    ) / 1e3 / (b_hi - b_lo)
+    rtt_s = max(
+        0.0, (batches[b_lo]["t_e2e_ms"] - batches[b_lo]["t_kernel_ms"]) / 1e3
+    )
+    # projection assumptions, stated in the artifact: a locally-attached
+    # chip pays ~100us dispatch overhead and moves probe/result bytes at
+    # ~8 GB/s over the host link (28 B/probe: 16 in, 12 out)
+    local_dispatch_s = 100e-6
+    local_bw = 8e9
+    proj = {}
+    for B in (1024, 65536):
+        t_local = (
+            local_dispatch_s
+            + batches[B]["t_kernel_ms"] / 1e3
+            + B * 28 / local_bw
+        )
+        proj[str(B)] = round(B / t_local)
+    return {
+        "n_entries": n_entries,
+        "batches": batches,
+        "device_rtt_ms": round(rtt_s * 1e3, 2),
+        "device_kernel_us_per_1k": round(kern_per_probe * 1e6 * 1000, 2),
+        "projected_local_qps": proj,
+        "note": "projected_local_qps is a PROJECTION for a locally-"
+        "attached chip (100us dispatch, 8 GB/s link assumed), from "
+        "measured on-device kernel time; t_e2e is measured through the "
+        "tunnel",
+    }
 
 
 def measure_rebuild() -> tuple[float, float]:
@@ -1002,6 +1194,29 @@ def main() -> None:
         return True
 
     try:
+        if not budgeted("kernel_roofline", 90):
+            raise _Skip()
+        roof = measure_kernel_roofline(codec.parity_matrix, packed)
+        extra.append(
+            {
+                "metric": "kernel_roofline",
+                "value": roof.get(roof.get("best_mode"), {}).get("gbps"),
+                "unit": "GB/s",
+                "vs_baseline": roof.get("mul_vs_shift"),
+                "detail": roof,
+                "note": "measured i32 ops/s vs nominal VPU peak and HBM "
+                "traffic vs nominal HBM peak for both xtime formulations "
+                "(VERDICT r4 item 5); vs_baseline = mul-formulation "
+                "speedup over the r4 shift formulation; bottleneck stated "
+                "in detail",
+            }
+        )
+    except _Skip:
+        pass
+    except Exception as e:
+        extra.append({"metric": "kernel_roofline", "error": str(e)[:200]})
+
+    try:
         if not budgeted("ec.encode.host_kernel", 15):
             raise _Skip()
         # shipping host codec (GFNI tier where the CPU has it) vs the
@@ -1042,6 +1257,29 @@ def main() -> None:
         )
     except Exception as e:  # never lose the headline metric to a new bench
         extra.append({"metric": "needle_lookup_qps", "error": str(e)[:200]})
+
+    try:
+        if not budgeted("lookup_gate.decomposition", 150):
+            raise _Skip()
+        dec = measure_lookup_gate_decomposition()
+        extra.append(
+            {
+                "metric": "lookup_gate.decomposition",
+                "value": dec["projected_local_qps"].get("65536"),
+                "unit": "projected #/sec",
+                "detail": dec,
+                "note": "device lookup gate decomposed: per-dispatch "
+                "tunnel RTT vs on-device kernel time (VERDICT r4 item 6); "
+                "value = projected QPS for a LOCALLY-attached chip at "
+                "batch=64k under the stated assumptions",
+            }
+        )
+    except _Skip:
+        pass
+    except Exception as e:
+        extra.append(
+            {"metric": "lookup_gate.decomposition", "error": str(e)[:200]}
+        )
 
     try:
         if not budgeted("ec.rebuild_throughput", 60):
@@ -1137,7 +1375,12 @@ def main() -> None:
                 "vs_baseline": round(m["multi_gbps"] / m["seq_gbps"], 2),
                 "detail": m,
                 "note": f"{m['n_volumes']} volumes encoded concurrently "
-                "(write_ec_files_multi) vs sequentially, adaptive codec",
+                "(write_ec_files_multi) vs sequentially, adaptive codec. "
+                f"DISCLOSURE, not a target: host_cpus={os.cpu_count() if not hasattr(os, 'sched_getaffinity') else len(os.sched_getaffinity(0))} "
+                "— host-side parallel speedup is structurally capped at "
+                "~1.0x on a 1-core host; BASELINE config 3's multi-volume "
+                "number is the DEVICE batch dimension "
+                "(ec.encode.multi.device)",
             }
         )
     except _Skip:
@@ -1151,13 +1394,38 @@ def main() -> None:
         md = measure_multi_device(
             n_volumes=int(os.environ.get("BENCH_MULTI_DEV_VOLS", 64))
         )
+        # the batching win must HOLD AS V GROWS (VERDICT r4 item 7): a
+        # second shape with 4x the volume count, still launch-bound
+        try:
+            if remaining() > 45:
+                md_big = measure_multi_device(
+                    n_volumes=int(
+                        os.environ.get("BENCH_MULTI_DEV_VOLS_BIG", 256)
+                    ),
+                    k_lo=4,
+                    k_hi=16,
+                )
+                md["v256"] = {
+                    k: md_big[k]
+                    for k in (
+                        "n_volumes",
+                        "bytes",
+                        "wide_gbps",
+                        "per_volume_dispatch_gbps",
+                        "batch_speedup",
+                    )
+                }
+        except Exception as e:
+            md["v256"] = {"error": str(e)[:120]}
         extra.append(
             {
                 "metric": "ec.encode.multi.device",
                 "value": md["wide_gbps"],
                 "unit": "GB/s",
                 # the batch dimension's win: one wide dispatch vs V
-                # per-volume dispatches of the same kernel
+                # per-volume dispatches of the same kernel. THIS is
+                # BASELINE config 3's multi-volume number (the host
+                # ec.encode.multi leg is a 1-core disclosure)
                 "vs_baseline": md["batch_speedup"],
                 "detail": md,
                 "note": f"{md['n_volumes']} small volumes as ONE wide "
@@ -1165,7 +1433,8 @@ def main() -> None:
                 "(BASELINE config 3's batch dimension in the launch-bound "
                 "small-volume regime; HBM-resident, slope-timed; at "
                 ">=20MB/dispatch batching is ~1x because launches already "
-                "amortize)",
+                "amortize); detail.v256 shows the win holding at 4x the "
+                "volume count",
             }
         )
     except _Skip:
@@ -1187,6 +1456,7 @@ def main() -> None:
         "value": round(tpu_gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(tpu_gbps / cpu_gbps, 2),
+        "device_status": _device_status(),
         "extra": extra,
     }
     if os.environ.get("GRAFT_BENCH_CPU_FALLBACK"):
@@ -1196,38 +1466,145 @@ def main() -> None:
             "host-side metrics (serving, e2e, host_kernel, multi) are "
             "unaffected"
         )
-    print(json.dumps(headline))
+    _emit_final(headline)
 
 
-def _device_backend_usable(timeout: float = 120.0) -> bool:
-    """Out-of-process probe with a deadline: the tunneled backend can HANG
-    (not raise) at init when its relay is down — observed live — and a hung
-    bench records nothing at all."""
-    import subprocess
-
+def _device_status() -> str:
+    """Machine-readable provenance for the device legs: 'tpu' only when
+    the real accelerator answered; anything else marks a stand-in run.
+    Round 4's artifact was a CPU stand-in with no way to tell — this field
+    is the fix (VERDICT r4 item 1b)."""
+    if os.environ.get("GRAFT_BENCH_CPU_FALLBACK"):
+        return "cpu_standin"
     try:
-        return (
-            subprocess.run(
-                [
-                    sys.executable,
-                    "-c",
-                    "import jax, numpy as np; "
-                    "jax.device_put(np.zeros(8, np.uint8))"
-                    ".block_until_ready()",
-                ],
-                capture_output=True,
-                timeout=timeout,
-            ).returncode
-            == 0
-        )
+        import jax
+
+        return jax.devices()[0].platform  # "tpu" / "cpu" / ...
     except Exception:
-        return False
+        return "unknown"
+
+
+# keys worth carrying on the compact final line, in emission order
+_COMPACT_KEYS = (
+    "metric",
+    "value",
+    "unit",
+    "vs_baseline",
+    "write_qps",
+    "write_vs_baseline",
+    "skipped",
+)
+_FINAL_LINE_CAP = 1900  # bytes; the driver tail-captures 2,000 chars
+
+
+def _compact_entry(e: dict) -> dict:
+    c = {k: e[k] for k in _COMPACT_KEYS if k in e}
+    if "error" in e:
+        c["error"] = str(e["error"])[:60]
+    # dict-valued metrics (geometries, rooflines): keep numbers, drop prose
+    v = c.get("value")
+    if isinstance(v, dict):
+        c["value"] = {
+            k: (round(x, 3) if isinstance(x, float) else x)
+            for k, x in v.items()
+            if isinstance(x, (int, float))
+        }
+    return c
+
+
+def _emit_final(headline: dict) -> None:
+    """Write the full result to BENCH_DETAIL.json and print ONE compact
+    JSON line guaranteed under the driver's 2,000-char tail capture.
+
+    Round 4's official record was `parsed: null` because the single output
+    line grew past the capture window; the detail file is now the deep
+    record and the stdout line is the contract-sized summary."""
+    detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_DETAIL.json")
+    try:
+        with open(detail_path, "w") as f:
+            json.dump(headline, f, indent=1)
+            f.write("\n")
+    except Exception as e:  # an unwritable detail file must not kill stdout
+        print(f"bench: BENCH_DETAIL.json not written: {e}", file=sys.stderr)
+
+    compact = {k: v for k, v in headline.items() if k != "extra"}
+    compact.pop("note", None)
+    compact["detail_file"] = "BENCH_DETAIL.json"
+    extras = [_compact_entry(e) for e in headline.get("extra", [])]
+    compact["extra"] = extras
+    line = json.dumps(compact, separators=(",", ":"))
+    # degrade gracefully if some future metric bloats the line: drop
+    # skipped markers first, then trim trailing extras — both degrade
+    # steps flag the omission so the record never silently shrinks
+    if len(line) > _FINAL_LINE_CAP:
+        extras = [e for e in extras if "skipped" not in e]
+        compact["extra"] = extras
+        compact["extra_truncated"] = True
+        line = json.dumps(compact, separators=(",", ":"))
+    while len(line) > _FINAL_LINE_CAP and extras:
+        extras.pop()
+        compact["extra_truncated"] = True
+        line = json.dumps(compact, separators=(",", ":"))
+    print(line)
+
+
+def _probe_device_backend(timeout: float = 120.0) -> str:
+    """Shared out-of-process probe (util/device_probe.py): the tunneled
+    backend can HANG (not raise) at init when its relay is down — observed
+    live — and a hung bench records nothing at all. Three-state verdict:
+    "ok" / "down" / "timeout" (hung to deadline = hard-down relay)."""
+    from seaweedfs_tpu.util.device_probe import probe_device_backend
+
+    return probe_device_backend(timeout=timeout)[0]
+
+
+def _device_backend_usable_with_retry() -> bool:
+    """The tunnel FLAPS (observed across rounds 3-4): a single failed probe
+    at bench time turned round 4's official device legs into CPU stand-ins.
+    Retry with backoff before giving up (VERDICT r4 item 1b).
+
+    The per-probe deadline stays generous (150s: cold jax init over the
+    tunnel legitimately takes ~2 min, and shrinking it would demote a
+    slow-but-healthy backend to a stand-in), but a probe that HUNG to its
+    deadline is a hard-down relay — retrying would burn another 150s for
+    nothing and starve the bench body of driver wall-clock, so only
+    fast-fails (relay up, backend erroring) are retried."""
+    delays = (15.0, 30.0)  # between attempts; fast-fail probes ~seconds
+    for attempt in range(len(delays) + 1):
+        verdict = _probe_device_backend(timeout=150.0)
+        if verdict == "ok":
+            if attempt:
+                print(
+                    f"bench: device probe recovered on attempt "
+                    f"{attempt + 1}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            return True
+        if verdict == "timeout":
+            print(
+                "bench: device probe HUNG to its 150s deadline "
+                "(hard-down relay); not retrying",
+                file=sys.stderr,
+                flush=True,
+            )
+            return False
+        if attempt < len(delays):
+            print(
+                f"bench: device probe failed (attempt {attempt + 1}/"
+                f"{len(delays) + 1}); retrying in {delays[attempt]:.0f}s",
+                file=sys.stderr,
+                flush=True,
+            )
+            time.sleep(delays[attempt])
+    return False
 
 
 if __name__ == "__main__":
     if (
         not os.environ.get("GRAFT_BENCH_CPU_FALLBACK")
-        and not _device_backend_usable()
+        and not _device_backend_usable_with_retry()
     ):
         # the device is unreachable: losing the WHOLE bench to a hang would
         # record nothing — re-exec onto pure CPU (axon hook disarmed) so

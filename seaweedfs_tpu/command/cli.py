@@ -1051,6 +1051,28 @@ def cmd_filer_copy(argv: list[str]) -> int:
 
             ttl_seconds = TTL.read(args.ttl).minutes * 60
 
+        # the filer's cipher setting governs DIRECT volume uploads too:
+        # with -encryptVolumeData, plaintext chunks from this command would
+        # break the "volume servers only see ciphertext" guarantee, so the
+        # cipher flag is read once up front and every chunk is encrypted
+        # client-side with its own key carried in chunk metadata (ref
+        # filer_copy.go:114,180; upload_content.go:135-150)
+        try:
+            conf = await stub.call("GetFilerConfiguration", {})
+            cipher = bool(conf.get("cipher"))
+        except Exception as e:
+            # fail CLOSED: assuming no cipher on an RPC blip would upload
+            # plaintext to a cluster whose guarantee is "volume servers
+            # only see ciphertext"
+            print(
+                f"GetFilerConfiguration failed ({e}); refusing to copy "
+                "without knowing the filer's cipher setting",
+                file=sys.stderr,
+            )
+            await session.close()
+            await channel.close()
+            return 1
+
         async def upload_chunk(data: bytes) -> FileChunk:
             resp = await stub.call(
                 "AssignVolume",
@@ -1063,16 +1085,24 @@ def cmd_filer_copy(argv: list[str]) -> int:
             )
             if resp.get("error"):
                 raise RuntimeError(resp["error"])
+            key = b""
+            payload = data
+            if cipher:
+                from ..util.cipher import encrypt, gen_cipher_key
+
+                key = gen_cipher_key()
+                payload = encrypt(data, key)
             # shared chunk-upload helper: multipart, JWT, the ttl query the
             # volume server stamps the needle TTL from, error-body checks
             result = await upload_data(
-                session, resp["url"], resp["file_id"], data,
+                session, resp["url"], resp["file_id"], payload,
                 ttl=args.ttl, jwt=resp.get("auth", ""),
             )
             return FileChunk(
                 fid=resp["file_id"], offset=0, size=len(data),
                 mtime_ns=_time.time_ns(),
                 etag=result.get("eTag", ""),
+                cipher_key=key,
             )
 
         async def copy_one(local: str, remote: str) -> None:

@@ -29,6 +29,7 @@ bottleneck either way. Kept the packed formulation (simpler, no MXU).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -43,17 +44,27 @@ LANE = 128
 SUBLANE = 8  # i32 min tile sublane count
 _MSB = np.uint32(0x80808080)
 _LOW7 = np.uint32(0xFEFEFEFE)
+_POLY = np.uint32(0x1D)
+
+# xtime formulation: "mul" folds the 0x1D reduction into one byte-parallel
+# i32 multiply (r is 0x00/0x01 per byte, and 1*0x1D = 29 < 256 so no byte
+# crosses its lane) — 6 VPU ops vs the 11-op shift/xor chain. The kernel is
+# VPU-op-bound, so fewer ops per word is directly throughput (measured in
+# bench kernel_roofline; override with SEAWEED_GF_XTIME=shift to compare).
+_XTIME_MODE = os.environ.get("SEAWEED_GF_XTIME", "mul")
 
 
-def _xtime(x):
+def _xtime(x, mode: str | None = None):
     """Byte-parallel multiply-by-2 in GF(2^8) on packed uint32 words."""
+    if (mode or _XTIME_MODE) == "mul":
+        return ((x << 1) & _LOW7) ^ (((x & _MSB) >> 7) * _POLY)
     msb = x & _MSB
     doubled = (x << 1) & _LOW7
     r = msb >> 7
     return doubled ^ (r << 4) ^ (r << 3) ^ (r << 2) ^ r
 
 
-def gf_matmul_expr(matrix: np.ndarray, rows: list):
+def gf_matmul_expr(matrix: np.ndarray, rows: list, xtime_mode: str | None = None):
     """out[i] = XOR_j matrix[i,j] * rows[j] in GF(2^8), on packed uint32.
 
     matrix is a static numpy uint8 [R, C]; rows is a list of C equal-shaped
@@ -74,36 +85,69 @@ def gf_matmul_expr(matrix: np.ndarray, rows: list):
                 if (col[i] >> k) & 1:
                     acc[i] = t if acc[i] is None else acc[i] ^ t
             if k + 1 < max_bits:
-                t = _xtime(t)
+                t = _xtime(t, xtime_mode)
     zero = jnp.zeros_like(rows[0])
     return [a if a is not None else zero for a in acc]
 
 
+def count_expr_ops(matrix: np.ndarray, xtime_mode: str | None = None) -> int:
+    """Static i32-op count of gf_matmul_expr per packed input WORD COLUMN
+    (i.e. per 4 bytes of every input row together) — the numerator of the
+    VPU roofline in bench kernel_roofline."""
+    mode = xtime_mode or _XTIME_MODE
+    per_xtime = 6 if mode == "mul" else 11
+    matrix = np.asarray(matrix, dtype=np.uint8)
+    r_cnt, c_cnt = matrix.shape
+    ops = 0
+    # acc in gf_matmul_expr is shared across COLUMNS: only each row's very
+    # first contribution overall is free, not its first per column
+    first = [True] * r_cnt
+    for j in range(c_cnt):
+        col = [int(matrix[i, j]) for i in range(r_cnt)]
+        max_bits = max((c.bit_length() for c in col), default=0)
+        if max_bits == 0:
+            continue
+        ops += (max_bits - 1) * per_xtime  # the shared chain
+        for k in range(max_bits):
+            for i in range(r_cnt):
+                if (col[i] >> k) & 1:
+                    if not first[i]:
+                        ops += 1  # XOR-accumulate
+                    first[i] = False
+    return ops
+
+
 # --- pure-jnp path (CPU fallback + reference for the kernel) ---
-@functools.partial(jax.jit, static_argnums=(0,))
-def _gf_matmul_jnp_packed(matrix_key, packed):
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def _gf_matmul_jnp_packed(matrix_key, packed, xtime_mode: str | None = None):
     matrix = np.asarray(matrix_key, dtype=np.uint8)
     rows = [packed[j] for j in range(matrix.shape[1])]
-    return jnp.stack(gf_matmul_expr(matrix, rows))
+    return jnp.stack(gf_matmul_expr(matrix, rows, xtime_mode))
 
 
 # --- pallas kernel ---
-def _gf_kernel(matrix: np.ndarray, data_ref, out_ref):
+def _gf_kernel(matrix: np.ndarray, xtime_mode, data_ref, out_ref):
     c_cnt = matrix.shape[1]
     rows = [data_ref[j] for j in range(c_cnt)]
-    outs = gf_matmul_expr(matrix, rows)
+    outs = gf_matmul_expr(matrix, rows, xtime_mode)
     for i, o in enumerate(outs):
         out_ref[i] = o
 
 
-@functools.partial(jax.jit, static_argnums=(0, 2, 3))
-def _gf_matmul_pallas(matrix_key, packed3d, block_rows: int, interpret: bool):
+@functools.partial(jax.jit, static_argnums=(0, 2, 3, 4))
+def _gf_matmul_pallas(
+    matrix_key,
+    packed3d,
+    block_rows: int,
+    interpret: bool,
+    xtime_mode: str | None = None,
+):
     """packed3d: uint32[C, S, LANE] with S % block_rows == 0 -> [R, S, LANE]."""
     matrix = np.asarray(matrix_key, dtype=np.uint8)
     r_cnt, c_cnt = matrix.shape
     _, s, lane = packed3d.shape
     return pl.pallas_call(
-        functools.partial(_gf_kernel, matrix),
+        functools.partial(_gf_kernel, matrix, xtime_mode),
         out_shape=jax.ShapeDtypeStruct((r_cnt, s, lane), jnp.uint32),
         grid=(s // block_rows,),
         in_specs=[
@@ -175,6 +219,7 @@ def gf_matmul_packed(
     block_rows: int = DEFAULT_BLOCK_ROWS,
     force_pallas: bool | None = None,
     interpret: bool = False,
+    xtime_mode: str | None = None,
 ):
     """GF(2^8) matmul on packed words: uint32[C, W] -> uint32[R, W].
 
@@ -191,13 +236,13 @@ def gf_matmul_packed(
     use_pallas = force_pallas if force_pallas is not None else _on_tpu()
     w = packed.shape[1]
     if not use_pallas and not interpret:
-        return _gf_matmul_jnp_packed(key, packed)
+        return _gf_matmul_jnp_packed(key, packed, xtime_mode)
     granule = block_rows * LANE
     if w % granule:
         pad = granule - w % granule
         packed = jnp.pad(packed, ((0, 0), (0, pad)))
     packed3d = packed.reshape(packed.shape[0], -1, LANE)
-    out = _gf_matmul_pallas(key, packed3d, block_rows, interpret)
+    out = _gf_matmul_pallas(key, packed3d, block_rows, interpret, xtime_mode)
     return out.reshape(out.shape[0], -1)[:, :w]
 
 

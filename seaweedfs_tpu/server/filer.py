@@ -442,9 +442,14 @@ class FilerServer:
             yield ev.to_dict()
 
     async def _grpc_configuration(self, req, context) -> dict:
+        # cipher is part of the contract: direct-to-volume uploaders
+        # (filer.copy) must learn it here and encrypt client-side, or the
+        # "volume servers only see ciphertext" guarantee silently breaks
+        # (ref filer_copy.go:114,180 reading GetFilerConfiguration.Cipher)
         return {
             "masters": [self.master],
             "collection": self.collection,
             "replication": self.replication,
             "max_mb": self.chunk_size // (1024 * 1024),
+            "cipher": self.cipher,
         }

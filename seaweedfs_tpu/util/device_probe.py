@@ -1,0 +1,50 @@
+"""Out-of-process jax-backend health probe, shared by bench.py and
+__graft_entry__.py.
+
+The tunneled backend has two live-observed failure modes: raising at
+transfer time, and HANGING forever in make_c_api_client when the relay is
+down. An in-process probe cannot survive the hang, so the probe runs a
+tiny device_put in a subprocess with a deadline.
+
+GRAFT_PROBE_CMD overrides the probe's Python code — the hermetic
+injection seam (tests force either verdict with e.g. "pass" /
+"import sys; sys.exit(3)" instead of depending on live tunnel state).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+DEFAULT_PROBE_CODE = (
+    "import jax, numpy as np; "
+    "jax.device_put(np.zeros(8, np.uint8)).block_until_ready()"
+)
+
+
+def probe_device_backend(timeout: float = 120.0) -> tuple[str, str]:
+    """-> (verdict, detail). Verdict is explicitly three-state so no
+    caller can truthiness-test a hang into "usable":
+
+    - "ok":      healthy backend
+    - "down":    probe failed fast (relay up, backend erroring)
+    - "timeout": probe hung to its deadline = hard-down relay
+    """
+    probe_code = os.environ.get("GRAFT_PROBE_CMD", DEFAULT_PROBE_CODE)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", probe_code],
+            capture_output=True,
+            timeout=timeout,
+        )
+        if proc.returncode == 0:
+            return "ok", ""
+        return "down", (
+            f"probe rc={proc.returncode}: "
+            + proc.stderr.decode("utf-8", "replace")[-300:]
+        )
+    except subprocess.TimeoutExpired:
+        return "timeout", f"probe HUNG >{timeout:.0f}s (dead relay/tunnel)"
+    except Exception as e:  # pragma: no cover - subprocess machinery
+        return "down", repr(e)

@@ -1,0 +1,126 @@
+"""The bench emission contract (VERDICT r4 item 1): the FINAL stdout line
+must stay under the driver's 2,000-char tail capture no matter how many
+metrics the bench grows, with the full record going to BENCH_DETAIL.json.
+Round 4's official artifact was `parsed: null` because the one-line JSON
+outgrew the window."""
+
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench = _load_bench()
+
+
+def _fat_headline(n_extra=14):
+    """A headline the size r5's bench realistically produces: every metric
+    carrying a fat detail dict, latency blocks, and long notes."""
+    extra = []
+    for i in range(n_extra):
+        extra.append(
+            {
+                "metric": f"metric.number_{i}.with_long_name",
+                "value": 123.456789,
+                "unit": "GB/s",
+                "vs_baseline": 17.42,
+                "detail": {
+                    "latency_ms": {"p50": 1.2, "p95": 3.4, "p99": 9.9},
+                    "n_volumes": 64,
+                    "host_cpus": 1,
+                    "long_note_payload": "x" * 400,
+                },
+                "note": "a long explanatory note " * 10,
+            }
+        )
+    extra.append({"metric": "broken.leg", "error": "E" * 500})
+    extra.append({"metric": "skipped.leg", "skipped": "bench budget spent"})
+    return {
+        "metric": "ec.encode_throughput",
+        "value": 65.241,
+        "unit": "GB/s",
+        "vs_baseline": 17.4,
+        "device_status": "tpu",
+        "extra": extra,
+    }
+
+
+def _run_emit(tmp_path, monkeypatch, headline):
+    detail = tmp_path / "BENCH_DETAIL.json"
+    # _emit_final writes next to bench.py; point it at tmp via __file__
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench._emit_final(headline)
+    lines = [l for l in buf.getvalue().splitlines() if l.strip()]
+    return lines, detail
+
+
+def test_final_line_fits_capture_window(tmp_path, monkeypatch):
+    lines, detail = _run_emit(tmp_path, monkeypatch, _fat_headline())
+    assert len(lines) == 1
+    line = lines[-1]
+    assert len(line.encode()) < 1900, len(line.encode())
+    parsed = json.loads(line)
+    assert parsed["metric"] == "ec.encode_throughput"
+    assert parsed["device_status"] == "tpu"
+    assert parsed["detail_file"] == "BENCH_DETAIL.json"
+    # compact entries keep the comparison numbers, drop the prose
+    by_name = {e.get("metric"): e for e in parsed["extra"]}
+    m0 = by_name["metric.number_0.with_long_name"]
+    assert m0["vs_baseline"] == 17.42
+    assert "detail" not in m0 and "note" not in m0
+    # errors survive, truncated
+    assert len(by_name["broken.leg"]["error"]) <= 60
+
+
+def test_detail_file_carries_everything(tmp_path, monkeypatch):
+    head = _fat_headline()
+    lines, detail = _run_emit(tmp_path, monkeypatch, head)
+    full = json.loads(detail.read_text())
+    assert full == head  # nothing lost
+
+
+def test_pathological_width_still_fits(tmp_path, monkeypatch):
+    """Even an absurd metric count degrades to a parseable <1.9KB line."""
+    lines, _ = _run_emit(tmp_path, monkeypatch, _fat_headline(n_extra=60))
+    line = lines[-1]
+    assert len(line.encode()) < 1900
+    parsed = json.loads(line)
+    assert parsed.get("extra_truncated") is True
+    assert parsed["value"] == 65.241  # headline always survives
+
+
+def test_dict_valued_metric_compacts_to_numbers(tmp_path, monkeypatch):
+    head = {
+        "metric": "ec.encode_throughput",
+        "value": 65.0,
+        "unit": "GB/s",
+        "vs_baseline": 17.0,
+        "device_status": "cpu_standin",
+        "extra": [
+            {
+                "metric": "ec.encode_throughput.geometries",
+                "value": {"6.3": 95.23456, "12.4": 79.0, "note": "prose"},
+                "unit": "GB/s",
+            }
+        ],
+    }
+    lines, _ = _run_emit(tmp_path, monkeypatch, head)
+    parsed = json.loads(lines[-1])
+    geo = parsed["extra"][0]["value"]
+    assert geo == {"6.3": 95.235, "12.4": 79.0}  # numbers kept, prose gone

@@ -218,3 +218,67 @@ def test_filer_copy_ttl_applied(tmp_path):
             await cluster.stop()
 
     asyncio.run(body())
+
+
+def test_filer_copy_cipher(tmp_path):
+    """With a cipher-enabled filer, filer.copy must learn the flag via
+    GetFilerConfiguration and encrypt chunks client-side: volume servers
+    only ever see ciphertext (ref filer_copy.go:114,180)."""
+    import asyncio
+
+    from tests.test_cluster import Cluster, free_port_pair
+
+    src = tmp_path / "src"
+    src.mkdir()
+    secret = b"TOP-SECRET-PAYLOAD-" * 64
+    (src / "s.bin").write_bytes(secret)
+
+    async def body():
+        import aiohttp
+
+        from seaweedfs_tpu.server.filer import FilerServer
+
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        fs = FilerServer(
+            master=cluster.master.address,
+            port=free_port_pair(),
+            cipher=True,
+        )
+        await fs.start()
+        try:
+            await fs.master_client.wait_connected()
+            from seaweedfs_tpu.command.cli import cmd_filer_copy
+
+            rc = await asyncio.to_thread(
+                cmd_filer_copy,
+                ["-filer", fs.address, str(src / "s.bin"), "/enc"],
+            )
+            assert rc == 0
+            entry = fs.filer.find_entry("/enc/s.bin")
+            assert entry is not None and entry.chunks
+            assert all(c.cipher_key for c in entry.chunks)
+            async with aiohttp.ClientSession() as session:
+                # read-back through the filer decrypts
+                async with session.get(
+                    f"http://{fs.address}/enc/s.bin"
+                ) as r:
+                    assert r.status == 200
+                    assert await r.read() == secret
+                # the raw needle on the volume server is ciphertext
+                from seaweedfs_tpu.client.operation import lookup
+
+                c = entry.chunks[0]
+                vid = c.fid.split(",")[0]
+                locs = await lookup(cluster.master.address, vid)
+                async with session.get(
+                    f"http://{locs[0]}/{c.fid}"
+                ) as r:
+                    assert r.status == 200
+                    raw = await r.read()
+                    assert secret[:64] not in raw
+        finally:
+            await fs.stop()
+            await cluster.stop()
+
+    asyncio.run(body())

@@ -93,10 +93,29 @@ def test_keepalive_sequential_and_bad_request(tmp_path):
             assert [s[1] for s in seen] == ["/x0", "/x1", "/x2"]
             assert seen[0][2] == "q=0"
 
-            # chunked request bodies are rejected with 400
+            # chunked request bodies are de-chunked and served (r5)
             w.write(
                 b"POST /y HTTP/1.1\r\nHost: h\r\n"
-                b"Transfer-Encoding: chunked\r\n\r\n0\r\n\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"
+                b"5\r\nhello\r\n0\r\n\r\n"
+            )
+            await w.drain()
+            head = await r.readuntil(b"\r\n\r\n")
+            assert b"200" in head.split(b"\r\n")[0]
+            n = int(
+                [
+                    ln.split(b":")[1]
+                    for ln in head.lower().split(b"\r\n")
+                    if ln.startswith(b"content-length")
+                ][0]
+            )
+            await r.readexactly(n)
+            assert seen[-1] == ("POST", "/y", "", b"hello")
+
+            # a non-chunked transfer coding is rejected with 400
+            w.write(
+                b"POST /z HTTP/1.1\r\nHost: h\r\n"
+                b"Transfer-Encoding: gzip\r\n\r\n"
             )
             await w.drain()
             head = await r.readuntil(b"\r\n\r\n")
@@ -351,5 +370,283 @@ def test_expect_100_continue_deferred_behind_pipelined_response():
             w.close()
         finally:
             await srv.stop()
+
+    _run(body())
+
+
+# ---------------- chunked request bodies (r5) ----------------
+async def _read_one_response(r):
+    head = await r.readuntil(b"\r\n\r\n")
+    status = int(head.split(b" ", 2)[1])
+    n = 0
+    for ln in head.lower().split(b"\r\n"):
+        if ln.startswith(b"content-length"):
+            n = int(ln.split(b":")[1])
+    body = await r.readexactly(n) if n else b""
+    return status, body
+
+
+def test_chunked_body_incremental_delivery():
+    """A chunked POST delivered byte-dribbled across many TCP segments is
+    assembled and handed to the fast handler with chunk framing removed."""
+
+    async def body():
+        seen = []
+
+        async def handler(req):
+            seen.append(
+                (
+                    bytes(req.body),
+                    req.headers.get(b"content-length"),
+                    b"transfer-encoding" in req.headers,
+                )
+            )
+            return render_response(200, b"ok")
+
+        srv = FastHTTPServer(handler)
+        port = free_port()
+        await srv.start("127.0.0.1", port)
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            payload = (
+                b"POST /u HTTP/1.1\r\nHost: h\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"
+                b"4\r\nWiki\r\n"
+                b"6\r\npedia \r\n"
+                b"b;ext=1\r\nin chunks.\n\r\n"
+                b"0\r\nTrailer: t\r\n\r\n"
+            )
+            for i in range(0, len(payload), 7):  # dribble
+                w.write(payload[i:i + 7])
+                await w.drain()
+                await asyncio.sleep(0)
+            st, _ = await _read_one_response(r)
+            assert st == 200
+            assert seen == [(b"Wikipedia in chunks.\n", b"21", False)]
+            # connection stays keep-alive usable
+            w.write(b"GET /after HTTP/1.1\r\nHost: h\r\n\r\n")
+            await w.drain()
+            st, _ = await _read_one_response(r)
+            assert st == 200
+            w.close()
+        finally:
+            await srv.stop()
+
+    _run(body())
+
+
+def test_chunked_body_fallback_replays_with_content_length():
+    """A chunked request the fast tier doesn't serve must replay to the
+    backend Content-Length-framed (the backend never sees chunked)."""
+
+    async def body():
+        backend_seen = []
+
+        async def backend_conn(r, w):
+            head = await r.readuntil(b"\r\n\r\n")
+            clen = 0
+            for ln in head.lower().split(b"\r\n"):
+                if ln.startswith(b"content-length:"):
+                    clen = int(ln.split(b":")[1])
+            data = await r.readexactly(clen) if clen else b""
+            backend_seen.append((head, data))
+            w.write(
+                b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n"
+                b"Connection: close\r\n\r\nhi"
+            )
+            await w.drain()
+            w.close()
+
+        bport = free_port()
+        backend = await asyncio.start_server(
+            backend_conn, "127.0.0.1", bport
+        )
+
+        async def handler(req):
+            return FALLBACK
+
+        srv = FastHTTPServer(handler, backend=("127.0.0.1", bport))
+        port = free_port()
+        await srv.start("127.0.0.1", port)
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.write(
+                b"PUT /f/a.txt HTTP/1.1\r\nHost: h\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"
+                b"3\r\nabc\r\n3\r\ndef\r\n0\r\n\r\n"
+            )
+            await w.drain()
+            st, resp = await _read_one_response(r)
+            assert (st, resp) == (200, b"hi")
+            head, data = backend_seen[0]
+            assert data == b"abcdef"
+            low = head.lower()
+            assert b"content-length: 6" in low
+            assert b"transfer-encoding" not in low
+            w.close()
+        finally:
+            await srv.stop()
+        backend.close()
+
+    _run(body())
+
+
+@pytest.mark.parametrize(
+    "raw",
+    [
+        # malformed chunk size line
+        b"POST / HTTP/1.1\r\nHost: h\r\nTransfer-Encoding: chunked\r\n\r\n"
+        b"zz\r\nabc\r\n0\r\n\r\n",
+        # chunk data not CRLF-terminated where claimed
+        b"POST / HTTP/1.1\r\nHost: h\r\nTransfer-Encoding: chunked\r\n\r\n"
+        b"3\r\nabcdef\r\n0\r\n\r\n",
+        # non-numeric Content-Length (ADVICE r4: must 400, not wedge)
+        b"POST / HTTP/1.1\r\nHost: h\r\nContent-Length: banana\r\n\r\n",
+        # negative Content-Length
+        b"POST / HTTP/1.1\r\nHost: h\r\nContent-Length: -5\r\n\r\n",
+    ],
+)
+def test_malformed_framing_rejected_with_400(raw):
+    async def body():
+        async def handler(req):
+            return render_response(200, b"ok")
+
+        srv = FastHTTPServer(handler)
+        port = free_port()
+        await srv.start("127.0.0.1", port)
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.write(raw)
+            await w.drain()
+            head = await r.readuntil(b"\r\n\r\n")
+            assert b"400" in head.split(b"\r\n")[0]
+            w.close()
+        finally:
+            await srv.stop()
+
+    _run(body())
+
+
+def test_chunked_expect_100_continue():
+    """curl -T from a pipe sends chunked + Expect: 100-continue and holds
+    the body until the interim response."""
+
+    async def body():
+        async def handler(req):
+            return render_response(200, b"n=%d" % len(req.body))
+
+        srv = FastHTTPServer(handler)
+        port = free_port()
+        await srv.start("127.0.0.1", port)
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.write(
+                b"PUT /p HTTP/1.1\r\nHost: h\r\n"
+                b"Transfer-Encoding: chunked\r\n"
+                b"Expect: 100-continue\r\n\r\n"
+            )
+            await w.drain()
+            interim = await r.readuntil(b"\r\n\r\n")
+            assert interim.startswith(b"HTTP/1.1 100 Continue")
+            w.write(b"3\r\nxyz\r\n0\r\n\r\n")
+            await w.drain()
+            st, resp = await _read_one_response(r)
+            assert (st, resp) == (200, b"n=3")
+            w.close()
+        finally:
+            await srv.stop()
+
+    _run(body())
+
+
+def test_proxy_streams_large_lenless_response():
+    """A big Content-Length-less backend response is relayed piecewise
+    (ADVICE r4: no full read(-1) materialization) and the client
+    connection close-framed."""
+
+    async def body():
+        big = bytes(range(256)) * (24 << 10)  # 6MB, > _STREAM_THRESHOLD
+
+        async def backend_conn(r, w):
+            await r.readuntil(b"\r\n\r\n")
+            w.write(b"HTTP/1.1 200 OK\r\nConnection: close\r\n\r\n")
+            for i in range(0, len(big), 1 << 16):
+                w.write(big[i:i + (1 << 16)])
+                await w.drain()
+            w.close()
+
+        bport = free_port()
+        backend = await asyncio.start_server(
+            backend_conn, "127.0.0.1", bport
+        )
+
+        async def handler(req):
+            return FALLBACK
+
+        srv = FastHTTPServer(handler, backend=("127.0.0.1", bport))
+        port = free_port()
+        await srv.start("127.0.0.1", port)
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.write(b"GET /big HTTP/1.1\r\nHost: h\r\n\r\n")
+            await w.drain()
+            head = await r.readuntil(b"\r\n\r\n")
+            assert b"200" in head.split(b"\r\n")[0]
+            data = await r.read(-1)  # close-framed
+            assert data == big
+            w.close()
+        finally:
+            await srv.stop()
+        backend.close()
+
+    _run(body())
+
+
+def test_proxy_partial_head_keeps_pipelined_connection():
+    """A backend that flushes the status line before the rest of the head
+    must not be misclassified as length-less: the client connection stays
+    alive and a pipelined second request is still answered."""
+
+    async def body():
+        async def backend_conn(r, w):
+            await r.readuntil(b"\r\n\r\n")
+            w.write(b"HTTP/1.1 200 OK\r\n")
+            await w.drain()
+            await asyncio.sleep(0.05)  # force a separate TCP segment
+            w.write(
+                b"Content-Length: 3\r\nConnection: close\r\n\r\nabc"
+            )
+            await w.drain()
+            w.close()
+
+        bport = free_port()
+        backend = await asyncio.start_server(
+            backend_conn, "127.0.0.1", bport
+        )
+
+        async def handler(req):
+            if req.path == "/fast":
+                return render_response(200, b"fast")
+            return FALLBACK
+
+        srv = FastHTTPServer(handler, backend=("127.0.0.1", bport))
+        port = free_port()
+        await srv.start("127.0.0.1", port)
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            # pipeline: fallback-bound request, then a fast one
+            w.write(
+                b"GET /slowhead HTTP/1.1\r\nHost: h\r\n\r\n"
+                b"GET /fast HTTP/1.1\r\nHost: h\r\n\r\n"
+            )
+            await w.drain()
+            st, resp = await _read_one_response(r)
+            assert (st, resp) == (200, b"abc")
+            st, resp = await _read_one_response(r)
+            assert (st, resp) == (200, b"fast")
+            w.close()
+        finally:
+            await srv.stop()
+        backend.close()
 
     _run(body())
