@@ -57,6 +57,15 @@ TTL_BYTES_LENGTH = 2
 
 PAIR_NAME_PREFIX = "Seaweed-"
 
+# hot-path packers (to_bytes): bound struct.Struct methods beat
+# int.to_bytes-per-field by several us per needle
+import struct as _struct
+
+_pack_header = _struct.Struct(">IQI").pack_into  # cookie, id, size
+_pack_u16 = _struct.Struct(">H").pack_into
+_pack_u32 = _struct.Struct(">I").pack_into
+_pack_u64 = _struct.Struct(">Q").pack_into
+
 
 class CrcError(Exception):
     """Data on disk corrupted (CRC mismatch)."""
@@ -194,8 +203,8 @@ class Needle:
         (ref needle_read_write.go:31-126).
         """
         self.checksum = masked_crc(self.data)
-        buf = io.BytesIO()
         if version == VERSION1:
+            buf = io.BytesIO()
             self.size = len(self.data)
             buf.write(u32_to_bytes(self.cookie))
             buf.write(u64_to_bytes(self.id))
@@ -209,34 +218,50 @@ class Needle:
         if version not in (VERSION2, VERSION3):
             raise ValueError(f"unsupported version {version}")
 
+        # single preallocated buffer + pack_into: this serializer sits on
+        # the per-request write path and the BytesIO/many-small-writes
+        # formulation was ~40us/needle at serving QPS rates
         self.size = self._computed_size_v2()
-        buf.write(u32_to_bytes(self.cookie))
-        buf.write(u64_to_bytes(self.id))
-        buf.write(u32_to_bytes(self.size))
-        if len(self.data) > 0:
-            buf.write(u32_to_bytes(len(self.data)))
-            buf.write(self.data)
-            buf.write(bytes([self.flags & 0xFF]))
+        dlen = len(self.data)
+        actual = get_actual_size(self.size, version)
+        out = bytearray(actual)  # padding arrives pre-zeroed
+        _pack_header(out, 0, self.cookie, self.id, self.size)
+        pos = NEEDLE_HEADER_SIZE
+        if dlen > 0:
+            _pack_u32(out, pos, dlen)
+            pos += 4
+            out[pos: pos + dlen] = self.data
+            pos += dlen
+            out[pos] = self.flags & 0xFF
+            pos += 1
             if self.has_name():
                 name = self.name[:255]
-                buf.write(bytes([len(name)]))
-                buf.write(name)
+                out[pos] = len(name)
+                out[pos + 1: pos + 1 + len(name)] = name
+                pos += 1 + len(name)
             if self.has_mime():
                 mime = self.mime[:255]
-                buf.write(bytes([len(mime)]))
-                buf.write(mime)
+                out[pos] = len(mime)
+                out[pos + 1: pos + 1 + len(mime)] = mime
+                pos += 1 + len(mime)
             if self.has_last_modified_date():
-                buf.write(u64_to_bytes(self.last_modified)[8 - LAST_MODIFIED_BYTES_LENGTH :])
+                out[pos: pos + LAST_MODIFIED_BYTES_LENGTH] = u64_to_bytes(
+                    self.last_modified
+                )[8 - LAST_MODIFIED_BYTES_LENGTH:]
+                pos += LAST_MODIFIED_BYTES_LENGTH
             if self.has_ttl() and self.ttl is not None:
-                buf.write(self.ttl.to_bytes())
+                out[pos: pos + TTL_BYTES_LENGTH] = self.ttl.to_bytes()
+                pos += TTL_BYTES_LENGTH
             if self.has_pairs():
-                buf.write(u16_to_bytes(len(self.pairs)))
-                buf.write(self.pairs)
-        buf.write(u32_to_bytes(self.checksum))
+                _pack_u16(out, pos, len(self.pairs))
+                pos += 2
+                out[pos: pos + len(self.pairs)] = self.pairs
+                pos += len(self.pairs)
+        _pack_u32(out, pos, self.checksum)
+        pos += 4
         if version == VERSION3:
-            buf.write(u64_to_bytes(self.append_at_ns))
-        buf.write(b"\x00" * padding_length(self.size, version))
-        return buf.getvalue(), len(self.data), get_actual_size(self.size, version)
+            _pack_u64(out, pos, self.append_at_ns)
+        return bytes(out), dlen, actual
 
     # --- parsing ---
     def parse_header(self, b: bytes) -> None:

@@ -3,12 +3,14 @@ assignment (ref: weed/topology/topology.go, topology_ec.go)."""
 
 from __future__ import annotations
 
+import secrets as _secrets
 import threading
 from typing import Dict, Optional
 
 from ..sequence import MemorySequencer
 from ..storage.erasure_coding import TOTAL_SHARDS_COUNT
 from ..storage.erasure_coding.ec_volume import ShardBits
+from ..storage.file_id import format_needle_id_cookie
 from ..storage.super_block import ReplicaPlacement
 from ..storage.ttl import EMPTY_TTL, TTL
 from .node import DataCenter, DataNode, Node
@@ -108,11 +110,7 @@ class Topology(Node):
         layout = self.get_volume_layout(collection, rp, ttl)
         vid, locations = layout.pick_for_write()
         file_id = self.sequence.next_file_id(count)
-        import secrets
-
-        from ..storage.file_id import format_needle_id_cookie
-
-        cookie = secrets.randbits(32)
+        cookie = _secrets.randbits(32)
         fid = f"{vid},{format_needle_id_cookie(file_id, cookie)}"
         return fid, count, locations
 

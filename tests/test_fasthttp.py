@@ -650,3 +650,30 @@ def test_proxy_partial_head_keeps_pipelined_connection():
         backend.close()
 
     _run(body())
+
+
+def test_client_content_length_as_final_header():
+    """The protocol-based client must parse a head whose Content-Length is
+    the LAST header (head excludes the blank line's CRLF) — and any parse
+    error must resolve the request future, never hang it."""
+
+    async def body():
+        async def conn(r, w):
+            await r.readuntil(b"\r\n\r\n")
+            w.write(
+                b"HTTP/1.1 200 OK\r\nContent-Type: text/plain\r\n"
+                b"Content-Length: 5\r\n\r\nhello"
+            )
+            await w.drain()
+
+        srv = await asyncio.start_server(conn, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        c = FastHTTPClient()
+        st, resp = await asyncio.wait_for(
+            c.request("GET", f"127.0.0.1:{port}", "/"), 5
+        )
+        assert (st, resp) == (200, b"hello")
+        await c.close()
+        srv.close()
+
+    _run(body())
