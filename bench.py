@@ -81,6 +81,31 @@ def measure_cpu_baseline(codec, data: np.ndarray, min_seconds: float = 1.0) -> f
             return n_bytes * iters / dt / 1e9
 
 
+def _measured_gbps(
+    encode_fn, packed, n_bytes: int, k_lo: int = 8, k_hi: int = 64,
+    reps: int = 5,
+) -> float:
+    """Shared device-timing harness: jit, compile+warm through a scalar
+    digest (forces the whole FIFO queue to drain — the only trustworthy
+    timing discipline over the tunnel's RTT noise), then slope-time."""
+    import jax
+    import jax.numpy as jnp
+
+    encode = jax.jit(encode_fn)
+    digest = jax.jit(lambda x: x.sum(dtype=jnp.uint32))
+    _ = np.asarray(digest(encode(packed)))  # compile + warm
+
+    def run(k: int) -> float:
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(k):
+            out = encode(packed)
+        _ = np.asarray(digest(out))
+        return time.perf_counter() - t0
+
+    return n_bytes / _slope_time(run, k_lo=k_lo, k_hi=k_hi, reps=reps) / 1e9
+
+
 def measure_tpu(parity_matrix, packed_np: np.ndarray) -> float:
     """GB/s of data encoded on device (slope-timed)."""
     import jax
@@ -89,22 +114,11 @@ def measure_tpu(parity_matrix, packed_np: np.ndarray) -> float:
     from seaweedfs_tpu.ops.gf256 import gf_matmul_packed
 
     packed = jax.device_put(jnp.asarray(packed_np))
-    n_bytes = packed_np.size * 4
-
-    encode = jax.jit(lambda p: gf_matmul_packed(parity_matrix, p))
-    digest = jax.jit(lambda x: x.sum(dtype=jnp.uint32))
-
-    _ = np.asarray(digest(encode(packed)))  # compile + warm
-
-    def run(k: int) -> float:
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(k):
-            out = encode(packed)
-        _ = np.asarray(digest(out))  # forces the whole FIFO queue to drain
-        return time.perf_counter() - t0
-
-    return n_bytes / _slope_time(run) / 1e9
+    return _measured_gbps(
+        lambda p: gf_matmul_packed(parity_matrix, p),
+        packed,
+        packed_np.size * 4,
+    )
 
 
 def measure_kernel_roofline(parity_matrix, packed_np: np.ndarray) -> dict:
@@ -126,7 +140,6 @@ def measure_kernel_roofline(parity_matrix, packed_np: np.ndarray) -> dict:
     packed_np = packed_np[:, : min(packed_np.shape[1], 1 << 20)]
     packed = jax.device_put(jnp.asarray(packed_np))
     n_bytes = packed_np.size * 4
-    digest = jax.jit(lambda x: x.sum(dtype=jnp.uint32))
 
     VPU_PEAK = 3.9e12
     HBM_PEAK = 819e9
@@ -139,22 +152,10 @@ def measure_kernel_roofline(parity_matrix, packed_np: np.ndarray) -> dict:
     }
     best_mode, best_gbps = None, 0.0
     for mode in ("mul", "shift"):
-        encode = jax.jit(
-            lambda p, m=mode: gf_matmul_packed(
-                parity_matrix, p, xtime_mode=m
-            )
+        gbps = _measured_gbps(
+            lambda p, m=mode: gf_matmul_packed(parity_matrix, p, xtime_mode=m),
+            packed, n_bytes, k_lo=4, k_hi=16, reps=3,
         )
-        _ = np.asarray(digest(encode(packed)))  # compile + warm
-
-        def run(k: int) -> float:
-            t0 = time.perf_counter()
-            o = None
-            for _ in range(k):
-                o = encode(packed)
-            _ = np.asarray(digest(o))
-            return time.perf_counter() - t0
-
-        gbps = n_bytes / _slope_time(run, k_lo=4, k_hi=16, reps=3) / 1e9
         ops_per_word_col = count_expr_ops(parity_matrix, mode)
         ops_per_input_byte = ops_per_word_col / (
             4 * parity_matrix.shape[1]
@@ -176,6 +177,38 @@ def measure_kernel_roofline(parity_matrix, packed_np: np.ndarray) -> dict:
     out["best_mode"] = best_mode
     out["mul_vs_shift"] = round(
         out["mul"]["gbps"] / max(out["shift"]["gbps"], 1e-9), 2
+    )
+    return out
+
+
+def measure_mxu_bitslice(parity_matrix, packed_np: np.ndarray) -> dict:
+    """MXU bit-slice prototype vs the packed VPU kernel, same batch,
+    slope-timed (VERDICT r4 item 5). Answers whether routing the GF(2^8)
+    matmul through the MXU (binary matmul over bit planes) beats the VPU
+    xtime formulation — the prototype's earlier out-of-tree measurement
+    (~63 GB/s, on par) is now reproducible from the tree."""
+    import jax
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ops.gf256 import (
+        gf_matmul_bitsliced,
+        gf_matmul_packed,
+    )
+
+    packed_np = packed_np[:, : min(packed_np.shape[1], 1 << 20)]
+    packed = jax.device_put(jnp.asarray(packed_np))
+    n_bytes = packed_np.size * 4
+
+    out: dict = {"bytes": n_bytes}
+    for name, fn in (
+        ("bitslice", lambda p: gf_matmul_bitsliced(parity_matrix, p)),
+        ("packed", lambda p: gf_matmul_packed(parity_matrix, p)),
+    ):
+        out[f"{name}_gbps"] = round(
+            _measured_gbps(fn, packed, n_bytes, k_lo=2, k_hi=8, reps=3), 3
+        )
+    out["vs_packed"] = round(
+        out["bitslice_gbps"] / max(out["packed_gbps"], 1e-9), 2
     )
     return out
 
@@ -488,6 +521,96 @@ def measure_lookup_gate_decomposition(n_entries: int = 1_000_000) -> dict:
     }
 
 
+def measure_write_budget() -> dict:
+    """Per-request microsecond budget of one serving POST's components
+    (VERDICT r4 item 2's 'publish the budget'): each leg timed standalone,
+    best-of-3 over thousands of reps. The gap between the component sum
+    and the measured end-to-end p50 is event-loop + socket machinery —
+    the remainder the fast tier pays per hop on this 1-core host."""
+    import tempfile
+
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+    from seaweedfs_tpu.types import VERSION3
+    from seaweedfs_tpu.util.fasthttp import build_multipart, parse_multipart
+
+    def best_us(fn, n=5000) -> float:
+        for _ in range(200):
+            fn()
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                fn()
+            best = min(best, time.perf_counter() - t0)
+        return best / n * 1e6
+
+    out: dict = {}
+    data = b"x" * 1024
+    n_obj = Needle(cookie=0x1234, id=42, data=data)
+    out["needle_to_bytes_us"] = round(best_us(
+        lambda: n_obj.to_bytes(VERSION3)), 2)
+
+    import shutil
+
+    d = tempfile.mkdtemp(
+        prefix="bench_budget_",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None,
+    )
+    try:
+        v = Volume(d, "", 9, create=True)
+        try:
+            seq = [0]
+
+            def wr():
+                seq[0] += 1
+                v.write_needle(Needle(cookie=1, id=seq[0], data=data))
+
+            out["volume_write_needle_us"] = round(best_us(wr), 2)
+        finally:
+            v.close()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+    body, ctype = build_multipart("file", data)
+    ctype_b = ctype.encode()
+    out["parse_multipart_us"] = round(best_us(
+        lambda: parse_multipart(body, ctype_b)), 2)
+
+    from seaweedfs_tpu.util.fasthttp import FastHTTPProtocol, FastHTTPServer
+
+    raw = (
+        b"POST /9,0123456789ab HTTP/1.1\r\nHost: h\r\n"
+        b"Content-Length: %d\r\n\r\n" % len(body) + body
+    )
+
+    class _T:
+        def pause_reading(self):
+            pass
+
+        def resume_reading(self):
+            pass
+
+        def is_closing(self):
+            return False
+
+    proto = FastHTTPProtocol(FastHTTPServer(None))
+    proto.transport = _T()
+
+    def parse():
+        proto.buf += raw
+        proto._try_parse()
+
+    out["http_parse_us"] = round(best_us(parse), 2)
+    out["component_sum_us"] = round(sum(
+        v for k, v in out.items() if k.endswith("_us")), 1)
+    out["note"] = (
+        "assign RPC + 2x(socket send/recv + event-loop wakeups) + client "
+        "side are the remainder of the measured write p50"
+    )
+    return out
+
+
 def measure_rebuild() -> tuple[float, float]:
     """ec.rebuild throughput (BASELINE.json config 2): reconstruct 4 lost
     shards (2 data + 2 parity) from 10 survivors — the same constant-matrix
@@ -564,6 +687,44 @@ def _rm_shards(base: str) -> None:
             pass
 
 
+def _measure_io_legs(d: str, base: str, sample: int = 512 << 20) -> dict:
+    """Per-leg file-IO unit costs on the e2e working directory, measured
+    back-to-back with the pipelines so throttle state matches: sequential
+    read of the existing .dat (readinto, preallocated buffer) and a
+    fresh-file write (page allocation + copy — the cost every new shard
+    file pays). -> {read_gbps, fresh_write_gbps}; the route-dependent
+    ceilings are assembled in _e2e_results where the executed route is
+    known."""
+    sample = min(sample, os.path.getsize(base + ".dat"))
+    buf = bytearray(64 << 20)
+    mv = memoryview(buf)
+    t0 = time.perf_counter()
+    got = 0
+    with open(base + ".dat", "rb", buffering=0) as f:
+        while got < sample:
+            n = f.readinto(mv[: min(len(buf), sample - got)])
+            if not n:
+                break
+            got += n
+    read_gbps = got / (time.perf_counter() - t0) / 1e9
+
+    scratch = os.path.join(d, "_io_leg_scratch")
+    block = bytes(buf)
+    t0 = time.perf_counter()
+    written = 0
+    with open(scratch, "wb") as f:
+        while written < sample:
+            n = f.write(block[: min(len(block), sample - written)])
+            written += n
+    write_gbps = written / (time.perf_counter() - t0) / 1e9
+    os.remove(scratch)
+
+    return {
+        "read_gbps": round(read_gbps, 2),
+        "fresh_write_gbps": round(write_gbps, 2),
+    }
+
+
 def measure_encode_e2e(size_bytes: int = 4 << 30, emit=None):
     """End-to-end `ec.encode` of one .dat through write_ec_files: disk reads,
     host packing, encode and shard writes included (BASELINE.json config 1;
@@ -638,7 +799,10 @@ def measure_encode_e2e(size_bytes: int = 4 << 30, emit=None):
             )
 
         def run_best():
+            from seaweedfs_tpu.storage.erasure_coding import encoder as _enc
+
             write_ec_files(base, codec=best)
+            result["best_route"] = dict(_enc.LAST_ROUTE)
 
         golden = None
         best_samples = None
@@ -671,6 +835,19 @@ def measure_encode_e2e(size_bytes: int = 4 << 30, emit=None):
             result["host_memcpy_gbps"] = round(measure_memcpy_roofline(), 2)
         except Exception:
             pass
+        try:
+            # the REAL e2e roofline (VERDICT r4 item 8): file IO on this
+            # host is 2-4x slower than memcpy (fresh tmpfs writes fault +
+            # zero pages; reads allocate), so the honest ceiling is built
+            # from measured file-leg unit costs IN THE SAME THROTTLE
+            # WINDOW: read the source once, write 1.4 bytes of shards
+            result["io_legs"] = _measure_io_legs(d, base)
+        except Exception:
+            pass
+        if emit:
+            # the device leg below can die to a slow tunnel; the roofline
+            # and memcpy context must already be in the last partial
+            emit(result)
 
         # --- device pipeline (always measured, even when transfer-bound;
         # smaller cap so a slow tunnel can't eat the whole timebox) ---
@@ -1064,6 +1241,38 @@ def _e2e_results(r: dict) -> list:
             entry["memcpy_equiv_per_byte"] = round(
                 mem / max(r["best_gbps"], 1e-9), 2
             )
+        legs = r.get("io_legs")
+        if legs:
+            # the e2e roofline (VERDICT r4 item 8): ceilings built from
+            # measured FILE-leg unit costs in the same throttle window —
+            # memcpy overstates this host's file IO by 2-4x (fresh tmpfs
+            # writes fault+zero pages, reads allocate), which is why
+            # memcpy_equiv_per_byte ~5 looked like headroom that file IO
+            # physics doesn't actually offer. Two bounds, route-aware:
+            # every route reads the source once and fresh-writes parity;
+            # a route that fresh-writes data shards too (onepass/inline)
+            # pays 1.4/W, one that splices them kernel-side pays ~1.0/W
+            # of kernel copy + 1.4/memcpy of encode passes instead.
+            R, W = legs["read_gbps"], legs["fresh_write_gbps"]
+            mem_bw = r.get("host_memcpy_gbps") or 8.0
+            c_fresh = 1.0 / (1.0 / R + 1.4 / W)
+            c_splice = 1.0 / (
+                1.0 / R + 1.0 / W + 0.4 / W + 1.4 / mem_bw
+            )
+            route = r.get("best_route", {})
+            applicable = c_splice if route.get("spliced") else c_fresh
+            entry["e2e_roofline"] = {
+                **legs,
+                "route": route,
+                "ceiling_fresh_gbps": round(c_fresh, 3),
+                "ceiling_spliced_gbps": round(c_splice, 3),
+                "fraction_of_ceiling": round(
+                    r["best_gbps"] / max(applicable, 1e-9), 2
+                ),
+                "model": "fresh: 1/(1/R + 1.4/W); spliced: 1/(1/R + "
+                "1.4/W + 1.4/memcpy) with data shards kernel-copied at "
+                "~W; fraction is vs the executed route's bound",
+            }
         out.append(entry)
     return out
 
@@ -1217,6 +1426,37 @@ def main() -> None:
         extra.append({"metric": "kernel_roofline", "error": str(e)[:200]})
 
     try:
+        if not budgeted("kernel_mxu_bitslice", 60):
+            raise _Skip()
+        if _device_status() != "tpu":
+            # there is no MXU on the CPU stand-in: a number here answers
+            # nothing and eats budget real metrics need
+            extra.append(
+                {"metric": "kernel_mxu_bitslice", "skipped": "no MXU on "
+                 "CPU stand-in (device_status != tpu)"}
+            )
+            raise _Skip()
+        mx = measure_mxu_bitslice(codec.parity_matrix, packed)
+        extra.append(
+            {
+                "metric": "kernel_mxu_bitslice",
+                "value": mx["bitslice_gbps"],
+                "unit": "GB/s",
+                "vs_baseline": mx["vs_packed"],
+                "detail": mx,
+                "note": "MXU bit-slice prototype (GF(2) matmul over bit "
+                "planes, ops/gf256.gf_matmul_bitsliced) vs the shipping "
+                "packed VPU kernel on the same HBM-resident batch "
+                "(VERDICT r4 item 5's in-tree prototype + measurement); "
+                "meaningful only when device_status=tpu",
+            }
+        )
+    except _Skip:
+        pass
+    except Exception as e:
+        extra.append({"metric": "kernel_mxu_bitslice", "error": str(e)[:200]})
+
+    try:
         if not budgeted("ec.encode.host_kernel", 15):
             raise _Skip()
         # shipping host codec (GFNI tier where the CPU has it) vs the
@@ -1338,6 +1578,26 @@ def main() -> None:
         pass
     except Exception as e:
         extra.append({"metric": "serving_read_qps", "error": str(e)[:200]})
+
+    try:
+        if not budgeted("serving_write_budget", 25):
+            raise _Skip()
+        wb = measure_write_budget()
+        extra.append(
+            {
+                "metric": "serving_write_budget",
+                "value": wb["component_sum_us"],
+                "unit": "us (component sum)",
+                "detail": wb,
+                "note": "per-request budget of one POST's handler "
+                "components (VERDICT r4 item 2); the measured write p50 "
+                "minus this sum is event-loop + socket machinery",
+            }
+        )
+    except _Skip:
+        pass
+    except Exception as e:
+        extra.append({"metric": "serving_write_budget", "error": str(e)[:200]})
 
     try:
         if not budgeted("ec.encode_throughput.geometries", 90):

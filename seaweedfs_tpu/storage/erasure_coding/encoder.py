@@ -40,6 +40,10 @@ from ..super_block import SuperBlock
 
 DEFAULT_CHUNK = 4 * 1024 * 1024  # per-shard streaming chunk
 
+# set by write_ec_files after each run: {"route": ..., "spliced": bool} —
+# benchmark/diagnostic introspection, not part of the encode contract
+LAST_ROUTE: dict = {}
+
 
 def _get_codec(codec):
     if codec is None:
@@ -628,6 +632,7 @@ def write_ec_files(
     this host (_calibrate_host_route) — the ranking is
     hardware-dependent and point probes proved unreliable.
     """
+    global LAST_ROUTE
     codec = _get_codec(codec)
     # structure flags left None = "pick for me", resolved PER FLAG from
     # the calibrated route — an explicit pipeline=False or splice_data
@@ -677,6 +682,7 @@ def write_ec_files(
             n_large, large_block_size, n_small, small_block_size,
             chunk=chunk,
         ):
+            LAST_ROUTE = {"route": "onepass", "spliced": False}
             return
 
     spliced = False
@@ -685,6 +691,12 @@ def write_ec_files(
             dat_path, base_file_name, k,
             n_large, large_block_size, n_small, small_block_size,
         )
+    # introspection for benchmarks/diagnostics: which structure actually
+    # ran (the roofline model differs when data shards were spliced)
+    LAST_ROUTE = {
+        "route": "pipeline" if pipeline else ("mmap" if use_mmap else "pread"),
+        "spliced": spliced,
+    }
 
     outputs = [
         None if (spliced and i < k) else open(base_file_name + to_ext(i), "wb")
