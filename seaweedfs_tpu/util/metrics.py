@@ -34,12 +34,30 @@ class Counter(_Labeled):
         with self._lock:
             self._values[key] += amount
 
+    def child(self, **labels) -> "_CounterChild":
+        """Pre-bound label set with O(1) inc — for per-request hot paths
+        where tuple(sorted(labels.items())) per call is measurable."""
+        return _CounterChild(self, tuple(sorted(labels.items())))
+
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
             for key, v in self._values.items():
                 out.append(f"{self.name}{_fmt_labels(key)} {v}")
         return out
+
+
+class _CounterChild:
+    __slots__ = ("_counter", "_key")
+
+    def __init__(self, counter: Counter, key: tuple):
+        self._counter = counter
+        self._key = key
+
+    def inc(self, amount: float = 1.0) -> None:
+        c = self._counter
+        with c._lock:
+            c._values[self._key] += amount
 
 
 class Gauge(_Labeled):

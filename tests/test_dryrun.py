@@ -174,3 +174,20 @@ def test_entry_pins_cpu_when_default_backend_broken(monkeypatch):
         assert out.shape == (2, 4, 1024)
     finally:
         restore()
+
+
+def test_device_probe_three_state(monkeypatch):
+    """probe_device_backend is explicitly three-state; the GRAFT_PROBE_CMD
+    seam forces each verdict hermetically."""
+    from seaweedfs_tpu.util.device_probe import probe_device_backend
+
+    monkeypatch.setenv("GRAFT_PROBE_CMD", "pass")
+    assert probe_device_backend(timeout=30)[0] == "ok"
+
+    monkeypatch.setenv("GRAFT_PROBE_CMD", "import sys; sys.exit(3)")
+    verdict, detail = probe_device_backend(timeout=30)
+    assert verdict == "down" and "rc=3" in detail
+
+    monkeypatch.setenv("GRAFT_PROBE_CMD", "import time; time.sleep(30)")
+    verdict, detail = probe_device_backend(timeout=1.0)
+    assert verdict == "timeout" and "HUNG" in detail

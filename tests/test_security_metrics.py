@@ -246,3 +246,18 @@ def test_jwt_cluster_end_to_end(tmp_path):
             await ms.stop()
 
     asyncio.run(body())
+
+
+def test_counter_child_prebound_labels():
+    """Counter.child pre-binds a label set; increments land on the same
+    series as kwargs inc() and render identically."""
+    from seaweedfs_tpu.util.metrics import Counter
+
+    c = Counter("test_child_total")
+    c.inc(server="volume", operation="GET")
+    child = c.child(operation="GET", server="volume")  # order-insensitive
+    child.inc()
+    child.inc(2.5)
+    rendered = "\n".join(c.render())
+    assert 'operation="GET"' in rendered and 'server="volume"' in rendered
+    assert "4.5" in rendered
