@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import functools as _functools
 import os
+import time
 from typing import Optional
 
 import aiohttp
@@ -149,6 +150,7 @@ class VolumeServer(EcHandlers):
         self._codec = None
         self._group_committers: dict[int, object] = {}
         self._req_counters: dict[str, object] = {}
+        self._replica_loc_cache: dict[int, tuple[float, list]] = {}
         # cross-request probe batching (north-star #2 serving path):
         # off | auto (bulk_lookup's device policy) | host | device
         self.lookup_gate = None
@@ -1089,6 +1091,16 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
 
     # ---------------- replication (ref store_replicate.go:20-121) ----------------
     async def _lookup_volume(self, vid: int) -> list[str]:
+        """Replica locations for vid, TTL-cached: a master RPC per
+        replicated WRITE would put the master on every write's critical
+        path (the reference serves this from wdclient's vid cache,
+        ref store_replicate.go:100). Short TTL: topology changes
+        (fix.replication, volume moves) must be picked up promptly."""
+        cached = self._replica_loc_cache.get(vid)
+        now = time.monotonic()  # wall-clock steps must not break the TTL
+        if cached is not None and now - cached[0] < 2.0:
+            return cached[1]
+        locations: list[str] = []
         try:
             stub = Stub(grpc_address(self.master), "master")
             resp = await stub.call("LookupVolume", {"volume_ids": [str(vid)]})
@@ -1096,10 +1108,26 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
                 if int(r.get("volumeId", "0").split(",")[0]) == vid and r.get(
                     "locations"
                 ):
-                    return [l["url"] for l in r["locations"]]
+                    locations = [l["url"] for l in r["locations"]]
+                    break
         except Exception:
-            pass
-        return []
+            # master unreachable: serve the stale entry only within a
+            # bounded window — beyond it, stale locations would keep
+            # routing writes/redirects to servers the volume left
+            if cached is not None and now - cached[0] < 30.0:
+                return cached[1]
+            return []
+        if not locations:
+            # a transient empty answer (heartbeat lag) must cost one
+            # request, not a 2s window of failed replication; empty
+            # results are also what bogus client-supplied vids produce,
+            # so not caching them keeps the dict scanner-proof
+            self._replica_loc_cache.pop(vid, None)
+            return []
+        if len(self._replica_loc_cache) > 4096:  # runaway-vid backstop
+            self._replica_loc_cache.clear()
+        self._replica_loc_cache[vid] = (now, locations)
+        return locations
 
     async def _replicate(
         self, request: web.Request, vid: int, method: str, body: bytes

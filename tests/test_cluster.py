@@ -426,3 +426,43 @@ def test_cluster_ec_rebuild_balance_lifecycle(tmp_path):
             await cluster.stop()
 
     asyncio.run(body())
+
+
+def test_replica_location_cache(tmp_path):
+    """Replicated writes must not pay a master LookupVolume RPC each: the
+    locations are TTL-cached on the primary (ref store_replicate.go:100
+    serves them from wdclient's vid cache)."""
+
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=2)
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                ar = await assign(cluster.master.address, replication="001")
+                vid = int(ar.fid.split(",")[0])
+                await upload_data(session, ar.url, ar.fid, b"first")
+                primary = next(
+                    vs for vs in cluster.volume_servers
+                    if ar.url in (vs.address, vs.public_url)
+                )
+                assert vid in primary._replica_loc_cache
+                # poison the master address: a cached lookup must not RPC
+                real_master = primary.master
+                primary.master = "127.0.0.1:1"
+                try:
+                    ar2 = await assign(
+                        cluster.master.address, replication="001"
+                    )
+                    if int(ar2.fid.split(",")[0]) == vid and ar2.url == ar.url:
+                        await upload_data(session, ar2.url, ar2.fid, b"second")
+                finally:
+                    primary.master = real_master
+                # and both replicas hold the first write either way
+                locs = await lookup(cluster.master.address, vid)
+                for url in locs:
+                    got = await read_url(session, f"http://{url}/{ar.fid}")
+                    assert got == b"first"
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
