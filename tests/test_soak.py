@@ -1,0 +1,155 @@
+"""Sustained mixed-load soak over an in-process cluster: writes, reads,
+deletes, and a mid-run vacuum racing them, with a memory-growth bound.
+
+Gated behind SEAWEED_SOAK=1 (wall-clock heavy; the CI-default suite stays
+fast). Run manually:  SEAWEED_SOAK=1 python -m pytest tests/test_soak.py -q
+"""
+
+import asyncio
+import os
+import random
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("SEAWEED_SOAK") != "1",
+    reason="soak test: set SEAWEED_SOAK=1 to run",
+)
+
+
+def _rss_mb() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024
+    return 0.0
+
+
+def test_soak_mixed_load(tmp_path):
+    import aiohttp
+
+    from tests.test_cluster import Cluster, assign_retry
+
+    duration = float(os.environ.get("SEAWEED_SOAK_SECONDS", 45))
+
+    async def body():
+        from seaweedfs_tpu.client import assign
+        from seaweedfs_tpu.client.operation import upload_data
+
+        cluster = Cluster(tmp_path, n_volume_servers=2)
+        await cluster.start()
+        stats = {"writes": 0, "reads": 0, "deletes": 0, "errors": 0}
+        live: dict = {}  # fid -> (url, payload)
+        stop = asyncio.Event()
+
+        async def writer(session):
+            while not stop.is_set():
+                try:
+                    ar = await assign(cluster.master.address)
+                    data = random.randbytes(random.randint(100, 8000))
+                    await upload_data(session, ar.url, ar.fid, data)
+                    live[ar.fid] = (ar.url, data)
+                    stats["writes"] += 1
+                    # bound harness-retained payloads: on hour-long soaks
+                    # an unbounded dict would read as a fake "leak"
+                    while len(live) > 2000:
+                        live.pop(next(iter(live)))
+                except Exception:
+                    stats["errors"] += 1
+                    await asyncio.sleep(0.05)
+
+        async def reader(session):
+            while not stop.is_set():
+                if not live:
+                    await asyncio.sleep(0.01)
+                    continue
+                fid = random.choice(list(live))
+                pair = live.get(fid)
+                if pair is None:
+                    continue
+                url, data = pair
+                try:
+                    async with session.get(f"http://{url}/{fid}") as r:
+                        body_bytes = await r.read()
+                        # a fid deleted between choice and GET may 404
+                        if r.status == 200 and fid in live:
+                            assert body_bytes == live[fid][1]
+                            stats["reads"] += 1
+                except Exception:
+                    stats["errors"] += 1
+
+        async def deleter(session):
+            while not stop.is_set():
+                await asyncio.sleep(0.05)
+                if len(live) < 50:
+                    continue
+                fid = random.choice(list(live))
+                url, _ = live.pop(fid)
+                try:
+                    async with session.delete(f"http://{url}/{fid}") as r:
+                        if r.status < 300:
+                            stats["deletes"] += 1
+                except Exception:
+                    stats["errors"] += 1
+
+        async def vacuumer(session):
+            while not stop.is_set():
+                try:
+                    await asyncio.wait_for(stop.wait(), duration / 4)
+                    return  # stop requested during the wait
+                except asyncio.TimeoutError:
+                    pass
+                try:
+                    async with session.get(
+                        f"http://{cluster.master.address}/vol/vacuum"
+                        "?garbageThreshold=0.05"
+                    ):
+                        pass
+                except Exception:
+                    stats["errors"] += 1
+
+        try:
+            await assign_retry(cluster.master.address)  # volumes grown
+            rss_start = _rss_mb()
+            async with aiohttp.ClientSession() as session:
+                tasks = [
+                    asyncio.ensure_future(writer(session)) for _ in range(4)
+                ] + [
+                    asyncio.ensure_future(reader(session)) for _ in range(4)
+                ] + [
+                    asyncio.ensure_future(deleter(session)),
+                    asyncio.ensure_future(vacuumer(session)),
+                ]
+                await asyncio.sleep(duration)
+                stop.set()
+                await asyncio.gather(*tasks, return_exceptions=True)
+
+                # every surviving fid still reads back bit-exact
+                sample = random.sample(
+                    list(live.items()), min(len(live), 200)
+                )
+                for fid, (url, data) in sample:
+                    async with session.get(f"http://{url}/{fid}") as r:
+                        assert r.status == 200, f"{fid}: {r.status}"
+                        assert await r.read() == data
+            rss_growth = _rss_mb() - rss_start
+            min_ops = max(20, duration * 2)
+            assert stats["writes"] > min_ops, stats
+            assert stats["reads"] > min_ops, stats
+            assert stats["deletes"] > duration / 4, stats
+            # error share must stay marginal (transient growth races only)
+            total = stats["writes"] + stats["reads"] + stats["deletes"]
+            assert stats["errors"] < total * 0.02, stats
+            # leak bound, duration-scaled: the harness dict is capped at
+            # 2k entries (~10 MB) and the needle maps legitimately grow
+            # with the written set, so allow linear headroom over a flat
+            # floor before calling it a leak
+            bound = 300 + duration * 4
+            assert rss_growth < bound, (
+                f"RSS grew {rss_growth:.0f} MB (> {bound:.0f}): {stats}"
+            )
+            print(f"soak: {stats}, rss +{rss_growth:.0f} MB")
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
