@@ -1467,6 +1467,7 @@ def main() -> None:
     from seaweedfs_tpu.ops.gf256 import pack_bytes_host
     from seaweedfs_tpu.storage.erasure_coding.coder_cpu import CpuRSCodec
     from seaweedfs_tpu.tpu.coder import get_codec
+    from seaweedfs_tpu.util import available_cpus
 
     # global wall-clock budget: a driver-side kill before the final print
     # would lose EVERY number, so each secondary metric checks the budget
@@ -1692,7 +1693,10 @@ def main() -> None:
                 "detail": qps,
                 "note": "in-process cluster (byte-level fast tier) on "
                 f"tmpfs, 1KB x {qps.get('num_files')} files, "
-                f"c={qps.get('concurrency')}; read_qps_batched = "
+                f"c={qps.get('concurrency')}, host_cpus="
+                f"{available_cpus()} "
+                "(reference numbers are from a multicore MacBook); "
+                "read_qps_batched = "
                 "BatchLookupGate micro-batched probes; latency blocks "
                 "comparable row-for-row with BASELINE.md. At fixed "
                 "concurrency p50 ~= c/QPS (closed loop), so a p50 bar "
@@ -1784,7 +1788,7 @@ def main() -> None:
                 "detail": m,
                 "note": f"{m['n_volumes']} volumes encoded concurrently "
                 "(write_ec_files_multi) vs sequentially, adaptive codec. "
-                f"DISCLOSURE, not a target: host_cpus={os.cpu_count() if not hasattr(os, 'sched_getaffinity') else len(os.sched_getaffinity(0))} "
+                f"DISCLOSURE, not a target: host_cpus={available_cpus()} "
                 "— host-side parallel speedup is structurally capped at "
                 "~1.0x on a 1-core host; BASELINE config 3's multi-volume "
                 "number is the DEVICE batch dimension "
