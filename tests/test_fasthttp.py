@@ -759,3 +759,64 @@ def test_parser_fuzz_never_wedges_server():
             await srv.stop()
 
     _run(body())
+
+
+def test_chunked_decode_property_random_framings():
+    """Property-style: any body, chunked any way, delivered in any TCP
+    segmentation, must reassemble bit-exact with a correct synthesized
+    Content-Length."""
+    import random as _random
+
+    rng = _random.Random(11)
+
+    async def body():
+        seen = []
+
+        async def handler(req):
+            seen.append((bytes(req.body), req.headers.get(b"content-length")))
+            return render_response(200, b"ok")
+
+        srv = FastHTTPServer(handler)
+        port = free_port()
+        await srv.start("127.0.0.1", port)
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            for trial in range(25):
+                payload = rng.randbytes(rng.randint(0, 40000))
+                # random chunking
+                frames = [b"POST /p HTTP/1.1\r\nHost: h\r\n"
+                          b"Transfer-Encoding: chunked\r\n\r\n"]
+                pos = 0
+                while pos < len(payload):
+                    n = rng.randint(1, max(1, len(payload) - pos))
+                    chunk = payload[pos:pos + n]
+                    ext = b";x=1" if rng.random() < 0.3 else b""
+                    frames.append(b"%x%s\r\n" % (len(chunk), ext))
+                    frames.append(chunk + b"\r\n")
+                    pos += n
+                frames.append(b"0\r\n")
+                if rng.random() < 0.3:
+                    frames.append(b"X-Trailer: t\r\n")
+                frames.append(b"\r\n")
+                wire = b"".join(frames)
+                # random TCP segmentation
+                sent = 0
+                while sent < len(wire):
+                    seg = rng.randint(1, max(1, min(8192, len(wire) - sent)))
+                    w.write(wire[sent:sent + seg])
+                    await w.drain()
+                    if rng.random() < 0.3:
+                        await asyncio.sleep(0)
+                    sent += len(wire[sent:sent + seg])
+                st, _ = await _read_one_response(r)
+                assert st == 200, (trial, st)
+                got, clen = seen[-1]
+                assert got == payload, (
+                    trial, len(got), len(payload)
+                )
+                assert clen == str(len(payload)).encode()
+            w.close()
+        finally:
+            await srv.stop()
+
+    _run(body())
