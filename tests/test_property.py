@@ -89,3 +89,60 @@ def test_gf_matmul_matches_table_oracle(r_cnt, c_cnt, n, rnd):
     want = mat_mul(matrix, data)
     got = np.asarray(gf_matmul_bytes(matrix, data, force_pallas=False))
     assert (got == want).all()
+
+
+@settings(max_examples=120, deadline=None)
+@given(
+    st.lists(
+        st.tuples(
+            st.integers(0, 500),  # offset
+            st.integers(1, 200),  # size
+            st.integers(1, 4),  # mtime (small range: ties happen)
+        ),
+        min_size=1,
+        max_size=12,
+    )
+)
+def test_visible_intervals_match_byte_simulation(spans):
+    """Newest-wins interval resolution vs a brute-force byte oracle: for
+    any sequence of overlapping chunk writes — including equal-mtime ties,
+    broken by fid like the implementation — every byte must resolve to
+    the winning chunk AND carry the right chunk_offset, which the read
+    path turns into offset_in_chunk (the reference's filechunks_test.go
+    is property-style over the same logic)."""
+    from seaweedfs_tpu.filer.entry import FileChunk
+    from seaweedfs_tpu.filer.filechunks import (
+        non_overlapping_visible_intervals,
+    )
+
+    chunks = []
+    for i, (off, sz, mt) in enumerate(spans):
+        chunks.append(
+            FileChunk(fid=f"f{i}", offset=off, size=sz, mtime_ns=mt)
+        )
+    extent = max(off + sz for off, sz, _ in spans)
+    offset_of = {c.fid: c.offset for c in chunks}
+
+    def winner_at(b):
+        covering = [
+            c for c in chunks if c.offset <= b < c.offset + c.size
+        ]
+        if not covering:
+            return None
+        return max(covering, key=lambda c: (c.mtime_ns, c.fid)).fid
+
+    shadow = [winner_at(b) for b in range(extent)]
+
+    vis = non_overlapping_visible_intervals(chunks)
+
+    # intervals are sorted, non-overlapping, correctly offset
+    for a, b in zip(vis, vis[1:]):
+        assert a.stop <= b.start
+    resolved = [None] * extent
+    for v in vis:
+        assert v.start < v.stop
+        assert v.chunk_offset == offset_of[v.fid]
+        for b in range(v.start, v.stop):
+            assert resolved[b] is None  # no double coverage
+            resolved[b] = v.fid
+    assert resolved == shadow
