@@ -71,7 +71,16 @@ def locate_data(
     data_shards: int = DATA_SHARDS_COUNT,
 ) -> list[Interval]:
     """Ref LocateData (ec_locate.go:11-48); data_shards parametrizes the
-    row width for alternate RS geometries (6.3 / 12.4)."""
+    row width for alternate RS geometries (6.3 / 12.4).
+
+    Faithful to a latent reference quirk: the large->small transition
+    below uses the shard-derived row count (ec_locate.go:15, the +k*S
+    addend) while _locate_offset's layout boundary uses dat_size//(L*k)
+    (ec_locate.go:52). In the narrow window where the two disagree
+    (dat_size mod L*k >= L*k - k*S, ~10MB per 10GB at real geometry) a
+    boundary-crossing read walks large blocks past the layout boundary —
+    identically to the reference, which shard layouts on disk follow.
+    tests/test_property.py pins the consistent domain."""
     block_index, is_large_block, inner_block_offset = _locate_offset(
         large_block_length, small_block_length, dat_size, offset, data_shards
     )
