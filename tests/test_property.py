@@ -8,6 +8,7 @@ matrices, not just the RS parity rows.
 """
 
 import numpy as np
+import pytest
 from hypothesis import given, settings
 from hypothesis import strategies as st
 
@@ -194,3 +195,112 @@ def test_ec_locate_tiles_the_request_exactly(data_shards, dat_size, data):
         assert iv.large_block_rows_count == n_large_rows
         pos += iv.size
     assert pos == offset + size
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    st.lists(
+        st.tuples(
+            st.sampled_from(["set", "delete", "get"]),
+            st.integers(1, 40),  # small key space forces overwrites
+            st.integers(1, 2**31),  # offset units
+            st.integers(1, 2**31),  # size
+        ),
+        max_size=60,
+    )
+)
+def test_compact_map_matches_dict_oracle(ops):
+    """CompactMap vs a plain-dict oracle over arbitrary set/delete/get
+    sequences: return values, membership, tombstone semantics, and the
+    sorted live snapshot (the bulk-lookup kernel's probe table) must all
+    agree, and snapshot_token must change iff a mutation happened."""
+    from seaweedfs_tpu.storage.needle_map.compact_map import CompactMap
+    from seaweedfs_tpu.types import TOMBSTONE_FILE_SIZE
+
+    m = CompactMap()
+    oracle: dict = {}  # key -> (offset_units, size)
+    for op, key, off, size in ops:
+        tok = m.snapshot_token()
+        if op == "set":
+            got_old = m.set(key, off, size)
+            want_old = oracle.get(key, (0, 0))
+            assert got_old == want_old
+            oracle[key] = (off, size)
+            assert m.snapshot_token() != tok
+        elif op == "delete":
+            freed = m.delete(key)
+            old = oracle.get(key)
+            if old is None:
+                assert freed == 0
+                assert m.snapshot_token() == tok  # absent: no mutation
+            else:
+                want = 0 if old[1] == TOMBSTONE_FILE_SIZE else old[1]
+                assert freed == want
+                oracle[key] = (old[0], TOMBSTONE_FILE_SIZE)
+                assert m.snapshot_token() != tok
+        else:
+            nv = m.get(key)
+            want = oracle.get(key)
+            if want is None:
+                assert nv is None
+            else:
+                assert (nv.offset_units, nv.size) == want
+            assert m.snapshot_token() == tok
+
+    assert len(m) == len(oracle)
+    keys, offsets, sizes = m.snapshot()
+    live = sorted(
+        (k, v[0], v[1])
+        for k, v in oracle.items()
+        if v[1] != TOMBSTONE_FILE_SIZE
+    )
+    assert list(keys) == [k for k, _, _ in live]
+    assert list(offsets) == [o for _, o, _ in live]
+    assert list(sizes) == [s for _, _, s in live]
+
+
+import seaweedfs_tpu.types as _types
+
+
+@pytest.mark.skipif(
+    _types.OFFSET_SIZE != 4,
+    reason="5-byte-offset build: covered by test_5byte_offsets.py",
+)
+@settings(max_examples=200, deadline=None)
+@given(
+    st.integers(0, 2**64 - 1),
+    st.integers(0, 2**32 - 1),
+    st.integers(0, 2**32 - 1),
+)
+def test_idx_entry_roundtrip(key, offset_units, size):
+    """.idx entry codec: big-endian roundtrip over the full field space
+    (16B entries at 4-byte offsets; the 5-byte variant has its own
+    suite in test_5byte_offsets.py)."""
+    from seaweedfs_tpu.storage.idx import entry_to_bytes, parse_entry
+    from seaweedfs_tpu.types import NEEDLE_MAP_ENTRY_SIZE
+
+    blob = entry_to_bytes(key, offset_units, size)
+    assert len(blob) == NEEDLE_MAP_ENTRY_SIZE
+    assert parse_entry(blob) == (key, offset_units, size)
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.integers(0, 255), st.sampled_from("mhdwMy"))
+def test_ttl_roundtrip(count, unit):
+    """TTL string/byte codecs agree with the reference's 2-byte wire form
+    (count u8 + unit), through both representations."""
+    from seaweedfs_tpu.storage.ttl import TTL
+
+    t = TTL.read(f"{count}{unit}")
+    if count == 0:
+        # reference behavior: ToBytes keeps the unit byte for count=0
+        # (volume_ttl.go ToBytes) while ToUint32 collapses to 0
+        # (volume_ttl.go:72-75)
+        assert t.to_bytes() == bytes([0, t.unit])
+        assert t.to_u32() == 0
+        return
+    back = TTL.from_bytes(t.to_bytes())
+    assert back.minutes == t.minutes
+    assert back.to_bytes() == t.to_bytes()
+    # u32 form (heartbeats/super block) is equivalent
+    assert TTL.from_u32(t.to_u32()).to_bytes() == t.to_bytes()
