@@ -60,7 +60,12 @@ class FileId:
             raise ValueError(f"wrong fid format: {fid!r}")
         vid = parse_volume_id(fid[:comma])
         key, cookie = parse_needle_id_cookie(fid[comma + 1 :])
-        return FileId(volume_id=vid, key=key + delta, cookie=cookie)
+        # Go's NeedleId is uint64: key+delta wraps modulo 2^64 there, and
+        # an unmasked Python int would overflow the 8-byte serializers
+        return FileId(
+            volume_id=vid, key=(key + delta) & 0xFFFFFFFFFFFFFFFF,
+            cookie=cookie,
+        )
 
     def __str__(self) -> str:
         return f"{self.volume_id},{format_needle_id_cookie(self.key, self.cookie)}"
