@@ -338,13 +338,16 @@ class MasterServer:
         if proxied is not None:
             return proxied
         count = int(params.get("count", 1) or 1)
-        option = self._parse_option(params)
         try:
+            option = self._parse_option(params)
             await self._ensure_writable(option)
             fid, cnt, locations = self.topo.pick_for_write(
                 count, option.collection, option.replica_placement, option.ttl
             )
-        except (NoFreeSpaceError, LookupError) as e:
+        except (NoFreeSpaceError, LookupError, ValueError) as e:
+            # ValueError: malformed replication/ttl params, or a placement
+            # the byte encoding can't represent (e.g. "300") — an error
+            # body, not a 500
             return {"error": str(e)}
         dn = locations[0]
         result = {
@@ -430,8 +433,11 @@ class MasterServer:
         if gate is not None:
             return gate
         params = dict(request.query)
-        option = self._parse_option(params)
-        count = int(params.get("count", 1) or 1)
+        try:
+            option = self._parse_option(params)
+            count = int(params.get("count", 1) or 1)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
         grown = await self.growth.grow_by_count(
             count, self.topo, option, self._allocate_volume
         )

@@ -45,11 +45,32 @@ class ReplicaPlacement:
         )
 
     def to_byte(self) -> int:
-        return (
+        # the xyz decimal encoding only fits a byte for single-digit
+        # components summing under 256; the reference's Go byte()
+        # conversion silently TRUNCATES larger placements
+        # (replica_placement.go Byte()), corrupting e.g. "300" into 44 on
+        # disk — raise instead, and reject out-of-digit components that
+        # would alias another placement (1 dc + 15 racks reads back as
+        # "250")
+        for c in (
+            self.diff_data_center_count,
+            self.diff_rack_count,
+            self.same_rack_count,
+        ):
+            if not 0 <= c <= 9:
+                raise ValueError(
+                    f"replica placement component out of range: {c}"
+                )
+        v = (
             self.diff_data_center_count * 100
             + self.diff_rack_count * 10
             + self.same_rack_count
         )
+        if v > 255:
+            raise ValueError(
+                f"replica placement {self} does not fit the byte encoding"
+            )
+        return v
 
     def copy_count(self) -> int:
         return (

@@ -347,3 +347,50 @@ def test_cipher_roundtrip_and_tamper_detection(data, draw):
     tampered[pos] ^= 0x01
     with pytest.raises(ValueError):
         decrypt(bytes(tampered), key)
+
+
+@settings(max_examples=150, deadline=None)
+@given(
+    st.sampled_from([1, 2, 3]),
+    st.integers(0, 9),
+    st.integers(0, 9),
+    st.integers(0, 9),
+    st.integers(1, 255),
+    st.sampled_from("mhdwMy"),
+    st.integers(0, 2**16 - 1),
+    st.binary(max_size=64),
+)
+def test_super_block_roundtrip(version, dc, rack, same, ttl_count, ttl_unit,
+                               rev, extra):
+    """Super block codec: version, xyz replica placement, TTL, compaction
+    revision, and the opaque extra payload all roundtrip; replica
+    placement's string/byte forms agree."""
+    from seaweedfs_tpu.storage.super_block import ReplicaPlacement, SuperBlock
+    from seaweedfs_tpu.storage.ttl import TTL
+
+    rp = ReplicaPlacement.parse(f"{dc}{rack}{same}")
+    if dc * 100 + rack * 10 + same > 255:
+        # unrepresentable in the byte encoding: we raise (the reference's
+        # Go byte() would silently truncate — see to_byte docstring)
+        with pytest.raises(ValueError):
+            rp.to_byte()
+        return
+    assert ReplicaPlacement.from_byte(rp.to_byte()) == rp
+    assert ReplicaPlacement.parse(str(rp)) == rp
+
+    if version == 1:
+        extra = b""  # v1 carries no extra section
+    sb = SuperBlock(
+        version=version,
+        replica_placement=rp,
+        ttl=TTL.read(f"{ttl_count}{ttl_unit}"),
+        compaction_revision=rev,
+        extra=extra,
+    )
+    blob = sb.to_bytes()
+    back = SuperBlock.parse(blob)
+    assert back.version == sb.version
+    assert back.replica_placement == rp
+    assert back.ttl.to_bytes() == sb.ttl.to_bytes()
+    assert back.compaction_revision == rev
+    assert bytes(back.extra) == bytes(extra)
