@@ -337,8 +337,8 @@ class MasterServer:
         proxied = await self._proxy_to_leader("Assign", dict(params))
         if proxied is not None:
             return proxied
-        count = int(params.get("count", 1) or 1)
         try:
+            count = int(params.get("count", 1) or 1)
             option = self._parse_option(params)
             await self._ensure_writable(option)
             fid, cnt, locations = self.topo.pick_for_write(
@@ -435,6 +435,9 @@ class MasterServer:
         params = dict(request.query)
         try:
             option = self._parse_option(params)
+            # force the representability check (parse accepts any digits,
+            # e.g. "300", but the byte encoding can't store them)
+            option.replica_placement.to_byte()
             count = int(params.get("count", 1) or 1)
         except ValueError as e:
             return web.json_response({"error": str(e)}, status=400)

@@ -246,6 +246,7 @@ async def cmd_volume_configure_replication(env, argv) -> str:
 
     try:
         rp = ReplicaPlacement.parse(replication)
+        rp.to_byte()  # force the representability check up front
     except ValueError as e:
         return f"replication format: {e}"
     holders = []
