@@ -73,14 +73,17 @@ def locate_data(
     """Ref LocateData (ec_locate.go:11-48); data_shards parametrizes the
     row width for alternate RS geometries (6.3 / 12.4).
 
-    Faithful to a latent reference quirk: the large->small transition
-    below uses the shard-derived row count (ec_locate.go:15, the +k*S
-    addend) while _locate_offset's layout boundary uses dat_size//(L*k)
-    (ec_locate.go:52). In the narrow window where the two disagree
-    (dat_size mod L*k >= L*k - k*S, ~10MB per 10GB at real geometry) a
-    boundary-crossing read walks large blocks past the layout boundary —
-    identically to the reference, which shard layouts on disk follow.
-    tests/test_property.py pins the consistent domain."""
+    Faithful to a latent reference BUG: three row-count derivations
+    disagree in a narrow window. The encoder's large-row loop uses
+    strictly-greater (ec_encoder.go:214), _locate_offset's layout
+    boundary uses dat_size//(L*k) (ec_locate.go:52), and the
+    large->small transition plus ToShardIdAndOffset use the
+    shard-derived +k*S addend count (ec_locate.go:15,73-83). For
+    dat_size in [n*L*k - k*S, n*L*k] — ~10MB per 10GB at real
+    geometry — the reference's own reader mis-addresses shards ITS OWN
+    encoder wrote. Reproduced identically here for wire parity;
+    tests/test_property.py pins both the consistent domain and the
+    broken window (test_ec_row_boundary_window_is_reference_faithful)."""
     block_index, is_large_block, inner_block_offset = _locate_offset(
         large_block_length, small_block_length, dat_size, offset, data_shards
     )

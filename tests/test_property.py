@@ -7,6 +7,8 @@ GF kernel is checked against the table-driven galois oracle for arbitrary
 matrices, not just the RS parity rows.
 """
 
+import os
+
 import numpy as np
 import pytest
 from hypothesis import given, settings
@@ -467,3 +469,91 @@ def test_s3_v4_sign_verify_roundtrip(segments, query, payload, method):
     bad["headers"]["Authorization"] = auth.replace(sig, flipped)
     with pytest.raises(AccessDenied):
         iam.authenticate(bad)
+
+
+
+
+def _ec_shard_readback(size: int, seed: int = 3) -> tuple[bytes, bytes]:
+    """Encode a random .dat of `size` bytes at tiny geometry and read the
+    whole file back through locate_data + shards. -> (payload, readback).
+    Uses its own tempdir (not the tmp_path fixture: the hypothesis caller
+    is function-scoped-fixture-hostile)."""
+    import shutil
+    import tempfile
+
+    from seaweedfs_tpu.storage.erasure_coding import to_ext, write_ec_files
+    from seaweedfs_tpu.storage.erasure_coding.locate import locate_data
+
+    L, S = _EC_L, _EC_S
+    payload = np.random.default_rng(seed).integers(
+        0, 256, size=size, dtype=np.uint8
+    ).tobytes()
+    d = tempfile.mkdtemp(prefix="ec_prop_")
+    try:
+        base = os.path.join(d, "1")
+        with open(base + ".dat", "wb") as f:
+            f.write(payload)
+        write_ec_files(base, large_block_size=L, small_block_size=S)
+        intervals = locate_data(L, S, size, 0, size)
+        got = bytearray()
+        for iv in intervals:
+            shard_id, off = iv.to_shard_id_and_offset(L, S)
+            with open(base + to_ext(shard_id), "rb") as f:
+                f.seek(off)
+                got += f.read(iv.size)
+        return payload, bytes(got)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+_EC_L, _EC_S, _EC_K = 256, 32, 10
+
+@settings(max_examples=20, deadline=None)
+@given(st.data())
+def test_ec_encode_readback_random_sizes(data):
+    """End-to-end EC property at tiny geometry: encode a random-content
+    .dat of a boundary-hugging random size, then reassemble the WHOLE
+    file from the 14 shards via locate_data and byte-compare. Sweeps the
+    large/small-row and padding boundaries the checked-in fixture can't."""
+    from hypothesis import assume
+
+    row_l, row_s = _EC_L * _EC_K, _EC_S * _EC_K
+    boundaries = [
+        b + d
+        for b in (row_s, 2 * row_s, row_l, row_l + row_s, 2 * row_l)
+        for d in (-1, 0, 1)
+    ]
+    size = data.draw(
+        st.one_of(st.sampled_from(boundaries), st.integers(1, 3 * row_l))
+    )
+    # skip the reference's broken row-boundary window — CLOSED at the
+    # lower bound (see locate_data's docstring and
+    # test_ec_row_boundary_window_is_reference_faithful)
+    assume(not any(
+        n * row_l - row_s <= size <= n * row_l for n in (1, 2, 3)
+    ))
+    payload, got = _ec_shard_readback(
+        size, seed=data.draw(st.integers(0, 2**32 - 1))
+    )
+    assert got == payload, (size, len(got), "shard readback != source")
+
+
+def test_ec_row_boundary_window_is_reference_faithful():
+    """Pin the latent reference bug (see locate_data's docstring): for
+    dat_size in [n*L*k - k*S, n*L*k] the encoder's row loop
+    (ec_encoder.go:214 strict-greater) and the reader's shard addressing
+    (ec_locate.go:15,73-83 addend row count) disagree — the reference
+    corrupts its own shards there, and this port reproduces the wire
+    behavior byte-for-byte. If either side is ever 'fixed' alone, this
+    test flags the divergence so the fix is made consistently."""
+    for size in (
+        _EC_L * _EC_K - 1,  # inside the window
+        _EC_L * _EC_K - _EC_S * _EC_K,  # the (inclusive) lower bound
+        _EC_L * _EC_K,  # the exact row multiple
+    ):
+        payload, got = _ec_shard_readback(size)
+        assert got != payload, (
+            f"size {size} in the row-boundary window reads back clean: "
+            "one side of the reference bug was fixed — fix locate/encode "
+            "consistently and update locate_data's docstring + this test"
+        )
