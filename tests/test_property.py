@@ -557,3 +557,70 @@ def test_ec_row_boundary_window_is_reference_faithful():
             "one side of the reference bug was fixed — fix locate/encode "
             "consistently and update locate_data's docstring + this test"
         )
+
+
+@settings(max_examples=15, deadline=None)
+@given(
+    st.lists(
+        st.tuples(
+            st.sampled_from(["write", "overwrite", "delete"]),
+            st.integers(1, 12),  # key (small space: overwrites happen)
+            st.integers(1, 3000),  # payload size
+        ),
+        min_size=1,
+        max_size=25,
+    ),
+    st.randoms(use_true_random=False),
+    st.sampled_from(["compact", "compact2"]),
+)
+def test_vacuum_preserves_live_needles(ops, rnd, compact_name):
+    """Vacuum invariant: after any write/overwrite/delete sequence and a
+    compact+commit, every live needle reads back bit-exact, every deleted
+    key stays gone, and the .dat holds no more than the live payload plus
+    per-needle overhead."""
+    import shutil
+    import tempfile
+
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage import vacuum as vacuum_mod
+    from seaweedfs_tpu.storage.vacuum import commit_compact
+    from seaweedfs_tpu.storage.volume import AlreadyDeleted, NotFound, Volume
+
+    rng = np.random.default_rng(rnd.randrange(2**32))
+    d = tempfile.mkdtemp(prefix="vac_prop_")
+    try:
+        v = Volume(d, "", 3, create=True)
+        live: dict = {}
+        for op, key, size in ops:
+            if op in ("write", "overwrite"):
+                data = rng.integers(0, 256, size=size, dtype=np.uint8
+                                    ).tobytes()
+                v.write_needle(Needle(cookie=7, id=key, data=data))
+                live[key] = data
+            else:
+                v.delete_needle(Needle(id=key))
+                live.pop(key, None)
+
+        getattr(vacuum_mod, compact_name)(v)
+        v2 = commit_compact(v)
+        try:
+            for key, data in live.items():
+                n = Needle(id=key)
+                v2.read_needle(n)
+                assert bytes(n.data) == data, f"key {key} corrupted"
+            for op, key, _ in ops:
+                if key not in live:
+                    n = Needle(id=key)
+                    try:
+                        v2.read_needle(n)
+                        assert False, f"deleted key {key} still readable"
+                    except (NotFound, AlreadyDeleted):
+                        pass
+            dat = os.path.getsize(os.path.join(d, "3.dat"))
+            payload = sum(len(x) for x in live.values())
+            # super block + per-needle header/crc/ts/padding overhead
+            assert dat <= 8 + payload + len(live) * 64 + 64
+        finally:
+            v2.close()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
