@@ -624,3 +624,77 @@ def test_vacuum_preserves_live_needles(ops, rnd, compact_name):
             v2.close()
     finally:
         shutil.rmtree(d, ignore_errors=True)
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    st.lists(
+        st.tuples(
+            st.sampled_from(["insert", "delete", "crash", "reopen"]),
+            st.integers(0, 9),  # file index within /d
+            st.integers(0, 5),  # mtime tag
+        ),
+        min_size=1,
+        max_size=30,
+    ),
+    st.integers(2, 40),  # memtable limit: flush cadence varies
+)
+def test_lsm_store_matches_dict_oracle_across_crashes(ops, limit):
+    """LSM filer store vs a dict oracle through arbitrary insert/delete
+    sequences interleaved with hard crashes (WAL replay, lock released
+    the way a dying process would) and clean reopen cycles: lookups and
+    directory listings must always match the oracle."""
+    import shutil
+    import tempfile
+
+    from seaweedfs_tpu.filer.entry import Attr, Entry
+    from seaweedfs_tpu.filer.lsm_store import LsmFilerStore
+
+    d = tempfile.mkdtemp(prefix="lsm_prop_")
+    try:
+        s = LsmFilerStore(d, memtable_limit=limit, max_segments=3)
+        oracle: dict = {}
+        try:
+            for op, i, tag in ops:
+                path = f"/d/f{i}"
+                if op == "insert":
+                    s.insert_entry(
+                        Entry(full_path=path,
+                              attr=Attr(mtime=float(tag), mode=0o644))
+                    )
+                    oracle[path] = tag
+                elif op == "delete":
+                    s.delete_entry(path)
+                    oracle.pop(path, None)
+                else:
+                    if op == "crash":
+                        os.close(s._lock_fd)
+                        s._lock_fd = None
+                    else:
+                        s.close()
+                    # unbind BEFORE reopening: if the constructor raises
+                    # (the bug class this test hunts), the finally below
+                    # must neither mask the traceback nor close the dead
+                    # store (whose flush would mutate the crashed dir)
+                    s = None
+                    s = LsmFilerStore(d, memtable_limit=limit,
+                                      max_segments=3)
+                # full oracle check after every op
+                for p, t in oracle.items():
+                    e = s.find_entry(p)
+                    assert e is not None, (op, p)
+                    assert e.attr.mtime == float(t), (op, p)
+                for i2 in range(10):
+                    p = f"/d/f{i2}"
+                    if p not in oracle:
+                        assert s.find_entry(p) is None, (op, p)
+                names = sorted(
+                    e.name
+                    for e in s.list_directory_entries("/d", "", True, 100)
+                )
+                assert names == sorted(p.rsplit("/", 1)[1] for p in oracle)
+        finally:
+            if s is not None:
+                s.close()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
