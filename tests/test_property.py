@@ -698,3 +698,70 @@ def test_lsm_store_matches_dict_oracle_across_crashes(ops, limit):
                 s.close()
     finally:
         shutil.rmtree(d, ignore_errors=True)
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    # two regimes: small tables (plain kernel, incl. the empty path) and
+    # >= MIN_BUCKETED so the interpolation-bucketed kernel — the path
+    # real serving volumes take — is property-covered too
+    st.one_of(st.integers(0, 400), st.just(5000)),
+    st.integers(1, 80),  # probe batch size (padding paths vary)
+    st.randoms(use_true_random=False),
+)
+def test_index_snapshot_lookup_matches_dict(n, p, rnd):
+    """The branchless batched binary-search kernel (serving's bulk lookup)
+    vs a plain dict: hits return the exact (offset, size), misses report
+    found=False — across empty tables, u64-boundary keys, duplicate
+    probes, and batch paddings."""
+    from seaweedfs_tpu.ops.index_kernel import IndexSnapshot
+
+    rng = np.random.default_rng(rnd.randrange(2**32))
+    if n >= 4096:
+        # dense regime: small key span like real volumes (monotonic file
+        # ids), which keeps bucketing eligible (span < 2^62 guard)
+        gaps = rng.integers(1, 20, size=n, dtype=np.uint64)
+        pool = np.cumsum(gaps).astype(np.uint64)
+    else:
+        # sparse regime: keys across the full u64 range
+        pool = np.unique(
+            rng.integers(1, 2**63, size=max(n, 1), dtype=np.uint64).astype(
+                np.uint64
+            ) * 2
+        )[: max(n, 0)]
+        if n >= 4:
+            # force the u64 boundary values INTO the table (a post-unique
+            # slice would deterministically drop the maximum)
+            pool = np.unique(np.concatenate([
+                pool[:-4],
+                np.asarray(
+                    [1, 2**32 - 1, 2**32, 2**64 - 2], dtype=np.uint64
+                ),
+            ]))
+    keys = np.sort(pool).astype(np.uint64)
+    offsets = rng.integers(1, 2**32, size=len(keys), dtype=np.uint64).astype(
+        np.uint32
+    )
+    sizes = rng.integers(1, 2**32, size=len(keys), dtype=np.uint64).astype(
+        np.uint32
+    )
+    table = {int(k): (int(o), int(s))
+             for k, o, s in zip(keys, offsets, sizes)}
+    snap = IndexSnapshot(keys, offsets, sizes)
+    # the dense small-span regime must take the bucketed kernel
+    assert (snap.starts is not None) == (n >= snap.MIN_BUCKETED)
+
+    hit_pool = keys if len(keys) else np.asarray([3], dtype=np.uint64)
+    probes = np.where(
+        rng.random(p) < 0.5,
+        hit_pool[rng.integers(0, len(hit_pool), size=p)],
+        rng.integers(1, 2**64 - 1, size=p, dtype=np.uint64),
+    ).astype(np.uint64)
+    off, size, found = snap.lookup(probes)
+    for j in range(p):
+        want = table.get(int(probes[j]))
+        if want is None:
+            assert not found[j], (j, int(probes[j]))
+        else:
+            assert found[j], (j, int(probes[j]))
+            assert (int(off[j]), int(size[j])) == want
