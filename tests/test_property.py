@@ -765,3 +765,72 @@ def test_index_snapshot_lookup_matches_dict(n, p, rnd):
         else:
             assert found[j], (j, int(probes[j]))
             assert (int(off[j]), int(size[j])) == want
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    st.lists(
+        st.tuples(
+            st.sampled_from(["put", "delete"]),
+            st.integers(1, 15),  # key
+            st.integers(1, 10000),  # size
+        ),
+        max_size=40,
+    )
+)
+def test_needle_map_metrics_survive_idx_replay(ops):
+    """MapMetric accounting vs an oracle through arbitrary put/delete
+    sequences, and — the reference's needle_map_metric_test.go concern —
+    identical metrics when a fresh map replays the .idx log."""
+    import shutil
+    import tempfile
+
+    from seaweedfs_tpu.storage.needle_map.mapper import (
+        NeedleMap,
+        load_needle_map,
+    )
+
+    d = tempfile.mkdtemp(prefix="nm_prop_")
+    try:
+        nm = NeedleMap(os.path.join(d, "v.idx"))
+        live: dict = {}  # key -> size
+        want_files = want_fbytes = want_dels = want_dbytes = max_key = 0
+        max_key_idx = 0  # replay max: EVERY idx entry, tombstones included
+        off = 0
+        for op, key, size in ops:
+            # reference-faithful asymmetry: the live path only raises the
+            # max on puts (needle_map.go:51-66), while idx replay raises
+            # it on every entry incl. tombstones (needle_map_memory.go
+            # doLoading) — so a delete of a never-written key shows in
+            # the replayed max only
+            max_key_idx = max(max_key_idx, key)
+            if op == "put":
+                off += 1
+                nm.put(key, off, size)
+                max_key = max(max_key, key)
+                want_files += 1
+                want_fbytes += size
+                if key in live:  # overwrite counts the old copy deleted
+                    want_dels += 1
+                    want_dbytes += live[key]
+                live[key] = size
+            else:
+                nm.delete(key, off)
+                if key in live:
+                    want_dels += 1
+                    want_dbytes += live.pop(key)
+
+        def check(m, label, want_max):
+            assert m.file_count == want_files, label
+            assert m.content_size == want_fbytes, label
+            assert m.deleted_count == want_dels, label
+            assert m.deleted_size == want_dbytes, label
+            assert m.metric.maximum_file_key == want_max, label
+
+        check(nm, "in-memory", max_key)
+        nm.close()
+        nm2 = load_needle_map(os.path.join(d, "v.idx"))
+        check(nm2, "idx replay", max_key_idx)
+        nm2.close()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
