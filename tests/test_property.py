@@ -834,3 +834,35 @@ def test_needle_map_metrics_survive_idx_replay(ops):
         nm2.close()
     finally:
         shutil.rmtree(d, ignore_errors=True)
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    st.integers(1, 6),  # parity rows
+    st.integers(1, 12),  # data rows
+    st.one_of(  # lengths hugging SIMD width/tail boundaries
+        st.integers(1, 300),
+        st.sampled_from([63, 64, 65, 127, 128, 129, 255, 256, 257, 511,
+                         512, 513, 1023, 1024, 1025]),
+    ),
+    st.integers(0, 2**32 - 1),
+)
+def test_native_gf_matmul_matches_table_oracle(r_cnt, c_cnt, n, seed):
+    """The C++ SIMD GF(2^8) kernel (GFNI/AVX2/SSSE3/scalar tiers) vs the
+    table-driven oracle at lengths hugging vector-width and tail
+    boundaries — the classic home of SIMD tail/alignment bugs."""
+    from seaweedfs_tpu import native
+    from seaweedfs_tpu.storage.erasure_coding.galois import mat_mul
+
+    if not native.available():
+        pytest.skip("native kernel not built on this host")
+    rng = np.random.default_rng(seed)
+    matrix = rng.integers(0, 256, size=(r_cnt, c_cnt), dtype=np.uint8)
+    data = rng.integers(0, 256, size=(c_cnt, n), dtype=np.uint8)
+    want = mat_mul(matrix, data)
+    got = native.gf_matmul_native(matrix, data)
+    assert (got == want).all(), (r_cnt, c_cnt, n)
+    # the row-pointer API (zero-copy mmap path) must agree too
+    rows = [np.ascontiguousarray(data[i]) for i in range(c_cnt)]
+    got_rows = native.gf_matmul_rows_native(matrix, rows)
+    assert (got_rows == want).all(), (r_cnt, c_cnt, n, "rows api")
