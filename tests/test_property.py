@@ -866,3 +866,56 @@ def test_native_gf_matmul_matches_table_oracle(r_cnt, c_cnt, n, seed):
     rows = [np.ascontiguousarray(data[i]) for i in range(c_cnt)]
     got_rows = native.gf_matmul_rows_native(matrix, rows)
     assert (got_rows == want).all(), (r_cnt, c_cnt, n, "rows api")
+
+
+@settings(max_examples=80, deadline=None)
+@given(
+    st.lists(
+        st.tuples(
+            st.sampled_from(["/a", "/a/b", "/c", "/cc"]),
+            st.sampled_from(["create", "update", "delete"]),
+        ),
+        max_size=40,
+    ),
+    st.sampled_from(["/", "/a", "/a/b", "/c"]),
+    st.data(),
+)
+def test_meta_log_resume_never_skips_or_duplicates(events, prefix, data):
+    """MetaLog resumption property: reading in arbitrary chunks from
+    arbitrary watermarks yields exactly the prefix-matching events, in
+    order, with no duplicates. Prefix matching is PLAIN string prefix —
+    "/c" matches "/cc" — like the reference's strings.HasPrefix
+    (filer_grpc_server_sub_meta.go)."""
+    from seaweedfs_tpu.filer.meta_log import MetaLog
+
+    log = MetaLog(capacity=1000)
+    appended = []
+    for directory, etype in events:
+        appended.append(log.append(directory, etype, None, {"d": directory}))
+
+    def matches(ev):
+        # plain string prefix over the entry full path or directory,
+        # mirroring _match_prefix / the reference's strings.HasPrefix
+        full = f"{ev.directory.rstrip('/')}/{ev.new_entry.get('name', '')}"
+        return (
+            prefix == "/"
+            or full.startswith(prefix)
+            or ev.directory.startswith(prefix)
+        )
+
+    want = [ev.ts_ns for ev in appended if matches(ev)]
+
+    # per-resume exactness: from ANY cursor t (0, any event ts, or the
+    # watermark), one read must return exactly the matching events with
+    # ts_ns > t, in order — no skip, no duplicate, no suffix tolerance
+    all_ts = [0] + [ev.ts_ns for ev in appended] + [log.last_ts_ns]
+    cursors = [0, log.last_ts_ns] + (
+        [data.draw(st.sampled_from(all_ts)) for _ in range(3)]
+        if appended else []
+    )
+    for t in cursors:
+        batch, watermark = log.read_since_with_watermark(t, prefix)
+        assert [ev.ts_ns for ev in batch] == [x for x in want if x > t], t
+        assert watermark == log.last_ts_ns
+    # resume from the watermark is empty until new events arrive
+    assert log.read_since(log.last_ts_ns, prefix) == []
