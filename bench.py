@@ -1961,9 +1961,14 @@ def _emit_final(headline: dict, mutate=None) -> bool:
     with _EMIT_LOCK:
         if _EMITTED:
             return False
-        _EMITTED = True
         if mutate is not None:
             mutate()
+        # serialize from a snapshot: the lock excludes other EMITTERS, not
+        # main()'s appends to the live dict — a watchdog firing mid-run
+        # must not json.dump a dict that mutates under it
+        import copy as _copy
+
+        headline = _copy.deepcopy(headline)
         detail_path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
         )
@@ -1995,6 +2000,10 @@ def _emit_final(headline: dict, mutate=None) -> bool:
             compact["extra_truncated"] = True
             line = json.dumps(compact, separators=(",", ":"))
         print(line, flush=True)
+        # claim the emission only once the compact line is actually out:
+        # if anything above raised, the flag stays False and the OTHER
+        # caller (normal completion vs watchdog) still prints the artifact
+        _EMITTED = True
         return True
 
 

@@ -9,6 +9,13 @@ from typing import Optional
 
 from ..pb import grpc_address
 from ..pb.rpc import Stub
+from ..util.backoff import (
+    BackoffPolicy,
+    deadline_after,
+    remaining,
+    retry_async,
+)
+from ..util.metrics import RETRY_COUNTER
 
 
 class VidMap:
@@ -41,13 +48,20 @@ class VidMap:
 
 
 class MasterClient:
-    def __init__(self, name: str, masters: list[str]):
+    # reconnect pacing: starts snappy (leader elections resolve in
+    # hundreds of ms), caps at 5s so a dead master quorum costs one
+    # connection attempt per master per ~5s instead of a tight spin
+    RECONNECT_POLICY = BackoffPolicy(base=0.2, cap=5.0, attempts=1 << 30)
+    LOOKUP_POLICY = BackoffPolicy(base=0.05, cap=1.0, attempts=4)
+
+    def __init__(self, name: str, masters: list[str], rng=None):
         self.name = name
         self.masters = masters
         self.current_master = masters[0]
         self.vid_map = VidMap()
         self._task: Optional[asyncio.Task] = None
         self._connected = asyncio.Event()
+        self._rng = rng or random.Random()  # injectable for deterministic tests
 
     async def start(self) -> None:
         self._task = asyncio.ensure_future(self._keep_connected_loop())
@@ -64,7 +78,14 @@ class MasterClient:
         await asyncio.wait_for(self._connected.wait(), timeout)
 
     async def _keep_connected_loop(self) -> None:
-        """(ref masterclient.go:47-121 — follows leader redirects)"""
+        """(ref masterclient.go:47-121 — follows leader redirects).
+
+        Reconnect attempts back off exponentially with full jitter
+        (capped, so a restarted master is re-found within ~5s worst
+        case) and the streak resets the moment a stream actually
+        reaches connected state — replacing the old flat 0.5s spin
+        that hammered a struggling quorum in lockstep."""
+        failures = 0
         while True:
             for master in self.masters:
                 try:
@@ -73,8 +94,13 @@ class MasterClient:
                     return
                 except Exception:
                     pass
+                if self._connected.is_set():
+                    failures = 0  # the stream made it to the leader
                 self._connected.clear()
-                await asyncio.sleep(0.5)
+                RETRY_COUNTER.inc(op="keep_connected")
+                delay = self.RECONNECT_POLICY.delay(failures, self._rng)
+                failures = min(failures + 1, 16)  # cap the exponent, not time
+                await asyncio.sleep(delay)
 
     async def _consume(self, master: str) -> None:
         stub = Stub(grpc_address(master), "master")
@@ -113,13 +139,34 @@ class MasterClient:
             raise LookupError(f"volume {vid} not found in cache")
         return f"http://{url}/{fid}"
 
-    async def lookup_file_id_async(self, fid: str) -> str:
-        """Cache lookup with a master-RPC fallback on miss."""
+    async def lookup_file_id_async(
+        self, fid: str, timeout: float = 5.0
+    ) -> str:
+        """Cache lookup with a master-RPC fallback on miss. The fallback
+        retries with capped jittered backoff inside one absolute deadline
+        (`timeout` seconds for the WHOLE lookup, propagated into each RPC
+        as its remaining budget) — a flaky master costs bounded latency,
+        never an unbounded error or a bare 30s hang."""
         vid = int(fid.split(",")[0])
         url = self.vid_map.pick(vid)
         if url is None:
-            stub = Stub(grpc_address(self.current_master), "master")
-            resp = await stub.call("LookupVolume", {"volume_ids": [str(vid)]})
+            deadline = deadline_after(timeout)
+
+            async def one_lookup():
+                stub = Stub(grpc_address(self.current_master), "master")
+                return await stub.call(
+                    "LookupVolume",
+                    {"volume_ids": [str(vid)]},
+                    timeout=remaining(deadline, 30.0),
+                )
+
+            resp = await retry_async(
+                one_lookup,
+                policy=self.LOOKUP_POLICY,
+                deadline=deadline,
+                rng=self._rng,
+                op="master_lookup",
+            )
             for r in resp.get("volume_id_locations", []):
                 for loc in r.get("locations", []):
                     self.vid_map.add(vid, loc["url"])

@@ -20,6 +20,8 @@ import grpc
 import grpc.aio
 import msgpack
 
+from ..util import faults
+
 UNARY_UNARY = "unary_unary"
 UNARY_STREAM = "unary_stream"
 STREAM_STREAM = "stream_stream"
@@ -143,6 +145,12 @@ class Stub:
         return f"/{self.service}/{method}"
 
     async def call(self, method: str, request: Any, timeout: float | None = 30):
+        if faults._PLAN is not None:
+            # fault-injection seam: reset / latency / hang before the wire;
+            # an injected hang honors this call's timeout like a real one
+            await faults.async_fault(
+                faults._PLAN, f"rpc:{method}", self.address, timeout=timeout
+            )
         fn = self._channel.unary_unary(
             self._path(method),
             request_serializer=_pack,
@@ -158,7 +166,19 @@ class Stub:
             request_serializer=_pack,
             response_deserializer=_unpack,
         )
+        plan = faults._PLAN
+        if plan is not None:
+            return self._faulted_stream(plan, method, fn, request, timeout)
         return fn(request, timeout=timeout)
+
+    async def _faulted_stream(self, plan, method, fn, request, timeout):
+        """server_stream with the injection seam applied before the first
+        item — a reset here looks like a peer that dropped the stream."""
+        await faults.async_fault(
+            plan, f"rpc:{method}", self.address, timeout=timeout
+        )
+        async for item in fn(request, timeout=timeout):
+            yield item
 
     def bidi_stream(self, method: str, request_iterator=None):
         fn = self._channel.stream_stream(

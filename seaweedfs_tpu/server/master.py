@@ -236,8 +236,11 @@ class MasterServer:
             result = await self._do_assign(params)
             # hand-formatted success body: fid/url are plain host:port and
             # hex strings (never need JSON escaping), and dumps() was
-            # measurable at assign QPS rates
-            if "error" not in result and "auth" not in result:
+            # measurable at assign QPS rates. Exact expected-key check: any
+            # field this formatter doesn't know (auth today, whatever
+            # _do_assign grows tomorrow) falls through to the json tier
+            # instead of being silently dropped
+            if set(result) == {"fid", "url", "publicUrl", "count"}:
                 return render_response(
                     200,
                     (

@@ -16,11 +16,18 @@ from __future__ import annotations
 
 import asyncio
 import os
+import random
 import time
 from typing import Optional
 
 from ..pb import grpc_address
 from ..pb.rpc import Stub
+from ..util.backoff import (
+    BackoffPolicy,
+    deadline_after,
+    remaining,
+)
+from ..util.metrics import EC_RECONSTRUCTIONS, RETRY_COUNTER
 from ..storage.erasure_coding import (
     DATA_SHARDS_COUNT,
     TOTAL_SHARDS_COUNT,
@@ -44,6 +51,19 @@ from ..storage.volume_info import VolumeInfo, save_volume_info
 from ..types import TOMBSTONE_FILE_SIZE, to_actual_offset
 
 SHARD_LOCATION_TTL = 10.0  # seconds between LookupEcVolume refreshes
+
+# total wall-clock budget for one EC needle read, across every interval,
+# remote attempt, location refresh and reconstruction; each remote RPC gets
+# the REMAINDER of this budget as its timeout instead of a bare 30s
+EC_READ_DEADLINE_SECONDS = float(
+    os.environ.get("SEAWEEDFS_TPU_EC_READ_DEADLINE", "15.0")
+)
+# per-url remote-read retry: quick second chance for transient resets; the
+# deadline, not the attempt count, is the real bound
+EC_REMOTE_READ_POLICY = BackoffPolicy(base=0.02, cap=0.25, attempts=2)
+# rounds of (force-refresh locations, re-attempt remote reads) before
+# falling back to reconstruction — replaces the old single force-refresh
+EC_REFRESH_ROUNDS = 2
 
 
 class EcHandlers:
@@ -423,62 +443,114 @@ class EcHandlers:
                 ]
             ev.shard_locations_refresh_time = now
 
+    class _Deleted(Exception):
+        """Needle tombstoned on a remote holder: a definitive answer, not
+        a failure — must short-circuit retries and reconstruction."""
+
+    async def _read_remote_shard_once(
+        self, ev: EcVolume, url: str, shard_id: int, offset: int, size: int,
+        file_key: int, deadline: Optional[float],
+    ) -> bytes:
+        stub = Stub(grpc_address(url), "volume")
+        buf = bytearray()
+        async for msg in stub.server_stream(
+            "VolumeEcShardRead",
+            {
+                "volume_id": ev.volume_id,
+                "shard_id": shard_id,
+                "offset": offset,
+                "size": size,
+                "file_key": file_key,
+            },
+            timeout=remaining(deadline, 30.0),
+        ):
+            if msg.get("error"):
+                raise IOError(msg["error"])
+            if msg.get("is_deleted"):
+                raise EcHandlers._Deleted()
+            buf.extend(msg.get("data", b""))
+        return bytes(buf)
+
     async def _read_remote_shard_interval(
-        self, ev: EcVolume, shard_id: int, offset: int, size: int, file_key: int
+        self,
+        ev: EcVolume,
+        shard_id: int,
+        offset: int,
+        size: int,
+        file_key: int,
+        deadline: Optional[float] = None,
     ) -> Optional[bytes]:
+        """Try each known holder of the shard; per-url transient failures
+        get one jittered retry, and every RPC's timeout is the remaining
+        read deadline (a stalled holder can no longer eat a bare 30s of a
+        15s read budget). Raises _Deleted on a tombstone answer."""
         with ev.shard_locations_lock:
             urls = list(ev.shard_locations.get(shard_id, []))
+        rng = getattr(self, "_backoff_rng", None)
         for url in urls:
             if url in (self.address, self.public_url):
                 continue
-            stub = Stub(grpc_address(url), "volume")
-            buf = bytearray()
-            try:
-                async for msg in stub.server_stream(
-                    "VolumeEcShardRead",
-                    {
-                        "volume_id": ev.volume_id,
-                        "shard_id": shard_id,
-                        "offset": offset,
-                        "size": size,
-                        "file_key": file_key,
-                    },
-                    timeout=30,
-                ):
-                    if msg.get("error"):
-                        raise IOError(msg["error"])
-                    if msg.get("is_deleted"):
-                        return None
-                    buf.extend(msg.get("data", b""))
-                return bytes(buf)
-            except Exception:
-                continue
+            for attempt in range(EC_REMOTE_READ_POLICY.attempts):
+                if deadline is not None and time.monotonic() >= deadline:
+                    return None
+                try:
+                    return await self._read_remote_shard_once(
+                        ev, url, shard_id, offset, size, file_key, deadline
+                    )
+                except EcHandlers._Deleted:
+                    raise
+                except Exception:
+                    if attempt == EC_REMOTE_READ_POLICY.attempts - 1:
+                        break  # next url
+                    RETRY_COUNTER.inc(op="ec_remote_read")
+                    d = EC_REMOTE_READ_POLICY.delay(
+                        attempt, rng if rng is not None else random
+                    )
+                    if deadline is not None:
+                        d = min(d, max(0.0, deadline - time.monotonic()))
+                    await asyncio.sleep(d)
         return None
 
     async def _read_one_ec_interval(
-        self, ev: EcVolume, shard_id: int, offset: int, size: int, file_key: int
+        self,
+        ev: EcVolume,
+        shard_id: int,
+        offset: int,
+        size: int,
+        file_key: int,
+        deadline: Optional[float] = None,
     ) -> Optional[bytes]:
         shard = ev.find_shard(shard_id)
         if shard is not None:
             return shard.read_at(size, offset)
+        if deadline is None:
+            deadline = deadline_after(EC_READ_DEADLINE_SECONDS)
         await self._refresh_shard_locations(ev)
-        data = await self._read_remote_shard_interval(
-            ev, shard_id, offset, size, file_key
-        )
-        if data is not None:
-            return data
-        # the cached locations may be stale (ref store_ec.go:211 forgets
-        # failed shard locations); force-refresh once and retry
-        await self._refresh_shard_locations(ev, force=True)
-        data = await self._read_remote_shard_interval(
-            ev, shard_id, offset, size, file_key
-        )
-        if data is not None:
-            return data
+        try:
+            data = await self._read_remote_shard_interval(
+                ev, shard_id, offset, size, file_key, deadline
+            )
+            if data is not None:
+                return data
+            # the cached locations may be stale (ref store_ec.go:211
+            # forgets failed shard locations); force-refresh and retry in
+            # bounded rounds while the deadline allows
+            for _ in range(EC_REFRESH_ROUNDS):
+                if time.monotonic() >= deadline:
+                    break
+                RETRY_COUNTER.inc(op="ec_location_refresh")
+                await self._refresh_shard_locations(ev, force=True)
+                data = await self._read_remote_shard_interval(
+                    ev, shard_id, offset, size, file_key, deadline
+                )
+                if data is not None:
+                    return data
+        except EcHandlers._Deleted:
+            return None
         # degraded: reconstruct from any DATA_SHARDS_COUNT other shards
         # (ref store_ec.go:319-373)
         return await self._recover_one_interval(
-            ev, shard_id, offset, size, file_key
+            ev, shard_id, offset, size, file_key, deadline
         )
 
     def codec_for(self, data_shards: int, parity_shards: int):
@@ -500,7 +572,8 @@ class EcHandlers:
         return cache[key]
 
     async def _recover_one_interval(
-        self, ev: EcVolume, missing_shard: int, offset: int, size: int, file_key: int
+        self, ev: EcVolume, missing_shard: int, offset: int, size: int,
+        file_key: int, deadline: Optional[float] = None,
     ) -> Optional[bytes]:
         import numpy as np
 
@@ -512,9 +585,12 @@ class EcHandlers:
             if shard is not None:
                 b = shard.read_at(size, offset)
             else:
-                b = await self._read_remote_shard_interval(
-                    ev, shard_id, offset, size, file_key
-                )
+                try:
+                    b = await self._read_remote_shard_interval(
+                        ev, shard_id, offset, size, file_key, deadline
+                    )
+                except EcHandlers._Deleted:
+                    b = None
             if b is not None and len(b) == size:
                 bufs[shard_id] = np.frombuffer(b, dtype=np.uint8)
 
@@ -536,6 +612,8 @@ class EcHandlers:
             ),
         )
         out = full[missing_shard]
+        if out is not None:
+            EC_RECONSTRUCTIONS.inc()
         return None if out is None else out.tobytes()
 
     async def read_ec_needle(self, ev: EcVolume, key: int) -> Optional[Needle]:
@@ -551,15 +629,18 @@ class EcHandlers:
         self, ev: EcVolume, key: int, offset_units: int, size: int
     ) -> Optional[Needle]:
         """Interval reads for an already-located needle (the bulk path hands
-        in offsets from EcVolume.bulk_locate instead of re-searching)."""
+        in offsets from EcVolume.bulk_locate instead of re-searching). One
+        deadline covers the WHOLE needle — retries on interval 1 shrink the
+        budget intervals 2..n may spend."""
         intervals = ev.intervals_for(offset_units, size)
+        deadline = deadline_after(EC_READ_DEADLINE_SECONDS)
         chunks = []
         for iv in intervals:
             shard_id, shard_offset = iv.to_shard_id_and_offset(
                 1024 * 1024 * 1024, 1024 * 1024
             )
             data = await self._read_one_ec_interval(
-                ev, shard_id, shard_offset, iv.size, key
+                ev, shard_id, shard_offset, iv.size, key, deadline
             )
             if data is None or len(data) != iv.size:
                 return None

@@ -12,6 +12,8 @@ import os
 import threading
 from typing import Protocol
 
+from ..util import faults
+
 
 class BackendStorageFile(Protocol):
     def read_at(self, size: int, offset: int) -> bytes: ...
@@ -35,6 +37,7 @@ class DiskFile:
 
     def __init__(self, path: str, create: bool = True, read_only: bool = False):
         self._path = path
+        self._read_only = read_only
         if read_only:
             flags = os.O_RDONLY
         else:
@@ -48,6 +51,8 @@ class DiskFile:
         return self._path
 
     def read_at(self, size: int, offset: int) -> bytes:
+        if faults._PLAN is not None:
+            faults.sync_fault(faults._PLAN, "read_at", self._path)
         chunks = []
         remaining, pos = size, offset
         while remaining > 0:
@@ -60,6 +65,8 @@ class DiskFile:
         return b"".join(chunks)
 
     def write_at(self, data: bytes, offset: int) -> int:
+        if faults._PLAN is not None:
+            data = self._faulted_write(faults._PLAN, data, offset)
         view = memoryview(data)
         pos = offset
         while view:
@@ -70,6 +77,39 @@ class DiskFile:
             self._size = pos
         return pos - offset
 
+    def _faulted_write(self, plan, data: bytes, offset: int) -> bytes:
+        """Consult the fault plan for this write. Latency/EIO are applied
+        by sync_fault; torn/crash writes are applied here: the kept prefix
+        is persisted and the fault raised, leaving a short record on disk
+        exactly as an interrupted pwrite chain would."""
+        ev = faults.sync_fault(plan, "write_at", self._path, allow_partial=True)
+        if ev is None:
+            return data
+        if ev.kind in ("torn", "crash"):
+            rule = ev.rule
+            if rule.at_offset is not None:
+                keep = max(0, min(len(data), rule.at_offset - offset))
+            elif rule.keep is not None:
+                keep = min(rule.keep, len(data))
+            else:
+                keep = ev.rng.randrange(len(data) + 1)
+            view = memoryview(data)[:keep]
+            pos = offset
+            while view:
+                n = os.pwrite(self._fd, view, pos)
+                view = view[n:]
+                pos += n
+            if pos > self._size:
+                self._size = pos
+            if ev.kind == "crash":
+                plan.mark_dead()
+                raise faults.SimulatedCrash(
+                    f"crash after {keep}/{len(data)} bytes at "
+                    f"{self._path}:{offset}"
+                )
+            raise faults.injected_eio(self._path)
+        return data
+
     def append(self, data: bytes) -> int:
         """Append at current end; returns the offset written at."""
         end = self.size()
@@ -77,13 +117,22 @@ class DiskFile:
         return end
 
     def truncate(self, size: int) -> None:
+        if faults._PLAN is not None:
+            faults.sync_fault(faults._PLAN, "truncate", self._path)
         os.ftruncate(self._fd, size)
         self._size = size
 
     def sync(self) -> None:
+        if faults._PLAN is not None:
+            faults.sync_fault(faults._PLAN, "sync", self._path)
         os.fsync(self._fd)
 
     def size(self) -> int:
+        if self._read_only:
+            # read-only opens (cli fix/verify, vacuum sources) may watch a
+            # file another writer is appending to; the in-process cache
+            # below is valid only under the single-writer invariant
+            return os.fstat(self._fd).st_size
         return self._size
 
     def close(self) -> None:

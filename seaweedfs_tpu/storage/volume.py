@@ -28,6 +28,7 @@ from ..types import (
     MAX_POSSIBLE_VOLUME_SIZE,
     NEEDLE_HEADER_SIZE,
     NEEDLE_MAP_ENTRY_SIZE,
+    NEEDLE_PADDING_SIZE,
     TOMBSTONE_FILE_SIZE,
     to_actual_offset,
     to_offset_units,
@@ -110,6 +111,177 @@ def check_volume_data_integrity(
     return n.append_at_ns
 
 
+class UnrecoverableCorruption(Exception):
+    """A COMPLETE record failed verification: bit rot, not a torn tail.
+    Truncating would destroy an acked, durable write — the volume must go
+    read-only with the evidence intact instead."""
+
+
+def _idx_entry_status(
+    dat: BackendStorageFile, version: int, key: int, offset_units: int,
+    size: int, dat_size: int,
+) -> tuple[str, Optional[int]]:
+    """Classify the record one .idx entry references:
+    ("ok", end)      — complete and CRC-valid;
+    ("ok-weak", None) — delete-of-absent-key entry (offset 0): valid but
+                        names no position;
+    ("torn", None)   — the record extends past EOF: a crash artifact,
+                        safe to drop (its write was never acked);
+    ("corrupt", None) — complete on disk but fails id/size/CRC checks:
+                        bit rot, NOT recoverable by truncation."""
+    if offset_units == 0:
+        return ("ok-weak", None)
+    body_size = 0 if size == TOMBSTONE_FILE_SIZE else size
+    offset = to_actual_offset(offset_units)
+    end = offset + get_actual_size(body_size, version)
+    if end > dat_size:
+        return ("torn", None)
+    try:
+        n = read_needle_data(dat, offset, body_size, version)
+    except Exception:
+        return ("corrupt", None)
+    if n.id != key:
+        return ("corrupt", None)
+    return ("ok", end)
+
+
+def expected_dat_frontier(
+    version: int, idx_path: str, data_start: int
+) -> Optional[int]:
+    """Where the .dat should end according to the .idx: the MAX record end
+    over every entry (every append logs exactly one entry after its record
+    lands). Order-independent on purpose — `weed-tpu fix` and vacuum
+    rebuild key-SORTED index files, where the last entry is the largest
+    key, not the latest append. None when the frontier cannot be derived
+    (torn idx, no positional entries). Vectorized: this runs on every
+    memory-kind volume load."""
+    idx_size = os.path.getsize(idx_path)
+    if idx_size % NEEDLE_MAP_ENTRY_SIZE != 0:
+        return None
+    if idx_size == 0:
+        return data_start
+    import numpy as np
+
+    from ..types import VERSION3
+    from .idx import parse_index_bytes
+
+    with open(idx_path, "rb") as f:
+        _keys, offsets, sizes = parse_index_bytes(f.read())
+    live = offsets > 0
+    if not live.any():
+        return None
+    body = np.where(
+        sizes == np.uint32(TOMBSTONE_FILE_SIZE), 0, sizes
+    ).astype(np.int64)
+    # get_actual_size, vectorized: header+body+crc(+ts), padded to 8 with
+    # 1..8 bytes (8 - base%8 is already in 1..8, matching padding_length)
+    base = NEEDLE_HEADER_SIZE + body + 4 + (8 if version == VERSION3 else 0)
+    ends = offsets.astype(np.int64) * NEEDLE_PADDING_SIZE + base + (
+        8 - base % 8
+    )
+    return int(ends[live].max())
+
+
+def recover_torn_tail(
+    dat: BackendStorageFile, version: int, idx_path: str,
+    data_start: int = SUPER_BLOCK_SIZE,
+) -> dict:
+    """Bring a volume whose process died mid-append back to a consistent
+    prefix (the reference instead marks the volume read-only,
+    volume_loading.go:100-116 — we repair).
+
+    Verifies every .idx entry against its record (complete + CRC-valid).
+    Torn entries — records running past EOF, the shape a crash or a
+    power-loss-reordered flush leaves — must form a contiguous tail,
+    which is truncated away (their writes were never acked). The .dat is
+    then scanned FORWARD from the highest verified record end (order-
+    independent: fix/vacuum write key-sorted index files) to re-index
+    fully-written records whose index entry was lost (crash between the
+    .dat append and the .idx append), and truncated at the first
+    incomplete record. Any COMPLETE record failing verification is bit
+    rot, not a crash artifact: UnrecoverableCorruption, volume goes
+    read-only. Returns counts for the degraded-mode metrics:
+    {records_recovered, dat_bytes_dropped, idx_entries_dropped,
+    idx_bytes_torn}.
+    """
+    from .idx import entry_to_bytes, iter_index
+
+    stats = {
+        "records_recovered": 0,
+        "dat_bytes_dropped": 0,
+        "idx_entries_dropped": 0,
+        "idx_bytes_torn": 0,
+    }
+    idx_size = os.path.getsize(idx_path)
+    torn = idx_size % NEEDLE_MAP_ENTRY_SIZE
+    if torn:
+        idx_size -= torn
+        os.truncate(idx_path, idx_size)
+        stats["idx_bytes_torn"] = torn
+    dat_size = dat.size()
+    n_entries = idx_size // NEEDLE_MAP_ENTRY_SIZE
+    max_valid_end = min(data_start, dat_size)
+    first_torn: Optional[int] = None
+    with open(idx_path, "rb") as f:
+        for i, (key, offset_units, size) in enumerate(iter_index(f)):
+            status, end = _idx_entry_status(
+                dat, version, key, offset_units, size, dat_size
+            )
+            if status == "corrupt":
+                raise UnrecoverableCorruption(
+                    f"record for key {key:#x} is complete but invalid "
+                    f"(bit rot); refusing to truncate acked data"
+                )
+            if status == "torn":
+                if first_torn is None:
+                    first_torn = i
+                continue
+            if first_torn is not None:
+                # a verified entry AFTER a torn one is not the contiguous
+                # tail a crash leaves — too strange to repair blindly
+                raise UnrecoverableCorruption(
+                    "valid index entry follows a torn one; "
+                    "not a crash-shaped tail"
+                )
+            if end is not None:  # positional entry ("ok-weak" has no end)
+                max_valid_end = max(max_valid_end, end)
+    if first_torn is not None:
+        os.truncate(idx_path, first_torn * NEEDLE_MAP_ENTRY_SIZE)
+        stats["idx_entries_dropped"] = n_entries - first_torn
+    pos = max_valid_end
+    recovered: list[bytes] = []
+    while pos + NEEDLE_HEADER_SIZE <= dat_size:
+        try:
+            header, body_len = read_needle_header(dat, version, pos)
+        except Exception:
+            break
+        if header.id == 0 and header.size == 0:
+            break  # zero-fill, never a real record
+        total = NEEDLE_HEADER_SIZE + body_len
+        if pos + total > dat_size:
+            break  # torn mid-record: never acked, drop it
+        try:
+            n = Needle()
+            n.read_bytes(dat.read_at(total, pos), pos, header.size, version)
+        except Exception:
+            break
+        size_for_index = (
+            n.size if len(n.data) else TOMBSTONE_FILE_SIZE
+        )  # empty record == tombstone append (volume_read_write.go:186)
+        recovered.append(
+            entry_to_bytes(n.id, to_offset_units(pos), size_for_index)
+        )
+        pos += total
+    if recovered:
+        with open(idx_path, "ab") as f:
+            f.write(b"".join(recovered))
+        stats["records_recovered"] = len(recovered)
+    if pos < dat_size:
+        dat.truncate(pos)
+        stats["dat_bytes_dropped"] = dat_size - pos
+    return stats
+
+
 class Volume:
     def __init__(
         self,
@@ -171,14 +343,32 @@ class Volume:
             self.data_backend.write_at(self.super_block.to_bytes(), 0)
 
         self.needle_map_kind = needle_map_kind
+        self.recovery_stats: Optional[dict] = None
         self.nm: NeedleMap
         if os.path.exists(base + ".idx") and dat_exists:
             try:
                 self.last_append_at_ns = check_volume_data_integrity(
                     self.data_backend, self.version, base + ".idx"
                 )
+                if needle_map_kind == "memory":
+                    # the last idx entry can verify while the dat still
+                    # carries a torn record PAST it (crash mid-append,
+                    # before the idx entry landed) — check the frontier
+                    expected = expected_dat_frontier(
+                        self.version, base + ".idx",
+                        self.super_block.block_size(),
+                    )
+                    if expected is not None and expected != self.data_backend.size():
+                        self._recover_torn_tail(base)
             except Exception:
-                self.no_write_or_delete = True
+                # the tail is torn (crash mid-append). The reference mounts
+                # read-only; we repair to the last CRC-valid needle
+                # boundary — but only for the log-format .idx the memory
+                # map replays (sqlite/sorted kinds have other formats)
+                if needle_map_kind == "memory":
+                    self._recover_torn_tail(base)
+                else:
+                    self.no_write_or_delete = True
             self.nm = self._open_needle_map(base, needle_map_kind)
             if needle_map_kind == "sorted":
                 # sorted-file maps can't Put; the reference only uses them
@@ -209,6 +399,39 @@ class Volume:
 
             return SortedFileNeedleMap(base + ".idx")
         return load_needle_map(base + ".idx")
+
+    def _recover_torn_tail(self, base: str) -> None:
+        """Repair a torn .dat/.idx tail on load; read-only fallback when
+        even the repaired prefix fails verification."""
+        from ..util.log import warning
+        from ..util.metrics import TORN_TAIL_COUNTER
+
+        try:
+            stats = recover_torn_tail(
+                self.data_backend, self.version, base + ".idx",
+                data_start=self.super_block.block_size(),
+            )
+            self.last_append_at_ns = check_volume_data_integrity(
+                self.data_backend, self.version, base + ".idx"
+            )
+        except Exception:
+            self.no_write_or_delete = True
+            return
+        self.recovery_stats = stats
+        TORN_TAIL_COUNTER.inc(item="volumes")
+        for item, key in (
+            ("records_recovered", "records_recovered"),
+            ("dat_bytes_dropped", "dat_bytes_dropped"),
+            ("idx_entries_dropped", "idx_entries_dropped"),
+        ):
+            if stats[key]:
+                TORN_TAIL_COUNTER.inc(stats[key], item=item)
+        warning(
+            "volume %d: torn tail recovered (%d records re-indexed, "
+            "%d dat bytes dropped, %d idx entries dropped)",
+            self.id, stats["records_recovered"], stats["dat_bytes_dropped"],
+            stats["idx_entries_dropped"],
+        )
 
     # --- basic accessors ---
     def file_name(self) -> str:

@@ -1,0 +1,96 @@
+"""Capped, jittered exponential backoff with deadline propagation.
+
+Shared by every serving-path retry loop (master-client lookups, EC remote
+shard reads, keep-connected reconnects) so they all have the same shape:
+full-jitter delays (AWS architecture blog's `random(0, min(cap, base*2^k))`
+— the variant that best de-correlates a thundering herd), a hard attempt
+cap, and an absolute deadline that both truncates sleeps and refuses to
+start attempts it cannot finish. Pass a seeded `random.Random` for
+deterministic tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Optional
+
+from .metrics import RETRY_COUNTER
+
+
+@dataclass(frozen=True)
+class BackoffPolicy:
+    base: float = 0.05  # first-retry delay upper bound (seconds)
+    cap: float = 2.0  # per-delay ceiling
+    multiplier: float = 2.0
+    attempts: int = 4  # total tries, including the first
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        """Full-jitter delay before retry number `attempt` (0-based)."""
+        return rng.uniform(0.0, min(self.cap, self.base * self.multiplier**attempt))
+
+
+DEFAULT_POLICY = BackoffPolicy()
+
+
+def deadline_after(seconds: Optional[float]) -> Optional[float]:
+    """Relative budget -> absolute time.monotonic() deadline (None passes
+    through: no deadline)."""
+    return None if seconds is None else time.monotonic() + seconds
+
+
+def remaining(deadline: Optional[float], default: Optional[float] = None,
+              floor: float = 0.001) -> Optional[float]:
+    """Seconds left until an absolute deadline, for per-call timeouts.
+    None deadline -> `default`. Never returns less than `floor`, so a
+    just-expired deadline yields a timeout that fails fast rather than a
+    negative value some APIs treat as infinite."""
+    if deadline is None:
+        return default
+    return max(floor, deadline - time.monotonic())
+
+
+async def retry_async(
+    fn: Callable[[], Awaitable],
+    *,
+    policy: BackoffPolicy = DEFAULT_POLICY,
+    deadline: Optional[float] = None,
+    retry_on: tuple = (Exception,),
+    rng: Optional[random.Random] = None,
+    op: str = "",
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+) -> object:
+    """Run `fn()` (a zero-arg coroutine factory) with backoff.
+
+    `deadline` is absolute (time.monotonic()); sleeps are truncated to it
+    and no retry starts past it — the budget propagates into `fn` via
+    `remaining(deadline)` at the call site. The last exception is re-raised
+    when attempts or deadline run out. Retries count into
+    seaweedfs_tpu_retries_total{op=...}.
+    """
+    rng = rng or random
+    last: Optional[BaseException] = None
+    for attempt in range(policy.attempts):
+        try:
+            return await fn()
+        except retry_on as e:
+            last = e
+        if attempt == policy.attempts - 1:
+            break
+        d = policy.delay(attempt, rng)
+        if deadline is not None:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                break
+            d = min(d, left)
+        if op:
+            RETRY_COUNTER.inc(op=op)
+        if on_retry is not None:
+            on_retry(attempt, last)
+        await asyncio.sleep(d)
+        if deadline is not None and time.monotonic() >= deadline:
+            break
+    assert last is not None
+    raise last

@@ -29,6 +29,8 @@ from __future__ import annotations
 import asyncio
 from typing import Awaitable, Callable, Optional
 
+from . import faults
+
 FALLBACK = object()  # sentinel: "proxy this request to the full app"
 DETACHED = object()  # sentinel: "the handler will write the response itself
 # (via req.transport) from a later callback" — used by batch continuations
@@ -138,6 +140,10 @@ class FastHTTPProtocol(asyncio.Protocol):
         self._continued = False  # 100 Continue sent for the pending request
         self._processing = False  # a request's response is still pending
         self._want_continue = False  # 100 deferred until the conn is idle
+        # kernel-buffer flow control (pause_writing/resume_writing): relays
+        # await _drain_waiter instead of polling get_write_buffer_size()
+        self._write_paused = False
+        self._drain_waiter: Optional[asyncio.Future] = None
         # backpressure threshold for the CURRENT partial request: raised by
         # _try_parse once the request's frame size is known, so a request
         # whose total frame slightly exceeds _MAX_BODY (body under the cap,
@@ -163,8 +169,36 @@ class FastHTTPProtocol(asyncio.Protocol):
         self._closed = True
         self._queue.put_nowait(None)
         self.server._conns.discard(self)
+        w = self._drain_waiter
+        if w is not None and not w.done():
+            w.set_result(None)  # waiters wake and see is_closing()
+        self._drain_waiter = None
         if self._task is not None:
             self._task.cancel()
+
+    # -- outgoing flow control (transport write-buffer watermarks) --
+    def pause_writing(self):
+        self._write_paused = True
+
+    def resume_writing(self):
+        self._write_paused = False
+        w = self._drain_waiter
+        if w is not None and not w.done():
+            w.set_result(None)
+        self._drain_waiter = None
+
+    async def drain(self):
+        """Wait until the transport's write buffer falls under the low
+        watermark (or the connection dies — callers re-check is_closing).
+        The event-driven replacement for sleep-polling
+        get_write_buffer_size() in paced relays."""
+        if not self._write_paused or self._closed:
+            return
+        w = self._drain_waiter
+        if w is None or w.done():
+            w = asyncio.get_event_loop().create_future()
+            self._drain_waiter = w
+        await w
 
     def data_received(self, data: bytes):
         self.buf += data
@@ -501,16 +535,29 @@ async def _relay_paced(
     transport, data: bytes, stall_timeout: float = 60.0
 ) -> None:
     """Write to a protocol transport without unbounded buffering: after
-    each piece, wait for the kernel to drain past the high-water mark.
-    A client that stops reading mid-stream would otherwise pin the event
-    loop polling forever and hold the backend connection open — bound the
-    wait and let the caller's except path drop the connection."""
+    each piece, wait for the transport's flow control to signal drained
+    (pause_writing fired on write when the buffer crossed the high-water
+    mark; resume_writing resolves the protocol's drain future). A client
+    that stops reading mid-stream holds the relay in ONE suspended await
+    instead of a wakeup loop; the wait is still bounded so the caller's
+    except path can drop the connection."""
     if transport.is_closing():
         # a closed client must STOP the relay loop, not look "drained" —
         # otherwise the caller pulls the whole remaining backend body
         # into a dead connection
         raise ConnectionResetError("client connection closed mid-relay")
     transport.write(data)
+    proto = transport.get_protocol()
+    drain = getattr(proto, "drain", None)
+    if drain is not None:
+        try:
+            await asyncio.wait_for(drain(), stall_timeout)
+        except asyncio.TimeoutError:
+            raise TimeoutError("client stalled during streamed relay") from None
+        if transport.is_closing():
+            raise ConnectionResetError("client connection closed mid-relay")
+        return
+    # transports whose protocol has no drain hook: legacy sleep-poll
     waited = 0.0
     while transport.get_write_buffer_size() > _STREAM_THRESHOLD:
         if transport.is_closing():
@@ -895,6 +942,13 @@ class FastHTTPClient:
         headers: Optional[dict] = None,
         retried: bool = False,
     ) -> tuple[int, bytes]:
+        plan = faults._PLAN
+        if plan is not None:
+            # fault-injection seam: latency sleeps, resets raise, and
+            # http_error rules synthesize a 5xx as if the peer degraded
+            ev = await faults.async_fault(plan, f"http:{method}", hostport)
+            if ev is not None and ev.kind == "http_error":
+                return ev.rule.status, b'{"error":"injected fault"}'
         conn = await self._get(hostport)
         parts = [
             f"{method} {target} HTTP/1.1\r\nHost: {hostport}\r\n".encode()

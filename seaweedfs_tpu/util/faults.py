@@ -1,0 +1,287 @@
+"""Deterministic, seed-driven fault injection for the serving paths.
+
+A `FaultPlan` is a schedule of `FaultRule`s keyed by (operation, target):
+"the 3rd write_at on volume 7's .dat fails with EIO", "10% of
+VolumeEcShardRead RPCs to host B see 200ms latency then a reset". The plan
+is consulted from three seams:
+
+- the backend-storage file interface (`storage/backend.py`): short/torn
+  writes, mid-write crash, EIO, fsync failure, read latency;
+- the dynamic-gRPC client (`pb/rpc.py` Stub.call/server_stream):
+  connection reset, latency, hang-until-deadline;
+- the HTTP data-plane client (`util/fasthttp.py` FastHTTPClient.request):
+  connection reset, latency, synthesized 5xx.
+
+Every probabilistic decision draws from a per-rule `random.Random` seeded
+from (plan seed, rule index, rule key), so a plan replays identically for a
+given seed and operation sequence regardless of unrelated interleaving.
+
+Activation: `install_plan()` programmatically, or the environment variable
+`SEAWEEDFS_TPU_FAULTS` naming a JSON plan file (or carrying inline JSON)
+read once at import. With neither, `_PLAN` stays None and every seam is a
+single module-attribute load plus an `is None` check — tier-1 runs
+unchanged.
+
+Crash semantics: a rule with fault="crash" performs a torn write (a prefix
+of the payload) and then marks the plan dead; every later faultable
+operation raises `SimulatedCrash`, like syscalls in a killed process. In
+particular the write path's truncate-rollback cannot run, so the torn tail
+stays on disk for `storage/volume.py`'s load-time recovery to find —
+exactly the state a real `kill -9` mid-append leaves. Tests clear or swap
+the plan before "restarting" the process (reloading the volume).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from fnmatch import fnmatchcase
+from random import Random
+from typing import Optional
+
+from .metrics import FAULTS_INJECTED
+
+
+class SimulatedCrash(BaseException):
+    """The process 'died' mid-operation. Derives from BaseException so
+    per-operation `except Exception` cleanup handlers (e.g. the volume
+    write path's truncate-rollback) cannot swallow it and tidy up state a
+    real crash would have left torn."""
+
+
+class InjectedError(OSError):
+    """Marker base for injected I/O errors (still an OSError, so existing
+    error handling treats it like the real thing)."""
+
+
+def injected_eio(target: str) -> InjectedError:
+    return InjectedError(errno.EIO, f"injected EIO on {target}")
+
+
+@dataclass
+class FaultRule:
+    """One scheduled fault.
+
+    op/target are fnmatch patterns: op names the seam ("write_at",
+    "read_at", "sync", "truncate", "rpc:<Method>", "http:<METHOD>"), target
+    the file path or host:port. Trigger is either `nth` (fire on the nth
+    matching call, 1-based) or `probability` (per-match coin flip from the
+    rule's seeded RNG); `times` caps total fires (default 1 for nth rules,
+    unlimited for probability rules).
+    """
+
+    op: str
+    target: str = "*"
+    fault: str = "eio"  # eio|torn|crash|latency|reset|hang|http_error
+    nth: Optional[int] = None
+    probability: Optional[float] = None
+    times: Optional[int] = None
+    delay: float = 0.0  # seconds, for latency/hang (hang: until deadline)
+    keep: Optional[int] = None  # bytes written before a torn/crash write
+    at_offset: Optional[int] = None  # absolute file offset the crash cuts at
+    status: int = 503  # synthesized status for http_error
+
+    def max_fires(self) -> Optional[int]:
+        if self.times is not None:
+            return self.times
+        return 1 if self.nth is not None else None
+
+
+@dataclass
+class FaultEvent:
+    """One fired fault, as handed to a seam (and logged on the plan)."""
+
+    rule: FaultRule
+    op: str
+    target: str
+    rng: Random  # rule-scoped; seams draw torn-write cut points from it
+
+    @property
+    def kind(self) -> str:
+        return self.rule.fault
+
+
+class FaultPlan:
+    def __init__(self, seed: int = 0, rules: Optional[list[FaultRule]] = None):
+        self.seed = seed
+        self.rules: list[FaultRule] = []
+        self._lock = threading.Lock()
+        self._match_counts: list[int] = []
+        self._fire_counts: list[int] = []
+        self._rngs: list[Random] = []
+        self._dead = False
+        self.events: list[tuple[str, str, str]] = []  # (op, target, kind)
+        for r in rules or []:
+            self.add(r)
+
+    def add(self, rule: FaultRule) -> "FaultPlan":
+        with self._lock:
+            i = len(self.rules)
+            self.rules.append(rule)
+            self._match_counts.append(0)
+            self._fire_counts.append(0)
+            # rule-scoped stream: firing decisions for one rule are
+            # independent of how other rules' matches interleave
+            self._rngs.append(Random(f"{self.seed}:{i}:{rule.op}:{rule.target}"))
+        return self
+
+    def mark_dead(self) -> None:
+        with self._lock:
+            self._dead = True
+
+    @property
+    def dead(self) -> bool:
+        return self._dead
+
+    def fired(self, op_pattern: str = "*") -> int:
+        with self._lock:
+            return sum(1 for op, _t, _k in self.events if fnmatchcase(op, op_pattern))
+
+    def match(self, op: str, target: str) -> Optional[FaultEvent]:
+        """Consult the schedule for one operation; returns the fault to
+        apply, or None. Raises SimulatedCrash once the plan is dead."""
+        with self._lock:
+            if self._dead:
+                raise SimulatedCrash(f"{op} on {target} after simulated crash")
+            for i, rule in enumerate(self.rules):
+                if not fnmatchcase(op, rule.op) or not fnmatchcase(target, rule.target):
+                    continue
+                self._match_counts[i] += 1
+                cap = rule.max_fires()
+                if cap is not None and self._fire_counts[i] >= cap:
+                    continue
+                fire = False
+                if rule.nth is not None:
+                    fire = self._match_counts[i] == rule.nth
+                elif rule.probability is not None:
+                    fire = self._rngs[i].random() < rule.probability
+                else:
+                    fire = True
+                if not fire:
+                    continue
+                self._fire_counts[i] += 1
+                self.events.append((op, target, rule.fault))
+                FAULTS_INJECTED.inc(op=op.split(":")[0], kind=rule.fault)
+                return FaultEvent(rule=rule, op=op, target=target, rng=self._rngs[i])
+        return None
+
+    # --- (de)serialization: env-var / JSON-file activation ---
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        plan = cls(seed=int(d.get("seed", 0)))
+        for rd in d.get("rules", []):
+            plan.add(FaultRule(**rd))
+        return plan
+
+    def to_dict(self) -> dict:
+        out = {"seed": self.seed, "rules": []}
+        for r in self.rules:
+            rd = {"op": r.op, "target": r.target, "fault": r.fault}
+            for k in ("nth", "probability", "times", "keep", "at_offset"):
+                v = getattr(r, k)
+                if v is not None:
+                    rd[k] = v
+            if r.delay:
+                rd["delay"] = r.delay
+            if r.fault == "http_error":
+                rd["status"] = r.status
+            out["rules"].append(rd)
+        return out
+
+
+# process-global plan; seams read the module attribute directly so the
+# disabled path costs one load + is-None test
+_PLAN: Optional[FaultPlan] = None
+
+
+def install_plan(plan: Optional[FaultPlan]) -> None:
+    global _PLAN
+    _PLAN = plan
+
+
+def clear_plan() -> None:
+    install_plan(None)
+
+
+def current_plan() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def _load_env_plan() -> None:
+    spec = os.environ.get("SEAWEEDFS_TPU_FAULTS", "")
+    if not spec:
+        return
+    try:
+        if spec.lstrip().startswith("{"):
+            data = json.loads(spec)
+        else:
+            with open(spec) as f:
+                data = json.load(f)
+        install_plan(FaultPlan.from_dict(data))
+    except Exception as e:  # a broken plan must be loud, not silently off
+        raise ValueError(f"SEAWEEDFS_TPU_FAULTS unparseable: {e}") from e
+
+
+_load_env_plan()
+
+
+# ---------------------------------------------------------------- seams --
+
+
+def sync_fault(
+    plan: FaultPlan, op: str, target: str, allow_partial: bool = False
+) -> Optional[FaultEvent]:
+    """Blocking-code seam (disk I/O): applies latency/EIO in place. With
+    allow_partial (the write seam), torn/crash events are RETURNED for the
+    caller to apply as a partial write; on every other seam a fired event
+    must never be a counted no-op, so crash kills the plan here and torn
+    degrades to EIO."""
+    ev = plan.match(op, target)
+    if ev is None:
+        return None
+    kind = ev.kind
+    if kind == "latency":
+        time.sleep(ev.rule.delay)
+        return None
+    if kind in ("eio", "fsync_fail"):
+        raise injected_eio(target)
+    if not allow_partial:
+        if kind == "crash":
+            plan.mark_dead()
+            raise SimulatedCrash(f"crash in {op} of {target}")
+        raise injected_eio(target)
+    return ev
+
+
+async def async_fault(
+    plan: FaultPlan, op: str, target: str, timeout: Optional[float] = None
+) -> Optional[FaultEvent]:
+    """Event-loop seam (RPC/HTTP clients). latency sleeps then proceeds;
+    reset raises ConnectionResetError; hang sleeps until the CALLER's
+    per-call timeout (or the rule's delay, whichever is shorter; 30s when
+    neither bounds it) then raises TimeoutError — the shape of a peer
+    that accepted the connection and went silent, surfacing through the
+    same deadline machinery a real hang would. http_error events are
+    returned for the HTTP seam to synthesize a status; other seams treat
+    them as resets."""
+    ev = plan.match(op, target)
+    if ev is None:
+        return None
+    kind = ev.kind
+    if kind == "latency":
+        await asyncio.sleep(ev.rule.delay)
+        return None
+    if kind == "reset":
+        raise ConnectionResetError(f"injected reset: {op} to {target}")
+    if kind == "hang":
+        bounds = [w for w in (ev.rule.delay or None, timeout) if w is not None]
+        await asyncio.sleep(min(bounds) if bounds else 30.0)
+        raise TimeoutError(f"injected hang: {op} to {target}")
+    if kind in ("eio",):
+        raise injected_eio(target)
+    return ev

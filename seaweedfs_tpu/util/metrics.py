@@ -174,3 +174,23 @@ VOLUME_GAUGE = REGISTRY.gauge(
 EC_ENCODE_BYTES = REGISTRY.counter(
     "seaweedfs_tpu_ec_encoded_bytes_total", "bytes erasure-coded, by backend"
 )
+
+# degraded-mode visibility (see docs/robustness.md): every retry loop,
+# on-the-fly EC reconstruction and load-time torn-tail repair counts here,
+# so a chaos run can assert HOW the system survived, not just that it did
+RETRY_COUNTER = REGISTRY.counter(
+    "seaweedfs_tpu_retries_total", "retry attempts by operation"
+)
+EC_RECONSTRUCTIONS = REGISTRY.counter(
+    "seaweedfs_tpu_ec_reconstructions_total",
+    "EC intervals served by reconstruction from >= data_shards other shards",
+)
+TORN_TAIL_COUNTER = REGISTRY.counter(
+    "seaweedfs_tpu_torn_tail_total",
+    "torn-tail recovery on volume load, by item "
+    "(volumes/records_recovered/dat_bytes_dropped/idx_entries_dropped)",
+)
+FAULTS_INJECTED = REGISTRY.counter(
+    "seaweedfs_tpu_faults_injected_total",
+    "faults fired by the active injection plan, by op/kind",
+)

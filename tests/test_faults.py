@@ -1,0 +1,450 @@
+"""Fault-injection harness + hardened recovery (ISSUE 1).
+
+Three layers of coverage, all fast enough for tier-1 (the chaos smoke is
+the every-PR regression gate ISSUE 1 asks for):
+
+- FaultPlan mechanics: determinism for a given seed, nth/probability
+  triggers, env-var activation, the dead-plan (post-crash) state.
+- Backoff: full-jitter bounds, deadline honoring, retry-until-success.
+- Crash recovery: a property test killing the process at 200+ random byte
+  offsets (mid .dat record, mid .idx entry, mid fsync) and asserting every
+  fully-acked write survives reload and every torn needle is dropped.
+- Cluster chaos smoke: a 3-node cluster read workload under a seeded plan
+  injecting EIO + resets + latency returns 100% correct bytes.
+"""
+
+import asyncio
+import os
+import random
+import time
+
+import pytest
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.util import faults
+from seaweedfs_tpu.util.backoff import (
+    BackoffPolicy,
+    deadline_after,
+    remaining,
+    retry_async,
+)
+from seaweedfs_tpu.util.faults import (
+    FaultPlan,
+    FaultRule,
+    InjectedError,
+    SimulatedCrash,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """Every test starts and ends with injection disabled."""
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+
+
+# ---------------------------------------------------------------- plan --
+
+
+def test_plan_is_deterministic_for_seed():
+    def run(seed):
+        plan = FaultPlan(seed=seed, rules=[
+            FaultRule(op="read_at", target="*", probability=0.3, fault="eio"),
+            FaultRule(op="write_at", target="*.dat", nth=5, fault="eio"),
+        ])
+        events = []
+        for i in range(200):
+            try:
+                ev = plan.match("read_at" if i % 2 else "write_at",
+                                f"/v/{i % 3}.dat")
+            except BaseException:
+                ev = None
+            events.append(None if ev is None else (ev.op, ev.kind))
+        return events
+
+    assert run(42) == run(42)
+    assert run(42) != run(43)  # and the seed actually matters
+
+
+def test_plan_nth_fires_once():
+    plan = FaultPlan(rules=[
+        FaultRule(op="sync", target="*", nth=3, fault="eio"),
+    ])
+    fired = [plan.match("sync", "/x") is not None for _ in range(10)]
+    assert fired == [False, False, True] + [False] * 7
+
+
+def test_plan_times_caps_probability_rule():
+    plan = FaultPlan(seed=1, rules=[
+        FaultRule(op="op", target="*", probability=1.0, times=2, fault="eio"),
+    ])
+    fired = [plan.match("op", "t") is not None for _ in range(5)]
+    assert fired == [True, True, False, False, False]
+
+
+def test_plan_dead_after_crash_raises_everywhere():
+    plan = FaultPlan(rules=[])
+    plan.mark_dead()
+    with pytest.raises(SimulatedCrash):
+        plan.match("read_at", "/any")
+
+
+def test_env_var_activation(monkeypatch):
+    spec = '{"seed": 9, "rules": [{"op": "read_at", "nth": 1, "fault": "eio"}]}'
+    monkeypatch.setenv("SEAWEEDFS_TPU_FAULTS", spec)
+    faults._load_env_plan()
+    plan = faults.current_plan()
+    assert plan is not None and plan.seed == 9
+    assert plan.match("read_at", "/x").kind == "eio"
+    faults.clear_plan()
+
+
+def test_plan_roundtrips_through_dict():
+    plan = FaultPlan(seed=3, rules=[
+        FaultRule(op="write_at", target="*.dat", nth=2, fault="crash", keep=10),
+        FaultRule(op="http:GET", probability=0.5, fault="http_error", status=503),
+    ])
+    plan2 = FaultPlan.from_dict(plan.to_dict())
+    assert plan2.to_dict() == plan.to_dict()
+
+
+# ------------------------------------------------------------- backoff --
+
+
+def test_backoff_delays_respect_jitter_bounds():
+    policy = BackoffPolicy(base=0.1, cap=1.5, multiplier=2.0, attempts=10)
+    rng = random.Random(7)
+    for attempt in range(10):
+        upper = min(1.5, 0.1 * 2.0**attempt)
+        for _ in range(50):
+            d = policy.delay(attempt, rng)
+            assert 0.0 <= d <= upper
+
+
+def test_retry_async_honors_deadline():
+    calls = []
+
+    async def always_fails():
+        calls.append(1)
+        raise IOError("nope")
+
+    async def body():
+        t0 = time.monotonic()
+        with pytest.raises(IOError):
+            await retry_async(
+                always_fails,
+                policy=BackoffPolicy(base=0.05, cap=0.05, attempts=1000),
+                deadline=deadline_after(0.2),
+                rng=random.Random(1),
+            )
+        return time.monotonic() - t0
+
+    elapsed = asyncio.run(body())
+    assert elapsed < 1.0  # nowhere near 1000 attempts' worth
+    assert 2 <= len(calls) < 50
+
+
+def test_retry_async_returns_after_transient_failures():
+    attempts = []
+
+    async def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise ConnectionResetError("transient")
+        return "ok"
+
+    out = asyncio.run(retry_async(
+        flaky,
+        policy=BackoffPolicy(base=0.001, cap=0.002, attempts=5),
+        rng=random.Random(2),
+    ))
+    assert out == "ok" and len(attempts) == 3
+
+
+def test_remaining_converts_deadline_to_timeout():
+    assert remaining(None, 30.0) == 30.0
+    d = deadline_after(5.0)
+    assert 4.0 < remaining(d) <= 5.0
+    assert remaining(time.monotonic() - 1.0) == pytest.approx(0.001)
+
+
+# ------------------------------------------------------- disk backend --
+
+
+def test_diskfile_eio_write_rolls_back_cleanly(tmp_path):
+    v = Volume(str(tmp_path), "", 1)
+    v.write_needle(Needle(cookie=1, id=1, data=b"a" * 40))
+    faults.install_plan(FaultPlan(rules=[
+        FaultRule(op="write_at", target="*.dat", nth=1, fault="torn", keep=9),
+    ]))
+    with pytest.raises(InjectedError):
+        v.write_needle(Needle(cookie=2, id=2, data=b"b" * 40))
+    faults.clear_plan()
+    # the write path's truncate-rollback ran: the tail is clean and the
+    # volume keeps serving
+    v.write_needle(Needle(cookie=3, id=3, data=b"c" * 40))
+    for nid, byte in ((1, b"a"), (3, b"c")):
+        n = Needle(id=nid, cookie=nid)
+        v.read_needle(n)
+        assert n.data == byte * 40
+    v.close()
+
+
+def test_diskfile_readonly_size_tracks_external_growth(tmp_path):
+    from seaweedfs_tpu.storage.backend import DiskFile
+
+    p = str(tmp_path / "grow.dat")
+    writer = DiskFile(p)
+    writer.write_at(b"x" * 10, 0)
+    reader = DiskFile(p, create=False, read_only=True)
+    assert reader.size() == 10
+    writer.write_at(b"y" * 10, 10)  # concurrent append by another handle
+    assert reader.size() == 20  # fstat-backed, not frozen at open time
+    assert writer.size() == 20
+    writer.close()
+    reader.close()
+
+
+# ------------------------------------------------- crash recovery (PBT) --
+
+
+def test_crash_recovery_property(tmp_path):
+    """Kill the 'process' at an arbitrary byte offset mid-append (in the
+    .dat record, the .idx entry, or fsync) and reload: every fully-acked
+    write must read back byte-identical, the torn needle must be gone, and
+    the volume must come back writable. 200+ seeded kill points."""
+    rng = random.Random(0xFA17)
+    for it in range(220):
+        d = tmp_path / f"it{it}"
+        d.mkdir()
+        v = Volume(str(d), "", 1)
+        acked = {}
+        for nid in range(1, rng.randrange(1, 6) + 1):
+            data = bytes([rng.randrange(256)]) * rng.randrange(8, 200)
+            v.write_needle(Needle(cookie=nid, id=nid, data=data))
+            acked[nid] = data
+        deleted = None
+        if acked and rng.random() < 0.3:
+            deleted = rng.choice(list(acked))
+            v.delete_needle(Needle(id=deleted, cookie=deleted))
+            del acked[deleted]
+
+        victim_data = b"V" * rng.randrange(8, 200)
+        where = rng.choice([".dat", ".dat", ".dat", ".idx", "sync"])
+        if where == "sync":
+            rule = FaultRule(op="sync", target="*", nth=1, fault="crash")
+        else:
+            # keep is a uniformly random cut point inside the pending
+            # append (record for .dat, 16-byte entry for .idx)
+            rule = FaultRule(
+                op="write_at", target=f"*{where}", nth=1, fault="crash",
+                keep=rng.randrange(0, 300),
+            )
+        faults.install_plan(FaultPlan(seed=it, rules=[rule]))
+        try:
+            v.write_needle(
+                Needle(cookie=99, id=99, data=victim_data),
+                sync=(where == "sync"),
+            )
+            crashed = False
+        except SimulatedCrash:
+            crashed = True
+        except Exception:
+            crashed = False  # keep cut past the record: write fine
+        finally:
+            faults.clear_plan()
+        assert crashed, f"iteration {it}: crash fault did not fire"
+
+        v2 = Volume(str(d), "", 1, create=False)
+        assert not v2.is_read_only(), f"iteration {it}: stuck read-only"
+        for nid, data in acked.items():
+            n = Needle(id=nid, cookie=nid)
+            assert v2.read_needle(n) == len(data), f"iteration {it}: lost {nid}"
+            assert n.data == data, f"iteration {it}: corrupt {nid}"
+        if deleted is not None:
+            with pytest.raises(Exception):
+                v2.read_needle(Needle(id=deleted, cookie=deleted))
+        # the victim is either fully recovered or fully gone — never torn
+        n = Needle(id=99, cookie=99)
+        try:
+            v2.read_needle(n)
+            assert n.data == victim_data, f"iteration {it}: torn victim"
+        except Exception:
+            pass
+        # and the volume accepts (and persists) new writes
+        v2.write_needle(Needle(cookie=7, id=777, data=b"post" * 4))
+        n = Needle(id=777, cookie=7)
+        v2.read_needle(n)
+        assert n.data == b"post" * 4
+        v2.close()
+
+
+def test_key_sorted_idx_reload_is_not_misdiagnosed(tmp_path):
+    """`weed-tpu fix` and vacuum rebuild KEY-sorted index files, where the
+    last entry is the largest key, not the latest append. The load-time
+    frontier check must stay order-independent: no spurious 'torn tail'
+    recovery on a healthy volume."""
+    from seaweedfs_tpu.storage.backend import DiskFile
+    from seaweedfs_tpu.storage.needle_map import MemDb
+    from seaweedfs_tpu.storage.super_block import read_super_block
+    from seaweedfs_tpu.storage.volume import scan_volume_file
+    from seaweedfs_tpu.types import to_offset_units
+
+    v = Volume(str(tmp_path), "", 3)
+    # dat order k1, k5, k1': in a key-sorted idx the LAST entry (k5) ends
+    # mid-file — a naive last-entry frontier would cry torn tail here
+    v.write_needle(Needle(cookie=1, id=1, data=b"a" * 50))
+    v.write_needle(Needle(cookie=5, id=5, data=b"e" * 50))
+    v.write_needle(Needle(cookie=1, id=1, data=b"A" * 70))
+    v.close()
+
+    base = str(tmp_path / "3")
+    dat = DiskFile(base + ".dat", create=False, read_only=True)
+    sb = read_super_block(dat)
+    nm = MemDb()
+
+    def visit(n, offset, body):
+        if n.size > 0:
+            nm.set(n.id, to_offset_units(offset), n.size)
+        else:
+            nm.delete(n.id)
+
+    scan_volume_file(dat, sb, visit, read_body=False)
+    nm.save_to_idx(base + ".idx")  # key-sorted, like cli.py _fix
+    dat.close()
+
+    v2 = Volume(str(tmp_path), "", 3, create=False)
+    assert v2.recovery_stats is None  # no spurious recovery
+    assert not v2.is_read_only()
+    n = Needle(id=1, cookie=1)
+    v2.read_needle(n)
+    assert n.data == b"A" * 70
+    v2.close()
+
+
+def test_injected_hang_respects_call_timeout():
+    """An injected RPC hang must surface through the caller's timeout,
+    not a hardcoded 30s — the deadline propagation is the contract."""
+    plan = FaultPlan(rules=[FaultRule(op="rpc:Slow", fault="hang")])
+
+    async def body():
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            await faults.async_fault(plan, "rpc:Slow", "h:1", timeout=0.05)
+        return time.monotonic() - t0
+
+    assert asyncio.run(body()) < 1.0
+
+
+def test_crash_fault_fires_on_non_write_seams():
+    """A crash rule matching read_at/truncate must actually kill the plan,
+    never be a counted no-op."""
+    from seaweedfs_tpu.storage.backend import DiskFile
+
+    plan = FaultPlan(rules=[FaultRule(op="read_at", nth=1, fault="crash")])
+    faults.install_plan(plan)
+    import tempfile
+
+    with tempfile.NamedTemporaryFile() as f:
+        df = DiskFile(f.name)
+        df.write_at(b"x" * 8, 0)
+        with pytest.raises(SimulatedCrash):
+            df.read_at(4, 0)
+        assert plan.dead
+        with pytest.raises(SimulatedCrash):
+            df.write_at(b"y", 0)  # everything after the crash is dead
+        df.close()
+
+
+def test_bitrot_still_goes_readonly_not_truncated(tmp_path):
+    """In-place corruption of an ACKED record is not a crash artifact:
+    recovery must refuse to truncate it and mark the volume read-only."""
+    v = Volume(str(tmp_path), "", 5)
+    v.write_needle(Needle(cookie=1, id=1, data=b"a" * 64))
+    v.write_needle(Needle(cookie=2, id=2, data=b"b" * 64))
+    v.close()
+    dat = str(tmp_path / "5.dat")
+    size = os.path.getsize(dat)
+    with open(dat, "r+b") as f:
+        f.seek(size - 30)
+        f.write(b"\xff" * 4)
+    v2 = Volume(str(tmp_path), "", 5, create=False)
+    assert v2.is_read_only()
+    assert os.path.getsize(dat) == size  # evidence intact
+    v2.close()
+
+
+# ------------------------------------------------------- cluster chaos --
+
+
+def test_cluster_chaos_read_workload(tmp_path):
+    """The every-PR chaos smoke: write 18 blobs into a 3-node cluster,
+    then read them all back (twice) under a seeded plan injecting EIO on
+    10% of disk reads, resets + latency on the client HTTP path and
+    latency on 10% of RPCs. Reads retry with backoff — degraded service
+    is allowed, wrong bytes or data loss are not."""
+    from test_cluster import Cluster, assign_retry
+
+    from seaweedfs_tpu.util.fasthttp import FastHTTPClient
+
+    async def body():
+        import aiohttp
+
+        cluster = Cluster(tmp_path)
+        await cluster.start()
+        client = FastHTTPClient()
+        try:
+            async with aiohttp.ClientSession() as session:
+                from seaweedfs_tpu.client.operation import upload_data
+
+                payloads = {}
+                for i in range(18):
+                    ar = await assign_retry(cluster.master.address)
+                    data = random.Random(i).randbytes(500 + 37 * i)
+                    await upload_data(
+                        session, ar.url, ar.fid, data, filename=f"c{i}.bin"
+                    )
+                    payloads[(ar.url, ar.fid)] = data
+
+            plan = FaultPlan(seed=0xC405, rules=[
+                FaultRule(op="read_at", target="*.dat",
+                          probability=0.10, fault="eio"),
+                FaultRule(op="http:GET", target="*",
+                          probability=0.10, fault="reset"),
+                FaultRule(op="http:GET", target="*", nth=3,
+                          fault="reset"),  # at least one guaranteed fault
+                FaultRule(op="http:GET", target="*",
+                          probability=0.10, fault="latency", delay=0.02),
+                FaultRule(op="rpc:*", target="*",
+                          probability=0.10, fault="latency", delay=0.02),
+            ])
+            faults.install_plan(plan)
+
+            async def read_with_retry(url, fid):
+                async def one():
+                    status, body = await client.request("GET", url, f"/{fid}")
+                    if status != 200:
+                        raise IOError(f"status {status}")
+                    return body
+
+                return await retry_async(
+                    one,
+                    policy=BackoffPolicy(base=0.01, cap=0.1, attempts=8),
+                    deadline=deadline_after(10.0),
+                    rng=random.Random(hash(fid) & 0xFFFF),
+                )
+
+            for _pass in range(2):
+                for (url, fid), data in payloads.items():
+                    got = await read_with_retry(url, fid)
+                    assert got == data, f"wrong bytes for {fid} under chaos"
+            assert plan.fired() > 0, "chaos plan never fired"
+            faults.clear_plan()
+        finally:
+            faults.clear_plan()
+            await client.close()
+            await cluster.stop()
+
+    asyncio.run(body())
