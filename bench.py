@@ -33,6 +33,7 @@ import os
 import sys
 import threading
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -284,6 +285,9 @@ def measure_multi_device(
         "wide_gbps": round(wide_gbps, 3),
         "per_volume_dispatch_gbps": round(seq_gbps, 3),
         "batch_speedup": round(wide_gbps / max(seq_gbps, 1e-9), 2),
+        # stand-in runs self-invalidate (VERDICT §4): GB/s measured on a
+        # CPU stand-in says nothing about the device batch dimension
+        "valid": jax.devices()[0].platform == "tpu",
     }
 
 
@@ -406,7 +410,10 @@ def measure_lookup(
     return tpu_qps, cpu_qps
 
 
-def measure_lookup_gate_decomposition(n_entries: int = 1_000_000) -> dict:
+def measure_lookup_gate_decomposition(
+    n_entries: int = 1_000_000,
+    batch_sizes: tuple = (64, 1024, 65536),
+) -> dict:
     """Separate per-dispatch RTT from on-device kernel time for the
     serving lookup gate (VERDICT r4 item 6).
 
@@ -440,7 +447,7 @@ def measure_lookup_gate_decomposition(n_entries: int = 1_000_000) -> dict:
     digest = jax.jit(lambda o, s, f: o.sum(dtype=jnp.uint32))
 
     batches: dict = {}
-    sizes_b = (64, 1024, 65536)
+    sizes_b = tuple(batch_sizes)
     for B in sizes_b:
         probes = keys[rng.integers(0, n_entries, size=B)]
         snap.lookup(probes)  # compile + warm this padded shape
@@ -502,23 +509,33 @@ def measure_lookup_gate_decomposition(n_entries: int = 1_000_000) -> dict:
     local_dispatch_s = 100e-6
     local_bw = 8e9
     proj = {}
-    for B in (1024, 65536):
+    for B in sizes_b[1:]:
         t_local = (
             local_dispatch_s
             + batches[B]["t_kernel_ms"] / 1e3
             + B * 28 / local_bw
         )
         proj[str(B)] = round(B / t_local)
+    valid = jax.devices()[0].platform == "tpu"
     return {
         "n_entries": n_entries,
         "batches": batches,
         "device_rtt_ms": round(rtt_s * 1e3, 2),
         "device_kernel_us_per_1k": round(kern_per_probe * 1e6 * 1000, 2),
         "projected_local_qps": proj,
-        "note": "projected_local_qps is a PROJECTION for a locally-"
-        "attached chip (100us dispatch, 8 GB/s link assumed), from "
-        "measured on-device kernel time; t_e2e is measured through the "
-        "tunnel",
+        # stand-in runs self-invalidate (VERDICT §4): a projection built
+        # from CPU stand-in kernel time is not a device projection
+        "valid": valid,
+        "note": (
+            "projected_local_qps is a PROJECTION for a locally-"
+            "attached chip (100us dispatch, 8 GB/s link assumed), from "
+            "measured on-device kernel time; t_e2e is measured through "
+            "the tunnel"
+            if valid
+            else "INVALID AS A DEVICE NUMBER: projection from CPU "
+            "stand-in kernel time (no TPU answered this run); the "
+            "numbers characterize the stand-in host, not the chip"
+        ),
     }
 
 
@@ -550,17 +567,20 @@ def measure_ping_ceiling(concurrency: int = 16, n: int = 20000) -> dict:
 
         esrv = await asyncio.start_server(handle, "127.0.0.1", 0)
         eport = esrv.sockets[0].getsockname()[1]
-        q: asyncio.Queue = asyncio.Queue()
-        for i in range(n):
-            q.put_nowait(i)
+        # plain deque work queues (not asyncio.Queue), matching the
+        # serving benchmark client: the floor must pay the same per-op
+        # client machinery the real legs pay, no more
+        from collections import deque
+
+        q = deque(range(n))
 
         async def echo_client():
             r, w = await asyncio.open_connection("127.0.0.1", eport)
             msg = b"x" * 200
             while True:
                 try:
-                    q.get_nowait()
-                except asyncio.QueueEmpty:
+                    q.popleft()
+                except IndexError:
                     break
                 w.write(msg)
                 await r.readexactly(len(msg))
@@ -584,14 +604,13 @@ def measure_ping_ceiling(concurrency: int = 16, n: int = 20000) -> dict:
         port = srv._server.sockets[0].getsockname()[1]
         http = FastHTTPClient(pool_per_host=concurrency + 4)
         try:
-            for i in range(n):
-                q.put_nowait(i)
+            q.extend(range(n))
 
             async def ping_client():
                 while True:
                     try:
-                        q.get_nowait()
-                    except asyncio.QueueEmpty:
+                        q.popleft()
+                    except IndexError:
                         break
                     st, _ = await http.request(
                         "GET", f"127.0.0.1:{port}", "/ping"
@@ -618,18 +637,95 @@ def measure_ping_ceiling(concurrency: int = 16, n: int = 20000) -> dict:
     return out
 
 
-def measure_write_budget() -> dict:
-    """Per-request microsecond budget of one serving POST's components
-    (VERDICT r4 item 2's 'publish the budget'): each leg timed standalone,
-    best-of-3 over thousands of reps. The gap between the component sum
-    and the measured end-to-end p50 is event-loop + socket machinery —
-    the remainder the fast tier pays per hop on this 1-core host."""
+def _measure_group_commit_wait(n: int = 600, conc: int = 16) -> dict:
+    """Flush-wait of the fsync group-commit tier: c concurrent writers
+    through a GroupCommitWorker on tmpfs, measuring enqueue->fsync'd wall
+    per request plus the worker's adaptive batch stats."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    from seaweedfs_tpu.storage.group_commit import GroupCommitWorker
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    d = tempfile.mkdtemp(
+        prefix="bench_gc_",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None,
+    )
+    out: dict = {"concurrency": conc, "writes": n}
+    try:
+        v = Volume(d, "", 11, create=True)
+        try:
+
+            async def run() -> None:
+                gc = GroupCommitWorker(v)
+                gc.start()
+                seq = [0]
+                waits: list[float] = []
+                data = b"x" * 1024
+
+                async def writer() -> None:
+                    while seq[0] < n:
+                        seq[0] += 1
+                        nd = Needle(cookie=1, id=seq[0], data=data)
+                        t0 = time.perf_counter()
+                        await gc.write(nd)
+                        waits.append(time.perf_counter() - t0)
+
+                await asyncio.gather(*(writer() for _ in range(conc)))
+                await gc.stop()
+                waits.sort()
+                out["flush_wait_p50_us"] = round(
+                    waits[len(waits) // 2] * 1e6, 1
+                )
+                out["flush_wait_avg_us"] = round(
+                    sum(waits) / len(waits) * 1e6, 1
+                )
+                out["batches"] = gc.stats["batches"]
+                out["avg_batch"] = round(
+                    gc.stats["requests"] / max(gc.stats["batches"], 1), 1
+                )
+                out["largest_batch"] = gc.stats["largest_batch"]
+
+            asyncio.run(run())
+        finally:
+            v.close()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
+def measure_write_budget(
+    serving: Optional[dict] = None, ping: Optional[dict] = None
+) -> dict:
+    """Itemized microsecond budget of the serving write path (ISSUE 2
+    tentpole; extends VERDICT r4 item 2's 'publish the budget').
+
+    Two layers:
+    - unit_costs_us: each handler component timed standalone, best-of-3
+      over thousands of reps — the per-request serialized CPU each write
+      spends in that code.
+    - attribution vs the LIVE p50 (when `serving` — a measure_serving_qps
+      result dict — is given): the benchmark client partitions every
+      write's wall time into assign-RPC / client-build / upload-RPC legs,
+      so leg averages sum to the average write latency BY CONSTRUCTION
+      and coverage_of_p50 states how much of the measured p50 the
+      itemization explains. On this 1-core host the closed loop satisfies
+      p50 ~= c x (serialized work per request), so each leg's wall is
+      ~c x its unit cost plus socket/event-loop machinery (the ping floor
+      measures that machinery per hop).
+    """
     import tempfile
 
     from seaweedfs_tpu.storage.needle import Needle
     from seaweedfs_tpu.storage.volume import Volume
     from seaweedfs_tpu.types import VERSION3
-    from seaweedfs_tpu.util.fasthttp import build_multipart, parse_multipart
+    from seaweedfs_tpu.util.fasthttp import (
+        build_multipart,
+        parse_multipart,
+        render_response,
+    )
 
     def best_us(fn, n=5000) -> float:
         for _ in range(200):
@@ -642,10 +738,10 @@ def measure_write_budget() -> dict:
             best = min(best, time.perf_counter() - t0)
         return best / n * 1e6
 
-    out: dict = {}
+    unit: dict = {}
     data = b"x" * 1024
     n_obj = Needle(cookie=0x1234, id=42, data=data)
-    out["needle_to_bytes_us"] = round(best_us(
+    unit["needle_to_bytes_us"] = round(best_us(
         lambda: n_obj.to_bytes(VERSION3)), 2)
 
     import shutil
@@ -663,7 +759,7 @@ def measure_write_budget() -> dict:
                 seq[0] += 1
                 v.write_needle(Needle(cookie=1, id=seq[0], data=data))
 
-            out["volume_write_needle_us"] = round(best_us(wr), 2)
+            unit["volume_write_needle_us"] = round(best_us(wr), 2)
         finally:
             v.close()
     finally:
@@ -671,8 +767,20 @@ def measure_write_budget() -> dict:
 
     body, ctype = build_multipart("file", data)
     ctype_b = ctype.encode()
-    out["parse_multipart_us"] = round(best_us(
+    unit["parse_multipart_us"] = round(best_us(
         lambda: parse_multipart(body, ctype_b)), 2)
+
+    # client-side request build: payload synthesis + multipart framing
+    # (the bench writer's work between assign and send)
+    from seaweedfs_tpu.command.benchmark import fake_payload
+
+    unit["client_build_us"] = round(best_us(
+        lambda: build_multipart("file", fake_payload(7, 1024))), 2)
+    # response assembly on the server side (201 + JSON body)
+    unit["response_render_us"] = round(best_us(
+        lambda: render_response(
+            201, b'{"name": "", "size": 1024, "eTag": "deadbeef"}'
+        )), 2)
 
     from seaweedfs_tpu.util.fasthttp import FastHTTPProtocol, FastHTTPServer
 
@@ -698,13 +806,100 @@ def measure_write_budget() -> dict:
         proto.buf += raw
         proto._try_parse()
 
-    out["http_parse_us"] = round(best_us(parse), 2)
-    out["component_sum_us"] = round(sum(
-        v for k, v in out.items() if k.endswith("_us")), 1)
-    out["note"] = (
-        "assign RPC + 2x(socket send/recv + event-loop wakeups) + client "
-        "side are the remainder of the measured write p50"
-    )
+    unit["http_parse_us"] = round(best_us(parse), 2)
+
+    out: dict = {"unit_costs_us": unit}
+    out["unit_sum_us"] = round(sum(unit.values()), 1)
+    try:
+        out["group_commit"] = _measure_group_commit_wait()
+    except Exception as e:
+        out["group_commit"] = {"error": str(e)[:120]}
+
+    legs = (serving or {}).get("write_legs")
+    lat = (serving or {}).get("write_latency") or {}
+    if legs and lat.get("p50_ms"):
+        p50_us = lat["p50_ms"] * 1000.0
+        # the p50-coverage components use each leg's own p50 where the
+        # 0.1ms latency buckets can resolve it (the upload leg, which
+        # dominates) and the leg average below that resolution (assign/
+        # build, tens of µs): summing averages against the p50 would let
+        # a heavy tail inflate coverage past what the median's mass
+        # actually explains
+        comp = {
+            "assign_rpc_us": (
+                legs["assign_p50_us"] or legs["assign_avg_us"]
+            ),
+            "client_build_us": (
+                legs["build_p50_us"] or legs["build_avg_us"]
+            ),
+            "upload_rpc_us": (
+                legs["upload_p50_us"] or legs["upload_avg_us"]
+            ),
+        }
+        out["components_us"] = comp
+        out["component_sum_us"] = round(sum(comp.values()), 1)
+        # avg-based sum alongside: legs partition each request, so this
+        # reconciles with write_avg_us by construction (a self-check that
+        # the instrumentation lost nothing)
+        out["component_sum_avg_us"] = round(
+            legs["assign_avg_us"]
+            + legs["build_avg_us"]
+            + legs["upload_avg_us"],
+            1,
+        )
+        out["write_p50_us"] = round(p50_us, 1)
+        out["write_avg_us"] = round(lat.get("avg_ms", 0) * 1000.0, 1)
+        out["coverage_of_p50"] = round(
+            out["component_sum_us"] / max(p50_us, 1e-9), 3
+        )
+        out["assign_amortization"] = {
+            "assign_rpcs": legs["assign_rpcs"],
+            "assign_batch": legs["assign_batch"],
+        }
+        if ping and ping.get("ping_us_per_req"):
+            # the measured-floor argument, every component named: a write
+            # is (1 + 1/batch) ping-equivalent HTTP hops plus the itemized
+            # handler CPU; on this 1-core closed loop QPS ~= 1e6 / that
+            p_us = ping["ping_us_per_req"]
+            batch = max(legs["assign_batch"], 1)
+            hops = 1.0 + 1.0 / batch
+            floor_us = p_us * hops + out["unit_sum_us"]
+            out["measured_floor"] = {
+                "ping_us_per_req": p_us,
+                "ping_equivalent_hops": round(hops, 3),
+                "hop_components_us": round(p_us * hops, 1),
+                "handler_unit_sum_us": out["unit_sum_us"],
+                "floor_us_per_write": round(floor_us, 1),
+                "floor_write_qps": round(1e6 / floor_us),
+                "model": "write = 1 upload hop + 1/assign_batch assign "
+                "hop (each = serving_ping_ceiling's us/req: socket + "
+                "event loop + HTTP machinery) + handler unit CPU "
+                "(unit_costs_us: http parse, multipart parse, needle "
+                "serialize, volume append, response render, client "
+                "build); remaining gap to the measured QPS is benchmark-"
+                "client response handling + scheduler queueing",
+            }
+        out["note"] = (
+            "components are the benchmark client's own partition of every "
+            "write's wall time (assign RPC | request build | upload RPC), "
+            "measured in the same c=16 run as the p50: per-leg p50 where "
+            "the 0.1ms buckets resolve it, leg average below that. "
+            "component_sum_avg_us reconciles with write_avg_us by "
+            "construction (the legs partition each request); "
+            "coverage_of_p50 states the itemized share of the p50. "
+            "unit_costs_us are the standalone per-request CPU costs of "
+            "the upload leg's handler components; upload_rpc ~= c x "
+            "(unit costs + socket/event-loop machinery per hop, see "
+            "serving_ping_ceiling). group_commit reports the fsync "
+            "tier's flush wait separately."
+        )
+    else:
+        out["component_sum_us"] = out["unit_sum_us"]
+        out["note"] = (
+            "no live serving sample available this run: unit costs only "
+            "(assign RPC + 2x(socket send/recv + event-loop wakeups) + "
+            "client side are the remainder of a measured write p50)"
+        )
     return out
 
 
@@ -900,6 +1095,9 @@ def measure_encode_e2e(size_bytes: int = 4 << 30, emit=None):
 
             write_ec_files(base, codec=best)
             result["best_route"] = dict(_enc.LAST_ROUTE)
+            result["best_stages"] = {
+                k: round(v, 3) for k, v in _enc.LAST_STAGES.items()
+            }
 
         golden = None
         best_samples = None
@@ -1073,6 +1271,32 @@ def measure_multi_encode(
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _write_legs_us(stats_out: dict) -> Optional[dict]:
+    """run_benchmark's write-leg Stats -> flat microsecond dict (avg
+    carries the sub-0.1ms resolution the 0.1ms-bucket p50 can't)."""
+    wlegs = stats_out.get("write_legs")
+    if not wlegs:
+        return None
+
+    def leg(stats) -> tuple[float, float]:
+        avg = stats._sum_ms / max(stats.completed, 1) * 1000.0
+        return round(avg, 1), round(stats.percentile(50) * 1000, 1)
+
+    a_avg, a_p50 = leg(wlegs["assign_stats"])
+    b_avg, b_p50 = leg(wlegs["build_stats"])
+    u_avg, u_p50 = leg(wlegs["upload_stats"])
+    return {
+        "assign_avg_us": a_avg,
+        "assign_p50_us": a_p50,
+        "build_avg_us": b_avg,
+        "build_p50_us": b_p50,
+        "upload_avg_us": u_avg,
+        "upload_p50_us": u_p50,
+        "assign_rpcs": wlegs["assign_rpcs"],
+        "assign_batch": wlegs["assign_batch"],
+    }
+
+
 def measure_serving_qps(
     num_files: int = 3000, concurrency: int = 16
 ) -> dict:
@@ -1148,17 +1372,26 @@ def measure_serving_qps(
                     "p99_ms": stats.percentile(99),
                 }
 
-            # write once + plain read at c=16 (reference benchmark shape)
+            # write once + plain read at c=16 (reference benchmark shape);
+            # assigns ride a count=128 lease (the reference benchmark's
+            # fid-reuse trick) so the master round-trip is amortized to
+            # 1/128 of a write
             s1: dict = {}
             await run_benchmark(
                 ms.address, num_files=num_files, file_size=1024,
-                concurrency=concurrency, stats_out=s1,
+                concurrency=concurrency, stats_out=s1, assign_batch=128,
             )
             out["write_qps"] = round(s1.get("write_qps", 0))
             out["read_qps"] = round(s1.get("read_qps", 0))
             out["failed"] = s1.get("write_failed", 0) + s1.get("read_failed", 0)
             out["write_latency"] = pcts(s1.get("write_stats"))
             out["read_latency"] = pcts(s1.get("read_stats"))
+            # early + final write sub-samples (VERDICT §7: the host's
+            # ~30% swing must be disclosed next to the official number)
+            out["write_samples"] = s1.get("write_samples")
+            wl = _write_legs_us(s1)
+            if wl:
+                out["write_legs"] = wl
             fids = s1.get("fids") or []
 
             async def read_leg(conc: int, gate, nf: int = 0) -> dict:
@@ -1186,6 +1419,7 @@ def measure_serving_qps(
             # seed every leg so an all-failures run records zeros instead
             # of KeyError-ing away the whole serving entry
             best: dict = {name: (-1, {}) for name in legs}
+            samples: dict = {name: [] for name in legs}
             names = list(legs)
             for rnd in range(3):
                 order = names if rnd % 2 == 0 else names[::-1]
@@ -1197,6 +1431,7 @@ def measure_serving_qps(
                         else None
                     )
                     s = await read_leg(conc, gate)
+                    samples[name].append(round(s.get("read_qps", 0)))
                     if s.get("read_qps", 0) > best[name][0]:
                         best[name] = (s.get("read_qps", 0), s)
                     if gated:
@@ -1207,6 +1442,16 @@ def measure_serving_qps(
                         ] = vs.lookup_gate.stats["largest_batch"]
             for name, (qps, s) in best.items():
                 out[name] = round(max(qps, 0))
+            # per-round samples with min/max disclosed: the official
+            # number is the best round, and these show the swing it rode
+            out["read_samples"] = {
+                name: {
+                    "rounds": vals,
+                    "min": min(vals) if vals else 0,
+                    "max": max(vals) if vals else 0,
+                }
+                for name, vals in samples.items()
+            }
             out["read_qps"] = round(
                 max(best["read_qps"][0], s1.get("read_qps", 0))
             )
@@ -1219,7 +1464,8 @@ def measure_serving_qps(
 
             # device-gate leg (VERDICT r3 #3 asked for it in the artifact;
             # on the tunneled bench backend per-batch RTT dominates, which
-            # the number honestly records)
+            # the number honestly records). Self-invalidating: the leg
+            # carries valid=False whenever the device is a CPU stand-in.
             if os.environ.get("BENCH_QPS_DEVICE", "1") != "0":
                 try:
                     s3 = await asyncio.wait_for(
@@ -1235,12 +1481,31 @@ def measure_serving_qps(
                     out["read_qps_batched_device"] = round(
                         s3.get("read_qps", 0)
                     )
+                    out["read_qps_batched_device_valid"] = (
+                        _device_status() == "tpu"
+                    )
                 except asyncio.TimeoutError:
                     out["read_qps_batched_device_error"] = (
                         "timeboxed out (device RTT-bound)"
                     )
                 except Exception as e:
                     out["read_qps_batched_device_error"] = str(e)[:120]
+            # the adaptive gate's own host-vs-device routing decision for
+            # this environment (Volume.bulk_lookup's auto policy), stated
+            # in the artifact so a stand-in run can't masquerade as a
+            # device-served one (VERDICT §4)
+            try:
+                from seaweedfs_tpu.storage.volume import _device_available
+                from seaweedfs_tpu.types import OFFSET_SIZE
+
+                dev_ok = bool(_device_available()) and OFFSET_SIZE == 4
+                out["lookup_gate_decision"] = {
+                    "auto_routes_to": "device" if dev_ok else "host",
+                    "device_status": _device_status(),
+                    "valid_as_device_number": _device_status() == "tpu",
+                }
+            except Exception as e:
+                out["lookup_gate_decision"] = {"error": str(e)[:120]}
             vs.lookup_gate = None
         finally:
             await vs.stop()
@@ -1338,6 +1603,29 @@ def _e2e_results(r: dict) -> list:
             entry["memcpy_equiv_per_byte"] = round(
                 mem / max(r["best_gbps"], 1e-9), 2
             )
+        stages = r.get("best_stages")
+        if stages:
+            # stage breakdown of the winning run (VERDICT §5): does the
+            # GF kernel bound the shipped e2e number, or the file legs?
+            total = stages.get("total_s") or sum(
+                v for k, v in stages.items() if k.endswith("_s")
+            )
+            kern = stages.get("kernel_s", stages.get("fused_s", 0.0))
+            entry["stage_breakdown"] = {
+                **stages,
+                "kernel_share": round(kern / max(total, 1e-9), 3),
+                "note": (
+                    "fused_s = single-sweep native route (read/encode/"
+                    "write interleaved, not separable); on the mmap route "
+                    ".dat page-fault reads land inside kernel_s/"
+                    "shard_write_s, so kernel_share is an UPPER bound on "
+                    "the kernel's true share; ecx_s=0 because "
+                    "write_ec_files never writes .ecx (that belongs to "
+                    "volume->EC conversion). kernel_share < ~0.5 means "
+                    "further host-kernel work cannot move this number "
+                    "much — the file legs bound it"
+                ),
+            }
         legs = r.get("io_legs")
         if legs:
             # the e2e roofline (VERDICT r4 item 8): ceilings built from
@@ -1662,6 +1950,8 @@ def main() -> None:
     except Exception as e:
         extra.append({"metric": "ec.rebuild_throughput", "error": str(e)[:200]})
 
+    serving_qps: Optional[dict] = None
+    ping_detail: Optional[dict] = None
     try:
         if not budgeted("serving_read_qps", 60):
             raise _Skip()
@@ -1677,6 +1967,7 @@ def main() -> None:
         else:
             nf = 3_000
         qps = measure_serving_qps(num_files=nf)
+        serving_qps = qps
         best_read = max(qps.get("read_qps", 0), qps.get("read_qps_batched", 0))
         extra.append(
             {
@@ -1696,11 +1987,14 @@ def main() -> None:
                 f"c={qps.get('concurrency')}, host_cpus="
                 f"{available_cpus()} "
                 "(reference numbers are from a multicore MacBook); "
-                "read_qps_batched = "
+                "writes lease fids in count=128 assign batches (the "
+                "reference benchmark's fid reuse; write_legs itemizes "
+                "the p50); read_qps_batched = "
                 "BatchLookupGate micro-batched probes; latency blocks "
                 "comparable row-for-row with BASELINE.md. At fixed "
                 "concurrency p50 ~= c/QPS (closed loop), so a p50 bar "
-                "is a QPS bar: 1.5 ms at c=16 means ~10.7k write QPS",
+                "is a QPS bar: 1.5 ms at c=16 means ~10.7k write QPS. "
+                "write_samples/read_samples disclose the per-run swing",
             }
         )
     except _Skip:
@@ -1712,11 +2006,22 @@ def main() -> None:
         if not budgeted("serving_ping_ceiling", 30):
             raise _Skip()
         pc = measure_ping_ceiling()
+        ping_detail = pc
+        if serving_qps is not None and pc.get("ping_qps"):
+            # the acceptance-visible ratio: how close the read data plane
+            # runs to the stack's own trivial-200 floor, same c=16 on both
+            # sides
+            br = max(
+                serving_qps.get("read_qps", 0),
+                serving_qps.get("read_qps_batched", 0),
+            )
+            pc["read_over_ping"] = round(br / pc["ping_qps"], 3)
         extra.append(
             {
                 "metric": "serving_ping_ceiling",
                 "value": pc["ping_qps"],
                 "unit": "#/sec",
+                "vs_baseline": pc.get("read_over_ping"),
                 "detail": pc,
                 "note": "the stack's own floor: trivial-200 QPS at c=16 "
                 "through the fast tier + pooled protocol client, with a "
@@ -1734,16 +2039,21 @@ def main() -> None:
     try:
         if not budgeted("serving_write_budget", 25):
             raise _Skip()
-        wb = measure_write_budget()
+        wb = measure_write_budget(serving=serving_qps, ping=ping_detail)
         extra.append(
             {
                 "metric": "serving_write_budget",
                 "value": wb["component_sum_us"],
                 "unit": "us (component sum)",
+                "vs_baseline": wb.get("coverage_of_p50"),
                 "detail": wb,
-                "note": "per-request budget of one POST's handler "
-                "components (VERDICT r4 item 2); the measured write p50 "
-                "minus this sum is event-loop + socket machinery",
+                "note": "itemized write-path budget (ISSUE 2 tentpole): "
+                "value = the client-partitioned leg sum measured in the "
+                "same c=16 run as the serving p50; vs_baseline = share "
+                "of the measured write p50 those components explain "
+                "(acceptance: >= 0.8). detail carries unit CPU costs "
+                "per handler component and the fsync tier's group-commit "
+                "flush wait",
             }
         )
     except _Skip:

@@ -53,6 +53,80 @@ async def assign(
     )
 
 
+class AssignLease:
+    """Amortizes the per-write assign round-trip: one ``count=N`` assign
+    leases N consecutive file ids, and ``take()`` hands them out as the
+    ``fid``, ``fid_1`` ... ``fid_{N-1}`` derived forms every server-side
+    parser already accepts (FileId.parse's ``_delta`` convention — the
+    reference benchmark reuses count-assigned fids the same way,
+    ref: weed/command/benchmark.go writeFiles).
+
+    ``fetch`` is any ``async (count) -> AssignResult`` — the gRPC
+    :func:`assign` by default, or an HTTP fetcher (the bench client passes
+    one riding its keep-alive pool). Refills are single-flight: concurrent
+    takers drained the lease await the same in-flight assign instead of
+    stampeding the master. When the master signs upload JWTs the token
+    covers the base fid only, so the lease detects ``auth`` in the first
+    response and clamps itself to width 1 (one signed assign per write)
+    instead of handing out unauthenticated derived fids.
+    """
+
+    def __init__(self, master: str = "", batch: int = 128, fetch=None, **kw):
+        if fetch is None:
+            if not master:
+                raise ValueError("AssignLease needs a master or a fetch fn")
+
+            async def fetch(count: int) -> AssignResult:
+                return await assign(master, count=count, **kw)
+
+        self._fetch = fetch
+        self._batch = max(1, batch)
+        self._cur: Optional[AssignResult] = None
+        self._next_delta = 0
+        self._refill: Optional[asyncio.Task] = None
+        self._signed = False  # master signs uploads: lease width is 1
+        self.assign_rpcs = 0  # refills performed (amortization visibility)
+
+    async def take(self) -> AssignResult:
+        while True:
+            cur = self._cur
+            if cur is not None and self._next_delta < cur.count:
+                delta = self._next_delta
+                self._next_delta += 1
+                return AssignResult(
+                    fid=cur.fid if delta == 0 else f"{cur.fid}_{delta}",
+                    url=cur.url,
+                    public_url=cur.public_url,
+                    count=1,
+                    auth=cur.auth if delta == 0 else "",
+                )
+            if self._refill is None:
+                self._refill = asyncio.ensure_future(self._do_refill())
+            refill = self._refill
+            try:
+                await refill
+            finally:
+                if self._refill is refill:
+                    self._refill = None
+
+    async def _do_refill(self) -> None:
+        res = await self._fetch(1 if self._signed else self._batch)
+        self.assign_rpcs += 1
+        # a master that honors fewer ids than asked (or a batch=1 lease)
+        # still works: count bounds the deltas handed out. A master that
+        # SIGNS uploads clamps the lease to its base fid — derived fids
+        # would carry no token and fail auth, so each take refills with
+        # its own signed assign instead of failing 127 of 128 writes
+        if res.auth:
+            self._signed = True
+            res = AssignResult(
+                fid=res.fid, url=res.url, public_url=res.public_url,
+                count=1, auth=res.auth,
+            )
+        self._cur = res
+        self._next_delta = 0
+
+
 async def upload_data(
     session: aiohttp.ClientSession,
     url: str,

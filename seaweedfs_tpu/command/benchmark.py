@@ -15,10 +15,12 @@ import asyncio
 import json
 import random
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
 from ..client import MasterClient
+from ..client.operation import AssignLease, AssignResult
 from ..util.fasthttp import FastHTTPClient, build_multipart
 
 
@@ -103,12 +105,22 @@ async def run_benchmark(
     do_read: bool = True,
     stats_out: Optional[dict] = None,
     fids_in: Optional[list] = None,
+    assign_batch: int = 1,
 ) -> str:
     """Returns the human report; when `stats_out` is given it also receives
     {write_qps, write_failed, read_qps, read_failed, write_stats,
     read_stats, fids} for machine use (bench.py's serving-QPS north-star
-    entry). `fids_in` seeds the read phase so read-only passes
-    (do_write=False) can re-read a previously written set."""
+    entry), plus the write-path attribution legs {assign_stats, build_stats,
+    upload_stats} (per-request wall time of the assign RPC, client-side
+    request build, and upload RPC — they partition each recorded write
+    latency) and write_samples (early/final QPS sub-samples). `fids_in`
+    seeds the read phase so read-only passes (do_write=False) can re-read a
+    previously written set.
+
+    assign_batch > 1 leases file ids in count=N batches through an
+    AssignLease (the reference benchmark's fid-reuse trick,
+    ref: weed/command/benchmark.go), amortizing the per-write master
+    round-trip to 1/N of a request."""
     out = []
     mc = MasterClient("benchmark", [master])
     await mc.start()
@@ -116,42 +128,71 @@ async def run_benchmark(
         await mc.wait_connected()
         fids: list[str] = list(fids_in) if fids_in else []
         http = FastHTTPClient(pool_per_host=concurrency + 4)
-        assign_target = (
+        assign_base = (
             "/dir/assign?collection=" + collection if collection
             else "/dir/assign"
         )
         if do_write:
             stats = Stats("Writing Benchmark")
-            queue: asyncio.Queue = asyncio.Queue()
-            for i in range(num_files):
-                queue.put_nowait(i)
+            # write-path attribution: each write's latency is partitioned
+            # into assign / client-build / upload legs so the serving bench
+            # can publish an itemized p50 budget (ISSUE 2 tentpole)
+            leg_assign = Stats("assign leg")
+            leg_build = Stats("build leg")
+            leg_upload = Stats("upload leg")
+            # plain deque, not asyncio.Queue: workers only ever pop
+            # synchronously, and Queue's loop bookkeeping per get/put was
+            # visible in the closed-loop profile
+            queue: deque = deque()
+
+            async def fetch_lease(count: int) -> AssignResult:
+                sep = "&" if "?" in assign_base else "?"
+                st, body = await http.request(
+                    "GET", master, f"{assign_base}{sep}count={count}"
+                )
+                ar = json.loads(body)
+                if st != 200 or ar.get("error"):
+                    raise RuntimeError(f"assign: {st} {ar}")
+                return AssignResult(
+                    fid=ar["fid"],
+                    url=ar["url"],
+                    public_url=ar.get("publicUrl", ar["url"]),
+                    count=int(ar.get("count", count)),
+                    auth=ar.get("auth", ""),
+                )
+
+            lease = (
+                AssignLease(fetch=fetch_lease, batch=assign_batch)
+                if assign_batch > 1
+                else None
+            )
 
             async def writer() -> None:
                 while True:
                     try:
-                        i = queue.get_nowait()
-                    except asyncio.QueueEmpty:
+                        i = queue.popleft()
+                    except IndexError:
                         return
                     t0 = time.perf_counter()
                     try:
-                        st, body = await http.request(
-                            "GET", master, assign_target
-                        )
-                        ar = json.loads(body)
-                        if st != 200 or ar.get("error"):
-                            raise RuntimeError(f"assign: {st} {ar}")
+                        if lease is not None:
+                            ar = await lease.take()
+                        else:
+                            ar = await fetch_lease(1)
+                        t1 = time.perf_counter()
                         payload, ctype = build_multipart(
                             "file", fake_payload(i, file_size)
                         )
                         headers = (
-                            {"Authorization": "Bearer " + ar["auth"]}
-                            if ar.get("auth")
+                            {"Authorization": "Bearer " + ar.auth}
+                            if ar.auth
                             else None
                         )
+                        t2 = time.perf_counter()
                         st, rbody = await http.request(
                             "POST",
-                            ar["url"],
-                            "/" + ar["fid"],
+                            ar.url,
+                            "/" + ar.fid,
                             body=payload,
                             content_type=ctype,
                             headers=headers,
@@ -160,46 +201,85 @@ async def run_benchmark(
                             raise RuntimeError(
                                 f"upload: {st} {rbody[:120]!r}"
                             )
-                        stats.record(time.perf_counter() - t0, file_size)
-                        fids.append(ar["fid"])
+                        t3 = time.perf_counter()
+                        stats.record(t3 - t0, file_size)
+                        leg_assign.record(t1 - t0, 0)
+                        leg_build.record(t2 - t1, 0)
+                        leg_upload.record(t3 - t2, 0)
+                        fids.append(ar.fid)
                     except Exception:
                         stats.failed += 1
 
+            # two timed sub-phases (early + final sample): the host's
+            # burst-credit throttling swings serving QPS ~30% within a
+            # run, and a single aggregate hides which regime the official
+            # number was measured in
+            n_early = max(min(num_files // 5, 20_000), 1)
+            write_samples: list[dict] = []
             stats.start = time.perf_counter()
-            await asyncio.gather(*(writer() for _ in range(concurrency)))
+            done = 0
+            for phase_files in (n_early, num_files - n_early):
+                if phase_files <= 0:
+                    continue
+                base_completed = stats.completed
+                queue.extend(range(done, done + phase_files))
+                done += phase_files
+                p0 = time.perf_counter()
+                await asyncio.gather(*(writer() for _ in range(concurrency)))
+                dt = max(time.perf_counter() - p0, 1e-9)
+                write_samples.append(
+                    {
+                        "files": phase_files,
+                        "completed": stats.completed - base_completed,
+                        "qps": round((stats.completed - base_completed) / dt),
+                    }
+                )
             stats.end = time.perf_counter()
-            out.append(stats.report(concurrency))
             if stats_out is not None:
                 stats_out["write_qps"] = stats.completed / max(
                     stats.end - stats.start, 1e-9
                 )
                 stats_out["write_failed"] = stats.failed
                 stats_out["write_stats"] = stats
+                stats_out["write_legs"] = {
+                    "assign_stats": leg_assign,
+                    "build_stats": leg_build,
+                    "upload_stats": leg_upload,
+                    "assign_rpcs": (
+                        lease.assign_rpcs if lease is not None
+                        else leg_assign.completed
+                    ),
+                    "assign_batch": assign_batch,
+                }
+                stats_out["write_samples"] = write_samples
+            out.append(stats.report(concurrency))
 
         if do_read and fids:
             stats = Stats("Randomly Reading Benchmark")
-            reads = [random.choice(fids) for _ in range(num_files)]
-            queue = asyncio.Queue()
-            for fid in reads:
-                queue.put_nowait(fid)
+            reads = deque(random.choice(fids) for _ in range(num_files))
 
             async def reader() -> None:
                 while True:
                     try:
-                        fid = queue.get_nowait()
-                    except asyncio.QueueEmpty:
+                        fid = reads.popleft()
+                    except IndexError:
                         return
                     t0 = time.perf_counter()
                     try:
                         # cache hit normally; falls back to a master RPC
                         # when the vid cache hasn't learned a
-                        # freshly-grown volume yet
-                        url = await mc.lookup_file_id_async(fid)
-                        hostport, _, path = url.removeprefix(
-                            "http://"
-                        ).partition("/")
+                        # freshly-grown volume yet. The hit path picks the
+                        # hostport straight from the vid map — building and
+                        # re-splitting a full URL string per read was
+                        # measurable at serving QPS rates.
+                        hostport = mc.vid_map.pick(int(fid.split(",")[0]))
+                        if hostport is None:
+                            url = await mc.lookup_file_id_async(fid)
+                            hostport = url.removeprefix("http://").partition(
+                                "/"
+                            )[0]
                         st, data = await http.request(
-                            "GET", hostport, "/" + path
+                            "GET", hostport, "/" + fid
                         )
                         if st != 200:
                             raise RuntimeError(f"read {fid}: {st}")

@@ -637,6 +637,12 @@ def cmd_benchmark(argv: list[str]) -> int:
     p.add_argument("-write", action="store_true", default=True)
     p.add_argument("-skipRead", action="store_true")
     p.add_argument(
+        "-assignBatch", type=int, default=1,
+        help="lease file ids in count=N assign batches (amortizes the "
+        "per-write master round-trip; keep 1 against JWT-secured "
+        "clusters — upload tokens cover the base fid only)",
+    )
+    p.add_argument(
         "-cpuprofile", default="", help="cpu profile output file (pstats)"
     )
     p.add_argument("-memprofile", default="", help="memory profile output file")
@@ -653,6 +659,7 @@ def cmd_benchmark(argv: list[str]) -> int:
                 concurrency=args.c,
                 collection=args.collection,
                 do_read=not args.skipRead,
+                assign_batch=args.assignBatch,
             )
         )
     print(out)

@@ -341,7 +341,10 @@ class MasterServer:
         if proxied is not None:
             return proxied
         try:
-            count = int(params.get("count", 1) or 1)
+            # clamp the lease width: count=N reserves N sequential file
+            # ids, and an unbounded client value could burn the shared
+            # key space (or overflow derived-fid arithmetic) in one call
+            count = min(max(int(params.get("count", 1) or 1), 1), 100_000)
             option = self._parse_option(params)
             await self._ensure_writable(option)
             fid, cnt, locations = self.topo.pick_for_write(

@@ -44,6 +44,17 @@ DEFAULT_CHUNK = 4 * 1024 * 1024  # per-shard streaming chunk
 # benchmark/diagnostic introspection, not part of the encode contract
 LAST_ROUTE: dict = {}
 
+# per-stage wall seconds of the last write_ec_files run (read / kernel /
+# shard-write, or fused/splice where stages aren't separable). Diagnostic
+# only — filled by the NON-pipelined row encoders (the pipelined device
+# path overlaps stages, so per-stage walls would double-count there) and
+# not synchronized across concurrent write_ec_files_multi volumes.
+LAST_STAGES: dict = {}
+
+
+def _stage_add(key: str, dt: float) -> None:
+    LAST_STAGES[key] = LAST_STAGES.get(key, 0.0) + dt
+
 
 def _get_codec(codec):
     if codec is None:
@@ -87,6 +98,8 @@ def _encode_rows(
     rows: int,
     chunk: int,
 ) -> None:
+    import time as _time
+
     k = codec.data_shards
     data = np.empty((k, chunk), dtype=np.uint8)
     for row in range(rows):
@@ -95,9 +108,12 @@ def _encode_rows(
         while done < block_size:
             this = min(chunk, block_size - done)
             buf = data[:, :this] if this != chunk else data
+            t0 = _time.perf_counter()
             for i in range(k):
                 _read_into(dat_f, buf[i], row_start + i * block_size + done)
+            t1 = _time.perf_counter()
             parity = codec.encode(buf)
+            t2 = _time.perf_counter()
             # contiguous-row memoryviews: BufferedWriter copies synchronously,
             # so reusing `data` next iteration is safe and we skip a tobytes()
             # copy of every byte written
@@ -106,6 +122,10 @@ def _encode_rows(
                     outputs[i].write(buf[i].data)
             for p in range(codec.parity_shards):
                 outputs[k + p].write(np.ascontiguousarray(parity[p]).data)
+            t3 = _time.perf_counter()
+            _stage_add("read_s", t1 - t0)
+            _stage_add("kernel_s", t2 - t1)
+            _stage_add("shard_write_s", t3 - t2)
             done += this
 
 
@@ -124,6 +144,8 @@ def _encode_rows_mmap(
     stream straight from the map. Only EOF-straddling tails get copied into
     a scratch row. The single-core replacement for the reference's
     read-copy-everything loop (ref ec_encoder.go:120-136)."""
+    import time as _time
+
     k = codec.data_shards
     dat_size = arr.size
     scratch = np.empty((k, chunk), dtype=np.uint8)
@@ -133,6 +155,7 @@ def _encode_rows_mmap(
         done = 0
         while done < block_size:
             this = min(chunk, block_size - done)
+            t0 = _time.perf_counter()
             rows_v = []
             for i in range(k):
                 off = row_start + i * block_size + done
@@ -147,12 +170,22 @@ def _encode_rows_mmap(
                     s[:n] = arr[off:dat_size]
                     s[n:] = 0
                     rows_v.append(s)
+            t1 = _time.perf_counter()
             parity = np.ascontiguousarray(codec.encode_rows(rows_v))
+            t2 = _time.perf_counter()
             for i in range(k):
                 if outputs[i] is not None:
                     outputs[i].write(rows_v[i].data)
             for p in range(codec.parity_shards):
                 outputs[k + p].write(parity[p].data)
+            t3 = _time.perf_counter()
+            # on this mmapped route the .dat "read" is page faults taken
+            # INSIDE kernel_s (encode touches the map) and shard_write_s
+            # (data shards stream from the map); read_s only covers the
+            # view assembly + EOF-tail copies
+            _stage_add("read_s", t1 - t0)
+            _stage_add("kernel_s", t2 - t1)
+            _stage_add("shard_write_s", t3 - t2)
             done += this
 
 
@@ -633,6 +666,10 @@ def write_ec_files(
     hardware-dependent and point probes proved unreliable.
     """
     global LAST_ROUTE
+    LAST_STAGES.clear()
+    import time as _time
+
+    _t_enter = _time.perf_counter()
     codec = _get_codec(codec)
     # structure flags left None = "pick for me", resolved PER FLAG from
     # the calibrated route — an explicit pipeline=False or splice_data
@@ -646,7 +683,13 @@ def write_ec_files(
         and not pipeline
         and getattr(codec, "zero_copy_rows", False)
     ):
+        _t_cal = _time.perf_counter()
         route = _calibrate_host_route(codec)
+        cal = _time.perf_counter() - _t_cal
+        if cal > 1e-3:
+            # first call per codec runs a measured race; disclose it so
+            # the stage sums still reconcile with total_s
+            LAST_STAGES["calibrate_s"] = round(cal, 3)
     if onepass is None:
         onepass = route == "onepass"
     if mmap_input is None:
@@ -683,14 +726,24 @@ def write_ec_files(
             chunk=chunk,
         ):
             LAST_ROUTE = {"route": "onepass", "spliced": False}
+            # the fused native kernel interleaves read/encode/write in one
+            # sweep: stages aren't separable, disclose the fused total
+            LAST_STAGES["fused_s"] = _time.perf_counter() - _t_enter
+            LAST_STAGES["total_s"] = LAST_STAGES["fused_s"]
+            LAST_STAGES["ecx_s"] = 0.0
             return
 
     spliced = False
     if splice_data is None or splice_data:
+        _t_sp = _time.perf_counter()
         spliced = _splice_data_shards(
             dat_path, base_file_name, k,
             n_large, large_block_size, n_small, small_block_size,
         )
+        if spliced:
+            # data shards were carved kernel-side (copy_file_range/pwrite
+            # interleave): read+write of the data shards in one stage
+            LAST_STAGES["splice_s"] = _time.perf_counter() - _t_sp
     # introspection for benchmarks/diagnostics: which structure actually
     # ran (the roofline model differs when data shards were spliced)
     LAST_ROUTE = {
@@ -742,6 +795,12 @@ def write_ec_files(
         for f in outputs:
             if f is not None:
                 f.close()
+        LAST_STAGES["total_s"] = _time.perf_counter() - _t_enter
+        # .ecx is NOT written here: write_ec_files produces .ec00-.ec13
+        # only (the sorted .ecx index comes from write_sorted_file_from_idx
+        # during volume->EC conversion) — stated so the stage breakdown
+        # can't be misread as omitting it
+        LAST_STAGES.setdefault("ecx_s", 0.0)
 
 
 def _row_counts(

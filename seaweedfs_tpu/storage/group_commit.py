@@ -7,16 +7,30 @@ the .dat back and fails every request in the batch
 re-design: writers enqueue (needle, future); the worker appends the whole
 batch, fsyncs once, and resolves the futures — one disk flush amortized over
 many concurrent writers.
+
+Batch formation is ADAPTIVE, never timed: a batch is flushed the moment the
+queue drains, so a lone writer pays zero added latency. The only widening
+step is one event-loop yield before draining, taken only while the previous
+batch proved there are concurrent writers in flight — that single pass lets
+the wakeup's other writers enqueue, growing the batch without a fixed
+window (a timed hold was measured strictly worse for the lookup gate at
+every concurrency, and the same holds here).
 """
 
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass
 from typing import Optional
 
 from .needle import Needle
 from .volume import Volume
+from ..util.metrics import (
+    GROUP_COMMIT_BATCH_SIZE,
+    GROUP_COMMIT_FSYNCS,
+    WRITE_STAGE_SECONDS,
+)
 
 MAX_BATCH_BYTES = 4 * 1024 * 1024
 MAX_BATCH_REQUESTS = 128
@@ -27,6 +41,7 @@ class _Request:
     needle: Needle
     is_write: bool
     future: asyncio.Future
+    enqueued_at: float = 0.0
 
 
 class GroupCommitWorker:
@@ -34,6 +49,9 @@ class GroupCommitWorker:
         self.volume = volume
         self.queue: asyncio.Queue[_Request] = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
+        # adaptive coalescing state: did the LAST flush see concurrency?
+        self._concurrent = False
+        self.stats = {"batches": 0, "requests": 0, "largest_batch": 0}
 
     def start(self) -> None:
         if self._task is None:
@@ -50,17 +68,28 @@ class GroupCommitWorker:
 
     async def write(self, n: Needle) -> tuple[int, int, bool]:
         fut = asyncio.get_event_loop().create_future()
-        await self.queue.put(_Request(n, True, fut))
+        await self.queue.put(
+            _Request(n, True, fut, enqueued_at=time.perf_counter())
+        )
         return await fut
 
     async def delete(self, n: Needle) -> int:
         fut = asyncio.get_event_loop().create_future()
-        await self.queue.put(_Request(n, False, fut))
+        await self.queue.put(
+            _Request(n, False, fut, enqueued_at=time.perf_counter())
+        )
         return await fut
 
     async def _run(self) -> None:
         while True:
             batch = [await self.queue.get()]
+            if self.queue.empty() and self._concurrent:
+                # adaptive widening: the previous flush proved writers are
+                # arriving concurrently, so yield ONE loop pass to let this
+                # wakeup's other writers enqueue before draining. When the
+                # queue has already drained to a lone writer the yield is
+                # skipped and the flush is immediate — no fixed window.
+                await asyncio.sleep(0)
             bytes_queued = len(batch[0].needle.data)
             # drain whatever is immediately available, bounded like the
             # reference's 4MB/128 limits
@@ -72,9 +101,22 @@ class GroupCommitWorker:
                 req = self.queue.get_nowait()
                 batch.append(req)
                 bytes_queued += len(req.needle.data)
+            self._concurrent = len(batch) > 1 or not self.queue.empty()
+            self.stats["batches"] += 1
+            self.stats["requests"] += len(batch)
+            if len(batch) > self.stats["largest_batch"]:
+                self.stats["largest_batch"] = len(batch)
+            GROUP_COMMIT_BATCH_SIZE.observe(len(batch))
+            GROUP_COMMIT_FSYNCS.inc()
             await asyncio.get_event_loop().run_in_executor(
                 None, self._commit_batch, batch
             )
+            done = time.perf_counter()
+            for req in batch:
+                if req.enqueued_at:
+                    WRITE_STAGE_SECONDS.observe(
+                        done - req.enqueued_at, stage="group_commit_wait"
+                    )
 
     def _commit_batch(self, batch: list[_Request]) -> None:
         v = self.volume

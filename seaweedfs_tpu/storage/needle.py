@@ -269,8 +269,14 @@ class Needle:
         self.id = bytes_to_u64(b[4:12])
         self.size = bytes_to_u32(b[12:16])
 
-    def _read_data_v2(self, b: bytes) -> None:
-        """Ref needle_read_write.go:212-271."""
+    def _read_data_v2(self, b) -> None:
+        """Ref needle_read_write.go:212-271.
+
+        `b` may be a memoryview over the pread blob: `data` is kept as a
+        zero-copy slice of it (serving renders straight from the buffer —
+        copying every body was measurable at read-QPS rates), while the
+        small optional fields are materialized as bytes so downstream
+        `.decode()`-style consumers keep working."""
         index, n = 0, len(b)
         if index < n:
             data_size = bytes_to_u32(b[index : index + 4])
@@ -286,14 +292,14 @@ class Needle:
             index += 1
             if name_size + index > n:
                 raise ValueError("index out of range 2")
-            self.name = b[index : index + name_size]
+            self.name = bytes(b[index : index + name_size])
             index += name_size
         if index < n and self.has_mime():
             mime_size = b[index]
             index += 1
             if mime_size + index > n:
                 raise ValueError("index out of range 3")
-            self.mime = b[index : index + mime_size]
+            self.mime = bytes(b[index : index + mime_size])
             index += mime_size
         if index < n and self.has_last_modified_date():
             if LAST_MODIFIED_BYTES_LENGTH + index > n:
@@ -314,7 +320,7 @@ class Needle:
             index += 2
             if pairs_size + index > n:
                 raise ValueError("index out of range 7")
-            self.pairs = b[index : index + pairs_size]
+            self.pairs = bytes(b[index : index + pairs_size])
             index += pairs_size
 
     def read_bytes(self, b: bytes, offset: int, size: int, version: int) -> None:
@@ -326,10 +332,11 @@ class Needle:
                 f"entry not found: offset {offset} found id {self.id} "
                 f"size {self.size}, expected size {size}"
             )
+        mv = memoryview(b)  # body fields slice the blob zero-copy
         if version == VERSION1:
-            self.data = b[NEEDLE_HEADER_SIZE : NEEDLE_HEADER_SIZE + size]
+            self.data = mv[NEEDLE_HEADER_SIZE : NEEDLE_HEADER_SIZE + size]
         elif version in (VERSION2, VERSION3):
-            self._read_data_v2(b[NEEDLE_HEADER_SIZE : NEEDLE_HEADER_SIZE + self.size])
+            self._read_data_v2(mv[NEEDLE_HEADER_SIZE : NEEDLE_HEADER_SIZE + self.size])
         else:
             raise ValueError(f"unsupported version {version}")
         if size > 0:

@@ -14,7 +14,18 @@ from __future__ import annotations
 try:
     import google_crc32c as _gcrc
 
-    def crc32c(data: bytes, init: int = 0) -> int:
+    def crc32c(data, init: int = 0) -> int:
+        if type(data) is not bytes:
+            # google-crc32c's C binding accepts only bytes and objects
+            # exposing __array_interface__; the serving data plane hands
+            # zero-copy memoryviews through here, and wrapping them in a
+            # numpy view keeps the CRC zero-copy too
+            try:
+                import numpy as _np
+
+                data = _np.frombuffer(data, _np.uint8)
+            except Exception:
+                data = bytes(data)
         return _gcrc.extend(init, data)
 
 except ImportError:  # pragma: no cover - fallback path

@@ -8,7 +8,7 @@ client library; the push-gateway mode of the reference is replaced by pull.
 from __future__ import annotations
 
 import threading
-from bisect import bisect_right
+from bisect import bisect_left
 from collections import defaultdict
 
 _DEFAULT_BUCKETS = [
@@ -95,7 +95,12 @@ class Histogram(_Labeled):
         key = tuple(sorted(labels.items()))
         with self._lock:
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
-            idx = bisect_right(self.buckets, value)
+            # Prometheus le bounds are INCLUSIVE: a value equal to a
+            # boundary belongs in that boundary's bucket (bisect_left).
+            # bisect_right pushed every exact boundary hit one bucket up —
+            # invisible for continuous latencies, wrong for the integer
+            # batch-size buckets where boundary values are the common case
+            idx = bisect_left(self.buckets, value)
             if idx < len(counts):
                 counts[idx] += 1  # cumulative sums computed at render time
             self._sums[key] += value
@@ -193,4 +198,23 @@ TORN_TAIL_COUNTER = REGISTRY.counter(
 FAULTS_INJECTED = REGISTRY.counter(
     "seaweedfs_tpu_faults_injected_total",
     "faults fired by the active injection plan, by op/kind",
+)
+
+# serving-plane write-path attribution (see docs/perf.md): stages of one
+# replicated/fsync'd POST — local_append (append[+fsync] wall),
+# replicate_wait (extra wall the ack spent on the fan-out AFTER the local
+# write finished; overlap means this shrinks toward 0), group_commit_wait
+# (enqueue -> fsync'd-batch-resolution wall on the fsync=true tier)
+WRITE_STAGE_SECONDS = REGISTRY.histogram(
+    "seaweedfs_tpu_write_stage_seconds",
+    "volume write path stage wall time, by stage",
+)
+GROUP_COMMIT_BATCH_SIZE = REGISTRY.histogram(
+    "seaweedfs_tpu_group_commit_batch_size",
+    "requests per group-commit fsync batch",
+    buckets=[1, 2, 4, 8, 16, 32, 64, 128],
+)
+GROUP_COMMIT_FSYNCS = REGISTRY.counter(
+    "seaweedfs_tpu_group_commit_fsyncs_total",
+    "group-commit batches flushed (one fsync each)",
 )

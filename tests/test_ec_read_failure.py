@@ -1,0 +1,220 @@
+"""Property test for the distributed EC read path under failure (ISSUE 2 /
+VERDICT §6): encode one volume, spread RS(10,4) shards over 7 servers
+(2 each), then per example kill 0-2 shard servers (0-4 shards — up to the
+full parity budget) and issue random-offset reads, asserting byte equality
+with the pre-encode oracle. Reads route through all three serving paths:
+the local shard on its holder, the remote shard stream from every other
+server, and reconstruct-from-10 once a needle's home shard is among the
+killed (the final example forces that deterministically and asserts the
+reconstruction counter moved).
+
+Property-test structure (random examples against an invariant oracle) in
+the Hypothesis style, driven by a seeded RNG: the hypothesis package is
+not in this container's tier-1 image, and an importorskip would silently
+drop the coverage, so the 26 examples (>= the 25 VERDICT §6 asks for) are
+generated deterministically from a fixed seed instead — same distribution
+every run, failures reproducible by seed.
+"""
+
+import asyncio
+import os
+import random
+
+import aiohttp
+import pytest
+
+from seaweedfs_tpu.client import assign
+from seaweedfs_tpu.client.operation import upload_data
+from seaweedfs_tpu.pb import grpc_address
+from seaweedfs_tpu.pb.rpc import Stub, close_all_channels
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
+
+from test_cluster import Cluster, assign_retry
+
+N_SERVERS = 7
+N_EXAMPLES = 26  # >= 25; the last one is the forced-reconstruction case
+
+
+def _examples(rng: random.Random, servers: list[str]):
+    """(kill_set, [(payload_idx, start, span), ...]) per example."""
+    out = []
+    for ex in range(N_EXAMPLES - 1):
+        n_kill = rng.choice([0, 1, 1, 2, 2])
+        kills = rng.sample(servers, n_kill)
+        reads = [
+            (rng.randrange(10_000), rng.random(), rng.randrange(1, 4000))
+            for _ in range(3)
+        ]
+        out.append((kills, reads))
+    return out
+
+
+def test_ec_read_random_offsets_under_failures(tmp_path):
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=N_SERVERS)
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                rng = random.Random(0xEC5EED)
+                # ~12MB across one vid: payloads span multiple RS data
+                # shards (small block = 1MB, so > 10MB crosses all rows)
+                ar0 = await assign_retry(cluster.master.address)
+                vid = int(ar0.fid.split(",")[0])
+                source_url = ar0.url
+                payloads: dict[str, bytes] = {}
+                fids: list[str] = []
+                for i in range(1, 13):
+                    fid = f"{vid},{format_needle_id_cookie(i, 0xEC0000 + i)}"
+                    data = rng.randbytes(900_000 + 17_001 * i)
+                    await upload_data(session, source_url, fid, data)
+                    payloads[fid] = data
+                    fids.append(fid)
+
+                src_stub = Stub(grpc_address(source_url), "volume")
+                r = await src_stub.call(
+                    "VolumeMarkReadonly", {"volume_id": vid}
+                )
+                r = await src_stub.call(
+                    "VolumeEcShardsGenerate", {"volume_id": vid},
+                    timeout=240,
+                )
+                assert not r.get("error"), r
+
+                servers = [vs.address for vs in cluster.volume_servers]
+                shard_map = {
+                    s: [i, i + N_SERVERS] for i, s in enumerate(servers)
+                }
+                for target, shard_ids in shard_map.items():
+                    tstub = Stub(grpc_address(target), "volume")
+                    if target != source_url:
+                        r = await tstub.call(
+                            "VolumeEcShardsCopy",
+                            {
+                                "volume_id": vid,
+                                "shard_ids": shard_ids,
+                                "copy_ecx_file": True,
+                                "source_data_node": source_url,
+                            },
+                            timeout=240,
+                        )
+                        assert not r.get("error"), r
+                    r = await tstub.call(
+                        "VolumeEcShardsMount",
+                        {"volume_id": vid, "shard_ids": shard_ids},
+                    )
+                    assert not r.get("error"), r
+                await src_stub.call("VolumeUnmount", {"volume_id": vid})
+                await src_stub.call(
+                    "VolumeEcShardsDelete",
+                    {
+                        "volume_id": vid,
+                        "shard_ids": [
+                            i for i in range(14)
+                            if i not in shard_map[source_url]
+                        ],
+                    },
+                )
+                for _ in range(150):
+                    locs = cluster.master.topo.lookup_ec_shards(vid)
+                    if locs is not None and sum(
+                        1 for l in locs.locations if l
+                    ) == 14:
+                        break
+                    await asyncio.sleep(0.1)
+
+                async def read_range(url, fid, start, end):
+                    headers = {"Range": f"bytes={start}-{end}"}
+                    async with session.get(
+                        f"http://{url}/{fid}", headers=headers
+                    ) as resp:
+                        assert resp.status in (200, 206), (
+                            resp.status, url, fid
+                        )
+                        body = await resp.read()
+                        if resp.status == 200:
+                            body = body[start: end + 1]
+                        return body
+
+                async def unmount(server):
+                    stub = Stub(grpc_address(server), "volume")
+                    await stub.call(
+                        "VolumeEcShardsUnmount",
+                        {"volume_id": vid, "shard_ids": shard_map[server]},
+                    )
+
+                async def remount(server):
+                    stub = Stub(grpc_address(server), "volume")
+                    await stub.call(
+                        "VolumeEcShardsMount",
+                        {"volume_id": vid, "shard_ids": shard_map[server]},
+                    )
+
+                from seaweedfs_tpu.util.metrics import EC_RECONSTRUCTIONS
+
+                def reconstructions() -> float:
+                    with EC_RECONSTRUCTIONS._lock:
+                        return sum(EC_RECONSTRUCTIONS._values.values())
+
+                async def run_example(kills, reads, check_all_servers):
+                    for s in kills:
+                        await unmount(s)
+                    if kills:
+                        await asyncio.sleep(0.5)
+                    alive = [s for s in servers if s not in kills]
+                    try:
+                        for pick, frac, span in reads:
+                            fid = fids[pick % len(fids)]
+                            data = payloads[fid]
+                            start = int(frac * (len(data) - 1))
+                            end = min(start + span, len(data) - 1)
+                            url = alive[pick % len(alive)]
+                            got = await read_range(url, fid, start, end)
+                            assert got == data[start: end + 1], (
+                                f"range mismatch {fid} [{start}:{end}] "
+                                f"via {url} kills={kills}"
+                            )
+                        if check_all_servers:
+                            # one fid, full body, from EVERY alive server:
+                            # local-shard on its holder, remote stream on
+                            # the rest
+                            fid = fids[reads[0][0] % len(fids)]
+                            for url in alive:
+                                async with session.get(
+                                    f"http://{url}/{fid}"
+                                ) as resp:
+                                    assert resp.status == 200, (
+                                        resp.status, url
+                                    )
+                                    assert (
+                                        await resp.read() == payloads[fid]
+                                    ), f"full read {fid} via {url}"
+                    finally:
+                        for s in kills:
+                            await remount(s)
+
+                examples = _examples(rng, servers)
+                for i, (kills, reads) in enumerate(examples):
+                    await run_example(kills, reads, check_all_servers=(
+                        i % 5 == 0
+                    ))
+
+                # forced reconstruct-from-10: kill the holders of data
+                # shards 0,1 (and 7,8) — early-offset needles live there,
+                # so their reads can only be served by reconstruction
+                before = reconstructions()
+                await run_example(
+                    [servers[0], servers[1]],
+                    [(0, 0.0, 3000), (1, 0.01, 2000), (2, 0.02, 1000)],
+                    check_all_servers=True,
+                )
+                assert reconstructions() > before, (
+                    "killing data-shard holders must force the "
+                    "reconstruct-from-10 path"
+                )
+        finally:
+            await cluster.stop()
+            await close_all_channels()
+
+    asyncio.run(body())

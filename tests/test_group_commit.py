@@ -39,6 +39,44 @@ def test_group_commit_concurrent_writes(tmp_path):
     asyncio.run(body())
 
 
+def test_group_commit_adaptive_batching(tmp_path):
+    """The adaptive window must amortize concurrent writers into shared
+    fsync batches (far fewer batches than requests) while a lone writer
+    still flushes immediately — and the stats must record both."""
+
+    async def body():
+        v = Volume(str(tmp_path), "", 3)
+        worker = GroupCommitWorker(v)
+        worker.start()
+        try:
+            # lone writer: one request = one batch, flushed immediately
+            await worker.write(Needle(cookie=1, id=1, data=b"solo"))
+            assert worker.stats["batches"] == 1
+            assert worker.stats["requests"] == 1
+
+            # sustained concurrency: batches must coalesce
+            async def one(nid):
+                await worker.write(
+                    Needle(cookie=1, id=nid, data=b"x" * 400)
+                )
+
+            n = 160
+            await asyncio.gather(*(one(i) for i in range(2, 2 + n)))
+            reqs = worker.stats["requests"]
+            batches = worker.stats["batches"]
+            assert reqs == n + 1
+            assert batches < n / 2, (
+                f"adaptive coalescing failed: {batches} fsyncs for "
+                f"{reqs} writes"
+            )
+            assert worker.stats["largest_batch"] > 1
+        finally:
+            await worker.stop()
+            v.close()
+
+    asyncio.run(body())
+
+
 def test_group_commit_rollback_on_sync_failure(tmp_path):
     async def body():
         v = Volume(str(tmp_path), "", 2)

@@ -1,0 +1,195 @@
+"""Tier-1 guard for the serving write-path budget instrumentation
+(ISSUE 2): the in-process cluster write path must emit every itemized
+budget component non-zero, so the attribution in bench.py's
+serving_write_budget can't silently rot. Runs small (hundreds of writes)
+to stay inside the tier-1 wall clock.
+"""
+
+import asyncio
+import importlib.util
+import os
+import socket
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench = _load_bench()
+
+
+def free_port_pair() -> int:
+    for _ in range(50):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+        if p + 10000 > 65535:
+            continue
+        try:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", p + 10000))
+            return p
+        except OSError:
+            continue
+    raise RuntimeError("no free port pair")
+
+
+def _run_write_phase(tmp_path, num_files=240, concurrency=8):
+    """Mini cluster + instrumented write phase -> run_benchmark stats."""
+    from seaweedfs_tpu.command.benchmark import run_benchmark
+    from seaweedfs_tpu.pb.rpc import close_all_channels
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    s: dict = {}
+
+    async def body():
+        ms = MasterServer(port=free_port_pair(), pulse_seconds=0.2)
+        await ms.start()
+        vs = VolumeServer(
+            master=ms.address,
+            directories=[str(tmp_path)],
+            port=free_port_pair(),
+            pulse_seconds=0.2,
+            max_volume_counts=[10],
+        )
+        await vs.start()
+        try:
+            for _ in range(100):
+                if ms.topo.data_nodes():
+                    break
+                await asyncio.sleep(0.1)
+            await run_benchmark(
+                ms.address,
+                num_files=num_files,
+                concurrency=concurrency,
+                stats_out=s,
+                do_read=False,
+                assign_batch=32,
+            )
+        finally:
+            await vs.stop()
+            await ms.stop()
+            await close_all_channels()
+
+    asyncio.run(body())
+    return s
+
+
+def test_write_budget_components_emitted_and_nonzero(tmp_path):
+    s = _run_write_phase(tmp_path)
+    assert s["write_failed"] == 0, "instrumented write phase had failures"
+    assert s["write_qps"] > 0
+
+    # the client-side leg partition must be populated for every write
+    legs = bench._write_legs_us(s)
+    assert legs is not None
+    for key in ("assign_avg_us", "build_avg_us", "upload_avg_us"):
+        assert legs[key] > 0, f"{key} not measured"
+    # batched assigns actually amortized: far fewer RPCs than writes
+    assert legs["assign_rpcs"] < s["write_stats"].completed / 4
+    assert legs["assign_batch"] == 32
+
+    # early + final serving samples (VERDICT §7)
+    samples = s["write_samples"]
+    assert len(samples) == 2
+    assert all(x["qps"] > 0 for x in samples)
+
+    # itemized budget: components non-zero and coverage computable
+    stats = s["write_stats"]
+    serving = {
+        "write_legs": legs,
+        "write_latency": {
+            "p50_ms": stats.percentile(50),
+            "avg_ms": stats._sum_ms / max(stats.completed, 1),
+        },
+    }
+    wb = bench.measure_write_budget(serving=serving)
+    for key, val in wb["unit_costs_us"].items():
+        assert val > 0, f"unit cost {key} is zero"
+    for key, val in wb["components_us"].items():
+        assert val > 0, f"component {key} is zero"
+    assert wb["component_sum_us"] > 0
+    assert wb["write_p50_us"] > 0
+    # legs partition each request's wall clock, so their avg sum explains
+    # the average latency by construction; vs p50 it must stay well above
+    # the acceptance floor even on a noisy CI host
+    assert wb["coverage_of_p50"] > 0.5
+    # fsync tier: adaptive group commit measured, batching actually >1
+    gc = wb["group_commit"]
+    assert gc["flush_wait_p50_us"] > 0
+    assert gc["avg_batch"] > 1.5, gc
+
+
+def test_group_commit_put_fast_path_and_fsync(tmp_path):
+    """PUT rides the fast write tier and fsync=true rides group commit —
+    both must store bytes readable back through the same stack."""
+    import aiohttp
+
+    from seaweedfs_tpu.client import assign
+    from seaweedfs_tpu.pb.rpc import close_all_channels
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from seaweedfs_tpu.util.fasthttp import FastHTTPClient
+
+    async def body():
+        ms = MasterServer(port=free_port_pair(), pulse_seconds=0.2)
+        await ms.start()
+        vs = VolumeServer(
+            master=ms.address,
+            directories=[str(tmp_path)],
+            port=free_port_pair(),
+            pulse_seconds=0.2,
+            max_volume_counts=[10],
+        )
+        await vs.start()
+        try:
+            for _ in range(100):
+                if ms.topo.data_nodes():
+                    break
+                await asyncio.sleep(0.1)
+            for _ in range(60):
+                try:
+                    ar = await assign(ms.address)
+                    break
+                except Exception:
+                    await asyncio.sleep(0.25)
+            http = FastHTTPClient()
+            payload = b"put-body-fast-path" * 40
+            # multipart-free PUT body: fast-tier path
+            st, body_resp = await http.request(
+                "PUT", ar.url, "/" + ar.fid, body=payload,
+                content_type="application/x-custom",
+            )
+            assert st == 201, (st, body_resp)
+            st, got = await http.request("GET", ar.url, "/" + ar.fid)
+            assert st == 200 and got == payload
+            # fsync=true rides the group-commit worker (slow tier)
+            ar2 = await assign(ms.address)
+            async with aiohttp.ClientSession() as session:
+                async with session.put(
+                    f"http://{ar2.url}/{ar2.fid}?fsync=true", data=b"gc-body"
+                ) as resp:
+                    assert resp.status == 201, await resp.text()
+            st, got = await http.request("GET", ar2.url, "/" + ar2.fid)
+            assert st == 200 and got == b"gc-body"
+            gc = vs._group_committers.get(
+                int(ar2.fid.split(",")[0])
+            )
+            assert gc is not None and gc.stats["requests"] >= 1
+            await http.close()
+        finally:
+            await vs.stop()
+            await ms.stop()
+            await close_all_channels()
+
+    asyncio.run(body())
