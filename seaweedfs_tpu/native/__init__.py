@@ -209,17 +209,23 @@ def gf_matmul_native(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
     return gf_matmul_rows_native(matrix, list(data))
 
 
-def gf_matmul_rows_native(matrix: np.ndarray, rows_in) -> np.ndarray:
+def gf_matmul_rows_native(
+    matrix: np.ndarray, rows_in, out: Optional[np.ndarray] = None
+) -> np.ndarray:
     """Same matmul, but over C separately-allocated contiguous 1-D rows of
     equal length (the kernel takes per-row pointers, so rows may be views
-    into an mmapped file — no gather copy)."""
+    into an mmapped file — no gather copy). `out`, when given, receives the
+    result in place (hot loops recycle their output buffers instead of
+    faulting fresh pages every call)."""
     lib = load()
     if lib is None:
         raise RuntimeError("native gf256 library unavailable")
-    return _matmul_rows(lib, matrix, rows_in)
+    return _matmul_rows(lib, matrix, rows_in, out=out)
 
 
-def _matmul_rows(lib, matrix: np.ndarray, rows_in) -> np.ndarray:
+def _matmul_rows(
+    lib, matrix: np.ndarray, rows_in, out: Optional[np.ndarray] = None
+) -> np.ndarray:
     """Shared ctypes marshalling for gf_matmul against any loaded tier."""
     matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
     rows, cols = matrix.shape
@@ -227,7 +233,13 @@ def _matmul_rows(lib, matrix: np.ndarray, rows_in) -> np.ndarray:
     rows_in = [np.ascontiguousarray(r, dtype=np.uint8) for r in rows_in]
     n = rows_in[0].shape[0]
     assert all(r.shape == (n,) for r in rows_in)
-    out = np.empty((rows, n), dtype=np.uint8)
+    if out is None:
+        out = np.empty((rows, n), dtype=np.uint8)
+    else:
+        assert out.shape == (rows, n) and out.dtype == np.uint8
+        assert out.flags["C_CONTIGUOUS"] or all(
+            row.flags["C_CONTIGUOUS"] for row in out
+        )
 
     u8p = ctypes.POINTER(ctypes.c_uint8)
     data_ptrs = (u8p * cols)(*(r.ctypes.data_as(u8p) for r in rows_in))

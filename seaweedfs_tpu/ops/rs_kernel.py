@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..storage.erasure_coding.galois import (
+    DECODE_ROWS_CACHE,
     build_matrix,
     mat_mul,
     reconstruction_matrix,
@@ -110,3 +111,47 @@ class TpuRSCodec:
             for out_row, i in enumerate(targets):
                 shards[i] = recovered[out_row]
         return shards
+
+    def apply_matrix(self, m: np.ndarray, data) -> np.ndarray:
+        """Public bulk GF(2^8) matmul on the device kernel (the primitive
+        batched multi-volume rebuild dispatches through)."""
+        return self._apply(np.asarray(m, dtype=np.uint8), data)
+
+    def reconstruct_rows(
+        self,
+        shards: Sequence[Optional[np.ndarray]],
+        wanted: Sequence[int],
+        out: Optional[np.ndarray] = None,
+    ) -> list:
+        """Reconstruct ONLY the `wanted` shard ids from any k survivors —
+        one device dispatch with the composed decode rows (data rows from
+        the survivor inverse, parity rows pre-multiplied host-side), cached
+        per (survivor set, wanted rows) in the shared DECODE_ROWS_CACHE so
+        steady rebuild/degraded-read traffic reuses both the matrix AND its
+        compiled kernel (jit caches per matrix shape)."""
+        shards = list(shards)
+        if len(shards) != self.total_shards:
+            raise ValueError(f"expected {self.total_shards} shard slots")
+        present = [i for i, s in enumerate(shards) if s is not None]
+        if len(present) < self.data_shards:
+            raise ValueError(
+                f"too few shards: {len(present)} < {self.data_shards}"
+            )
+        need = [i for i in wanted if shards[i] is None]
+        recovered_by_id = {}
+        if need:
+            survivors = present[: self.data_shards]
+            rows = DECODE_ROWS_CACHE.rows_for(self.matrix, survivors, need)
+            sub = np.stack(
+                [np.asarray(shards[i], dtype=np.uint8) for i in survivors]
+            )
+            recovered = self._apply(rows, sub)
+            if out is not None and len(need) == len(wanted):
+                out[:] = recovered  # device result lands in the recycled
+                recovered = out  # caller buffer (interface parity with CPU)
+            for out_row, i in enumerate(need):
+                recovered_by_id[i] = recovered[out_row]
+        return [
+            shards[i] if shards[i] is not None else recovered_by_id[i]
+            for i in wanted
+        ]

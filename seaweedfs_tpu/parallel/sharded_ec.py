@@ -21,7 +21,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:  # moved to the jax namespace in newer releases
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
 
 from ..ops.gf256 import gf_matmul_expr, pack_bytes, unpack_bytes
 
@@ -156,3 +160,23 @@ def sharded_reconstruct_step(
         )
 
     return jax.jit(body)(survivors)[:v]
+
+
+def sharded_reconstruct_padded(
+    dec_rows: np.ndarray, survivors: np.ndarray, mesh: Mesh
+) -> np.ndarray:
+    """sharded_reconstruct_step for arbitrary byte widths: pads the column
+    axis up to the mesh's packing unit (4 bytes x blk devices — zero columns
+    decode to zero under GF linearity) and slices the pad back off. The
+    multi-chip leg rebuild_ec_files_multi dispatches survivor batches
+    through."""
+    survivors = np.ascontiguousarray(survivors, dtype=np.uint8)
+    v, k, n = survivors.shape
+    unit = 4 * mesh.shape["blk"]
+    pad = (-n) % unit
+    if pad:
+        survivors = np.concatenate(
+            [survivors, np.zeros((v, k, pad), dtype=np.uint8)], axis=2
+        )
+    out = np.asarray(sharded_reconstruct_step(dec_rows, survivors, mesh))
+    return out[:, :, :n] if pad else out

@@ -17,7 +17,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+try:  # moved to the jax namespace in newer releases
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
 
 from ..ops.index_kernel import _search_range, _split_u64
 

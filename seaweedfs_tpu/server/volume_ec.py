@@ -27,11 +27,16 @@ from ..util.backoff import (
     deadline_after,
     remaining,
 )
-from ..util.metrics import EC_RECONSTRUCTIONS, RETRY_COUNTER
+from ..util.metrics import (
+    EC_DEGRADED_READ_SECONDS,
+    EC_RECONSTRUCTIONS,
+    RETRY_COUNTER,
+)
 from ..storage.erasure_coding import (
     DATA_SHARDS_COUNT,
     TOTAL_SHARDS_COUNT,
     rebuild_ec_files,
+    rebuild_ec_files_multi,
     to_ext,
     write_dat_file,
     write_ec_files,
@@ -65,6 +70,90 @@ EC_REMOTE_READ_POLICY = BackoffPolicy(base=0.02, cap=0.25, attempts=2)
 # falling back to reconstruction — replaces the old single force-refresh
 EC_REFRESH_ROUNDS = 2
 
+# degraded-read interval cache: reconstructed spans kept per server so
+# repeated reads of a dead shard stop re-paying the survivor fetch + decode
+EC_DEGRADED_CACHE_BYTES = (
+    int(os.environ.get("SEAWEEDFS_TPU_EC_DEGRADED_CACHE_MB", "16")) << 20
+)
+# reconstruction granularity: intervals are widened to this alignment
+# (readahead — neighbouring needles on the same dead shard land in one
+# reconstructed span)
+EC_DEGRADED_SPAN = 128 * 1024
+
+
+class DegradedIntervalCache:
+    """Byte-bounded LRU of reconstructed shard spans, keyed by
+    (volume_id, shard_id, span_start).
+
+    A degraded read widens its interval to EC_DEGRADED_SPAN alignment
+    before reconstructing, caches the whole span, and serves any later
+    interval that falls inside a cached span — so a hot dead shard costs
+    one fetch+decode per span instead of per needle. Tombstones invalidate
+    the volume's spans (reconstructed bytes may include the deleted
+    needle's data; correctness of the tombstone answer comes from the .ecx
+    check upstream, but the cache must not outlive the journal write).
+    """
+
+    def __init__(self, capacity_bytes: int = EC_DEGRADED_CACHE_BYTES):
+        import threading
+        from collections import OrderedDict
+
+        self.capacity = capacity_bytes
+        self._spans: "OrderedDict[tuple, bytes]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def span_for(
+        offset: int, size: int, shard_size: Optional[int]
+    ) -> tuple[int, int]:
+        """Aligned (span_start, span_size) covering [offset, offset+size);
+        no readahead when the shard size is unknown (an over-long survivor
+        fetch past EOF would read short and poison the reconstruction)."""
+        if not shard_size or offset + size > shard_size:
+            return offset, size
+        start = offset - (offset % EC_DEGRADED_SPAN)
+        end = offset + size
+        end += (-end) % EC_DEGRADED_SPAN
+        return start, min(end, shard_size) - start
+
+    def get(
+        self, vid: int, shard_id: int, offset: int, size: int
+    ) -> Optional[bytes]:
+        start = offset - (offset % EC_DEGRADED_SPAN)
+        with self._lock:
+            for key in ((vid, shard_id, start), (vid, shard_id, offset)):
+                span = self._spans.get(key)
+                if span is not None and key[2] + len(span) >= offset + size:
+                    self._spans.move_to_end(key)
+                    return span[offset - key[2] : offset - key[2] + size]
+        return None
+
+    def put(self, vid: int, shard_id: int, span_start: int, data: bytes) -> None:
+        key = (vid, shard_id, span_start)
+        with self._lock:
+            old = self._spans.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old)
+            self._spans[key] = data
+            self._bytes += len(data)
+            while self._bytes > self.capacity and self._spans:
+                _k, v = self._spans.popitem(last=False)
+                self._bytes -= len(v)
+
+    def invalidate(self, vid: int) -> int:
+        """Drop every cached span of a volume (on .ecj tombstone writes);
+        returns how many spans were dropped."""
+        with self._lock:
+            doomed = [k for k in self._spans if k[0] == vid]
+            for k in doomed:
+                self._bytes -= len(self._spans.pop(k))
+            return len(doomed)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
 
 class EcHandlers:
     """Mixin for VolumeServer (expects .store, .master, .codec, .address)."""
@@ -73,6 +162,7 @@ class EcHandlers:
         svc.unary("VolumeEcShardsGenerate")(self._grpc_ec_generate)
         svc.unary("VolumeEcShardsGenerateBatch")(self._grpc_ec_generate_batch)
         svc.unary("VolumeEcShardsRebuild")(self._grpc_ec_rebuild)
+        svc.unary("VolumeEcShardsRebuildBatch")(self._grpc_ec_rebuild_batch)
         svc.unary("VolumeEcShardsCopy")(self._grpc_ec_copy)
         svc.unary("VolumeEcShardsDelete")(self._grpc_ec_delete)
         svc.unary("VolumeEcShardsMount")(self._grpc_ec_mount)
@@ -208,14 +298,85 @@ class EcHandlers:
         if base is None:
             return {"error": f"volume {vid} not found"}
         codec = self._codec_from_vif(base)
+        # survey BEFORE rebuilding: if a concurrent rebuild of this volume
+        # (e.g. a retried batch) commits first, rebuild_ec_files waits on
+        # the per-base lock and returns [] — the caller must still learn
+        # which of ITS missing shards now exist so it can mount them
+        pre_missing = [
+            i
+            for i in range(codec.total_shards)
+            if not os.path.exists(base + to_ext(i))
+        ]
         loop = asyncio.get_event_loop()
         try:
-            rebuilt = await loop.run_in_executor(
+            await loop.run_in_executor(
                 None, lambda: rebuild_ec_files(base, codec=codec)
             )
+            rebuilt = [
+                i for i in pre_missing if os.path.exists(base + to_ext(i))
+            ]
             return {"rebuilt_shard_ids": rebuilt}
         except Exception as e:
             return {"error": str(e)}
+
+    async def _grpc_ec_rebuild_batch(self, req, context) -> dict:
+        """Rebuild missing shards of MANY local EC volumes in one call:
+        volumes sharing an RS geometry stream through rebuild_ec_files_multi
+        (device codecs batch same-decode-matrix chunks across volumes into
+        wide dispatches; host codecs rebuild volumes across cores). Our
+        extension — the reference rebuilds one volume per RPC
+        (command_ec_rebuild.go:97-244). Returns per-volume results/errors;
+        a volume that fails batched is retried alone so one broken survivor
+        set cannot sink its neighbours."""
+        vids = [int(v) for v in req.get("volume_ids", [])]
+        collection = req.get("collection", "")
+        results: dict = {}
+        errors: dict = {}
+        by_codec: dict = {}
+        for vid in vids:
+            base = self._base_name(collection, vid)
+            if base is None:
+                errors[str(vid)] = f"volume {vid} not found"
+                continue
+            codec = self._codec_from_vif(base)
+            by_codec.setdefault(id(codec), (codec, []))[1].append((vid, base))
+        loop = asyncio.get_event_loop()
+        for codec, group in by_codec.values():
+            # survey the missing sets BEFORE rebuilding: a partially
+            # committed batch (per-volume atomic renames) followed by a
+            # per-volume retry would otherwise report [] for the volumes
+            # the batch already fixed, and the caller would never mount
+            # their rebuilt shards
+            pre_missing = {
+                vid: [
+                    i
+                    for i in range(codec.total_shards)
+                    if not os.path.exists(base + to_ext(i))
+                ]
+                for vid, base in group
+            }
+            try:
+                await loop.run_in_executor(
+                    None,
+                    lambda c=codec, g=group: rebuild_ec_files_multi(
+                        [b for _vid, b in g], codec=c
+                    ),
+                )
+                for vid, base in group:
+                    results[str(vid)] = {"rebuilt_shard_ids": pre_missing[vid]}
+            except Exception:
+                for vid, base in group:
+                    try:
+                        await loop.run_in_executor(
+                            None,
+                            lambda b=base, c=codec: rebuild_ec_files(b, codec=c),
+                        )
+                        results[str(vid)] = {
+                            "rebuilt_shard_ids": pre_missing[vid]
+                        }
+                    except Exception as e:
+                        errors[str(vid)] = str(e)
+        return {"results": results, "errors": errors}
 
     async def _grpc_ec_info(self, req, context) -> dict:
         """RS geometry of a local EC volume from its .vif (our extension;
@@ -300,6 +461,8 @@ class EcHandlers:
         base = self._base_name(collection, vid)
         if base is None:
             return {}
+        # cached degraded-read spans may embed this generation's bytes
+        self._ec_degraded_cache().invalidate(vid)
         for shard_id in shard_ids:
             try:
                 os.remove(base + to_ext(shard_id))
@@ -342,6 +505,7 @@ class EcHandlers:
         """(ref :246-268)"""
         vid = int(req["volume_id"])
         shard_ids = [int(s) for s in req.get("shard_ids", [])]
+        self._ec_degraded_cache().invalidate(vid)
         removed = ShardBits()
         for shard_id in shard_ids:
             for loc in self.store.locations:
@@ -393,6 +557,7 @@ class EcHandlers:
         await loop.run_in_executor(
             None, ev.delete_needle_from_ecx, int(req["file_key"])
         )
+        self._note_ec_tombstone(ev)
         return {}
 
     async def _grpc_ec_shards_to_volume(self, req, context) -> dict:
@@ -402,6 +567,9 @@ class EcHandlers:
         base = self._base_name(collection, vid)
         if base is None or not os.path.exists(base + ".ecx"):
             return {"error": f"ec volume {vid} not found"}
+        # the vid returns to (and may later re-leave) the normal-volume
+        # world: cached spans must not survive into the next generation
+        self._ec_degraded_cache().invalidate(vid)
         codec = self._codec_from_vif(base)
         missing = [
             i
@@ -571,11 +739,43 @@ class EcHandlers:
             cache[key] = get_codec(self.codec_backend, data_shards, parity_shards)
         return cache[key]
 
+    def _ec_degraded_cache(self) -> DegradedIntervalCache:
+        cache = getattr(self, "_degraded_cache", None)
+        if cache is None:
+            cache = self._degraded_cache = DegradedIntervalCache()
+        return cache
+
+    def _note_ec_tombstone(self, ev: EcVolume) -> None:
+        """A needle was tombstoned in this volume's .ecx/.ecj: reconstructed
+        spans may embed its bytes — drop them."""
+        self._ec_degraded_cache().invalidate(ev.volume_id)
+
     async def _recover_one_interval(
         self, ev: EcVolume, missing_shard: int, offset: int, size: int,
         file_key: int, deadline: Optional[float] = None,
     ) -> Optional[bytes]:
+        """Reconstruct [offset, offset+size) of a shard nobody can serve:
+        all survivor intervals are fetched CONCURRENTLY (local pread +
+        remote streams in one gather — wall clock is the slowest survivor,
+        not the sum), decoded missing-row-only through the shared
+        decode-matrix LRU, and the whole readahead-widened span is kept in
+        the degraded-read cache so the next needle on this dead shard skips
+        the fetch+decode entirely (ref store_ec.go:319-373 fetches, then
+        reconstructs all rows, every time)."""
         import numpy as np
+
+        t_start = time.perf_counter()
+        cache = self._ec_degraded_cache()
+        hit = cache.get(ev.volume_id, missing_shard, offset, size)
+        if hit is not None:
+            EC_RECONSTRUCTIONS.inc(kind="cache_hit")
+            EC_DEGRADED_READ_SECONDS.observe(
+                time.perf_counter() - t_start, result="cache_hit"
+            )
+            return hit
+        span_start, span_size = cache.span_for(
+            offset, size, ev.shard_size() or None
+        )
 
         total = ev.total_shards
         bufs: list[Optional[np.ndarray]] = [None] * total
@@ -583,19 +783,31 @@ class EcHandlers:
         async def fetch(shard_id: int) -> None:
             shard = ev.find_shard(shard_id)
             if shard is not None:
-                b = shard.read_at(size, offset)
+                b = shard.read_at(span_size, span_start)
             else:
                 try:
                     b = await self._read_remote_shard_interval(
-                        ev, shard_id, offset, size, file_key, deadline
+                        ev, shard_id, span_start, span_size, file_key, deadline
                     )
                 except EcHandlers._Deleted:
                     b = None
-            if b is not None and len(b) == size:
+            if b is not None and len(b) == span_size:
                 bufs[shard_id] = np.frombuffer(b, dtype=np.uint8)
 
         candidates = [i for i in range(total) if i != missing_shard]
-        await asyncio.gather(*(fetch(i) for i in candidates))
+        local = [i for i in candidates if ev.find_shard(i) is not None]
+        remote = [i for i in candidates if ev.find_shard(i) is None]
+        # local survivors are page-cache preads — take them all (spares are
+        # free); remote survivors cost span_size real network bytes each,
+        # so ask only as many holders as the decode needs plus one spare,
+        # widening to the rest only on a shortfall
+        needed = max(0, ev.data_shards - len(local))
+        first = remote[: needed + 1] if needed else []
+        await asyncio.gather(*(fetch(i) for i in local + first))
+        if sum(1 for b in bufs if b is not None) < ev.data_shards:
+            rest = [i for i in remote if i not in first]
+            if rest:
+                await asyncio.gather(*(fetch(i) for i in rest))
         present = [i for i in range(total) if bufs[i] is not None]
         if len(present) < ev.data_shards:
             return None
@@ -605,16 +817,20 @@ class EcHandlers:
         ]
         codec = self.codec_for(ev.data_shards, ev.parity_shards)
         loop = asyncio.get_event_loop()
-        full = await loop.run_in_executor(
+        rows = await loop.run_in_executor(
             None,
-            lambda: codec.reconstruct(
-                trimmed, data_only=missing_shard < ev.data_shards
-            ),
+            lambda: codec.reconstruct_rows(trimmed, [missing_shard]),
         )
-        out = full[missing_shard]
-        if out is not None:
-            EC_RECONSTRUCTIONS.inc()
-        return None if out is None else out.tobytes()
+        out = rows[0]
+        if out is None:
+            return None
+        span = np.ascontiguousarray(out).tobytes()
+        cache.put(ev.volume_id, missing_shard, span_start, span)
+        EC_RECONSTRUCTIONS.inc(kind="cold")
+        EC_DEGRADED_READ_SECONDS.observe(
+            time.perf_counter() - t_start, result="cold"
+        )
+        return span[offset - span_start : offset - span_start + size]
 
     async def read_ec_needle(self, ev: EcVolume, key: int) -> Optional[Needle]:
         try:
@@ -661,6 +877,7 @@ class EcHandlers:
             return 0
         loop = asyncio.get_event_loop()
         await loop.run_in_executor(None, ev.delete_needle_from_ecx, key)
+        self._note_ec_tombstone(ev)
         await self._refresh_shard_locations(ev)
         urls = set()
         with ev.shard_locations_lock:

@@ -685,7 +685,14 @@ async def cmd_ec_decode(env, argv) -> str:
 @command("ec.rebuild")
 async def cmd_ec_rebuild(env, argv) -> str:
     """Rebuild missing shards of damaged EC volumes
-    (ref command_ec_rebuild.go:97-244)."""
+    (ref command_ec_rebuild.go:97-244).
+
+    Survivor pulls happen per volume as in the reference, but the rebuild
+    RPCs are grouped per rebuilder node into VolumeEcShardsRebuildBatch so
+    a fleet-wide repair (every volume that lost the same node's shards)
+    decodes through shared wide batches server-side instead of one RPC and
+    one dispatch per volume (our extension; per-volume fallback kept for
+    servers without the batch RPC)."""
     env.confirm_is_locked()
     flags = _parse_flags(argv)
     collection = flags.get("collection", "")
@@ -695,6 +702,7 @@ async def cmd_ec_rebuild(env, argv) -> str:
         for vid, bits in n.shards.items():
             by_vid[vid] = by_vid[vid].plus(bits)
     results = []
+    plans = []  # (vid, rebuilder, local bits after pulls)
     for vid, bits in sorted(by_vid.items()):
         holders = [n.url for n in ec_nodes if vid in n.shards]
         k, m = await _ec_geometry(env, vid, collection, holders)
@@ -707,7 +715,9 @@ async def cmd_ec_rebuild(env, argv) -> str:
         rebuilder = max(ec_nodes, key=lambda n: n.free_slots)
         rstub = env.volume_stub(rebuilder.url)
         local = rebuilder.shards.get(vid, ShardBits())
-        # pull every survivor shard the rebuilder lacks
+        # pull every survivor shard the rebuilder lacks; a copy failure
+        # skips THIS volume only — the other damaged volumes still rebuild
+        copy_error = None
         for n in ec_nodes:
             if n.url == rebuilder.url:
                 continue
@@ -730,33 +740,72 @@ async def cmd_ec_rebuild(env, argv) -> str:
                 timeout=3600,
             )
             if r.get("error"):
-                return f"volume {vid}: copy for rebuild: {r['error']}"
+                copy_error = r["error"]
+                break
             for s in pull:
                 local = local.add(s)
-        r = await rstub.call(
-            "VolumeEcShardsRebuild",
-            {"volume_id": vid, "collection": collection},
-            timeout=3600,
-        )
-        if r.get("error"):
-            results.append(f"volume {vid}: rebuild failed: {r['error']}")
+        if copy_error is not None:
+            results.append(f"volume {vid}: copy for rebuild: {copy_error}")
             continue
-        rebuilt = r.get("rebuilt_shard_ids", [])
-        await rstub.call(
-            "VolumeEcShardsMount",
-            {"volume_id": vid, "collection": collection, "shard_ids": rebuilt},
-        )
-        # drop the extra survivor copies the rebuilder pulled
-        extra = [
-            s for s in local.shard_ids()
-            if s not in rebuilt and not rebuilder.shards.get(vid, ShardBits()).has(s)
-        ]
-        if extra:
-            await rstub.call(
-                "VolumeEcShardsDelete",
-                {"volume_id": vid, "collection": collection, "shard_ids": extra},
+        plans.append((vid, rebuilder, local))
+
+    # one batched rebuild RPC per rebuilder node
+    by_rebuilder: dict[str, list] = defaultdict(list)
+    for plan in plans:
+        by_rebuilder[plan[1].url].append(plan)
+    for url, group in by_rebuilder.items():
+        rstub = env.volume_stub(url)
+        vids = [vid for vid, _n, _l in group]
+        per_vid: dict[int, dict] = {}
+        try:
+            r = await rstub.call(
+                "VolumeEcShardsRebuildBatch",
+                {"volume_ids": vids, "collection": collection},
+                timeout=3600,
             )
-        results.append(f"volume {vid}: rebuilt shards {rebuilt} on {rebuilder.url}")
+        except Exception as e:  # older server without the batch RPC
+            r = {"error": str(e)}
+        if r.get("error"):
+            # per-volume fallback
+            for vid, _n, _l in group:
+                per_vid[vid] = await rstub.call(
+                    "VolumeEcShardsRebuild",
+                    {"volume_id": vid, "collection": collection},
+                    timeout=3600,
+                )
+        else:
+            for vid in vids:
+                res = r.get("results", {}).get(str(vid))
+                err = r.get("errors", {}).get(str(vid))
+                per_vid[vid] = res if res is not None else {
+                    "error": err or "missing batch result"
+                }
+        for vid, rebuilder, local in group:
+            rr = per_vid[vid]
+            if rr.get("error"):
+                results.append(f"volume {vid}: rebuild failed: {rr['error']}")
+                continue
+            rebuilt = rr.get("rebuilt_shard_ids", [])
+            await rstub.call(
+                "VolumeEcShardsMount",
+                {"volume_id": vid, "collection": collection,
+                 "shard_ids": rebuilt},
+            )
+            # drop the extra survivor copies the rebuilder pulled
+            extra = [
+                s for s in local.shard_ids()
+                if s not in rebuilt
+                and not rebuilder.shards.get(vid, ShardBits()).has(s)
+            ]
+            if extra:
+                await rstub.call(
+                    "VolumeEcShardsDelete",
+                    {"volume_id": vid, "collection": collection,
+                     "shard_ids": extra},
+                )
+            results.append(
+                f"volume {vid}: rebuilt shards {rebuilt} on {rebuilder.url}"
+            )
     return "\n".join(results) or "no damaged ec volumes"
 
 

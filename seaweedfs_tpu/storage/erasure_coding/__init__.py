@@ -29,6 +29,7 @@ from .encoder import (  # noqa: E402
     write_ec_files,
     write_ec_files_multi,
     rebuild_ec_files,
+    rebuild_ec_files_multi,
     write_sorted_file_from_idx,
     write_dat_file,
     write_idx_file_from_ec_index,
@@ -49,6 +50,7 @@ __all__ = [
     "write_ec_files",
     "write_ec_files_multi",
     "rebuild_ec_files",
+    "rebuild_ec_files_multi",
     "write_sorted_file_from_idx",
     "write_dat_file",
     "write_idx_file_from_ec_index",

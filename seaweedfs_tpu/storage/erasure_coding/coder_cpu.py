@@ -12,7 +12,12 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from .galois import MUL_TABLE, build_matrix, reconstruction_matrix
+from .galois import (
+    DECODE_ROWS_CACHE,
+    MUL_TABLE,
+    build_matrix,
+    reconstruction_matrix,
+)
 
 
 class CpuRSCodec:
@@ -42,6 +47,27 @@ class CpuRSCodec:
                 else:
                     acc ^= MUL_TABLE[c][data[j]]
         return out
+
+    def _apply_rows(
+        self,
+        m: np.ndarray,
+        rows: "Sequence[np.ndarray]",
+        out: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """_mat_apply over separately-allocated 1-D rows; subclasses that can
+        consume row pointers (native) override to skip the stack copy and
+        write straight into a caller-recycled `out`."""
+        res = self._mat_apply(m, np.stack(rows))
+        if out is None:
+            return res
+        out[:] = res
+        return out
+
+    def apply_matrix(self, m: np.ndarray, data: np.ndarray) -> np.ndarray:
+        """Public bulk GF(2^8) matmul: uint8[R, C] x uint8[C, N] -> uint8[R, N]
+        on this codec's compute path (the primitive batched multi-volume
+        rebuild dispatches through)."""
+        return self._mat_apply(np.asarray(m, dtype=np.uint8), data)
 
     def encode(self, data: np.ndarray) -> np.ndarray:
         """data: uint8[k, N] -> parity uint8[m, N]."""
@@ -107,3 +133,47 @@ class CpuRSCodec:
             for out_row, i in enumerate(missing_parity):
                 shards[i] = recovered[out_row]
         return shards
+
+    def reconstruct_rows(
+        self,
+        shards: Sequence[Optional[np.ndarray]],
+        wanted: Sequence[int],
+        out: Optional[np.ndarray] = None,
+    ) -> list[np.ndarray]:
+        """Reconstruct ONLY the `wanted` shard ids from any k survivors.
+
+        Returns arrays aligned with `wanted` (already-present wanted shards
+        pass through untouched), byte-identical to full reconstruct() on the
+        same ids — but the decode matrix is sliced to the wanted rows (one
+        fused matmul, parity rows composed with the survivor inverse) and
+        cached in the shared DECODE_ROWS_CACHE LRU, so the per-chunk cost is
+        the matmul alone. This is the repair-plane hot primitive: rebuild
+        pays for 4 output rows instead of 14, a single-dead-shard degraded
+        read for 1.
+        """
+        shards = list(shards)
+        if len(shards) != self.total_shards:
+            raise ValueError(f"expected {self.total_shards} shard slots")
+        present = [i for i, s in enumerate(shards) if s is not None]
+        if len(present) < self.data_shards:
+            raise ValueError(
+                f"too few shards: {len(present)} < {self.data_shards}"
+            )
+        need = [i for i in wanted if shards[i] is None]
+        recovered_by_id: dict[int, np.ndarray] = {}
+        if need:
+            survivors = present[: self.data_shards]
+            rows = DECODE_ROWS_CACHE.rows_for(self.matrix, survivors, need)
+            recovered = self._apply_rows(
+                rows,
+                [shards[i] for i in survivors],
+                # `out` (shape [len(need), N]) only fits when every wanted
+                # id actually needs recovering — hot callers guarantee that
+                out=out if out is not None and len(need) == len(wanted) else None,
+            )
+            for out_row, i in enumerate(need):
+                recovered_by_id[i] = recovered[out_row]
+        return [
+            shards[i] if shards[i] is not None else recovered_by_id[i]
+            for i in wanted
+        ]

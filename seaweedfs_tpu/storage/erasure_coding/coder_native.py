@@ -40,6 +40,14 @@ class NativeRSCodec(CpuRSCodec):
     def _mat_apply(self, m: np.ndarray, data: np.ndarray) -> np.ndarray:
         return self._native.gf_matmul_native(m, data)
 
+    def _apply_rows(self, m: np.ndarray, rows, out=None) -> np.ndarray:
+        # decode-side analogue of encode_rows: the survivor chunks (read
+        # buffers, mmap views) go to the kernel as row pointers and the
+        # result lands in the caller's recycled `out` — reconstruct_rows
+        # pays neither a k-row stack copy nor a fresh output allocation
+        # per chunk
+        return self._native.gf_matmul_rows_native(m, rows, out=out)
+
     def encode_rows(self, rows) -> np.ndarray:
         # per-row pointers straight into the kernel — mmap views encode
         # without ever being copied into a stacked buffer

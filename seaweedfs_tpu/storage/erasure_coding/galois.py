@@ -156,3 +156,80 @@ def reconstruction_matrix(
     if len(survivor_rows) != k:
         raise ValueError(f"need exactly {k} survivors, got {len(survivor_rows)}")
     return mat_inv(sub_matrix_for_survivors(full_matrix, survivor_rows))
+
+
+def compose_decode_rows(
+    full_matrix: np.ndarray, survivors: list[int], wanted: list[int]
+) -> np.ndarray:
+    """The (len(wanted) x k) matrix that maps k survivor shards DIRECTLY to
+    the wanted shard ids — data rows come from the survivor inverse, parity
+    rows are the parity generator composed with that inverse (exact GF
+    algebra, so the output is byte-identical to reconstructing all data and
+    re-encoding the parity)."""
+    k = full_matrix.shape[1]
+    dec = reconstruction_matrix(full_matrix, survivors)
+    rows = np.empty((len(wanted), k), dtype=np.uint8)
+    for r, i in enumerate(wanted):
+        if i < k:
+            rows[r] = dec[i]
+        else:
+            rows[r] = mat_mul(full_matrix[i : i + 1], dec)[0]
+    return rows
+
+
+class DecodeRowsCache:
+    """Bounded LRU of composed decode matrices keyed by (geometry, survivor
+    set, wanted rows) — shared by rebuild_ec_files and the degraded-read
+    path so a steady repair workload pays the Gauss-Jordan inversion once
+    per missing-shard pattern, not once per chunk/interval."""
+
+    def __init__(self, maxsize: int = 256):
+        import threading
+        from collections import OrderedDict
+
+        self.maxsize = maxsize
+        self._entries: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def rows_for(
+        self, full_matrix: np.ndarray, survivors: list[int], wanted: list[int]
+    ) -> np.ndarray:
+        key = (
+            full_matrix.shape[0],
+            full_matrix.shape[1],
+            tuple(survivors),
+            tuple(wanted),
+        )
+        with self._lock:
+            rows = self._entries.get(key)
+            if rows is not None:
+                self._entries.move_to_end(key)
+        try:
+            from ...util.metrics import EC_DECODE_MATRIX_CACHE
+
+            EC_DECODE_MATRIX_CACHE.inc(
+                outcome="hit" if rows is not None else "miss"
+            )
+        except ImportError:  # metrics must never break the math path
+            pass
+        if rows is not None:
+            return rows
+        rows = compose_decode_rows(full_matrix, survivors, wanted)
+        with self._lock:
+            self._entries[key] = rows
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.maxsize:
+                self._entries.popitem(last=False)
+        return rows
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+# process-wide instance (all geometries share it; keys carry the geometry)
+DECODE_ROWS_CACHE = DecodeRowsCache()

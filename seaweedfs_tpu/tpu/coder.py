@@ -8,8 +8,11 @@ volume-server EC handlers, selected by configuration:
     backend = "tpu"     # or "cpu"
 
 Both implementations expose the same interface (encode / encode_all /
-verify / reconstruct over uint8[shards, N]) and produce byte-identical
-output.
+verify / reconstruct / reconstruct_rows / apply_matrix over
+uint8[shards, N]) and produce byte-identical output — reconstruct_rows is
+the repair-plane primitive (decode matrix sliced to the wanted shard ids,
+cached in galois.DECODE_ROWS_CACHE) that rebuild_ec_files and the
+degraded-read path dispatch through.
 """
 
 from __future__ import annotations

@@ -188,7 +188,9 @@ RETRY_COUNTER = REGISTRY.counter(
 )
 EC_RECONSTRUCTIONS = REGISTRY.counter(
     "seaweedfs_tpu_ec_reconstructions_total",
-    "EC intervals served by reconstruction from >= data_shards other shards",
+    "EC intervals served by reconstruction from >= data_shards other shards, "
+    'by kind (kind="cold" = full survivor fetch + decode, kind="cache_hit" '
+    "= served from the degraded-read interval cache)",
 )
 TORN_TAIL_COUNTER = REGISTRY.counter(
     "seaweedfs_tpu_torn_tail_total",
@@ -217,4 +219,23 @@ GROUP_COMMIT_BATCH_SIZE = REGISTRY.histogram(
 GROUP_COMMIT_FSYNCS = REGISTRY.counter(
     "seaweedfs_tpu_group_commit_fsyncs_total",
     "group-commit batches flushed (one fsync each)",
+)
+
+# repair-plane attribution (see docs/perf.md "Repair plane"): rebuild gets
+# the same itemized-budget treatment the write path got — per-stage walls
+# of every rebuild_ec_files run (stages overlap on the pipelined route, so
+# their sum can exceed the rebuild wall), degraded-read interval latency
+# split cold vs cache-served, and the decode-matrix LRU's hit rate
+EC_REBUILD_STAGE_SECONDS = REGISTRY.histogram(
+    "seaweedfs_tpu_ec_rebuild_stage_seconds",
+    "rebuild_ec_files per-stage wall seconds, by stage (read/decode/write; "
+    "pipelined stages overlap)",
+)
+EC_DEGRADED_READ_SECONDS = REGISTRY.histogram(
+    "seaweedfs_tpu_ec_degraded_read_seconds",
+    "degraded EC interval read latency, by result (cold/cache_hit)",
+)
+EC_DECODE_MATRIX_CACHE = REGISTRY.counter(
+    "seaweedfs_tpu_ec_decode_matrix_cache_total",
+    "decode-matrix LRU lookups, by outcome (hit/miss)",
 )
