@@ -6,7 +6,12 @@ reference's shared dial helper, ref: weed/pb/grpc_client_server.go:56-140).
 
 Method kinds: unary_unary, unary_stream, stream_stream — enough for the
 reference's surface (heartbeat bidi stream, KeepConnected push stream,
-CopyFile/EcShardRead download streams, everything else unary).
+CopyFile/EcShardRead download streams, everything else unary) plus the
+anti-entropy extensions (volume `VolumeScrub`/`VolumeTailSync`/
+`VolumeRepairCopy`, master `RepairStatus`); being schemaless, new
+anti-entropy heartbeat fields (`volume_digests`, `content_digest`,
+`scrub_corrupt`) ride the existing SendHeartbeat stream with no proto
+changes.
 """
 
 from __future__ import annotations

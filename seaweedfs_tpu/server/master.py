@@ -18,6 +18,8 @@ and freshly assigned volume ids are majority-committed before use
 from __future__ import annotations
 
 import asyncio
+import os
+import random
 import time
 from typing import Optional
 
@@ -26,11 +28,19 @@ from aiohttp import web
 from ..pb import grpc_address
 from ..pb.rpc import Service, Stub, serve
 from ..sequence import MemorySequencer
+from ..storage.erasure_coding import DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT
 from ..storage.erasure_coding.ec_volume import ShardBits
 from ..storage.super_block import ReplicaPlacement
 from ..storage.ttl import TTL
 from ..topology import GrowOption, Topology, VolumeGrowth
+from ..topology.repair import (
+    RepairQueue,
+    find_unresolved_divergence,
+    plan_ec_repairs,
+    plan_replica_repairs,
+)
 from ..topology.volume_growth import NoFreeSpaceError, grow_count_for_copy_level
+from ..util.metrics import ANTIENTROPY_DIVERGED, REPAIR_SECONDS
 
 
 class MasterServer:
@@ -51,6 +61,9 @@ class MasterServer:
         maintenance_filer: str = "",
         sequencer_file: str = "",
         raft_state_file: str = "",
+        auto_repair: Optional[bool] = None,
+        repair_grace_seconds: Optional[float] = None,
+        repair_concurrency: int = 2,
     ):
         self.jwt_signing_key = jwt_signing_key
         self.jwt_expires_seconds = jwt_expires_seconds
@@ -85,6 +98,24 @@ class MasterServer:
             adjust_max_volume_id=self.topo.adjust_max_volume_id,
             state_file=raft_state_file,
         )
+        # anti-entropy repair plane: heartbeat-driven failure detection ->
+        # prioritized queue -> batched-rebuild dispatch. The background
+        # loop is opt-in (SEAWEEDFS_TPU_AUTO_REPAIR / auto_repair=True);
+        # run_anti_entropy_once() is always callable (shell, tests).
+        if auto_repair is None:
+            auto_repair = os.environ.get(
+                "SEAWEEDFS_TPU_AUTO_REPAIR", ""
+            ).lower() in ("1", "true", "on", "yes")
+        self.auto_repair = auto_repair
+        self.repair_grace_seconds = (
+            repair_grace_seconds
+            if repair_grace_seconds is not None
+            else max(15.0, 4 * pulse_seconds)
+        )
+        self.repair_concurrency = repair_concurrency
+        self.repair_queue = RepairQueue(rng=random.Random())
+        self.repair_log: list[dict] = []  # last dispatch outcomes
+        self._repair_task: Optional[asyncio.Task] = None
         self._clients: dict[str, asyncio.Queue] = {}
         self._option_cache: dict[tuple, GrowOption] = {}
         self._admin_token: Optional[tuple[int, float]] = None  # (token, ts)
@@ -151,6 +182,7 @@ class MasterServer:
         svc.unary("LeaseAdminToken")(self._grpc_lease_admin_token)
         svc.unary("ReleaseAdminToken")(self._grpc_release_admin_token)
         svc.unary("GetMasterConfiguration")(self._grpc_get_configuration)
+        svc.unary("RepairStatus")(self._grpc_repair_status)
         svc.unary("RaftRequestVote")(self._grpc_raft_request_vote)
         svc.unary("RaftAppendEntries")(self._grpc_raft_append_entries)
         self._grpc_server = await serve(grpc_address(self.address), svc)
@@ -159,6 +191,8 @@ class MasterServer:
             self._maintenance_task = asyncio.ensure_future(
                 self._maintenance_loop()
             )
+        if self.auto_repair:
+            self._repair_task = asyncio.ensure_future(self._anti_entropy_loop())
 
     async def _maintenance_loop(self) -> None:
         """Leader-only periodic admin scripts (ref: master_server.go:191-246
@@ -193,6 +227,12 @@ class MasterServer:
         self._shutdown = True
         if getattr(self, "_fast_server", None) is not None:
             await self._fast_server.stop()
+        if self._repair_task is not None:
+            self._repair_task.cancel()
+            try:
+                await self._repair_task
+            except (asyncio.CancelledError, Exception):
+                pass
         if self._maintenance_task is not None:
             self._maintenance_task.cancel()
             try:
@@ -635,6 +675,23 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
                         if not dn.ec_shards.get(int(m["id"])):
                             deleted_vids.append(int(m["id"]))
 
+                if hb.get("volume_digests"):
+                    # anti-entropy tick: refresh digest/frontier/quarantine
+                    # fields in place — layouts don't change, but replica
+                    # comparison must see current values
+                    for m in hb["volume_digests"]:
+                        info = dn.volumes.get(int(m["id"]))
+                        if info is None:
+                            continue
+                        for k in (
+                            "content_digest",
+                            "append_at_ns",
+                            "read_only",
+                            "scrub_corrupt",
+                        ):
+                            if k in m:
+                                info[k] = m[k]
+
                 if new_vids or deleted_vids:
                     self._broadcast_location(
                         dn, new_vids=new_vids, deleted_vids=deleted_vids
@@ -849,6 +906,310 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
 
     async def _grpc_raft_append_entries(self, req, context) -> dict:
         return await self.raft.handle_append_entries(req)
+
+    # ---------------- anti-entropy repair scheduler ----------------
+    async def _anti_entropy_loop(self) -> None:
+        """Leader-only background repair: scan heartbeat state every few
+        pulses, queue findings, dispatch under the concurrency cap."""
+        interval = max(self.pulse_seconds * 2, 1.0)
+        while not self._shutdown:
+            try:
+                await asyncio.sleep(interval)
+                if not self.is_leader or self._shutdown:
+                    continue
+                await self.run_anti_entropy_once()
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                continue  # scheduler errors must never kill the master
+
+    async def run_anti_entropy_once(self, max_dispatch: Optional[int] = None) -> dict:
+        """One scan+dispatch round: detect (silent nodes, missing EC
+        shards, quarantined/diverged replicas), merge findings into the
+        prioritized queue (fewest-survivors-first), dispatch up to the
+        concurrency cap, full-jitter backoff on failures. Returns a
+        status dict; also the engine behind `ec.repair.status -run`."""
+        if not self.is_leader:
+            return {"error": "not leader"}
+        live = {
+            dn.url
+            for dn in self.topo.live_data_nodes(self.repair_grace_seconds)
+        }
+        ec_states = self.topo.ec_states(live)
+        for st in ec_states:
+            # expected_total is heartbeat-history and resets with the
+            # master: a shard whose EVERY holder died before this leader's
+            # first scan would stay invisible. The .vif geometry (cached
+            # per vid once a holder answers) is the source of truth.
+            total = await self._ec_expected_total(st)
+            if total:
+                st["total_shards"] = max(int(st["total_shards"]), total)
+        replica_states = self.topo.replica_states(live)
+        tasks = plan_ec_repairs(ec_states)
+        tasks += plan_replica_repairs(replica_states)
+        diverged = find_unresolved_divergence(replica_states)
+        ANTIENTROPY_DIVERGED.set(len(diverged))
+        if diverged:
+            from ..util import log
+
+            log.warning(
+                "anti-entropy: volumes %s have healthy replicas that "
+                "disagree at EQUAL append frontiers — not auto-repairable "
+                "(run volume.fsck / re-replicate)", diverged,
+            )
+        valid_keys = set()
+        for t in tasks:
+            valid_keys.add(t.key)
+            self.repair_queue.offer(t)
+        self.repair_queue.prune(valid_keys)
+        now = time.monotonic()
+        ready = self.repair_queue.pop_ready(
+            now, max_dispatch or self.repair_concurrency
+        )
+        results: list[dict] = []
+        ec_ready = [t for t in ready if t.kind == "ec_rebuild"]
+        other = [t for t in ready if t.kind != "ec_rebuild"]
+
+        # EC: survivor pulls run CONCURRENTLY per task (the cap is how
+        # many we popped), then ONE batched rebuild RPC per rebuilder
+        # node (PR 3's VolumeEcShardsRebuildBatch fast path — same-loss-
+        # pattern volumes share wide device dispatches there)
+        t0s = {t.key: time.perf_counter() for t in ec_ready}
+        prep = await asyncio.gather(
+            *(self._prepare_ec_rebuild(t, live) for t in ec_ready),
+            return_exceptions=True,
+        )
+        prepared: dict[tuple, list] = {}
+        for t, outcome in zip(ec_ready, prep):
+            if isinstance(outcome, BaseException):
+                REPAIR_SECONDS.observe(
+                    time.perf_counter() - t0s[t.key],
+                    kind="ec_rebuild", result="error",
+                )
+                self.repair_queue.reschedule_failure(t, time.monotonic())
+                results.append({**t.to_info(), "error": str(outcome)})
+            else:
+                prepared.setdefault((outcome, t.collection), []).append(
+                    (t, t0s[t.key])
+                )
+        # group rebuilds and replica repairs all dispatch concurrently —
+        # one slow rebuild must not stall an unrelated critical repair
+        await asyncio.gather(
+            *(
+                self._dispatch_ec_group(rebuilder, collection, group, results)
+                for (rebuilder, collection), group in prepared.items()
+            ),
+            *(self._dispatch_replica_task(t, results) for t in other),
+        )
+
+        self.repair_log = (self.repair_log + results)[-50:]
+        return {
+            "dispatched": results,
+            "queue_depth": self.repair_queue.depth(),
+            "live_nodes": sorted(live),
+            "diverged_volumes": diverged,
+        }
+
+    async def _ec_expected_total(self, st: dict) -> int:
+        """Authoritative shard count (k+m) for one EC volume from a
+        holder's .vif, cached per vid; 0 when no holder answers."""
+        vid = int(st["vid"])
+        cache = getattr(self, "_ec_geom_cache", None)
+        if cache is None:
+            cache = self._ec_geom_cache = {}
+        if vid in cache:
+            return cache[vid]
+        holders = sorted({u for urls in st["holders"].values() for u in urls})
+        for url in holders:
+            try:
+                r = await Stub(grpc_address(url), "volume").call(
+                    "VolumeEcShardsInfo",
+                    {"volume_id": vid, "collection": st.get("collection", "")},
+                    timeout=10,
+                )
+            except Exception:
+                continue
+            if not r.get("error") and r.get("data_shards"):
+                total = int(r["data_shards"]) + int(r.get("parity_shards", 0))
+                if len(cache) > 65536:  # runaway-vid backstop
+                    cache.clear()
+                cache[vid] = total
+                return total
+        return 0
+
+    async def _dispatch_ec_group(
+        self, rebuilder: str, collection: str, group: list, results: list
+    ) -> None:
+        rstub = Stub(grpc_address(rebuilder), "volume")
+        vids = [t.vid for t, _t0 in group]
+        try:
+            r = await rstub.call(
+                "VolumeEcShardsRebuildBatch",
+                {"volume_ids": vids, "collection": collection},
+                timeout=3600,
+            )
+        except Exception as e:
+            r = {"error": str(e)}
+        for t, t0 in group:
+            err = r.get("error") or r.get("errors", {}).get(str(t.vid))
+            res = r.get("results", {}).get(str(t.vid)) or {}
+            rebuilt = res.get("rebuilt_shard_ids", [])
+            if not err:
+                try:
+                    await rstub.call(
+                        "VolumeEcShardsMount",
+                        {
+                            "volume_id": t.vid,
+                            "collection": t.collection,
+                            "shard_ids": rebuilt,
+                        },
+                    )
+                except Exception as e:
+                    err = f"mount rebuilt shards: {e}"
+            dt = time.perf_counter() - t0
+            if err:
+                REPAIR_SECONDS.observe(dt, kind="ec_rebuild", result="error")
+                self.repair_queue.reschedule_failure(t, time.monotonic())
+                results.append({**t.to_info(), "error": err})
+            else:
+                REPAIR_SECONDS.observe(dt, kind="ec_rebuild", result="ok")
+                results.append(
+                    {**t.to_info(), "rebuilder": rebuilder, "rebuilt": rebuilt}
+                )
+
+    async def _dispatch_replica_task(self, t, results: list) -> None:
+        t0 = time.perf_counter()
+        method = (
+            "VolumeRepairCopy"
+            if t.kind == "replica_recopy"
+            else "VolumeTailSync"
+        )
+        try:
+            r = await Stub(grpc_address(t.target), "volume").call(
+                method,
+                {
+                    "volume_id": t.vid,
+                    "collection": t.collection,
+                    "source_data_node": t.source,
+                },
+                timeout=3600,
+            )
+            err = r.get("error")
+        except Exception as e:
+            err = str(e)
+        dt = time.perf_counter() - t0
+        if err:
+            REPAIR_SECONDS.observe(dt, kind=t.kind, result="error")
+            self.repair_queue.reschedule_failure(t, time.monotonic())
+            results.append({**t.to_info(), "error": err})
+        else:
+            REPAIR_SECONDS.observe(dt, kind=t.kind, result="ok")
+            results.append({**t.to_info(), "repaired": True})
+
+    async def _master_ec_geometry(
+        self, vid: int, collection: str, holders: list[str]
+    ) -> tuple[int, int]:
+        """(data_shards, parity_shards) from a shard holder's .vif;
+        standard 10.4 when nobody answers."""
+        for url in holders:
+            try:
+                r = await Stub(grpc_address(url), "volume").call(
+                    "VolumeEcShardsInfo",
+                    {"volume_id": vid, "collection": collection},
+                )
+                if not r.get("error"):
+                    return (
+                        int(r.get("data_shards") or DATA_SHARDS_COUNT),
+                        int(
+                            r.get("parity_shards")
+                            or TOTAL_SHARDS_COUNT - DATA_SHARDS_COUNT
+                        ),
+                    )
+            except Exception:
+                continue
+        return DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT - DATA_SHARDS_COUNT
+
+    async def _prepare_ec_rebuild(self, task, live: set) -> str:
+        """Stage one EC rebuild: verify repairability, choose the live
+        rebuilder holding the most shards (fewest pulls), and copy it the
+        survivors it lacks. Returns the rebuilder url; raises on any
+        blocker (the caller reschedules with backoff)."""
+        locs = self.topo.lookup_ec_shards(task.vid)
+        if locs is None:
+            raise LookupError(f"ec volume {task.vid} no longer registered")
+        holders: dict[int, list[str]] = {}
+        for sid in range(locs.expected_total):
+            urls = [dn.url for dn in locs.locations[sid] if dn.url in live]
+            if urls:
+                holders[sid] = urls
+        all_urls = sorted({u for urls in holders.values() for u in urls})
+        if not all_urls:
+            raise LookupError(f"ec volume {task.vid}: no live holders")
+        k, _m = await self._master_ec_geometry(
+            task.vid, task.collection, all_urls
+        )
+        if len(holders) < k:
+            raise RuntimeError(
+                f"ec volume {task.vid} unrepairable: "
+                f"{len(holders)} survivors < {k} data shards"
+            )
+        by_url: dict[str, set[int]] = {u: set() for u in all_urls}
+        for sid, urls in holders.items():
+            for u in urls:
+                by_url[u].add(sid)
+        rebuilder = max(all_urls, key=lambda u: len(by_url[u]))
+        rstub = Stub(grpc_address(rebuilder), "volume")
+        local = set(by_url[rebuilder])
+        for url in all_urls:
+            if url == rebuilder:
+                continue
+            pull = sorted(by_url[url] - local)
+            if not pull:
+                continue
+            r = await rstub.call(
+                "VolumeEcShardsCopy",
+                {
+                    "volume_id": task.vid,
+                    "collection": task.collection,
+                    "shard_ids": pull,
+                    "copy_ecx_file": True,
+                    "source_data_node": url,
+                },
+                timeout=3600,
+            )
+            if r.get("error"):
+                raise IOError(
+                    f"pull shards {pull} from {url}: {r['error']}"
+                )
+            local.update(pull)
+        return rebuilder
+
+    async def _grpc_repair_status(self, req, context) -> dict:
+        """Repair-plane introspection for `ec.repair.status` (+ `-run` to
+        force a scan/dispatch round)."""
+        proxied = await self._proxy_to_leader("RepairStatus", req)
+        if proxied is not None:
+            return proxied
+        ran = None
+        if req.get("run"):
+            ran = await self.run_anti_entropy_once(
+                max_dispatch=int(req.get("max_dispatch", 0) or 0) or None
+            )
+        live = {
+            dn.url
+            for dn in self.topo.live_data_nodes(self.repair_grace_seconds)
+        }
+        all_nodes = {dn.url for dn in self.topo.data_nodes()}
+        return {
+            "auto_repair": self.auto_repair,
+            "grace_seconds": self.repair_grace_seconds,
+            "queue_depth": self.repair_queue.depth(),
+            "queue": self.repair_queue.snapshot(),
+            "live_nodes": sorted(live),
+            "silent_nodes": sorted(all_nodes - live),
+            "recent": self.repair_log[-10:],
+            **({"ran": ran} if ran is not None else {}),
+        }
 
     # ---------------- vacuum driver (ref topology_vacuum.go) ----------------
     async def vacuum(self, garbage_threshold: float) -> list[dict]:

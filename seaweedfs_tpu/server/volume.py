@@ -148,6 +148,17 @@ class VolumeServer(EcHandlers):
         self._http_client: Optional[aiohttp.ClientSession] = None
         self._shutdown = False
         self._codec = None
+        # anti-entropy plane: background scrubber (rate-shaped by
+        # SEAWEEDFS_TPU_SCRUB_MBPS; 0 = no background pass, scrubs run
+        # only when forced via VolumeScrub / the volume.scrub command)
+        self.scrub_mbps = float(
+            os.environ.get("SEAWEEDFS_TPU_SCRUB_MBPS", "0") or 0
+        )
+        self.scrub_interval_seconds = float(
+            os.environ.get("SEAWEEDFS_TPU_SCRUB_INTERVAL", "300") or 300
+        )
+        self._scrubber = None
+        self._scrub_task: Optional[asyncio.Task] = None
         self._group_committers: dict[int, object] = {}
         self._req_counters: dict[str, object] = {}
         self._replica_loc_cache: dict[int, tuple[float, list]] = {}
@@ -226,6 +237,9 @@ class VolumeServer(EcHandlers):
         svc.unary("VolumeCopy")(self._grpc_volume_copy)
         svc.server_stream("VolumeIncrementalCopy")(self._grpc_incremental_copy)
         svc.unary("VolumeSyncStatus")(self._grpc_sync_status)
+        svc.unary("VolumeScrub")(self._grpc_volume_scrub)
+        svc.unary("VolumeTailSync")(self._grpc_volume_tail_sync)
+        svc.unary("VolumeRepairCopy")(self._grpc_volume_repair_copy)
         svc.server_stream("Query")(self._grpc_query)
         svc.server_stream("VolumeTierMoveDatToRemote")(self._grpc_tier_to_remote)
         svc.server_stream("VolumeTierMoveDatFromRemote")(
@@ -235,9 +249,17 @@ class VolumeServer(EcHandlers):
         self._grpc_server = await serve(grpc_address(self.address), svc)
 
         self._heartbeat_task = asyncio.ensure_future(self._heartbeat_loop())
+        if self.scrub_mbps > 0:
+            self._scrub_task = asyncio.ensure_future(self._scrub_loop())
 
     async def stop(self) -> None:
         self._shutdown = True
+        if self._scrub_task is not None:
+            self._scrub_task.cancel()
+            try:
+                await self._scrub_task
+            except (asyncio.CancelledError, Exception):
+                pass
         if self.lookup_gate is not None:
             self.lookup_gate.close()
         for gc in self._group_committers.values():
@@ -334,6 +356,11 @@ class VolumeServer(EcHandlers):
                 if tick % 17 == 0:
                     # periodic full EC state (ref :121 — EC tick = 17 x pulse)
                     hb.update(self.store.collect_ec_heartbeat())
+                if tick % 5 == 0:
+                    # anti-entropy tick: slim digest/frontier refresh so the
+                    # master compares CURRENT replica digests, not the ones
+                    # frozen at stream connect (our extension)
+                    hb["volume_digests"] = self.store.collect_volume_digests()
                 await call.write(hb)
         finally:
             reader_task.cancel()
@@ -1575,6 +1602,33 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
             "last_append_at_ns": v.last_append_at_ns,
         }
 
+    async def _pull_volume_files(
+        self, vid: int, collection: str, source: str, base: str
+    ) -> None:
+        """Stream .dat/.idx/.vif from a source server into base.* (atomic
+        per-file via .tmp+rename); shared by VolumeCopy and the repair
+        re-copy path."""
+        stub = Stub(grpc_address(source), "volume")
+        for ext in (".dat", ".idx", ".vif"):
+            tmp = base + ext + ".tmp"
+            got_any = False
+            with open(tmp, "wb") as f:
+                async for msg in stub.server_stream(
+                    "CopyFile",
+                    {"volume_id": vid, "collection": collection, "ext": ext},
+                    timeout=3600,
+                ):
+                    if msg.get("error"):
+                        if ext == ".vif":
+                            break
+                        raise IOError(msg["error"])
+                    f.write(msg.get("file_content", b""))
+                    got_any = True
+            if got_any or ext != ".vif":
+                os.replace(tmp, base + ext)
+            else:
+                os.remove(tmp)
+
     async def _grpc_volume_copy(self, req, context) -> dict:
         """Pull a whole volume (.dat/.idx/.vif) from a source server and
         mount it (ref volume_grpc_copy.go:23-116)."""
@@ -1590,31 +1644,182 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
         from ..storage.volume import volume_base_name
 
         base = volume_base_name(loc.directory, collection, vid)
-        stub = Stub(grpc_address(source), "volume")
         try:
-            for ext in (".dat", ".idx", ".vif"):
-                tmp = base + ext + ".tmp"
-                got_any = False
-                with open(tmp, "wb") as f:
-                    async for msg in stub.server_stream(
-                        "CopyFile",
-                        {"volume_id": vid, "collection": collection, "ext": ext},
-                        timeout=3600,
-                    ):
-                        if msg.get("error"):
-                            if ext == ".vif":
-                                break
-                            raise IOError(msg["error"])
-                        f.write(msg.get("file_content", b""))
-                        got_any = True
-                if got_any or ext != ".vif":
-                    os.replace(tmp, base + ext)
-                else:
-                    os.remove(tmp)
+            await self._pull_volume_files(vid, collection, source, base)
             self.store.mount_volume(vid)
             return {}
         except Exception as e:
             return {"error": str(e)}
+
+    # ---------------- anti-entropy plane ----------------
+    @property
+    def scrubber(self):
+        if self._scrubber is None:
+            from ..storage.scrub import Scrubber
+
+            self._scrubber = Scrubber(
+                self.store,
+                rate_mbps=self.scrub_mbps,
+                codec_for=self.codec_for,
+            )
+        return self._scrubber
+
+    async def _scrub_loop(self) -> None:
+        """Background scrub: one rate-shaped pass per interval. The token
+        bucket bounds the I/O so verification coexists with serving load;
+        the per-volume cursor makes restarts resume, not restart."""
+        loop = asyncio.get_event_loop()
+        while not self._shutdown:
+            try:
+                await asyncio.sleep(self.scrub_interval_seconds)
+                if self._shutdown:
+                    return
+                await loop.run_in_executor(None, self.scrubber.run_pass)
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                # a broken volume must not kill the loop; findings (and
+                # quarantines) from the partial pass already counted
+                continue
+
+    async def _grpc_volume_scrub(self, req, context) -> dict:
+        """Forced scrub pass (shell `volume.scrub` / tests): walk the
+        requested volume (or everything local), verify CRCs, extents and
+        EC parity, apply the quarantine policy, return the full report."""
+        volume_id = int(req.get("volume_id", 0) or 0)
+        include_ec = bool(req.get("include_ec", True))
+        scrubber = self.scrubber
+        rate = req.get("rate_mbps")
+        if rate:
+            from ..storage.scrub import Scrubber
+
+            scrubber = Scrubber(
+                self.store, rate_mbps=float(rate), codec_for=self.codec_for
+            )
+        loop = asyncio.get_event_loop()
+        try:
+            report = await loop.run_in_executor(
+                None,
+                lambda: scrubber.run_pass(
+                    volume_id=volume_id or None, include_ec=include_ec
+                ),
+            )
+            return report
+        except Exception as e:
+            return {"error": str(e)}
+
+    async def _grpc_volume_tail_sync(self, req, context) -> dict:
+        """Catch-up resync of a stale replica: pull every record appended
+        on the source after our local frontier through the incremental
+        tail path (volume_backup.py) and replay it into the local volume.
+        Dispatched by the master when replica digests diverge and our
+        append frontier trails."""
+        from ..storage.volume_backup import apply_incremental
+        from ..util.metrics import ANTIENTROPY_RESYNCS
+
+        vid = int(req["volume_id"])
+        source = req["source_data_node"]
+        v = self.store.find_volume(vid)
+        if v is None:
+            return {"error": f"volume {vid} not found"}
+        since_ns = v.last_append_at_ns
+        stub = Stub(grpc_address(source), "volume")
+        chunks = []
+        async for msg in stub.server_stream(
+            "VolumeIncrementalCopy",
+            {"volume_id": vid, "since_ns": since_ns},
+            timeout=3600,
+        ):
+            if msg.get("error"):
+                return {"error": msg["error"]}
+            chunks.append(msg.get("file_content", b""))
+        data = b"".join(chunks)
+        if not data:
+            return {"applied_records": 0, "applied_bytes": 0}
+        loop = asyncio.get_event_loop()
+        old_msg = self.store._volume_message(v)
+        try:
+            applied = await loop.run_in_executor(
+                None, apply_incremental, v, data
+            )
+        except Exception as e:
+            return {"error": f"apply incremental: {e}"}
+        ANTIENTROPY_RESYNCS.inc(kind="tail_sync")
+        # the digest changed: let the master see the converged state on
+        # the next pulse instead of the next full reconnect
+        self.store.note_volume_changed(old_msg, self.store._volume_message(v))
+        return {"applied_records": applied, "applied_bytes": len(data)}
+
+    async def _grpc_volume_repair_copy(self, req, context) -> dict:
+        """Replace a scrub-quarantined replica with a fresh copy from a
+        healthy peer: quarantine the damaged files aside as `.bad` (never
+        deleted), pull .dat/.idx/.vif from the source, remount. The
+        master dispatches this when a volume heartbeats `scrub_corrupt`
+        while a clean replica exists."""
+        from ..util.metrics import ANTIENTROPY_RESYNCS
+
+        vid = int(req["volume_id"])
+        collection = req.get("collection", "")
+        source = req["source_data_node"]
+        v = self.store.find_volume(vid)
+        if v is None:
+            return {"error": f"volume {vid} not found"}
+        if not v.scrub_corrupt and not req.get("force"):
+            # idempotent skip: the master may re-dispatch while the healed
+            # state is still riding a heartbeat back to it
+            return {"repaired": False, "skipped": "not quarantined"}
+        base = v.file_name()
+        target_loc = None
+        for loc in self.store.locations:
+            if loc.find_volume(vid) is not None:
+                target_loc = loc
+                break
+        old_msg = self.store._volume_message(v)
+        # a group committer pinned to the old volume object would fsync a
+        # closed fd after the swap — retire it first
+        gc = self._group_committers.pop(vid, None)
+        if gc is not None:
+            await gc.stop()
+        # unmount WITHOUT a deleted-delta: repair is an in-place swap, the
+        # note_volume_changed below reports the healthy state
+        with target_loc._lock:
+            target_loc.volumes.pop(vid, None)
+        v.close()
+        for ext in (".dat", ".idx", ".vif"):
+            try:
+                os.replace(base + ext, base + ext + ".bad")
+            except FileNotFoundError:
+                pass
+        try:
+            await self._pull_volume_files(vid, collection, source, base)
+        except Exception as e:
+            # rollback: a transient copy failure must not convert a
+            # corrupt-but-present replica into a missing one — put the
+            # quarantined files back, remount, re-flag, retry later
+            for ext in (".dat", ".idx", ".vif"):
+                for leftover in (base + ext + ".tmp", base + ext):
+                    try:
+                        os.remove(leftover)  # partial pull artifacts
+                    except FileNotFoundError:
+                        pass
+                try:
+                    os.replace(base + ext + ".bad", base + ext)
+                except FileNotFoundError:
+                    pass
+            target_loc.load_existing_volumes()
+            restored = self.store.find_volume(vid)
+            if restored is not None:
+                restored.quarantine("restored after failed repair pull")
+            return {"error": f"pull from {source}: {e}"}
+        target_loc.load_existing_volumes()
+        new_v = self.store.find_volume(vid)
+        if new_v is None:
+            return {"error": f"volume {vid} did not remount after repair"}
+        ANTIENTROPY_RESYNCS.inc(kind="recopy")
+        self.store.note_volume_changed(
+            old_msg, self.store._volume_message(new_v)
+        )
+        return {"repaired": True}
 
     async def _grpc_tier_to_remote(self, req, context):
         """Move a volume's .dat to a remote tier, streaming progress

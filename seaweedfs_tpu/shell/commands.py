@@ -809,6 +809,106 @@ async def cmd_ec_rebuild(env, argv) -> str:
     return "\n".join(results) or "no damaged ec volumes"
 
 
+@command("volume.scrub")
+async def cmd_volume_scrub(env, argv) -> str:
+    """Force a scrub pass: volume.scrub [-volumeId N] [-node host:port].
+    Every targeted server re-verifies needle CRCs, index extents and EC
+    parity (rate-shaped by its SEAWEEDFS_TPU_SCRUB_MBPS), applies the
+    quarantine policy, and reports findings (our extension; see
+    docs/robustness.md "Anti-entropy plane")."""
+    flags = _parse_flags(argv)
+    vid = int(flags.get("volumeId", 0) or 0)
+    node = flags.get("node", "")
+    lines = []
+    for dn in await env.collect_data_nodes():
+        if node and dn["url"] != node:
+            continue
+        if vid and not (
+            any(int(v["id"]) == vid for v in dn.get("volumes", []))
+            or any(int(m["id"]) == vid for m in dn.get("ec_shards", []))
+        ):
+            continue
+        try:
+            r = await env.volume_stub(dn["url"]).call(
+                "VolumeScrub",
+                {"volume_id": vid, "include_ec": True},
+                timeout=3600,
+            )
+        except Exception as e:
+            lines.append(f"{dn['url']}: scrub failed: {e}")
+            continue
+        if r.get("error"):
+            lines.append(f"{dn['url']}: scrub failed: {r['error']}")
+            continue
+        for vr in r.get("volumes", []):
+            lines.append(
+                f"{dn['url']} volume {vr['volume_id']}: "
+                f"{vr['scanned']} records / {vr['bytes']} bytes verified, "
+                f"{len(vr['corruptions'])} corruption(s)"
+                + ("" if vr.get("completed", True) else " (partial pass)")
+            )
+            for key, kind, detail in vr["corruptions"]:
+                lines.append(f"  CORRUPT key {int(key):#x}: {kind} ({detail})")
+        for er in r.get("ec_volumes", []):
+            if er.get("skipped"):
+                lines.append(
+                    f"{dn['url']} ec volume {er['volume_id']}: "
+                    f"skipped ({er['skipped']})"
+                )
+                continue
+            lines.append(
+                f"{dn['url']} ec volume {er['volume_id']}: "
+                f"{er['bytes']} bytes parity-verified, "
+                f"corrupt shards {er['corrupt_shards']}"
+            )
+        for q in r.get("quarantined", []):
+            what = (
+                f"shard {q['shard_id']}" if "shard_id" in q else "volume"
+            )
+            lines.append(
+                f"{dn['url']}: QUARANTINED {what} of volume "
+                f"{q['volume_id']} (repair scheduler will pick it up)"
+            )
+    return "\n".join(lines) or "nothing to scrub"
+
+
+@command("ec.repair.status")
+async def cmd_ec_repair_status(env, argv) -> str:
+    """Repair-plane status: ec.repair.status [-run]. Shows the master's
+    prioritized repair queue (fewest-survivors-first), silent nodes, and
+    recent dispatch outcomes; -run forces one scan+dispatch round."""
+    flags = _parse_flags(argv)
+    req = {"run": True} if "run" in flags else {}
+    r = await env.master_stub.call("RepairStatus", req, timeout=3600)
+    if r.get("error"):
+        return f"repair status failed: {r['error']}"
+    lines = [
+        f"auto_repair: {'on' if r.get('auto_repair') else 'off'} "
+        f"(grace {r.get('grace_seconds')}s) · "
+        f"queue depth: {r.get('queue_depth', 0)} · "
+        f"live nodes: {len(r.get('live_nodes', []))}"
+    ]
+    if r.get("silent_nodes"):
+        lines.append("silent nodes: " + ", ".join(r["silent_nodes"]))
+    for t in r.get("queue", []):
+        lines.append(
+            f"  queued {t['kind']} volume {t['volume_id']} "
+            f"(survivors {t['survivors']}, attempts {t['attempts']})"
+        )
+    for t in r.get("recent", []):
+        outcome = (
+            f"ERROR: {t['error']}" if t.get("error") else "repaired"
+        )
+        lines.append(f"  recent {t['kind']} volume {t['volume_id']}: {outcome}")
+    if "ran" in r:
+        ran = r["ran"]
+        lines.append(
+            f"ran one round: dispatched {len(ran.get('dispatched', []))}, "
+            f"queue depth now {ran.get('queue_depth', 0)}"
+        )
+    return "\n".join(lines)
+
+
 @command("ec.balance")
 async def cmd_ec_balance(env, argv) -> str:
     """Dedupe + rack-aware rebalancing of EC shards

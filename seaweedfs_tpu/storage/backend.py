@@ -51,8 +51,11 @@ class DiskFile:
         return self._path
 
     def read_at(self, size: int, offset: int) -> bytes:
+        flip = None
         if faults._PLAN is not None:
-            faults.sync_fault(faults._PLAN, "read_at", self._path)
+            flip = faults.sync_fault(
+                faults._PLAN, "read_at", self._path, corruptable=True
+            )
         chunks = []
         remaining, pos = size, offset
         while remaining > 0:
@@ -62,7 +65,12 @@ class DiskFile:
             chunks.append(b)
             remaining -= len(b)
             pos += len(b)
-        return b"".join(chunks)
+        out = b"".join(chunks)
+        if flip is not None and flip.kind == "bitflip":
+            # transient read-side corruption (bad cable / lying controller):
+            # the bytes on disk stay intact, this read sees flipped bits
+            out = faults.apply_bitflip(flip, out, offset)
+        return out
 
     def write_at(self, data: bytes, offset: int) -> int:
         if faults._PLAN is not None:
@@ -82,9 +90,16 @@ class DiskFile:
         by sync_fault; torn/crash writes are applied here: the kept prefix
         is persisted and the fault raised, leaving a short record on disk
         exactly as an interrupted pwrite chain would."""
-        ev = faults.sync_fault(plan, "write_at", self._path, allow_partial=True)
+        ev = faults.sync_fault(
+            plan, "write_at", self._path, allow_partial=True, corruptable=True
+        )
         if ev is None:
             return data
+        if ev.kind == "bitflip":
+            # silent write-path corruption: the flipped bytes are what
+            # lands on disk (and what any verify-after-write would see) —
+            # the canonical seed for scrub-detection tests
+            return faults.apply_bitflip(ev, data, offset)
         if ev.kind in ("torn", "crash"):
             rule = ev.rule
             if rule.at_offset is not None:

@@ -171,7 +171,33 @@ class Store:
             "ttl": v.super_block.ttl.to_u32(),
             "compact_revision": v.super_block.compaction_revision,
             "modified_at_second": int(v.last_modified_ts_seconds),
+            # anti-entropy fields: order-independent live-content digest +
+            # append frontier let the master spot diverged/stale replicas
+            # from heartbeats alone; scrub_corrupt marks a quarantined copy
+            "content_digest": v.content_digest(),
+            "append_at_ns": v.last_append_at_ns,
+            "scrub_corrupt": v.scrub_corrupt,
         }
+
+    def collect_volume_digests(self) -> list[dict]:
+        """Lightweight per-pulse digest refresh: full volume messages only
+        travel at stream connect and on add/remove deltas, so steady-state
+        writes would leave the master comparing stale digests. This slim
+        message (id + digest + frontier + corrupt flag) rides every few
+        heartbeat ticks instead."""
+        out = []
+        for loc in self.locations:
+            for v in list(loc.volumes.values()):
+                out.append(
+                    {
+                        "id": v.id,
+                        "content_digest": v.content_digest(),
+                        "append_at_ns": v.last_append_at_ns,
+                        "read_only": v.is_read_only(),
+                        "scrub_corrupt": v.scrub_corrupt,
+                    }
+                )
+        return out
 
     def collect_heartbeat(self) -> dict:
         volume_messages = []

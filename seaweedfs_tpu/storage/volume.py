@@ -282,6 +282,26 @@ def recover_torn_tail(
     return stats
 
 
+def digest_fold(keys, sizes) -> int:
+    """XOR-fold of splitmix64-mixed (key, size) terms over live index
+    columns — the commutative content digest replicas compare. Pure
+    integer arithmetic (never Python hash(): that is salted per process,
+    and replicas live in different processes)."""
+    import numpy as np
+
+    if len(keys) == 0:
+        return 0
+    x = np.asarray(keys, dtype=np.uint64) ^ (
+        np.asarray(sizes, dtype=np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+    )
+    x ^= x >> np.uint64(30)
+    x *= np.uint64(0xBF58476D1CE4E5B9)
+    x ^= x >> np.uint64(27)
+    x *= np.uint64(0x94D049BB133111EB)
+    x ^= x >> np.uint64(31)
+    return int(np.bitwise_xor.reduce(x))
+
+
 class Volume:
     def __init__(
         self,
@@ -303,6 +323,10 @@ class Volume:
         self.last_compact_index_offset = 0
         self.last_compact_revision = 0
         self._lock = threading.RLock()
+        # anti-entropy state: memoized content digest (keyed by the needle
+        # map's mutation token) + the scrub quarantine flag heartbeats carry
+        self._digest_cache: Optional[tuple] = None
+        self.scrub_corrupt = False
         # device-resident index snapshot for bulk probes, keyed by the
         # map's mutation token (see bulk_lookup)
         from ..ops.snapshot_cache import SnapshotCache
@@ -497,6 +521,41 @@ class Volume:
 
     def is_read_only(self) -> bool:
         return self.no_write_or_delete
+
+    def content_digest(self) -> int:
+        """Order-independent 64-bit digest of the LIVE content set — the
+        XOR-fold of a mixed (key, size) term per non-deleted needle. Two
+        replicas holding the same needles report the same digest no matter
+        how their appends interleaved on disk, so the master can compare
+        digests straight off heartbeats to catch diverged/stale replicas
+        (the anti-entropy plane's cheap invariant). Memoized on the needle
+        map's mutation token: steady state costs a token compare."""
+        with self._lock:
+            try:
+                token = self.nm.snapshot_token()
+            except Exception:
+                token = None
+            cached = self._digest_cache
+            if token is not None and cached is not None and cached[0] == token:
+                return cached[1]
+            try:
+                keys, _offsets, sizes = self.nm.snapshot()
+            except Exception:
+                return 0
+            d = digest_fold(keys, sizes)
+            if token is not None:
+                self._digest_cache = (token, d)
+            return d
+
+    def quarantine(self, reason: str) -> None:
+        """Scrub found latent damage: freeze writes and flag the volume for
+        the master's repair scheduler. NEVER deletes anything — the
+        evidence stays on disk for repair/forensics."""
+        from ..util.log import warning
+
+        self.no_write_or_delete = True
+        self.scrub_corrupt = True
+        warning("volume %d quarantined: %s", self.id, reason)
 
     def garbage_level(self) -> float:
         """Ref: volume_vacuum.go:20-34."""
@@ -713,7 +772,7 @@ class Volume:
         """Remove all files (ref: volume_read_write.go:44-65)."""
         self.close()
         base = self.file_name()
-        for ext in (".dat", ".idx", ".vif", ".sdx", ".cpd", ".cpx"):
+        for ext in (".dat", ".idx", ".vif", ".sdx", ".cpd", ".cpx", ".scrub"):
             try:
                 os.remove(base + ext)
             except FileNotFoundError:

@@ -55,8 +55,14 @@ class EcShardLocations:
         # 32 slots (the ShardBits width) so alternate geometries with more
         # than 14 shards (e.g. 12.4) register cleanly
         self.locations: list[list[DataNode]] = [[] for _ in range(32)]
+        # highest shard id ever registered + 1: the repair scheduler's
+        # expectation of how many shards this volume SHOULD have, so a
+        # shard whose every holder died still counts as missing
+        self.expected_total = 0
 
     def add_shard(self, shard_id: int, dn: DataNode) -> bool:
+        if shard_id + 1 > self.expected_total:
+            self.expected_total = shard_id + 1
         if dn in self.locations[shard_id]:
             return False
         self.locations[shard_id].append(dn)
@@ -188,6 +194,70 @@ class Topology(Node):
 
     def data_nodes(self) -> list[DataNode]:
         return list(self.descend_data_nodes())
+
+    # --- anti-entropy state snapshots (consumed by topology/repair.py) ---
+    def live_data_nodes(self, grace_seconds: float) -> list[DataNode]:
+        """Nodes whose heartbeats are fresh. A node silent past the grace
+        period stops counting as a holder — the heartbeat-driven failure
+        detector feeding the repair scheduler (a broken stream already
+        unregisters the node; this also catches a hung one that keeps the
+        stream open without pulsing)."""
+        import time as _time
+
+        now = _time.time()
+        return [
+            dn
+            for dn in self.data_nodes()
+            if now - dn.last_seen <= grace_seconds
+        ]
+
+    def ec_states(self, live_urls: Optional[set] = None) -> list[dict]:
+        """Per-EC-volume holder map restricted to live nodes, in the shape
+        `repair.plan_ec_repairs` consumes."""
+        out = []
+        with self._ec_lock:
+            for (collection, vid), locs in self.ec_shard_map.items():
+                if locs.expected_total == 0:
+                    continue
+                holders: Dict[int, list[str]] = {}
+                for sid in range(locs.expected_total):
+                    urls = [
+                        dn.url
+                        for dn in locs.locations[sid]
+                        if live_urls is None or dn.url in live_urls
+                    ]
+                    if urls:
+                        holders[sid] = urls
+                out.append(
+                    {
+                        "vid": vid,
+                        "collection": collection,
+                        "total_shards": locs.expected_total,
+                        "holders": holders,
+                    }
+                )
+        return out
+
+    def replica_states(self, live_urls: Optional[set] = None) -> dict:
+        """{vid: [per-live-replica digest/frontier/corrupt records]} for
+        `repair.plan_replica_repairs`, read straight off the volume infos
+        heartbeats delivered."""
+        states: Dict[int, list[dict]] = {}
+        for dn in self.data_nodes():
+            if live_urls is not None and dn.url not in live_urls:
+                continue
+            for vid, info in list(dn.volumes.items()):
+                states.setdefault(int(vid), []).append(
+                    {
+                        "url": dn.url,
+                        "collection": info.get("collection", ""),
+                        "content_digest": int(info.get("content_digest", 0)),
+                        "append_at_ns": int(info.get("append_at_ns", 0)),
+                        "scrub_corrupt": bool(info.get("scrub_corrupt")),
+                        "read_only": bool(info.get("read_only")),
+                    }
+                )
+        return states
 
     def to_info(self) -> dict:
         return {

@@ -14,7 +14,11 @@ commands via their -config flag.
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib  # Python >= 3.11
+except ImportError:  # pragma: no cover - environment-dependent
+    import tomli as tomllib  # same API, the backport package
 from typing import Any, Optional
 
 SEARCH_PATHS = [".", os.path.expanduser("~/.seaweedfs-tpu"), "/etc/seaweedfs-tpu"]

@@ -77,14 +77,16 @@ class FaultRule:
 
     op: str
     target: str = "*"
-    fault: str = "eio"  # eio|torn|crash|latency|reset|hang|http_error
+    fault: str = "eio"  # eio|torn|crash|latency|reset|hang|http_error|bitflip
     nth: Optional[int] = None
     probability: Optional[float] = None
     times: Optional[int] = None
     delay: float = 0.0  # seconds, for latency/hang (hang: until deadline)
     keep: Optional[int] = None  # bytes written before a torn/crash write
-    at_offset: Optional[int] = None  # absolute file offset the crash cuts at
+    at_offset: Optional[int] = None  # absolute file offset (crash cut point,
+    # or the byte a bitflip corrupts)
     status: int = 503  # synthesized status for http_error
+    bits: int = 1  # bits flipped by a bitflip fault
 
     def max_fires(self) -> Optional[int]:
         if self.times is not None:
@@ -190,6 +192,8 @@ class FaultPlan:
                 rd["delay"] = r.delay
             if r.fault == "http_error":
                 rd["status"] = r.status
+            if r.fault == "bitflip" and r.bits != 1:
+                rd["bits"] = r.bits
             out["rules"].append(rd)
         return out
 
@@ -234,13 +238,16 @@ _load_env_plan()
 
 
 def sync_fault(
-    plan: FaultPlan, op: str, target: str, allow_partial: bool = False
+    plan: FaultPlan, op: str, target: str, allow_partial: bool = False,
+    corruptable: bool = False,
 ) -> Optional[FaultEvent]:
     """Blocking-code seam (disk I/O): applies latency/EIO in place. With
     allow_partial (the write seam), torn/crash events are RETURNED for the
-    caller to apply as a partial write; on every other seam a fired event
-    must never be a counted no-op, so crash kills the plan here and torn
-    degrades to EIO."""
+    caller to apply as a partial write; with corruptable (the read/write
+    data seams), bitflip events are RETURNED for the caller to apply to
+    the buffer via apply_bitflip. On every other seam a fired event must
+    never be a counted no-op, so crash kills the plan here and torn /
+    bitflip degrade to EIO."""
     ev = plan.match(op, target)
     if ev is None:
         return None
@@ -250,12 +257,44 @@ def sync_fault(
         return None
     if kind in ("eio", "fsync_fail"):
         raise injected_eio(target)
+    if kind == "bitflip":
+        if corruptable:
+            return ev
+        raise injected_eio(target)
     if not allow_partial:
         if kind == "crash":
             plan.mark_dead()
             raise SimulatedCrash(f"crash in {op} of {target}")
         raise injected_eio(target)
     return ev
+
+
+def apply_bitflip(ev: FaultEvent, data, file_offset: int = 0) -> bytes:
+    """Silent data corruption: flip `rule.bits` bits of `data` (the buffer
+    read from / about to be written at `file_offset`). The victim byte is
+    `rule.at_offset - file_offset` when the rule pins an absolute file
+    offset, else drawn from the rule's seeded RNG — deterministic per plan
+    seed either way. A pinned offset that misses this buffer falls back to
+    the seeded-random position: the firing was already counted, and a
+    counted fault must never be a no-op (the PR 1 invariant). Models bit
+    rot / a lying disk: no error surfaces, only wrong bytes."""
+    buf = bytearray(data)
+    if not buf:
+        return bytes(buf)
+    rule = ev.rule
+    pos = None
+    if rule.at_offset is not None:
+        pos = rule.at_offset - file_offset
+        if not 0 <= pos < len(buf):
+            pos = None
+    if pos is None:
+        pos = ev.rng.randrange(len(buf))
+    # flip N consecutive bit positions: distinct bits, so flips never cancel
+    bitpos = pos * 8 + ev.rng.randrange(8)
+    for i in range(max(1, rule.bits)):
+        p = (bitpos + i) % (len(buf) * 8)
+        buf[p // 8] ^= 1 << (p % 8)
+    return bytes(buf)
 
 
 async def async_fault(

@@ -239,3 +239,43 @@ EC_DECODE_MATRIX_CACHE = REGISTRY.counter(
     "seaweedfs_tpu_ec_decode_matrix_cache_total",
     "decode-matrix LRU lookups, by outcome (hit/miss)",
 )
+
+# anti-entropy plane (see docs/robustness.md "Anti-entropy plane"): the
+# background scrub proves stored bytes still verify, replica digests catch
+# diverged/stale copies, and the master's repair scheduler turns both into
+# rebuilds/resyncs — each stage observable so a chaos run can assert the
+# loop closed (corruption found -> repaired -> re-scrub clean)
+SCRUB_BYTES = REGISTRY.counter(
+    "seaweedfs_tpu_scrub_bytes_total",
+    "bytes read and verified by the scrubber, by kind (dat/idx/ec)",
+)
+SCRUB_CORRUPTIONS = REGISTRY.counter(
+    "seaweedfs_tpu_scrub_corruptions_found_total",
+    "latent damage found by scrub, by kind (needle_crc/needle_id/"
+    "idx_extent/ec_data/ec_parity/ec_shard_size/ec_unidentified)",
+)
+SCRUB_PASSES = REGISTRY.counter(
+    "seaweedfs_tpu_scrub_passes_total",
+    "completed scrub passes, by plane (volume/ec)",
+)
+ANTIENTROPY_RESYNCS = REGISTRY.counter(
+    "seaweedfs_tpu_antientropy_resyncs_total",
+    "replica repairs dispatched by digest/scrub anti-entropy, by kind "
+    "(tail_sync = catch-up append replay, recopy = full re-pull of a "
+    "quarantined replica)",
+)
+REPAIR_QUEUE_DEPTH = REGISTRY.gauge(
+    "seaweedfs_tpu_repair_queue_depth",
+    "repair tasks currently queued on the master (fewest-survivors-first)",
+)
+ANTIENTROPY_DIVERGED = REGISTRY.gauge(
+    "seaweedfs_tpu_antientropy_diverged_volumes",
+    "volumes whose healthy replicas disagree on content digest with EQUAL "
+    "append frontiers — divergence the tail path cannot fix (operator "
+    "action: volume.fsck / re-replicate); refreshed every scheduler scan",
+)
+REPAIR_SECONDS = REGISTRY.histogram(
+    "seaweedfs_tpu_repair_seconds",
+    "wall seconds per dispatched repair, by kind (ec_rebuild/replica_"
+    "recopy/tail_sync) and result (ok/error)",
+)

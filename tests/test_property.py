@@ -11,6 +11,8 @@ import os
 
 import numpy as np
 import pytest
+
+pytest.importorskip("hypothesis")  # missing dep must skip, not error collection
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

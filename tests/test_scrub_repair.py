@@ -1,0 +1,855 @@
+"""Anti-entropy plane (ISSUE 4): background scrub, replica digest sync,
+heartbeat-driven auto-repair.
+
+Layers of coverage, all tier-1:
+
+- bitflip fault mechanics: determinism per seed, pinned-offset flips,
+  read-seam transience vs write-seam persistence;
+- scrub: clean pass counters (the tier-1 metrics guard), detection +
+  quarantine of a bitflipped needle, token-bucket rate bounding, the
+  persisted resume cursor;
+- EC parity verification: recompute-and-compare finds the damaged shard
+  (data or parity) and the batched rebuild path restores byte-identical
+  content — seed corruption -> scrub finds it -> repair -> re-scrub clean;
+- replica digests: equal iff live contents equal (seeded interleaved
+  append/delete property), tail_sync convergence for a stale replica;
+- repair scheduler units: fewest-survivors-first ordering, dedupe that
+  keeps retry state, full-jitter backoff on injected failure;
+- cluster end-to-end: corrupt needle (replica) + corrupt EC shard, forced
+  scrub detects both, the master scheduler repairs both through
+  VolumeRepairCopy / VolumeEcShardsRebuildBatch, the queue drains to 0,
+  and a second scrub comes back clean.
+"""
+
+import asyncio
+import os
+import random
+import time
+
+import pytest
+
+from seaweedfs_tpu.storage import scrub as scrub_mod
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.scrub import Scrubber, TokenBucket
+from seaweedfs_tpu.storage.volume import Volume, digest_fold
+from seaweedfs_tpu.topology.repair import (
+    RepairQueue,
+    RepairTask,
+    plan_ec_repairs,
+    plan_replica_repairs,
+)
+from seaweedfs_tpu.types import NEEDLE_HEADER_SIZE
+from seaweedfs_tpu.util import faults
+from seaweedfs_tpu.util.backoff import BackoffPolicy
+from seaweedfs_tpu.util.faults import FaultPlan, FaultRule
+from seaweedfs_tpu.util.metrics import (
+    ANTIENTROPY_RESYNCS,
+    REPAIR_QUEUE_DEPTH,
+    SCRUB_BYTES,
+    SCRUB_CORRUPTIONS,
+    SCRUB_PASSES,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+
+
+def counter_value(metric, **labels) -> float:
+    key = tuple(sorted(labels.items()))
+    with metric._lock:
+        return metric._values.get(key, 0.0)
+
+
+def gauge_value(metric, **labels) -> float:
+    return counter_value(metric, **labels)
+
+
+# ------------------------------------------------------------- bitflip --
+
+
+def test_bitflip_write_seam_is_deterministic_and_persistent(tmp_path):
+    """Same plan seed -> same corrupted bytes on disk; the flip lands
+    silently (no error) and differs from the intended payload."""
+
+    from seaweedfs_tpu.storage.backend import DiskFile
+
+    def run(sub: str, seed: int, flip: bool = True) -> bytes:
+        p = str(tmp_path / f"{sub}.bin")
+        df = DiskFile(p)
+        if flip:
+            faults.install_plan(FaultPlan(seed=seed, rules=[
+                FaultRule(op="write_at", target="*.bin", nth=1,
+                          fault="bitflip", bits=3),
+            ]))
+        df.write_at(b"\x5a" * 256, 0)
+        faults.clear_plan()
+        df.close()
+        with open(p, "rb") as f:
+            return f.read()
+
+    a = run("a", 5)
+    b = run("b", 5)
+    c = run("c", 6)
+    clean = run("d", 0, flip=False)
+    assert a != clean  # the flip really corrupted the stored bytes
+    assert a == b  # deterministic per seed
+    assert a != c  # and the seed matters
+
+
+def test_bitflip_read_seam_is_transient(tmp_path):
+    """A read-seam bitflip corrupts THAT read only — the bytes on disk
+    stay intact (lying controller, not rotted media)."""
+    from seaweedfs_tpu.storage.backend import DiskFile
+
+    p = str(tmp_path / "t.bin")
+    df = DiskFile(p)
+    df.write_at(b"A" * 32, 0)
+    faults.install_plan(FaultPlan(seed=1, rules=[
+        FaultRule(op="read_at", target="*t.bin", nth=1, fault="bitflip"),
+    ]))
+    corrupted = df.read_at(32, 0)
+    faults.clear_plan()
+    assert corrupted != b"A" * 32
+    assert df.read_at(32, 0) == b"A" * 32  # disk intact
+    df.close()
+
+
+def test_bitflip_at_offset_pins_the_victim_byte(tmp_path):
+    from seaweedfs_tpu.storage.backend import DiskFile
+
+    p = str(tmp_path / "o.bin")
+    df = DiskFile(p)
+    faults.install_plan(FaultPlan(seed=2, rules=[
+        FaultRule(op="write_at", target="*o.bin", nth=1,
+                  fault="bitflip", at_offset=10),
+    ]))
+    df.write_at(b"\x00" * 32, 0)
+    faults.clear_plan()
+    got = df.read_at(32, 0)
+    flipped = [i for i, x in enumerate(got) if x != 0]
+    assert flipped == [10]
+    df.close()
+
+
+def test_bitflip_pinned_offset_outside_window_still_corrupts(tmp_path):
+    """A counted fault must never be a no-op (the PR 1 invariant): a
+    pinned at_offset that misses the I/O buffer falls back to a
+    seeded-random victim byte instead of silently spending the rule."""
+    from seaweedfs_tpu.storage.backend import DiskFile
+
+    p = str(tmp_path / "w.bin")
+    df = DiskFile(p)
+    faults.install_plan(FaultPlan(seed=4, rules=[
+        FaultRule(op="write_at", target="*w.bin", nth=1,
+                  fault="bitflip", at_offset=10_000),  # way past the buffer
+    ]))
+    df.write_at(b"\x00" * 64, 0)
+    plan = faults.current_plan()
+    assert plan.fired() == 1
+    faults.clear_plan()
+    assert df.read_at(64, 0) != b"\x00" * 64  # corruption still landed
+    df.close()
+
+
+# ---------------------------------------------------------------- scrub --
+
+
+def _fill(v: Volume, n: int = 8, size: int = 500) -> dict:
+    data = {}
+    for i in range(1, n + 1):
+        payload = bytes([i % 251]) * size
+        v.write_needle(Needle(cookie=i, id=i, data=payload))
+        data[i] = payload
+    return data
+
+
+def test_scrub_clean_pass_emits_metrics(tmp_path):
+    """Tier-1 guard: a forced scrub pass moves scrub_bytes_total and
+    scrub_passes_total, finds nothing on a healthy volume, and leaves it
+    writable."""
+    v = Volume(str(tmp_path), "", 1)
+    _fill(v)
+    bytes_before = counter_value(SCRUB_BYTES, kind="dat")
+    passes_before = counter_value(SCRUB_PASSES, plane="volume")
+    r = scrub_mod.scrub_volume(v)
+    assert r["scanned"] == 8 and r["corruptions"] == [] and r["completed"]
+    assert counter_value(SCRUB_BYTES, kind="dat") > bytes_before
+    assert counter_value(SCRUB_PASSES, plane="volume") == passes_before + 1
+    assert not v.is_read_only()
+    v.close()
+
+
+def test_scrub_detects_bitflipped_needle_and_quarantines(tmp_path):
+    """Seed corruption with the bitflip plan -> scrub finds it (typed
+    counter moves), the volume quarantines read-only, and nothing is
+    deleted (evidence intact)."""
+    v = Volume(str(tmp_path), "", 1)
+    _fill(v, n=5)
+    # flip 3 bits inside the data region of the NEXT record
+    at = v.data_file_size() + NEEDLE_HEADER_SIZE + 7
+    faults.install_plan(FaultPlan(seed=11, rules=[
+        FaultRule(op="write_at", target="*.dat", nth=1,
+                  fault="bitflip", at_offset=at, bits=3),
+    ]))
+    v.write_needle(Needle(cookie=9, id=9, data=b"victim" * 50))
+    faults.clear_plan()
+    size_before = v.data_file_size()
+    crc_before = counter_value(SCRUB_CORRUPTIONS, kind="needle_crc")
+    r = scrub_mod.scrub_volume(v)
+    kinds = [k for _key, k, _d in r["corruptions"]]
+    assert kinds == ["needle_crc"], r["corruptions"]
+    assert counter_value(SCRUB_CORRUPTIONS, kind="needle_crc") == crc_before + 1
+    assert v.is_read_only() and v.scrub_corrupt
+    assert v.data_file_size() == size_before  # never auto-delete
+    # the healthy records still verify in the same report
+    assert r["scanned"] == 6
+    v.close()
+
+
+def test_scrub_resume_cursor_survives_restart(tmp_path):
+    """A timesliced pass persists its cursor; a RELOADED volume continues
+    where the previous process left off instead of restarting."""
+    v = Volume(str(tmp_path), "", 1)
+    _fill(v, n=10)
+    r1 = scrub_mod.scrub_volume(v, max_entries=4)
+    assert not r1["completed"] and r1["scanned"] == 4
+    v.close()
+
+    v2 = Volume(str(tmp_path), "", 1, create=False)
+    r2 = scrub_mod.scrub_volume(v2, max_entries=100)
+    assert r2["completed"] and r2["scanned"] == 6  # the remaining entries
+    cur = scrub_mod.load_cursor(v2.file_name())
+    assert cur["passes"] == 1 and cur["resume_key"] == 0
+    v2.close()
+
+
+def test_scrub_rate_is_bounded_by_token_bucket(tmp_path):
+    """The acceptance bound: scrub I/O throughput stays under the
+    configured byte/s rate (beyond the one-burst allowance)."""
+    v = Volume(str(tmp_path), "", 1)
+    _fill(v, n=12, size=20_000)  # ~240KB of payload
+    total = sum(
+        scrub_mod.get_actual_size(20_000, v.version) for _ in range(12)
+    )
+    rate = 400_000.0  # bytes/s
+    bucket = TokenBucket(rate, capacity=50_000)
+    t0 = time.monotonic()
+    r = scrub_mod.scrub_volume(v, bucket=bucket)
+    elapsed = time.monotonic() - t0
+    assert r["scanned"] == 12 and r["completed"]
+    floor = (total - 50_000) / rate
+    assert elapsed >= floor * 0.75, (elapsed, floor)
+    v.close()
+
+
+# ---------------------------------------------------------- EC parity --
+
+
+def _make_ec(tmp_path, vid=2, n=30):
+    from seaweedfs_tpu.storage.erasure_coding import write_ec_files
+
+    from seaweedfs_tpu.tpu.coder import get_codec
+
+    v = Volume(str(tmp_path), "", vid)
+    for i in range(1, n):
+        v.write_needle(Needle(cookie=i, id=i, data=bytes([i % 250]) * 777))
+    v.close()
+    base = os.path.join(str(tmp_path), str(vid))
+    codec = get_codec("cpu")
+    write_ec_files(base, codec=codec)
+    return base, codec
+
+
+def _flip_byte(path: str, offset: int, mask: int = 0x40) -> None:
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ mask]))
+
+
+def test_ec_scrub_identifies_data_and_parity_corruption(tmp_path):
+    from seaweedfs_tpu.storage.erasure_coding import to_ext
+
+    base, codec = _make_ec(tmp_path)
+    clean = scrub_mod.scrub_ec_volume(base, codec)
+    assert clean["corrupt_shards"] == [] and clean["bytes"] > 0
+
+    _flip_byte(base + to_ext(3), 1234)  # data shard
+    r = scrub_mod.scrub_ec_volume(base, codec)
+    assert r["corrupt_shards"] == [3] and not r["unidentified"]
+    _flip_byte(base + to_ext(3), 1234)  # restore
+
+    _flip_byte(base + to_ext(12), 777)  # parity shard
+    r = scrub_mod.scrub_ec_volume(base, codec)
+    assert r["corrupt_shards"] == [12] and not r["unidentified"]
+
+
+def test_ec_seed_scrub_repair_rescrub_loop(tmp_path):
+    """The local self-healing proof: seeded corruption -> scrub finds the
+    shard -> quarantine (.bad, evidence intact) -> the batched rebuild
+    path restores BYTE-IDENTICAL content -> re-scrub reports clean."""
+    from seaweedfs_tpu.storage.erasure_coding import (
+        rebuild_ec_files_multi,
+        to_ext,
+    )
+
+    base, codec = _make_ec(tmp_path)
+    victim = base + to_ext(5)
+    with open(victim, "rb") as f:
+        pristine = f.read()
+    rng = random.Random(0xBAD5EED)
+    _flip_byte(victim, rng.randrange(len(pristine)))
+
+    par_before = counter_value(SCRUB_CORRUPTIONS, kind="ec_data")
+    r = scrub_mod.scrub_ec_volume(base, codec)
+    assert r["corrupt_shards"] == [5]
+    assert counter_value(SCRUB_CORRUPTIONS, kind="ec_data") > par_before
+
+    # quarantine: move aside (never delete), then the batched rebuild
+    os.replace(victim, victim + ".bad")
+    rebuild_ec_files_multi([base], codec=codec)
+    with open(victim, "rb") as f:
+        assert f.read() == pristine  # byte-identical restore
+    assert os.path.exists(victim + ".bad")  # evidence kept
+
+    r2 = scrub_mod.scrub_ec_volume(base, codec)
+    assert r2["corrupt_shards"] == [] and not r2["unidentified"]
+
+
+# ------------------------------------------------------ replica digests --
+
+
+def test_digest_antientropy_property(tmp_path):
+    """Seeded interleaved appends/deletes on two 'replicas': after every
+    round, digests are equal IFF the live content sets are equal."""
+    rng = random.Random(0xD16E57)
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    va = Volume(str(tmp_path / "a"), "", 1)
+    vb = Volume(str(tmp_path / "b"), "", 1)
+    live_a, live_b = {}, {}
+    for round_no in range(60):
+        op = rng.random()
+        key = rng.randrange(1, 20)
+        size = rng.randrange(1, 400)
+        both = rng.random() < 0.7  # 30% of ops hit only one replica
+        targets = [("a", va, live_a), ("b", vb, live_b)]
+        if not both:
+            targets = [targets[rng.randrange(2)]]
+        for _name, v, live in targets:
+            if op < 0.75:
+                v.write_needle(
+                    Needle(cookie=key, id=key, data=bytes([key]) * size)
+                )
+                live[key] = size
+            elif key in live:
+                v.delete_needle(Needle(id=key, cookie=key))
+                live.pop(key, None)
+        same_content = {
+            k: s for k, s in live_a.items()
+        } == {k: s for k, s in live_b.items()}
+        same_digest = va.content_digest() == vb.content_digest()
+        assert same_content == same_digest, (
+            round_no, live_a, live_b, same_content, same_digest,
+        )
+    va.close()
+    vb.close()
+
+
+def test_tail_sync_converges_stale_replica(tmp_path):
+    """The catch-up path: a replica that missed appends pulls the tail
+    (volume_backup incremental) and its digest converges."""
+    from seaweedfs_tpu.storage.volume_backup import (
+        apply_incremental,
+        incremental_changes,
+    )
+
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    va = Volume(str(tmp_path / "a"), "", 1)
+    vb = Volume(str(tmp_path / "b"), "", 1)
+    for i in range(1, 6):
+        for v in (va, vb):
+            v.write_needle(Needle(cookie=i, id=i, data=bytes([i]) * 120))
+    # replica b goes dark; a keeps writing (and deletes one key)
+    for i in range(6, 10):
+        va.write_needle(Needle(cookie=i, id=i, data=bytes([i]) * 120))
+    va.delete_needle(Needle(id=2, cookie=2))
+    assert va.content_digest() != vb.content_digest()
+
+    blob = b"".join(incremental_changes(va, vb.last_append_at_ns))
+    applied = apply_incremental(vb, blob)
+    assert applied == 5  # 4 appends + 1 tombstone
+    assert va.content_digest() == vb.content_digest()
+    n = Needle(id=7, cookie=7)
+    vb.read_needle(n)
+    assert n.data == bytes([7]) * 120
+    va.close()
+    vb.close()
+
+
+def test_digest_fold_is_order_independent_and_process_stable():
+    import numpy as np
+
+    keys = np.array([9, 4, 7], dtype=np.uint64)
+    sizes = np.array([100, 200, 300], dtype=np.uint64)
+    perm = np.array([1, 2, 0])
+    assert digest_fold(keys, sizes) == digest_fold(keys[perm], sizes[perm])
+    # pinned value: the digest must be arithmetic, not salted hash()
+    assert digest_fold(
+        np.array([1], dtype=np.uint64), np.array([10], dtype=np.uint64)
+    ) == digest_fold(
+        np.array([1], dtype=np.uint64), np.array([10], dtype=np.uint64)
+    )
+    assert digest_fold(keys, sizes) != digest_fold(keys, sizes + np.uint64(1))
+
+
+def test_find_unresolved_divergence_flags_equal_frontier_disagreement():
+    from seaweedfs_tpu.topology.repair import find_unresolved_divergence
+
+    states = {
+        # same frontier, different digests: the tail path can't fix this
+        1: [
+            {"url": "a", "content_digest": 1, "append_at_ns": 9},
+            {"url": "b", "content_digest": 2, "append_at_ns": 9},
+        ],
+        # trailing replica: tail_sync's job, NOT unresolved
+        2: [
+            {"url": "a", "content_digest": 1, "append_at_ns": 5},
+            {"url": "b", "content_digest": 2, "append_at_ns": 9},
+        ],
+        # healthy agreement
+        3: [
+            {"url": "a", "content_digest": 7, "append_at_ns": 3},
+            {"url": "b", "content_digest": 7, "append_at_ns": 3},
+        ],
+        # three replicas: the two AT the top frontier disagree -> flagged
+        4: [
+            {"url": "a", "content_digest": 1, "append_at_ns": 9},
+            {"url": "b", "content_digest": 2, "append_at_ns": 9},
+            {"url": "c", "content_digest": 1, "append_at_ns": 4},
+        ],
+    }
+    assert find_unresolved_divergence(states) == [1, 4]
+
+
+# ------------------------------------------------------ repair scheduler --
+
+
+def test_plan_ec_repairs_orders_fewest_survivors_first():
+    states = [
+        {"vid": 1, "collection": "", "total_shards": 14,
+         "holders": {i: ["n1"] for i in range(12)}},  # 2 missing
+        {"vid": 2, "collection": "", "total_shards": 14,
+         "holders": {i: ["n1"] for i in range(10)}},  # 4 missing (riskier)
+        {"vid": 3, "collection": "", "total_shards": 14,
+         "holders": {i: ["n1"] for i in range(14)}},  # healthy
+    ]
+    tasks = plan_ec_repairs(states)
+    assert [t.vid for t in tasks] == [2, 1]  # fewest survivors first
+    assert tasks[0].missing == list(range(10, 14))
+    assert tasks[0].survivors == 10
+
+
+def test_plan_ec_repairs_counts_dead_nodes_shards_missing():
+    """A silent node's shards are excluded by the caller (live filter);
+    the planner must then see them as missing."""
+    holders = {i: (["dead"] if i < 4 else ["live"]) for i in range(14)}
+    # the live filter already stripped "dead"
+    live_holders = {i: u for i, u in holders.items() if u != ["dead"]}
+    tasks = plan_ec_repairs(
+        [{"vid": 7, "total_shards": 14, "holders": live_holders}]
+    )
+    assert len(tasks) == 1
+    assert tasks[0].missing == [0, 1, 2, 3]
+
+
+def test_plan_replica_repairs_recopy_and_tail_sync():
+    states = {
+        # corrupt replica + healthy peer -> recopy from the peer
+        1: [
+            {"url": "a", "content_digest": 5, "append_at_ns": 10,
+             "scrub_corrupt": True},
+            {"url": "b", "content_digest": 5, "append_at_ns": 10},
+        ],
+        # diverged digest + trailing frontier -> tail_sync
+        2: [
+            {"url": "a", "content_digest": 1, "append_at_ns": 5},
+            {"url": "b", "content_digest": 2, "append_at_ns": 9},
+        ],
+        # healthy pair -> nothing
+        3: [
+            {"url": "a", "content_digest": 3, "append_at_ns": 4},
+            {"url": "b", "content_digest": 3, "append_at_ns": 4},
+        ],
+        # single replica -> nothing (no peer to compare/repair from)
+        4: [{"url": "a", "content_digest": 9, "append_at_ns": 1,
+             "scrub_corrupt": True}],
+    }
+    tasks = plan_replica_repairs(states)
+    by_kind = {(t.kind, t.vid): t for t in tasks}
+    assert set(by_kind) == {("replica_recopy", 1), ("tail_sync", 2)}
+    assert by_kind[("replica_recopy", 1)].target == "a"
+    assert by_kind[("replica_recopy", 1)].source == "b"
+    t2 = by_kind[("tail_sync", 2)]
+    assert t2.target == "a" and t2.source == "b"
+
+
+def test_repair_queue_dedupe_backoff_and_depth_gauge():
+    policy = BackoffPolicy(base=0.05, cap=0.4, multiplier=2.0, attempts=99)
+    q = RepairQueue(policy=policy, rng=random.Random(3))
+    t = RepairTask(kind="ec_rebuild", vid=1, priority=10, survivors=10)
+    assert q.offer(t) is True
+    assert q.offer(
+        RepairTask(kind="ec_rebuild", vid=1, priority=9, survivors=9)
+    ) is False  # deduped: same key, refreshed facts
+    assert q.depth() == 1
+    assert gauge_value(REPAIR_QUEUE_DEPTH) == 1.0
+
+    now = 100.0
+    [got] = q.pop_ready(now, limit=5)
+    assert got.priority == 9  # the refreshed plan won
+    assert q.depth() == 0 and gauge_value(REPAIR_QUEUE_DEPTH) == 0.0
+
+    # injected rebuild failure: full-jitter backoff within policy bounds
+    q.reschedule_failure(got, now)
+    assert got.attempts == 1
+    assert now <= got.not_before <= now + 0.05  # base * 2^0
+    assert q.pop_ready(now, limit=5) == []  # backoff holds it
+    [again] = q.pop_ready(now + 0.5, limit=5)
+    q.reschedule_failure(again, now)
+    assert again.attempts == 2
+    assert now <= again.not_before <= now + 0.1  # base * 2^1
+
+    # re-planning the same finding must NOT reset retry state
+    q.offer(RepairTask(kind="ec_rebuild", vid=1, priority=9, survivors=9))
+    [kept] = q.pop_ready(now + 10, limit=5)
+    assert kept.attempts == 2
+
+    # pruning drops findings the latest scan no longer justifies
+    q.offer(RepairTask(kind="ec_rebuild", vid=2, priority=5))
+    q.prune(valid_keys=set())
+    assert q.depth() == 0 and gauge_value(REPAIR_QUEUE_DEPTH) == 0.0
+
+
+def test_repair_queue_priority_order():
+    q = RepairQueue(rng=random.Random(0))
+    for vid, pri in ((1, 12), (2, 4), (3, 8)):
+        q.offer(RepairTask(kind="ec_rebuild", vid=vid, priority=pri))
+    got = q.pop_ready(0.0, limit=10)
+    assert [t.vid for t in got] == [2, 3, 1]
+
+
+def test_repair_copy_rolls_back_on_failed_pull(tmp_path):
+    """A transient pull failure must not convert a corrupt-but-present
+    replica into a missing one: the .bad files go back, the volume
+    remounts (still quarantined), and the data is still readable."""
+    import aiohttp
+
+    from test_cluster import Cluster, assign_retry
+
+    from seaweedfs_tpu.client.operation import read_url, upload_data
+    from seaweedfs_tpu.pb import grpc_address
+    from seaweedfs_tpu.pb.rpc import Stub
+
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                ar = await assign_retry(cluster.master.address)
+                data = os.urandom(600)
+                await upload_data(session, ar.url, ar.fid, data, "r.bin")
+                vid = int(ar.fid.split(",")[0])
+                vs = cluster.server_for(ar.url)
+                vs.store.find_volume(vid).quarantine("test")
+                r = await Stub(grpc_address(ar.url), "volume").call(
+                    "VolumeRepairCopy",
+                    {
+                        "volume_id": vid,
+                        "source_data_node": "127.0.0.1:1",  # unreachable
+                    },
+                    timeout=60,
+                )
+                assert r.get("error"), r
+                v = vs.store.find_volume(vid)
+                assert v is not None, "replica went missing after rollback"
+                assert v.scrub_corrupt  # still flagged for a later retry
+                got = await read_url(session, f"http://{ar.url}/{ar.fid}")
+                assert got == data  # the (only) copy still serves
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+# ------------------------------------------------------ shell commands --
+
+
+def test_shell_volume_scrub_and_repair_status(tmp_path):
+    """The operator surface: `volume.scrub` forces a pass and reports
+    findings; `ec.repair.status -run` drives one scheduler round and
+    shows the (empty, healthy-cluster) queue."""
+    from test_cluster import Cluster, assign_retry
+
+    import aiohttp
+
+    from seaweedfs_tpu.client.operation import upload_data
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+
+    async def body():
+        cluster = Cluster(tmp_path)
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                ar = await assign_retry(cluster.master.address)
+                await upload_data(
+                    session, ar.url, ar.fid, os.urandom(700), "s.bin"
+                )
+            env = CommandEnv(cluster.master.address)
+            out = await run_command(env, "volume.scrub")
+            assert "records" in out and "corruption(s)" in out, out
+            assert "CORRUPT" not in out  # healthy cluster
+            out = await run_command(env, "ec.repair.status -run")
+            assert "queue depth: 0" in out, out
+            assert "ran one round: dispatched 0" in out, out
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+# ------------------------------------------------------ cluster e2e --
+
+
+def test_cluster_self_healing_end_to_end(tmp_path):
+    """The acceptance proof: a deterministic bitflip plan corrupts a
+    replicated needle on one holder; a seeded flip corrupts an EC shard.
+    Forced scrub passes detect both (counters), the master's repair
+    scheduler restores byte-identical data (VolumeRepairCopy for the
+    replica, the batched VolumeEcShardsRebuildBatch fast path for the
+    shard), repair_queue_depth drains to 0, and second scrub passes
+    report zero corruptions."""
+    import aiohttp
+
+    from test_cluster import Cluster, assign_retry
+
+    from seaweedfs_tpu.client.operation import read_url, upload_data
+    from seaweedfs_tpu.pb import grpc_address
+    from seaweedfs_tpu.pb.rpc import Stub
+    from seaweedfs_tpu.storage.erasure_coding import to_ext
+
+    async def wait_for(predicate, timeout=15.0, interval=0.1, what=""):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return
+            await asyncio.sleep(interval)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    async def body():
+        cluster = Cluster(tmp_path)
+        await cluster.start()
+        master = cluster.master
+        try:
+            async with aiohttp.ClientSession() as session:
+                # ---- part 1: replicated volume with one corrupt copy ----
+                ar = await assign_retry(master.address, replication="001")
+                vid = int(ar.fid.split(",")[0])
+                good = os.urandom(900)
+                await upload_data(session, ar.url, ar.fid, good, "g.bin")
+                await wait_for(
+                    lambda: len(
+                        master.topo.replica_states().get(vid, [])
+                    ) == 2,
+                    what="2 replicas registered",
+                )
+                replicas = master.topo.replica_states()[vid]
+                target_url = replicas[0]["url"]
+                target_vs = cluster.server_for(target_url)
+                tv = target_vs.store.find_volume(vid)
+                # deterministic plan: flip 3 bits inside the data region of
+                # the NEXT record landing in the target replica's .dat only
+                at = tv.data_file_size() + NEEDLE_HEADER_SIZE + 16
+                faults.install_plan(FaultPlan(seed=0x5CAB, rules=[
+                    FaultRule(op="write_at",
+                              target=tv.file_name() + ".dat", nth=1,
+                              fault="bitflip", at_offset=at, bits=3),
+                ]))
+                victim = os.urandom(800)
+                # write into the SAME volume so the flip rule matches
+                from seaweedfs_tpu.storage.file_id import (
+                    format_needle_id_cookie,
+                )
+
+                vfid = f"{vid},{format_needle_id_cookie(0x77, 0xC0FFEE)}"
+                await upload_data(session, ar.url, vfid, victim, "v.bin")
+                faults.clear_plan()
+
+                # ---- part 2: EC volume, all shards local, one corrupted --
+                ar3 = await assign_retry(master.address)
+                evid = int(ar3.fid.split(",")[0])
+                while evid == vid:
+                    ar3 = await assign_retry(master.address)
+                    evid = int(ar3.fid.split(",")[0])
+                ec_payloads = {}
+                for i in range(1, 25):
+                    fid = f"{evid},{format_needle_id_cookie(i, 0xAB00 + i)}"
+                    data = random.Random(i).randbytes(1500 + 13 * i)
+                    await upload_data(session, ar3.url, fid, data)
+                    ec_payloads[fid] = data
+                src = Stub(grpc_address(ar3.url), "volume")
+                await src.call("VolumeMarkReadonly", {"volume_id": evid})
+                r = await src.call(
+                    "VolumeEcShardsGenerate", {"volume_id": evid},
+                    timeout=300,
+                )
+                assert not r.get("error"), r
+                r = await src.call(
+                    "VolumeEcShardsMount",
+                    {"volume_id": evid, "shard_ids": list(range(14))},
+                )
+                assert not r.get("error"), r
+                await src.call("VolumeUnmount", {"volume_id": evid})
+                await src.call("VolumeDelete", {"volume_id": evid})
+                await wait_for(
+                    lambda: (
+                        master.topo.lookup_ec_shards(evid) is not None
+                        and sum(
+                            1
+                            for l in master.topo.lookup_ec_shards(
+                                evid
+                            ).locations
+                            if l
+                        ) == 14
+                    ),
+                    what="all 14 EC shards registered",
+                )
+                ec_vs = cluster.server_for(ar3.url)
+                ec_base = None
+                for loc in ec_vs.store.locations:
+                    ev = loc.find_ec_volume(evid)
+                    if ev is not None:
+                        ec_base = ev.file_name()
+                assert ec_base is not None
+                shard_path = ec_base + to_ext(4)
+                with open(shard_path, "rb") as f:
+                    pristine_shard = f.read()
+                rng = random.Random(0xEC5EED)
+                _flip_byte(shard_path, rng.randrange(len(pristine_shard)))
+
+                # ---- forced scrub passes detect BOTH ----
+                crc_before = counter_value(
+                    SCRUB_CORRUPTIONS, kind="needle_crc"
+                )
+                par_before = counter_value(
+                    SCRUB_CORRUPTIONS, kind="ec_data"
+                )
+                rep1 = await Stub(
+                    grpc_address(target_url), "volume"
+                ).call("VolumeScrub", {"volume_id": vid}, timeout=300)
+                assert not rep1.get("error"), rep1
+                found = [
+                    c
+                    for vr in rep1["volumes"]
+                    for c in vr["corruptions"]
+                ]
+                assert len(found) == 1 and found[0][1] == "needle_crc", rep1
+                rep2 = await Stub(
+                    grpc_address(ar3.url), "volume"
+                ).call("VolumeScrub", {"volume_id": evid}, timeout=300)
+                assert not rep2.get("error"), rep2
+                ec_reports = [
+                    e for e in rep2["ec_volumes"] if e["volume_id"] == evid
+                ]
+                assert ec_reports and ec_reports[0]["corrupt_shards"] == [4]
+                assert counter_value(
+                    SCRUB_CORRUPTIONS, kind="needle_crc"
+                ) > crc_before
+                assert counter_value(
+                    SCRUB_CORRUPTIONS, kind="ec_data"
+                ) > par_before
+                assert os.path.exists(shard_path + ".bad")  # quarantined
+
+                # heartbeats deliver quarantine + missing shard to master
+                await wait_for(
+                    lambda: any(
+                        r.get("scrub_corrupt")
+                        for r in master.topo.replica_states().get(vid, [])
+                    ),
+                    what="scrub_corrupt flag at master",
+                )
+                await wait_for(
+                    lambda: not master.topo.lookup_ec_shards(
+                        evid
+                    ).locations[4],
+                    what="shard 4 unregistered",
+                )
+
+                # ---- the repair scheduler closes the loop ----
+                resync_before = counter_value(
+                    ANTIENTROPY_RESYNCS, kind="recopy"
+                )
+                for _ in range(40):
+                    out = await master.run_anti_entropy_once(max_dispatch=4)
+                    assert "error" not in out, out
+                    errs = [
+                        d for d in out["dispatched"] if d.get("error")
+                    ]
+                    assert not errs, errs
+                    if (
+                        out["queue_depth"] == 0
+                        and master.topo.lookup_ec_shards(evid).locations[4]
+                        and not any(
+                            r.get("scrub_corrupt")
+                            for r in master.topo.replica_states().get(
+                                vid, []
+                            )
+                        )
+                    ):
+                        break
+                    await asyncio.sleep(0.3)
+                else:
+                    raise AssertionError("repair never converged")
+                # tier-1 guard: the queue drained to 0, observably
+                assert gauge_value(REPAIR_QUEUE_DEPTH) == 0.0
+                assert counter_value(
+                    ANTIENTROPY_RESYNCS, kind="recopy"
+                ) > resync_before
+
+                # ---- byte-identical restores ----
+                with open(shard_path, "rb") as f:
+                    assert f.read() == pristine_shard
+                assert os.path.exists(shard_path + ".bad")  # evidence kept
+                got = await read_url(
+                    session, f"http://{target_url}/{vfid}"
+                )
+                assert got == victim  # the corrupt replica now serves truth
+                got = await read_url(session, f"http://{target_url}/{ar.fid}")
+                assert got == good
+
+                # ---- second scrub passes: zero corruptions ----
+                rep3 = await Stub(
+                    grpc_address(target_url), "volume"
+                ).call("VolumeScrub", {"volume_id": vid}, timeout=300)
+                assert all(
+                    vr["corruptions"] == [] for vr in rep3["volumes"]
+                ), rep3
+                rep4 = await Stub(
+                    grpc_address(ar3.url), "volume"
+                ).call("VolumeScrub", {"volume_id": evid}, timeout=300)
+                assert all(
+                    e["corrupt_shards"] == [] and not e.get("unidentified")
+                    for e in rep4["ec_volumes"]
+                    if e["volume_id"] == evid
+                ), rep4
+        finally:
+            faults.clear_plan()
+            await cluster.stop()
+
+    asyncio.run(body())
