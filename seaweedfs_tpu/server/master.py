@@ -40,7 +40,12 @@ from ..topology.repair import (
     plan_replica_repairs,
 )
 from ..topology.volume_growth import NoFreeSpaceError, grow_count_for_copy_level
-from ..util.metrics import ANTIENTROPY_DIVERGED, REPAIR_SECONDS
+from ..topology.vacuum_plan import plan_vacuums
+from ..util.metrics import (
+    ANTIENTROPY_DIVERGED,
+    REPAIR_SECONDS,
+    VACUUM_QUEUE_DEPTH,
+)
 
 
 class MasterServer:
@@ -64,6 +69,8 @@ class MasterServer:
         auto_repair: Optional[bool] = None,
         repair_grace_seconds: Optional[float] = None,
         repair_concurrency: int = 2,
+        auto_vacuum: Optional[bool] = None,
+        vacuum_concurrency: int = 2,
     ):
         self.jwt_signing_key = jwt_signing_key
         self.jwt_expires_seconds = jwt_expires_seconds
@@ -116,6 +123,24 @@ class MasterServer:
         self.repair_queue = RepairQueue(rng=random.Random())
         self.repair_log: list[dict] = []  # last dispatch outcomes
         self._repair_task: Optional[asyncio.Task] = None
+        # vacuum plane: garbage ratios ride heartbeats; findings feed a
+        # highest-garbage-first queue dispatched under a concurrency cap
+        # with full-jitter backoff — the repair scheduler's shape applied
+        # to compaction. Background loop opt-in (SEAWEEDFS_TPU_AUTO_VACUUM
+        # / auto_vacuum=True); run_vacuum_once() is always callable
+        # (/vol/vacuum, VacuumStatus -run, tests).
+        if auto_vacuum is None:
+            auto_vacuum = os.environ.get(
+                "SEAWEEDFS_TPU_AUTO_VACUUM", ""
+            ).lower() in ("1", "true", "on", "yes")
+        self.auto_vacuum = auto_vacuum
+        self.vacuum_concurrency = vacuum_concurrency
+        self.vacuum_queue = RepairQueue(
+            rng=random.Random(), depth_gauge=VACUUM_QUEUE_DEPTH
+        )
+        self.vacuum_log: list[dict] = []
+        self._vacuum_task: Optional[asyncio.Task] = None
+        self._vacuum_inflight: set[int] = set()
         self._clients: dict[str, asyncio.Queue] = {}
         self._option_cache: dict[tuple, GrowOption] = {}
         self._admin_token: Optional[tuple[int, float]] = None  # (token, ts)
@@ -183,6 +208,7 @@ class MasterServer:
         svc.unary("ReleaseAdminToken")(self._grpc_release_admin_token)
         svc.unary("GetMasterConfiguration")(self._grpc_get_configuration)
         svc.unary("RepairStatus")(self._grpc_repair_status)
+        svc.unary("VacuumStatus")(self._grpc_vacuum_status)
         svc.unary("RaftRequestVote")(self._grpc_raft_request_vote)
         svc.unary("RaftAppendEntries")(self._grpc_raft_append_entries)
         self._grpc_server = await serve(grpc_address(self.address), svc)
@@ -193,6 +219,8 @@ class MasterServer:
             )
         if self.auto_repair:
             self._repair_task = asyncio.ensure_future(self._anti_entropy_loop())
+        if self.auto_vacuum:
+            self._vacuum_task = asyncio.ensure_future(self._auto_vacuum_loop())
 
     async def _maintenance_loop(self) -> None:
         """Leader-only periodic admin scripts (ref: master_server.go:191-246
@@ -231,6 +259,12 @@ class MasterServer:
             self._repair_task.cancel()
             try:
                 await self._repair_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._vacuum_task is not None:
+            self._vacuum_task.cancel()
+            try:
+                await self._vacuum_task
             except (asyncio.CancelledError, Exception):
                 pass
         if self._maintenance_task is not None:
@@ -688,6 +722,7 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
                             "append_at_ns",
                             "read_only",
                             "scrub_corrupt",
+                            "garbage_ratio",
                         ):
                             if k in m:
                                 info[k] = m[k]
@@ -1211,47 +1246,287 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
             **({"ran": ran} if ran is not None else {}),
         }
 
-    # ---------------- vacuum driver (ref topology_vacuum.go) ----------------
-    async def vacuum(self, garbage_threshold: float) -> list[dict]:
-        results = []
+    # ---------------- vacuum scheduler (ref topology_vacuum.go, rebuilt in
+    # the repair scheduler's shape: heartbeat-ranked queue, concurrency
+    # cap, full-jitter backoff, opt-in background loop) ----------------
+    async def _auto_vacuum_loop(self) -> None:
+        """Leader-only background vacuum: rank candidates off heartbeat
+        garbage ratios every few pulses, dispatch under the cap."""
+        interval = max(self.pulse_seconds * 4, 2.0)
+        while not self._shutdown:
+            try:
+                await asyncio.sleep(interval)
+                if not self.is_leader or self._shutdown:
+                    continue
+                await self.run_vacuum_once()
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                continue  # scheduler errors must never kill the master
+
+    async def run_vacuum_once(
+        self,
+        garbage_threshold: Optional[float] = None,
+        max_dispatch: Optional[int] = None,
+        probe_all: bool = False,
+    ) -> dict:
+        """One scan+dispatch round: candidates from heartbeat-carried
+        garbage ratios merge into the highest-garbage-first queue, up to
+        the concurrency cap dispatch concurrently (authoritative
+        VacuumVolumeCheck -> compact every replica -> commit or cleanup),
+        failures back off with full jitter. probe_all enqueues every
+        registered volume regardless of heartbeat ratio (forced sweeps:
+        the per-replica check still gates the actual compaction)."""
+        if not self.is_leader:
+            return {"error": "not leader"}
+        threshold = (
+            self.garbage_threshold
+            if garbage_threshold is None
+            else garbage_threshold
+        )
+        if probe_all:
+            # forced sweeps enumerate the LAYOUTS (registered at volume
+            # allocation), not heartbeat-fed dn.volumes — a volume grown
+            # moments ago must still be sweepable (the pre-scheduler
+            # /vol/vacuum semantics)
+            states = self._layout_vacuum_states()
+        else:
+            live = {
+                dn.url
+                for dn in self.topo.live_data_nodes(self.repair_grace_seconds)
+            }
+            states = self.topo.replica_states(live)
+        tasks = plan_vacuums(states, threshold, include_all=probe_all)
+        valid_keys = set()
+        for t in tasks:
+            valid_keys.add(t.key)
+            self.vacuum_queue.offer(t)
+        # tasks mid-retry (a forced sweep's failure in backoff) survive
+        # scans whose plan wouldn't re-justify them — the promised retry
+        # must happen; a success or terminal skip removes them normally
+        self.vacuum_queue.prune(valid_keys | self.vacuum_queue.retry_keys())
+        now = time.monotonic()
+        ready = self.vacuum_queue.pop_ready(
+            now, max_dispatch or self.vacuum_concurrency
+        )
+        results: list[dict] = []
+        await asyncio.gather(
+            *(self._dispatch_vacuum_task(t, threshold, results) for t in ready)
+        )
+        self.vacuum_log = (self.vacuum_log + results)[-50:]
+        return {
+            "dispatched": results,
+            "queue_depth": self.vacuum_queue.depth(),
+            "threshold": threshold,
+        }
+
+    def _layout_vacuum_states(self) -> dict:
+        """Every registered volume from the layout maps, in the
+        `plan_vacuums` shape; garbage ratio pinned to 1.0 so include_all
+        ordering is stable — the dispatcher's authoritative
+        VacuumVolumeCheck supplies the real number. read_only /
+        scrub_corrupt are carried over from the heartbeat-fed volume
+        infos when known, so forced sweeps honor the planner's
+        quarantine gate too (the volume server also refuses to compact a
+        quarantined volume — defense in depth)."""
+        states: dict = {}
         for collection in list(self.topo.collections.values()):
             for layout in collection.layouts():
                 for vid, nodes in list(layout.vid_to_locations.items()):
-                    checks = []
+                    replicas = []
                     for dn in nodes:
-                        stub = Stub(grpc_address(dn.url), "volume")
-                        try:
-                            r = await stub.call(
-                                "VacuumVolumeCheck", {"volume_id": vid}
-                            )
-                            checks.append(float(r.get("garbage_ratio", 0)))
-                        except Exception:
-                            checks.append(0.0)
-                    if not checks or min(checks) < garbage_threshold:
-                        continue
-                    ok = True
-                    for dn in nodes:
-                        stub = Stub(grpc_address(dn.url), "volume")
-                        try:
-                            r = await stub.call(
-                                "VacuumVolumeCompact", {"volume_id": vid},
-                                timeout=600,
-                            )
-                            ok = ok and not r.get("error")
-                        except Exception:
-                            ok = False
-                    for dn in nodes:
-                        stub = Stub(grpc_address(dn.url), "volume")
-                        try:
-                            if ok:
-                                await stub.call(
-                                    "VacuumVolumeCommit", {"volume_id": vid}
-                                )
-                            else:
-                                await stub.call(
-                                    "VacuumVolumeCleanup", {"volume_id": vid}
-                                )
-                        except Exception:
-                            pass
-                    results.append({"volume_id": vid, "compacted": ok})
-        return results
+                        info = dn.volumes.get(int(vid), {})
+                        replicas.append(
+                            {
+                                "url": dn.url,
+                                "collection": collection.name,
+                                "garbage_ratio": 1.0,
+                                "read_only": bool(info.get("read_only")),
+                                "scrub_corrupt": bool(
+                                    info.get("scrub_corrupt")
+                                ),
+                            }
+                        )
+                    states[int(vid)] = replicas
+        return states
+
+    async def _dispatch_vacuum_task(
+        self, t, threshold: float, results: list
+    ) -> None:
+        """check -> compact (all replicas, concurrently) -> commit/cleanup
+        for one queued volume (ref topology_vacuum.go per-volume flow).
+        An in-flight set spans all three dispatch paths (auto loop,
+        /vol/vacuum, -run) so one master never double-dispatches a
+        volume; the volume server's own is_compacting gate covers the
+        rest (a refused compact/cleanup errors into backoff here)."""
+        inflight = self._vacuum_inflight
+        if t.vid in inflight:
+            results.append(
+                {**t.to_info(), "skipped": "already dispatching"}
+            )
+            return
+        inflight.add(t.vid)
+        try:
+            await self._dispatch_vacuum_task_inner(t, threshold, results)
+        finally:
+            inflight.discard(t.vid)
+
+    async def _dispatch_vacuum_task_inner(
+        self, t, threshold: float, results: list
+    ) -> None:
+        t0 = time.perf_counter()
+        nodes = self.topo.lookup(t.collection, t.vid)
+        if not nodes:
+            results.append({**t.to_info(), "error": "volume not registered"})
+            return  # prune/offer re-discovers it if it reappears
+        urls = sorted({dn.url for dn in nodes})
+
+        async def rpc(url: str, method: str, timeout: float = 600):
+            r = await Stub(grpc_address(url), "volume").call(
+                method, {"volume_id": t.vid}, timeout=timeout
+            )
+            if r.get("error"):
+                raise IOError(f"{method} on {url}: {r['error']}")
+            return r
+
+        async def cleanup_all() -> None:
+            # idempotent shadow sweep; a server with a compact still in
+            # flight refuses (it must not lose its own shadow mid-write)
+            await asyncio.gather(
+                *(
+                    Stub(grpc_address(u), "volume").call(
+                        "VacuumVolumeCleanup", {"volume_id": t.vid}
+                    )
+                    for u in urls
+                ),
+                return_exceptions=True,
+            )
+
+        try:
+            checks = await asyncio.gather(
+                *(rpc(u, "VacuumVolumeCheck", 30) for u in urls)
+            )
+            ratio = min(float(c.get("garbage_ratio", 0)) for c in checks)
+            if ratio < threshold:
+                REPAIR_SECONDS.observe(
+                    time.perf_counter() - t0, kind="vacuum", result="skipped"
+                )
+                results.append(
+                    {
+                        **t.to_info(),
+                        "skipped": f"garbage {ratio:.3f} < {threshold}",
+                    }
+                )
+                # a prior PARTIAL failure may have stranded shadows on the
+                # replica that kept its garbage — sweep them on the way out
+                await cleanup_all()
+                return
+            # settle EVERY compact before deciding: gather's first-error
+            # fast path would fire cleanup while other replicas are still
+            # mid-copy, unlinking their shadows under the writer
+            compacts = await asyncio.gather(
+                *(rpc(u, "VacuumVolumeCompact") for u in urls),
+                return_exceptions=True,
+            )
+            failed = [e for e in compacts if isinstance(e, BaseException)]
+            if failed:
+                raise IOError("; ".join(str(e) for e in failed[:3]))
+        except Exception as e:
+            # compaction is all-or-nothing per volume: sweep the shadows
+            # everywhere (now that every compact RPC has settled), back
+            # off, retry later
+            await cleanup_all()
+            REPAIR_SECONDS.observe(
+                time.perf_counter() - t0, kind="vacuum", result="error"
+            )
+            self.vacuum_queue.reschedule_failure(t, time.monotonic())
+            results.append({**t.to_info(), "error": str(e)})
+            return
+        commit = await asyncio.gather(
+            *(rpc(u, "VacuumVolumeCommit") for u in urls),
+            return_exceptions=True,
+        )
+        errs = [str(e) for e in commit if isinstance(e, BaseException)]
+        dt = time.perf_counter() - t0
+        if errs:
+            REPAIR_SECONDS.observe(dt, kind="vacuum", result="error")
+            self.vacuum_queue.reschedule_failure(t, time.monotonic())
+            results.append({**t.to_info(), "error": "; ".join(errs[:3])})
+        else:
+            REPAIR_SECONDS.observe(dt, kind="vacuum", result="ok")
+            results.append(
+                {
+                    **t.to_info(),
+                    "compacted": True,
+                    "garbage_ratio": round(ratio, 4),
+                    "nodes": urls,
+                }
+            )
+
+    async def _grpc_vacuum_status(self, req, context) -> dict:
+        """Vacuum-plane introspection for `volume.vacuum -status` (+ `-run`
+        to force a scan/dispatch round), mirroring RepairStatus."""
+        proxied = await self._proxy_to_leader("VacuumStatus", req)
+        if proxied is not None:
+            return proxied
+        ran = None
+        if req.get("run"):
+            ran = await self.run_vacuum_once(
+                garbage_threshold=(
+                    float(req["garbage_threshold"])
+                    if req.get("garbage_threshold") is not None
+                    else None
+                ),
+                max_dispatch=int(req.get("max_dispatch", 0) or 0) or None,
+                probe_all=bool(req.get("probe_all")),
+            )
+        return {
+            "auto_vacuum": self.auto_vacuum,
+            "garbage_threshold": self.garbage_threshold,
+            "queue_depth": self.vacuum_queue.depth(),
+            "queue": self.vacuum_queue.snapshot(),
+            "recent": self.vacuum_log[-10:],
+            **({"ran": ran} if ran is not None else {}),
+        }
+
+    # ---------------- vacuum driver (the /vol/vacuum HTTP entry point) ----
+    async def vacuum(self, garbage_threshold: float) -> list[dict]:
+        """Forced cluster sweep through the scheduler: every registered
+        volume is enqueued, the authoritative per-replica check applies
+        `garbage_threshold`, and the queue drains in vacuum_concurrency-
+        sized waves — a forced sweep must not launch every volume's
+        compaction at once (the background-interference storm the cap
+        exists to prevent). Tasks a failure pushed into backoff are left
+        queued for the background loop / a later call (the queue's
+        retry_keys survive scan pruning). Deliberately NOT a loop over
+        run_vacuum_once: that would RE-PLAN every wave, re-offering the
+        tasks the previous wave already popped and skipped — the drain
+        needs plan-once / pop-until-empty semantics."""
+        if not self.is_leader:
+            return []
+        states = self._layout_vacuum_states()
+        tasks = plan_vacuums(states, garbage_threshold, include_all=True)
+        for t in tasks:
+            self.vacuum_queue.offer(t)
+        dispatched: list[dict] = []
+        while True:
+            ready = self.vacuum_queue.pop_ready(
+                time.monotonic(), self.vacuum_concurrency
+            )
+            if not ready:
+                break
+            await asyncio.gather(
+                *(
+                    self._dispatch_vacuum_task(t, garbage_threshold, dispatched)
+                    for t in ready
+                )
+            )
+        self.vacuum_log = (self.vacuum_log + dispatched)[-50:]
+        return [
+            {
+                "volume_id": d["volume_id"],
+                "compacted": bool(d.get("compacted")),
+            }
+            for d in dispatched
+            if "skipped" not in d
+        ]

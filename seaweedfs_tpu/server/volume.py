@@ -32,7 +32,7 @@ from ..storage.erasure_coding import to_ext
 from ..storage.file_id import FileId
 from ..storage.needle import Needle, NotFoundError
 from ..storage.store import Store
-from ..storage.volume import AlreadyDeleted, CookieMismatch, NotFound
+from ..storage.volume import AlreadyDeleted, CookieMismatch, NotFound, Volume
 from ..storage import vacuum as vacuum_mod
 from ..util.fasthttp import (
     DETACHED,
@@ -1352,8 +1352,25 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
             return {"error": "volume not found"}
         loop = asyncio.get_event_loop()
         try:
-            await loop.run_in_executor(None, vacuum_mod.compact2, v)
-            return {}
+            # the per-run report, NOT the module-global "last" snapshot:
+            # concurrent compactions (vacuum_concurrency > 1) each get
+            # their own numbers
+            report = await loop.run_in_executor(
+                None,
+                lambda: vacuum_mod.compact2(
+                    v,
+                    route=req.get("route") or None,
+                    verify=req.get("verify"),
+                ),
+            )
+            return {
+                "stages": report.get("stages", {}),
+                "route": {
+                    k: report[k]
+                    for k in ("route", "extents", "records")
+                    if k in report
+                },
+            }
         except Exception as e:
             return {"error": str(e)}
 
@@ -1363,18 +1380,48 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
         if v is None:
             return {"error": "volume not found"}
         loop = asyncio.get_event_loop()
+        old_msg = self.store._volume_message(v)
         try:
             new_v = await loop.run_in_executor(None, vacuum_mod.commit_compact, v)
             for loc in self.store.locations:
                 if loc.find_volume(vid) is not None:
                     loc.volumes[vid] = new_v
+            # the garbage ratio (and digest) just changed: ride the next
+            # heartbeat pulse so the master's vacuum queue prunes this
+            # volume instead of re-dispatching off stale state
+            self.store.note_volume_changed(
+                old_msg, self.store._volume_message(new_v)
+            )
             return {}
         except Exception as e:
+            # commit_compact closed the volume before it failed (shadows
+            # swept, old .dat/.idx intact): reload so the volume keeps
+            # serving and a later vacuum retry can start clean
+            try:
+                reloaded = await loop.run_in_executor(
+                    None,
+                    lambda: Volume(
+                        v.dir, v.collection, vid, create=False,
+                        needle_map_kind=getattr(
+                            v, "needle_map_kind", "memory"
+                        ),
+                    ),
+                )
+                for loc in self.store.locations:
+                    if loc.find_volume(vid) is not None:
+                        loc.volumes[vid] = reloaded
+            except Exception:
+                pass  # original error is the one worth reporting
             return {"error": str(e)}
 
     async def _grpc_vacuum_cleanup(self, req, context) -> dict:
         v = self.store.find_volume(int(req["volume_id"]))
         if v is not None:
+            if v.is_compacting:
+                # a cleanup racing an in-flight compact2 would unlink the
+                # shadow mid-write and leave .cpx-without-.cpd on disk —
+                # the state the load-time sweep treats as half-committed
+                return {"error": "compaction in flight; not cleaning"}
             vacuum_mod.cleanup_compact(v)
         return {}
 
@@ -1602,12 +1649,26 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
             "last_append_at_ns": v.last_append_at_ns,
         }
 
+    async def _charge_maintenance(self, n: int, plane: str = "repair") -> None:
+        """Charge n bytes to the shared maintenance budget (no-op when
+        SEAWEEDFS_TPU_MAINT_MBPS is unset). The blocking token wait runs in
+        the executor so a throttled repair pull never stalls serving."""
+        from ..storage.maintenance import plane_bucket
+
+        bucket = plane_bucket(plane)
+        if bucket is not None and n:
+            await asyncio.get_event_loop().run_in_executor(
+                None, bucket.consume, n
+            )
+
     async def _pull_volume_files(
         self, vid: int, collection: str, source: str, base: str
     ) -> None:
         """Stream .dat/.idx/.vif from a source server into base.* (atomic
         per-file via .tmp+rename); shared by VolumeCopy and the repair
-        re-copy path."""
+        re-copy path. Pull traffic is charged to the shared maintenance
+        budget: a repair storm and a scrub pass together stay under the
+        one configured background-I/O cap."""
         stub = Stub(grpc_address(source), "volume")
         for ext in (".dat", ".idx", ".vif"):
             tmp = base + ext + ".tmp"
@@ -1622,7 +1683,9 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
                         if ext == ".vif":
                             break
                         raise IOError(msg["error"])
-                    f.write(msg.get("file_content", b""))
+                    chunk = msg.get("file_content", b"")
+                    await self._charge_maintenance(len(chunk))
+                    f.write(chunk)
                     got_any = True
             if got_any or ext != ".vif":
                 os.replace(tmp, base + ext)

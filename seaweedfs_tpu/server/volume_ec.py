@@ -431,7 +431,11 @@ class EcHandlers:
                 ):
                     if msg.get("error"):
                         raise IOError(msg["error"])
-                    f.write(msg.get("file_content", b""))
+                    chunk = msg.get("file_content", b"")
+                    # survivor-shard pulls share the maintenance budget
+                    # with scrub + vacuum (one cap over all planes)
+                    await self._charge_maintenance(len(chunk))
+                    f.write(chunk)
             os.replace(tmp, base + ext)
 
         try:

@@ -347,7 +347,48 @@ async def cmd_volume_tier_download(env, argv) -> str:
 
 @command("volume.vacuum")
 async def cmd_volume_vacuum(env, argv) -> str:
+    """Vacuum plane: `volume.vacuum [-garbageThreshold=0.3]` forces a
+    cluster sweep; `-status` shows the master's highest-garbage-first
+    queue and recent outcomes; `-run` forces one scheduler scan+dispatch
+    round off heartbeat garbage ratios (see docs/perf.md "Vacuum plane")."""
     flags = _parse_flags(argv)
+    if "status" in flags or "run" in flags:
+        req: dict = {}
+        if "run" in flags:
+            req["run"] = True
+            if "garbageThreshold" in flags:
+                req["garbage_threshold"] = float(flags["garbageThreshold"])
+        r = await env.master_stub.call("VacuumStatus", req, timeout=3600)
+        if r.get("error"):
+            return f"vacuum status failed: {r['error']}"
+        lines = [
+            f"auto_vacuum: {'on' if r.get('auto_vacuum') else 'off'} "
+            f"(threshold {r.get('garbage_threshold')}) · "
+            f"queue depth: {r.get('queue_depth', 0)}"
+        ]
+        from ..topology.vacuum_plan import priority_to_ratio
+
+        for t in r.get("queue", []):
+            lines.append(
+                f"  queued volume {t['volume_id']} (garbage ~"
+                f"{priority_to_ratio(int(t['priority'])):.2f}, "
+                f"attempts {t['attempts']})"
+            )
+        for t in r.get("recent", []):
+            if t.get("error"):
+                outcome = f"ERROR: {t['error']}"
+            elif t.get("skipped"):
+                outcome = f"skipped ({t['skipped']})"
+            else:
+                outcome = "compacted"
+            lines.append(f"  recent volume {t['volume_id']}: {outcome}")
+        if "ran" in r:
+            ran = r["ran"]
+            lines.append(
+                f"ran one round: dispatched {len(ran.get('dispatched', []))},"
+                f" queue depth now {ran.get('queue_depth', 0)}"
+            )
+        return "\n".join(lines)
     threshold = float(flags.get("garbageThreshold", 0.3))
     import aiohttp
 

@@ -1,0 +1,175 @@
+"""Shared maintenance I/O budget: one byte/s cap over every background
+plane.
+
+Online-EC studies show background maintenance traffic is the dominant
+interference source for foreground reads on warm stores (arxiv
+1709.05365): each plane being individually rate-shaped is not enough when
+scrub, vacuum and repair pulls run concurrently — their SUM is what the
+foreground p50 sees. `MaintenanceBudget` generalizes the scrubber's token
+bucket into a single bucket shared by every plane, with per-plane byte
+accounting so operators can see who spent the budget.
+
+Activation: `SEAWEEDFS_TPU_MAINT_MBPS` (MB/s across all planes) arms the
+process-wide budget returned by `shared_budget()`; unset/0 means no shared
+cap and each plane falls back to its own shaping (e.g. the scrubber's
+`SEAWEEDFS_TPU_SCRUB_MBPS`). Planes take a `plane("scrub")` handle whose
+`consume(n)` blocks until the shared bucket holds n tokens — the handle
+satisfies the same duck-type as a `TokenBucket`, so every existing
+`bucket.consume(...)` call site works unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+
+class TokenBucket:
+    """Byte/s rate shaping for maintenance I/O. `consume(n)` blocks until
+    the bucket holds n tokens; capacity (burst) defaults to one second of
+    rate, so sustained throughput converges on `rate` while a tiny pass
+    still finishes in one gulp. Injectable clock/sleep for tests."""
+
+    def __init__(
+        self,
+        rate_bytes_per_s: float,
+        capacity: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if rate_bytes_per_s <= 0:
+            raise ValueError("token bucket needs a positive rate")
+        self.rate = float(rate_bytes_per_s)
+        self.capacity = float(capacity if capacity is not None else rate_bytes_per_s)
+        self._clock = clock
+        self._sleep = sleep
+        self._tokens = self.capacity
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def consume(self, n: int) -> float:
+        """Take n tokens, sleeping as needed; returns seconds slept.
+        Requests larger than the burst capacity are paid in capacity-sized
+        installments (they must not deadlock, just take proportionally
+        longer)."""
+        slept = 0.0
+        need = float(n)
+        while need > 0:
+            with self._lock:
+                now = self._clock()
+                self._tokens = min(
+                    self.capacity, self._tokens + (now - self._last) * self.rate
+                )
+                self._last = now
+                chunk = min(need, self.capacity)
+                if self._tokens >= chunk:
+                    self._tokens -= chunk
+                    need -= chunk
+                    continue
+                wait = max((chunk - self._tokens) / self.rate, 0.001)
+            self._sleep(wait)
+            slept += wait
+        return slept
+
+
+class _PlaneHandle:
+    """One plane's view of the shared budget: a TokenBucket-shaped object
+    whose consumption is charged to the common bucket and attributed to
+    the plane in the budget's accounting (and the maintenance_bytes_total
+    metric)."""
+
+    __slots__ = ("_budget", "plane")
+
+    def __init__(self, budget: "MaintenanceBudget", plane: str):
+        self._budget = budget
+        self.plane = plane
+
+    def consume(self, n: int) -> float:
+        return self._budget.consume(n, self.plane)
+
+
+class MaintenanceBudget:
+    """One token bucket shared by every background plane (scrub, vacuum,
+    repair), so their COMBINED read+write traffic stays under a single
+    MB/s cap no matter how many planes happen to run at once."""
+
+    def __init__(
+        self,
+        rate_mbps: float,
+        capacity_bytes: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.rate_mbps = float(rate_mbps)
+        self.bucket = TokenBucket(
+            rate_mbps * 1e6, capacity=capacity_bytes, clock=clock, sleep=sleep
+        )
+        self._lock = threading.Lock()
+        self._spent: dict[str, int] = {}
+        self._slept: dict[str, float] = {}
+
+    def plane(self, name: str) -> _PlaneHandle:
+        return _PlaneHandle(self, name)
+
+    def consume(self, n: int, plane: str = "other") -> float:
+        slept = self.bucket.consume(n)
+        with self._lock:
+            self._spent[plane] = self._spent.get(plane, 0) + int(n)
+            self._slept[plane] = self._slept.get(plane, 0.0) + slept
+        try:
+            from ..util.metrics import MAINTENANCE_BYTES
+
+            MAINTENANCE_BYTES.inc(n, plane=plane)
+        except ImportError:
+            pass
+        return slept
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "rate_mbps": self.rate_mbps,
+                "spent_bytes": dict(self._spent),
+                "throttle_seconds": {
+                    k: round(v, 3) for k, v in self._slept.items()
+                },
+            }
+
+
+_SHARED: Optional[MaintenanceBudget] = None
+_SHARED_LOCK = threading.Lock()
+
+
+def shared_budget() -> Optional[MaintenanceBudget]:
+    """The process-wide budget armed by SEAWEEDFS_TPU_MAINT_MBPS, or None
+    when no shared cap is configured (each plane shapes itself)."""
+    global _SHARED
+    if _SHARED is not None:
+        return _SHARED
+    rate = float(os.environ.get("SEAWEEDFS_TPU_MAINT_MBPS", "0") or 0)
+    if rate <= 0:
+        return None
+    with _SHARED_LOCK:
+        if _SHARED is None:
+            _SHARED = MaintenanceBudget(rate)
+        return _SHARED
+
+
+def configure_shared(budget: Optional[MaintenanceBudget]) -> None:
+    """Install (or clear) the process-wide budget — tests and embedders."""
+    global _SHARED
+    with _SHARED_LOCK:
+        _SHARED = budget
+
+
+def plane_bucket(plane: str, explicit=None):
+    """The rate shaper a plane should use: an explicitly configured bucket
+    wins (the plane's own knob), else the shared budget's plane handle,
+    else None (unshaped)."""
+    if explicit is not None:
+        return explicit
+    budget = shared_budget()
+    if budget is not None:
+        return budget.plane(plane)
+    return None

@@ -30,64 +30,18 @@ from __future__ import annotations
 
 import json
 import os
-import threading
-import time
 from typing import Callable, Optional
 
 from ..types import TOMBSTONE_FILE_SIZE, to_actual_offset
 from ..util.metrics import SCRUB_BYTES, SCRUB_CORRUPTIONS, SCRUB_PASSES
+from .maintenance import TokenBucket, plane_bucket  # noqa: F401 — TokenBucket
+# stays importable from here (its original home) for existing callers; the
+# class itself moved to maintenance.py where it became the building block
+# of the SHARED maintenance budget (scrub + vacuum + repair under one cap)
 from .needle import get_actual_size, read_needle_data
 
 # parity verification granularity: bytes per shard per round
 EC_SCRUB_CHUNK = 1 << 20
-
-
-class TokenBucket:
-    """Byte/s rate shaping for scrub I/O. `consume(n)` blocks until the
-    bucket holds n tokens; capacity (burst) defaults to one second of
-    rate, so sustained throughput converges on `rate` while a tiny scrub
-    still finishes in one gulp. Injectable clock/sleep for tests."""
-
-    def __init__(
-        self,
-        rate_bytes_per_s: float,
-        capacity: Optional[float] = None,
-        clock: Callable[[], float] = time.monotonic,
-        sleep: Callable[[float], None] = time.sleep,
-    ):
-        if rate_bytes_per_s <= 0:
-            raise ValueError("token bucket needs a positive rate")
-        self.rate = float(rate_bytes_per_s)
-        self.capacity = float(capacity if capacity is not None else rate_bytes_per_s)
-        self._clock = clock
-        self._sleep = sleep
-        self._tokens = self.capacity
-        self._last = clock()
-        self._lock = threading.Lock()
-
-    def consume(self, n: int) -> float:
-        """Take n tokens, sleeping as needed; returns seconds slept.
-        Requests larger than the burst capacity are paid in capacity-sized
-        installments (they must not deadlock, just take proportionally
-        longer)."""
-        slept = 0.0
-        need = float(n)
-        while need > 0:
-            with self._lock:
-                now = self._clock()
-                self._tokens = min(
-                    self.capacity, self._tokens + (now - self._last) * self.rate
-                )
-                self._last = now
-                chunk = min(need, self.capacity)
-                if self._tokens >= chunk:
-                    self._tokens -= chunk
-                    need -= chunk
-                    continue
-                wait = max((chunk - self._tokens) / self.rate, 0.001)
-            self._sleep(wait)
-            slept += wait
-        return slept
 
 
 # ---------------------------------------------------------------- cursor --
@@ -367,8 +321,14 @@ class Scrubber:
         codec_for: Optional[Callable[[int, int], object]] = None,
     ):
         self.store = store
-        self.bucket = (
-            TokenBucket(rate_mbps * 1e6) if rate_mbps and rate_mbps > 0 else None
+        # an explicit scrub rate wins; otherwise the shared maintenance
+        # budget (SEAWEEDFS_TPU_MAINT_MBPS) shapes scrub I/O jointly with
+        # vacuum and repair so the planes' SUM stays under one cap
+        self.bucket = plane_bucket(
+            "scrub",
+            TokenBucket(rate_mbps * 1e6)
+            if rate_mbps and rate_mbps > 0
+            else None,
         )
         self.codec_for = codec_for
 

@@ -177,6 +177,11 @@ class Store:
             "content_digest": v.content_digest(),
             "append_at_ns": v.last_append_at_ns,
             "scrub_corrupt": v.scrub_corrupt,
+            # vacuum plane: the garbage ratio rides every heartbeat so the
+            # master's vacuum scheduler can rank candidates without an RPC
+            # sweep (the per-dispatch VacuumVolumeCheck stays the
+            # authoritative re-check)
+            "garbage_ratio": round(v.garbage_level(), 4),
         }
 
     def collect_volume_digests(self) -> list[dict]:
@@ -195,6 +200,7 @@ class Store:
                         "append_at_ns": v.last_append_at_ns,
                         "read_only": v.is_read_only(),
                         "scrub_corrupt": v.scrub_corrupt,
+                        "garbage_ratio": round(v.garbage_level(), 4),
                     }
                 )
         return out

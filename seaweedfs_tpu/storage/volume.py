@@ -334,6 +334,22 @@ class Volume:
         self._index_cache = SnapshotCache()
 
         base = self.file_name()
+        # a dead compaction's shadow files must be repaired BEFORE anything
+        # opens the .dat/.idx: sweep .cpd/.cpx leftovers, or complete a
+        # commit that crashed between its two renames (vacuum.py)
+        try:
+            from .vacuum import sweep_compaction_shadows
+
+            swept = sweep_compaction_shadows(base)
+            if swept:
+                from ..util.log import warning
+
+                warning(
+                    "volume %d: %s stale compaction shadows at load",
+                    vid, swept,
+                )
+        except OSError:
+            pass  # unreadable shadows: the load below decides read-only
         dat_exists = os.path.exists(base + ".dat")
 
         # tiered volumes have no local .dat; their .vif names the remote

@@ -71,13 +71,16 @@ class RepairQueue:
         self,
         policy: BackoffPolicy = REPAIR_BACKOFF,
         rng: Optional[random.Random] = None,
+        depth_gauge=REPAIR_QUEUE_DEPTH,
     ):
         self.policy = policy
         self.rng = rng or random.Random()
         self._tasks: dict[tuple, RepairTask] = {}
+        # the vacuum scheduler reuses this queue with its own depth gauge
+        self._depth_gauge = depth_gauge
 
     def _publish_depth(self) -> None:
-        REPAIR_QUEUE_DEPTH.set(len(self._tasks))
+        self._depth_gauge.set(len(self._tasks))
 
     def offer(self, task: RepairTask) -> bool:
         existing = self._tasks.get(task.key)
@@ -99,6 +102,14 @@ class RepairQueue:
         for key in [k for k in self._tasks if k not in valid_keys]:
             self._tasks.pop(key)
         self._publish_depth()
+
+    def retry_keys(self) -> set:
+        """Keys of tasks that have already failed at least once (they sit
+        in a backoff window). The vacuum scheduler exempts these from
+        pruning: a forced sweep's failed task must survive background
+        scans whose (stale or higher-threshold) plan wouldn't re-justify
+        it — the caller was promised a retry."""
+        return {k for k, t in self._tasks.items() if t.attempts > 0}
 
     def pop_ready(self, now: float, limit: int) -> list[RepairTask]:
         ready = sorted(

@@ -255,6 +255,7 @@ class Topology(Node):
                         "append_at_ns": int(info.get("append_at_ns", 0)),
                         "scrub_corrupt": bool(info.get("scrub_corrupt")),
                         "read_only": bool(info.get("read_only")),
+                        "garbage_ratio": float(info.get("garbage_ratio", 0.0)),
                     }
                 )
         return states

@@ -7,6 +7,12 @@ weed/operation/upload_content.go:30,66-95 with the key carried in the
 chunk metadata, and decrypted on the filer/mount read path). The volume
 server only ever sees ciphertext; possession of the filer metadata is
 what grants plaintext access.
+
+Uses the `cryptography` package when available; otherwise falls back to a
+pure-Python AES-256-GCM (FIPS-197 + NIST SP 800-38D). The fallback is
+correct but slow (~100 KB/s) — fine for the KB-sized chunk payloads this
+code path actually carries, and it keeps the cipher feature working on
+images without the native wheel.
 """
 
 from __future__ import annotations
@@ -19,11 +25,10 @@ _NONCE_SIZE = 12  # GCM standard nonce
 def _aesgcm(key: bytes):
     try:
         from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-    except ImportError as e:  # pragma: no cover - baked into this image
-        raise RuntimeError(
-            "content cipher requires the 'cryptography' package"
-        ) from e
-    return AESGCM(key)
+
+        return AESGCM(key)
+    except ImportError:
+        return _PurePythonAESGCM(key)
 
 
 def gen_cipher_key() -> bytes:
@@ -47,3 +52,176 @@ def decrypt(ciphertext: bytes, key: bytes) -> bytes:
         return _aesgcm(key).decrypt(nonce, bytes(body), None)
     except Exception as e:
         raise ValueError(f"chunk decrypt failed: {e}") from e
+
+
+# ------------------------------------------------- pure-Python fallback --
+# AES-256 per FIPS-197 with the S-box derived from the GF(2^8) inverse +
+# affine map (no hand-typed table to mistype), GCM per SP 800-38D with
+# GHASH done on 128-bit Python ints. Tables built lazily on first use.
+
+_SBOX: list | None = None
+_TAG_SIZE = 16
+
+
+def _build_sbox() -> list:
+    # GF(2^8) exp/log over generator 3, then inverse + affine transform
+    exp = [0] * 256
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        x ^= (x << 1) ^ (0x11B if x & 0x80 else 0)
+        x &= 0xFF
+    log = [0] * 256
+    for i in range(255):
+        log[exp[i]] = i
+    sbox = [0] * 256
+    for v in range(256):
+        inv = 0 if v == 0 else exp[(255 - log[v]) % 255]
+        b = inv
+        res = 0x63
+        for _ in range(4):
+            b = ((b << 1) | (b >> 7)) & 0xFF
+            res ^= b
+        sbox[v] = res ^ inv
+    return sbox
+
+
+def _sbox() -> list:
+    global _SBOX
+    if _SBOX is None:
+        _SBOX = _build_sbox()
+    return _SBOX
+
+
+def _expand_key_256(key: bytes) -> list:
+    """AES-256 key schedule -> 15 round keys of 16 bytes each."""
+    sbox = _sbox()
+    words = [list(key[i : i + 4]) for i in range(0, 32, 4)]
+    rcon = 1
+    for i in range(8, 60):
+        t = list(words[i - 1])
+        if i % 8 == 0:
+            t = t[1:] + t[:1]
+            t = [sbox[b] for b in t]
+            t[0] ^= rcon
+            rcon = (rcon << 1) ^ (0x11B if rcon & 0x80 else 0)
+            rcon &= 0xFF
+        elif i % 8 == 4:
+            t = [sbox[b] for b in t]
+        words.append([a ^ b for a, b in zip(words[i - 8], t)])
+    return [
+        bytes(b for w in words[r * 4 : r * 4 + 4] for b in w)
+        for r in range(15)
+    ]
+
+
+# ShiftRows as a flat index permutation over the column-major state
+_SHIFT = [0, 5, 10, 15, 4, 9, 14, 3, 8, 13, 2, 7, 12, 1, 6, 11]
+
+
+def _encrypt_block(round_keys: list, block: bytes) -> bytes:
+    sbox = _sbox()
+    s = [b ^ k for b, k in zip(block, round_keys[0])]
+    for rnd in range(1, 15):
+        s = [sbox[s[i]] for i in _SHIFT]
+        if rnd < 14:
+            t = []
+            for c in range(0, 16, 4):
+                a0, a1, a2, a3 = s[c : c + 4]
+                x = a0 ^ a1 ^ a2 ^ a3
+                t.append(a0 ^ x ^ _xt(a0 ^ a1))
+                t.append(a1 ^ x ^ _xt(a1 ^ a2))
+                t.append(a2 ^ x ^ _xt(a2 ^ a3))
+                t.append(a3 ^ x ^ _xt(a3 ^ a0))
+            s = t
+        s = [b ^ k for b, k in zip(s, round_keys[rnd])]
+    return bytes(s)
+
+
+def _xt(b: int) -> int:
+    b <<= 1
+    return (b ^ 0x1B) & 0xFF if b & 0x100 else b
+
+
+_R = 0xE1 << 120  # GHASH reduction poly x^128 + x^7 + x^2 + x + 1
+
+
+def _ghash_mult(x: int, y: int) -> int:
+    """Carryless multiply in GF(2^128), MSB-first bit order per SP 800-38D."""
+    z = 0
+    v = y
+    for i in range(127, -1, -1):
+        if (x >> i) & 1:
+            z ^= v
+        v = (v >> 1) ^ _R if v & 1 else v >> 1
+    return z
+
+
+class _PurePythonAESGCM:
+    """Drop-in for cryptography's AESGCM (encrypt/decrypt with nonce and
+    optional AAD), AES-256 only — the only key size this repo generates."""
+
+    def __init__(self, key: bytes):
+        if len(key) != 32:
+            raise ValueError("pure-python fallback supports AES-256 only")
+        self._rk = _expand_key_256(bytes(key))
+        self._h = int.from_bytes(_encrypt_block(self._rk, b"\x00" * 16), "big")
+
+    def _ctr_stream(self, j0: bytes, n_bytes: int) -> bytes:
+        out = bytearray()
+        prefix, ctr = j0[:12], int.from_bytes(j0[12:], "big")
+        for _ in range((n_bytes + 15) // 16):
+            ctr = (ctr + 1) & 0xFFFFFFFF
+            out += _encrypt_block(self._rk, prefix + ctr.to_bytes(4, "big"))
+        return bytes(out[:n_bytes])
+
+    def _ghash(self, aad: bytes, ct: bytes) -> int:
+        y = 0
+        for blob in (aad, ct):
+            for i in range(0, len(blob), 16):
+                block = blob[i : i + 16].ljust(16, b"\x00")
+                y = _ghash_mult(y ^ int.from_bytes(block, "big"), self._h)
+        lens = (len(aad) * 8).to_bytes(8, "big") + (len(ct) * 8).to_bytes(
+            8, "big"
+        )
+        return _ghash_mult(y ^ int.from_bytes(lens, "big"), self._h)
+
+    def _j0(self, nonce: bytes) -> bytes:
+        if len(nonce) == 12:
+            return nonce + b"\x00\x00\x00\x01"
+        # SP 800-38D: J0 = GHASH(nonce padded to a block boundary) folded
+        # with ONE final block of 0^64 || [len(nonce) in bits]_64
+        padded = nonce + b"\x00" * ((16 - len(nonce) % 16) % 16)
+        y = 0
+        for i in range(0, len(padded), 16):
+            y = _ghash_mult(
+                y ^ int.from_bytes(padded[i : i + 16], "big"), self._h
+            )
+        y = _ghash_mult(y ^ (len(nonce) * 8), self._h)
+        return y.to_bytes(16, "big")
+
+    def encrypt(self, nonce: bytes, data: bytes, aad) -> bytes:
+        aad = aad or b""
+        j0 = self._j0(nonce)
+        ct = bytes(
+            a ^ b for a, b in zip(data, self._ctr_stream(j0, len(data)))
+        )
+        s = self._ghash(aad, ct)
+        tag = int.from_bytes(_encrypt_block(self._rk, j0), "big") ^ s
+        return ct + tag.to_bytes(16, "big")
+
+    def decrypt(self, nonce: bytes, data: bytes, aad) -> bytes:
+        aad = aad or b""
+        if len(data) < _TAG_SIZE:
+            raise ValueError("ciphertext shorter than the GCM tag")
+        ct, tag = data[:-_TAG_SIZE], data[-_TAG_SIZE:]
+        j0 = self._j0(nonce)
+        s = self._ghash(aad, ct)
+        want = int.from_bytes(_encrypt_block(self._rk, j0), "big") ^ s
+        # constant-time-ish compare (int xor) — this is a test-image
+        # fallback, but there is no reason to be sloppy about it
+        if want ^ int.from_bytes(tag, "big"):
+            raise ValueError("GCM tag mismatch")
+        return bytes(
+            a ^ b for a, b in zip(ct, self._ctr_stream(j0, len(ct)))
+        )

@@ -277,5 +277,26 @@ ANTIENTROPY_DIVERGED = REGISTRY.gauge(
 REPAIR_SECONDS = REGISTRY.histogram(
     "seaweedfs_tpu_repair_seconds",
     "wall seconds per dispatched repair, by kind (ec_rebuild/replica_"
-    "recopy/tail_sync) and result (ok/error)",
+    "recopy/tail_sync/vacuum) and result (ok/error/skipped)",
+)
+
+# vacuum plane (see docs/perf.md "Vacuum plane"): compaction gets the same
+# itemized treatment as the rebuild plane — per-stage walls of every
+# extent-coalesced copy (pipelined read overlaps write, so stage sums can
+# exceed total), the master's garbage-driven queue depth, and the shared
+# maintenance budget's per-plane spend so the combined background I/O cap
+# is externally auditable
+VACUUM_STAGE_SECONDS = REGISTRY.histogram(
+    "seaweedfs_tpu_vacuum_stage_seconds",
+    "compaction copy per-stage wall seconds, by stage (plan/read/write/"
+    "verify/idx/total; pipelined stages overlap)",
+)
+VACUUM_QUEUE_DEPTH = REGISTRY.gauge(
+    "seaweedfs_tpu_vacuum_queue_depth",
+    "vacuum tasks currently queued on the master (highest-garbage-first)",
+)
+MAINTENANCE_BYTES = REGISTRY.counter(
+    "seaweedfs_tpu_maintenance_bytes_total",
+    "bytes charged to the shared maintenance I/O budget, by plane "
+    "(scrub/vacuum/repair)",
 )

@@ -181,6 +181,25 @@ def test_write_budget_unit_costs_standalone():
     assert "coverage_of_p50" not in wb
 
 
+def test_vacuum_throughput_leg_shape():
+    """ISSUE 5 guard: the vacuum.throughput leg must emit a non-zero stage
+    breakdown, the executed route label, and the naive-baseline ratio —
+    and the two shadow sets must be content-identical."""
+    vt = bench.measure_vacuum_throughput(
+        n_needles=1200, needle_bytes=1024, reps=1
+    )
+    assert vt["best_gbps"] > 0
+    assert vt["naive_gbps"] > 0
+    assert vt["vs_naive"] > 0  # the naive-baseline ratio is emitted
+    assert vt["route"]["route"] in ("pread", "mmap")
+    assert vt["route"]["records"] > 0
+    stages = vt["stages"]
+    assert stages["total_s"] > 0
+    assert stages.get("write_s", 0) > 0
+    assert vt["identical"] is True
+    assert vt["live_bytes"] > 0
+
+
 def test_watchdog_emits_partial_and_exits(tmp_path):
     """A bench hung past its deadline must still produce a parseable final
     line (the r4 failure mode, one step worse): run a stub main that arms

@@ -147,7 +147,10 @@ def test_shell_ec_encode_batches_colocated_volumes(tmp_path):
                 ar0 = await assign_retry(cluster.master.address)
                 url = ar0.url
                 vid0 = int(ar0.fid.split(",")[0])
-                vids = [vid0, vid0 + 1]
+                # a second volume that is KNOWN to exist on the (single)
+                # server: assign may hand out the highest-numbered volume,
+                # where vid0 + 1 was never grown
+                vids = [vid0, vid0 - 1 if vid0 > 1 else vid0 + 1]
                 payloads = {}
                 for vid in vids:
                     for i in range(1, 6):
@@ -201,7 +204,9 @@ def test_generate_batch_rpc_and_read_back(tmp_path):
                 ar0 = await assign_retry(cluster.master.address)
                 url = ar0.url
                 vid0 = int(ar0.fid.split(",")[0])
-                vids = [vid0, vid0 + 1]
+                # see test_shell_ec_encode_batches_colocated_volumes: vid0+1
+                # need not exist when assign picked the highest-grown volume
+                vids = [vid0, vid0 - 1 if vid0 > 1 else vid0 + 1]
                 payloads = {}
                 for vid in vids:
                     for i in range(1, 8):

@@ -1,0 +1,542 @@
+"""Vacuum-plane fast path (ISSUE 5): extent-coalesced compaction
+correctness, crash kill-points, the shared maintenance budget, and the
+master's garbage-driven scheduler.
+
+- Property: compact() (dat-scan) and compact2() (idx-based, fast path)
+  produce byte-identical live content and identical post-commit needle
+  maps over seeded random append/delete/overwrite histories, on both copy
+  routes, including the makeup_diff race (writes landing between compact
+  and commit).
+- Crash kill-points: a simulated crash mid-.cpd write, or between the
+  commit's two renames, recovers to a consistent volume on reload; stale
+  shadows from a dead compaction are swept at load.
+- Verified vacuum doubles as a scrub pass: a bit-rotted live record
+  aborts the compaction and quarantines the volume.
+- MaintenanceBudget: scrub + vacuum charged to ONE bucket stay jointly
+  under the configured cap (fake clock — deterministic).
+- plan_vacuums: threshold gate, highest-garbage-first order, exclusions.
+- Cluster: VacuumStatus RPC + `volume.vacuum -status/-run` shell flow.
+"""
+
+import os
+import random
+
+import pytest
+
+from seaweedfs_tpu.storage import vacuum as vacuum_mod
+from seaweedfs_tpu.storage.maintenance import MaintenanceBudget
+from seaweedfs_tpu.storage.needle import Needle, read_needle_blob
+from seaweedfs_tpu.storage.vacuum import (
+    CorruptLiveRecord,
+    commit_compact,
+    compact,
+    compact2,
+    sweep_compaction_shadows,
+)
+from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.types import TOMBSTONE_FILE_SIZE, to_actual_offset
+from seaweedfs_tpu.util import faults
+from seaweedfs_tpu.util.faults import FaultPlan, FaultRule, SimulatedCrash
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+
+
+def _apply_history(v: Volume, rng: random.Random, ops: int) -> dict:
+    """Seeded random append/delete/overwrite history; returns the expected
+    live set {key: data}."""
+    live: dict[int, bytes] = {}
+    cookies: dict[int, int] = {}
+    for _ in range(ops):
+        roll = rng.random()
+        if roll < 0.55 or not live:
+            nid = rng.randrange(1, 64)
+            data = bytes([rng.randrange(256)]) * rng.randrange(1, 400)
+            cookie = cookies.setdefault(nid, rng.randrange(1, 1 << 31))
+            v.write_needle(Needle(id=nid, cookie=cookie, data=data))
+            live[nid] = data
+        elif roll < 0.8:
+            nid = rng.choice(list(live))
+            data = os.urandom(rng.randrange(1, 400))
+            v.write_needle(Needle(id=nid, cookie=cookies[nid], data=data))
+            live[nid] = data
+        else:
+            nid = rng.choice(list(live))
+            v.delete_needle(Needle(id=nid, cookie=cookies[nid]))
+            del live[nid]
+    return live
+
+
+def _live_blobs(v: Volume) -> dict:
+    """{key: (size, full on-disk record bytes)} over the live map."""
+    out = {}
+    keys, offsets, sizes = v.nm.snapshot()
+    for k, off, size in zip(keys, offsets, sizes):
+        k, off, size = int(k), int(off), int(size)
+        if off == 0 or size == TOMBSTONE_FILE_SIZE:
+            continue
+        blob = read_needle_blob(
+            v.data_backend, to_actual_offset(off), size, v.version
+        )
+        out[k] = (size, bytes(blob))
+    return out
+
+
+def _clone_volume_files(src_base: str, dst_dir, vid: int) -> None:
+    import shutil
+
+    os.makedirs(dst_dir, exist_ok=True)
+    for ext in (".dat", ".idx"):
+        shutil.copyfile(src_base + ext, os.path.join(dst_dir, f"{vid}{ext}"))
+
+
+@pytest.mark.parametrize("route", ["pread", "mmap"])
+def test_compact_vs_compact2_property(tmp_path, route):
+    """Over seeded random histories, the dat-scan path, the naive idx
+    path and the extent-coalesced fast path (both routes) all commit to
+    the same live content and the same needle map."""
+    for it in range(8):
+        rng = random.Random(1000 + it)
+        d = tmp_path / f"it{it}"
+        d.mkdir()
+        v = Volume(str(d), "", 1)
+        expected = _apply_history(v, rng, rng.randrange(20, 120))
+        v.sync()
+        base = v.file_name()
+
+        # clone the volume twice: one per compaction flavor
+        _clone_volume_files(base, d / "scan", 1)
+        _clone_volume_files(base, d / "fast", 1)
+        v.close()
+
+        v_scan = Volume(str(d / "scan"), "", 1, create=False)
+        compact(v_scan)
+        v_scan = commit_compact(v_scan)
+
+        v_fast = Volume(str(d / "fast"), "", 1, create=False)
+        compact2(v_fast, route=route)
+        v_fast = commit_compact(v_fast)
+
+        blobs_scan = _live_blobs(v_scan)
+        blobs_fast = _live_blobs(v_fast)
+        assert set(blobs_scan) == set(expected), f"it{it}: map keys diverged"
+        assert set(blobs_fast) == set(expected), f"it{it}: map keys diverged"
+        for k in expected:
+            assert blobs_scan[k] == blobs_fast[k], f"it{it}: record {k}"
+            n = v_fast.read_needle_by_key(k)
+            assert bytes(n.data) == expected[k], f"it{it}: content {k}"
+        # no garbage left: every index entry is live and accounted for
+        assert v_fast.deleted_size() == 0
+        v_scan.close()
+        v_fast.close()
+
+
+def test_makeup_diff_race_fast_path(tmp_path):
+    """Writes landing between compact2 (fast path) and commit_compact are
+    replayed into the shadow files: overwrites, deletes and brand-new keys
+    racing the compaction all survive the swap."""
+    for it in range(6):
+        rng = random.Random(7000 + it)
+        d = tmp_path / f"it{it}"
+        d.mkdir()
+        v = Volume(str(d), "", 1)
+        live = _apply_history(v, rng, 60)
+        compact2(v)
+
+        # race the commit: overwrite one live key, delete another, add one
+        keys = sorted(live)
+        over, dele = keys[0], keys[-1]
+        hdr = v.read_needle_by_key(over)
+        v.write_needle(Needle(id=over, cookie=hdr.cookie, data=b"RACED" * 9))
+        live[over] = b"RACED" * 9
+        hdr2 = v.read_needle_by_key(dele)
+        v.delete_needle(Needle(id=dele, cookie=hdr2.cookie))
+        del live[dele]
+        v.write_needle(Needle(id=999, cookie=42, data=b"NEW" * 21))
+        live[999] = b"NEW" * 21
+
+        v2 = commit_compact(v)
+        for k, data in live.items():
+            got = v2.read_needle_by_key(k)
+            assert bytes(got.data) == data, f"it{it}: key {k}"
+        with pytest.raises(Exception):
+            v2.read_needle_by_key(dele)
+        v2.close()
+
+
+def test_fast_path_emits_stages_and_route(tmp_path):
+    v = Volume(str(tmp_path), "", 1)
+    for i in range(1, 40):
+        v.write_needle(Needle(id=i, cookie=i, data=os.urandom(300)))
+    for i in range(2, 40, 3):
+        v.delete_needle(Needle(id=i, cookie=i))
+    compact2(v, route="pread")
+    stages = dict(vacuum_mod.LAST_VACUUM_STAGES)
+    route = dict(vacuum_mod.LAST_VACUUM_ROUTE)
+    assert stages.get("total_s", 0) > 0
+    assert stages.get("write_s", 0) > 0
+    assert route["route"] == "pread"
+    assert route["records"] > 0
+    # garbage means gaps, gaps mean multiple extents
+    assert route["extents"] > 1
+    v2 = commit_compact(v)
+    v2.close()
+
+
+def test_kill_point_mid_cpd_write_recovers(tmp_path):
+    """A simulated crash mid-.cpd write leaves a torn shadow; reload
+    sweeps it and the volume serves its full pre-vacuum content."""
+    v = Volume(str(tmp_path), "", 1)
+    acked = {}
+    for i in range(1, 30):
+        data = os.urandom(250)
+        v.write_needle(Needle(id=i, cookie=i, data=data))
+        acked[i] = data
+    for i in (3, 9, 27):
+        v.delete_needle(Needle(id=i, cookie=i))
+        del acked[i]
+    faults.install_plan(
+        FaultPlan(
+            seed=5,
+            rules=[
+                FaultRule(
+                    op="write_at", target="*.cpd", nth=2, fault="crash",
+                    keep=100,
+                )
+            ],
+        )
+    )
+    with pytest.raises(SimulatedCrash):
+        compact2(v)
+    faults.clear_plan()
+    base = v.file_name()
+    assert os.path.exists(base + ".cpd"), "torn shadow should remain"
+    v.close()
+
+    v2 = Volume(str(tmp_path), "", 1, create=False)
+    assert not os.path.exists(base + ".cpd")
+    assert not os.path.exists(base + ".cpx")
+    assert not v2.is_read_only()
+    for k, data in acked.items():
+        assert bytes(v2.read_needle_by_key(k).data) == data
+    v2.close()
+
+
+def test_kill_point_between_commit_renames_completes(tmp_path):
+    """Crash AFTER rename(.cpd->.dat) but BEFORE rename(.cpx->.idx): the
+    .dat is the committed copy and the orphan .cpx must be renamed into
+    place on load — the old key-ordered .idx describes a file that no
+    longer exists."""
+    v = Volume(str(tmp_path), "", 1)
+    acked = {}
+    for i in range(1, 25):
+        data = bytes([i]) * (40 + i)
+        v.write_needle(Needle(id=i, cookie=i, data=data))
+        acked[i] = data
+    for i in range(1, 25, 4):
+        v.delete_needle(Needle(id=i, cookie=i))
+        del acked[i]
+    compact2(v)
+    base = v.file_name()
+    v.close()
+    # the first rename of commit_compact, then "the process dies"
+    os.rename(base + ".cpd", base + ".dat")
+    assert os.path.exists(base + ".cpx")
+
+    v2 = Volume(str(tmp_path), "", 1, create=False)
+    assert not os.path.exists(base + ".cpx"), "commit should be completed"
+    assert not v2.is_read_only()
+    assert v2.deleted_count() == 0
+    for k, data in acked.items():
+        assert bytes(v2.read_needle_by_key(k).data) == data
+    v2.close()
+
+
+def test_stale_shadow_pair_swept_at_load(tmp_path):
+    v = Volume(str(tmp_path), "", 1)
+    v.write_needle(Needle(id=1, cookie=1, data=b"keep me"))
+    base = v.file_name()
+    v.close()
+    with open(base + ".cpd", "wb") as f:
+        f.write(b"dead compaction leftovers")
+    with open(base + ".cpx", "wb") as f:
+        f.write(b"\x00" * 16)
+    assert sweep_compaction_shadows(base) == "swept"
+    assert not os.path.exists(base + ".cpd")
+    assert not os.path.exists(base + ".cpx")
+    v2 = Volume(str(tmp_path), "", 1, create=False)
+    assert bytes(v2.read_needle_by_key(1).data) == b"keep me"
+    v2.close()
+
+
+def test_verified_vacuum_catches_bitrot_and_quarantines(tmp_path):
+    """verify=True re-parses every copied record through the CRC parser:
+    a flipped byte in a live record aborts the compaction (no shadows
+    left) and quarantines the volume, like a scrub finding."""
+    v = Volume(str(tmp_path), "", 1)
+    for i in range(1, 12):
+        v.write_needle(Needle(id=i, cookie=i, data=bytes([i]) * 120))
+    v.sync()
+    base = v.file_name()
+    # flip a byte inside needle 5's body, on disk, behind the map's back
+    nv = v.nm.get(5)
+    off = to_actual_offset(nv.offset_units) + 20
+    with open(base + ".dat", "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(CorruptLiveRecord):
+        compact2(v, verify=True)
+    assert v.scrub_corrupt and v.is_read_only()
+    assert not os.path.exists(base + ".cpd")
+    assert not os.path.exists(base + ".cpx")
+    v.close()
+
+
+def test_verified_vacuum_clean_volume_passes(tmp_path):
+    v = Volume(str(tmp_path), "", 1)
+    expected = {}
+    for i in range(1, 20):
+        data = os.urandom(200)
+        v.write_needle(Needle(id=i, cookie=i, data=data))
+        expected[i] = data
+    compact2(v, verify=True)
+    assert vacuum_mod.LAST_VACUUM_STAGES.get("verify_s", 0) > 0
+    v2 = commit_compact(v)
+    for k, data in expected.items():
+        assert bytes(v2.read_needle_by_key(k).data) == data
+    v2.close()
+
+
+def test_concurrent_compaction_rejected(tmp_path):
+    """Two dispatch paths racing one volume must not interleave writes
+    into the same shadow pair: the second compaction is refused while the
+    first holds the flag."""
+    v = Volume(str(tmp_path), "", 1)
+    for i in range(1, 6):
+        v.write_needle(Needle(id=i, cookie=i, data=b"d" * 100))
+    v.is_compacting = True  # an in-flight compaction elsewhere
+    with pytest.raises(RuntimeError):
+        compact2(v)
+    v.is_compacting = False
+    compact2(v)  # and the flag is released on completion: this succeeds
+    assert not v.is_compacting
+    v2 = commit_compact(v)
+    v2.close()
+
+
+def test_quarantined_volume_refuses_vacuum(tmp_path):
+    """Vacuum must never rewrite quarantined evidence — that volume
+    belongs to the repair plane (recopy from a healthy peer)."""
+    v = Volume(str(tmp_path), "", 1)
+    v.write_needle(Needle(id=1, cookie=1, data=b"evidence"))
+    v.quarantine("scrub found bit rot")
+    with pytest.raises(PermissionError):
+        compact2(v)
+    assert not os.path.exists(v.file_name() + ".cpd")
+    v.close()
+
+
+# ------------------------------------------------- maintenance budget --
+
+
+class _FakeClock:
+    """Deterministic clock+sleep pair for token-bucket math."""
+
+    def __init__(self):
+        self.now = 0.0
+
+    def clock(self) -> float:
+        return self.now
+
+    def sleep(self, dt: float) -> None:
+        self.now += dt
+
+
+def test_maintenance_budget_caps_combined_scrub_and_vacuum(tmp_path):
+    """Tier-1 guard for the acceptance criterion: scrub and vacuum charged
+    to ONE MaintenanceBudget are JOINTLY rate-bound — total bytes over
+    elapsed (fake) time never beats the configured cap + one burst."""
+    from seaweedfs_tpu.storage.scrub import scrub_volume
+
+    clk = _FakeClock()
+    rate = 0.5  # MB/s; burst shrunk so the small test volume overruns it
+    budget = MaintenanceBudget(
+        rate, capacity_bytes=50_000, clock=clk.clock, sleep=clk.sleep
+    )
+
+    v = Volume(str(tmp_path), "", 1)
+    for i in range(1, 120):
+        v.write_needle(Needle(id=i, cookie=i, data=os.urandom(1800)))
+    for i in range(2, 120, 3):
+        v.delete_needle(Needle(id=i, cookie=i))
+    v.sync()
+    base = v.file_name()
+
+    # scrub through one plane handle, vacuum through the other
+    report = scrub_volume(v, budget.plane("scrub"), quarantine=False)
+    assert not report["corruptions"]
+    vacuum_mod._copy_data_based_on_index_file(
+        base + ".dat", base + ".idx", base + ".cpd", base + ".cpx",
+        v.super_block, v.version, route="pread", bucket=budget.plane("vacuum"),
+    )
+    snap = budget.snapshot()
+    total = sum(snap["spent_bytes"].values())
+    assert snap["spent_bytes"].get("scrub", 0) > 0
+    assert snap["spent_bytes"].get("vacuum", 0) > 0
+    # combined throughput bound: the burst capacity is forgiven
+    cap_bytes = budget.bucket.capacity
+    assert total > cap_bytes, "test must actually exceed one burst"
+    min_elapsed = (total - cap_bytes) / (rate * 1e6)
+    assert clk.now >= min_elapsed * 0.999, (
+        f"combined {total}B took {clk.now}s of budget time; "
+        f"cap demands >= {min_elapsed}s"
+    )
+    v.close()
+    for ext in (".cpd", ".cpx"):
+        os.remove(base + ext)
+
+
+def test_plane_bucket_explicit_wins(monkeypatch):
+    from seaweedfs_tpu.storage import maintenance
+
+    explicit = object()
+    assert maintenance.plane_bucket("scrub", explicit) is explicit
+    maintenance.configure_shared(None)
+    monkeypatch.delenv("SEAWEEDFS_TPU_MAINT_MBPS", raising=False)
+    assert maintenance.plane_bucket("scrub") is None
+    budget = MaintenanceBudget(1.0)
+    maintenance.configure_shared(budget)
+    try:
+        handle = maintenance.plane_bucket("vacuum")
+        assert handle is not None and handle.plane == "vacuum"
+    finally:
+        maintenance.configure_shared(None)
+
+
+# ------------------------------------------------------ scheduler units --
+
+
+def test_plan_vacuums_threshold_and_order():
+    from seaweedfs_tpu.topology.vacuum_plan import plan_vacuums
+
+    states = {
+        1: [{"url": "a", "garbage_ratio": 0.9}, {"url": "b", "garbage_ratio": 0.8}],
+        2: [{"url": "a", "garbage_ratio": 0.4}],
+        3: [{"url": "a", "garbage_ratio": 0.1}],
+        4: [{"url": "a", "garbage_ratio": 0.95, "read_only": True}],
+        5: [{"url": "a", "garbage_ratio": 0.99, "scrub_corrupt": True}],
+        6: [{"url": "a", "garbage_ratio": 0.85}, {"url": "b", "garbage_ratio": 0.2}],
+    }
+    tasks = plan_vacuums(states, threshold=0.3)
+    # highest garbage first; 4/5 excluded (read-only/quarantined), 3 below
+    # threshold, 6 gated by its LOWEST replica
+    assert [t.vid for t in tasks] == [1, 2]
+    assert tasks[0].priority < tasks[1].priority
+    # a volume is ranked by its LOWEST replica ratio (commit needs all
+    # replicas), so 6 (min 0.2) sorts below 2 (0.4)
+    everything = plan_vacuums(states, threshold=0.3, include_all=True)
+    assert [t.vid for t in everything] == [1, 2, 6, 3]
+
+
+def test_vacuum_queue_backoff_and_depth_gauge():
+    import time as _time
+
+    from seaweedfs_tpu.topology.repair import RepairQueue, RepairTask
+    from seaweedfs_tpu.util.metrics import VACUUM_QUEUE_DEPTH
+
+    q = RepairQueue(rng=random.Random(3), depth_gauge=VACUUM_QUEUE_DEPTH)
+    t = RepairTask(kind="vacuum", vid=9, priority=100)
+    q.offer(t)
+    assert q.depth() == 1
+    now = _time.monotonic()
+    [popped] = q.pop_ready(now, 5)
+    q.reschedule_failure(popped, now)
+    assert q.depth() == 1
+    assert popped.not_before > now  # backed off
+    assert q.pop_ready(now, 5) == []  # still in its backoff window
+    gauge_val = VACUUM_QUEUE_DEPTH._values[tuple()]
+    assert gauge_val == 1.0
+
+
+def test_cluster_vacuum_status_and_scheduler_run(tmp_path):
+    """VacuumStatus RPC + shell `volume.vacuum -status` / `-run` against a
+    live cluster: deletes raise the heartbeat garbage ratio, a forced
+    scheduler round compacts the volume, and the status output reflects
+    the drained queue."""
+    import asyncio
+
+    import aiohttp
+
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    from tests.test_cluster import Cluster, assign_retry
+
+    async def body():
+        from seaweedfs_tpu.client.operation import delete_file, upload_data
+        from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
+
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                ar = await assign_retry(cluster.master.address)
+                vid = int(ar.fid.split(",")[0])
+                # deterministic co-located fids (single volume server)
+                fids = [
+                    f"{vid},{format_needle_id_cookie(i, 0xAB00 + i)}"
+                    for i in range(1, 14)
+                ]
+                for fid in fids:
+                    await upload_data(session, ar.url, fid, b"y" * 2000)
+                for fid in fids[:-1]:
+                    await delete_file(session, ar.url, fid)
+
+                env = CommandEnv(cluster.master.address)
+                out = await run_command(env, "volume.vacuum -status")
+                assert "auto_vacuum: off" in out
+
+                # wait for a digest refresh to carry the new garbage ratio,
+                # then force scheduler rounds until the volume compacts
+                deadline = asyncio.get_event_loop().time() + 20
+                compacted = []
+                while asyncio.get_event_loop().time() < deadline:
+                    r = await cluster.master.run_vacuum_once(
+                        garbage_threshold=0.05, max_dispatch=10
+                    )
+                    compacted = [
+                        d
+                        for d in r.get("dispatched", [])
+                        if d.get("compacted")
+                    ]
+                    if compacted:
+                        break
+                    await asyncio.sleep(0.3)
+                assert compacted, "scheduler never compacted the volume"
+                assert compacted[0]["volume_id"] == vid
+
+                # the surviving needle still reads back
+                got = None
+                for _ in range(10):
+                    async with session.get(
+                        f"http://{ar.url}/{fids[-1]}"
+                    ) as resp:
+                        if resp.status == 200:
+                            got = await resp.read()
+                            break
+                    await asyncio.sleep(0.2)
+                assert got == b"y" * 2000
+
+                out = await run_command(env, "volume.vacuum -status")
+                assert "queue depth: 0" in out
+        finally:
+            await cluster.stop()
+            from seaweedfs_tpu.pb.rpc import close_all_channels
+
+            await close_all_channels()
+
+    asyncio.run(body())
