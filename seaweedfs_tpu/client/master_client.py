@@ -24,6 +24,7 @@ class VidMap:
 
     def __init__(self):
         self._map: dict[int, list[str]] = {}
+        self._rr: dict[int, int] = {}
 
     def lookup(self, vid: int) -> list[str]:
         return list(self._map.get(vid, []))
@@ -33,6 +34,23 @@ class VidMap:
         if not locs:
             return None
         return random.choice(locs)
+
+    def pick_ordered(self, vid: int) -> list[str]:
+        """All replica locations, rotated round-robin per call: element 0
+        is the primary this read should try, the rest are hedge targets in
+        preference order. Successive calls for one vid walk the replica
+        set so skewed load spreads across holders instead of pinning one
+        server (random `pick` spreads in expectation; round-robin spreads
+        deterministically, which matters when a handful of hot needles
+        dominates the offered load)."""
+        locs = self._map.get(vid)
+        if not locs:
+            return []
+        if len(locs) == 1:
+            return locs  # the live list; callers read, never mutate
+        i = self._rr.get(vid, 0)
+        self._rr[vid] = (i + 1) % len(locs)
+        return locs[i:] + locs[:i]
 
     def add(self, vid: int, url: str) -> None:
         locs = self._map.setdefault(vid, [])

@@ -23,6 +23,36 @@ class AssignResult:
     auth: str = ""  # fid-scoped upload JWT when the master signs (jwt.go)
 
 
+async def http_assign(
+    http, master: str, count: int = 1, collection: str = ""
+) -> AssignResult:
+    """One `/dir/assign` over a FastHTTPClient-shaped keep-alive pool —
+    the HTTP twin of the gRPC :func:`assign`, shared by the benchmark
+    clients' leases (`command/benchmark.py`, bench.py's open-loop leg).
+    Status is checked BEFORE parsing: a non-JSON error body (plain-text
+    500, dropped connection) must report the status, not die as a
+    JSONDecodeError that hides it."""
+    import json
+
+    target = "/dir/assign"
+    if collection:
+        target += f"?collection={collection}"
+    sep = "&" if "?" in target else "?"
+    st, body = await http.request("GET", master, f"{target}{sep}count={count}")
+    if st != 200:
+        raise RuntimeError(f"assign: {st} {body[:200]!r}")
+    ar = json.loads(body)
+    if ar.get("error"):
+        raise RuntimeError(f"assign: {st} {ar}")
+    return AssignResult(
+        fid=ar["fid"],
+        url=ar["url"],
+        public_url=ar.get("publicUrl", ar["url"]),
+        count=int(ar.get("count", count)),
+        auth=ar.get("auth", ""),
+    )
+
+
 async def assign(
     master: str,
     count: int = 1,

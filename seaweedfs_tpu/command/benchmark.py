@@ -12,7 +12,6 @@ in a 0.1ms-bucket histogram with the same percentile table.
 from __future__ import annotations
 
 import asyncio
-import json
 import random
 import time
 from collections import deque
@@ -20,7 +19,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..client import MasterClient
-from ..client.operation import AssignLease, AssignResult
+from ..client.operation import AssignLease, http_assign
 from ..util.fasthttp import FastHTTPClient, build_multipart
 
 
@@ -106,6 +105,7 @@ async def run_benchmark(
     stats_out: Optional[dict] = None,
     fids_in: Optional[list] = None,
     assign_batch: int = 1,
+    read_fanout: bool = False,
 ) -> str:
     """Returns the human report; when `stats_out` is given it also receives
     {write_qps, write_failed, read_qps, read_failed, write_stats,
@@ -120,7 +120,12 @@ async def run_benchmark(
     assign_batch > 1 leases file ids in count=N batches through an
     AssignLease (the reference benchmark's fid-reuse trick,
     ref: weed/command/benchmark.go), amortizing the per-write master
-    round-trip to 1/N of a request."""
+    round-trip to 1/N of a request.
+
+    read_fanout=True routes reads through client.read_fanout.ReplicaReader
+    — round-robin across replica locations with hedge-on-p99-timeout — so
+    skewed read load spreads across holders instead of pinning one server
+    (stats_out then also carries `read_fanout` hedge counters)."""
     out = []
     mc = MasterClient("benchmark", [master])
     await mc.start()
@@ -128,10 +133,6 @@ async def run_benchmark(
         await mc.wait_connected()
         fids: list[str] = list(fids_in) if fids_in else []
         http = FastHTTPClient(pool_per_host=concurrency + 4)
-        assign_base = (
-            "/dir/assign?collection=" + collection if collection
-            else "/dir/assign"
-        )
         if do_write:
             stats = Stats("Writing Benchmark")
             # write-path attribution: each write's latency is partitioned
@@ -145,21 +146,8 @@ async def run_benchmark(
             # visible in the closed-loop profile
             queue: deque = deque()
 
-            async def fetch_lease(count: int) -> AssignResult:
-                sep = "&" if "?" in assign_base else "?"
-                st, body = await http.request(
-                    "GET", master, f"{assign_base}{sep}count={count}"
-                )
-                ar = json.loads(body)
-                if st != 200 or ar.get("error"):
-                    raise RuntimeError(f"assign: {st} {ar}")
-                return AssignResult(
-                    fid=ar["fid"],
-                    url=ar["url"],
-                    public_url=ar.get("publicUrl", ar["url"]),
-                    count=int(ar.get("count", count)),
-                    auth=ar.get("auth", ""),
-                )
+            async def fetch_lease(count: int):
+                return await http_assign(http, master, count, collection)
 
             lease = (
                 AssignLease(fetch=fetch_lease, batch=assign_batch)
@@ -257,6 +245,11 @@ async def run_benchmark(
         if do_read and fids:
             stats = Stats("Randomly Reading Benchmark")
             reads = deque(random.choice(fids) for _ in range(num_files))
+            fan = None
+            if read_fanout:
+                from ..client.read_fanout import ReplicaReader
+
+                fan = ReplicaReader(http, mc.vid_map)
 
             async def reader() -> None:
                 while True:
@@ -266,6 +259,28 @@ async def run_benchmark(
                         return
                     t0 = time.perf_counter()
                     try:
+                        if fan is not None:
+                            # replica fan-out: round-robin + p99 hedging
+                            try:
+                                st, data = await fan.read(fid)
+                            except LookupError:
+                                # vid cache hasn't learned a freshly-
+                                # grown volume yet: same master-RPC
+                                # fallback as the non-fanout path, which
+                                # also teaches the vid map for next time
+                                url = await mc.lookup_file_id_async(fid)
+                                hp = url.removeprefix(
+                                    "http://"
+                                ).partition("/")[0]
+                                st, data = await http.request(
+                                    "GET", hp, "/" + fid
+                                )
+                            if st != 200:
+                                raise RuntimeError(f"read {fid}: {st}")
+                            stats.record(
+                                time.perf_counter() - t0, len(data)
+                            )
+                            continue
                         # cache hit normally; falls back to a master RPC
                         # when the vid cache hasn't learned a
                         # freshly-grown volume yet. The hit path picks the
@@ -297,6 +312,8 @@ async def run_benchmark(
                 )
                 stats_out["read_failed"] = stats.failed
                 stats_out["read_stats"] = stats
+                if fan is not None:
+                    stats_out["read_fanout"] = fan.stats()
         if stats_out is not None:
             stats_out["fids"] = fids
         await http.close()

@@ -6,4 +6,10 @@
 - index_kernel: vectorized fid -> (offset, size) probes over sorted index
   snapshots (replaces CompactMap's per-request binary search,
   ref: weed/storage/needle_map/compact_map.go:145).
+
+Also home to the serving-plane load machinery that exercises those paths:
+
+- loadgen: open-loop (Poisson-arrival, coordinated-omission-corrected)
+  load generation with zipfian key popularity and log-bucketed latency
+  histograms — the `serving.open_loop` bench leg's engine.
 """

@@ -1,0 +1,283 @@
+"""Open-loop load generation for the serving planes.
+
+The closed-loop benchmark (`command/benchmark.py`) measures throughput the
+way `weed benchmark` does: `c` clients in lock-step, each waiting for its
+own response before sending the next request. That shape hides coordinated
+omission entirely — when the server stalls, the clients stop *offering*
+load, so the stall never shows up in the latency record — and its uniform
+key popularity resembles no production workload (the paper's whole
+Haystack premise is that hot-object skew exists and should be exploited).
+
+This module is the open-loop complement (the wrk2 discipline, and the
+methodology the online-EC characterization study — arXiv 1709.05365 —
+uses to publish tail latency under realistic arrival processes):
+
+- arrivals follow a Poisson process at a configured *offered* rate,
+  independent of how the server is doing;
+- each operation's latency is measured from its **scheduled arrival
+  time**, not from when a worker got around to sending it — so a stalled
+  server back-pressures the schedule and the queueing delay lands in the
+  histogram (the coordinated-omission correction);
+- key popularity is zipfian (exponent `s`, default 1.1) with an optional
+  uniform "cold scan" fraction, and payload sizes draw from a weighted
+  size distribution;
+- latencies land in a log-bucketed histogram whose relative error is
+  bounded by the bucket growth factor at every percentile, p999 included.
+
+Brownouts ride the existing fault plan (`util/faults.brownout`): a ramped
+latency rule over a time window on the HTTP client seam degrades the
+measured path mid-run without touching server code.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Optional
+
+import numpy as np
+
+
+class LogHistogram:
+    """Log-bucketed latency histogram: bucket i covers
+    [base * growth**i, base * growth**(i+1)).
+
+    With the defaults (growth=1.25, 96 buckets from 1µs) every
+    percentile — p50 through p999 — is reported with <= 25% relative
+    error over a 1µs..~2000s span, so recording is one log + one
+    increment and the tail is as trustworthy as the median (a
+    linear-bucket table either truncates the tail or loses the head)."""
+
+    __slots__ = ("base", "growth", "_log_g", "counts", "count", "total", "max")
+
+    def __init__(self, base: float = 1e-6, growth: float = 1.25, n_buckets: int = 96):
+        self.base = base
+        self.growth = growth
+        self._log_g = math.log(growth)
+        self.counts = [0] * n_buckets
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+
+    def record(self, seconds: float) -> None:
+        if seconds < self.base:
+            i = 0
+        else:
+            i = min(
+                int(math.log(seconds / self.base) / self._log_g),
+                len(self.counts) - 1,
+            )
+        self.counts[i] += 1
+        self.count += 1
+        self.total += seconds
+        if seconds > self.max:
+            self.max = seconds
+
+    def percentile(self, p: float) -> float:
+        """Latency (seconds) at percentile p in [0, 100]: the geometric
+        midpoint of the covering bucket (upper-bounded by the observed
+        max, so a lone outlier reports itself, not its bucket ceiling)."""
+        if self.count == 0:
+            return 0.0
+        target = self.count * p / 100.0
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if cum >= target and c:
+                if i == len(self.counts) - 1:
+                    # overflow bucket: its midpoint means nothing — the
+                    # observed max is the only honest answer there
+                    return self.max
+                mid = self.base * self.growth ** (i + 0.5)
+                return min(mid, self.max) if self.max else mid
+        return self.max
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def merge(self, other: "LogHistogram") -> None:
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.total += other.total
+        self.max = max(self.max, other.max)
+
+    def summary_ms(self) -> dict:
+        """The publishable block: p50/p99/p999 (+ mean/max) in ms."""
+        return {
+            "p50_ms": round(self.percentile(50) * 1e3, 3),
+            "p99_ms": round(self.percentile(99) * 1e3, 3),
+            "p999_ms": round(self.percentile(99.9) * 1e3, 3),
+            "mean_ms": round(self.mean * 1e3, 3),
+            "max_ms": round(self.max * 1e3, 3),
+            "count": self.count,
+        }
+
+
+class ZipfKeys:
+    """Zipfian popularity over `n` keys: rank r is drawn with probability
+    proportional to 1/r**s, and ranks are mapped to key indices through a
+    seeded permutation so the hot set spreads across volumes instead of
+    clustering at the low fids.
+
+    `cold_fraction` of draws bypass the zipf law and pick uniformly over
+    the whole key space — the "cold scan" share of a production mix
+    (backups, crawlers) that keeps a cache honest about its miss path.
+    Sampling is vectorized: draw(k) binary-searches k uniforms against the
+    precomputed CDF."""
+
+    def __init__(
+        self,
+        n: int,
+        s: float = 1.1,
+        seed: int = 0,
+        cold_fraction: float = 0.0,
+    ):
+        if n <= 0:
+            raise ValueError("ZipfKeys needs n >= 1")
+        self.n = n
+        self.s = s
+        self.cold_fraction = cold_fraction
+        self._rng = np.random.default_rng(seed)
+        weights = 1.0 / np.arange(1, n + 1, dtype=np.float64) ** s
+        self._cdf = np.cumsum(weights)
+        self._cdf /= self._cdf[-1]
+        self._perm = self._rng.permutation(n)
+
+    def draw(self, k: int) -> np.ndarray:
+        """k key indices in [0, n) — zipf-popular through the permutation,
+        with the configured cold fraction drawn uniformly."""
+        u = self._rng.random(k)
+        ranks = np.searchsorted(self._cdf, u, side="left")
+        if self.cold_fraction > 0.0:
+            cold = self._rng.random(k) < self.cold_fraction
+            ranks[cold] = self._rng.integers(0, self.n, int(cold.sum()))
+        return self._perm[np.minimum(ranks, self.n - 1)]
+
+    def hot_share(self, top_fraction: float = 0.01) -> float:
+        """Probability mass on the hottest `top_fraction` of keys — the
+        skew statement a cache-hit-rate claim is judged against."""
+        top = max(1, int(self.n * top_fraction))
+        return float(self._cdf[top - 1])
+
+
+@dataclass
+class SizeDist:
+    """Weighted payload-size mix; default approximates a small-object
+    photo/thumbnail store (mostly ~1KB, a long tail of larger blobs)."""
+
+    choices: tuple = ((1024, 0.90), (4096, 0.08), (32768, 0.02))
+    seed: int = 0
+    _rng: object = field(default=None, repr=False)
+
+    def __post_init__(self):
+        self._rng = np.random.default_rng(self.seed)
+        self._sizes = np.array([c[0] for c in self.choices])
+        w = np.array([c[1] for c in self.choices], dtype=np.float64)
+        self._p = w / w.sum()
+
+    def draw(self, k: int) -> np.ndarray:
+        return self._rng.choice(self._sizes, size=k, p=self._p)
+
+
+@dataclass
+class OpenLoopResult:
+    offered_rate: float
+    duration: float
+    completed: int = 0
+    failed: int = 0
+    hist: LogHistogram = field(default_factory=LogHistogram)
+
+    @property
+    def achieved_rate(self) -> float:
+        return self.completed / self.duration if self.duration > 0 else 0.0
+
+    def summary(self) -> dict:
+        out = {
+            "offered_qps": round(self.offered_rate),
+            "achieved_qps": round(self.achieved_rate),
+            "achieved_over_offered": round(
+                self.achieved_rate / self.offered_rate, 3
+            )
+            if self.offered_rate
+            else 0.0,
+            "completed": self.completed,
+            "failed": self.failed,
+            **self.hist.summary_ms(),
+        }
+        return out
+
+
+def arrival_count(rate: float, duration: float) -> int:
+    """How many arrivals run_open_loop will schedule for (rate, duration)
+    — the single owner of that formula, so callers pre-sizing per-arrival
+    inputs (key schedules) can never drift out of lock-step with it."""
+    return max(1, int(rate * duration))
+
+
+async def run_open_loop(
+    op: Callable[[int], Awaitable[bool]],
+    rate: float,
+    duration: float,
+    seed: int = 0,
+    workers: int = 256,
+    result: Optional[OpenLoopResult] = None,
+    now: Callable[[], float] = time.perf_counter,
+) -> OpenLoopResult:
+    """Drive `op` at a Poisson-arrival offered `rate` for `duration`
+    seconds; returns latency/throughput stats.
+
+    `op(i)` performs the i-th operation and returns truthy on success.
+    Latency for arrival i is `completion_time - scheduled_arrival_time` —
+    the coordinated-omission-corrected number: when the server (or the
+    single shared core) falls behind, the schedule does NOT stretch, so
+    queueing delay is charged to the requests that experienced it.
+
+    The loop is open in the offered-load sense — arrivals keep coming at
+    the configured rate no matter how slow responses are — realized as a
+    fixed worker pool draining the precomputed arrival schedule (the wrk2
+    construction). `workers` bounds in-flight requests so a dying server
+    degrades into honest multi-second recorded latencies instead of an
+    unbounded task pile; with workers >> rate x typical-latency the pool
+    never gates arrivals.
+    """
+    res = result or OpenLoopResult(offered_rate=rate, duration=duration)
+    n = arrival_count(rate, duration)
+    rng = np.random.default_rng(seed)
+    # Poisson process: exponential inter-arrival gaps at 1/rate mean
+    # (.tolist(): python floats index faster and keep np scalars out of
+    # the recorded latencies / JSON summaries)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n)).tolist()
+    hist = res.hist
+    idx = [0]
+    t0 = now()
+
+    async def worker() -> None:
+        while True:
+            i = idx[0]
+            if i >= n:
+                return
+            idx[0] = i + 1
+            sched = arrivals[i]
+            delay = t0 + sched - now()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            try:
+                ok = await op(i)
+            except Exception:
+                ok = False
+            # CO correction: latency from the SCHEDULED arrival
+            hist.record(now() - (t0 + sched))
+            if ok:
+                res.completed += 1
+            else:
+                res.failed += 1
+
+    await asyncio.gather(*(worker() for _ in range(min(workers, n))))
+    # the true duration is schedule span or wall, whichever is longer
+    # (a backlogged run keeps completing past the last arrival)
+    res.duration = max(duration, now() - t0)
+    return res

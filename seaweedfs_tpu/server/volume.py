@@ -42,11 +42,186 @@ from ..util.fasthttp import (
     parse_multipart,
     render_response,
 )
-from ..util.metrics import REQUEST_COUNTER, WRITE_STAGE_SECONDS
+from ..util.metrics import (
+    READ_CACHE_BYTES,
+    READ_CACHE_EVICTIONS,
+    READ_CACHE_HITS,
+    READ_CACHE_MISSES,
+    READ_STAGE_SECONDS,
+    REQUEST_COUNTER,
+    WRITE_STAGE_SECONDS,
+)
 from .volume_ec import EcHandlers
 
 
 _NEEDS_FULL_APP = object()  # needle shape the fast tier doesn't serve
+
+# pre-assembled response head for the common read shape (no
+# Last-Modified): one %-format replaces the 9-piece render_response
+# join + etag()-hex-str round-trip, measurable at read QPS rates.
+# %08x of the u32 checksum == u32_to_bytes(checksum).hex() (both BE).
+_HEAD_200 = (
+    b"HTTP/1.1 200 OK\r\n"
+    b"Content-Type: %b\r\n"
+    b"Content-Length: %d\r\n"
+    b'Etag: "%08x"\r\n'
+    b"Accept-Ranges: bytes\r\n"
+    b"Connection: keep-alive\r\n\r\n"
+)
+
+# hot-needle cache sizing: capacity from the env (MB; 0 disables), entry
+# bodies capped so one large blob cannot monopolize the LRU
+READ_CACHE_BYTES_CAP = int(
+    float(os.environ.get("SEAWEEDFS_TPU_READ_CACHE_MB", "64") or 0) * (1 << 20)
+)
+READ_CACHE_MAX_ENTRY = 128 * 1024
+
+
+class HotNeedleCache:
+    """Byte-bounded LRU of whole small needle responses keyed by
+    (vid, key, cookie) — the serving read plane exploiting zipfian skew
+    (the `DegradedIntervalCache` pattern from volume_ec.py applied to the
+    hot path in front of the volume tier).
+
+    Entries carry the pre-rendered wire response (status line + headers +
+    body in ONE bytes object, the same zero-copy write shape the
+    pre-rendered-head path produces) plus the (volume object, offset_units,
+    size) the record was parsed from. A hit is served only while BOTH
+    still hold:
+
+    - the SAME Volume object is mounted (vacuum-commit, repair recopy and
+      remounts swap the object, so their entries can never resurface), and
+    - the live needle map still points the key at the same
+      (offset_units, size): the .dat is append-only, so an unchanged
+      location means unchanged bytes; any overwrite moves the entry to a
+      new offset and any delete tombstones it.
+
+    That makes hits byte-identical to uncached reads by construction —
+    even for mutations that bypass the server layer entirely. The
+    explicit invalidation hooks (overwrite/delete/vacuum-commit) exist on
+    top so the LRU sheds dead entries instead of carrying them to
+    eviction. TTL'd needles are never cached (expiry is a read-time
+    decision the cache cannot replay)."""
+
+    def __init__(self, capacity_bytes: int = READ_CACHE_BYTES_CAP,
+                 max_entry: int = READ_CACHE_MAX_ENTRY):
+        import threading
+        import weakref
+        from collections import OrderedDict
+
+        self.capacity = capacity_bytes
+        self.max_entry = max_entry
+        # (vid, key) -> (vol_ref, cookie, offset_units, size, resp, head_len)
+        # — one live record per needle key, so the cookie lives in the
+        # entry (hit requires a match) and per-key invalidation is O(1)
+        self._entries: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self._weakref = weakref.ref
+        self._hits = READ_CACHE_HITS.child()
+        self._misses = READ_CACHE_MISSES.child()
+        self._served = READ_CACHE_BYTES.child()
+        # plain ints alongside the registry counters: the bench reads the
+        # hit rate without scraping /metrics (GIL-atomic increments)
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, v, vid: int, key: int, cookie: int, head_only: bool):
+        """The response bytes for a cached needle, or None. `v` is the
+        currently-mounted Volume the caller resolved for vid."""
+        k = (vid, key)
+        with self._lock:
+            e = self._entries.get(k)
+            if e is not None:
+                self._entries.move_to_end(k)
+        if e is None:
+            self.misses += 1
+            self._misses.inc()
+            return None
+        vol_ref, e_cookie, offset_units, size, resp, head_len = e
+        if e_cookie != cookie:
+            # wrong cookie is a REQUEST property, not staleness: the
+            # uncached path owns the 404; the entry stays for valid reads
+            self.misses += 1
+            self._misses.inc()
+            return None
+        # freshness: same volume object AND the live map still points here
+        if vol_ref() is not v or v.locate_live(key) != (offset_units, size):
+            with self._lock:
+                cur = self._entries.get(k)
+                if cur is e:
+                    del self._entries[k]
+                    self._bytes -= len(resp)
+            READ_CACHE_EVICTIONS.inc(reason="stale")
+            self.misses += 1
+            self._misses.inc()
+            return None
+        self.hits += 1
+        self._hits.inc()
+        out = resp[:head_len] if head_only else resp
+        self._served.inc(len(out))
+        return out
+
+    def put(
+        self, v, vid: int, n, offset_units: int, size: int, resp: bytes,
+        head_len: int,
+    ) -> None:
+        """Admit one rendered response. Caller guarantees `resp` is the
+        simple GET shape (pre-rendered head + raw body) parsed from
+        (offset_units, size) of `v`'s .dat."""
+        if len(resp) > self.max_entry or n.has_ttl():
+            return
+        k = (vid, n.id)
+        entry = (
+            self._weakref(v), n.cookie, offset_units, size, bytes(resp),
+            head_len,
+        )
+        with self._lock:
+            old = self._entries.pop(k, None)
+            if old is not None:
+                self._bytes -= len(old[4])
+            self._entries[k] = entry
+            self._bytes += len(resp)
+            evicted = 0
+            while self._bytes > self.capacity and self._entries:
+                _k, e = self._entries.popitem(last=False)
+                self._bytes -= len(e[4])
+                evicted += 1
+        if evicted:
+            READ_CACHE_EVICTIONS.inc(evicted, reason="lru")
+
+    def invalidate_key(self, vid: int, key: int, reason: str = "overwrite") -> None:
+        """Drop one needle's entry (overwrite/delete hooks)."""
+        with self._lock:
+            e = self._entries.pop((vid, key), None)
+            if e is not None:
+                self._bytes -= len(e[4])
+        if e is not None:
+            READ_CACHE_EVICTIONS.inc(reason=reason)
+
+    def invalidate_volume(self, vid: int, reason: str = "vacuum") -> int:
+        """Drop every entry of a volume (vacuum-commit swap, repair
+        recopy, unmount); returns how many entries were dropped."""
+        with self._lock:
+            doomed = [k for k in self._entries if k[0] == vid]
+            for k in doomed:
+                self._bytes -= len(self._entries.pop(k)[4])
+        if doomed:
+            READ_CACHE_EVICTIONS.inc(len(doomed), reason=reason)
+        return len(doomed)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {"entries": len(self._entries), "bytes": self._bytes}
+        out["hits"] = self.hits
+        out["misses"] = self.misses
+        total = self.hits + self.misses
+        out["hit_rate"] = round(self.hits / total, 4) if total else 0.0
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
 
 
 def _parse_fid_path_cached(path: str):
@@ -174,6 +349,19 @@ class VolumeServer(EcHandlers):
                     batch_lookup
                 ],
             )
+        # hot-needle read cache (ISSUE 6): whole small responses in front
+        # of the volume tier, byte-bounded by SEAWEEDFS_TPU_READ_CACHE_MB
+        # (0 disables); correctness comes from the per-hit map validation,
+        # not from the env default
+        self.read_cache = (
+            HotNeedleCache() if READ_CACHE_BYTES_CAP > 0 else None
+        )
+        # read-path stage attribution, pre-bound (tuple(sorted(labels))
+        # per request was measurable at write QPS; reads are hotter)
+        self._stage_cache_hit = READ_STAGE_SECONDS.child(stage="cache_hit")
+        self._stage_read_render = READ_STAGE_SECONDS.child(
+            stage="read_render"
+        )
 
     def _group_committer(self, vid: int):
         gc = self._group_committers.get(vid)
@@ -416,6 +604,13 @@ class VolumeServer(EcHandlers):
         v = self.store.find_volume(vid)
         if v is None or v.has_remote_file:
             return FALLBACK  # EC / tiered / redirect paths
+        t0 = time.perf_counter()
+        cache = self.read_cache
+        if cache is not None:
+            out = cache.get(v, vid, fid.key, fid.cookie, head_only)
+            if out is not None:
+                self._stage_cache_hit.observe(time.perf_counter() - t0)
+                return out
         if self.lookup_gate is not None:
             # batched serving path (north-star #2): the index probe joins
             # the gate's micro-batch, and the WHOLE continuation (pread ->
@@ -427,6 +622,11 @@ class VolumeServer(EcHandlers):
                 if out is None:  # complex needle: full app takes over
                     finish_detached_proxy(self._fast_server, req)
                 else:
+                    # gated misses are read_render too: gate wait + probe
+                    # + pread + render, wall from request entry
+                    self._stage_read_render.observe(
+                        time.perf_counter() - t0
+                    )
                     self._count_fast(req.method)
                     finish_detached(req, out)
 
@@ -435,7 +635,7 @@ class VolumeServer(EcHandlers):
         try:
             # direct volume read: v is already resolved, and the by-key
             # form skips the shell-needle + per-field merge of read_needle
-            n = v.read_needle_by_key(fid.key)
+            n, off_units, size = v.read_needle_by_key_located(fid.key)
         except (NotFound, NotFoundError, AlreadyDeleted, LookupError):
             return render_response(
                 404, b'{"error": "not found"}', head_only=head_only
@@ -443,7 +643,31 @@ class VolumeServer(EcHandlers):
         except Exception:
             return FALLBACK
         out = self._render_needle(n, fid, head_only)
-        return FALLBACK if out is _NEEDS_FULL_APP else out
+        if out is _NEEDS_FULL_APP:
+            return FALLBACK
+        self._maybe_cache_fill(
+            cache, v, vid, fid, n, off_units, size, out, head_only
+        )
+        self._stage_read_render.observe(time.perf_counter() - t0)
+        return out
+
+    def _maybe_cache_fill(
+        self, cache, v, vid, fid, n, off_units, size, out, head_only
+    ) -> None:
+        """Admit a just-rendered simple-shape GET response into the
+        hot-needle cache. `out` must be the pre-rendered head + raw body
+        join `_render_needle` produces for the no-Last-Modified shape;
+        anything else (HEAD, TTL'd, cookie-mismatch 404s) is skipped."""
+        if (
+            cache is None
+            or head_only
+            or n.last_modified
+            or n.cookie != fid.cookie
+            or n.is_chunked_manifest()
+            or n.is_compressed()
+        ):
+            return
+        cache.put(v, vid, n, off_units, size, out, len(out) - len(n.data))
 
     def _render_gated(self, v, vid, fid, head_only, loc, exc) -> bytes:
         """Response bytes for a gated read, run inside the gate's flush."""
@@ -475,7 +699,14 @@ class VolumeServer(EcHandlers):
                 n = Needle(id=fid.key)
                 self.store.read_volume_needle(vid, n)
             out = self._render_needle(n, fid, head_only)
-            return None if out is _NEEDS_FULL_APP else out
+            if out is _NEEDS_FULL_APP:
+                return None
+            if not stale:
+                self._maybe_cache_fill(
+                    self.read_cache, v, vid, fid, n, offset_units, size,
+                    out, head_only,
+                )
+            return out
         except (NotFound, NotFoundError, AlreadyDeleted, LookupError):
             return render_response(
                 404, b'{"error": "not found"}', head_only=head_only
@@ -485,18 +716,8 @@ class VolumeServer(EcHandlers):
                 500, b'{"error": "internal error"}', head_only=head_only
             )
 
-    # pre-assembled response head for the common read shape (no
-    # Last-Modified): one %-format replaces the 9-piece render_response
-    # join + etag()-hex-str round-trip, measurable at read QPS rates.
-    # %08x of the u32 checksum == u32_to_bytes(checksum).hex() (both BE).
-    _HEAD_200 = (
-        b"HTTP/1.1 200 OK\r\n"
-        b"Content-Type: %b\r\n"
-        b"Content-Length: %d\r\n"
-        b'Etag: "%08x"\r\n'
-        b"Accept-Ranges: bytes\r\n"
-        b"Connection: keep-alive\r\n\r\n"
-    )
+    # the module-level pre-assembled head (see _HEAD_200 above)
+    _HEAD_200 = _HEAD_200
 
     def _render_needle(self, n, fid, head_only):
         if n.cookie != fid.cookie:
@@ -573,6 +794,8 @@ class VolumeServer(EcHandlers):
             return render_response(
                 500, _json.dumps({"error": str(e)}).encode()
             )
+        if self.read_cache is not None:
+            self.read_cache.invalidate_key(vid, fid.key, "overwrite")
         if filename and (
             '"' in filename or "\\" in filename or not filename.isprintable()
         ):
@@ -1085,6 +1308,8 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
         WRITE_STAGE_SECONDS.observe(
             time.perf_counter() - t0, stage="local_append"
         )
+        if self.read_cache is not None:
+            self.read_cache.invalidate_key(vid, fid.key, "overwrite")
         if rep_task is not None:
             t1 = time.perf_counter()
             err = await rep_task
@@ -1123,6 +1348,8 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
                 # whole cascade (ref volume_server_handlers_write.go)
                 await self._delete_manifest_chunks(check)
             size = self.store.delete_volume_needle(vid, n)
+            if self.read_cache is not None:
+                self.read_cache.invalidate_key(vid, fid.key, "delete")
             if not is_replicate:
                 await self._replicate(request, vid, "DELETE", b"")
             return web.json_response({"size": size}, status=202)
@@ -1289,11 +1516,17 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
         return {}
 
     async def _grpc_volume_unmount(self, req, context) -> dict:
-        self.store.unmount_volume(int(req["volume_id"]))
+        vid = int(req["volume_id"])
+        self.store.unmount_volume(vid)
+        if self.read_cache is not None:
+            self.read_cache.invalidate_volume(vid, "unmount")
         return {}
 
     async def _grpc_volume_delete(self, req, context) -> dict:
-        self.store.delete_volume(int(req["volume_id"]))
+        vid = int(req["volume_id"])
+        self.store.delete_volume(vid)
+        if self.read_cache is not None:
+            self.read_cache.invalidate_volume(vid, "volume_delete")
         return {}
 
     async def _grpc_volume_mark_readonly(self, req, context) -> dict:
@@ -1386,6 +1619,11 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
             for loc in self.store.locations:
                 if loc.find_volume(vid) is not None:
                     loc.volumes[vid] = new_v
+            # the swap rewrote the .dat: cached responses must not outlive
+            # it (the per-hit volume-identity check would catch any that
+            # did, but the LRU should shed them now, not at eviction)
+            if self.read_cache is not None:
+                self.read_cache.invalidate_volume(vid, "vacuum")
             # the garbage ratio (and digest) just changed: ride the next
             # heartbeat pulse so the master's vacuum queue prunes this
             # volume instead of re-dispatching off stale state
@@ -1432,6 +1670,10 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
                 fid = FileId.parse(fid_str)
                 n = Needle(id=fid.key, cookie=fid.cookie)
                 size = self.store.delete_volume_needle(fid.volume_id, n)
+                if self.read_cache is not None:
+                    self.read_cache.invalidate_key(
+                        fid.volume_id, fid.key, "delete"
+                    )
                 results.append({"file_id": fid_str, "status": 202, "size": size})
             except Exception as e:
                 results.append({"file_id": fid_str, "status": 500, "error": str(e)})
@@ -1807,6 +2049,9 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
             )
         except Exception as e:
             return {"error": f"apply incremental: {e}"}
+        if self.read_cache is not None:
+            # replayed records may overwrite cached keys
+            self.read_cache.invalidate_volume(vid, "tail_sync")
         ANTIENTROPY_RESYNCS.inc(kind="tail_sync")
         # the digest changed: let the master see the converged state on
         # the next pulse instead of the next full reconnect
@@ -1878,6 +2123,8 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
         new_v = self.store.find_volume(vid)
         if new_v is None:
             return {"error": f"volume {vid} did not remount after repair"}
+        if self.read_cache is not None:
+            self.read_cache.invalidate_volume(vid, "repair")
         ANTIENTROPY_RESYNCS.inc(kind="recopy")
         self.store.note_volume_changed(
             old_msg, self.store._volume_message(new_v)

@@ -685,6 +685,14 @@ class Volume:
         returning the hydrated needle directly. Same semantics as
         read_needle without the caller-allocated shell needle and the
         per-field dict merge (both measurable at read-QPS rates)."""
+        return self.read_needle_by_key_located(key)[0]
+
+    def read_needle_by_key_located(self, key: int) -> tuple[Needle, int, int]:
+        """read_needle_by_key plus the (offset_units, size) the record was
+        served from. The location is the hot-needle cache's validity
+        token: a later hit is legal only while the live map still points
+        the key at the same location (append-only .dat ⇒ same location,
+        same bytes; any overwrite/delete moves or tombstones the entry)."""
         with self._lock:
             nv = self.nm.get(key)
             if nv is None or nv.offset_units == 0:
@@ -692,14 +700,28 @@ class Volume:
             if nv.size == TOMBSTONE_FILE_SIZE:
                 raise AlreadyDeleted(f"needle {key} already deleted")
             if nv.size == 0:
-                return Needle(id=key)
+                return Needle(id=key), nv.offset_units, 0
             n = read_needle_data(
                 self.data_backend, to_actual_offset(nv.offset_units), nv.size, self.version
             )
         if n.has_ttl() and n.ttl is not None and n.ttl.minutes:
             if n.has_last_modified_date() and time.time() >= n.last_modified + n.ttl.minutes * 60:
                 raise NotFound(f"needle {key} expired")
-        return n
+        return n, nv.offset_units, nv.size
+
+    def locate_live(self, key: int):
+        """(offset_units, size) of the key's live record, or None when the
+        key is absent/deleted. One locked map probe — the hot-needle
+        cache's per-hit freshness check."""
+        with self._lock:
+            nv = self.nm.get(key)
+        if (
+            nv is None
+            or nv.offset_units == 0
+            or nv.size == TOMBSTONE_FILE_SIZE
+        ):
+            return None
+        return nv.offset_units, nv.size
 
     def bulk_lookup(self, keys, use_device: Optional[bool] = None):
         """Batched fid -> (offset, size) index probes.

@@ -1008,6 +1008,12 @@ class FastHTTPClient:
             fut = conn.begin()
             conn.transport.write(wire)
             status, resp_body, reusable = await fut
+        except asyncio.CancelledError:
+            # a cancelled request (hedged read losing its race) leaves the
+            # response half-read on the wire: the connection must die, not
+            # linger open outside the pool
+            conn.transport.close()
+            raise
         except (ConnectionError, asyncio.IncompleteReadError, OSError):
             conn.transport.close()
             if retried:

@@ -87,11 +87,61 @@ class FaultRule:
     # or the byte a bitflip corrupts)
     status: int = 503  # synthesized status for http_error
     bits: int = 1  # bits flipped by a bitflip fault
+    # time-windowed rules (brownouts): active only while the plan clock is
+    # in [from_s, until_s) seconds since install; ramp=True scales a
+    # latency rule's delay triangularly over the window (0 at the edges,
+    # `delay` at the midpoint — degrade in, peak, recover)
+    from_s: Optional[float] = None
+    until_s: Optional[float] = None
+    ramp: bool = False
 
     def max_fires(self) -> Optional[int]:
         if self.times is not None:
             return self.times
         return 1 if self.nth is not None else None
+
+    def window_factor(self, t: float) -> Optional[float]:
+        """Delay scale at plan-relative time t: None when the rule is
+        outside its window (inactive), 1.0 for unwindowed/unramped rules,
+        else the triangular ramp position."""
+        if self.from_s is None and self.until_s is None:
+            return 1.0
+        lo = self.from_s or 0.0
+        hi = self.until_s if self.until_s is not None else float("inf")
+        if not lo <= t < hi:
+            return None
+        if not self.ramp or hi == float("inf"):
+            return 1.0
+        mid = (t - lo) / (hi - lo)  # 0..1 across the window
+        return 1.0 - abs(2.0 * mid - 1.0)
+
+
+def brownout(
+    op: str = "http:*",
+    target: str = "*",
+    delay: float = 0.2,
+    start: float = 0.0,
+    duration: float = 5.0,
+    probability: float = 1.0,
+) -> FaultRule:
+    """Convenience constructor for a brownout: a ramped latency rule over
+    a time window. For `duration` seconds beginning `start` seconds after
+    the plan is installed, matching operations see injected latency that
+    ramps 0 → `delay` → 0 triangularly across the window — the shape of a
+    peer degrading (GC storm, thermal throttle, noisy neighbour) and
+    recovering, as opposed to the step function a bare latency rule
+    injects. Load harnesses and chaos tests were hand-rolling latency
+    schedules for this; see docs/robustness.md's fault matrix."""
+    return FaultRule(
+        op=op,
+        target=target,
+        fault="latency",
+        probability=probability,
+        delay=delay,
+        from_s=start,
+        until_s=start + duration,
+        ramp=True,
+    )
 
 
 @dataclass
@@ -102,6 +152,8 @@ class FaultEvent:
     op: str
     target: str
     rng: Random  # rule-scoped; seams draw torn-write cut points from it
+    delay: float = 0.0  # effective delay for latency/hang rules: the
+    # rule's delay scaled by its window ramp at fire time
 
     @property
     def kind(self) -> str:
@@ -117,9 +169,15 @@ class FaultPlan:
         self._fire_counts: list[int] = []
         self._rngs: list[Random] = []
         self._dead = False
+        # windowed rules (brownouts) measure time from this epoch;
+        # install_plan restarts it so windows are install-relative
+        self.epoch = time.monotonic()
         self.events: list[tuple[str, str, str]] = []  # (op, target, kind)
         for r in rules or []:
             self.add(r)
+
+    def restart_clock(self) -> None:
+        self.epoch = time.monotonic()
 
     def add(self, rule: FaultRule) -> "FaultPlan":
         with self._lock:
@@ -150,8 +208,14 @@ class FaultPlan:
         with self._lock:
             if self._dead:
                 raise SimulatedCrash(f"{op} on {target} after simulated crash")
+            now_rel = time.monotonic() - self.epoch
             for i, rule in enumerate(self.rules):
                 if not fnmatchcase(op, rule.op) or not fnmatchcase(target, rule.target):
+                    continue
+                # windowed rules outside their window neither count a
+                # match (nth bookkeeping) nor fire
+                factor = rule.window_factor(now_rel)
+                if factor is None:
                     continue
                 self._match_counts[i] += 1
                 cap = rule.max_fires()
@@ -169,7 +233,10 @@ class FaultPlan:
                 self._fire_counts[i] += 1
                 self.events.append((op, target, rule.fault))
                 FAULTS_INJECTED.inc(op=op.split(":")[0], kind=rule.fault)
-                return FaultEvent(rule=rule, op=op, target=target, rng=self._rngs[i])
+                return FaultEvent(
+                    rule=rule, op=op, target=target, rng=self._rngs[i],
+                    delay=rule.delay * factor,
+                )
         return None
 
     # --- (de)serialization: env-var / JSON-file activation ---
@@ -184,12 +251,15 @@ class FaultPlan:
         out = {"seed": self.seed, "rules": []}
         for r in self.rules:
             rd = {"op": r.op, "target": r.target, "fault": r.fault}
-            for k in ("nth", "probability", "times", "keep", "at_offset"):
+            for k in ("nth", "probability", "times", "keep", "at_offset",
+                      "from_s", "until_s"):
                 v = getattr(r, k)
                 if v is not None:
                     rd[k] = v
             if r.delay:
                 rd["delay"] = r.delay
+            if r.ramp:
+                rd["ramp"] = True
             if r.fault == "http_error":
                 rd["status"] = r.status
             if r.fault == "bitflip" and r.bits != 1:
@@ -205,6 +275,10 @@ _PLAN: Optional[FaultPlan] = None
 
 def install_plan(plan: Optional[FaultPlan]) -> None:
     global _PLAN
+    if plan is not None:
+        # windowed rules (brownouts) run install-relative: a plan built
+        # ahead of time must not have burned its window before activation
+        plan.restart_clock()
     _PLAN = plan
 
 
@@ -253,7 +327,7 @@ def sync_fault(
         return None
     kind = ev.kind
     if kind == "latency":
-        time.sleep(ev.rule.delay)
+        time.sleep(ev.delay)
         return None
     if kind in ("eio", "fsync_fail"):
         raise injected_eio(target)
@@ -313,12 +387,14 @@ async def async_fault(
         return None
     kind = ev.kind
     if kind == "latency":
-        await asyncio.sleep(ev.rule.delay)
+        await asyncio.sleep(ev.delay)
         return None
     if kind == "reset":
         raise ConnectionResetError(f"injected reset: {op} to {target}")
     if kind == "hang":
-        bounds = [w for w in (ev.rule.delay or None, timeout) if w is not None]
+        # the window-scaled effective delay, like latency (a ramped
+        # windowed hang would otherwise silently ignore its ramp)
+        bounds = [w for w in (ev.delay or None, timeout) if w is not None]
         await asyncio.sleep(min(bounds) if bounds else 30.0)
         raise TimeoutError(f"injected hang: {op} to {target}")
     if kind in ("eio",):

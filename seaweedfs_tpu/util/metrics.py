@@ -93,6 +93,9 @@ class Histogram(_Labeled):
 
     def observe(self, value: float, **labels) -> None:
         key = tuple(sorted(labels.items()))
+        self._observe_key(key, value)
+
+    def _observe_key(self, key: tuple, value: float) -> None:
         with self._lock:
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
             # Prometheus le bounds are INCLUSIVE: a value equal to a
@@ -105,6 +108,11 @@ class Histogram(_Labeled):
                 counts[idx] += 1  # cumulative sums computed at render time
             self._sums[key] += value
             self._totals[key] += 1
+
+    def child(self, **labels) -> "_HistogramChild":
+        """Pre-bound label set with an O(1)-overhead observe — the
+        histogram analogue of Counter.child, for per-request hot paths."""
+        return _HistogramChild(self, tuple(sorted(labels.items())))
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
@@ -123,6 +131,17 @@ class Histogram(_Labeled):
                 out.append(f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]}")
                 out.append(f"{self.name}_count{_fmt_labels(key)} {self._totals[key]}")
         return out
+
+
+class _HistogramChild:
+    __slots__ = ("_hist", "_key")
+
+    def __init__(self, hist: Histogram, key: tuple):
+        self._hist = hist
+        self._key = key
+
+    def observe(self, value: float) -> None:
+        self._hist._observe_key(self._key, value)
 
 
 def _fmt_labels(key: tuple, **extra) -> str:
@@ -219,6 +238,35 @@ GROUP_COMMIT_BATCH_SIZE = REGISTRY.histogram(
 GROUP_COMMIT_FSYNCS = REGISTRY.counter(
     "seaweedfs_tpu_group_commit_fsyncs_total",
     "group-commit batches flushed (one fsync each)",
+)
+
+# serving read plane (see docs/perf.md "Serving read plane"): the read
+# path gets the same itemized-stage treatment as writes, and the
+# hot-needle cache in front of the volume tier is externally auditable —
+# hit rate, bytes it absorbed, and the LRU's churn
+READ_STAGE_SECONDS = REGISTRY.histogram(
+    "seaweedfs_tpu_read_stage_seconds",
+    "volume read path stage wall time, by stage (cache_hit = full request "
+    "served from the hot-needle cache; read_render = map probe + pread + "
+    "parse + response render on a miss)",
+)
+READ_CACHE_HITS = REGISTRY.counter(
+    "seaweedfs_tpu_read_cache_hits_total",
+    "reads served whole from the hot-needle cache",
+)
+READ_CACHE_MISSES = REGISTRY.counter(
+    "seaweedfs_tpu_read_cache_misses_total",
+    "cacheable reads that went to the volume tier (includes entries "
+    "invalidated by overwrite/delete/vacuum since they were cached)",
+)
+READ_CACHE_BYTES = REGISTRY.counter(
+    "seaweedfs_tpu_read_cache_bytes_total",
+    "response bytes served from the hot-needle cache",
+)
+READ_CACHE_EVICTIONS = REGISTRY.counter(
+    "seaweedfs_tpu_read_cache_evictions_total",
+    "hot-needle cache entries evicted (LRU byte bound) or invalidated "
+    "(overwrite/delete/vacuum-commit), by reason",
 )
 
 # repair-plane attribution (see docs/perf.md "Repair plane"): rebuild gets

@@ -200,6 +200,67 @@ def test_vacuum_throughput_leg_shape():
     assert vt["live_bytes"] > 0
 
 
+def test_serving_open_loop_leg_shape():
+    """ISSUE 6 guard: the serving.open_loop leg must emit non-zero
+    p50/p99/p999, achieved-vs-offered rate, a cache hit-rate field, and
+    the cached-vs-uncached byte-identity verdict."""
+    ol = bench.measure_serving_open_loop(
+        num_files=400, rate=800, duration=1.5, brownout_leg=True
+    )
+    summ = ol["open_loop"]
+    assert summ["p50_ms"] > 0
+    assert summ["p99_ms"] > 0
+    assert summ["p999_ms"] > 0
+    assert summ["p50_ms"] <= summ["p99_ms"] <= summ["p999_ms"]
+    assert ol["achieved_qps"] > 0
+    assert summ["offered_qps"] > 0
+    assert 0 < summ["achieved_over_offered"] <= 1.5
+    assert "hit_rate" in ol["cache"]
+    assert ol["cache"]["hit_rate"] > 0  # zipf skew must actually cache
+    assert ol["cached_uncached_identical"] is True
+    assert ol["open_loop"]["count"] > 0
+    assert ol["inline_ping_qps"] > 0
+    assert ol["achieved_over_ping"] > 0
+    # the brownout sub-leg ran, injected faults, and published its tail
+    assert ol["brownout"]["injected"] > 0
+    assert ol["brownout"]["p999_ms"] >= ol["brownout"]["p99_ms"] > 0
+    # replica fan-out carried the reads (single holder -> no hedges)
+    assert ol["read_fanout"]["reads"] > 0
+
+
+def test_device_history_appends_per_emit(tmp_path, monkeypatch):
+    """ISSUE 6 satellite: every bench emit appends {run, device_status}
+    to DEVICE_HISTORY.jsonl so stand-in runs stop erasing the record of
+    when the device was last reachable."""
+    head = {
+        "metric": "ec.encode_throughput", "value": 1.0, "unit": "GB/s",
+        "vs_baseline": 1.0, "device_status": "tpu", "extra": [],
+    }
+    lines, _ = _run_emit(tmp_path, monkeypatch, dict(head))
+    lines, _ = _run_emit(
+        tmp_path, monkeypatch,
+        {**head, "device_status": "cpu_standin", "value": 0.5},
+    )
+    hist_path = tmp_path / "DEVICE_HISTORY.jsonl"
+    entries = [
+        json.loads(ln) for ln in hist_path.read_text().splitlines() if ln
+    ]
+    assert [e["run"] for e in entries] == [1, 2]
+    assert [e["device_status"] for e in entries] == ["tpu", "cpu_standin"]
+    # the final line carries the pointer, not the (unbounded) history
+    parsed = json.loads(lines[-1])
+    assert parsed["device_history_file"] == "DEVICE_HISTORY.jsonl"
+    assert "device_history" not in parsed
+    # a torn line (watchdog kill mid-append) must not disable appends
+    with open(hist_path, "a") as f:
+        f.write('{"run": 3, "device_st')  # no newline, truncated JSON
+    lines, _ = _run_emit(tmp_path, monkeypatch, dict(head))
+    raw = [ln for ln in hist_path.read_text().splitlines() if ln.strip()]
+    last = json.loads(raw[-1])
+    assert last["run"] == len(raw)  # numbering survives the torn line
+    assert last["device_status"] == "tpu"
+
+
 def test_watchdog_emits_partial_and_exits(tmp_path):
     """A bench hung past its deadline must still produce a parseable final
     line (the r4 failure mode, one step worse): run a stub main that arms

@@ -1,0 +1,439 @@
+"""Open-loop load harness units (ISSUE 6): log-bucketed histogram
+percentiles, zipfian key sampling, the coordinated-omission correction,
+the brownout fault constructor, and the client replica fan-out
+(round-robin + hedge-on-p99-timeout)."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops.loadgen import (
+    LogHistogram,
+    OpenLoopResult,
+    SizeDist,
+    ZipfKeys,
+    run_open_loop,
+)
+from seaweedfs_tpu.util import faults
+
+
+# ---------------------------------------------------------- histogram --
+
+
+def test_log_histogram_percentiles_bounded_error():
+    h = LogHistogram(growth=1.25)
+    rng = np.random.default_rng(3)
+    lat = rng.lognormal(mean=-7.0, sigma=1.0, size=20000)  # ~1ms-ish
+    for v in lat:
+        h.record(float(v))
+    for p in (50, 99, 99.9):
+        true = float(np.percentile(lat, p))
+        got = h.percentile(p)
+        assert got == pytest.approx(true, rel=0.25), p
+    assert h.count == 20000
+    s = h.summary_ms()
+    assert s["p50_ms"] < s["p99_ms"] <= s["p999_ms"] <= s["max_ms"]
+
+
+def test_log_histogram_merge_and_edges():
+    a, b = LogHistogram(), LogHistogram()
+    assert a.percentile(99) == 0.0  # empty
+    a.record(0.0)  # below base clamps to bucket 0
+    a.record(1e9)  # beyond span clamps to last bucket, max preserved
+    b.record(0.001)
+    a.merge(b)
+    assert a.count == 3
+    assert a.percentile(100) == 1e9  # upper-bounded by observed max
+
+
+# --------------------------------------------------------------- zipf --
+
+
+def test_zipf_deterministic_and_skewed():
+    a = ZipfKeys(50_000, s=1.1, seed=5)
+    b = ZipfKeys(50_000, s=1.1, seed=5)
+    assert np.array_equal(a.draw(1000), b.draw(1000))
+    assert a.hot_share(0.01) > 0.4  # zipf 1.1: hottest 1% carries >40%
+    # a flatter exponent carries less mass on the head
+    flat = ZipfKeys(50_000, s=0.6, seed=5)
+    assert flat.hot_share(0.01) < a.hot_share(0.01)
+
+
+def test_zipf_cold_fraction_spreads():
+    hot = ZipfKeys(10_000, s=1.3, seed=7, cold_fraction=0.0)
+    mixed = ZipfKeys(10_000, s=1.3, seed=7, cold_fraction=0.5)
+    assert len(np.unique(mixed.draw(5000))) > len(np.unique(hot.draw(5000)))
+
+
+def test_size_dist_weighted():
+    sd = SizeDist(choices=((100, 0.9), (1000, 0.1)), seed=1)
+    draws = sd.draw(5000)
+    assert set(draws.tolist()) == {100, 1000}
+    assert 0.8 < (draws == 100).mean() < 0.97
+
+
+# ---------------------------------------------------- open-loop runner --
+
+
+def test_open_loop_coordinated_omission_correction():
+    """A server stalling at 1/4 of the offered rate: a closed-loop client
+    would report each op's own ~40ms service time and hide the backlog;
+    the open-loop schedule charges the queueing delay to the requests
+    that suffered it, so recorded latency must grow far past the service
+    time."""
+
+    async def main() -> OpenLoopResult:
+        async def op(i):
+            await asyncio.sleep(0.04)  # service time 40ms
+            return True
+
+        # 2 workers x 25/s = 50/s capacity, offered 200/s for 1s
+        return await run_open_loop(op, rate=200, duration=1.0, workers=2)
+
+    res = asyncio.run(main())
+    assert res.hist.percentile(99) > 0.2  # >> the 40ms service time
+    assert res.achieved_rate < 80
+    s = res.summary()
+    assert s["achieved_over_offered"] < 0.5
+    assert s["p999_ms"] >= s["p99_ms"] > 200
+
+
+def test_open_loop_keeps_offered_rate_when_healthy():
+    async def main():
+        async def op(i):
+            return True
+
+        return await run_open_loop(op, rate=2000, duration=0.5, workers=32)
+
+    res = asyncio.run(main())
+    assert res.failed == 0
+    assert res.summary()["achieved_over_offered"] > 0.9
+    # a fast op's latency stays near the scheduler tick, far under 100ms
+    assert res.hist.percentile(50) < 0.1
+
+
+def test_open_loop_failures_counted():
+    async def main():
+        async def op(i):
+            if i % 3 == 0:
+                raise RuntimeError("boom")
+            return i % 2 == 0
+
+        return await run_open_loop(op, rate=300, duration=0.3, workers=8)
+
+    res = asyncio.run(main())
+    assert res.failed > 0 and res.completed > 0
+    assert res.completed + res.failed == res.hist.count
+
+
+# ----------------------------------------------------------- brownout --
+
+
+def test_brownout_rule_window_and_ramp():
+    r = faults.brownout(op="http:GET", delay=0.2, start=1.0, duration=4.0)
+    assert r.fault == "latency" and r.ramp
+    assert r.window_factor(0.5) is None  # before the window
+    assert r.window_factor(5.5) is None  # after it
+    assert r.window_factor(3.0) == pytest.approx(1.0)  # midpoint peak
+    assert r.window_factor(2.0) == pytest.approx(0.5)  # ramping up
+    assert r.window_factor(4.0) == pytest.approx(0.5)  # ramping down
+    # unwindowed rules are unchanged
+    assert faults.FaultRule(op="x").window_factor(123.0) == 1.0
+
+
+def test_brownout_fires_scaled_delay_and_roundtrips():
+    plan = faults.FaultPlan(
+        seed=2, rules=[faults.brownout(op="op:*", delay=0.1, duration=2.0)]
+    )
+    plan.epoch = time.monotonic() - 1.0  # mid-window: peak
+    ev = plan.match("op:x", "t")
+    assert ev is not None and ev.delay == pytest.approx(0.1, rel=0.05)
+    plan.epoch = time.monotonic() - 0.5  # quarter: half the peak
+    ev = plan.match("op:x", "t")
+    assert ev.delay == pytest.approx(0.05, rel=0.1)
+    plan.epoch = time.monotonic() - 10.0  # expired: inert
+    assert plan.match("op:x", "t") is None
+    # serialization round-trip keeps the window + ramp
+    rt = faults.FaultPlan.from_dict(plan.to_dict())
+    r = rt.rules[0]
+    assert (r.from_s, r.until_s, r.ramp) == (0.0, 2.0, True)
+
+
+def test_brownout_window_outside_does_not_consume_nth():
+    """A windowed rule outside its window must not burn nth bookkeeping."""
+    r = faults.FaultRule(
+        op="op:*", fault="eio", nth=1, from_s=0.0, until_s=1.0
+    )
+    plan = faults.FaultPlan(seed=0, rules=[r])
+    plan.epoch = time.monotonic() - 5.0  # expired
+    assert plan.match("op:x", "t") is None
+    plan.epoch = time.monotonic()  # back inside: the 1st match fires
+    assert plan.match("op:x", "t") is not None
+
+
+def test_install_plan_restarts_window_clock():
+    plan = faults.FaultPlan(
+        seed=1, rules=[faults.brownout(op="op:*", delay=0.1, duration=5.0)]
+    )
+    plan.epoch = time.monotonic() - 100.0  # stale clock
+    faults.install_plan(plan)
+    try:
+        assert time.monotonic() - plan.epoch < 5.0
+        assert plan.match("op:x", "t") is not None
+    finally:
+        faults.clear_plan()
+
+
+# ------------------------------------------------------ replica fan-out --
+
+
+class _FakeHttp:
+    """Scripted FastHTTPClient: per-host (delay, status, body)."""
+
+    def __init__(self, script):
+        self.script = script
+        self.calls: list = []
+
+    async def request(self, method, hostport, target, **kw):
+        self.calls.append(hostport)
+        delay, st, body = self.script[hostport]
+        if delay:
+            await asyncio.sleep(delay)
+        return st, body
+
+
+class _VidMap:
+    def __init__(self, locs):
+        from seaweedfs_tpu.client.master_client import VidMap
+
+        self.m = VidMap()
+        for u in locs:
+            self.m.add(1, u)
+
+    def pick_ordered(self, vid):
+        return self.m.pick_ordered(vid)
+
+
+def test_pick_ordered_round_robins():
+    from seaweedfs_tpu.client.master_client import VidMap
+
+    vm = VidMap()
+    for u in ("a:1", "b:2", "c:3"):
+        vm.add(7, u)
+    seen = [vm.pick_ordered(7)[0] for _ in range(6)]
+    assert seen == ["a:1", "b:2", "c:3", "a:1", "b:2", "c:3"]
+    # every rotation preserves the full set in preference order
+    assert sorted(vm.pick_ordered(7)) == ["a:1", "b:2", "c:3"]
+    assert vm.pick_ordered(99) == []
+
+
+def test_hedge_fires_on_slow_primary_and_hedge_wins():
+    from seaweedfs_tpu.client.read_fanout import ReplicaReader
+
+    http = _FakeHttp({
+        "slow:1": (0.5, 200, b"from-slow"),
+        "fast:2": (0.0, 200, b"from-fast"),
+    })
+    reader = ReplicaReader(
+        http, _VidMap(["slow:1", "fast:2"]).m,
+        hedge_floor_s=0.01, hedge_cap_s=0.05,
+    )
+
+    async def main():
+        st, body = await reader.read("1,0000001")
+        return st, body
+
+    st, body = asyncio.run(main())
+    assert (st, body) == (200, b"from-fast")
+    assert reader.hedges == 1 and reader.hedge_wins == 1
+    assert http.calls == ["slow:1", "fast:2"]
+
+
+def test_no_hedge_when_primary_fast():
+    from seaweedfs_tpu.client.read_fanout import ReplicaReader
+
+    http = _FakeHttp({
+        "a:1": (0.0, 200, b"A"),
+        "b:2": (0.0, 200, b"B"),
+    })
+    reader = ReplicaReader(http, _VidMap(["a:1", "b:2"]).m, hedge_cap_s=0.2)
+
+    async def main():
+        out = []
+        for _ in range(4):
+            out.append((await reader.read("1,0000001"))[1])
+        return out
+
+    bodies = asyncio.run(main())
+    # round-robin alternates primaries; no hedges launched
+    assert bodies == [b"A", b"B", b"A", b"B"]
+    assert reader.hedges == 0
+    assert reader.hist.count == 4
+
+
+def test_read_nowait_round_robins_even_replica_counts():
+    """Regression: read_nowait must consume exactly ONE rotation per
+    read — a second rotation inside the hedged path would re-align every
+    read onto the same primary whenever the replica count is even."""
+    from seaweedfs_tpu.client.read_fanout import ReplicaReader
+
+    http = _FakeHttp({
+        "a:1": (0.0, 200, b"A"),
+        "b:2": (0.0, 200, b"B"),
+    })
+    reader = ReplicaReader(http, _VidMap(["a:1", "b:2"]).m, hedge_cap_s=0.2)
+
+    async def main():
+        out = []
+        for _ in range(4):
+            st, body = await reader.read_nowait("1,0000001")
+            out.append(body)
+        return out
+
+    assert asyncio.run(main()) == [b"A", b"B", b"A", b"B"]
+    assert reader.hedges == 0
+
+
+def test_read_nowait_single_holder_is_direct():
+    from seaweedfs_tpu.client.read_fanout import ReplicaReader
+
+    http = _FakeHttp({"only:1": (0.0, 200, b"X")})
+    reader = ReplicaReader(http, _VidMap(["only:1"]).m)
+
+    async def main():
+        return await reader.read_nowait("1,0000001")
+
+    st, body = asyncio.run(main())
+    assert (st, body) == (200, b"X")
+    assert reader.reads == 1 and reader.hist.count == 0  # no timing taken
+
+
+def test_dead_primary_fails_over_to_replica():
+    """A replica that FAILS fast (connection refused) must cost one
+    failover round-trip, not 1/N of all reads until the vid map learns."""
+    from seaweedfs_tpu.client.read_fanout import ReplicaReader
+
+    class _Dead(_FakeHttp):
+        async def request(self, method, hostport, target, **kw):
+            self.calls.append(hostport)
+            if hostport == "dead:1":
+                raise ConnectionRefusedError("down")
+            return 200, b"alive"
+
+    http = _Dead({})
+    reader = ReplicaReader(http, _VidMap(["dead:1", "live:2"]).m)
+
+    async def main():
+        out = []
+        for _ in range(2):  # round-robin puts dead:1 first on read 1
+            out.append(await reader.read("1,0000001"))
+        return out
+
+    results = asyncio.run(main())
+    assert all(r == (200, b"alive") for r in results)
+    assert "dead:1" in http.calls and http.calls.count("live:2") == 2
+    assert reader.hedges >= 1 and reader.hedge_wins >= 1
+
+
+def test_hedged_error_status_does_not_beat_slow_success():
+    """Regression: a degraded replica's INSTANT 404/503 must not win the
+    hedge race over a healthy-but-slow primary, and error latencies must
+    not feed (and shrink) the hedge-threshold histogram."""
+    from seaweedfs_tpu.client.read_fanout import ReplicaReader
+
+    http = _FakeHttp({
+        "slowok:1": (0.08, 200, b"slow-but-right"),
+        "degraded:2": (0.0, 404, b"not found"),
+    })
+    reader = ReplicaReader(
+        http, _VidMap(["slowok:1", "degraded:2"]).m,
+        hedge_floor_s=0.01, hedge_cap_s=0.02,
+    )
+
+    async def main():
+        return await reader.read("1,0000001")
+
+    st, body = asyncio.run(main())
+    assert (st, body) == (200, b"slow-but-right")
+    assert reader.hedges == 1 and reader.hedge_wins == 0
+    assert reader.hist.count == 1  # only the 200 recorded
+
+
+def test_fast_error_status_cross_checks_next_replica():
+    """A diverged replica answering 404 INSTANTLY (within the hedge
+    threshold) must be cross-checked against the next holder; a genuine
+    miss (both agree) returns the primary's answer after one extra
+    round-trip."""
+    from seaweedfs_tpu.client.read_fanout import ReplicaReader
+
+    http = _FakeHttp({
+        "diverged:1": (0.0, 404, b"nope"),
+        "healthy:2": (0.0, 200, b"still-here"),
+    })
+    reader = ReplicaReader(http, _VidMap(["diverged:1", "healthy:2"]).m)
+
+    async def main():
+        return await reader.read("1,0000001")
+
+    st, body = asyncio.run(main())
+    assert (st, body) == (200, b"still-here")
+    assert reader.hedges == 1 and reader.hedge_wins == 1
+
+    # both replicas agree it's gone: 404 stands, one extra RTT paid
+    http2 = _FakeHttp({
+        "a:1": (0.0, 404, b"nope"),
+        "b:2": (0.0, 404, b"nope"),
+    })
+    reader2 = ReplicaReader(http2, _VidMap(["a:1", "b:2"]).m)
+    st, _ = asyncio.run(reader2.read("1,0000001"))
+    assert st == 404
+    assert len(http2.calls) == 2 and reader2.hedge_wins == 0
+
+
+def test_cross_check_peer_failure_keeps_primary_answer():
+    """Regression: when the fast-error cross-check's peer is DOWN, the
+    primary's valid answer stands (no exception to the caller, no retry
+    of the dead peer, hedges counted once)."""
+    from seaweedfs_tpu.client.read_fanout import ReplicaReader
+
+    class _H(_FakeHttp):
+        async def request(self, method, hostport, target, **kw):
+            self.calls.append(hostport)
+            if hostport == "dead:2":
+                raise ConnectionRefusedError("down")
+            return 404, b"nope"
+
+    http = _H({})
+    reader = ReplicaReader(http, _VidMap(["has404:1", "dead:2"]).m)
+    st, body = asyncio.run(reader.read("1,0000001"))
+    assert (st, body) == (404, b"nope")
+    assert http.calls == ["has404:1", "dead:2"]
+    assert reader.hedges == 1
+
+
+def test_hedge_survives_failing_racer():
+    """A hedge that errors must not mask the primary's (late) success."""
+    from seaweedfs_tpu.client.read_fanout import ReplicaReader
+
+    class _Flaky(_FakeHttp):
+        async def request(self, method, hostport, target, **kw):
+            self.calls.append(hostport)
+            if hostport == "bad:2":
+                raise ConnectionResetError("nope")
+            await asyncio.sleep(0.08)
+            return 200, b"late-but-right"
+
+    http = _Flaky({})
+    reader = ReplicaReader(
+        http, _VidMap(["slow:1", "bad:2"]).m,
+        hedge_floor_s=0.01, hedge_cap_s=0.02,
+    )
+
+    async def main():
+        return await reader.read("1,0000001")
+
+    st, body = asyncio.run(main())
+    assert (st, body) == (200, b"late-but-right")
+    assert reader.hedges == 1 and reader.hedge_wins == 0
