@@ -2156,6 +2156,366 @@ def measure_serving_open_loop(
     return out
 
 
+def measure_s3_gateway(
+    num_objects: int = 3000,
+    obj_bytes: int = 1024,
+    list_keys: int = 10000,
+    max_keys: int = 100,
+    get_duration: float = 4.0,
+    concurrency: int = 16,
+    zipf_s: float = 1.1,
+) -> dict:
+    """Object-gateway legs (ISSUE 7 tentpole): s3.put_qps / s3.get_qps /
+    s3.list_qps through the full master + volume + filer + S3 stack,
+    next to the RAW volume-tier legs measured in the SAME credit window
+    (the acceptance ratio: gateway >= 0.5x raw on each verb).
+
+    - raw legs: closed-loop c=16 leased direct-to-volume PUTs, then
+      closed-loop random GETs of the same fids — the volume tier's own
+      numbers for this host and moment;
+    - s3.put: closed-loop c=16 PutObject through the gateway fast tier;
+      the handler's s3_stage_seconds partition (auth/meta/lease/upload/
+      render) is differenced across the leg and published as an
+      itemized per-request budget with coverage_of_p50 (the
+      serving_write_budget methodology applied to the gateway);
+    - s3.get: the open-loop harness (ops/loadgen.py) at the
+      same-credit-window inline trivial-200 ping rate, zipf-popular
+      keys, CO-corrected p50/p99/p999; plus an in-leg byte-identity
+      check of gateway GETs against direct volume reads of the same
+      chunks;
+    - s3.list: ListObjectsV2 pages (max-keys) over a bucket >= 100x the
+      page size, walked via continuation tokens; per-request
+      scanned-entries from the range-scan counter disclose that LIST
+      work is O(max-keys), not O(bucket), and one full walk is checked
+      against the expected sorted key set.
+    """
+    import asyncio
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(
+        prefix="bench_s3_",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None,
+    )
+    out: dict = {
+        "num_objects": num_objects,
+        "obj_bytes": obj_bytes,
+        "list_keys": list_keys,
+        "max_keys": max_keys,
+        "concurrency": concurrency,
+    }
+    free_port_pair = _free_port_pair
+
+    async def body() -> None:
+        from seaweedfs_tpu.client.operation import AssignLease, http_assign
+        from seaweedfs_tpu.command.benchmark import fake_payload
+        from seaweedfs_tpu.ops.loadgen import (
+            LogHistogram,
+            ZipfKeys,
+            arrival_count,
+            run_open_loop,
+        )
+        from seaweedfs_tpu.pb.rpc import close_all_channels
+        from seaweedfs_tpu.s3.server import S3Server
+        from seaweedfs_tpu.server.filer import FilerServer
+        from seaweedfs_tpu.server.master import MasterServer
+        from seaweedfs_tpu.server.volume import VolumeServer
+        from seaweedfs_tpu.util.fasthttp import FastHTTPClient
+        from seaweedfs_tpu.util.metrics import (
+            S3_LIST_SCANNED,
+            S3_STAGE_SECONDS,
+        )
+
+        ms = MasterServer(port=free_port_pair(), pulse_seconds=0.2)
+        await ms.start()
+        vs = VolumeServer(
+            master=ms.address,
+            directories=[d],
+            port=free_port_pair(),
+            pulse_seconds=0.2,
+            max_volume_counts=[20],
+        )
+        await vs.start()
+        fs = FilerServer(
+            master=ms.address,
+            port=free_port_pair(),
+            store_path=os.path.join(d, "meta.lsm"),
+        )
+        http = FastHTTPClient(pool_per_host=160)
+        s3 = None
+        s3_started = fs_started = False
+        try:
+            for _ in range(100):
+                if ms.topo.data_nodes():
+                    break
+                await asyncio.sleep(0.1)
+            # start the filer BEFORE scanning for the S3 port: the scan
+            # only sees ports that are actually bound
+            await fs.start()
+            fs_started = True
+            await fs.master_client.wait_connected()
+            s3 = S3Server(fs, port=free_port_pair())
+            await s3.start()
+            s3_started = True
+            st, _ = await http.request("PUT", s3.address, "/bench")
+            if st != 200:
+                out["error"] = f"create bucket: {st}"
+                return
+
+            # same-credit-window trivial-200 floor (shared helper)
+            out["inline_ping_qps"] = (
+                await _trivial_ping_qps(http, 12000, concurrency)
+            )["ping_qps"]
+
+            # --- raw volume-tier reference legs (same window) ---
+            async def fetch_lease(count: int):
+                return await http_assign(http, ms.address, count)
+
+            lease = AssignLease(fetch=fetch_lease, batch=128)
+            fids: list = []
+            idx = [0]
+            payload = fake_payload(11, obj_bytes)
+
+            async def raw_writer() -> None:
+                while True:
+                    i = idx[0]
+                    if i >= num_objects:
+                        return
+                    idx[0] = i + 1
+                    ar = await lease.take()
+                    st, _ = await http.request(
+                        "POST", ar.url, "/" + ar.fid, body=payload,
+                        content_type="application/octet-stream",
+                    )
+                    if st == 201:
+                        fids.append(ar.fid)
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*(raw_writer() for _ in range(concurrency)))
+            out["raw_put_qps"] = round(
+                len(fids) / max(time.perf_counter() - t0, 1e-9)
+            )
+            if not fids:
+                out["error"] = "raw write leg produced no fids"
+                return
+
+            n_reads = min(3 * num_objects, 12000)
+            ridx = [0]
+            rng = np.random.default_rng(5)
+            read_order = rng.integers(0, len(fids), size=n_reads).tolist()
+
+            async def raw_reader() -> None:
+                while True:
+                    i = ridx[0]
+                    if i >= n_reads:
+                        return
+                    ridx[0] = i + 1
+                    await http.request(
+                        "GET", vs.address, "/" + fids[read_order[i]]
+                    )
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*(raw_reader() for _ in range(concurrency)))
+            out["raw_get_qps"] = round(
+                n_reads / max(time.perf_counter() - t0, 1e-9)
+            )
+
+            # --- s3.put: closed-loop PutObject through the fast tier ---
+            stages = ("auth", "meta", "lease", "upload", "render")
+            before = {
+                s: S3_STAGE_SECONDS.sum_count(verb="PUT", stage=s)
+                for s in stages
+            }
+            keys = [f"o/{i:07d}" for i in range(num_objects)]
+            widx = [0]
+            put_hist = LogHistogram()
+            put_fail = [0]
+
+            async def s3_writer() -> None:
+                while True:
+                    i = widx[0]
+                    if i >= num_objects:
+                        return
+                    widx[0] = i + 1
+                    t1 = time.perf_counter()
+                    # same constant payload as the raw leg: the client-side
+                    # payload synthesis must not asymmetrically tax the
+                    # gateway leg's closed loop
+                    st, _ = await http.request(
+                        "PUT", s3.address, "/bench/" + keys[i],
+                        body=payload,
+                        content_type="application/octet-stream",
+                    )
+                    put_hist.record(time.perf_counter() - t1)
+                    if st != 200:
+                        put_fail[0] += 1
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*(s3_writer() for _ in range(concurrency)))
+            put_wall = max(time.perf_counter() - t0, 1e-9)
+            out["put_qps"] = round((num_objects - put_fail[0]) / put_wall)
+            out["put_failed"] = put_fail[0]
+            out["put_latency_ms"] = put_hist.summary_ms()
+            # itemized per-request stage budget (server-side partition of
+            # the handler wall, differenced across the leg)
+            budget: dict = {}
+            for s in stages:
+                s1, c1 = S3_STAGE_SECONDS.sum_count(verb="PUT", stage=s)
+                s0, c0 = before[s]
+                n = max(c1 - c0, 1)
+                budget[f"{s}_us"] = round((s1 - s0) / n * 1e6, 1)
+            budget["component_sum_us"] = round(
+                sum(v for v in budget.values()), 1
+            )
+            p50_us = put_hist.percentile(50) * 1e6
+            budget["put_p50_us"] = round(p50_us, 1)
+            budget["coverage_of_p50"] = round(
+                budget["component_sum_us"] / max(p50_us, 1e-9), 3
+            )
+            out["s3_stage_budget"] = budget
+            out["put_vs_raw"] = round(
+                out["put_qps"] / max(out["raw_put_qps"], 1), 3
+            )
+
+            # --- s3.get: open-loop zipfian GETs at the inline ping rate ---
+            zipf = ZipfKeys(len(keys), s=zipf_s, seed=13)
+            offered = float(out["inline_ping_qps"])
+            sched = zipf.draw(arrival_count(offered, get_duration)).tolist()
+
+            async def get_op(i: int) -> bool:
+                st, _ = await http.request(
+                    "GET", s3.address, "/bench/" + keys[sched[i]]
+                )
+                return st == 200
+
+            oc = s3.object_cache
+            hits0 = oc.hits if oc else 0
+            miss0 = oc.misses if oc else 0
+            res = await run_open_loop(
+                get_op, rate=offered, duration=get_duration, seed=3,
+                workers=64,
+            )
+            if oc is not None:
+                hits, misses = oc.hits - hits0, oc.misses - miss0
+                out["object_cache"] = {
+                    **oc.stats(),
+                    "leg_hits": hits,
+                    "leg_misses": misses,
+                    "hit_rate": round(hits / max(hits + misses, 1), 4),
+                }
+            else:
+                out["object_cache"] = {"disabled": True, "hit_rate": 0.0}
+            out["get_open_loop"] = res.summary()
+            out["get_qps"] = out["get_open_loop"]["achieved_qps"]
+            out["get_vs_raw"] = round(
+                out["get_qps"] / max(out["raw_get_qps"], 1), 3
+            )
+            out["get_over_ping"] = round(
+                out["get_qps"] / max(out["inline_ping_qps"], 1), 3
+            )
+
+            # --- byte identity: gateway GET == direct volume read ---
+            ident = True
+            for i in range(0, num_objects, max(1, num_objects // 16))[:16]:
+                entry = fs.filer.find_entry(f"/buckets/bench/{keys[i]}")
+                if entry is None:
+                    continue  # that PUT failed (counted in put_failed)
+                st_a, a = await http.request(
+                    "GET", s3.address, "/bench/" + keys[i]
+                )
+                direct = bytearray()
+                for c in sorted(entry.chunks, key=lambda c: c.offset):
+                    st_b, blob = await http.request(
+                        "GET", vs.address, "/" + c.fid
+                    )
+                    if st_b != 200:
+                        ident = False
+                    direct += blob
+                if not (st_a == 200 and bytes(direct) == a):
+                    ident = False
+            out["gateway_direct_identical"] = ident
+
+            # --- s3.list: range-scan ListObjectsV2 over a big bucket ---
+            st, _ = await http.request("PUT", s3.address, "/listbench")
+            n_dirs = 50
+            for i in range(list_keys):
+                fs.filer.touch(
+                    f"/buckets/listbench/d{i % n_dirs:02d}/k{i:07d}", "", []
+                )
+            scanned0 = sum(S3_LIST_SCANNED._values.values())
+            list_hist = LogHistogram()
+            walked: list = []
+            requests = [0]
+            token = [""]
+            t0 = time.perf_counter()
+            # full pagination walks until the time budget is spent; each
+            # request is one max-keys page
+            list_budget_s = min(3.0, get_duration)
+            full_walks = [0]
+            while time.perf_counter() - t0 < list_budget_s:
+                target = f"/listbench?list-type=2&max-keys={max_keys}"
+                if token[0]:
+                    target += f"&continuation-token={token[0]}"
+                t1 = time.perf_counter()
+                st, body_ = await http.request("GET", s3.address, target)
+                list_hist.record(time.perf_counter() - t1)
+                requests[0] += 1
+                if st != 200:
+                    out["list_error"] = f"status {st}"
+                    break
+                import xml.etree.ElementTree as ET
+
+                tree = ET.fromstring(body_)
+                page_keys = [
+                    c.findtext("Key") for c in tree.findall("Contents")
+                ]
+                if full_walks[0] == 0:
+                    walked.extend(page_keys)
+                if tree.findtext("IsTruncated") == "true":
+                    token[0] = tree.findtext("NextContinuationToken")
+                else:
+                    token[0] = ""
+                    full_walks[0] += 1
+            wall = max(time.perf_counter() - t0, 1e-9)
+            scanned1 = sum(S3_LIST_SCANNED._values.values())
+            out["list_qps"] = round(requests[0] / wall)
+            out["list_requests"] = requests[0]
+            out["list_latency_ms"] = list_hist.summary_ms()
+            out["list_scanned_per_request"] = round(
+                (scanned1 - scanned0) / max(requests[0], 1), 1
+            )
+            out["list_scan_bounded"] = (
+                out["list_scanned_per_request"] <= 4 * (max_keys + n_dirs)
+            )
+            expect = sorted(
+                f"d{i % n_dirs:02d}/k{i:07d}" for i in range(list_keys)
+            )
+            if full_walks[0] >= 1:
+                out["list_walk_complete"] = walked == expect
+            out["list_full_walks"] = full_walks[0]
+        finally:
+            await http.close()
+            try:
+                if s3_started:
+                    await s3.stop()
+            except Exception:
+                pass
+            try:
+                if fs_started:
+                    await fs.stop()
+            except Exception:
+                pass
+            await vs.stop()
+            await ms.stop()
+            await close_all_channels()
+
+    try:
+        asyncio.run(body())
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 class _Skip(Exception):
     """Secondary metric skipped: bench budget spent."""
 
@@ -2809,6 +3169,72 @@ def main() -> None:
         extra.append({"metric": "serving.open_loop", "error": str(e)[:200]})
 
     try:
+        if not budgeted("s3.put_qps", 90):
+            raise _Skip()
+        s3g = measure_s3_gateway(
+            num_objects=int(os.environ.get("BENCH_S3_OBJECTS", 3000)),
+            list_keys=int(os.environ.get("BENCH_S3_LIST_KEYS", 10000)),
+        )
+        budget_detail = s3g.get("s3_stage_budget", {})
+        extra.append(
+            {
+                "metric": "s3.put_qps",
+                "value": s3g.get("put_qps"),
+                "unit": "#/sec",
+                # acceptance ratio: gateway PutObject vs the raw
+                # volume-tier write leg in the SAME credit window
+                # (target >= 0.5)
+                "vs_baseline": s3g.get("put_vs_raw"),
+                "coverage_of_p50": budget_detail.get("coverage_of_p50"),
+                "detail": s3g,
+                "note": "closed-loop c=16 PutObject through the S3 fast "
+                "tier (shared serving core + leased chunk uploads into "
+                "the volume fast write tier); vs_baseline = put_qps / "
+                "raw_put_qps (direct leased volume PUTs, same window); "
+                "detail.s3_stage_budget itemizes the handler wall into "
+                "auth/meta/lease/upload/render with coverage_of_p50 "
+                "(serving_write_budget methodology)",
+            }
+        )
+        extra.append(
+            {
+                "metric": "s3.get_qps",
+                "value": s3g.get("get_qps"),
+                "unit": "#/sec",
+                "vs_baseline": s3g.get("get_vs_raw"),
+                "p99_ms": (s3g.get("get_open_loop") or {}).get("p99_ms"),
+                "identical": s3g.get("gateway_direct_identical"),
+                "note": "open-loop zipf(1.1) GetObject through the S3 "
+                "fast tier at the same-credit-window inline ping rate "
+                "(CO-corrected p50/p99/p999 in s3.put_qps detail); "
+                "vs_baseline = get_qps / raw_get_qps (direct volume "
+                "GETs, same window); identical = gateway GETs "
+                "byte-identical to direct volume chunk reads",
+            }
+        )
+        extra.append(
+            {
+                "metric": "s3.list_qps",
+                "value": s3g.get("list_qps"),
+                "unit": "#/sec",
+                "vs_baseline": s3g.get("list_scanned_per_request"),
+                "scan_bounded": s3g.get("list_scan_bounded"),
+                "note": "ListObjectsV2 pages (max-keys=100) walked via "
+                "continuation tokens over a "
+                f"{s3g.get('list_keys')}-key bucket (>= 100x the page); "
+                "vs_baseline = store entries SCANNED per request — the "
+                "range-scan bound O(max-keys + CommonPrefixes), not "
+                "O(bucket); scan_bounded asserts it; full-walk "
+                "concatenation checked against the sorted key set "
+                "(list_walk_complete in s3.put_qps detail)",
+            }
+        )
+    except _Skip:
+        pass
+    except Exception as e:
+        extra.append({"metric": "s3.put_qps", "error": str(e)[:200]})
+
+    try:
         if not budgeted("serving_write_budget", 25):
             raise _Skip()
         wb = measure_write_budget(serving=serving_qps, ping=ping_detail)
@@ -2974,6 +3400,9 @@ _COMPACT_KEYS = (
     "read_p99_ms",
     "p99_ms",
     "p999_ms",
+    "coverage_of_p50",
+    "identical",
+    "scan_bounded",
     "skipped",
 )
 _FINAL_LINE_CAP = 1900  # bytes; the driver tail-captures 2,000 chars

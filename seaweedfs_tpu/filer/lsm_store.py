@@ -63,16 +63,20 @@ class _Segment:
         self.path = path
         self.keys: List[Tuple[str, str]] = []
         self._offsets: List[Tuple[int, int]] = []  # (value offset, vlen)
+        # ONE sequential read, frames parsed from the buffer: per-frame
+        # read()/tell()/seek() syscalls dominated segment-open cost at
+        # metadata QPS rates (every flush and merge reopens a segment)
         with open(path, "rb") as f:
-            while True:
-                hdr = f.read(_FRAME.size)
-                if len(hdr) < _FRAME.size:
-                    break
-                klen, vlen = _FRAME.unpack(hdr)
-                key = msgpack.unpackb(f.read(klen), raw=False)
-                self.keys.append((key[0], key[1]))
-                self._offsets.append((f.tell(), vlen))
-                f.seek(vlen, 1)
+            data = f.read()
+        pos, end = 0, len(data)
+        while pos + _FRAME.size <= end:
+            klen, vlen = _FRAME.unpack_from(data, pos)
+            pos += _FRAME.size
+            key = msgpack.unpackb(data[pos : pos + klen], raw=False)
+            pos += klen
+            self.keys.append((key[0], key[1]))
+            self._offsets.append((pos, vlen))
+            pos += vlen
         self._f = open(path, "rb")
 
     def get(self, key: Tuple[str, str]) -> Optional[Tuple[bool, Optional[dict]]]:
@@ -99,6 +103,23 @@ class _Segment:
         while i < len(self.keys) and self.keys[i] < hi:
             yield self.keys[i], self._value(i)
             i += 1
+
+    def items(self) -> list:
+        """Every (key, value) pair via ONE sequential file read — the
+        merge path's bulk accessor (per-entry seek+read made compaction
+        the dominant metadata-write cost)."""
+        with open(self.path, "rb") as f:
+            data = f.read()
+        pos, end, out = 0, len(data), []
+        while pos + _FRAME.size <= end:
+            klen, vlen = _FRAME.unpack_from(data, pos)
+            pos += _FRAME.size
+            key = msgpack.unpackb(data[pos : pos + klen], raw=False)
+            pos += klen
+            val = msgpack.unpackb(data[pos : pos + vlen], raw=False)
+            pos += vlen
+            out.append(((key[0], key[1]), val))
+        return out
 
     def close(self) -> None:
         self._f.close()
@@ -234,25 +255,45 @@ class LsmFilerStore:
             self._compact()
 
     def _compact(self) -> None:
-        """Merge every segment into one, newest wins, tombstones dropped
-        (a full merge is leveldb's major compaction, sized for this store).
-        Crash-safe via the MANIFEST: the new segment becomes live only when
-        the manifest points at it, and unlisted leftovers are swept."""
+        """Tiered compaction: merge the ADJACENT segment pair with the
+        smallest combined key count, repeating until the count fits
+        max_segments. The previous merge-everything policy rewrote the
+        whole store every (max_segments x memtable_limit) mutations —
+        quadratic total I/O over a write-heavy life, which the object
+        gateway's PUT path made visible at metadata QPS rates; merging
+        the smallest adjacent pair keeps segments geometrically sized so
+        each entry is rewritten O(log n) times. Adjacency preserves the
+        rank (newest-wins) order; tombstones drop only when a merge
+        includes the OLDEST segment (a mid-stack tombstone must keep
+        shadowing older copies). Crash-safe via the MANIFEST exactly as
+        before: the merged segment becomes live only when the manifest
+        points at it, and unlisted leftovers are swept."""
+        while len(self._segments) > self.max_segments:
+            sizes = [len(s.keys) for s in self._segments]
+            lo = min(
+                range(len(sizes) - 1), key=lambda j: sizes[j] + sizes[j + 1]
+            )
+            self._merge_adjacent(lo, lo + 2)
+
+    def _merge_adjacent(self, lo: int, hi: int) -> None:
         merged: Dict[Tuple[str, str], Optional[dict]] = {}
-        for seg in self._segments:  # oldest -> newest, later puts overwrite
-            for i, key in enumerate(seg.keys):
-                merged[key] = seg._value(i)
-        live = sorted(
-            (k, v) for k, v in merged.items() if v is not None
-        )
-        seq = self._next_seq
-        path = os.path.join(self.dir, f"seg-{seq}.sst")
-        _write_segment(path, live)
-        _fsync_dir(self.dir)
-        old = self._segments
-        self._segments = [_Segment(path)]
-        self._seqs = [seq]
-        self._next_seq += 1
+        for seg in self._segments[lo:hi]:  # oldest -> newest overwrites
+            merged.update(seg.items())
+        items = sorted(merged.items())
+        if lo == 0:  # nothing older left to shadow: tombstones drop
+            items = [(k, v) for k, v in items if v is not None]
+        old = self._segments[lo:hi]
+        if items:
+            seq = self._next_seq
+            path = os.path.join(self.dir, f"seg-{seq}.sst")
+            _write_segment(path, items)
+            _fsync_dir(self.dir)
+            self._segments[lo:hi] = [_Segment(path)]
+            self._seqs[lo:hi] = [seq]
+            self._next_seq += 1
+        else:
+            self._segments[lo:hi] = []
+            self._seqs[lo:hi] = []
         self._write_manifest()
         for seg in old:
             seg.close()
@@ -332,9 +373,13 @@ class LsmFilerStore:
             mem_rank = len(self._segments)  # memtable is newest
             sources.append(
                 (
-                    (key, (mem_rank, v))
-                    for key, v in sorted(self._mem.items())
-                    if lo <= key < hi
+                    (key, (mem_rank, self._mem[key]))
+                    # range-filter BEFORE sorting: the memtable source
+                    # costs O(in-range), not O(memtable log memtable),
+                    # per page
+                    for key in sorted(
+                        k for k in self._mem if lo <= k < hi
+                    )
                 )
             )
             out: List[Entry] = []
@@ -352,6 +397,7 @@ class LsmFilerStore:
                 if len(out) >= limit:
                     break
             return out
+
 
     def close(self) -> None:
         with self._lock:

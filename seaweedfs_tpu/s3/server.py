@@ -29,6 +29,13 @@ from ..filer import (
     non_overlapping_visible_intervals,
     read_from_visible_intervals,
 )
+from ..filer.filer_store import ScanStats, prefix_successor, scan_subtree
+from ..util.fasthttp import FALLBACK, render_response
+from ..util.metrics import (
+    S3_LIST_REQUESTS,
+    S3_LIST_SCANNED,
+    S3_STAGE_SECONDS,
+)
 
 BUCKETS_ROOT = "/buckets"
 UPLOADS_DIR = "/.uploads"
@@ -50,19 +57,211 @@ def _findall_local(root: ET.Element, name: str) -> list[ET.Element]:
     return [el for el in root if _local(el.tag) == name]
 
 def _findtext_local(root: ET.Element, name: str, default: str = "") -> str:
-    for el in root.iter():
+    """Text of the DIRECT child with this local tag name. Direct children
+    only: root.iter() would also match a same-named element nested under
+    an unrelated node — e.g. a <Key> inside a CompleteMultipartUpload
+    part list shadowing the sibling the caller actually means."""
+    for el in root:
         if _local(el.tag) == name:
             return el.text or default
     return default
 
 
-def _error(code: str, message: str, status: int) -> web.Response:
+def _error_xml(code: str, message: str) -> bytes:
     root = ET.Element("Error")
     ET.SubElement(root, "Code").text = code
     ET.SubElement(root, "Message").text = message
+    return ET.tostring(root)
+
+
+def _error(code: str, message: str, status: int) -> web.Response:
     return web.Response(
-        body=ET.tostring(root), status=status, content_type="application/xml"
+        body=_error_xml(code, message),
+        status=status,
+        content_type="application/xml",
     )
+
+
+def list_objects_page(
+    filer: Filer,
+    bucket_path: str,
+    prefix: str = "",
+    after: str = "",
+    max_keys: int = 1000,
+    delimiter: str = "",
+    stats: Optional[ScanStats] = None,
+) -> tuple[list, bool]:
+    """One ListObjects page over the filer store's bounded range scan
+    (filer_store.scan_subtree) — the O(max-keys)-not-O(bucket) LIST path.
+
+    Returns (items, truncated): items are (key, Entry) for objects and
+    (group_prefix, None) for delimiter groups, one sorted stream sharing
+    the max_keys budget (S3 semantics: CommonPrefixes count toward
+    MaxKeys and paginate with the same cursor). Work scales with the
+    returned page: per-directory scans are page-bounded, and delimiter
+    groups are SKIPPED rather than enumerated — delimiter="/" never
+    descends into a grouped directory at all, any other delimiter seeks
+    the scan to prefix_successor(group) after its first key.
+    """
+    store = filer.store
+    if max_keys <= 0:
+        # max-keys=0 is a legal existence probe; answering truncated
+        # with no token would loop a token-following SDK forever
+        return [], False
+    # resume strictly after `after`; a group token resumes past its WHOLE
+    # group (a token "d/" must not re-enumerate d's subtree, whose keys
+    # all sort above "d/")
+    if after:
+        i = after.find(delimiter, len(prefix)) if delimiter else -1
+        if i >= 0:
+            start_at = prefix_successor(after[: i + len(delimiter)])
+        else:
+            start_at = after + "\x00"
+    else:
+        start_at = ""
+    structural = delimiter == "/"
+
+    def on_dir(dir_key: str) -> bool:
+        # "/"-delimited listing: a directory past the prefix IS a group —
+        # never enter it (the scanner yields one (dir_key, None) marker)
+        return not (
+            structural
+            and len(dir_key) > len(prefix)
+            and dir_key.startswith(prefix)
+        )
+
+    items: list = []
+    while len(items) <= max_keys:
+        restarted = False
+        for key, entry in scan_subtree(
+            store,
+            bucket_path,
+            start_at=start_at,
+            prefix=prefix,
+            stats=stats,
+            descend=on_dir if structural else None,
+        ):
+            if entry is None:
+                # structural group marker: subtree already skipped
+                items.append((key, None))
+            elif delimiter and not structural and (
+                key.find(delimiter, len(prefix)) >= 0
+            ):
+                i = key.find(delimiter, len(prefix))
+                group = key[: i + len(delimiter)]
+                items.append((group, None))
+                start_at = prefix_successor(group)
+                restarted = True
+                break
+            else:
+                items.append((key, entry))
+            if len(items) > max_keys:
+                break
+        if not restarted:
+            break
+    truncated = len(items) > max_keys
+    return items[:max_keys], truncated
+
+
+class ObjectResponseCache:
+    """Byte-bounded LRU of whole pre-rendered GetObject responses keyed
+    by object path — the volume server's HotNeedleCache argument applied
+    one layer up (ISSUE 7: zipfian object traffic re-reads a small hot
+    set through the gateway).
+
+    The metadata probe still runs on EVERY request: a hit is served only
+    when the live entry's signature — the exact chunk (fid, offset,
+    size) list plus etag, mtime and total size — matches what the
+    response was rendered from. The filer never rewrites a chunk fid
+    with different bytes (fids are write-once from the filer's side and
+    stay referenced while any entry lists them), so an unchanged
+    signature means unchanged content: hits are byte-identical to
+    uncached reads by construction, and any overwrite/delete/multipart
+    replace changes the signature and misses. What a hit saves is the
+    volume DATA hop, never metadata freshness.
+
+    Sized by SEAWEEDFS_TPU_S3_CACHE_MB (0 disables); single responses
+    over `max_entry` bytes are never admitted so one large object cannot
+    monopolize the budget."""
+
+    def __init__(self, capacity_bytes: int, max_entry: int = 256 << 10):
+        import threading
+        from collections import OrderedDict
+
+        self.capacity = capacity_bytes
+        self.max_entry = max_entry
+        self._entries: "OrderedDict[str, tuple]" = OrderedDict()  # path -> (sig, resp)
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def signature(entry) -> tuple:
+        return (
+            tuple((c.fid, c.offset, c.size) for c in entry.chunks),
+            entry.extended.get("etag", ""),
+            entry.attr.mtime,
+        )
+
+    def get(self, path: str, entry) -> Optional[bytes]:
+        with self._lock:
+            hit = self._entries.get(path)
+            if hit is not None and hit[0] == self.signature(entry):
+                self._entries.move_to_end(path)
+                self.hits += 1
+                return hit[1]
+            if hit is not None:  # stale signature: drop it now
+                self._bytes -= len(hit[1])
+                del self._entries[path]
+            self.misses += 1
+            return None
+
+    def put(self, path: str, entry, resp: bytes) -> None:
+        if len(resp) > self.max_entry or self.capacity <= 0:
+            return
+        with self._lock:
+            old = self._entries.pop(path, None)
+            if old is not None:
+                self._bytes -= len(old[1])
+            self._entries[path] = (self.signature(entry), resp)
+            self._bytes += len(resp)
+            while self._bytes > self.capacity and self._entries:
+                _, (_sig, victim) = self._entries.popitem(last=False)
+                self._bytes -= len(victim)
+
+    def stats(self) -> dict:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "bytes": self._bytes,
+            "entries": len(self._entries),
+        }
+
+
+class _CIHeaders:
+    """Case-insensitive str view over FastRequest's lower-cased byte
+    headers — the shape s3/auth.py expects from aiohttp's CIMultiDict.
+    No getall(): the fast tier collapses duplicate header names, and a
+    signature that depends on duplicates falls back to the full tier."""
+
+    __slots__ = ("_h",)
+
+    def __init__(self, headers: dict):
+        self._h = headers
+
+    def get(self, name: str, default: str = ""):
+        v = self._h.get(name.lower().encode("latin1"))
+        return v.decode("latin1") if v is not None else default
+
+    def __getitem__(self, name: str) -> str:
+        v = self._h.get(name.lower().encode("latin1"))
+        if v is None:
+            raise KeyError(name)
+        return v.decode("latin1")
+
+    def __contains__(self, name: str) -> bool:
+        return name.lower().encode("latin1") in self._h
 
 
 class S3Server:
@@ -86,18 +285,290 @@ class S3Server:
         self.address = f"{host}:{port}"
         self.iam = iam
         self._http_runner: Optional[web.AppRunner] = None
+        self._core = None
+        self._stage_children: dict = {}
+        self.last_list_scanned = 0
+        import os as _os
+
+        cache_mb = float(
+            _os.environ.get("SEAWEEDFS_TPU_S3_CACHE_MB", "64") or 0
+        )
+        self.object_cache: Optional[ObjectResponseCache] = (
+            ObjectResponseCache(int(cache_mb * (1 << 20)))
+            if cache_mb > 0
+            else None
+        )
 
     async def start(self) -> None:
         app = web.Application(client_max_size=1024 << 20)
         app.router.add_route("*", "/{tail:.*}", self._dispatch)
-        self._http_runner = web.AppRunner(app, access_log=None)
-        await self._http_runner.setup()
-        site = web.TCPSite(self._http_runner, self.host, self.port)
-        await site.start()
+        # shared serving core (ISSUE 7): hot object verbs — PutObject,
+        # GetObject, HeadObject — on the byte-level fast tier; every cold
+        # XML/control verb (bucket ops, LIST, multipart, copy, select,
+        # presigned queries) replays against the aiohttp app
+        from ..server.serving_core import ServingCore
+
+        self._core = ServingCore("s3", self._fast_dispatch, self.host, self.port)
+        await self._core.start(app)
+        self._http_runner = self._core._http_runner
 
     async def stop(self) -> None:
-        if self._http_runner is not None:
+        if self._core is not None:
+            await self._core.stop()
+        elif self._http_runner is not None:
             await self._http_runner.cleanup()
+
+    # ------------- fast-tier HTTP dispatch (server/serving_core.py) -------------
+    async def _fast_dispatch(self, req):
+        """Byte-level handlers for the hot object verbs. Anything the
+        fast tier does not fully understand — query strings (presigned
+        auth, uploadId, list-type), encoded paths, copy sources, bucket
+        operations — replays against the aiohttp app, so the two tiers
+        can never disagree."""
+        if req.query or "%" in req.path or "/../" in req.path:
+            return FALLBACK
+        bucket, _, key = req.path.strip("/").partition("/")
+        if not bucket or not key:
+            return FALLBACK  # ListBuckets / bucket ops / ListObjects
+        method = req.method
+        if method == "PUT":
+            if b"x-amz-copy-source" in req.headers:
+                return FALLBACK
+            return await self._fast_put_object(req, bucket, key)
+        if method in ("GET", "HEAD"):
+            return await self._fast_get_object(req, bucket, key)
+        return FALLBACK
+
+    def _stage_observe(self, verb: str, stage: str, dt: float) -> None:
+        ch = self._stage_children.get((verb, stage))
+        if ch is None:
+            ch = self._stage_children[(verb, stage)] = S3_STAGE_SECONDS.child(
+                verb=verb, stage=stage
+            )
+        ch.observe(dt)
+
+    def _fast_auth(self, req, bucket: str, key: str):
+        """-> None when the request may proceed, FALLBACK when auth is
+        enabled and this request is denied or not fully understood — the
+        aiohttp tier then re-authenticates with the full parser and
+        renders the proper S3 error (the fast tier never produces an
+        auth VERDICT the full tier wouldn't)."""
+        if self.iam is None or not self.iam.enabled:
+            return None
+        from .auth import AccessDenied
+
+        action = self._required_action(req.method, bucket, key, {})
+        headers = _CIHeaders(req.headers)
+        payload_hash = ""
+        auth_header = headers.get("Authorization", "")
+        if (
+            auth_header
+            and not auth_header.startswith("AWS ")
+            and not headers.get("x-amz-content-sha256")
+        ):
+            import hashlib
+
+            payload_hash = hashlib.sha256(req.body).hexdigest()
+        try:
+            ident = self.iam.authenticate(
+                {
+                    "method": req.method,
+                    "raw_path": req.path,
+                    "query_pairs": [],
+                    "raw_query": "",
+                    "headers": headers,
+                    "payload_hash": payload_hash,
+                }
+            )
+        except AccessDenied:
+            return FALLBACK
+        if not ident.can_do(action, bucket):
+            return FALLBACK
+        return None
+
+    async def _fast_put_object(self, req, bucket: str, key: str):
+        """PutObject on the fast tier: the raw request body is sliced
+        into chunk memoryviews by the filer's leased upload path — no
+        multipart framing, no intermediate copies. The handler wall is
+        partitioned into the s3_stage_seconds budget:
+        auth | meta (bucket check + entry touch) | lease | upload |
+        render (etag md5 + response bytes)."""
+        t0 = time.perf_counter()
+        if self._fast_auth(req, bucket, key) is not None:
+            return FALLBACK
+        t1 = time.perf_counter()
+        if self.filer.find_entry(f"{BUCKETS_ROOT}/{bucket}") is None:
+            return render_response(
+                404,
+                _error_xml("NoSuchBucket", f"bucket {bucket} not found"),
+                content_type=b"application/xml",
+            )
+        t2 = time.perf_counter()
+        st: dict = {}
+        try:
+            chunks = await self.fs._write_chunks(req.body, stages=st)
+        except Exception as e:
+            return render_response(
+                500,
+                _error_xml("InternalError", str(e)),
+                content_type=b"application/xml",
+            )
+        t3 = time.perf_counter()
+        import hashlib
+
+        etag = hashlib.md5(req.body).hexdigest()
+        t4 = time.perf_counter()
+        try:
+            # one store write: the etag rides the CREATE instead of a
+            # touch-then-update pair (half the metadata writes per PUT)
+            from ..filer.entry import Attr as _Attr
+            from ..filer.entry import Entry as _Entry
+
+            now = time.time()
+            self.filer.create_entry(
+                _Entry(
+                    full_path=self._object_path(bucket, key),
+                    attr=_Attr(
+                        mtime=now,
+                        crtime=now,
+                        mime=req.headers.get(b"content-type", b"").decode(
+                            "latin1"
+                        ),
+                    ),
+                    chunks=chunks,
+                    extended={"etag": etag},
+                )
+            )
+        except OSError as e:
+            self.fs._queue_chunk_deletion([c.fid for c in chunks])
+            return render_response(
+                500,
+                _error_xml("InternalError", str(e)),
+                content_type=b"application/xml",
+            )
+        t5 = time.perf_counter()
+        out = render_response(
+            200, b"", extra=b'ETag: "%s"\r\n' % etag.encode()
+        )
+        t6 = time.perf_counter()
+        ob = self._stage_observe
+        ob("PUT", "auth", t1 - t0)
+        ob("PUT", "meta", (t2 - t1) + (t5 - t4))
+        ob("PUT", "lease", st.get("lease", 0.0))
+        ob("PUT", "upload", st.get("upload", 0.0))
+        # residual of the chunk-write wall (slicing, scheduling) rides
+        # the upload leg so the partition still sums to the handler wall
+        ob("PUT", "render", (t4 - t3) + (t6 - t5) + max(
+            0.0, (t3 - t2) - st.get("lease", 0.0) - st.get("upload", 0.0)
+        ))
+        return out
+
+    async def _fast_get_object(self, req, bucket: str, key: str):
+        """GetObject/HeadObject on the fast tier. Range GETs fetch their
+        visible intervals through the filer's concurrent span reader
+        (distinct chunks in parallel via the replica fan-out). Stage
+        budget: auth | meta | fetch | render."""
+        t0 = time.perf_counter()
+        if self._fast_auth(req, bucket, key) is not None:
+            return FALLBACK
+        t1 = time.perf_counter()
+        entry = self.filer.find_entry(self._object_path(bucket, key))
+        if entry is None or entry.is_directory:
+            return render_response(
+                404,
+                _error_xml("NoSuchKey", f"key {key} not found"),
+                content_type=b"application/xml",
+            )
+        size = entry.size()
+        etag_hdr = b'ETag: "%s"\r\n' % entry.extended.get("etag", "").encode()
+        t2 = time.perf_counter()
+        ob = self._stage_observe
+        if req.method == "HEAD":
+            lm = time.strftime(
+                "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(entry.attr.mtime)
+            ).encode()
+            out = (
+                b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: application/octet-stream\r\n"
+                b"Content-Length: %d\r\n" % size
+            ) + etag_hdr + (
+                b"Last-Modified: %s\r\nConnection: keep-alive\r\n\r\n" % lm
+            )
+            ob("HEAD", "auth", t1 - t0)
+            ob("HEAD", "meta", t2 - t1)
+            ob("HEAD", "render", time.perf_counter() - t2)
+            return out
+        ctype = (entry.attr.mime or "application/octet-stream").encode()
+        rng = req.headers.get(b"range")
+        span = None
+        if rng is not None:
+            from ..util.http_range import parse_range
+
+            span = parse_range(rng.decode("latin1"), size)
+            if span == "invalid-range":
+                return render_response(
+                    416, b"", extra=b"Content-Range: bytes */%d\r\n" % size
+                )
+        cache = self.object_cache
+        path = self._object_path(bucket, key)
+        if span is None and cache is not None:
+            # validated object-response cache: the entry probe above is
+            # the freshness check; a signature match serves the whole
+            # pre-rendered response without the volume data hop
+            out = cache.get(path, entry)
+            if out is not None:
+                ob("GET", "auth", t1 - t0)
+                ob("GET", "meta", t2 - t1)
+                ob(
+                    "GET", "render",
+                    time.perf_counter() - t2,
+                )
+                return out
+        t3 = time.perf_counter()
+        try:
+            if span is not None:
+                start, end = span
+                visibles = non_overlapping_visible_intervals(entry.chunks)
+                body = await self.fs._read_span(
+                    visibles, start, end - start + 1
+                )
+            else:
+                # whole-object GET: single-chunk objects return the
+                # volume body directly (no interval sweep, no stitch)
+                body = (
+                    await self.fs._entry_body(entry, size) if size else b""
+                )
+        except Exception as e:
+            return render_response(
+                500,
+                _error_xml("InternalError", str(e)),
+                content_type=b"application/xml",
+            )
+        t4 = time.perf_counter()
+        if span is not None:
+            out = render_response(
+                206,
+                body,
+                content_type=ctype,
+                extra=etag_hdr
+                + b"Content-Range: bytes %d-%d/%d\r\nAccept-Ranges: bytes\r\n"
+                % (start, end, size),
+            )
+        else:
+            out = render_response(
+                200,
+                body,
+                content_type=ctype,
+                extra=etag_hdr + b"Accept-Ranges: bytes\r\n",
+            )
+            if cache is not None:
+                cache.put(path, entry, out)
+        t5 = time.perf_counter()
+        ob("GET", "auth", t1 - t0)
+        ob("GET", "meta", (t2 - t1) + (t3 - t2))
+        ob("GET", "fetch", t4 - t3)
+        ob("GET", "render", t5 - t4)
+        return out
 
     # ---------------- auth (ref s3api_server.go router action mapping) ----------------
     @staticmethod
@@ -260,44 +731,22 @@ class S3Server:
             or request.query.get("marker", "")
         )
 
-        contents: list[tuple[str, Entry]] = []
-        common: set[str] = set()
-
-        def walk(dir_path: str, rel: str) -> None:
-            for e in self.filer.list_entries(dir_path, limit=100_000):
-                child_rel = f"{rel}{e.name}" if rel else e.name
-                if e.is_directory:
-                    if delimiter == "/" and child_rel.startswith(prefix):
-                        common.add(child_rel + "/")
-                        continue
-                    # prune subtrees that cannot contribute: every key
-                    # under child_rel+"/" sorts before child_rel+"0"
-                    # ("/" < "0"), and prefix mismatch is structural
-                    subtree = child_rel + "/"
-                    if prefix and not (
-                        subtree.startswith(prefix) or prefix.startswith(subtree)
-                    ):
-                        continue
-                    if after and child_rel + "0" <= after:
-                        continue
-                    walk(e.full_path, subtree)
-                elif child_rel.startswith(prefix):
-                    if after and child_rel <= after:
-                        continue
-                    contents.append((child_rel, e))
-
-        walk(path, "")
-        # keys and common prefixes share one sorted stream and one
-        # max-keys budget (S3 semantics: prefixes count toward MaxKeys and
-        # paginate with the same marker)
-        merged: list[tuple[str, Optional[Entry]]] = [
-            (k, e) for k, e in contents
-        ] + [(p, None) for p in common]
-        merged.sort(key=lambda t: t[0])
-        if after:
-            merged = [t for t in merged if t[0] > after]
-        truncated = len(merged) > max_keys
-        page = merged[:max_keys]
+        # bounded range scan (list_objects_page): keys and common prefixes
+        # arrive as one sorted stream sharing the max-keys budget, and the
+        # work done is O(page + CommonPrefixes), not O(bucket)
+        stats = ScanStats()
+        page, truncated = list_objects_page(
+            self.filer,
+            path,
+            prefix=prefix,
+            after=after,
+            max_keys=max_keys,
+            delimiter=delimiter,
+            stats=stats,
+        )
+        S3_LIST_REQUESTS.inc()
+        S3_LIST_SCANNED.inc(stats.scanned)
+        self.last_list_scanned = stats.scanned  # bench/test visibility
         root = ET.Element("ListBucketResult")
         ET.SubElement(root, "Name").text = bucket
         ET.SubElement(root, "Prefix").text = prefix
@@ -478,18 +927,10 @@ class S3Server:
         )
 
     async def _read_span(self, visibles, offset: int, length: int) -> bytes:
-        """Fetch exactly the chunks overlapping [offset, offset+length)."""
-        from ..filer.filechunks import view_from_visibles
-
-        blobs = {}
-        for view in view_from_visibles(visibles, offset, length):
-            if view.fid not in blobs:
-                blobs[view.fid] = await self.fs._fetch_chunk(
-                    view.fid, view.cipher_key
-                )
-        return read_from_visible_intervals(
-            visibles, blobs.__getitem__, offset, length
-        )
+        """Fetch exactly the chunks overlapping [offset, offset+length) —
+        delegates to the filer server's span reader: distinct fids are
+        fetched CONCURRENTLY through the replica read fan-out."""
+        return await self.fs._read_span(visibles, offset, length)
 
     async def _select_object_content(
         self, request: web.Request, bucket: str, key: str

@@ -178,21 +178,18 @@ class MasterServer:
         app.router.add_get("/", self._ui)
         app.router.add_get("/ui", self._ui)
         app.router.add_get("/{file_id:[0-9]+,.+}", self._redirect)
-        self._http_runner = web.AppRunner(app, access_log=None)
-        await self._http_runner.setup()
-        # full app on an internal loopback port; the public port is the
-        # byte-level fast tier (util/fasthttp.py) which serves /dir/assign
-        # and /dir/lookup itself and proxies the rest here
-        site = web.TCPSite(self._http_runner, "127.0.0.1", 0)
-        await site.start()
-        internal_port = site._server.sockets[0].getsockname()[1]
+        # shared serving core (server/serving_core.py): full app on an
+        # internal loopback port; the public port is the byte-level fast
+        # tier which serves /dir/assign and /dir/lookup itself and
+        # proxies the rest here
+        from .serving_core import ServingCore
 
-        from ..util.fasthttp import FastHTTPServer
-
-        self._fast_server = FastHTTPServer(
-            self._fast_dispatch, backend=("127.0.0.1", internal_port)
+        self._core = ServingCore(
+            "master", self._fast_dispatch, self.host, self.port
         )
-        await self._fast_server.start(self.host, self.port)
+        await self._core.start(app)
+        self._fast_server = self._core.fast_server
+        self._http_runner = self._core._http_runner
 
         svc = Service("master")
         svc.bidi_stream("SendHeartbeat")(self._send_heartbeat)

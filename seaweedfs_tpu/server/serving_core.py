@@ -1,0 +1,134 @@
+"""Shared HTTP serving core: one byte-level fast tier + one aiohttp cold tier.
+
+Factored out of the volume server's start() (ISSUE 7 tentpole) so every
+HTTP-facing server — volume, master, filer, S3 gateway — runs the same
+two-tier shape instead of re-wiring it by hand:
+
+- the PUBLIC port is owned by a `util/fasthttp.FastHTTPServer` whose
+  handler is the server's fast tier (zero-copy body handoff, pre-rendered
+  heads, slim request queue — the data plane);
+- the full aiohttp application listens on an INTERNAL loopback port and
+  receives every request the fast tier does not fully understand
+  (FALLBACK replay keeps the two tiers semantically identical);
+- the server-side HTTP fault seam (`util/faults.py`) fires here, so the
+  existing fault plans — latency, brownout, reset, http_error, crash —
+  apply to gateway/filer/master requests exactly like they already did to
+  the client seam. The seam op is ``http:<METHOD>`` with the LISTENING
+  address as target, i.e. a plan rule like
+  ``FaultRule(op="http:GET", target="*:8333", fault="latency", ...)``
+  brownouts the S3 gateway's served reads. NOTE the deliberate
+  consequence for IN-CLUSTER hops: a request one of our own clients
+  sends to one of our own servers consults the plan twice (client seam
+  at `FastHTTPClient.request`, server seam here), so a rule targeting a
+  serving address injects on both sides and burns two `nth` matches per
+  such request — the peer degrades AND the network to it degrades,
+  which is what a real brownout looks like. Pin `target` to an address
+  only one seam sees (or use distinct rules) when single-fire matters;
+- per-method request counters (`seaweedfs_tpu_request_total{server=...}`)
+  with pre-bound children, shared by the sync-return path and DETACHED
+  completions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from aiohttp import web
+
+from ..util import faults
+from ..util.fasthttp import (
+    DETACHED,
+    FALLBACK,
+    FastHTTPServer,
+    render_response,
+)
+from ..util.metrics import REQUEST_COUNTER
+
+
+class ServingCore:
+    """Two-tier HTTP serving shared by volume/master/filer/S3 servers.
+
+    `handler` is the fast tier: ``async (FastRequest) -> bytes | FALLBACK
+    | DETACHED``. The aiohttp application passed to :meth:`start` is the
+    cold tier every FALLBACK replays against."""
+
+    def __init__(self, name: str, handler, host: str, port: int):
+        self.name = name
+        self.handler = handler
+        self.host = host
+        self.port = port
+        self.address = f"{host}:{port}"
+        self.fast_server: Optional[FastHTTPServer] = None
+        self._http_runner: Optional[web.AppRunner] = None
+        self.internal_port: Optional[int] = None
+        self._req_counters: dict = {}
+
+    async def start(self, app: web.Application) -> None:
+        self._http_runner = web.AppRunner(app, access_log=None)
+        await self._http_runner.setup()
+        site = web.TCPSite(self._http_runner, "127.0.0.1", 0)
+        await site.start()
+        self.internal_port = site._server.sockets[0].getsockname()[1]
+        self.fast_server = FastHTTPServer(
+            self._dispatch, backend=("127.0.0.1", self.internal_port)
+        )
+        await self.fast_server.start(self.host, self.port)
+
+    async def stop(self) -> None:
+        if self.fast_server is not None:
+            await self.fast_server.stop()
+        if self._http_runner is not None:
+            await self._http_runner.cleanup()
+
+    def count(self, method: str) -> None:
+        """Count one served request; pre-bound children keep this O(1) on
+        the hot path (DETACHED completions call this from their flush
+        callback, so a proxied continuation is never double-counted)."""
+        child = self._req_counters.get(method)
+        if child is None:
+            child = self._req_counters[method] = REQUEST_COUNTER.child(
+                server=self.name, operation=method
+            )
+        child.inc()
+
+    async def _dispatch(self, req):
+        plan = faults._PLAN
+        if plan is not None:
+            out = await self._apply_fault(plan, req)
+            if out is not None:
+                return out
+        out = await self.handler(req)
+        if out is not FALLBACK and out is not DETACHED:
+            self.count(req.method)
+        return out
+
+    async def _apply_fault(self, plan, req):
+        """Server-side HTTP seam: consult the plan at request arrival.
+        Returns response bytes / DETACHED to short-circuit, or None to
+        proceed to the handler (latency rules have already slept)."""
+        try:
+            ev = await faults.async_fault(
+                plan, f"http:{req.method}", self.address
+            )
+        except faults.SimulatedCrash:
+            # the 'process' is dead: connections just drop, mid-request
+            if req.transport is not None:
+                req.transport.close()
+            return DETACHED  # connection_lost tears the request loop down
+        except ConnectionResetError:
+            # injected reset: the peer sees a dropped connection, exactly
+            # like the client-seam variant
+            if req.transport is not None:
+                req.transport.close()
+            return DETACHED
+        except TimeoutError:
+            # injected hang already slept through the window; surface the
+            # way a gateway's upstream timeout would
+            return render_response(
+                500, b'{"error":"injected hang"}', keep_alive=False
+            )
+        if ev is not None and ev.kind == "http_error":
+            return render_response(
+                ev.rule.status, b'{"error":"injected fault"}'
+            )
+        return None

@@ -43,12 +43,12 @@ from ..util.fasthttp import (
     render_response,
 )
 from ..util.metrics import (
+    CHUNK_BATCH_PUT_SIZE,
     READ_CACHE_BYTES,
     READ_CACHE_EVICTIONS,
     READ_CACHE_HITS,
     READ_CACHE_MISSES,
     READ_STAGE_SECONDS,
-    REQUEST_COUNTER,
     WRITE_STAGE_SECONDS,
 )
 from .volume_ec import EcHandlers
@@ -335,7 +335,6 @@ class VolumeServer(EcHandlers):
         self._scrubber = None
         self._scrub_task: Optional[asyncio.Task] = None
         self._group_committers: dict[int, object] = {}
-        self._req_counters: dict[str, object] = {}
         self._replica_loc_cache: dict[int, tuple[float, list]] = {}
         # cross-request probe batching (north-star #2 serving path):
         # off | auto (bulk_lookup's device policy) | host | device
@@ -387,23 +386,20 @@ class VolumeServer(EcHandlers):
         self._http_client = aiohttp.ClientSession()
         app = web.Application(client_max_size=256 << 20)
         app.router.add_route("*", "/{tail:.*}", self._dispatch)
-        self._http_runner = web.AppRunner(app, access_log=None)
-        await self._http_runner.setup()
-        # the full aiohttp surface listens on an internal loopback port; the
-        # public port is owned by the byte-level fast tier, which serves the
-        # hot data plane itself and transparently proxies everything else
-        # here (util/fasthttp.py — the reference's thin Go handler loop
-        # equivalent, volume_server_handlers_read.go)
-        site = web.TCPSite(self._http_runner, "127.0.0.1", 0)
-        await site.start()
-        internal_port = site._server.sockets[0].getsockname()[1]
+        # shared serving core (server/serving_core.py): full aiohttp
+        # surface on an internal loopback port; the public port is owned
+        # by the byte-level fast tier, which serves the hot data plane
+        # itself and transparently proxies everything else (the
+        # reference's thin Go handler loop equivalent,
+        # volume_server_handlers_read.go)
+        from .serving_core import ServingCore
 
-        from ..util.fasthttp import FastHTTPServer
-
-        self._fast_server = FastHTTPServer(
-            self._fast_dispatch, backend=("127.0.0.1", internal_port)
+        self._core = ServingCore(
+            "volume", self._fast_dispatch, self.host, self.port
         )
-        await self._fast_server.start(self.host, self.port)
+        await self._core.start(app)
+        self._fast_server = self._core.fast_server
+        self._http_runner = self._core._http_runner
 
         svc = Service("volume")
         svc.unary("AllocateVolume")(self._grpc_allocate_volume)
@@ -557,37 +553,28 @@ class VolumeServer(EcHandlers):
             except Exception:
                 pass
 
-    # ---------------- fast-tier HTTP dispatch (util/fasthttp.py) ----------------
+    # ------------- fast-tier HTTP dispatch (server/serving_core.py) -------------
     async def _fast_dispatch(self, req):
         """Byte-level hot handlers for the data plane. Any request shape
         outside the fully-understood fast cases returns FALLBACK, which the
         protocol replays against the internal aiohttp app — semantics can
         never diverge, the fast tier only short-circuits what it completely
         covers. Reads may fall back at ANY point (no side effects); writes
-        only before the needle append."""
+        only before the needle append. Counting and the server-side fault
+        seam live in the shared ServingCore; DETACHED responses count at
+        their completion callback via _count_fast so a gated read that
+        proxies to the full app is never double-counted."""
         method = req.method
         if method in ("GET", "HEAD"):
-            out = await self._fast_read(req)
-        elif method in ("POST", "PUT"):
-            out = self._fast_write(req)
-        else:
-            return FALLBACK
-        if out is not FALLBACK and out is not DETACHED:
-            # pre-bound children: tuple(sorted(labels)) per request was
-            # measurable at serving QPS rates. DETACHED is counted at its
-            # completion (the flush callback): a gated read that proxies
-            # to the full app is counted there, and counting it here too
-            # would double-count
-            self._count_fast(method)
-        return out
+            return await self._fast_read(req)
+        if method in ("POST", "PUT"):
+            if req.path == "/!batch/put":
+                return self._fast_batch_put(req)
+            return self._fast_write(req)
+        return FALLBACK
 
     def _count_fast(self, method: str) -> None:
-        child = self._req_counters.get(method)
-        if child is None:
-            child = self._req_counters[method] = REQUEST_COUNTER.child(
-                server="volume", operation=method
-            )
-        child.inc()
+        self._core.count(method)
 
     async def _fast_read(self, req):
         if req.query or not req.path or req.path == "/" or "debug" in req.path:
@@ -810,6 +797,76 @@ class VolumeServer(EcHandlers):
                 % (filename, size, n.etag())
             ).encode()
         return render_response(201, body)
+
+    def _fast_batch_put(self, req):
+        """Batched multipart-free chunk PUT (POST /!batch/put): one
+        request appends N needles — the write-side sibling of
+        BatchLookupGate/BatchDelete, fed by the filer's chunk-upload
+        gate so concurrent gateway PUTs amortize the per-request HTTP
+        machinery instead of paying a full hop per chunk.
+
+        Frame: [u32 count] then per item [u16 fid_len][u32 body_len]
+        [fid][body]; bodies are handed to the needle append as
+        memoryviews into the request body (zero-copy). Response: JSON
+        list of {"f": fid, "s": size, "e": etag} or {"f": fid, "err":
+        reason} — items this server can't serve on the fast path
+        (missing volume, replicated placement) report per-item errors
+        and the CLIENT retries them through the single-needle path, so
+        semantics never diverge."""
+        import json as _json
+        import struct as _struct
+
+        if not self.guard.check_whitelist(req.peer):
+            return render_response(403, b'{"error": "forbidden"}')
+        if self.jwt_signing_key:
+            # per-item tokens can't ride one batch request: the filer
+            # never batches when the master signs uploads, and a stray
+            # batch against a signing server must not bypass auth
+            return render_response(401, b'{"error": "unauthorized"}')
+        body = req.body
+        mv = memoryview(body)
+        out = []
+        try:
+            (count,) = _struct.unpack_from("<I", body, 0)
+            pos = 4
+            if count > 4096:
+                raise ValueError("batch too large")
+            for _ in range(count):
+                fl, bl = _struct.unpack_from("<HI", body, pos)
+                pos += 6
+                fid_s = bytes(mv[pos : pos + fl]).decode("latin1")
+                pos += fl
+                if pos + bl > len(body):
+                    raise ValueError("truncated batch frame")
+                payload = mv[pos : pos + bl]
+                pos += bl
+                try:
+                    fid = FileId.parse(fid_s)
+                    vid = fid.volume_id
+                    v = self.store.find_volume(vid)
+                    if v is None:
+                        out.append({"f": fid_s, "err": "no volume"})
+                        continue
+                    if v.super_block.replica_placement.copy_count() > 1:
+                        # replication fan-out is the aiohttp single
+                        # path's job; the client retries item-wise
+                        out.append({"f": fid_s, "err": "replicated"})
+                        continue
+                    n = Needle(cookie=fid.cookie, id=fid.key, data=payload)
+                    _off, size, _unchanged = self.store.write_volume_needle(
+                        vid, n
+                    )
+                    if self.read_cache is not None:
+                        self.read_cache.invalidate_key(
+                            vid, fid.key, "overwrite"
+                        )
+                    out.append({"f": fid_s, "s": size, "e": n.etag()})
+                except Exception as e:
+                    out.append({"f": fid_s, "err": str(e)})
+        except Exception:
+            return render_response(400, b'{"error": "bad batch frame"}')
+        CHUNK_BATCH_PUT_SIZE.observe(count)
+        return render_response(200, _json.dumps(out).encode())
 
     # ---------------- HTTP dispatch ----------------
     async def _dispatch(self, request: web.Request) -> web.StreamResponse:

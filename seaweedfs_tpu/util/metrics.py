@@ -114,6 +114,14 @@ class Histogram(_Labeled):
         histogram analogue of Counter.child, for per-request hot paths."""
         return _HistogramChild(self, tuple(sorted(labels.items())))
 
+    def sum_count(self, **labels) -> tuple:
+        """(sum, count) snapshot for one label set — bench legs
+        difference these across a measured window to get per-stage
+        averages without parsing the rendered exposition."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._sums.get(key, 0.0), self._totals.get(key, 0)
+
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._lock:
@@ -326,6 +334,39 @@ REPAIR_SECONDS = REGISTRY.histogram(
     "seaweedfs_tpu_repair_seconds",
     "wall seconds per dispatched repair, by kind (ec_rebuild/replica_"
     "recopy/tail_sync/vacuum) and result (ok/error/skipped)",
+)
+
+# object gateway (see docs/perf.md "Object gateway"): the S3/filer fast
+# path gets the same itemized-stage treatment as the volume write path —
+# every fast-tier PutObject partitions its handler wall into
+# auth/meta/lease/upload/render (GETs into auth/meta/fetch/render), and
+# the LIST path discloses how many store entries each request actually
+# scanned (the O(max-keys)-not-O(bucket) claim, externally auditable)
+S3_STAGE_SECONDS = REGISTRY.histogram(
+    "seaweedfs_tpu_s3_stage_seconds",
+    "S3 gateway fast-path stage wall seconds, by verb and stage (PUT: "
+    "auth/meta/lease/upload/render partition the handler wall; GET: "
+    "auth/meta/fetch/render)",
+)
+S3_LIST_SCANNED = REGISTRY.counter(
+    "seaweedfs_tpu_s3_list_scanned_entries_total",
+    "filer-store entries pulled by ListObjects range scans (per-request "
+    "work bound: O(max-keys + returned CommonPrefixes))",
+)
+S3_LIST_REQUESTS = REGISTRY.counter(
+    "seaweedfs_tpu_s3_list_requests_total",
+    "ListObjects requests served by the range-scan path",
+)
+CHUNK_BATCH_PUT_SIZE = REGISTRY.histogram(
+    "seaweedfs_tpu_chunk_batch_put_size",
+    "needles per batched fast-tier chunk PUT (POST /!batch/put — the "
+    "filer upload gate's same-tick coalescing width)",
+    buckets=[1, 2, 4, 8, 16, 32, 64],
+)
+FILER_CHUNK_DELETE_BATCHES = REGISTRY.counter(
+    "seaweedfs_tpu_filer_chunk_delete_batches_total",
+    "batched per-host chunk-delete RPC rounds drained by the filer GC, "
+    "by result (ok/retry)",
 )
 
 # vacuum plane (see docs/perf.md "Vacuum plane"): compaction gets the same

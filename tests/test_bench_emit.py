@@ -228,6 +228,47 @@ def test_serving_open_loop_leg_shape():
     assert ol["read_fanout"]["reads"] > 0
 
 
+def test_s3_gateway_leg_shape():
+    """ISSUE 7 guard: the three s3.* legs must emit non-zero p50/p99,
+    the PUT stage budget's components must be non-zero and sum to ~the
+    measured avg/p50 latency, and the LIST leg must disclose a
+    page-bounded scanned-entries-per-request number."""
+    r = bench.measure_s3_gateway(
+        num_objects=300, obj_bytes=512, list_keys=1500, max_keys=50,
+        get_duration=1.2,
+    )
+    assert "error" not in r, r.get("error")
+    # put leg
+    assert r["put_qps"] > 0
+    assert r["put_latency_ms"]["p50_ms"] > 0
+    assert r["put_latency_ms"]["p99_ms"] >= r["put_latency_ms"]["p50_ms"]
+    assert r["put_vs_raw"] > 0 and r["raw_put_qps"] > 0
+    budget = r["s3_stage_budget"]
+    for stage in ("auth", "meta", "lease", "upload", "render"):
+        assert budget[f"{stage}_us"] > 0, stage
+    # components partition the handler wall; the client p50 adds the
+    # request hop on top, so coverage lands near (but under) 1.0
+    assert 0.3 <= budget["coverage_of_p50"] <= 1.3, budget
+    # get leg (open-loop summary)
+    ol = r["get_open_loop"]
+    assert r["get_qps"] > 0 and ol["p50_ms"] > 0
+    assert ol["p50_ms"] <= ol["p99_ms"] <= ol["p999_ms"]
+    assert r["get_vs_raw"] > 0 and r["raw_get_qps"] > 0
+    assert r["gateway_direct_identical"] is True
+    assert "hit_rate" in r["object_cache"]
+    # list leg: latency, QPS, and the scan-work disclosure
+    assert r["list_qps"] > 0
+    assert r["list_latency_ms"]["p50_ms"] > 0
+    assert r["list_latency_ms"]["p99_ms"] > 0
+    assert r["list_scanned_per_request"] > 0
+    assert r["list_scan_bounded"] is True
+    # the bucket is 30x the page here; a full-bucket walker would scan
+    # ~1500 entries per request
+    assert r["list_scanned_per_request"] < r["list_keys"] / 4
+    if r.get("list_full_walks"):
+        assert r["list_walk_complete"] is True
+
+
 def test_device_history_appends_per_emit(tmp_path, monkeypatch):
     """ISSUE 6 satellite: every bench emit appends {run, device_status}
     to DEVICE_HISTORY.jsonl so stand-in runs stop erasing the record of
