@@ -25,6 +25,7 @@ import time
 from typing import Optional
 
 from ..ops.loadgen import LogHistogram
+from ..util import trace
 
 
 class ReplicaReader:
@@ -144,6 +145,7 @@ class ReplicaReader:
             # next holder outright — a crashed peer must cost one extra
             # round-trip, not 1/N of all reads until the vid map learns
             self.hedges += 1
+            trace.flag(trace.FLAG_HEDGE)
             st, body = await self.http.request("GET", order[1], target)
             if st == 200:
                 self.hedge_wins += 1
@@ -162,6 +164,7 @@ class ReplicaReader:
             # the peer's problem, never a reason to re-run the primary
             # failover (the primary's answer is in hand and stands).
             self.hedges += 1
+            trace.flag(trace.FLAG_HEDGE)
             try:
                 # bounded: a hung cross-check peer must not stall a read
                 # whose answer is already in hand
@@ -178,8 +181,11 @@ class ReplicaReader:
                 self._record_ok(t0, st2)
                 return st2, body2
             return st, body  # peers agree: the primary's answer stands
-        # primary is past p99: race a hedge on the next replica
+        # primary is past p99: race a hedge on the next replica — and a
+        # promotion flag, so the trace that had to hedge is kept by the
+        # tail sampler even when it was not head-sampled
         self.hedges += 1
+        trace.flag(trace.FLAG_HEDGE)
         hedge = asyncio.ensure_future(
             self.http.request("GET", order[1], target)
         )

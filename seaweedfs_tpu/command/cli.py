@@ -94,7 +94,7 @@ def _add_volume_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "-pprof",
         action="store_true",
-        help="enable /debug/pprof/{profile,heap} HTTP handlers",
+        help="force /debug/pprof HTTP handlers on (default: served unless SEAWEEDFS_TPU_PPROF=0)",
     )
     p.add_argument(
         "-whiteList",
@@ -297,7 +297,7 @@ def cmd_server(argv: list[str]) -> int:
     p.add_argument(
         "-pprof",
         action="store_true",
-        help="enable /debug/pprof handlers on the volume server",
+        help="force /debug/pprof handlers on for the volume server (default: SEAWEEDFS_TPU_PPROF env gate)",
     )
     p.add_argument(
         "-whiteList",

@@ -25,7 +25,7 @@ import grpc
 import grpc.aio
 import msgpack
 
-from ..util import faults
+from ..util import faults, trace
 
 UNARY_UNARY = "unary_unary"
 UNARY_STREAM = "unary_stream"
@@ -38,6 +38,22 @@ def _pack(obj: Any) -> bytes:
 
 def _unpack(data: bytes) -> Any:
     return msgpack.unpackb(data, raw=False, strict_map_key=False)
+
+
+def _trace_metadata(context) -> "trace.SpanCtx | None":
+    """Parent trace context from a call's invocation metadata, or None.
+    Only unary handlers join traces — the long-lived streams (heartbeat,
+    KeepConnected) would hold one span open forever."""
+    try:
+        md = context.invocation_metadata()
+    except Exception:
+        return None
+    if not md:
+        return None
+    for item in md:
+        if item[0] == "traceparent":
+            return trace.parse_traceparent(item[1])
+    return None
 
 
 @dataclass
@@ -79,9 +95,30 @@ class Service:
         for mname, m in self._methods.items():
             if m.kind == UNARY_UNARY:
 
-                def make_uu(handler):
+                def make_uu(handler, method=mname, service=self.name):
                     async def call(request, context):
-                        return _pack(await handler(_unpack(request), context))
+                        # trace join over the gRPC seam: a `traceparent`
+                        # metadata entry (Stub.call injects it) makes the
+                        # handler a span of the caller's trace — master
+                        # leases, repair dispatches and vacuum RPCs all
+                        # line up in one timeline
+                        pctx = _trace_metadata(context)
+                        if pctx is None:
+                            return _pack(
+                                await handler(_unpack(request), context)
+                            )
+                        sp = trace.begin_request(
+                            f"rpc:{method}", pctx, service=service,
+                        )
+                        try:
+                            out = await handler(_unpack(request), context)
+                        except Exception as e:
+                            if sp is not None:
+                                sp.finish(err=e)
+                            raise
+                        if sp is not None:
+                            sp.finish()
+                        return _pack(out)
 
                     return call
 
@@ -161,6 +198,13 @@ class Stub:
             request_serializer=_pack,
             response_deserializer=_unpack,
         )
+        ctx = trace._CTX.get()
+        if ctx is not None:
+            return await fn(
+                request,
+                timeout=timeout,
+                metadata=(("traceparent", trace.format_traceparent(ctx)),),
+            )
         return await fn(request, timeout=timeout)
 
     def server_stream(

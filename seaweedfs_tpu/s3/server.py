@@ -327,6 +327,8 @@ class S3Server:
         can never disagree."""
         if req.query or "%" in req.path or "/../" in req.path:
             return FALLBACK
+        # (/metrics + /debug/* are FALLBACK'd by ServingCore._dispatch
+        # before any fast handler runs)
         bucket, _, key = req.path.strip("/").partition("/")
         if not bucket or not key:
             return FALLBACK  # ListBuckets / bucket ops / ListObjects

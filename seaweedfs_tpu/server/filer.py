@@ -44,6 +44,7 @@ from ..filer import (
 )
 from ..pb import grpc_address
 from ..pb.rpc import Service, Stub, serve
+from ..util import trace
 from ..util.fasthttp import FALLBACK, FastHTTPClient, render_response
 
 
@@ -81,7 +82,11 @@ class ChunkUploadGate:
         if loop is None:
             loop = self._loop = asyncio.get_event_loop()
         fut = loop.create_future()
-        self._pending.setdefault(host, []).append((fid, payload, fut))
+        # sampled member contexts ride the item: the flush records one
+        # span linked to every member trace (ISSUE 8 batch-seam links)
+        self._pending.setdefault(host, []).append(
+            (fid, payload, fut, trace.current_sampled())
+        )
         nbytes = self._bytes.get(host, 0) + len(payload)
         self._bytes[host] = nbytes
         self._count += 1
@@ -123,9 +128,21 @@ class ChunkUploadGate:
             return ""
 
     async def _send(self, host: str, items: list) -> None:
+        # the flush span adopts the first sampled member's trace and
+        # links all of them; entering the span ALSO makes it the current
+        # context, so the batched POST (and any item-wise retries) carry
+        # it downstream — the volume server's span parents to the flush
+        members = [c for _f, _p, _fut, c in items if c is not None]
+        cm = trace.batch_span(
+            "gate.chunk_put", members, host=host, batch=len(items)
+        )
+        with cm:
+            await self._send_inner(host, items)
+
+    async def _send_inner(self, host: str, items: list) -> None:
         try:
             if len(items) == 1:
-                fid, payload, fut = items[0]
+                fid, payload, fut, _ctx = items[0]
                 etag = await self._single(host, fid, payload)
                 if not fut.done():
                     fut.set_result(etag)
@@ -133,7 +150,7 @@ class ChunkUploadGate:
             import struct as _struct
 
             parts = [_struct.pack("<I", len(items))]
-            for fid, payload, _fut in items:
+            for fid, payload, _fut, _ctx in items:
                 fb = fid.encode("latin1")
                 parts.append(_struct.pack("<HI", len(fb), len(payload)))
                 parts.append(fb)
@@ -145,7 +162,7 @@ class ChunkUploadGate:
             if st != 200:
                 raise IOError(f"batch put: status {st} {resp[:160]!r}")
             by_fid = {r.get("f"): r for r in json.loads(resp)}
-            for fid, payload, fut in items:
+            for fid, payload, fut, _ctx in items:
                 if fut.done():
                     continue
                 r = by_fid.get(fid)
@@ -173,7 +190,7 @@ class ChunkUploadGate:
             # resolve every still-pending waiter; a future whose item-wise
             # retry is in flight checks done() before resolving, so the
             # two paths can't double-resolve
-            for _fid, _payload, fut in items:
+            for _fid, _payload, fut, _ctx in items:
                 if not fut.done():
                     fut.set_exception(IOError(str(e)))
 
@@ -538,7 +555,8 @@ class FilerServer:
             key = gen_cipher_key()
             payload = encrypt(bytes(piece), key)
         t0 = time.perf_counter()
-        ar = await lease.take()
+        with trace.span("filer.lease"):
+            ar = await lease.take()
         t1 = time.perf_counter()
         gate = self._upload_gate
         if gate is not None and not ar.auth and not ttl:
@@ -598,27 +616,35 @@ class FilerServer:
         if not offsets:
             return []
         lease = self._lease_for(ttl)
-        if len(offsets) == 1:
-            results = [await self._upload_chunk(mv, ttl, lease, stages)]
-        else:
-            sem = asyncio.Semaphore(self.upload_concurrency)
+        with trace.span(
+            "filer.write_chunks", bytes=len(mv), chunks=len(offsets)
+        ):
+            if len(offsets) == 1:
+                results = [await self._upload_chunk(mv, ttl, lease, stages)]
+            else:
+                sem = asyncio.Semaphore(self.upload_concurrency)
 
-            async def one(off: int):
-                async with sem:
-                    return await self._upload_chunk(
-                        mv[off : off + self.chunk_size], ttl, lease, stages
-                    )
+                async def one(off: int):
+                    async with sem:
+                        return await self._upload_chunk(
+                            mv[off : off + self.chunk_size], ttl, lease,
+                            stages,
+                        )
 
-            results = await asyncio.gather(
-                *(one(off) for off in offsets), return_exceptions=True
-            )
-            errs = [r for r in results if isinstance(r, BaseException)]
-            if errs:
-                # GC the chunks that DID land before surfacing the error
-                self._queue_chunk_deletion(
-                    [r[0] for r in results if not isinstance(r, BaseException)]
+                results = await asyncio.gather(
+                    *(one(off) for off in offsets), return_exceptions=True
                 )
-                raise errs[0]
+                errs = [r for r in results if isinstance(r, BaseException)]
+                if errs:
+                    # GC the chunks that DID land before surfacing the error
+                    self._queue_chunk_deletion(
+                        [
+                            r[0]
+                            for r in results
+                            if not isinstance(r, BaseException)
+                        ]
+                    )
+                    raise errs[0]
         return [
             FileChunk(
                 fid=fid,

@@ -27,6 +27,8 @@ from typing import Optional
 
 import numpy as np
 
+from ..util import trace
+
 # below this many probes a host searchsorted is a few µs — cheaper to run
 # inline on the loop than to round-trip a worker thread
 _EXECUTOR_THRESHOLD = 512
@@ -52,6 +54,10 @@ class BatchLookupGate:
         self.max_batch = max_batch
         self.use_device = use_device
         self._pending: dict = {}  # vid -> list[(key, future)]
+        # sampled member trace contexts per vid: the flush records ONE
+        # span linked to every member trace, so the amortized probe work
+        # is visible from each rider's timeline (ISSUE 8)
+        self._pending_traces: dict = {}
         self._count = 0
         self._flush_scheduled = False
         self._timer = None
@@ -88,6 +94,9 @@ class BatchLookupGate:
         if items is None:
             items = self._pending[vid] = []
         items.append((key, sink))
+        ctx = trace.current_sampled()
+        if ctx is not None:
+            self._pending_traces.setdefault(vid, []).append(ctx)
         self._count += 1
         if self._count >= self.max_batch:
             self._flush()
@@ -109,21 +118,30 @@ class BatchLookupGate:
         if not self._count:
             return
         pending, self._pending, self._count = self._pending, {}, 0
+        traces, self._pending_traces = self._pending_traces, {}
         for vid, items in pending.items():
             self.stats["probes"] += len(items)
             self.stats["batches"] += 1
             if len(items) > self.stats["largest_batch"]:
                 self.stats["largest_batch"] = len(items)
+            members = traces.get(vid)
             if (
                 len(items) < _EXECUTOR_THRESHOLD
                 and self.use_device is not True
             ):
                 # small host batch: one synchronous vectorized probe right
                 # here — no task, no executor, waiters resume on the very
-                # next loop pass
-                self._run_batch_sync(vid, items)
+                # next loop pass. When any member is sampled, the flush
+                # records one linked span (trace.batch_span is a shared
+                # no-op otherwise).
+                with trace.batch_span(
+                    "gate.lookup", members or (), vid=vid, batch=len(items)
+                ):
+                    self._run_batch_sync(vid, items)
             else:
-                t = asyncio.ensure_future(self._run_batch(vid, items))
+                t = asyncio.ensure_future(
+                    self._run_batch(vid, items, members)
+                )
                 self._tasks.add(t)
                 t.add_done_callback(self._tasks.discard)
 
@@ -182,8 +200,14 @@ class BatchLookupGate:
             for _k, sink in items[done:]:
                 self._resolve(sink, None, e)
 
-    async def _run_batch(self, vid: int, items: list) -> None:
+    async def _run_batch(
+        self, vid: int, items: list, members=None
+    ) -> None:
         done = 0
+        cm = trace.batch_span(
+            "gate.lookup", members or (), vid=vid, batch=len(items)
+        )
+        cm.__enter__()
         try:
             v = self.store.find_volume(vid)
             if v is None:
@@ -205,6 +229,8 @@ class BatchLookupGate:
             # becomes a 500 there); already-resolved sinks must not re-fire
             for _k, sink in items[done:]:
                 self._resolve(sink, None, e)
+        finally:
+            cm.__exit__(None, None, None)
 
     def close(self) -> None:
         if self._timer is not None:
@@ -215,6 +241,7 @@ class BatchLookupGate:
             for _k, sink in items:
                 self._resolve(sink, None, LookupError("gate closed"))
         self._pending = {}
+        self._pending_traces = {}
         self._count = 0
         # in-flight batch tasks are left to finish (they're short and their
         # waiters are still listening); cancelling them would strand those

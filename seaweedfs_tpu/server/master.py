@@ -174,7 +174,8 @@ class MasterServer:
         app.router.add_route("*", "/vol/vacuum", self._vol_vacuum)
         app.router.add_route("*", "/col/delete", self._col_delete)
         app.router.add_get("/cluster/status", self._cluster_status)
-        app.router.add_get("/metrics", self._metrics)
+        # /metrics and /debug/* are served by the ServingCore middleware
+        # before routing — a route here would be an unreachable shadow
         app.router.add_get("/", self._ui)
         app.router.add_get("/ui", self._ui)
         app.router.add_get("/{file_id:[0-9]+,.+}", self._redirect)
@@ -548,11 +549,6 @@ class MasterServer:
                 pass
         self.topo.delete_collection(collection)
         return web.json_response({})
-
-    async def _metrics(self, request: web.Request) -> web.Response:
-        from ..util.metrics import REGISTRY
-
-        return web.Response(text=REGISTRY.render(), content_type="text/plain")
 
     async def _ui(self, request: web.Request) -> web.Response:
         """Minimal HTML status page (ref: weed/server/master_ui/)."""
@@ -1002,37 +998,52 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
         ec_ready = [t for t in ready if t.kind == "ec_rebuild"]
         other = [t for t in ready if t.kind != "ec_rebuild"]
 
-        # EC: survivor pulls run CONCURRENTLY per task (the cap is how
-        # many we popped), then ONE batched rebuild RPC per rebuilder
-        # node (PR 3's VolumeEcShardsRebuildBatch fast path — same-loss-
-        # pattern volumes share wide device dispatches there)
-        t0s = {t.key: time.perf_counter() for t in ec_ready}
-        prep = await asyncio.gather(
-            *(self._prepare_ec_rebuild(t, live) for t in ec_ready),
-            return_exceptions=True,
+        # background-plane root span (ISSUE 8), only when the scan found
+        # work; the tail-sync/recopy/rebuild RPCs inherit the context so
+        # anti-entropy interference is visible next to serving traces
+        from ..util import trace
+
+        cm = (
+            trace.span_root(
+                "anti_entropy.dispatch", plane="repair", tasks=len(ready)
+            )
+            if ready
+            else trace.NULL_SPAN
         )
-        prepared: dict[tuple, list] = {}
-        for t, outcome in zip(ec_ready, prep):
-            if isinstance(outcome, BaseException):
-                REPAIR_SECONDS.observe(
-                    time.perf_counter() - t0s[t.key],
-                    kind="ec_rebuild", result="error",
-                )
-                self.repair_queue.reschedule_failure(t, time.monotonic())
-                results.append({**t.to_info(), "error": str(outcome)})
-            else:
-                prepared.setdefault((outcome, t.collection), []).append(
-                    (t, t0s[t.key])
-                )
-        # group rebuilds and replica repairs all dispatch concurrently —
-        # one slow rebuild must not stall an unrelated critical repair
-        await asyncio.gather(
-            *(
-                self._dispatch_ec_group(rebuilder, collection, group, results)
-                for (rebuilder, collection), group in prepared.items()
-            ),
-            *(self._dispatch_replica_task(t, results) for t in other),
-        )
+        with cm:
+            # EC: survivor pulls run CONCURRENTLY per task (the cap is how
+            # many we popped), then ONE batched rebuild RPC per rebuilder
+            # node (PR 3's VolumeEcShardsRebuildBatch fast path — same-loss-
+            # pattern volumes share wide device dispatches there)
+            t0s = {t.key: time.perf_counter() for t in ec_ready}
+            prep = await asyncio.gather(
+                *(self._prepare_ec_rebuild(t, live) for t in ec_ready),
+                return_exceptions=True,
+            )
+            prepared: dict[tuple, list] = {}
+            for t, outcome in zip(ec_ready, prep):
+                if isinstance(outcome, BaseException):
+                    REPAIR_SECONDS.observe(
+                        time.perf_counter() - t0s[t.key],
+                        kind="ec_rebuild", result="error",
+                    )
+                    self.repair_queue.reschedule_failure(t, time.monotonic())
+                    results.append({**t.to_info(), "error": str(outcome)})
+                else:
+                    prepared.setdefault((outcome, t.collection), []).append(
+                        (t, t0s[t.key])
+                    )
+            # group rebuilds and replica repairs all dispatch concurrently —
+            # one slow rebuild must not stall an unrelated critical repair
+            await asyncio.gather(
+                *(
+                    self._dispatch_ec_group(
+                        rebuilder, collection, group, results
+                    )
+                    for (rebuilder, collection), group in prepared.items()
+                ),
+                *(self._dispatch_replica_task(t, results) for t in other),
+            )
 
         self.repair_log = (self.repair_log + results)[-50:]
         return {
@@ -1307,9 +1318,24 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
             now, max_dispatch or self.vacuum_concurrency
         )
         results: list[dict] = []
-        await asyncio.gather(
-            *(self._dispatch_vacuum_task(t, threshold, results) for t in ready)
+        # background-plane root span (ISSUE 8), only when the round
+        # actually dispatches (idle scans every few pulses stay out of
+        # the flight recorder); the compact/commit RPCs inherit the
+        # context, so maintenance I/O lines up against serving traces
+        from ..util import trace
+
+        cm = (
+            trace.span_root("vacuum.round", plane="vacuum", tasks=len(ready))
+            if ready
+            else trace.NULL_SPAN
         )
+        with cm:
+            await asyncio.gather(
+                *(
+                    self._dispatch_vacuum_task(t, threshold, results)
+                    for t in ready
+                )
+            )
         self.vacuum_log = (self.vacuum_log + results)[-50:]
         return {
             "dispatched": results,

@@ -26,16 +26,30 @@ two-tier shape instead of re-wiring it by hand:
   only one seam sees (or use distinct rules) when single-fire matters;
 - per-method request counters (`seaweedfs_tpu_request_total{server=...}`)
   with pre-bound children, shared by the sync-return path and DETACHED
-  completions.
+  completions;
+- the distributed-tracing plane (ISSUE 8, `util/trace.py`): the fast
+  tier extracts ``traceparent`` (byte-level parse) or head-samples a new
+  root, times EVERY root into the live-p99 tracker, and tail-promotes
+  untraced requests that finish past it or hit the fault seam — the slow
+  and weird requests are kept even at sample=0, while the untraced fast
+  path allocates nothing per request;
+- a uniform observability surface on the cold tier of every server type:
+  ``/metrics`` (Prometheus exposition + exemplars), ``/debug/traces``
+  (flight-recorder JSONL, ``?status=1`` for counters) and the on-demand
+  ``/debug/pprof/{start,stop,dump,profile,heap}`` handlers. These paths
+  are reserved: the fast tiers FALLBACK them, and the middleware answers
+  before any route (including the S3 bucket router) sees them.
 """
 
 from __future__ import annotations
 
+import os
+import time
 from typing import Optional
 
 from aiohttp import web
 
-from ..util import faults
+from ..util import faults, trace
 from ..util.fasthttp import (
     DETACHED,
     FALLBACK,
@@ -43,6 +57,111 @@ from ..util.fasthttp import (
     render_response,
 )
 from ..util.metrics import REQUEST_COUNTER
+
+# bound once: _dispatch pays these per request at serving QPS rates
+_perf = time.perf_counter
+_coin = trace._rand.random
+
+
+def _make_debug_middleware(name: str, address: str, pprof=None):
+    """Cold-tier middleware serving the shared observability surface and
+    re-joining traces on fallback-replayed requests.
+
+    A closure over plain values ON PURPOSE: a bound ServingCore method
+    here would close the cycle app -> middleware -> core -> runner ->
+    app, which survives to interpreter finalization and then raises out
+    of aiohttp __del__ hooks ("Error in sys.excepthook" at process exit
+    under pytest)."""
+
+    @web.middleware
+    async def middleware(request, handler):
+        path = request.path
+        if path == "/metrics" or path.startswith("/debug/"):
+            return await _serve_debug(name, address, request, path, pprof)
+        tp = request.headers.get("traceparent")
+        if tp is None:
+            return await handler(request)
+        pctx = trace.parse_traceparent(tp)
+        if pctx is None:
+            # malformed header: same as no header — begin_request with
+            # parent=None would mean "caller won the head-sample coin"
+            # and force-record garbage-sending clients at sample=0
+            return await handler(request)
+        sp = trace.begin_request(
+            f"{name}:{request.method}",
+            pctx,
+            server=name,
+            addr=address,
+            tier="cold",
+        )
+        if sp is None:
+            return await handler(request)
+        sp.tags["path"] = path
+        try:
+            resp = await handler(request)
+        except Exception as e:
+            sp.finish(err=e)
+            raise
+        sp.finish()
+        return resp
+
+    return middleware
+
+
+async def _serve_debug(name: str, address: str, request, path: str,
+                       pprof=None):
+    if path == "/metrics":
+        from ..util.metrics import REGISTRY
+
+        # content negotiation: exemplars are only legal in the
+        # OpenMetrics exposition — classic text/plain parsers reject a
+        # '#' after the sample value, so a stock Prometheus scrape must
+        # get the exemplar-free classic render by default
+        if "openmetrics" in request.headers.get("Accept", ""):
+            return web.Response(
+                text=REGISTRY.render(exemplars=True) + "# EOF\n",
+                content_type="application/openmetrics-text",
+            )
+        return web.Response(text=REGISTRY.render(), content_type="text/plain")
+    if path == "/debug/traces":
+        rec = trace.RECORDER
+        if request.query.get("status"):
+            return web.json_response(
+                {"server": name, "addr": address, **rec.status()}
+            )
+        return web.Response(
+            text=rec.dump_jsonl(), content_type="application/x-ndjson"
+        )
+    if path.startswith("/debug/pprof/"):
+        # profiling is a process-global slowdown and the fast tiers
+        # FALLBACK these paths from the PUBLIC port, so the surface is
+        # OPT-IN (matching the old volume -pprof posture): serve only
+        # when the server forced it on (-pprof) or the operator set
+        # SEAWEEDFS_TPU_PPROF=1
+        env_on = (
+            os.environ.get("SEAWEEDFS_TPU_PPROF", "0") or "0"
+        ) not in ("0", "")
+        if not (pprof is True or (pprof is None and env_on)):
+            return web.json_response(
+                {"error": "pprof disabled (set SEAWEEDFS_TPU_PPROF=1 "
+                          "or start with -pprof)"},
+                status=403,
+            )
+        from ..util import profiling
+
+        handler_fn = {
+            "/debug/pprof/profile": profiling.handle_pprof_profile,
+            "/debug/pprof/heap": profiling.handle_pprof_heap,
+            "/debug/pprof/start": profiling.handle_pprof_start,
+            "/debug/pprof/stop": profiling.handle_pprof_stop,
+            "/debug/pprof/dump": profiling.handle_pprof_dump,
+        }.get(path)
+        if handler_fn is None:
+            return web.json_response(
+                {"error": "unknown profile endpoint"}, status=404
+            )
+        return await handler_fn(request)
+    return web.json_response({"error": "not found"}, status=404)
 
 
 class ServingCore:
@@ -52,11 +171,15 @@ class ServingCore:
     | DETACHED``. The aiohttp application passed to :meth:`start` is the
     cold tier every FALLBACK replays against."""
 
-    def __init__(self, name: str, handler, host: str, port: int):
+    def __init__(self, name: str, handler, host: str, port: int,
+                 pprof=None):
         self.name = name
         self.handler = handler
         self.host = host
         self.port = port
+        # None = env opt-in (SEAWEEDFS_TPU_PPROF=1), False = refuse the
+        # /debug/pprof surface, True = force it on (volume -pprof flag)
+        self.pprof = pprof
         self.address = f"{host}:{port}"
         self.fast_server: Optional[FastHTTPServer] = None
         self._http_runner: Optional[web.AppRunner] = None
@@ -64,6 +187,9 @@ class ServingCore:
         self._req_counters: dict = {}
 
     async def start(self, app: web.Application) -> None:
+        app.middlewares.append(
+            _make_debug_middleware(self.name, self.address, self.pprof)
+        )
         self._http_runner = web.AppRunner(app, access_log=None)
         await self._http_runner.setup()
         site = web.TCPSite(self._http_runner, "127.0.0.1", 0)
@@ -79,6 +205,20 @@ class ServingCore:
             await self.fast_server.stop()
         if self._http_runner is not None:
             await self._http_runner.cleanup()
+        # aiohttp caches per-(handler, middlewares) chains in a
+        # module-level lru_cache (web_app._cached_build_middleware); with
+        # any middleware installed that cache pins our bound route
+        # handlers — and through them the whole server object graph,
+        # gRPC server included — until interpreter finalization, where
+        # cygrpc's teardown then raises ("Error in sys.excepthook").
+        # Dropping the cache on stop releases the graph; live apps just
+        # rebuild their entries on the next request.
+        try:
+            from aiohttp.web_app import _cached_build_middleware
+
+            _cached_build_middleware.cache_clear()
+        except (ImportError, AttributeError):
+            pass  # private API: absent on other aiohttp versions
 
     def count(self, method: str) -> None:
         """Count one served request; pre-bound children keep this O(1) on
@@ -92,12 +232,81 @@ class ServingCore:
         child.inc()
 
     async def _dispatch(self, req):
+        """Fast-tier entry: trace join/head-sample, server-side fault
+        seam, handler, tail promotion. The untraced path (no traceparent
+        header, head sampler says no) builds no span name, no tags dict,
+        no context object — tail sampling still keeps the slow requests:
+        every root's wall feeds an allocation-free log histogram, and a
+        root past the live p99 is retro-promoted into the recorder. This
+        runs once per request at serving QPS rates: the sampling coin is
+        inlined and the clock/coin callables are module-bound, because
+        each avoided method call is measurable in the trace_overhead
+        leg's off-vs-on-at-1% comparison."""
+        if req.path == "/metrics" or req.path.startswith("/debug/"):
+            # reserved observability surface: ONE structural check in
+            # front of every fast tier (instead of a per-server
+            # convention) — the cold-tier middleware serves these
+            return FALLBACK
+        rec = trace.RECORDER
+        sp = None
+        enabled = rec.enabled
+        if enabled:
+            tp = req.headers.get(b"traceparent")
+            pctx = (
+                trace.parse_traceparent(tp) if tp is not None else None
+            )
+            if pctx is not None or (
+                rec.sample > 0.0 and _coin() < rec.sample
+            ):
+                sp = trace.begin_request(
+                    f"{self.name}:{req.method}", pctx,
+                    server=self.name, addr=self.address, path=req.path,
+                )
+            t0 = _perf()
         plan = faults._PLAN
         if plan is not None:
             out = await self._apply_fault(plan, req)
             if out is not None:
+                if sp is not None:
+                    sp.finish()
                 return out
-        out = await self.handler(req)
+        try:
+            out = await self.handler(req)
+        except Exception as e:
+            if sp is not None:
+                sp.finish(err=e)
+            raise
+        if enabled:
+            if out is FALLBACK or out is DETACHED:
+                # FALLBACK walls are µs of proxy hand-off (the real work
+                # happens on the cold-tier replay) and DETACHED walls end
+                # at handler return, not response write — feeding either
+                # into the root-latency tracker would collapse the live
+                # p99 threshold and turn promote_slow into a per-request
+                # firehose. A FALLBACK'd span is DROPPED outright: the
+                # cold-tier middleware traces the replay (joining via
+                # the client's own traceparent), and a head-sampled
+                # fast-tier root for a proxied request would be a
+                # meaningless µs orphan in the ring.
+                if sp is not None:
+                    if out is FALLBACK:
+                        sp.drop()
+                    else:
+                        sp.finish()
+            else:
+                dt = _perf() - t0
+                if sp is None:
+                    rec.note_root(dt)
+                    if dt > rec.slow_s:
+                        rec.promote_slow(
+                            f"{self.name}:{req.method}", dt,
+                            server=self.name, addr=self.address,
+                            path=req.path,
+                        )
+                else:
+                    if sp.parent_id == 0:
+                        rec.note_root(dt)
+                    sp.finish()
         if out is not FALLBACK and out is not DETACHED:
             self.count(req.method)
         return out
@@ -105,7 +314,9 @@ class ServingCore:
     async def _apply_fault(self, plan, req):
         """Server-side HTTP seam: consult the plan at request arrival.
         Returns response bytes / DETACHED to short-circuit, or None to
-        proceed to the handler (latency rules have already slept)."""
+        proceed to the handler (latency rules have already slept). Every
+        fired fault promotes the request into the flight recorder
+        (trace.note_fault) — injected faults are kept even at sample=0."""
         try:
             ev = await faults.async_fault(
                 plan, f"http:{req.method}", self.address
@@ -116,18 +327,30 @@ class ServingCore:
                 req.transport.close()
             return DETACHED  # connection_lost tears the request loop down
         except ConnectionResetError:
+            trace.note_fault(
+                f"{self.name}:{req.method}", "reset",
+                server=self.name, path=req.path,
+            )
             # injected reset: the peer sees a dropped connection, exactly
             # like the client-seam variant
             if req.transport is not None:
                 req.transport.close()
             return DETACHED
         except TimeoutError:
+            trace.note_fault(
+                f"{self.name}:{req.method}", "hang",
+                server=self.name, path=req.path,
+            )
             # injected hang already slept through the window; surface the
             # way a gateway's upstream timeout would
             return render_response(
                 500, b'{"error":"injected hang"}', keep_alive=False
             )
         if ev is not None and ev.kind == "http_error":
+            trace.note_fault(
+                f"{self.name}:{req.method}", "http_error",
+                server=self.name, path=req.path,
+            )
             return render_response(
                 ev.rule.status, b'{"error":"injected fault"}'
             )

@@ -394,8 +394,12 @@ class VolumeServer(EcHandlers):
         # volume_server_handlers_read.go)
         from .serving_core import ServingCore
 
+        # pprof honors the ctor/-pprof opt-in: True forces the HTTP
+        # profiling surface on, the default False falls back to the
+        # SEAWEEDFS_TPU_PPROF env gate like every other server type
         self._core = ServingCore(
-            "volume", self._fast_dispatch, self.host, self.port
+            "volume", self._fast_dispatch, self.host, self.port,
+            pprof=True if self.pprof else None,
         )
         await self._core.start(app)
         self._fast_server = self._core.fast_server
@@ -879,19 +883,9 @@ class VolumeServer(EcHandlers):
             return web.json_response({"Version": "seaweedfs-tpu", "Volumes": []})
         if path in ("/ui", "/ui/"):
             return self._ui_response()
-        if path == "/metrics":
-            from ..util.metrics import REGISTRY
-
-            return web.Response(text=REGISTRY.render(), content_type="text/plain")
-        if self.pprof and path.startswith("/debug/pprof"):
-            # live profiling handlers (ref -pprof, util/grace/pprof.go)
-            from ..util.profiling import handle_pprof_heap, handle_pprof_profile
-
-            if path.endswith("/profile"):
-                return await handle_pprof_profile(request)
-            if path.endswith("/heap"):
-                return await handle_pprof_heap(request)
-            return web.json_response({"error": "unknown profile"}, status=404)
+        # /metrics and /debug/pprof (ref -pprof, util/grace/pprof.go) are
+        # served by the shared ServingCore middleware before any route —
+        # handlers here would be unreachable shadows
         t0 = _time.perf_counter()
         try:
             return await self._dispatch_inner(request)
@@ -1527,6 +1521,14 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
         auth = request.headers.get("Authorization", "")
         if auth:
             headers["Authorization"] = auth
+        # cross-hop trace propagation: the fan-out rides aiohttp (not the
+        # FastHTTPClient, whose inject seam would do this), so the header
+        # is added here — each replica's server span parents to this hop
+        from ..util import trace
+
+        ctx = trace.current()
+        if ctx is not None:
+            headers["traceparent"] = trace.format_traceparent(ctx)
 
         async def one(url: str) -> None:
             target = f"http://{url}{request.path}?type=replicate"
@@ -1551,7 +1553,8 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
             except Exception as e:
                 errs.append(f"{url}: {e}")
 
-        await asyncio.gather(*(one(u) for u in others))
+        with trace.span("volume.replicate", replicas=len(others)):
+            await asyncio.gather(*(one(u) for u in others))
         return "; ".join(errs)
 
     # ---------------- gRPC admin ----------------
@@ -2030,13 +2033,23 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
         """Background scrub: one rate-shaped pass per interval. The token
         bucket bounds the I/O so verification coexists with serving load;
         the per-volume cursor makes restarts resume, not restart."""
+        from ..util import trace
+
         loop = asyncio.get_event_loop()
         while not self._shutdown:
             try:
                 await asyncio.sleep(self.scrub_interval_seconds)
                 if self._shutdown:
                     return
-                await loop.run_in_executor(None, self.scrubber.run_pass)
+                # background-plane root span (ISSUE 8): scrub passes show
+                # up in the same flight recorder as the serving traces
+                # they can interfere with
+                with trace.span_root(
+                    "scrub.pass", plane="scrub", addr=self.address
+                ):
+                    await loop.run_in_executor(
+                        None, self.scrubber.run_pass
+                    )
             except asyncio.CancelledError:
                 return
             except Exception:

@@ -979,3 +979,139 @@ async def cmd_ec_balance(env, argv) -> str:
                 f"{move.source} -> {move.target}"
             )
     return "\n".join(log) or "balanced: no moves needed"
+
+
+# ---------------- distributed tracing (ISSUE 8) ----------------
+async def _trace_endpoints(env, flags) -> list[str]:
+    """Servers whose /debug/traces to consult: the master plus every
+    registered volume server, plus any -servers=a:p,b:p extras (filer /
+    S3 gateways, which the master does not track)."""
+    urls = [env.master]
+    try:
+        for dn in await env.collect_data_nodes():
+            if dn.get("url"):
+                urls.append(dn["url"])
+    except Exception:
+        pass
+    extra = flags.get("servers", "")
+    if extra:
+        urls.extend(u for u in extra.split(",") if u)
+    if env.filer:
+        urls.append(env.filer)
+    # de-dup, keep order
+    seen: set = set()
+    return [u for u in urls if not (u in seen or seen.add(u))]
+
+
+async def _fetch_debug_traces(url: str, query: str = ""):
+    import aiohttp
+
+    timeout = aiohttp.ClientTimeout(total=10)
+    async with aiohttp.ClientSession(timeout=timeout) as s:
+        async with s.get(f"http://{url}/debug/traces{query}") as resp:
+            if resp.status != 200:
+                raise IOError(f"{url}: status {resp.status}")
+            return await resp.text()
+
+
+@command("trace.status")
+async def cmd_trace_status(env, argv) -> str:
+    """Per-server flight-recorder state: sampling rate, ring occupancy,
+    admission/promotion counters. -servers=host:port,... adds filer/S3
+    gateways the master does not know about."""
+    import json as _json
+
+    flags = _parse_flags(argv)
+    lines = []
+    for url in await _trace_endpoints(env, flags):
+        try:
+            st = _json.loads(await _fetch_debug_traces(url, "?status=1"))
+        except Exception as e:
+            lines.append(f"{url}: unreachable ({e})")
+            continue
+        thr = st.get("slow_threshold_ms")
+        lines.append(
+            f"{url} [{st.get('server', '?')}]: sample={st.get('sample')} "
+            f"ring={st.get('spans_in_ring')}/{st.get('capacity')} "
+            f"admitted={st.get('admitted')} "
+            f"promoted(slow/flag/fault)={st.get('promoted_slow')}/"
+            f"{st.get('promoted_flagged')}/{st.get('promoted_fault')} "
+            f"p99_gate={'%.2fms' % thr if thr is not None else 'warming'}"
+        )
+    return "\n".join(lines) or "no servers"
+
+
+@command("trace.dump")
+async def cmd_trace_dump(env, argv) -> str:
+    """Merge every server's flight-recorder ring by trace id and print
+    span trees. Flags: -trace=<32-hex id> (one trace), -limit=N (newest
+    N traces, default 5), -servers=host:port,... (extra filer/S3
+    endpoints). In-process clusters share one ring; spans are de-duped
+    by (trace, span) id."""
+    import json as _json
+
+    flags = _parse_flags(argv)
+    want = flags.get("trace", "")
+    limit = int(flags.get("limit", "5") or 5)
+    spans: dict[tuple, dict] = {}
+    errors = []
+    for url in await _trace_endpoints(env, flags):
+        try:
+            body = await _fetch_debug_traces(url)
+        except Exception as e:
+            errors.append(f"# {url}: unreachable ({e})")
+            continue
+        for line in body.splitlines():
+            if not line:
+                continue
+            try:
+                s = _json.loads(line)
+            except ValueError:
+                continue
+            spans.setdefault((s.get("trace"), s.get("span")), s)
+
+    by_trace: dict[str, list] = defaultdict(list)
+    for (tid, _sid), s in spans.items():
+        by_trace[tid].append(s)
+    if want:
+        by_trace = {tid: v for tid, v in by_trace.items() if tid == want}
+    # newest traces first (by earliest span start within the trace)
+    ordered = sorted(
+        by_trace.items(),
+        key=lambda kv: min(s.get("start", 0) for s in kv[1]),
+        reverse=True,
+    )[:limit]
+
+    out = list(errors)
+    for tid, tspans in ordered:
+        tspans.sort(key=lambda s: s.get("start", 0))
+        out.append(f"trace {tid} ({len(tspans)} spans)")
+        by_span = {s["span"]: s for s in tspans}
+
+        def depth(s) -> int:
+            d, seen = 0, set()
+            p = s.get("parent")
+            while p and p in by_span and p not in seen:
+                seen.add(p)
+                d += 1
+                p = by_span[p].get("parent")
+            return d
+
+        for s in tspans:
+            tags = s.get("tags", {})
+            extras = "".join(
+                f" {k}={v}" for k, v in tags.items() if k not in ("path",)
+            )
+            flagstr = (
+                " !" + ",".join(s["flags"]) if s.get("flags") else ""
+            )
+            links = (
+                f" links={len(s['links'])}" if s.get("links") else ""
+            )
+            out.append(
+                f"  {'  ' * depth(s)}{s.get('name')} "
+                f"{s.get('dur_us', 0):.0f}us"
+                f"{extras}{links}{flagstr}"
+                + (f" err={s['err']}" if s.get("err") else "")
+            )
+    return "\n".join(out) or "no traces recorded"

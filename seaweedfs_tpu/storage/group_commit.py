@@ -26,6 +26,7 @@ from typing import Optional
 
 from .needle import Needle
 from .volume import Volume
+from ..util import trace
 from ..util.metrics import (
     GROUP_COMMIT_BATCH_SIZE,
     GROUP_COMMIT_FSYNCS,
@@ -42,6 +43,9 @@ class _Request:
     is_write: bool
     future: asyncio.Future
     enqueued_at: float = 0.0
+    # sampled trace context of the enqueuer, so the fsync-batch flush can
+    # record one span linked to every member trace (ISSUE 8)
+    ctx: object = None
 
 
 class GroupCommitWorker:
@@ -69,14 +73,20 @@ class GroupCommitWorker:
     async def write(self, n: Needle) -> tuple[int, int, bool]:
         fut = asyncio.get_event_loop().create_future()
         await self.queue.put(
-            _Request(n, True, fut, enqueued_at=time.perf_counter())
+            _Request(
+                n, True, fut, enqueued_at=time.perf_counter(),
+                ctx=trace.current_sampled(),
+            )
         )
         return await fut
 
     async def delete(self, n: Needle) -> int:
         fut = asyncio.get_event_loop().create_future()
         await self.queue.put(
-            _Request(n, False, fut, enqueued_at=time.perf_counter())
+            _Request(
+                n, False, fut, enqueued_at=time.perf_counter(),
+                ctx=trace.current_sampled(),
+            )
         )
         return await fut
 
@@ -108,9 +118,14 @@ class GroupCommitWorker:
                 self.stats["largest_batch"] = len(batch)
             GROUP_COMMIT_BATCH_SIZE.observe(len(batch))
             GROUP_COMMIT_FSYNCS.inc()
-            await asyncio.get_event_loop().run_in_executor(
-                None, self._commit_batch, batch
-            )
+            members = [r.ctx for r in batch if r.ctx is not None]
+            with trace.batch_span(
+                "group_commit.flush", members,
+                vid=self.volume.id, batch=len(batch),
+            ):
+                await asyncio.get_event_loop().run_in_executor(
+                    None, self._commit_batch, batch
+                )
             done = time.perf_counter()
             for req in batch:
                 if req.enqueued_at:

@@ -30,7 +30,7 @@ import asyncio
 from collections import deque
 from typing import Awaitable, Callable, Optional
 
-from . import faults
+from . import faults, trace
 
 FALLBACK = object()  # sentinel: "proxy this request to the full app"
 DETACHED = object()  # sentinel: "the handler will write the response itself
@@ -981,9 +981,20 @@ class FastHTTPClient:
             # http_error rules synthesize a 5xx as if the peer degraded
             ev = await faults.async_fault(plan, f"http:{method}", hostport)
             if ev is not None and ev.kind == "http_error":
+                # tail sampling: a trace that saw an injected fault is
+                # kept (flag is a no-op without an active context)
+                trace.flag(trace.FLAG_FAULT)
                 return ev.rule.status, b'{"error":"injected fault"}'
+        # cross-hop context propagation: an active trace context rides a
+        # `traceparent` header so the server side joins the same trace
+        # (sampled or not — unsampled contexts still carry promotion
+        # flags downstream). The ctx-less path pays one contextvar load.
+        ctx = trace._CTX.get()
         conn = await self._get(hostport)
-        if not body and not content_type and not headers and method == "GET":
+        if (
+            not body and not content_type and not headers
+            and method == "GET" and ctx is None
+        ):
             # bodyless GET (the read data plane): one f-string render, no
             # part list/join — measurable at serving QPS rates
             wire = (
@@ -1000,6 +1011,11 @@ class FastHTTPClient:
             if headers:
                 for k, v in headers.items():
                     parts.append(f"{k}: {v}\r\n".encode())
+            if ctx is not None:
+                parts.append(
+                    b"traceparent: %s\r\n"
+                    % trace.format_traceparent_bytes(ctx)
+                )
             parts.append(b"\r\n")
             if body:
                 parts.append(body)
@@ -1018,7 +1034,10 @@ class FastHTTPClient:
             conn.transport.close()
             if retried:
                 raise
-            # stale pooled connection: one clean retry on a fresh one
+            # stale pooled connection: one clean retry on a fresh one —
+            # and a promotion flag, so the trace that paid the retry is
+            # kept by the tail sampler
+            trace.flag(trace.FLAG_RETRY)
             return await self.request(
                 method, hostport, target, body, content_type, headers,
                 retried=True,

@@ -3,17 +3,31 @@
 Counters, gauges and histograms with label support, rendered in the
 Prometheus text exposition format at /metrics on each server. No external
 client library; the push-gateway mode of the reference is replaced by pull.
+
+Histogram bucket samples can carry OpenMetrics-style exemplars (the last
+sampled trace_id observed per bucket, see util/trace.py): a `/metrics`
+latency spike links straight to the trace that caused it in
+`/debug/traces`. Exemplars are only emitted when `render(exemplars=True)`
+is asked for — /metrics negotiates via the Accept header, because the
+classic text format (text/plain) does not permit them and a stock
+Prometheus scraper would reject the whole exposition.
 """
 
 from __future__ import annotations
 
 import threading
+import time as _time
 from bisect import bisect_left
 from collections import defaultdict
 
 _DEFAULT_BUCKETS = [
     0.0001, 0.0003, 0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1, 3, 10,
 ]
+
+# set by util/trace.py at import: () -> hex trace id of the current
+# SAMPLED context, or None. Kept as a module attribute (not an import) so
+# the metrics module stays dependency-free at the bottom of the stack.
+_exemplar_fn = None
 
 
 class _Labeled:
@@ -39,8 +53,11 @@ class Counter(_Labeled):
         where tuple(sorted(labels.items())) per call is measurable."""
         return _CounterChild(self, tuple(sorted(labels.items())))
 
-    def render(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+    def render(self, exemplars: bool = False) -> list[str]:
+        out = [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} counter",
+        ]
         with self._lock:
             for key, v in self._values.items():
                 out.append(f"{self.name}{_fmt_labels(key)} {v}")
@@ -75,8 +92,11 @@ class Gauge(_Labeled):
         with self._lock:
             self._values[key] += amount
 
-    def render(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+    def render(self, exemplars: bool = False) -> list[str]:
+        out = [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} gauge",
+        ]
         with self._lock:
             for key, v in self._values.items():
                 out.append(f"{self.name}{_fmt_labels(key)} {v}")
@@ -90,6 +110,11 @@ class Histogram(_Labeled):
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = defaultdict(float)
         self._totals: dict[tuple, int] = defaultdict(int)
+        # (key, bucket_idx) -> (trace_hex, observed value, unix ts): the
+        # last SAMPLED observation per bucket, written only when the
+        # tracing contextvar says the current request is sampled — the
+        # unsampled hot path pays one module-attribute load + None check
+        self._exemplars: dict[tuple, tuple] = {}
 
     def observe(self, value: float, **labels) -> None:
         key = tuple(sorted(labels.items()))
@@ -108,6 +133,11 @@ class Histogram(_Labeled):
                 counts[idx] += 1  # cumulative sums computed at render time
             self._sums[key] += value
             self._totals[key] += 1
+        fn = _exemplar_fn
+        if fn is not None:
+            tid = fn()
+            if tid is not None:
+                self._exemplars[(key, idx)] = (tid, value, _time.time())
 
     def child(self, **labels) -> "_HistogramChild":
         """Pre-bound label set with an O(1)-overhead observe — the
@@ -122,19 +152,34 @@ class Histogram(_Labeled):
         with self._lock:
             return self._sums.get(key, 0.0), self._totals.get(key, 0)
 
-    def render(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+    def _exemplar_suffix(self, key: tuple, idx: int) -> str:
+        ex = self._exemplars.get((key, idx))
+        if ex is None:
+            return ""
+        tid, value, ts = ex
+        # OpenMetrics exemplar syntax — emitted only for the negotiated
+        # application/openmetrics-text exposition (see Registry.render)
+        return ' # {trace_id="%s"} %g %.3f' % (tid, value, ts)
+
+    def render(self, exemplars: bool = False) -> list[str]:
+        out = [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} histogram",
+        ]
+        ex = self._exemplar_suffix if exemplars else (lambda key, i: "")
         with self._lock:
             for key, counts in self._counts.items():
                 cumulative = 0
-                for b, c in zip(self.buckets, counts):
+                for i, (b, c) in enumerate(zip(self.buckets, counts)):
                     cumulative += c
                     out.append(
-                        f'{self.name}_bucket{_fmt_labels(key, le=str(b))} {cumulative}'
+                        f"{self.name}_bucket{_fmt_labels(key, le=str(b))} "
+                        f"{cumulative}{ex(key, i)}"
                     )
                 out.append(
                     f'{self.name}_bucket{_fmt_labels(key, le="+Inf")} '
                     f"{self._totals[key]}"
+                    f"{ex(key, len(self.buckets))}"
                 )
                 out.append(f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]}")
                 out.append(f"{self.name}_count{_fmt_labels(key)} {self._totals[key]}")
@@ -152,42 +197,97 @@ class _HistogramChild:
         self._hist._observe_key(self._key, value)
 
 
+def _escape_label_value(v) -> str:
+    """Escape per the exposition-format spec: backslash, double-quote and
+    newline inside a label value must be escaped or the whole render is
+    unparseable (vacuum route labels and fault `op` labels can carry
+    arbitrary strings)."""
+    s = str(v)
+    if "\\" in s or '"' in s or "\n" in s:
+        s = s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    return s
+
+
+def _escape_help(s: str) -> str:
+    """HELP lines escape backslash and newline (spec: help text is the
+    rest of the line)."""
+    if "\\" in s or "\n" in s:
+        s = s.replace("\\", "\\\\").replace("\n", "\\n")
+    return s
+
+
 def _fmt_labels(key: tuple, **extra) -> str:
     items = list(key) + sorted(extra.items())
     if not items:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in items)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in items)
     return "{" + inner + "}"
 
 
 class Registry:
+    """Name-keyed metric registry. Registration is idempotent: asking for
+    an existing name returns the existing collector when the kind
+    matches, and raises when it doesn't — duplicate metric families can
+    never render (they are invalid exposition text, and the silent
+    variant hid typo'd re-registrations)."""
+
     def __init__(self):
         self._metrics: list = []
+        self._by_name: dict[str, _Labeled] = {}
         self._lock = threading.Lock()
 
-    def counter(self, name: str, help_text: str = "") -> Counter:
-        m = Counter(name, help_text)
+    def _register(self, name: str, kind: str, factory):
         with self._lock:
+            m = self._by_name.get(name)
+            if m is not None:
+                if m.kind != kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as {m.kind}, "
+                        f"not {kind}"
+                    )
+                return m
+            m = factory()
+            self._by_name[name] = m
             self._metrics.append(m)
-        return m
+            return m
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self._register(
+            name, "counter", lambda: Counter(name, help_text)
+        )
 
     def gauge(self, name: str, help_text: str = "") -> Gauge:
-        m = Gauge(name, help_text)
-        with self._lock:
-            self._metrics.append(m)
-        return m
+        return self._register(name, "gauge", lambda: Gauge(name, help_text))
 
     def histogram(self, name: str, help_text: str = "", buckets=None) -> Histogram:
-        m = Histogram(name, help_text, buckets)
-        with self._lock:
-            self._metrics.append(m)
+        m = self._register(
+            name, "histogram", lambda: Histogram(name, help_text, buckets)
+        )
+        if buckets is not None and list(buckets) != m.buckets:
+            # idempotent return must not silently change bucket layout:
+            # observations from the second site would land in the first
+            # site's buckets and render wrong percentiles with no error
+            raise ValueError(
+                f"metric {name!r} already registered with buckets "
+                f"{m.buckets}, not {list(buckets)}"
+            )
         return m
 
-    def render(self) -> str:
+    def collectors(self) -> list:
+        """Snapshot of registered metrics (hygiene lint / self-checks)."""
+        with self._lock:
+            return list(self._metrics)
+
+    def render(self, exemplars: bool = False) -> str:
+        """Text exposition. `exemplars=True` appends the OpenMetrics
+        exemplar suffix to histogram bucket samples — only valid under
+        the `application/openmetrics-text` content type (classic
+        text-format parsers reject a `#` after the sample value), so
+        /metrics serves it via Accept-header negotiation only."""
         lines = []
         with self._lock:
             for m in self._metrics:
-                lines.extend(m.render())
+                lines.extend(m.render(exemplars=exemplars))
         return "\n".join(lines) + "\n"
 
 

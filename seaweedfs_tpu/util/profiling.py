@@ -115,3 +115,66 @@ async def handle_pprof_heap(request):
     snapshot = tracemalloc.take_snapshot()
     lines = [str(s) for s in snapshot.statistics("lineno")[:100]]
     return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+
+# --- on-demand start/stop/dump profiling of a LIVE server (ISSUE 8
+# satellite: the docstring's promised /debug/pprof handlers, wired onto
+# ServingCore's shared cold-tier middleware for every server type).
+# Unlike /debug/pprof/profile (fixed window), start/stop bracket an
+# operator-chosen workload; dump renders the captured stats — while the
+# profiler is still running it snapshots (disable -> render -> enable).
+
+_live_profiler: Optional[cProfile.Profile] = None
+_live_running = False
+
+
+async def handle_pprof_start(request):
+    """GET /debug/pprof/start — begin collecting; 409 when a collection
+    is already active (cProfile is process-global)."""
+    from aiohttp import web
+
+    global _live_profiler, _live_running
+    if _live_running:
+        return web.Response(status=409, text="profile already running\n")
+    prof = cProfile.Profile()
+    try:
+        prof.enable()
+    except ValueError as e:  # another profiler (-cpuprofile) holds the hook
+        return web.Response(status=409, text=f"{e}\n")
+    _live_profiler, _live_running = prof, True
+    return web.Response(text="profiling started\n", content_type="text/plain")
+
+
+async def handle_pprof_stop(request):
+    """GET /debug/pprof/stop — stop collecting; the stats stay in memory
+    for /debug/pprof/dump."""
+    from aiohttp import web
+
+    global _live_running
+    if not _live_running or _live_profiler is None:
+        return web.Response(status=409, text="no profile running\n")
+    _live_profiler.disable()
+    _live_running = False
+    return web.Response(text="profiling stopped\n", content_type="text/plain")
+
+
+async def handle_pprof_dump(request):
+    """GET /debug/pprof/dump[?limit=N] — cumulative-time report of the
+    last start/stop collection (snapshots a still-running one)."""
+    from aiohttp import web
+
+    if _live_profiler is None:
+        return web.Response(status=404, text="no profile collected\n")
+    try:
+        limit = min(int(request.query.get("limit", 50)), 500)
+    except ValueError:
+        return web.Response(status=400, text="bad limit parameter\n")
+    if _live_running:
+        _live_profiler.disable()
+        try:
+            text = profile_sorted_text(_live_profiler, limit)
+        finally:
+            _live_profiler.enable()
+    else:
+        text = profile_sorted_text(_live_profiler, limit)
+    return web.Response(text=text, content_type="text/plain")

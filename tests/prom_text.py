@@ -1,0 +1,269 @@
+"""Strict Prometheus text-exposition parser for tests (ISSUE 8).
+
+Validates the FULL /metrics render of a live server: every line must
+parse, # HELP / # TYPE must precede their family's samples, families must
+not interleave, histogram bucket counts must be monotone with ascending
+`le` ending at +Inf == _count, and _count/_sum must be present and
+consistent. Histogram bucket samples may carry an OpenMetrics-style
+exemplar suffix (`# {trace_id="..."} value [ts]`) and the exposition may
+end with the OpenMetrics `# EOF` terminator — the negotiated
+application/openmetrics-text form (see docs/observability.md); the
+classic text/plain render contains neither.
+
+Not a pytest file (no test_ prefix): imported by the exposition tests.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_KEY_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+
+
+class ExpositionError(AssertionError):
+    pass
+
+
+def _fail(lineno: int, line: str, why: str):
+    raise ExpositionError(f"line {lineno}: {why}: {line!r}")
+
+
+def _parse_label_block(s: str, lineno: int, line: str) -> tuple[dict, str]:
+    """Parse `{k="v",...}` at the start of s -> (labels, rest). Handles
+    the three escapes the spec defines (\\\\, \\", \\n)."""
+    assert s[0] == "{"
+    labels: dict = {}
+    i = 1
+    while True:
+        if i >= len(s):
+            _fail(lineno, line, "unterminated label block")
+        if s[i] == "}":
+            return labels, s[i + 1:]
+        # key
+        j = i
+        while j < len(s) and s[j] not in "=":
+            j += 1
+        key = s[i:j]
+        if not _LABEL_KEY_RE.match(key):
+            _fail(lineno, line, f"bad label key {key!r}")
+        if j + 1 >= len(s) or s[j + 1] != '"':
+            _fail(lineno, line, "label value must be quoted")
+        # value with escapes
+        val = []
+        k = j + 2
+        while True:
+            if k >= len(s):
+                _fail(lineno, line, "unterminated label value")
+            c = s[k]
+            if c == "\\":
+                if k + 1 >= len(s):
+                    _fail(lineno, line, "dangling escape")
+                nxt = s[k + 1]
+                if nxt == "\\":
+                    val.append("\\")
+                elif nxt == '"':
+                    val.append('"')
+                elif nxt == "n":
+                    val.append("\n")
+                else:
+                    _fail(lineno, line, f"invalid escape \\{nxt}")
+                k += 2
+                continue
+            if c == "\n":
+                _fail(lineno, line, "raw newline in label value")
+            if c == '"':
+                break
+            val.append(c)
+            k += 1
+        if key in labels:
+            _fail(lineno, line, f"duplicate label {key!r}")
+        labels[key] = "".join(val)
+        i = k + 1
+        if i < len(s) and s[i] == ",":
+            i += 1
+
+
+def _parse_value(tok: str, lineno: int, line: str) -> float:
+    if tok in ("+Inf", "Inf"):
+        return math.inf
+    if tok == "-Inf":
+        return -math.inf
+    if tok == "NaN":
+        return math.nan
+    try:
+        return float(tok)
+    except ValueError:
+        _fail(lineno, line, f"bad sample value {tok!r}")
+
+
+def _parse_exemplar(rest: str, lineno: int, line: str) -> dict:
+    """Parse ` # {labels} value [ts]` -> {"labels":…, "value":…}."""
+    rest = rest.lstrip()
+    if not rest.startswith("{"):
+        _fail(lineno, line, "exemplar must start with a label block")
+    labels, tail = _parse_label_block(rest, lineno, line)
+    toks = tail.split()
+    if not 1 <= len(toks) <= 2:
+        _fail(lineno, line, "exemplar needs value [timestamp]")
+    value = _parse_value(toks[0], lineno, line)
+    out = {"labels": labels, "value": value}
+    if len(toks) == 2:
+        out["ts"] = _parse_value(toks[1], lineno, line)
+    return out
+
+
+def parse_exposition(text: str) -> dict:
+    """Parse + validate; returns {family_name: {"type":…, "help":…,
+    "samples": [(name, labels, value, exemplar|None)]}}."""
+    families: dict = {}
+    current: str | None = None  # family whose samples may appear now
+    closed: set = set()  # families that may not reopen (no interleaving)
+
+    def family_of(name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                base = name[: -len(suffix)]
+                fam = families.get(base)
+                if fam is not None and fam["type"] == "histogram":
+                    return base
+        return name
+
+    lines = text.split("\n")
+    if lines and lines[-1] == "":
+        lines.pop()  # trailing newline
+    for lineno, line in enumerate(lines, 1):
+        if line == "":
+            _fail(lineno, line, "blank line")
+        if line == "# EOF":
+            # OpenMetrics terminator — only valid as the very last line
+            if lineno != len(lines):
+                _fail(lineno, line, "# EOF before end of exposition")
+            break
+        if line.startswith("#"):
+            parts = line.split(" ", 3)
+            if len(parts) < 3 or parts[0] != "#" or parts[1] not in (
+                "HELP", "TYPE",
+            ):
+                _fail(lineno, line, "malformed comment line")
+            kind, name = parts[1], parts[2]
+            if not _NAME_RE.match(name):
+                _fail(lineno, line, f"bad metric name {name!r}")
+            if name in closed and name != current:
+                _fail(lineno, line, f"family {name!r} reopened (interleaved)")
+            if kind == "HELP":
+                if current is not None and current != name:
+                    closed.add(current)
+                fam = families.setdefault(
+                    name, {"type": None, "help": None, "samples": []}
+                )
+                if fam["help"] is not None:
+                    _fail(lineno, line, "second HELP for family")
+                fam["help"] = parts[3] if len(parts) > 3 else ""
+                current = name
+            else:
+                typ = parts[3].strip() if len(parts) > 3 else ""
+                if typ not in _TYPES:
+                    _fail(lineno, line, f"bad TYPE {typ!r}")
+                fam = families.setdefault(
+                    name, {"type": None, "help": None, "samples": []}
+                )
+                if fam["samples"]:
+                    _fail(lineno, line, "TYPE after samples")
+                fam["type"] = typ
+                current = name
+            continue
+        # sample line
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            labels, rest = _parse_label_block("{" + rest, lineno, line)
+        else:
+            name, _, rest = line.partition(" ")
+            labels = {}
+        if not _NAME_RE.match(name):
+            _fail(lineno, line, f"bad sample name {name!r}")
+        rest = rest.strip()
+        exemplar = None
+        if " # " in rest:
+            valtok, _, extok = rest.partition(" # ")
+            exemplar = _parse_exemplar(extok, lineno, line)
+            rest = valtok
+        toks = rest.split()
+        if not toks:
+            _fail(lineno, line, "missing sample value")
+        value = _parse_value(toks[0], lineno, line)
+        fam_name = family_of(name)
+        fam = families.get(fam_name)
+        if fam is None or fam["type"] is None or fam["help"] is None:
+            _fail(lineno, line, f"sample before HELP/TYPE of {fam_name!r}")
+        if fam_name != current:
+            _fail(lineno, line, f"sample interleaves family {fam_name!r}")
+        if exemplar is not None and fam["type"] != "histogram":
+            _fail(lineno, line, "exemplar on non-histogram sample")
+        fam["samples"].append((name, labels, value, exemplar))
+
+    _check_histograms(families)
+    return families
+
+
+def _check_histograms(families: dict) -> None:
+    for fname, fam in families.items():
+        if fam["type"] != "histogram":
+            continue
+        # group by non-le label set
+        series: dict = {}
+        for name, labels, value, _ex in fam["samples"]:
+            key = tuple(sorted(
+                (k, v) for k, v in labels.items() if k != "le"
+            ))
+            entry = series.setdefault(
+                key, {"buckets": [], "sum": None, "count": None}
+            )
+            if name == fname + "_bucket":
+                if "le" not in labels:
+                    raise ExpositionError(
+                        f"{fname}: bucket sample without le ({labels})"
+                    )
+                le = (
+                    math.inf if labels["le"] == "+Inf"
+                    else float(labels["le"])
+                )
+                entry["buckets"].append((le, value))
+            elif name == fname + "_sum":
+                entry["sum"] = value
+            elif name == fname + "_count":
+                entry["count"] = value
+        for key, entry in series.items():
+            buckets = entry["buckets"]
+            if not buckets:
+                raise ExpositionError(f"{fname}{dict(key)}: no buckets")
+            les = [le for le, _ in buckets]
+            if les != sorted(les):
+                raise ExpositionError(f"{fname}{dict(key)}: le not ascending")
+            if les[-1] != math.inf:
+                raise ExpositionError(f"{fname}{dict(key)}: missing +Inf")
+            counts = [c for _, c in buckets]
+            for prev, nxt in zip(counts, counts[1:]):
+                if nxt < prev:
+                    raise ExpositionError(
+                        f"{fname}{dict(key)}: bucket counts not monotone "
+                        f"({counts})"
+                    )
+            if entry["count"] is None or entry["sum"] is None:
+                raise ExpositionError(
+                    f"{fname}{dict(key)}: missing _count/_sum"
+                )
+            if counts[-1] != entry["count"]:
+                raise ExpositionError(
+                    f"{fname}{dict(key)}: +Inf bucket {counts[-1]} != "
+                    f"_count {entry['count']}"
+                )
+            if entry["count"] > 0 and entry["sum"] < 0 and all(
+                le >= 0 for le in les[:-1]
+            ):
+                raise ExpositionError(
+                    f"{fname}{dict(key)}: negative sum with non-negative "
+                    "buckets"
+                )

@@ -228,6 +228,34 @@ def test_serving_open_loop_leg_shape():
     assert ol["read_fanout"]["reads"] > 0
 
 
+def test_trace_overhead_leg_shape():
+    """ISSUE 8 guard: the serving.trace_overhead leg must emit BOTH QPS
+    numbers (tracing-off and tracing-on-at-1%) with their ratio, and the
+    zero-alloc assertion must hold: across the tracing-on slices, ring
+    admissions == sampled roots + tail promotions — admissions scale
+    with the sampled count, never one per request."""
+    to = bench.measure_trace_overhead(
+        num_files=400, duration=2.0, rate=800
+    )
+    assert "error" not in to, to.get("error")
+    assert to["qps_off"] > 0
+    assert to["qps_on"] > 0
+    # disclosed comparison: in-situ per-request overhead over measured
+    # service time; the noisy macro ratio + per-mode CPU ride alongside
+    assert 0.9 < to["on_over_off"] <= 1.0
+    assert to["on_over_off_macro"] > 0
+    assert to["overhead_us_per_request"] >= 0
+    assert to["service_us_per_request"] > 0
+    assert to["window_count"] >= 2
+    assert to["cpu_us_per_request_off"] > 0
+    assert to["cpu_us_per_request_on"] > 0
+    # the on-windows really ran requests, and sampling stayed a fraction
+    assert to["trace_requests"] > 0
+    assert to["ring_admissions"] < to["trace_requests"] / 2
+    assert to["admissions_equal_sampled"] is True
+    assert 0 <= to["sampled_fraction"] < 0.2
+
+
 def test_s3_gateway_leg_shape():
     """ISSUE 7 guard: the three s3.* legs must emit non-zero p50/p99,
     the PUT stage budget's components must be non-zero and sum to ~the
