@@ -14,6 +14,7 @@ from ..util.backoff import (
     deadline_after,
     remaining,
     retry_async,
+    shared_retry_budget,
 )
 from ..util.metrics import RETRY_COUNTER
 
@@ -102,8 +103,17 @@ class MasterClient:
         (capped, so a restarted master is re-found within ~5s worst
         case) and the streak resets the moment a stream actually
         reaches connected state — replacing the old flat 0.5s spin
-        that hammered a struggling quorum in lockstep."""
+        that hammered a struggling quorum in lockstep.
+
+        This loop must retry forever (it IS the client's connection to
+        the cluster), so a drained shared RetryBudget cannot make it
+        give up — instead it pins the redial delay at the policy cap:
+        during a cluster-wide outage/partition every client converges on
+        one attempt per master per ~cap seconds (bounded redial rate),
+        and the budget refills from real successes the moment the
+        cluster heals."""
         failures = 0
+        budget = shared_retry_budget()
         while True:
             for master in self.masters:
                 try:
@@ -114,10 +124,20 @@ class MasterClient:
                     pass
                 if self._connected.is_set():
                     failures = 0  # the stream made it to the leader
+                    if budget is not None:
+                        budget.on_success()
+                elif budget is not None:
+                    budget.on_failure()
                 self._connected.clear()
                 RETRY_COUNTER.inc(op="keep_connected")
                 delay = self.RECONNECT_POLICY.delay(failures, self._rng)
                 failures = min(failures + 1, 16)  # cap the exponent, not time
+                if (
+                    failures > 1
+                    and budget is not None
+                    and not budget.allow("keep_connected")
+                ):
+                    delay = self.RECONNECT_POLICY.cap
                 await asyncio.sleep(delay)
 
     async def _consume(self, master: str) -> None:

@@ -25,7 +25,8 @@ import time
 from typing import Optional
 
 from ..ops.loadgen import LogHistogram
-from ..util import trace
+from ..util import overload, trace
+from ..util.backoff import shared_retry_budget
 
 
 class ReplicaReader:
@@ -60,6 +61,8 @@ class ReplicaReader:
         self.reads = 0  # total reads routed through this reader
         self.hedges = 0  # hedge requests launched
         self.hedge_wins = 0  # reads answered by the hedge, not the primary
+        self.hedges_suppressed = 0  # hedges withheld: target pool was
+        # shedding / breaker open, or the shared retry budget ran dry
         self._vid_of: dict[str, int] = {}  # fid -> vid memo (fids are
         # immutable strings; the split+int per read is measurable at
         # serving QPS rates on a shared core)
@@ -92,6 +95,43 @@ class ReplicaReader:
             vid = self._vid_of[fid] = int(fid.split(",")[0])
         return vid
 
+    def _alive(self, order: list) -> list:
+        """Drop replicas whose circuit breaker is open (non-consuming
+        peek — probes stay with callers that report outcomes). All-open
+        falls back to the original order: the read must still be tried,
+        and the breakers' half-open probes are how the pool heals."""
+        if len(order) <= 1:
+            return order
+        reg = overload.BREAKERS
+        alive = [u for u in order if not self._blocked(reg, u)]
+        return alive or order
+
+    @staticmethod
+    def _blocked(reg, url: str) -> bool:
+        br = reg.peek(url)
+        return br is not None and br.blocked()
+
+    def _may_hedge(self, peer: str, correctness: bool = False) -> bool:
+        """Gate every EXTRA request: paused while the target is shedding
+        or breaker-blocked (a hedge into an overloaded pool is retry-storm
+        fuel), and — for latency hedges only — capped by the shared retry
+        budget so hedges stay a fraction of successful traffic. The error
+        cross-check passes ``correctness=True``: it is a WRONG-ANSWER
+        guard (a tail-sync-lagging replica 404s needles its peers hold),
+        so a retry budget drained by unrelated failures must not suppress
+        it — only the target's own breaker/shed state may."""
+        br = overload.BREAKERS.peek(peer)
+        if br is not None and (br.blocked() or br.shedding()):
+            self.hedges_suppressed += 1
+            return False
+        if correctness:
+            return True
+        bud = shared_retry_budget()
+        if bud is not None and not bud.allow("hedge"):
+            self.hedges_suppressed += 1
+            return False
+        return True
+
     def read_nowait(self, fid: str):
         """An awaitable for GET /{fid} — the allocation-light form of
         `read()`: for single-holder vids (nothing to hedge to) this
@@ -120,10 +160,12 @@ class ReplicaReader:
             raise LookupError(f"volume {vid} not found in cache")
         self.reads += 1
         target = "/" + fid
+        order = self._alive(order)
         if len(order) == 1:
-            # single holder: nothing to hedge to, and the p99 estimate
-            # only feeds the hedge threshold — skip the timing machinery
-            # (measurable at serving QPS rates on a shared core)
+            # single holder (or every other holder breaker-blocked):
+            # nothing to hedge to, and the p99 estimate only feeds the
+            # hedge threshold — skip the timing machinery (measurable at
+            # serving QPS rates on a shared core)
             return await self.http.request("GET", order[0], target)
         t0 = time.perf_counter()
 
@@ -143,7 +185,15 @@ class ReplicaReader:
         except Exception:
             # primary FAILED fast (dead replica, reset): fail over to the
             # next holder outright — a crashed peer must cost one extra
-            # round-trip, not 1/N of all reads until the vid map learns
+            # round-trip, not 1/N of all reads until the vid map learns.
+            # Bounded by the shared retry budget: a mass-failure event
+            # drains it and the failovers stop amplifying the outage.
+            bud = shared_retry_budget()
+            if bud is not None:
+                bud.on_failure()
+                if not bud.allow("read_failover"):
+                    self.hedges_suppressed += 1
+                    raise
             self.hedges += 1
             trace.flag(trace.FLAG_HEDGE)
             st, body = await self.http.request("GET", order[1], target)
@@ -163,6 +213,8 @@ class ReplicaReader:
             # nothing. OUTSIDE the try above: a cross-check failure is
             # the peer's problem, never a reason to re-run the primary
             # failover (the primary's answer is in hand and stands).
+            if not self._may_hedge(order[1], correctness=True):
+                return st, body  # suppressed: the primary's answer stands
             self.hedges += 1
             trace.flag(trace.FLAG_HEDGE)
             try:
@@ -183,7 +235,19 @@ class ReplicaReader:
             return st, body  # peers agree: the primary's answer stands
         # primary is past p99: race a hedge on the next replica — and a
         # promotion flag, so the trace that had to hedge is kept by the
-        # tail sampler even when it was not head-sampled
+        # tail sampler even when it was not head-sampled. PAUSED while
+        # the hedge target is shedding/breaker-blocked or the shared
+        # retry budget is dry: when the pool is overloaded, the hedge
+        # that usually shaves the tail is instead the 2x amplifier that
+        # turns brownout into collapse — wait out the primary.
+        if not self._may_hedge(order[1]):
+            try:
+                st, body = await primary
+            except asyncio.CancelledError:
+                primary.cancel()
+                raise
+            self._record_ok(t0, st)
+            return st, body
         self.hedges += 1
         trace.flag(trace.FLAG_HEDGE)
         hedge = asyncio.ensure_future(
@@ -274,5 +338,6 @@ class ReplicaReader:
             "reads": self.reads,
             "hedges": self.hedges,
             "hedge_wins": self.hedge_wins,
+            "hedges_suppressed": self.hedges_suppressed,
             "hedge_threshold_ms": round(self.hedge_threshold() * 1e3, 2),
         }

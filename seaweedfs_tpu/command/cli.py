@@ -686,7 +686,11 @@ def cmd_upload(argv: list[str]) -> int:
 
         from ..client.operation import submit_file
 
-        async with aiohttp.ClientSession() as session:
+        from ..util.http_timeouts import client_timeout
+
+        async with aiohttp.ClientSession(
+            timeout=client_timeout()
+        ) as session:
             for path in args.files:
                 with open(path, "rb") as f:
                     data = f.read()
@@ -718,7 +722,11 @@ def cmd_download(argv: list[str]) -> int:
 
         from ..client.operation import lookup, read_url
 
-        async with aiohttp.ClientSession() as session:
+        from ..util.http_timeouts import client_timeout
+
+        async with aiohttp.ClientSession(
+            timeout=client_timeout()
+        ) as session:
             for fid in args.fids:
                 vid = int(fid.split(",")[0])
                 locs = await lookup(args.master, vid)
@@ -1048,7 +1056,9 @@ def cmd_filer_copy(argv: list[str]) -> int:
         # (rpc.Stub docstring) — close exactly what we opened
         channel = new_channel(grpc_address(args.filer))
         stub = Stub(grpc_address(args.filer), "filer", channel=channel)
-        session = aiohttp.ClientSession()
+        from ..util.http_timeouts import client_timeout
+
+        session = aiohttp.ClientSession(timeout=client_timeout())
         sem = asyncio.Semaphore(args.concurrency)
         stats = {"files": 0, "bytes": 0, "failed": 0}
         ttl_seconds = 0

@@ -84,7 +84,9 @@ class MessageBroker:
         if self.filer:
             import aiohttp
 
-            self._http = aiohttp.ClientSession()
+            from ..util.http_timeouts import client_timeout
+
+            self._http = aiohttp.ClientSession(timeout=client_timeout())
             await self._load_journal()
             self._flush_task = asyncio.ensure_future(self._flush_loop())
         svc = Service("messaging")

@@ -135,7 +135,9 @@ class WFS:
         self._subscribe_task: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
-        self._http = aiohttp.ClientSession()
+        from ..util.http_timeouts import client_timeout
+
+        self._http = aiohttp.ClientSession(timeout=client_timeout())
         self._subscribe_task = asyncio.ensure_future(self._follow_meta())
 
     async def stop(self) -> None:

@@ -74,7 +74,9 @@ class _AsyncPostingSink(NotificationSink):
         import aiohttp
 
         if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession()
+            from ..util.http_timeouts import client_timeout
+
+            self._session = aiohttp.ClientSession(timeout=client_timeout())
         return self._session
 
     def send(self, event_type, path, entry) -> None:

@@ -16,3 +16,11 @@ def grpc_address(http_address: str) -> str:
     """host:port -> host:(port+10000) (ref grpc_client_server.go:119-140)."""
     host, _, port = http_address.rpartition(":")
     return f"{host}:{int(port) + GRPC_PORT_OFFSET}"
+
+
+def http_address(grpc_addr: str) -> str:
+    """Inverse of grpc_address. The HTTP hostport is the canonical peer
+    identity (breakers, metrics): the gRPC and HTTP views of one server
+    must feed ONE circuit breaker, so both key by this form."""
+    host, _, port = grpc_addr.rpartition(":")
+    return f"{host}:{int(port) - GRPC_PORT_OFFSET}"

@@ -25,7 +25,9 @@ import grpc
 import grpc.aio
 import msgpack
 
-from ..util import faults, trace
+from . import http_address
+from ..util import faults, overload, trace
+from ..util.backoff import shared_retry_budget
 
 UNARY_UNARY = "unary_unary"
 UNARY_STREAM = "unary_stream"
@@ -181,31 +183,68 @@ class Stub:
     def __init__(self, address: str, service_name: str, channel=None):
         self.address = address
         self.service = service_name
+        # breakers key by the peer's HTTP hostport — the canonical peer
+        # identity — so this stub and FastHTTPClient feed ONE breaker
+        self.peer = http_address(address)
         self._channel = channel if channel is not None else get_channel(address)
 
     def _path(self, method: str) -> str:
         return f"/{self.service}/{method}"
 
     async def call(self, method: str, request: Any, timeout: float | None = 30):
-        if faults._PLAN is not None:
-            # fault-injection seam: reset / latency / hang before the wire;
-            # an injected hang honors this call's timeout like a real one
-            await faults.async_fault(
-                faults._PLAN, f"rpc:{method}", self.address, timeout=timeout
+        # per-peer circuit breaker, SHARED with the HTTP client's view of
+        # the same peer: an open breaker fails in microseconds instead of
+        # burning this call's full timeout against a dead/hung address —
+        # during an outage that difference is what keeps callers' retry
+        # loops (raft broadcasts, repair dispatch, keep-connected) from
+        # stacking timeout-deep queues. ConnectionError on purpose: every
+        # call site already treats it as "peer unreachable".
+        br = overload.peer_breaker(self.peer)
+        if br is not None and not br.allow():
+            raise overload.CircuitOpenError(
+                f"circuit open to {self.peer} (rpc:{method})"
             )
-        fn = self._channel.unary_unary(
-            self._path(method),
-            request_serializer=_pack,
-            response_deserializer=_unpack,
-        )
-        ctx = trace._CTX.get()
-        if ctx is not None:
-            return await fn(
-                request,
-                timeout=timeout,
-                metadata=(("traceparent", trace.format_traceparent(ctx)),),
+        try:
+            if faults._PLAN is not None:
+                # fault-injection seam: reset / latency / hang before the
+                # wire; an injected hang honors this call's timeout like a
+                # real one
+                await faults.async_fault(
+                    faults._PLAN, f"rpc:{method}", self.address,
+                    timeout=timeout,
+                )
+            fn = self._channel.unary_unary(
+                self._path(method),
+                request_serializer=_pack,
+                response_deserializer=_unpack,
             )
-        return await fn(request, timeout=timeout)
+            ctx = trace._CTX.get()
+            if ctx is not None:
+                out = await fn(
+                    request,
+                    timeout=timeout,
+                    metadata=(
+                        ("traceparent", trace.format_traceparent(ctx)),
+                    ),
+                )
+            else:
+                out = await fn(request, timeout=timeout)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            if br is not None:
+                br.record_failure()
+            raise
+        if br is not None:
+            br.record_success()
+        # every completed unary RPC is "successful traffic" for the shared
+        # retry budget — same deposit the HTTP client makes, so gRPC-heavy
+        # workloads (raft, heartbeats, repair) refill the budget their own
+        # retry loops draw from
+        bud = shared_retry_budget()
+        if bud is not None:
+            bud.on_success()
+        return out
 
     def server_stream(
         self, method: str, request: Any, timeout: float | None = None

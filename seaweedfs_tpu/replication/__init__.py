@@ -38,7 +38,9 @@ class FilerHttpSink(ReplicationSink):
 
     async def _ensure_session(self):
         if self._session is None:
-            self._session = aiohttp.ClientSession()
+            from ..util.http_timeouts import client_timeout
+
+            self._session = aiohttp.ClientSession(timeout=client_timeout())
         return self._session
 
     async def _copy(self, session, path: str, entry) -> None:
@@ -100,7 +102,9 @@ class S3Sink(ReplicationSink):
 
     async def _ensure_session(self):
         if self._session is None:
-            self._session = aiohttp.ClientSession()
+            from ..util.http_timeouts import client_timeout
+
+            self._session = aiohttp.ClientSession(timeout=client_timeout())
         return self._session
 
     def _url(self, path: str) -> str:

@@ -357,10 +357,11 @@ class FilerServer:
         chunks."""
         import random as _random
 
-        from ..util.backoff import BackoffPolicy
+        from ..util.backoff import BackoffPolicy, shared_retry_budget
 
         policy = BackoffPolicy(base=0.1, cap=5.0, attempts=1 << 30)
         rng = _random.Random(0x6047C)
+        budget = shared_retry_budget()
         failures = 0
         while True:
             await self._deletion_wakeup.wait()
@@ -377,13 +378,27 @@ class FilerServer:
             )
             if retry:
                 failures += 1
+                if budget is not None:
+                    budget.on_failure()
                 self._deletion_pending.extend(retry)
                 # re-arm, then back off: new arrivals merge into the
-                # retry round, and the jittered sleep IS the pacing
+                # retry round, and the jittered sleep IS the pacing.
+                # GC must retry forever (dropped fids leak bytes), so a
+                # drained shared RetryBudget pins the pacing at the cap
+                # instead of suppressing the round — during a volume
+                # outage every filer converges on one GC round per ~cap
+                # seconds rather than a storm.
                 self._deletion_wakeup.set()
-                await asyncio.sleep(policy.delay(min(failures, 6), rng))
+                delay = policy.delay(min(failures, 6), rng)
+                if budget is not None and not budget.allow(
+                    "filer_chunk_delete"
+                ):
+                    delay = policy.cap
+                await asyncio.sleep(delay)
             else:
                 failures = 0
+                if budget is not None:
+                    budget.on_success()
 
     async def _delete_chunk_batch(
         self, batch: list[tuple[str, int, str]]

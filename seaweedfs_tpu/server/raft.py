@@ -25,6 +25,7 @@ from typing import Callable, List, Optional
 
 from ..pb import grpc_address
 from ..pb.rpc import Stub
+from ..util import faults
 
 HEARTBEAT_INTERVAL = 0.15
 ELECTION_TIMEOUT_RANGE = (0.45, 0.9)
@@ -176,9 +177,12 @@ class RaftLite:
 
         async def one(peer: str) -> Optional[dict]:
             try:
-                return await Stub(grpc_address(peer), "master").call(
-                    method, req, timeout=1.0
-                )
+                # tagged with our own address so pairwise `partition`
+                # fault rules can match both endpoints of this hop
+                with faults.calling_from(self.address):
+                    return await Stub(grpc_address(peer), "master").call(
+                        method, req, timeout=1.0
+                    )
             except Exception:
                 return None
 

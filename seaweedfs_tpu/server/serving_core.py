@@ -49,7 +49,7 @@ from typing import Optional
 
 from aiohttp import web
 
-from ..util import faults, trace
+from ..util import faults, overload, trace
 from ..util.fasthttp import (
     DETACHED,
     FALLBACK,
@@ -61,6 +61,7 @@ from ..util.metrics import REQUEST_COUNTER
 # bound once: _dispatch pays these per request at serving QPS rates
 _perf = time.perf_counter
 _coin = trace._rand.random
+_classify = overload.classify_method
 
 
 def _make_debug_middleware(name: str, address: str, pprof=None):
@@ -132,6 +133,32 @@ async def _serve_debug(name: str, address: str, request, path: str,
         return web.Response(
             text=rec.dump_jsonl(), content_type="application/x-ndjson"
         )
+    if path == "/debug/overload":
+        # the overload plane's live state, per process: every admission
+        # gate this process runs (in-process clusters share one list —
+        # the `server` key on each gate disambiguates), the per-peer
+        # circuit breakers, and the shared retry budget. The shell's
+        # `overload.status` merges these cluster-wide. Served from the
+        # cold tier so it stays reachable WHILE the fast tier sheds.
+        from ..util.backoff import shared_retry_budget
+
+        budget = shared_retry_budget()
+        return web.json_response(
+            {
+                "server": name,
+                "addr": address,
+                # process identity for the shell's cluster-wide merge:
+                # gates are per-PROCESS, so (host, pid, gate-server) is
+                # the dedup key — counter values are not an identity
+                "pid": os.getpid(),
+                "admission_enabled": overload.admission_enabled(),
+                "gates": overload.gate_stats(),
+                "breakers": overload.BREAKERS.stats(),
+                "retry_budget": (
+                    budget.snapshot() if budget is not None else None
+                ),
+            }
+        )
     if path.startswith("/debug/pprof/"):
         # profiling is a process-global slowdown and the fast tiers
         # FALLBACK these paths from the PUBLIC port, so the surface is
@@ -185,6 +212,20 @@ class ServingCore:
         self._http_runner: Optional[web.AppRunner] = None
         self.internal_port: Optional[int] = None
         self._req_counters: dict = {}
+        # overload control (ISSUE 9): priority admission + adaptive
+        # concurrency limit in front of EVERY fast tier — None when
+        # SEAWEEDFS_TPU_ADMIT=0. The shed answer is pre-rendered once:
+        # refusing work must cost microseconds, or shedding at 3x
+        # offered load is itself the collapse.
+        self.gate = overload.new_server_gate(name)
+        retry_after = 1
+        if self.gate is not None:
+            retry_after = max(1, int(round(self.gate.retry_after_s)))
+        self._shed_resp = render_response(
+            503,
+            b'{"error":"overloaded, request shed"}',
+            extra=b"Retry-After: %d\r\n" % retry_after,
+        )
 
     async def start(self, app: web.Application) -> None:
         app.middlewares.append(
@@ -201,6 +242,7 @@ class ServingCore:
         await self.fast_server.start(self.host, self.port)
 
     async def stop(self) -> None:
+        overload.drop_gate(self.gate)
         if self.fast_server is not None:
             await self.fast_server.stop()
         if self._http_runner is not None:
@@ -245,11 +287,37 @@ class ServingCore:
         if req.path == "/metrics" or req.path.startswith("/debug/"):
             # reserved observability surface: ONE structural check in
             # front of every fast tier (instead of a per-server
-            # convention) — the cold-tier middleware serves these
+            # convention) — the cold-tier middleware serves these. Also
+            # exempt from admission: the overloaded state must stay
+            # observable WHILE it sheds.
             return FALLBACK
+        gate = self.gate
+        if gate is not None:
+            # priority admission BEFORE any per-request machinery: the
+            # wait charged against the class budget is everything since
+            # parse completion (event-loop backlog included — under
+            # single-loop saturation that backlog IS the queue), so a
+            # request that would blow its caller's deadline anyway is
+            # refused in microseconds with the pre-rendered 503.
+            waited = _perf() - req.t_arrive
+            adm = gate.try_admit(_classify(req.method), waited)
+            if adm is not True:
+                if adm is not False:
+                    adm = await gate.wait_queued(
+                        _classify(req.method), adm, waited
+                    )
+                if adm is False:
+                    if trace.RECORDER.enabled:
+                        trace.note_shed(
+                            f"{self.name}:{req.method}",
+                            server=self.name, path=req.path,
+                        )
+                    return self._shed_resp
         rec = trace.RECORDER
         sp = None
         enabled = rec.enabled
+        if enabled or gate is not None:
+            t0 = _perf()
         if enabled:
             tp = req.headers.get(b"traceparent")
             pctx = (
@@ -262,20 +330,43 @@ class ServingCore:
                     f"{self.name}:{req.method}", pctx,
                     server=self.name, addr=self.address, path=req.path,
                 )
-            t0 = _perf()
         plan = faults._PLAN
         if plan is not None:
-            out = await self._apply_fault(plan, req)
+            try:
+                out = await self._apply_fault(plan, req)
+            except BaseException:
+                if gate is not None:
+                    gate.release()
+                raise
             if out is not None:
+                if gate is not None:
+                    gate.release()
                 if sp is not None:
                     sp.finish()
                 return out
         try:
             out = await self.handler(req)
-        except Exception as e:
+        except BaseException as e:
+            # BaseException: a CancelledError (peer dropped mid-handler)
+            # must release the admission slot too, or capacity leaks
+            if gate is not None:
+                gate.release()
             if sp is not None:
                 sp.finish(err=e)
             raise
+        if gate is not None:
+            # feed the AIMD limiter from full fast-tier responses only:
+            # FALLBACK walls are µs of proxy hand-off and DETACHED walls
+            # end at handler return — either would drag the latency
+            # signal (and thus the limit) toward fiction
+            if out is FALLBACK or out is DETACHED:
+                gate.release()
+            else:
+                now = _perf()
+                # service wall feeds the AIMD limit; wait+service feeds
+                # the admitted-latency histogram (the server-side
+                # "admitted-request p99" in stats/overload.status)
+                gate.release(now - t0, now - req.t_arrive)
         if enabled:
             if out is FALLBACK or out is DETACHED:
                 # FALLBACK walls are µs of proxy hand-off (the real work
@@ -326,13 +417,14 @@ class ServingCore:
             if req.transport is not None:
                 req.transport.close()
             return DETACHED  # connection_lost tears the request loop down
-        except ConnectionResetError:
+        except ConnectionError:
+            # injected reset OR partition (ConnectionResetError is a
+            # ConnectionError): the peer sees a dropped connection,
+            # exactly like the client-seam variant
             trace.note_fault(
                 f"{self.name}:{req.method}", "reset",
                 server=self.name, path=req.path,
             )
-            # injected reset: the peer sees a dropped connection, exactly
-            # like the client-seam variant
             if req.transport is not None:
                 req.transport.close()
             return DETACHED
@@ -351,7 +443,14 @@ class ServingCore:
                 f"{self.name}:{req.method}", "http_error",
                 server=self.name, path=req.path,
             )
+            # shed-shaped statuses carry Retry-After like the admission
+            # gate's real 503s, so clients exercise the same honor path
+            extra = (
+                b"Retry-After: 1\r\n"
+                if ev.rule.status in (503, 429)
+                else b""
+            )
             return render_response(
-                ev.rule.status, b'{"error":"injected fault"}'
+                ev.rule.status, b'{"error":"injected fault"}', extra=extra
             )
         return None

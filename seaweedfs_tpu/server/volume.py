@@ -383,7 +383,9 @@ class VolumeServer(EcHandlers):
 
     # ---------------- lifecycle ----------------
     async def start(self) -> None:
-        self._http_client = aiohttp.ClientSession()
+        from ..util.http_timeouts import client_timeout
+
+        self._http_client = aiohttp.ClientSession(timeout=client_timeout())
         app = web.Application(client_max_size=256 << 20)
         app.router.add_route("*", "/{tail:.*}", self._dispatch)
         # shared serving core (server/serving_core.py): full aiohttp

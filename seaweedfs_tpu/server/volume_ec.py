@@ -26,6 +26,7 @@ from ..util.backoff import (
     BackoffPolicy,
     deadline_after,
     remaining,
+    shared_retry_budget,
 )
 from ..util.metrics import (
     EC_DEGRADED_READ_SECONDS,
@@ -659,6 +660,7 @@ class EcHandlers:
         with ev.shard_locations_lock:
             urls = list(ev.shard_locations.get(shard_id, []))
         rng = getattr(self, "_backoff_rng", None)
+        budget = shared_retry_budget()
         for url in urls:
             if url in (self.address, self.public_url):
                 continue
@@ -666,14 +668,20 @@ class EcHandlers:
                 if deadline is not None and time.monotonic() >= deadline:
                     return None
                 try:
-                    return await self._read_remote_shard_once(
+                    result = await self._read_remote_shard_once(
                         ev, url, shard_id, offset, size, file_key, deadline
                     )
                 except EcHandlers._Deleted:
                     raise
                 except Exception:
+                    if budget is not None:
+                        budget.on_failure()
                     if attempt == EC_REMOTE_READ_POLICY.attempts - 1:
                         break  # next url
+                    if budget is not None and not budget.allow(
+                        "ec_remote_read"
+                    ):
+                        break  # budget dry: no second chance, next url
                     RETRY_COUNTER.inc(op="ec_remote_read")
                     d = EC_REMOTE_READ_POLICY.delay(
                         attempt, rng if rng is not None else random
@@ -681,6 +689,10 @@ class EcHandlers:
                     if deadline is not None:
                         d = min(d, max(0.0, deadline - time.monotonic()))
                     await asyncio.sleep(d)
+                else:
+                    if budget is not None:
+                        budget.on_success()
+                    return result
         return None
 
     async def _read_one_ec_interval(

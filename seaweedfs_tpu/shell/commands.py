@@ -392,7 +392,9 @@ async def cmd_volume_vacuum(env, argv) -> str:
     threshold = float(flags.get("garbageThreshold", 0.3))
     import aiohttp
 
-    async with aiohttp.ClientSession() as session:
+    from ..util.http_timeouts import client_timeout
+
+    async with aiohttp.ClientSession(timeout=client_timeout()) as session:
         async with session.get(
             f"http://{env.master}/vol/vacuum?garbageThreshold={threshold}"
         ) as resp:
@@ -1115,3 +1117,74 @@ async def cmd_trace_dump(env, argv) -> str:
                 + (f" err={s['err']}" if s.get("err") else "")
             )
     return "\n".join(out) or "no traces recorded"
+
+
+async def _fetch_debug_json(url: str, path: str) -> dict:
+    import json as _json
+
+    import aiohttp
+
+    timeout = aiohttp.ClientTimeout(total=10)
+    async with aiohttp.ClientSession(timeout=timeout) as s:
+        async with s.get(f"http://{url}{path}") as resp:
+            if resp.status != 200:
+                raise IOError(f"{url}: status {resp.status}")
+            return _json.loads(await resp.text())
+
+
+@command("overload.status")
+async def cmd_overload_status(env, argv) -> str:
+    """The overload control plane's live state, cluster-wide: each
+    server's admission gate (adaptive concurrency limit, baseline,
+    inflight/queued, admitted/shed totals, pressure), open circuit
+    breakers, and the shared retry-budget fill. -servers=host:port,...
+    adds filer/S3 gateways the master does not know about. In-process
+    clusters share one process: the per-gate `server` key (master/
+    volume/filer/s3) disambiguates, and duplicate gates are de-duped."""
+    flags = _parse_flags(argv)
+    lines = []
+    seen_gates: set = set()
+    open_breakers: dict[str, dict] = {}
+    budget = None
+    for url in await _trace_endpoints(env, flags):
+        try:
+            st = await _fetch_debug_json(url, "/debug/overload")
+        except Exception as e:
+            lines.append(f"{url}: unreachable ({e})")
+            continue
+        if not st.get("admission_enabled", True):
+            lines.append(f"{url}: admission disabled (SEAWEEDFS_TPU_ADMIT=0)")
+        host = (st.get("addr") or url).rsplit(":", 1)[0]
+        for g in st.get("gates", []):
+            # gates are per-PROCESS (an in-process cluster reports the
+            # same list via every port it listens on): (host, pid,
+            # gate-server) identifies one — never counter values, which
+            # would collapse DISTINCT same-shape servers across processes
+            key = (host, st.get("pid"), g.get("server"))
+            if key in seen_gates:
+                continue  # same in-process gate seen via another server
+            seen_gates.add(key)
+            budgets = g.get("queue_budget_ms") or []
+            lines.append(
+                f"{g.get('server', '?')}[{url}]: limit={g.get('limit')} "
+                f"(baseline={g.get('baseline_ms')}ms "
+                f"+{g.get('limit_increases', 0)}/-{g.get('limit_decreases', 0)}) "
+                f"inflight={g.get('inflight')} queued={g.get('queued')} "
+                f"admitted={g.get('admitted_total')} shed={g.get('shed_total')} "
+                f"budget_ms={budgets} pressure={g.get('pressure')}"
+            )
+        for peer, b in (st.get("breakers") or {}).items():
+            if b.get("state") != "closed" or b.get("opens"):
+                open_breakers[peer] = b
+        if budget is None:
+            budget = st.get("retry_budget")
+    for peer, b in sorted(open_breakers.items()):
+        lines.append(
+            f"breaker {peer}: {b.get('state')} (opened {b.get('opens')}x)"
+        )
+    if budget is not None:
+        lines.append(
+            f"retry budget: {budget.get('tokens')}/{budget.get('max_tokens')} "
+            f"tokens (refill ratio {budget.get('ratio')})"
+        )
+    return "\n".join(lines) or "no servers"

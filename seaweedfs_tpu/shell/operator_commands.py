@@ -402,7 +402,9 @@ async def cmd_fs_cat(env, argv) -> str:
     chunks = sorted(entry.get("chunks", []), key=lambda c: int(c["offset"]))
     parts = []
     vid_locations: dict[int, list[str]] = {}
-    async with aiohttp.ClientSession() as session:
+    from ..util.http_timeouts import client_timeout
+
+    async with aiohttp.ClientSession(timeout=client_timeout()) as session:
         for c in chunks:
             vid = int(c["fid"].split(",")[0])
             if vid not in vid_locations:

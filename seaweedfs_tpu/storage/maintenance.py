@@ -16,6 +16,18 @@ cap and each plane falls back to its own shaping (e.g. the scrubber's
 `consume(n)` blocks until the shared bucket holds n tokens — the handle
 satisfies the same duck-type as a `TokenBucket`, so every existing
 `bucket.consume(...)` call site works unchanged.
+
+**Pressure coupling (ISSUE 9):** a static MB/s cap is the right ceiling
+for the steady state, but the wrong one during an overload — when the
+admission gates are shedding foreground requests, ANY maintenance I/O is
+stolen goodput. Every `consume()` (shared budget AND per-plane explicit
+buckets routed through `plane_bucket`) therefore consults
+`util/overload.global_pressure()` and sleeps extra time proportional to
+the pressure: at p≥~1 (a gate shed within the last second) each consume
+pays up to `SEAWEEDFS_TPU_MAINT_YIELD_MAX_S` (default 0.5s) — an
+effective pause that drains the moment shedding stops, never a deadlock.
+Yields are counted per plane (`maintenance_pressure_yields_total`), so a
+bench/chaos run can assert maintenance actually got out of the way.
 """
 
 from __future__ import annotations
@@ -74,6 +86,52 @@ class TokenBucket:
         return slept
 
 
+def _yield_max_s() -> float:
+    try:
+        return float(
+            os.environ.get("SEAWEEDFS_TPU_MAINT_YIELD_MAX_S", "") or 0.5
+        )
+    except ValueError:
+        return 0.5
+
+
+def yield_for_pressure(
+    plane: str,
+    base_s: float,
+    sleep: Callable[[float], None] = time.sleep,
+    pressure: Optional[Callable[[], float]] = None,
+) -> float:
+    """Sleep extra time proportional to foreground pressure; returns the
+    seconds yielded (0.0 — one float compare — in the common no-pressure
+    case). `base_s` is the uncontended wall this consume would take at
+    the configured rate: under pressure p the plane's effective rate
+    drops to rate*(1-p), i.e. extra = base * p/(1-p), clamped to the
+    per-consume cap so p→1 means "pause", never "hang forever"."""
+    if pressure is None:
+        pressure = _global_pressure
+    p = pressure()
+    if p < 0.05:
+        return 0.0
+    p = min(p, 0.999)
+    extra = min(base_s * (p / (1.0 - p)), _yield_max_s())
+    if extra <= 0.0:
+        return 0.0
+    try:
+        from ..util.metrics import MAINTENANCE_YIELDS
+
+        MAINTENANCE_YIELDS.inc(plane=plane)
+    except ImportError:
+        pass
+    sleep(extra)
+    return extra
+
+
+def _global_pressure() -> float:
+    from ..util.overload import global_pressure
+
+    return global_pressure()
+
+
 class _PlaneHandle:
     """One plane's view of the shared budget: a TokenBucket-shaped object
     whose consumption is charged to the common bucket and attributed to
@@ -106,18 +164,31 @@ class MaintenanceBudget:
         self.bucket = TokenBucket(
             rate_mbps * 1e6, capacity=capacity_bytes, clock=clock, sleep=sleep
         )
+        self._sleep = sleep
         self._lock = threading.Lock()
         self._spent: dict[str, int] = {}
         self._slept: dict[str, float] = {}
+        self._yielded: dict[str, float] = {}
 
     def plane(self, name: str) -> _PlaneHandle:
         return _PlaneHandle(self, name)
 
     def consume(self, n: int, plane: str = "other") -> float:
         slept = self.bucket.consume(n)
+        # pressure coupling: yield to foreground traffic being shed by
+        # the admission gates — the static cap is the ceiling, this makes
+        # it dynamic (arxiv 1709.05365's interference result)
+        yielded = yield_for_pressure(
+            plane, float(n) / self.bucket.rate, sleep=self._sleep
+        )
+        slept += yielded
         with self._lock:
             self._spent[plane] = self._spent.get(plane, 0) + int(n)
             self._slept[plane] = self._slept.get(plane, 0.0) + slept
+            if yielded:
+                self._yielded[plane] = (
+                    self._yielded.get(plane, 0.0) + yielded
+                )
         try:
             from ..util.metrics import MAINTENANCE_BYTES
 
@@ -133,6 +204,9 @@ class MaintenanceBudget:
                 "spent_bytes": dict(self._spent),
                 "throttle_seconds": {
                     k: round(v, 3) for k, v in self._slept.items()
+                },
+                "pressure_yield_seconds": {
+                    k: round(v, 3) for k, v in self._yielded.items()
                 },
             }
 
@@ -163,12 +237,33 @@ def configure_shared(budget: Optional[MaintenanceBudget]) -> None:
         _SHARED = budget
 
 
+class _PressureShapedBucket:
+    """A plane's explicitly configured bucket, with the foreground
+    pressure yield layered on top — the plane's own MB/s knob still sets
+    its ceiling, but an overloaded gate makes it back off exactly like
+    the shared budget's planes do. Same consume() duck-type."""
+
+    __slots__ = ("_bucket", "plane")
+
+    def __init__(self, bucket, plane: str):
+        self._bucket = bucket
+        self.plane = plane
+
+    def consume(self, n: int) -> float:
+        slept = self._bucket.consume(n)
+        rate = getattr(self._bucket, "rate", 0.0)
+        base_s = float(n) / rate if rate > 0 else 0.01
+        return slept + yield_for_pressure(
+            self.plane, base_s, sleep=getattr(self._bucket, "_sleep", time.sleep)
+        )
+
+
 def plane_bucket(plane: str, explicit=None):
     """The rate shaper a plane should use: an explicitly configured bucket
-    wins (the plane's own knob), else the shared budget's plane handle,
-    else None (unshaped)."""
+    wins (the plane's own knob, pressure-wrapped), else the shared
+    budget's plane handle, else None (unshaped)."""
     if explicit is not None:
-        return explicit
+        return _PressureShapedBucket(explicit, plane)
     budget = shared_budget()
     if budget is not None:
         return budget.plane(plane)

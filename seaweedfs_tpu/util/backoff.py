@@ -1,23 +1,40 @@
-"""Capped, jittered exponential backoff with deadline propagation.
+"""Capped, jittered exponential backoff with deadline propagation, plus
+the shared RetryBudget that keeps retries from amplifying an overload.
 
 Shared by every serving-path retry loop (master-client lookups, EC remote
-shard reads, keep-connected reconnects) so they all have the same shape:
-full-jitter delays (AWS architecture blog's `random(0, min(cap, base*2^k))`
-— the variant that best de-correlates a thundering herd), a hard attempt
-cap, and an absolute deadline that both truncates sleeps and refuses to
-start attempts it cannot finish. Pass a seeded `random.Random` for
-deterministic tests.
+shard reads, keep-connected reconnects, filer chunk-delete GC) so they
+all have the same shape: full-jitter delays (AWS architecture blog's
+`random(0, min(cap, base*2^k))` — the variant that best de-correlates a
+thundering herd), a hard attempt cap, and an absolute deadline that both
+truncates sleeps and refuses to start attempts it cannot finish. Pass a
+seeded `random.Random` for deterministic tests.
+
+The **RetryBudget** (the gRPC retry-throttling shape) is a token bucket
+refilled by *successes*: each success deposits `ratio` (default 0.1)
+tokens, each retryable failure withdraws one, and retries are permitted
+only while the bucket holds more than half its capacity. Under a healthy
+peer the bucket stays full and every retry goes through; under a failing
+or overloaded peer the bucket drains in ~`max_tokens` failures and
+retries are *suppressed* (`retries_suppressed_total{op}`) until real
+successes refill it — so the aggregate retry rate is capped at ~`ratio`
+of successful traffic and a brownout cannot snowball into a retry storm.
+One process-global budget (`shared_retry_budget()`) is consulted by
+`retry_async` and by the read fan-out's hedges; loops that must retry
+forever (keep-connected) fall back to their capped delay when the budget
+says no, instead of giving up.
 """
 
 from __future__ import annotations
 
 import asyncio
+import os
 import random
+import threading
 import time
 from dataclasses import dataclass
 from typing import Awaitable, Callable, Optional
 
-from .metrics import RETRY_COUNTER
+from .metrics import RETRIES_SUPPRESSED, RETRY_COUNTER
 
 
 @dataclass(frozen=True)
@@ -33,6 +50,81 @@ class BackoffPolicy:
 
 
 DEFAULT_POLICY = BackoffPolicy()
+
+
+class RetryBudget:
+    """Token-bucket retry throttle (the gRPC retryThrottling shape).
+
+    Starts full; `on_success()` deposits `ratio` tokens (capped),
+    `on_failure()` withdraws 1, and `allow(op)` permits a retry only
+    while the bucket holds more than half its capacity — counting every
+    refusal into `retries_suppressed_total{op}`. Thread-safe: consulted
+    from the event loop and from maintenance threads alike."""
+
+    def __init__(self, ratio: float = 0.1, max_tokens: float = 100.0):
+        self.ratio = ratio
+        self.max_tokens = max_tokens
+        self.tokens = max_tokens
+        self._lock = threading.Lock()
+
+    def on_success(self) -> None:
+        with self._lock:
+            self.tokens = min(self.max_tokens, self.tokens + self.ratio)
+
+    def on_failure(self) -> None:
+        with self._lock:
+            self.tokens = max(0.0, self.tokens - 1.0)
+
+    def allow(self, op: str = "") -> bool:
+        with self._lock:
+            ok = self.tokens > self.max_tokens / 2.0
+        if not ok:
+            RETRIES_SUPPRESSED.inc(op=op or "unknown")
+        return ok
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "tokens": round(self.tokens, 2),
+                "max_tokens": self.max_tokens,
+                "ratio": self.ratio,
+            }
+
+
+_SHARED_BUDGET: Optional[RetryBudget] = None
+_SHARED_BUDGET_LOCK = threading.Lock()
+
+
+def shared_retry_budget() -> Optional[RetryBudget]:
+    """The process-wide retry budget every retry loop consults. Tunable
+    via SEAWEEDFS_TPU_RETRY_BUDGET_RATIO (default 0.1 — retries capped
+    at ~10% of successes) and SEAWEEDFS_TPU_RETRY_BUDGET_TOKENS (bucket
+    size, default 100; 0 disables the budget entirely)."""
+    global _SHARED_BUDGET
+    if _SHARED_BUDGET is not None:
+        return _SHARED_BUDGET
+    try:
+        tokens = float(
+            os.environ.get("SEAWEEDFS_TPU_RETRY_BUDGET_TOKENS", "") or 100.0
+        )
+        ratio = float(
+            os.environ.get("SEAWEEDFS_TPU_RETRY_BUDGET_RATIO", "") or 0.1
+        )
+    except ValueError:
+        tokens, ratio = 100.0, 0.1
+    if tokens <= 0:
+        return None
+    with _SHARED_BUDGET_LOCK:
+        if _SHARED_BUDGET is None:
+            _SHARED_BUDGET = RetryBudget(ratio=ratio, max_tokens=tokens)
+        return _SHARED_BUDGET
+
+
+def configure_retry_budget(budget: Optional[RetryBudget]) -> None:
+    """Install (or clear, to re-read env) the process budget — tests."""
+    global _SHARED_BUDGET
+    with _SHARED_BUDGET_LOCK:
+        _SHARED_BUDGET = budget
 
 
 def deadline_after(seconds: Optional[float]) -> Optional[float]:
@@ -52,6 +144,9 @@ def remaining(deadline: Optional[float], default: Optional[float] = None,
     return max(floor, deadline - time.monotonic())
 
 
+_SHARED = object()  # sentinel: "use the process-wide retry budget"
+
+
 async def retry_async(
     fn: Callable[[], Awaitable],
     *,
@@ -61,6 +156,8 @@ async def retry_async(
     rng: Optional[random.Random] = None,
     op: str = "",
     on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    budget=_SHARED,
+    delay_floor: Optional[Callable[[], float]] = None,
 ) -> object:
     """Run `fn()` (a zero-arg coroutine factory) with backoff.
 
@@ -69,17 +166,39 @@ async def retry_async(
     `remaining(deadline)` at the call site. The last exception is re-raised
     when attempts or deadline run out. Retries count into
     seaweedfs_tpu_retries_total{op=...}.
+
+    `budget` is the shared RetryBudget by default: successes deposit,
+    retryable failures withdraw, and a drained budget SUPPRESSES further
+    retries (the last exception surfaces immediately) so a sick peer
+    costs each caller one attempt, not a storm. Pass budget=None to opt
+    a loop out. `delay_floor` (e.g. a peer's Retry-After hint via
+    FastHTTPClient.retry_after_remaining) raises individual sleeps to at
+    least its value — the peer asked for breathing room, jitter must not
+    undercut it; the deadline still wins (a retry past it is refused
+    either way).
     """
     rng = rng or random
+    if budget is _SHARED:
+        budget = shared_retry_budget()
     last: Optional[BaseException] = None
     for attempt in range(policy.attempts):
         try:
-            return await fn()
+            result = await fn()
         except retry_on as e:
             last = e
+            if budget is not None:
+                budget.on_failure()
+        else:
+            if budget is not None:
+                budget.on_success()
+            return result
         if attempt == policy.attempts - 1:
             break
+        if budget is not None and not budget.allow(op):
+            break
         d = policy.delay(attempt, rng)
+        if delay_floor is not None:
+            d = max(d, delay_floor())
         if deadline is not None:
             left = deadline - time.monotonic()
             if left <= 0:

@@ -27,10 +27,14 @@ Design rules:
 from __future__ import annotations
 
 import asyncio
+import time
 from collections import deque
 from typing import Awaitable, Callable, Optional
 
-from . import faults, trace
+from . import faults, overload, trace
+from .backoff import shared_retry_budget
+
+_perf = time.perf_counter  # bound once: stamped per parsed request
 
 FALLBACK = object()  # sentinel: "proxy this request to the full app"
 DETACHED = object()  # sentinel: "the handler will write the response itself
@@ -53,15 +57,21 @@ _STATUS_LINES = {
     404: b"HTTP/1.1 404 Not Found\r\n",
     405: b"HTTP/1.1 405 Method Not Allowed\r\n",
     416: b"HTTP/1.1 416 Range Not Satisfiable\r\n",
+    429: b"HTTP/1.1 429 Too Many Requests\r\n",
     500: b"HTTP/1.1 500 Internal Server Error\r\n",
+    503: b"HTTP/1.1 503 Service Unavailable\r\n",
 }
 
 
 class FastRequest:
-    """One parsed request. Header names are lower-case byte strings."""
+    """One parsed request. Header names are lower-case byte strings.
+    `t_arrive` is the perf_counter at parse completion: the admission
+    gate charges event-loop backlog (time between parse and dispatch)
+    against the request's queue budget — a request that already waited
+    past its class deadline is shed before doing work."""
 
     __slots__ = ("method", "target", "path", "query", "headers", "body", "peer",
-                 "raw_head", "transport", "done")
+                 "raw_head", "transport", "done", "t_arrive")
 
     def __init__(self, method, target, headers, body, peer, raw_head):
         self.method = method  # str: "GET"
@@ -346,6 +356,7 @@ class FastHTTPProtocol(asyncio.Protocol):
         )
         req.transport = self.transport
         req.done = None
+        req.t_arrive = _perf()
         return req
 
     def _resume_chunked(self):
@@ -857,8 +868,22 @@ class _ClientConn(asyncio.Protocol):
             w.set_exception(ConnectionError("bad response head"))
             return True
         keep = b"connection: close" not in lower
+        retry_after = None
+        if status in (503, 429):
+            # surface the peer's Retry-After so backoff/breakers honor
+            # it — only parsed on shed statuses, the 200 path pays one
+            # status compare
+            idx = lower.find(b"retry-after:")
+            if idx >= 0:
+                nl = lower.find(b"\r\n", idx)
+                if nl < 0:
+                    nl = len(head)
+                try:
+                    retry_after = float(head[idx + 12: nl].strip())
+                except ValueError:
+                    retry_after = None  # HTTP-date form: not spoken
         if chunked:
-            done = self._complete_chunked(end, status, keep, eof)
+            done = self._complete_chunked(end, status, keep, eof, retry_after)
         else:
             if clen >= 0:
                 total = end + 4 + clen
@@ -871,7 +896,7 @@ class _ClientConn(asyncio.Protocol):
                     return False
                 body = bytes(buf[end + 4: total])
                 del buf[:total]
-                w.set_result((status, body, keep))
+                w.set_result((status, body, keep, retry_after))
                 done = True
             else:
                 # length-less: framed by EOF, connection retired
@@ -879,13 +904,13 @@ class _ClientConn(asyncio.Protocol):
                     return False
                 body = bytes(buf[end + 4:])
                 del buf[:]
-                w.set_result((status, body, False))
+                w.set_result((status, body, False, retry_after))
                 done = True
         if done:
             self.waiter = None
         return done
 
-    def _complete_chunked(self, end, status, keep, eof) -> bool:
+    def _complete_chunked(self, end, status, keep, eof, retry_after=None) -> bool:
         """Chunked responses re-walk the buffer per attempt: fine for this
         client's shapes (our servers Content-Length-frame the data plane;
         chunked replies are rare, small streams)."""
@@ -915,7 +940,7 @@ class _ClientConn(asyncio.Protocol):
                         return False
                     if tnl == tpos:
                         del buf[:tnl + 2]
-                        w.set_result((status, bytes(out), keep))
+                        w.set_result((status, bytes(out), keep, retry_after))
                         return True
                     tpos = tnl + 2
             cstart = nl + 2
@@ -930,18 +955,68 @@ class _ClientConn(asyncio.Protocol):
         return False
 
 
+def _fire_timeout(conn: "_ClientConn", deadline_s: float) -> None:
+    """Per-request deadline: fail the in-flight waiter and drop the
+    connection (a half-read response can't be reused). Cheaper than
+    wait_for on the hot path — one call_later handle, cancelled on the
+    normal return."""
+    w = conn.waiter
+    if w is not None and not w.done():
+        w.set_exception(
+            TimeoutError(f"request exceeded {deadline_s}s deadline")
+        )
+    conn.closed = True
+    if conn.transport is not None:
+        conn.transport.close()
+
+
 class FastHTTPClient:
     """Keep-alive HTTP/1.1 client pool. request() -> (status, body).
 
     Built for the data plane's shapes: small JSON/payload responses framed
     by Content-Length. Responses without a Content-Length are read to EOF
-    and the connection retired."""
+    and the connection retired.
+
+    Overload-plane duties (ISSUE 9): every request carries a deadline
+    (default 30s — no unbounded waits on the data plane; pass
+    timeout=None ONLY for streaming shapes), consults the peer's circuit
+    breaker (an open breaker raises CircuitOpenError in microseconds
+    instead of burning the timeout), records the outcome into it, and
+    surfaces 503/429 ``Retry-After`` hints via
+    `retry_after_remaining(hostport)` so retry loops sleep at least as
+    long as the peer asked."""
 
     def __init__(self, pool_per_host: int = 32):
         self._pool: dict = {}
         self._limit = pool_per_host
+        self._breakers: dict = {}  # hostport -> CircuitBreaker | None
+        self._retry_after: dict = {}  # hostport -> monotonic deadline
 
-    async def _get(self, hostport: str) -> _ClientConn:
+    def _breaker(self, hostport: str):
+        try:
+            return self._breakers[hostport]
+        except KeyError:
+            br = self._breakers[hostport] = overload.peer_breaker(hostport)
+            return br
+
+    def note_retry_after(self, hostport: str, seconds: float) -> None:
+        self._retry_after[hostport] = time.monotonic() + seconds
+
+    def retry_after_remaining(self, hostport: str) -> float:
+        """Seconds the peer asked us to stay away (0 when none/expired)
+        — retry loops pass this as retry_async's delay_floor."""
+        t = self._retry_after.get(hostport)
+        if t is None:
+            return 0.0
+        rem = t - time.monotonic()
+        if rem <= 0:
+            del self._retry_after[hostport]
+            return 0.0
+        return rem
+
+    async def _get(
+        self, hostport: str, timeout: Optional[float] = None
+    ) -> _ClientConn:
         conns = self._pool.setdefault(hostport, [])
         while conns:
             c = conns.pop()
@@ -949,9 +1024,16 @@ class FastHTTPClient:
                 return c
         host, _, port = hostport.rpartition(":")
         loop = asyncio.get_running_loop()
-        _, proto = await loop.create_connection(
+        # the request deadline covers connection establishment too: a
+        # SYN-dropping peer (real partition, not the injected seam) must
+        # fail within the caller's budget, not the OS connect timeout
+        connect = loop.create_connection(
             lambda: _ClientConn(loop), host, int(port)
         )
+        if timeout is not None:
+            _, proto = await asyncio.wait_for(connect, timeout)
+        else:
+            _, proto = await connect
         return proto
 
     def _put(self, hostport: str, conn: _ClientConn):
@@ -974,23 +1056,52 @@ class FastHTTPClient:
         content_type: str = "",
         headers: Optional[dict] = None,
         retried: bool = False,
+        timeout: Optional[float] = 30.0,
     ) -> tuple[int, bytes]:
+        br = self._breaker(hostport)
+        if br is not None and not br.allow():
+            raise overload.CircuitOpenError(
+                f"circuit open to {hostport} (peer failing/shedding)"
+            )
         plan = faults._PLAN
         if plan is not None:
             # fault-injection seam: latency sleeps, resets raise, and
             # http_error rules synthesize a 5xx as if the peer degraded
-            ev = await faults.async_fault(plan, f"http:{method}", hostport)
+            try:
+                ev = await faults.async_fault(
+                    plan, f"http:{method}", hostport, timeout=timeout
+                )
+            except Exception:
+                if br is not None:
+                    br.record_failure()
+                raise
             if ev is not None and ev.kind == "http_error":
                 # tail sampling: a trace that saw an injected fault is
                 # kept (flag is a no-op without an active context)
                 trace.flag(trace.FLAG_FAULT)
+                if br is not None:
+                    if ev.rule.status in (503, 429):
+                        br.record_shed()
+                    else:
+                        # any other synthesized status still proves the
+                        # peer answered — and a half-open probe MUST get
+                        # an outcome here or it wedges the breaker open
+                        # forever (allow() consumed the probe slot)
+                        br.record_success()
                 return ev.rule.status, b'{"error":"injected fault"}'
         # cross-hop context propagation: an active trace context rides a
         # `traceparent` header so the server side joins the same trace
         # (sampled or not — unsampled contexts still carry promotion
         # flags downstream). The ctx-less path pays one contextvar load.
         ctx = trace._CTX.get()
-        conn = await self._get(hostport)
+        try:
+            conn = await self._get(hostport, timeout)
+        except OSError:
+            # connect refused/timed out: the canonical dead-peer signal
+            # (TimeoutError is an OSError since 3.10, so both land here)
+            if br is not None:
+                br.record_failure()
+            raise
         if (
             not body and not content_type and not headers
             and method == "GET" and ctx is None
@@ -1020,19 +1131,33 @@ class FastHTTPClient:
             if body:
                 parts.append(body)
             wire = b"".join(parts)
+        th = None
         try:
             fut = conn.begin()
             conn.transport.write(wire)
-            status, resp_body, reusable = await fut
+            if timeout is not None:
+                th = conn._loop.call_later(
+                    timeout, _fire_timeout, conn, timeout
+                )
+            status, resp_body, reusable, retry_after = await fut
         except asyncio.CancelledError:
             # a cancelled request (hedged read losing its race) leaves the
             # response half-read on the wire: the connection must die, not
             # linger open outside the pool
             conn.transport.close()
             raise
+        except TimeoutError:
+            # deadline fired (TimeoutError is an OSError since 3.10 —
+            # this arm must come first): NOT retried, a fresh connection
+            # would just burn another full deadline against a hung peer
+            if br is not None:
+                br.record_failure()
+            raise
         except (ConnectionError, asyncio.IncompleteReadError, OSError):
             conn.transport.close()
             if retried:
+                if br is not None:
+                    br.record_failure()
                 raise
             # stale pooled connection: one clean retry on a fresh one —
             # and a promotion flag, so the trace that paid the retry is
@@ -1040,12 +1165,35 @@ class FastHTTPClient:
             trace.flag(trace.FLAG_RETRY)
             return await self.request(
                 method, hostport, target, body, content_type, headers,
-                retried=True,
+                retried=True, timeout=timeout,
             )
+        finally:
+            if th is not None:
+                th.cancel()
         if reusable:
             self._put(hostport, conn)
         else:
             conn.transport.close()
+        if status in (503, 429):
+            if retry_after is not None:
+                self.note_retry_after(hostport, retry_after)
+            if br is not None:
+                br.record_shed(retry_after)
+        else:
+            if br is not None:
+                # any completed response (404s included) proves the peer
+                # is up and admitting — only transport failures and
+                # sheds count against it
+                br.record_success()
+            # every completed response is "successful traffic" for the
+            # shared retry budget (the gRPC retry-throttling shape:
+            # successes deposit ratio, failures withdraw 1 — so the
+            # hedges/failovers this client's callers pay for stay capped
+            # at a fraction of real throughput and refill as the system
+            # heals, not only when a retry_async loop happens to run)
+            bud = shared_retry_budget()
+            if bud is not None:
+                bud.on_success()
         return status, resp_body
 
     async def close(self):

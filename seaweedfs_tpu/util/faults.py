@@ -34,6 +34,8 @@ the plan before "restarting" the process (reloading the volume).
 from __future__ import annotations
 
 import asyncio
+import contextlib
+import contextvars
 import errno
 import json
 import os
@@ -45,6 +47,35 @@ from random import Random
 from typing import Optional
 
 from .metrics import FAULTS_INJECTED
+
+# the address of the node MAKING the current outbound call, when known —
+# pairwise `partition` rules need both endpoints, but the client seams
+# only see the callee. In-process callers that have an identity (raft
+# peers, server-to-server replication) wrap their calls in
+# `calling_from(self.address)`; external/anonymous callers leave it None
+# and only match a partition side whose pattern is "*".
+_CALL_SOURCE: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "faults_call_source", default=None
+)
+
+
+@contextlib.contextmanager
+def calling_from(address: str):
+    """Tag outbound calls in this (async) context with the caller's own
+    address, so pairwise partition rules can match both endpoints."""
+    tok = _CALL_SOURCE.set(address)
+    try:
+        yield
+    finally:
+        _CALL_SOURCE.reset(tok)
+
+
+def _source_matches(src: Optional[str], pattern: str) -> bool:
+    if pattern == "*":
+        return True
+    if src is None:
+        return False  # anonymous caller: only the wildcard side matches
+    return fnmatchcase(src, pattern)
 
 
 class SimulatedCrash(BaseException):
@@ -77,7 +108,8 @@ class FaultRule:
 
     op: str
     target: str = "*"
-    fault: str = "eio"  # eio|torn|crash|latency|reset|hang|http_error|bitflip
+    # eio|torn|crash|latency|reset|hang|http_error|bitflip|partition
+    fault: str = "eio"
     nth: Optional[int] = None
     probability: Optional[float] = None
     times: Optional[int] = None
@@ -94,6 +126,13 @@ class FaultRule:
     from_s: Optional[float] = None
     until_s: Optional[float] = None
     ramp: bool = False
+    # partition rules: the far end of the cut. The rule fires when the
+    # call's (source, target) pair matches (target, peer) in EITHER
+    # orientation — traffic is dropped both directions. peer="*" (the
+    # default) isolates `target` from everyone, including anonymous
+    # callers; a concrete pattern makes the cut pairwise and only
+    # matches callers that tagged themselves via `calling_from`.
+    peer: Optional[str] = None
 
     def max_fires(self) -> Optional[int]:
         if self.times is not None:
@@ -141,6 +180,36 @@ def brownout(
         from_s=start,
         until_s=start + duration,
         ramp=True,
+    )
+
+
+def partition(
+    a: str,
+    b: str = "*",
+    op: str = "*:*",
+    start: float = 0.0,
+    duration: Optional[float] = None,
+) -> FaultRule:
+    """Convenience constructor for a network partition: drop traffic both
+    directions between two addresses, windowed like `brownout`. For
+    `duration` seconds beginning `start` seconds after the plan is
+    installed (forever when duration is None — heal by swapping the
+    plan), every matching RPC/HTTP call whose (source, target) pair hits
+    (a, b) in either orientation raises ConnectionError at the seam —
+    the connection-refused shape of a firewalled peer, not a slow one.
+    With b="*" (default) node `a` is isolated from the whole cluster;
+    with a concrete `b` the cut is pairwise, and only callers that tag
+    their outbound calls via `calling_from(addr)` (raft peers do) can
+    match the source side. op="*:*" matches the RPC and HTTP client
+    seams but no disk ops. See docs/robustness.md's fault matrix."""
+    return FaultRule(
+        op=op,
+        target=a,
+        peer=b,
+        fault="partition",
+        probability=1.0,
+        from_s=start if (start or duration is not None) else None,
+        until_s=(start + duration) if duration is not None else None,
     )
 
 
@@ -209,8 +278,23 @@ class FaultPlan:
             if self._dead:
                 raise SimulatedCrash(f"{op} on {target} after simulated crash")
             now_rel = time.monotonic() - self.epoch
+            src = _CALL_SOURCE.get()
             for i, rule in enumerate(self.rules):
-                if not fnmatchcase(op, rule.op) or not fnmatchcase(target, rule.target):
+                if not fnmatchcase(op, rule.op):
+                    continue
+                if rule.fault == "partition":
+                    # both directions: (src -> target) matches the cut
+                    # (a, b) in either orientation
+                    a, b = rule.target, rule.peer or "*"
+                    if not (
+                        (fnmatchcase(target, a) and _source_matches(src, b))
+                        or (
+                            fnmatchcase(target, b)
+                            and _source_matches(src, a)
+                        )
+                    ):
+                        continue
+                elif not fnmatchcase(target, rule.target):
                     continue
                 # windowed rules outside their window neither count a
                 # match (nth bookkeeping) nor fire
@@ -252,7 +336,7 @@ class FaultPlan:
         for r in self.rules:
             rd = {"op": r.op, "target": r.target, "fault": r.fault}
             for k in ("nth", "probability", "times", "keep", "at_offset",
-                      "from_s", "until_s"):
+                      "from_s", "until_s", "peer"):
                 v = getattr(r, k)
                 if v is not None:
                     rd[k] = v
@@ -335,6 +419,11 @@ def sync_fault(
         if corruptable:
             return ev
         raise injected_eio(target)
+    if kind == "partition":
+        # a counted fault is never a no-op: on a disk seam the nearest
+        # honest shape is an I/O error (network partitions target the
+        # RPC/HTTP seams; op="*:*" cannot even match disk ops)
+        raise injected_eio(target)
     if not allow_partial:
         if kind == "crash":
             plan.mark_dead()
@@ -391,6 +480,11 @@ async def async_fault(
         return None
     if kind == "reset":
         raise ConnectionResetError(f"injected reset: {op} to {target}")
+    if kind == "partition":
+        # dropped both directions: surfaces as connection-refused, the
+        # firewalled-peer shape (fast failure — the retry/breaker
+        # machinery, not a timeout, decides what happens next)
+        raise ConnectionError(f"injected partition: {op} to {target}")
     if kind == "hang":
         # the window-scaled effective delay, like latency (a ramped
         # windowed hang would otherwise silently ignore its ramp)

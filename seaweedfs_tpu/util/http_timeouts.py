@@ -1,0 +1,36 @@
+"""One default aiohttp client timeout for every session in the tree.
+
+ISSUE 9's timeout discipline (enforced by the tier-1 static scan in
+tests/test_timeout_discipline.py): every outbound request path carries a
+deadline — an unbounded wait against a hung peer is how one sick server
+wedges its callers' queues and turns a brownout into an outage. The
+byte-level `FastHTTPClient` and the gRPC `Stub.call` carry their own
+per-request defaults (30s); aiohttp sessions get this shared
+`ClientTimeout` at construction:
+
+- `sock_connect=10`: a peer that cannot even complete a TCP handshake
+  in 10s is down — fail to the retry/breaker machinery, don't camp;
+- `sock_read=60`: every individual read must make progress within 60s.
+  Deliberately a PER-READ bound with no `total`: the sessions carrying
+  large transfers (replication sinks, mount chunk reads, backup
+  downloads) must not abort a healthy multi-minute body, while a peer
+  that stops sending mid-body still fails in bounded time. Long-lived
+  subscription streams ride gRPC `server_stream` (the allowlisted
+  streaming API), never these sessions.
+"""
+
+from __future__ import annotations
+
+
+def client_timeout(
+    total: float | None = None,
+    sock_connect: float = 10.0,
+    sock_read: float = 60.0,
+):
+    """The default `aiohttp.ClientTimeout` (lazy import: aiohttp is a
+    cold-path dependency for several callers)."""
+    import aiohttp
+
+    return aiohttp.ClientTimeout(
+        total=total, sock_connect=sock_connect, sock_read=sock_read
+    )

@@ -489,3 +489,42 @@ MAINTENANCE_BYTES = REGISTRY.counter(
     "bytes charged to the shared maintenance I/O budget, by plane "
     "(scrub/vacuum/repair)",
 )
+
+# overload control plane (see docs/robustness.md "Overload plane"): every
+# admission decision, limit move, breaker transition and suppressed retry
+# is counted so a brownout/overload run can assert HOW goodput survived —
+# lowest-class-first shedding, breakers isolating the sick peer, retries
+# capped at a fraction of successes — not just that it did
+OVERLOAD_SHED = REGISTRY.counter(
+    "seaweedfs_tpu_overload_shed_total",
+    "requests shed by the admission gate, by server, priority class "
+    "(read/write/meta/maint) and reason (deadline = waited past the "
+    "class's queue budget, queue_full = class's queue share exhausted)",
+)
+ADMISSION_QUEUE_DEPTH = REGISTRY.gauge(
+    "seaweedfs_tpu_admission_queue_depth",
+    "requests queued behind the adaptive concurrency limit, per server",
+)
+ADMISSION_LIMIT = REGISTRY.gauge(
+    "seaweedfs_tpu_admission_limit",
+    "live adaptive concurrency limit (AIMD on latency vs baseline), "
+    "per server",
+)
+RETRIES_SUPPRESSED = REGISTRY.counter(
+    "seaweedfs_tpu_retries_suppressed_total",
+    "retries/hedges withheld by the shared RetryBudget (token bucket "
+    "refilled by successes — no retry storms), by op",
+)
+CIRCUIT_TRANSITIONS = REGISTRY.counter(
+    "seaweedfs_tpu_circuit_transitions_total",
+    "circuit-breaker state transitions, by peer and target state",
+)
+CIRCUIT_OPEN = REGISTRY.gauge(
+    "seaweedfs_tpu_circuit_open",
+    "1 while a peer's circuit breaker is open (calls fail fast)",
+)
+MAINTENANCE_YIELDS = REGISTRY.counter(
+    "seaweedfs_tpu_maintenance_pressure_yields_total",
+    "maintenance budget consumes that yielded extra time to foreground "
+    "pressure (admission gates shedding/queueing), by plane",
+)

@@ -1,0 +1,647 @@
+"""Overload control plane: priority admission, adaptive concurrency
+limits, fast shedding, per-peer circuit breakers, and a pressure signal
+that throttles background maintenance.
+
+Nothing in PRs 2/6/7 defends *goodput* when offered load exceeds
+capacity: the serving paths are fast, but a 3x-capacity open-loop storm
+(ops/loadgen.py can generate one) just grows queues until every request
+times out — and retries/hedges amplify the collapse. This module is the
+control plane layered over those fast paths:
+
+- **AdmissionGate** (one per ServingCore, so master/volume/filer/S3 all
+  inherit it): every fast-tier request is classified into a priority
+  class (foreground reads > writes > gateway metadata > maintenance) and
+  admitted, queued, or shed BEFORE any work happens. Two mechanisms:
+
+  * a *queue-deadline*: the protocol stamps each request's arrival; a
+    request whose wait (event-loop backlog + gate queue) already exceeds
+    its class budget is shed instantly — the request was going to blow
+    its caller's deadline anyway, so the µs 503 beats the doomed work.
+    Lower classes get smaller budgets, so shedding is
+    lowest-class-first by construction;
+  * an *adaptive concurrency limit* (AdaptiveLimiter): AIMD on observed
+    handler latency vs a tracked baseline, the gradient
+    concurrency-limiting shape — requests past the limit queue (bounded,
+    with per-class depth shares) instead of piling onto the loop.
+
+  Shed responses are a pre-rendered 503 with ``Retry-After`` served in
+  microseconds, counted in ``overload_shed_total{class,reason}`` and
+  trace-flagged through the flight recorder's tail sampler.
+
+- **CircuitBreaker** (per peer, shared by the HTTP and gRPC clients):
+  closed → open on consecutive failures or a high shed rate, half-open
+  probes after the open window (or the peer's own Retry-After). An open
+  breaker fails calls in microseconds instead of burning a timeout per
+  attempt, and tells the read fan-out to stop hedging into a peer that
+  is already shedding.
+
+- **Pressure signal**: gates export max(recent-shed, queue-fullness) in
+  [0, 1]; `storage/maintenance.py` consults `global_pressure()` so
+  scrub/vacuum/repair I/O yields while foreground traffic is being shed
+  (the online-EC characterization result — arxiv 1709.05365 — is that
+  background coding I/O visibly steals foreground throughput; the
+  shared budget already caps the sum, this makes the cap *dynamic*).
+
+Env knobs (all optional; docs/robustness.md "Overload plane"):
+``SEAWEEDFS_TPU_ADMIT`` (0 disables admission, default on),
+``SEAWEEDFS_TPU_ADMIT_LIMIT`` (initial concurrency limit),
+``SEAWEEDFS_TPU_ADMIT_BUDGET_MS`` (foreground-read queue budget; other
+classes scale from it), ``SEAWEEDFS_TPU_RETRY_AFTER_S`` (shed hint),
+``SEAWEEDFS_TPU_BREAKER`` (0 disables circuit breakers).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import os
+import time
+from collections import deque
+from typing import Optional
+
+from .metrics import (
+    ADMISSION_LIMIT,
+    ADMISSION_QUEUE_DEPTH,
+    CIRCUIT_OPEN,
+    CIRCUIT_TRANSITIONS,
+    OVERLOAD_SHED,
+)
+
+# ------------------------------------------------------- priority classes --
+
+CLASS_READ = 0  # foreground reads (GET/HEAD on the data plane)
+CLASS_WRITE = 1  # writes (POST/PUT/DELETE)
+CLASS_META = 2  # gateway/filer metadata, everything else HTTP
+CLASS_MAINT = 3  # maintenance traffic (scrub/vacuum/repair riders)
+N_CLASSES = 4
+CLASS_NAMES = ("read", "write", "meta", "maint")
+
+_CLASS_BY_METHOD = {
+    "GET": CLASS_READ,
+    "HEAD": CLASS_READ,
+    "POST": CLASS_WRITE,
+    "PUT": CLASS_WRITE,
+    "DELETE": CLASS_WRITE,
+}
+
+
+def classify_method(method: str) -> int:
+    """Default request classifier: reads above writes above the rest.
+    Maintenance RPCs ride gRPC (not the HTTP gate) — their throttle is
+    the pressure coupling in storage/maintenance.py."""
+    return _CLASS_BY_METHOD.get(method, CLASS_META)
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+# ------------------------------------------------------- adaptive limiter --
+
+
+class AdaptiveLimiter:
+    """AIMD concurrency limit driven by observed latency vs a tracked
+    baseline (the gradient concurrency-limiting shape, windowed):
+
+    - every `window` samples, compare the window's mean latency against
+      `baseline * tolerance`; above it → multiplicative decrease (the
+      server is queueing internally), else, if the limit was actually
+      the binding constraint this window, additive increase by 1;
+    - the baseline tracks the *floor of windowed means* with a slow
+      upward drift: it snaps down to any window that averages lower and
+      drifts 10%/window toward higher ones, so it converges on the
+      uncontended mean service time, survives regime changes (payload
+      mix shifts) without locking in a congested measurement, and — the
+      reason it is a mean, not a min — a bimodal service mix (µs cache
+      hits beside ms disk reads) cannot pin the baseline at the fast
+      mode and turn every window into a multiplicative decrease.
+    """
+
+    def __init__(
+        self,
+        initial: Optional[int] = None,
+        min_limit: int = 8,
+        max_limit: int = 1024,
+        tolerance: float = 2.0,
+        window: int = 64,
+        decrease: float = 0.85,
+    ):
+        if initial is None:
+            initial = int(_env_f("SEAWEEDFS_TPU_ADMIT_LIMIT", 128))
+        self.limit = max(min_limit, int(initial))
+        self.min_limit = min_limit
+        self.max_limit = max_limit
+        self.tolerance = tolerance
+        self.window = window
+        self.decrease = decrease
+        self.baseline_s: Optional[float] = None
+        self.decreases = 0  # multiplicative backoffs taken (observability)
+        self.increases = 0
+        self._n = 0
+        self._sum = 0.0
+        self._min = float("inf")
+        self._hi_inflight = 0
+
+    def on_sample(self, latency_s: float, inflight: int) -> None:
+        self._n += 1
+        self._sum += latency_s
+        if latency_s < self._min:
+            self._min = latency_s
+        if inflight > self._hi_inflight:
+            self._hi_inflight = inflight
+        if self._n >= self.window:
+            self._update()
+
+    def _update(self) -> None:
+        win_avg = self._sum / self._n
+        saturated = self._hi_inflight >= self.limit - 1
+        self._n = 0
+        self._sum = 0.0
+        self._min = float("inf")
+        self._hi_inflight = 0
+        b = self.baseline_s
+        if b is None:
+            self.baseline_s = win_avg
+            return
+        # track the floor of windowed means: snap down, drift up at
+        # 10%/window (a min would let one µs cache hit define 'healthy')
+        self.baseline_s = min(win_avg, b + (win_avg - b) * 0.1)
+        if win_avg > self.baseline_s * self.tolerance:
+            new = max(self.min_limit, int(self.limit * self.decrease))
+            if new < self.limit:
+                self.limit = new
+                self.decreases += 1
+        elif saturated and self.limit < self.max_limit:
+            self.limit += 1
+            self.increases += 1
+
+
+# ------------------------------------------- admitted-latency histogram --
+
+# log-bucketed (growth sqrt(2), base 1µs, 64 buckets -> ~4300s span):
+# every percentile carries <= ~19% relative error, recording is one log
+# + one list increment — cheap enough for the admitted fast path, and
+# the per-server admitted p50/p99 it yields is the number an operator
+# (and the overload bench) actually wants next to shed counts
+_LAT_BASE = 1e-6
+_LAT_LOG_G = math.log(math.sqrt(2.0))
+_LAT_BUCKETS = 64
+
+
+def latency_percentile(counts: list, p: float) -> float:
+    """Seconds at percentile p in [0,100] of a bucket-count list (as
+    `AdmissionGate.admitted_counts` snapshots/deltas); 0.0 when empty."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    rank = total * p / 100.0
+    seen = 0.0
+    for i, c in enumerate(counts):
+        seen += c
+        if seen >= rank:
+            # geometric midpoint of the covering bucket
+            return _LAT_BASE * math.exp(_LAT_LOG_G * (i + 0.5))
+    return _LAT_BASE * math.exp(_LAT_LOG_G * _LAT_BUCKETS)
+
+
+# -------------------------------------------------------- admission gate --
+
+# per-class queue-wait budgets (seconds): a request that already waited
+# longer than its class budget is shed before doing work. Lower classes
+# get smaller budgets — shedding is lowest-class-first by construction.
+_BUDGET_SCALE = (1.0, 0.8, 0.6, 0.2)
+# per-class share of the bounded gate queue: when the queue is fuller
+# than a class's share allows, that class sheds at arrival while higher
+# classes may still queue.
+_QUEUE_SHARE = (1.0, 0.5, 0.25, 0.1)
+
+
+class AdmissionGate:
+    """Priority admission for one server's fast tier.
+
+    `try_admit(cls, waited_s)` is the synchronous fast path: True =
+    admitted (caller MUST pair with `release`), False = shed (caller
+    answers 503 immediately), else a Future the caller awaits via
+    `wait_queued`. Single-event-loop use only (no locking — ServingCore
+    dispatch is the sole caller)."""
+
+    def __init__(
+        self,
+        server: str,
+        limiter: Optional[AdaptiveLimiter] = None,
+        read_budget_s: Optional[float] = None,
+        max_queue: int = 512,
+        retry_after_s: Optional[float] = None,
+        clock=time.monotonic,
+    ):
+        self.server = server
+        self.limiter = limiter or AdaptiveLimiter()
+        if read_budget_s is None:
+            read_budget_s = _env_f("SEAWEEDFS_TPU_ADMIT_BUDGET_MS", 50.0) / 1e3
+        self.set_read_budget(read_budget_s)
+        self.max_queue = max_queue
+        self.retry_after_s = (
+            retry_after_s
+            if retry_after_s is not None
+            else _env_f("SEAWEEDFS_TPU_RETRY_AFTER_S", 1.0)
+        )
+        self._clock = clock
+        self.inflight = 0
+        self.admitted_total = 0
+        self.queued = 0
+        self._queues: tuple = tuple(deque() for _ in range(N_CLASSES))
+        self.shed_total = 0
+        self._shed_children: dict = {}
+        self.last_shed_t = 0.0
+        self._depth_gauge = ADMISSION_QUEUE_DEPTH
+        self._limit_gauge = ADMISSION_LIMIT
+        self._limit_gauge.set(self.limiter.limit, server=server)
+        # server-side latency of ADMITTED requests (admission wait +
+        # service), log-bucketed — the number "admitted-request p99"
+        # honestly means: a saturated open-loop *generator's* own client
+        # backlog cannot pollute it
+        self.admitted_counts = [0] * _LAT_BUCKETS
+
+    def set_read_budget(self, read_budget_s: float) -> None:
+        """Reset the per-class queue-wait budgets from the foreground-read
+        budget (benches scale it from a measured baseline p99)."""
+        self.queue_budget_s = tuple(
+            read_budget_s * s for s in _BUDGET_SCALE
+        )
+
+    # -- admission --
+    def try_admit(self, cls: int, waited_s: float = 0.0):
+        if waited_s > self.queue_budget_s[cls]:
+            self._shed(cls, "deadline")
+            return False
+        if self.inflight < self.limiter.limit:
+            self.inflight += 1
+            self.admitted_total += 1
+            return True
+        if self.queued >= self.max_queue * _QUEUE_SHARE[cls]:
+            self._shed(cls, "queue_full")
+            return False
+        fut = asyncio.get_event_loop().create_future()
+        self._queues[cls].append(fut)
+        self.queued += 1
+        self._depth_gauge.set(self.queued, server=self.server)
+        return fut
+
+    async def wait_queued(self, cls: int, fut, waited_s: float = 0.0) -> bool:
+        """Await a queued admission inside the class's remaining budget;
+        past it the request sheds (reason=deadline)."""
+        left = max(self.queue_budget_s[cls] - waited_s, 0.001)
+        try:
+            await asyncio.wait_for(fut, left)
+        except asyncio.TimeoutError:
+            # wait_for cancelled the future; _wake skips cancelled
+            # entries lazily — only the live count must drop NOW
+            self.queued -= 1
+            self._depth_gauge.set(self.queued, server=self.server)
+            self._shed(cls, "deadline")
+            return False
+        except asyncio.CancelledError:
+            # the caller's task died while queued (client disconnect mid
+            # overload — the exact regime this gate exists for). Undo the
+            # bookkeeping or the gate leaks: if _wake granted the slot in
+            # the race window before our cancellation landed, hand the
+            # inflight slot back (release() will never run for us);
+            # otherwise the future is a husk — stop counting it toward
+            # the queue depth, same as the timeout path.
+            if fut.done() and not fut.cancelled():
+                self.inflight -= 1
+                self._wake()
+            else:
+                fut.cancel()
+                self.queued -= 1
+                self._depth_gauge.set(self.queued, server=self.server)
+            raise
+        return True
+
+    async def admit(self, cls: int, waited_s: float = 0.0) -> bool:
+        r = self.try_admit(cls, waited_s)
+        if r is True or r is False:
+            return r
+        return await self.wait_queued(cls, r, waited_s)
+
+    def release(
+        self,
+        latency_s: Optional[float] = None,
+        total_s: Optional[float] = None,
+    ) -> None:
+        """`latency_s` is the handler service wall (feeds the AIMD
+        limiter), `total_s` the full server-side latency since parse
+        completion (wait + service — feeds the admitted histogram)."""
+        self.inflight -= 1
+        if latency_s is not None:
+            lim = self.limiter
+            before = lim.limit
+            lim.on_sample(latency_s, self.inflight + 1)
+            if lim.limit != before:
+                self._limit_gauge.set(lim.limit, server=self.server)
+        if total_s is not None:
+            if total_s < _LAT_BASE:
+                i = 0
+            else:
+                i = min(
+                    int(math.log(total_s / _LAT_BASE) / _LAT_LOG_G),
+                    _LAT_BUCKETS - 1,
+                )
+            self.admitted_counts[i] += 1
+        self._wake()
+
+    def _wake(self) -> None:
+        """Hand freed slots to queued waiters, highest class first."""
+        while self.inflight < self.limiter.limit and self.queued:
+            fut = None
+            for q in self._queues:  # class 0 (reads) first
+                while q:
+                    f = q.popleft()
+                    if not f.done():  # done == cancelled by wait_queued
+                        fut = f
+                        break
+                if fut is not None:
+                    break
+            if fut is None:
+                return  # only cancelled husks remained
+            self.queued -= 1
+            self._depth_gauge.set(self.queued, server=self.server)
+            self.inflight += 1
+            self.admitted_total += 1
+            fut.set_result(True)
+
+    # -- shedding / pressure --
+    def _shed(self, cls: int, reason: str) -> None:
+        self.shed_total += 1
+        self.last_shed_t = self._clock()
+        key = (cls, reason)
+        child = self._shed_children.get(key)
+        if child is None:
+            child = self._shed_children[key] = OVERLOAD_SHED.child(
+                server=self.server,
+                reason=reason,
+                **{"class": CLASS_NAMES[cls]},
+            )
+        child.inc()
+
+    def pressure(self) -> float:
+        """Foreground pressure in [0, 1]: 1.0 while shedding (within the
+        last second), else queue fullness."""
+        if self._clock() - self.last_shed_t < 1.0:
+            return 1.0
+        if self.queued:
+            return min(1.0, self.queued / self.max_queue)
+        return 0.0
+
+    def stats(self) -> dict:
+        lim = self.limiter
+        return {
+            "server": self.server,
+            "limit": lim.limit,
+            "baseline_ms": (
+                round(lim.baseline_s * 1e3, 3)
+                if lim.baseline_s is not None
+                else None
+            ),
+            "limit_decreases": lim.decreases,
+            "limit_increases": lim.increases,
+            "inflight": self.inflight,
+            "queued": self.queued,
+            "admitted_total": self.admitted_total,
+            "shed_total": self.shed_total,
+            "queue_budget_ms": [
+                round(b * 1e3, 1) for b in self.queue_budget_s
+            ],
+            "admitted_p50_ms": round(
+                latency_percentile(self.admitted_counts, 50) * 1e3, 3
+            ),
+            "admitted_p99_ms": round(
+                latency_percentile(self.admitted_counts, 99) * 1e3, 3
+            ),
+            "pressure": round(self.pressure(), 3),
+        }
+
+
+# ------------------------------------------------- gate registry/pressure --
+
+_GATES: list = []
+
+
+def admission_enabled() -> bool:
+    return (os.environ.get("SEAWEEDFS_TPU_ADMIT", "1") or "1") not in (
+        "0",
+        "",
+    )
+
+
+def new_server_gate(server: str) -> Optional[AdmissionGate]:
+    """An AdmissionGate for one ServingCore, registered into the global
+    pressure signal — or None when admission is disabled by env."""
+    if not admission_enabled():
+        return None
+    gate = AdmissionGate(server)
+    _GATES.append(gate)
+    return gate
+
+
+def drop_gate(gate: Optional[AdmissionGate]) -> None:
+    """Unregister a stopped server's gate so its last-shed window cannot
+    keep pressuring maintenance after the server is gone."""
+    if gate is not None:
+        try:
+            _GATES.remove(gate)
+        except ValueError:
+            pass
+
+
+def global_pressure() -> float:
+    """Max pressure over every live gate in this process — the signal
+    storage/maintenance.py consults. Plain float reads: safe from worker
+    threads."""
+    p = 0.0
+    for g in _GATES:
+        gp = g.pressure()
+        if gp > p:
+            p = gp
+            if p >= 1.0:
+                break
+    return p
+
+
+def gate_stats() -> list:
+    return [g.stats() for g in _GATES]
+
+
+# ------------------------------------------------------- circuit breaker --
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+
+class CircuitOpenError(ConnectionError):
+    """Fast-fail for calls to a peer whose breaker is open. A
+    ConnectionError on purpose: every existing retry/hedge/failover path
+    already treats it as 'peer unavailable' and moves on."""
+
+
+class CircuitBreaker:
+    """Per-peer closed/open/half-open breaker.
+
+    Opens on `fail_threshold` consecutive failures, or when at least
+    half of the last `shed_window` outcomes were sheds (503 +
+    Retry-After: the peer is alive but actively load-shedding — keep
+    hammering it and you ARE the overload). Half-open admits one probe
+    after the open window; the probe's outcome closes or re-opens."""
+
+    def __init__(
+        self,
+        peer: str,
+        fail_threshold: int = 6,
+        shed_window: int = 20,
+        shed_trip: float = 0.5,
+        open_s: float = 0.25,
+        clock=time.monotonic,
+    ):
+        self.peer = peer
+        self.fail_threshold = fail_threshold
+        self.shed_trip = shed_trip
+        self.open_s = open_s
+        self._clock = clock
+        self.state = CLOSED
+        self.opens = 0  # times tripped
+        self._consec_fail = 0
+        self._ring: deque = deque(maxlen=shed_window)  # True = shed
+        self._open_until = 0.0
+        self._probe_out = False
+        self._last_shed_t = 0.0
+
+    # -- gate --
+    def allow(self) -> bool:
+        """May a request go to this peer now? Consumes the half-open
+        probe slot, so callers must report the outcome via record_*."""
+        if self.state == CLOSED:
+            return True
+        if self.state == OPEN:
+            if self._clock() < self._open_until:
+                return False
+            self._transition(HALF_OPEN)
+            self._probe_out = True
+            return True
+        if self._probe_out:
+            return False  # half-open: one probe at a time
+        self._probe_out = True
+        return True
+
+    def blocked(self) -> bool:
+        """Non-consuming peek: would allow() refuse right now? (Replica
+        ordering uses this so peeking never eats the half-open probe.)"""
+        if self.state == CLOSED:
+            return False
+        if self.state == OPEN:
+            return self._clock() < self._open_until
+        return self._probe_out
+
+    def shedding(self) -> bool:
+        """Is the peer actively load-shedding? True within ~1s of a shed
+        answer — the read fan-out pauses hedging into such a pool (a
+        hedge into a shedding peer is pure retry-storm fuel)."""
+        return self._clock() - self._last_shed_t < 1.0
+
+    # -- outcomes --
+    def record_success(self) -> None:
+        self._consec_fail = 0
+        self._ring.append(False)
+        self._probe_out = False
+        if self.state != CLOSED:
+            self._transition(CLOSED)
+
+    def record_failure(self) -> None:
+        self._consec_fail += 1
+        self._ring.append(False)
+        if self.state == HALF_OPEN:
+            self._probe_out = False
+            self._trip(self.open_s)  # failed probe: back to open
+        elif self.state == CLOSED and (
+            self._consec_fail >= self.fail_threshold
+        ):
+            self._trip(self.open_s)
+
+    def record_shed(self, retry_after_s: Optional[float] = None) -> None:
+        """A 503/429 shed answer (alive peer refusing load). Not a
+        failure for the consecutive count — but a shed-heavy window
+        trips the breaker for the peer's own Retry-After hint."""
+        self._ring.append(True)
+        self._last_shed_t = self._clock()
+        if self.state == HALF_OPEN:
+            self._probe_out = False
+            self._trip(retry_after_s or self.open_s)
+            return
+        ring = self._ring
+        if (
+            self.state == CLOSED
+            and len(ring) >= ring.maxlen // 2
+            and sum(ring) >= len(ring) * self.shed_trip
+        ):
+            self._trip(retry_after_s or self.open_s)
+
+    def _trip(self, open_for: float) -> None:
+        self._transition(OPEN)
+        self._open_until = self._clock() + open_for
+        self.opens += 1
+        self._consec_fail = 0
+        self._ring.clear()
+
+    def _transition(self, to: str) -> None:
+        if to == self.state:
+            return
+        self.state = to
+        CIRCUIT_TRANSITIONS.inc(peer=self.peer, to=to)
+        CIRCUIT_OPEN.set(1.0 if to == OPEN else 0.0, peer=self.peer)
+
+
+class BreakerRegistry:
+    """Process-wide per-peer breakers, shared by the HTTP data-plane
+    client and the gRPC stub so both views of one peer's health feed one
+    breaker."""
+
+    def __init__(self, **breaker_kwargs):
+        self._kw = breaker_kwargs
+        self._by_peer: dict[str, CircuitBreaker] = {}
+
+    def get(self, peer: str) -> CircuitBreaker:
+        br = self._by_peer.get(peer)
+        if br is None:
+            br = self._by_peer[peer] = CircuitBreaker(peer, **self._kw)
+        return br
+
+    def peek(self, peer: str) -> Optional[CircuitBreaker]:
+        return self._by_peer.get(peer)
+
+    def reset(self) -> None:
+        self._by_peer.clear()
+
+    def stats(self) -> dict:
+        return {
+            p: {"state": b.state, "opens": b.opens}
+            for p, b in self._by_peer.items()
+        }
+
+
+BREAKERS = BreakerRegistry()
+
+
+def breakers_enabled() -> bool:
+    return (os.environ.get("SEAWEEDFS_TPU_BREAKER", "1") or "1") not in (
+        "0",
+        "",
+    )
+
+
+def peer_breaker(peer: str) -> Optional[CircuitBreaker]:
+    """The shared breaker for a peer address, or None when breakers are
+    disabled (env) — callers do `br is None or br.allow()`."""
+    if not breakers_enabled():
+        return None
+    return BREAKERS.get(peer)

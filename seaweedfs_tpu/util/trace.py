@@ -64,12 +64,14 @@ FLAG_ERROR = 1
 FLAG_RETRY = 2
 FLAG_HEDGE = 4
 FLAG_FAULT = 8
+FLAG_SHED = 16
 
 _FLAG_NAMES = (
     (FLAG_ERROR, "error"),
     (FLAG_RETRY, "retry"),
     (FLAG_HEDGE, "hedge"),
     (FLAG_FAULT, "fault"),
+    (FLAG_SHED, "shed"),
 )
 
 
@@ -575,6 +577,22 @@ def note_fault(name: str, kind: str, **tags) -> None:
         c.flags |= FLAG_FAULT
         return
     rec.promote_fault(name, kind, **tags)
+
+
+def note_shed(name: str, **tags) -> None:
+    """Admission-gate hook: a shed request flags its trace (joined from
+    the caller's traceparent) or retro-promotes a root — load-shedding
+    decisions are kept by the tail sampler even at sample=0, exactly
+    like injected faults. No-op (one attr load) while the recorder is
+    off, so the µs shed path stays µs."""
+    rec = RECORDER
+    if not rec.enabled:
+        return
+    c = _CTX.get()
+    if c is not None:
+        c.flags |= FLAG_SHED
+        return
+    rec.promote_fault(name, "shed", **tags)
 
 
 # exemplar hook: histograms ask for the live sampled trace id at observe

@@ -228,6 +228,66 @@ def test_serving_open_loop_leg_shape():
     assert ol["read_fanout"]["reads"] > 0
 
 
+def test_serving_overload_leg_shape():
+    """ISSUE 9 guard: the serving.overload leg must emit a goodput vs
+    the single-rate ceiling, a bounded server-side admitted p99 ratio,
+    real shed decisions counted by (class, reason), a µs-scale shed
+    path, and the brownout-recovery sub-leg's per-second goodput
+    buckets. Small/short shapes: the guard checks structure and sanity
+    bounds, the real acceptance numbers come from the full bench run."""
+    # deeper-than-default pool so the server-loop backlog reliably
+    # crosses the measured queue budget at this tiny shape; one bounded
+    # re-run absorbs shared-host noise where the 1x leg's p99 (which
+    # SETS the budget) got inflated enough that 3x never backlogs past
+    # it — the assertions themselves stay strict
+    for _attempt in range(2):
+        ov = bench.measure_serving_overload(
+            num_files=120,
+            base_duration=1.2,
+            duration=2.0,
+            recovery_duration=3.0,
+            workers=96,
+        )
+        if "error" not in ov and ov["overload"]["shed_responses"] > 0:
+            break
+    assert "error" not in ov, ov.get("error")
+    assert ov["admission_enabled"] is True
+    assert ov["corpus_files"] > 0
+    assert ov["inline_ping_qps"] > 0
+    assert ov["closed_loop_read"]["qps"] > 0
+    assert ov["read_budget_ms"] > 0
+    ceiling, over = ov["ceiling"], ov["overload"]
+    assert ceiling["goodput_qps"] > 0
+    assert over["offered_qps"] >= 2.5 * ceiling["offered_qps"]
+    # no congestion collapse: goodput at ~3x offered holds near the 1x
+    # ceiling (generous floor here: tiny corpus + short windows swing)
+    assert ov["goodput_over_ceiling"] >= 0.5
+    # the admission plane actually engaged and counted its decisions
+    assert over["shed_responses"] > 0
+    assert over["shed_by_class_reason"], "sheds not counted by class/reason"
+    assert all(
+        "class=" in k and "reason=" in k
+        for k in over["shed_by_class_reason"]
+    )
+    # server-side admitted p99 (wait + service) stays within the
+    # budget-scaled bound; the refusal itself is microseconds
+    assert over["admitted_server_p99_ms"] > 0
+    assert ov["admitted_p99_over_ceiling_p99"] <= 8.0
+    assert 0 < ov["shed_path_us"] < 50.0
+    # client-observed shed RTT is disclosed whenever sheds happened
+    assert over["shed_rtt"]["count"] == over["shed_responses"]
+    # the limiter published its trajectory and the gate its stats
+    assert over["limit_before"] > 0 and over["limit_after"] > 0
+    assert over["gate"]["admitted_total"] > 0
+    # brownout-recovery sub-leg: injected faults, per-second goodput
+    # buckets, and a recovery verdict
+    rec = ov["brownout_recovery"]
+    assert rec["injected"] > 0
+    assert len(rec["goodput_per_second"]) >= 3
+    assert rec["recovered_goodput_qps"] > 0
+    assert isinstance(rec["recovered"], bool)
+
+
 def test_trace_overhead_leg_shape():
     """ISSUE 8 guard: the serving.trace_overhead leg must emit BOTH QPS
     numbers (tracing-off and tracing-on-at-1%) with their ratio, and the

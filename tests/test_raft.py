@@ -294,6 +294,157 @@ def test_leader_flapping_converges(tmp_path):
     asyncio.run(body())
 
 
+def test_partition_fault_kind_drops_both_directions():
+    """The `partition` fault kind (ISSUE 9 satellite): one windowed rule
+    cuts traffic in BOTH orientations between two addresses, anonymous
+    callers only match the wildcard side, and the window heals it."""
+    from seaweedfs_tpu.util import faults
+
+    plan = faults.FaultPlan(
+        seed=1, rules=[faults.partition("a:1", "b:2")]
+    )
+
+    async def body():
+        # a -> b: source tagged via calling_from
+        with faults.calling_from("a:1"):
+            with pytest.raises(ConnectionError):
+                await faults.async_fault(plan, "rpc:Ping", "b:2")
+        # b -> a: the SAME rule, opposite orientation
+        with faults.calling_from("b:2"):
+            with pytest.raises(ConnectionError):
+                await faults.async_fault(plan, "rpc:Ping", "a:1")
+        # c -> b: not part of the cut
+        with faults.calling_from("c:3"):
+            assert await faults.async_fault(plan, "rpc:Ping", "b:2") is None
+        # anonymous -> b: only a wildcard peer side may match a None
+        # source, and this rule's peer is concrete
+        assert await faults.async_fault(plan, "rpc:Ping", "b:2") is None
+        # wildcard isolation: partition("a:1") cuts a:1 off from everyone,
+        # anonymous callers included
+        plan2 = faults.FaultPlan(seed=2, rules=[faults.partition("a:1")])
+        with pytest.raises(ConnectionError):
+            await faults.async_fault(plan2, "rpc:Ping", "a:1")
+        with faults.calling_from("a:1"):
+            with pytest.raises(ConnectionError):
+                await faults.async_fault(plan2, "rpc:Ping", "anyone:9")
+
+        # windowed like brownout: outside [start, start+duration) the
+        # rule neither fires nor counts
+        plan3 = faults.FaultPlan(
+            seed=3,
+            rules=[faults.partition("a:1", start=10.0, duration=5.0)],
+        )
+        assert await faults.async_fault(plan3, "rpc:Ping", "a:1") is None
+        assert plan3.fired() == 0
+
+    asyncio.run(body())
+    assert plan.fired() == 2  # a->b and b->a; nothing else matched
+
+
+def test_injected_partition_deposes_leader_and_writes_resume(tmp_path):
+    """The raft cluster under the REAL `partition` fault kind (not
+    method monkeypatching): the leader is cut off at the RPC seam in
+    both directions, the majority elects a successor, writes (assigns)
+    resume through it, and clearing the plan heals the cluster."""
+    from seaweedfs_tpu.util import faults
+
+    async def body():
+        cluster = MultiMasterCluster(tmp_path, n_volume_servers=1)
+        try:
+            await cluster.start()
+            old = cluster.leader()
+            from seaweedfs_tpu.pb import grpc_address
+
+            # two rules cover both orientations across the two address
+            # spaces in play: inbound anything -> the leader's gRPC
+            # listener, and outbound anything FROM the leader (raft
+            # broadcasts tag their source with the master address)
+            plan = faults.FaultPlan(
+                seed=0xBEEF,
+                rules=[
+                    faults.partition(grpc_address(old.address)),
+                    faults.partition("*", old.address),
+                ],
+            )
+            faults.install_plan(plan)
+            try:
+                await _wait_for(
+                    lambda: any(
+                        m.raft.is_leader and m is not old
+                        for m in cluster.masters
+                    )
+                    and not old.raft.is_leader,
+                    msg="majority re-election under injected partition",
+                )
+                new = next(
+                    m
+                    for m in cluster.masters
+                    if m.raft.is_leader and m is not old
+                )
+                assert plan.fired("rpc:*") > 0
+                # writes resume through the new leader once the volume
+                # server re-registers
+                await _wait_for(
+                    lambda: len(new.topo.data_nodes()) == cluster.n_vs,
+                    msg="volume server re-registered with new leader",
+                )
+                async with aiohttp.ClientSession() as http:
+                    async with http.get(
+                        f"http://{new.address}/dir/assign"
+                    ) as resp:
+                        assert "fid" in await resp.json()
+            finally:
+                faults.clear_plan()
+
+            # heal: the old leader converges onto the new term
+            new = cluster.leader()
+            await _wait_for(
+                lambda: old.raft.term == new.raft.term
+                and not old.raft.is_leader,
+                msg="healed node follows new leader",
+            )
+            assert sum(1 for m in cluster.masters if m.raft.is_leader) == 1
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_keep_connected_redial_rate_bounded_when_budget_dry(tmp_path):
+    """During a cluster-wide outage the master redial loop must not
+    tight-loop: with the shared retry budget drained, the delay pins at
+    the policy cap, so a ~1.2s outage window sees a bounded number of
+    keep-connected attempts instead of a storm."""
+    from seaweedfs_tpu.client import MasterClient
+    from seaweedfs_tpu.util.backoff import (
+        RetryBudget,
+        configure_retry_budget,
+    )
+    from seaweedfs_tpu.util.metrics import RETRY_COUNTER
+
+    async def body():
+        budget = RetryBudget(ratio=0.1, max_tokens=10.0)
+        for _ in range(6):
+            budget.on_failure()  # below half: retries suppressed
+        configure_retry_budget(budget)
+        key = (("op", "keep_connected"),)
+        before = RETRY_COUNTER._values.get(key, 0)
+        # nothing listens on this address: every connect attempt fails
+        mc = MasterClient("t-redial", [f"127.0.0.1:{free_port_pair()}"])
+        await mc.start()
+        try:
+            await asyncio.sleep(1.2)
+        finally:
+            await mc.stop()
+        attempts = RETRY_COUNTER._values.get(key, 0) - before
+        # first failure backs off at base jitter, every subsequent one at
+        # the 5s cap: a 1.2s window fits at most ~3 attempts. 20+ means
+        # the budget was ignored and the loop is hammering.
+        assert attempts <= 4, f"unbounded redial: {attempts} in 1.2s"
+
+    asyncio.run(body())
+
+
 def test_raft_state_persistence(tmp_path):
     """A restarted node reloads (term, voted_for, max_volume_id): it cannot
     grant a second vote in the same term, and the committed id survives."""

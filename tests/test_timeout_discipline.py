@@ -1,0 +1,134 @@
+"""Tier-1 static scan: every outbound request path carries a deadline.
+
+ISSUE 9 satellite — an unbounded wait against a hung peer is how one
+sick server wedges its callers' queues (timeout-deep queue stacking
+turns a brownout into an outage). Three request layers, three checks:
+
+- **aiohttp**: every `ClientSession(...)` construction in
+  `seaweedfs_tpu/` passes an explicit `timeout=` (the shared
+  `util/http_timeouts.client_timeout` default bounds connect and every
+  read without capping healthy large transfers);
+- **fasthttp / gRPC defaults**: `FastHTTPClient.request` and
+  `Stub.call` default to a bounded per-request timeout —
+  `timeout=None` is an explicit opt-in reserved for streaming shapes;
+- **explicit opt-outs**: any call site passing `timeout=None` to
+  `.request(` / `.call(` / `ClientSession(` must be on the allowlist
+  below with a reason (today: none — `Stub.server_stream` IS the
+  streaming API and carries its own default).
+
+AST-based, so string matches in comments/docstrings cannot false-
+positive and a violation reports file:line.
+"""
+
+import ast
+import inspect
+import os
+
+import pytest
+
+ROOT = os.path.join(os.path.dirname(os.path.dirname(__file__)), "seaweedfs_tpu")
+
+# (relpath, callee) pairs allowed to pass timeout=None explicitly —
+# streaming endpoints whose lifetime is the stream's, with a reason.
+TIMEOUT_NONE_ALLOWLIST: dict = {
+    # e.g. ("pb/rpc.py", "server_stream"): "subscription stream: bounded
+    #       by stream lifetime, not a per-request deadline",
+}
+
+
+def _py_files():
+    for dirpath, dirnames, filenames in os.walk(ROOT):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in filenames:
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def _callee_name(func) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _scan() -> list:
+    violations = []
+    for path in _py_files():
+        rel = os.path.relpath(path, ROOT)
+        with open(path, "r") as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _callee_name(node.func)
+            kw = {k.arg: k.value for k in node.keywords if k.arg}
+            if name == "ClientSession":
+                if "timeout" not in kw:
+                    violations.append(
+                        f"{rel}:{node.lineno}: aiohttp.ClientSession() "
+                        "without timeout= (use "
+                        "util/http_timeouts.client_timeout())"
+                    )
+                    continue
+            if name in ("ClientSession", "call", "request", "server_stream"):
+                tv = kw.get("timeout")
+                if (
+                    isinstance(tv, ast.Constant)
+                    and tv.value is None
+                    and (rel, name) not in TIMEOUT_NONE_ALLOWLIST
+                ):
+                    violations.append(
+                        f"{rel}:{node.lineno}: explicit timeout=None to "
+                        f"{name}() is an unbounded wait — allowlist it in "
+                        "tests/test_timeout_discipline.py with a reason "
+                        "if this is truly a streaming endpoint"
+                    )
+    return violations
+
+
+def test_every_request_call_site_carries_a_deadline():
+    violations = _scan()
+    assert not violations, "\n".join(violations)
+
+
+def test_client_defaults_are_bounded():
+    """The two hot-path clients default to a bounded per-request
+    deadline, so call sites that pass nothing still cannot wait
+    forever; the gRPC streaming API is the one deliberate exception."""
+    from seaweedfs_tpu.pb.rpc import Stub
+    from seaweedfs_tpu.util.fasthttp import FastHTTPClient
+
+    req_default = inspect.signature(FastHTTPClient.request).parameters[
+        "timeout"
+    ].default
+    assert req_default is not None and req_default > 0
+    call_default = inspect.signature(Stub.call).parameters["timeout"].default
+    assert call_default is not None and call_default > 0
+    # server_stream IS the streaming API: its None default is the
+    # explicit opt-in this scan's allowlist documents
+    stream_default = inspect.signature(Stub.server_stream).parameters[
+        "timeout"
+    ].default
+    assert stream_default is None
+
+
+def test_shared_client_timeout_bounds_connect_and_read():
+    pytest.importorskip("aiohttp")
+    from seaweedfs_tpu.util.http_timeouts import client_timeout
+
+    t = client_timeout()
+    assert t.sock_connect and t.sock_connect > 0
+    assert t.sock_read and t.sock_read > 0
+    # no total on purpose: healthy multi-minute transfers must survive
+    assert t.total is None
+
+
+def test_allowlist_entries_are_live():
+    """Every allowlist entry must still correspond to an existing file —
+    dead entries hide future violations at the same spot."""
+    for rel, _callee in TIMEOUT_NONE_ALLOWLIST:
+        assert os.path.exists(os.path.join(ROOT, rel)), (
+            f"stale allowlist entry: {rel}"
+        )

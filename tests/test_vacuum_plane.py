@@ -406,7 +406,11 @@ def test_plane_bucket_explicit_wins(monkeypatch):
     from seaweedfs_tpu.storage import maintenance
 
     explicit = object()
-    assert maintenance.plane_bucket("scrub", explicit) is explicit
+    shaped = maintenance.plane_bucket("scrub", explicit)
+    # the plane's own knob still wins, now wrapped so the explicit
+    # bucket yields under foreground pressure like shared-budget planes
+    assert isinstance(shaped, maintenance._PressureShapedBucket)
+    assert shaped._bucket is explicit and shaped.plane == "scrub"
     maintenance.configure_shared(None)
     monkeypatch.delenv("SEAWEEDFS_TPU_MAINT_MBPS", raising=False)
     assert maintenance.plane_bucket("scrub") is None
