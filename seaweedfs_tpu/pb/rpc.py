@@ -230,6 +230,12 @@ class Stub:
             else:
                 out = await fn(request, timeout=timeout)
         except asyncio.CancelledError:
+            # the caller abandoned the call before an outcome: no verdict
+            # on the peer, but a held half-open probe slot must be
+            # returned or this (possibly single-master) stub's breaker
+            # refuses the peer until the probe lease expires
+            if br is not None:
+                br.record_cancelled()
             raise
         except Exception:
             if br is not None:

@@ -1139,8 +1139,10 @@ async def cmd_overload_status(env, argv) -> str:
     inflight/queued, admitted/shed totals, pressure), open circuit
     breakers, and the shared retry-budget fill. -servers=host:port,...
     adds filer/S3 gateways the master does not know about. In-process
-    clusters share one process: the per-gate `server` key (master/
-    volume/filer/s3) disambiguates, and duplicate gates are de-duped."""
+    clusters share one process: each gate carries a per-process unique
+    `gate` id (server NAMES repeat — three in-process volume servers
+    are all "volume"), so the merge de-dupes repeated reports of one
+    gate without collapsing distinct same-named gates."""
     flags = _parse_flags(argv)
     lines = []
     seen_gates: set = set()
@@ -1158,9 +1160,11 @@ async def cmd_overload_status(env, argv) -> str:
         for g in st.get("gates", []):
             # gates are per-PROCESS (an in-process cluster reports the
             # same list via every port it listens on): (host, pid,
-            # gate-server) identifies one — never counter values, which
-            # would collapse DISTINCT same-shape servers across processes
-            key = (host, st.get("pid"), g.get("server"))
+            # gate-id) identifies one — never the server NAME (three
+            # in-process volume servers are all "volume" and would
+            # collapse) and never counter values (same-shape servers
+            # across processes would collapse)
+            key = (host, st.get("pid"), g.get("gate"), g.get("server"))
             if key in seen_gates:
                 continue  # same in-process gate seen via another server
             seen_gates.add(key)

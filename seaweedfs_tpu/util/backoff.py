@@ -167,17 +167,21 @@ async def retry_async(
     when attempts or deadline run out. Retries count into
     seaweedfs_tpu_retries_total{op=...}.
 
-    `budget` is the shared RetryBudget by default: successes deposit,
-    retryable failures withdraw, and a drained budget SUPPRESSES further
-    retries (the last exception surfaces immediately) so a sick peer
-    costs each caller one attempt, not a storm. Pass budget=None to opt
-    a loop out. `delay_floor` (e.g. a peer's Retry-After hint via
+    `budget` is the shared RetryBudget by default: retryable failures
+    withdraw, and a drained budget SUPPRESSES further retries (the last
+    exception surfaces immediately) so a sick peer costs each caller one
+    attempt, not a storm. Successes deposit ONLY for an explicitly
+    passed budget — the shared one is already fed by the transports
+    (FastHTTPClient.request / GrpcStub.call deposit every completed
+    response), and depositing here too would double the effective
+    retry-to-success ratio. Pass budget=None to opt a loop out. `delay_floor` (e.g. a peer's Retry-After hint via
     FastHTTPClient.retry_after_remaining) raises individual sleeps to at
     least its value — the peer asked for breathing room, jitter must not
     undercut it; the deadline still wins (a retry past it is refused
     either way).
     """
     rng = rng or random
+    deposit = budget is not _SHARED
     if budget is _SHARED:
         budget = shared_retry_budget()
     last: Optional[BaseException] = None
@@ -189,7 +193,7 @@ async def retry_async(
             if budget is not None:
                 budget.on_failure()
         else:
-            if budget is not None:
+            if budget is not None and deposit:
                 budget.on_success()
             return result
         if attempt == policy.attempts - 1:

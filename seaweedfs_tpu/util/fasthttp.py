@@ -1058,6 +1058,7 @@ class FastHTTPClient:
         retried: bool = False,
         timeout: Optional[float] = 30.0,
     ) -> tuple[int, bytes]:
+        t0 = time.monotonic()
         br = self._breaker(hostport)
         if br is not None and not br.allow():
             raise overload.CircuitOpenError(
@@ -1071,6 +1072,13 @@ class FastHTTPClient:
                 ev = await faults.async_fault(
                     plan, f"http:{method}", hostport, timeout=timeout
                 )
+            except asyncio.CancelledError:
+                # abandoned mid-sleep (hedge lost its race): no verdict
+                # on the peer, but a held half-open probe slot must be
+                # returned or the breaker wedges shut
+                if br is not None:
+                    br.record_cancelled()
+                raise
             except Exception:
                 if br is not None:
                     br.record_failure()
@@ -1094,13 +1102,31 @@ class FastHTTPClient:
         # (sampled or not — unsampled contexts still carry promotion
         # flags downstream). The ctx-less path pays one contextvar load.
         ctx = trace._CTX.get()
+        # one logical request spends ONE deadline across all its phases:
+        # the injected-fault wait above, connect, and the response below
+        # are each armed with the REMAINING budget, never a fresh copy
+        # of `timeout` (which would stack to ~3x the stated deadline)
+        left = timeout
+        if timeout is not None:
+            left = max(0.001, timeout - (time.monotonic() - t0))
         try:
-            conn = await self._get(hostport, timeout)
-        except OSError:
-            # connect refused/timed out: the canonical dead-peer signal
-            # (TimeoutError is an OSError since 3.10, so both land here)
+            conn = await self._get(hostport, left)
+        except asyncio.CancelledError:
+            if br is not None:
+                br.record_cancelled()
+            raise
+        except (OSError, asyncio.TimeoutError) as e:
+            # connect refused/timed out: the canonical dead-peer signal.
+            # asyncio.TimeoutError (wait_for's connect deadline) is NOT
+            # the builtin TimeoutError until 3.11, so it needs its own
+            # arm here — and a translation, so callers catching
+            # TimeoutError/OSError see the connect timeout too
             if br is not None:
                 br.record_failure()
+            if not isinstance(e, OSError):
+                raise TimeoutError(
+                    f"connect to {hostport} exceeded {timeout}s deadline"
+                ) from e
             raise
         if (
             not body and not content_type and not headers
@@ -1136,15 +1162,19 @@ class FastHTTPClient:
             fut = conn.begin()
             conn.transport.write(wire)
             if timeout is not None:
+                left = max(0.001, timeout - (time.monotonic() - t0))
                 th = conn._loop.call_later(
-                    timeout, _fire_timeout, conn, timeout
+                    left, _fire_timeout, conn, left
                 )
             status, resp_body, reusable, retry_after = await fut
         except asyncio.CancelledError:
             # a cancelled request (hedged read losing its race) leaves the
             # response half-read on the wire: the connection must die, not
-            # linger open outside the pool
+            # linger open outside the pool — and a held half-open probe
+            # slot must be returned, or the breaker wedges shut
             conn.transport.close()
+            if br is not None:
+                br.record_cancelled()
             raise
         except TimeoutError:
             # deadline fired (TimeoutError is an OSError since 3.10 —
@@ -1159,13 +1189,27 @@ class FastHTTPClient:
                 if br is not None:
                     br.record_failure()
                 raise
-            # stale pooled connection: one clean retry on a fresh one —
-            # and a promotion flag, so the trace that paid the retry is
-            # kept by the tail sampler
+            # stale pooled connection: one clean retry on a fresh one,
+            # against the REMAINING deadline (one logical request never
+            # exceeds its stated budget) — and a promotion flag, so the
+            # trace that paid the retry is kept by the tail sampler
+            if th is not None:
+                th.cancel()
+                th = None
+            if br is not None:
+                # a stale-connection write failure is no verdict on the
+                # peer — but if this request holds the half-open probe
+                # slot, the recursion's allow() would refuse it (and
+                # leak the slot until its lease): hand it back first so
+                # the retry becomes the probe
+                br.record_cancelled()
             trace.flag(trace.FLAG_RETRY)
+            left = timeout
+            if timeout is not None:
+                left = max(0.001, timeout - (time.monotonic() - t0))
             return await self.request(
                 method, hostport, target, body, content_type, headers,
-                retried=True, timeout=timeout,
+                retried=True, timeout=left,
             )
         finally:
             if th is not None:

@@ -57,6 +57,7 @@ import math
 import os
 import time
 from collections import deque
+from itertools import count
 from typing import Optional
 
 from .metrics import (
@@ -238,6 +239,11 @@ class AdmissionGate:
         clock=time.monotonic,
     ):
         self.server = server
+        # per-process unique identity: server NAMES repeat in in-process
+        # clusters (three volume servers are all "volume") — the metric
+        # series and the shell's cluster-wide merge must tell the gates
+        # apart or distinct gates silently collapse into one
+        self.gate_id = str(next(_GATE_SEQ))
         self.limiter = limiter or AdaptiveLimiter()
         if read_budget_s is None:
             read_budget_s = _env_f("SEAWEEDFS_TPU_ADMIT_BUDGET_MS", 50.0) / 1e3
@@ -258,7 +264,9 @@ class AdmissionGate:
         self.last_shed_t = 0.0
         self._depth_gauge = ADMISSION_QUEUE_DEPTH
         self._limit_gauge = ADMISSION_LIMIT
-        self._limit_gauge.set(self.limiter.limit, server=server)
+        self._limit_gauge.set(
+            self.limiter.limit, server=server, gate=self.gate_id
+        )
         # server-side latency of ADMITTED requests (admission wait +
         # service), log-bucketed — the number "admitted-request p99"
         # honestly means: a saturated open-loop *generator's* own client
@@ -287,7 +295,9 @@ class AdmissionGate:
         fut = asyncio.get_event_loop().create_future()
         self._queues[cls].append(fut)
         self.queued += 1
-        self._depth_gauge.set(self.queued, server=self.server)
+        self._depth_gauge.set(
+            self.queued, server=self.server, gate=self.gate_id
+        )
         return fut
 
     async def wait_queued(self, cls: int, fut, waited_s: float = 0.0) -> bool:
@@ -300,7 +310,9 @@ class AdmissionGate:
             # wait_for cancelled the future; _wake skips cancelled
             # entries lazily — only the live count must drop NOW
             self.queued -= 1
-            self._depth_gauge.set(self.queued, server=self.server)
+            self._depth_gauge.set(
+                self.queued, server=self.server, gate=self.gate_id
+            )
             self._shed(cls, "deadline")
             return False
         except asyncio.CancelledError:
@@ -317,7 +329,9 @@ class AdmissionGate:
             else:
                 fut.cancel()
                 self.queued -= 1
-                self._depth_gauge.set(self.queued, server=self.server)
+                self._depth_gauge.set(
+                    self.queued, server=self.server, gate=self.gate_id
+                )
             raise
         return True
 
@@ -341,7 +355,9 @@ class AdmissionGate:
             before = lim.limit
             lim.on_sample(latency_s, self.inflight + 1)
             if lim.limit != before:
-                self._limit_gauge.set(lim.limit, server=self.server)
+                self._limit_gauge.set(
+                    lim.limit, server=self.server, gate=self.gate_id
+                )
         if total_s is not None:
             if total_s < _LAT_BASE:
                 i = 0
@@ -368,7 +384,9 @@ class AdmissionGate:
             if fut is None:
                 return  # only cancelled husks remained
             self.queued -= 1
-            self._depth_gauge.set(self.queued, server=self.server)
+            self._depth_gauge.set(
+                self.queued, server=self.server, gate=self.gate_id
+            )
             self.inflight += 1
             self.admitted_total += 1
             fut.set_result(True)
@@ -382,6 +400,7 @@ class AdmissionGate:
         if child is None:
             child = self._shed_children[key] = OVERLOAD_SHED.child(
                 server=self.server,
+                gate=self.gate_id,
                 reason=reason,
                 **{"class": CLASS_NAMES[cls]},
             )
@@ -400,6 +419,7 @@ class AdmissionGate:
         lim = self.limiter
         return {
             "server": self.server,
+            "gate": self.gate_id,
             "limit": lim.limit,
             "baseline_ms": (
                 round(lim.baseline_s * 1e3, 3)
@@ -428,6 +448,7 @@ class AdmissionGate:
 # ------------------------------------------------- gate registry/pressure --
 
 _GATES: list = []
+_GATE_SEQ = count(1)  # per-process unique gate ids (names repeat)
 
 
 def admission_enabled() -> bool:
@@ -493,7 +514,10 @@ class CircuitBreaker:
     half of the last `shed_window` outcomes were sheds (503 +
     Retry-After: the peer is alive but actively load-shedding — keep
     hammering it and you ARE the overload). Half-open admits one probe
-    after the open window; the probe's outcome closes or re-opens."""
+    after the open window; the probe's outcome closes or re-opens. The
+    probe slot leases for `probe_timeout_s`: a probe whose caller never
+    reports (cancelled mid-flight, caller died) is reclaimed after the
+    lease instead of wedging allow() shut until process restart."""
 
     def __init__(
         self,
@@ -502,12 +526,14 @@ class CircuitBreaker:
         shed_window: int = 20,
         shed_trip: float = 0.5,
         open_s: float = 0.25,
+        probe_timeout_s: float = 5.0,
         clock=time.monotonic,
     ):
         self.peer = peer
         self.fail_threshold = fail_threshold
         self.shed_trip = shed_trip
         self.open_s = open_s
+        self.probe_timeout_s = probe_timeout_s
         self._clock = clock
         self.state = CLOSED
         self.opens = 0  # times tripped
@@ -515,23 +541,30 @@ class CircuitBreaker:
         self._ring: deque = deque(maxlen=shed_window)  # True = shed
         self._open_until = 0.0
         self._probe_out = False
+        self._probe_deadline = 0.0
         self._last_shed_t = 0.0
 
     # -- gate --
     def allow(self) -> bool:
         """May a request go to this peer now? Consumes the half-open
-        probe slot, so callers must report the outcome via record_*."""
+        probe slot, so callers must report the outcome via record_*
+        (record_cancelled when the request is abandoned outcome-less)."""
         if self.state == CLOSED:
             return True
         if self.state == OPEN:
             if self._clock() < self._open_until:
                 return False
             self._transition(HALF_OPEN)
-            self._probe_out = True
-            return True
-        if self._probe_out:
+            return self._lease_probe()
+        if self._probe_out and self._clock() < self._probe_deadline:
             return False  # half-open: one probe at a time
+        # no probe out — or the in-flight probe outlived its lease
+        # without reporting: reclaim the slot rather than refuse forever
+        return self._lease_probe()
+
+    def _lease_probe(self) -> bool:
         self._probe_out = True
+        self._probe_deadline = self._clock() + self.probe_timeout_s
         return True
 
     def blocked(self) -> bool:
@@ -541,7 +574,7 @@ class CircuitBreaker:
             return False
         if self.state == OPEN:
             return self._clock() < self._open_until
-        return self._probe_out
+        return self._probe_out and self._clock() < self._probe_deadline
 
     def shedding(self) -> bool:
         """Is the peer actively load-shedding? True within ~1s of a shed
@@ -567,6 +600,15 @@ class CircuitBreaker:
             self._consec_fail >= self.fail_threshold
         ):
             self._trip(self.open_s)
+
+    def record_cancelled(self) -> None:
+        """The caller abandoned its request before an outcome was known
+        (hedged reads losing their race are cancelled routinely). Says
+        nothing about the peer's health — but if the request held the
+        half-open probe slot it MUST be returned here, or allow()
+        refuses the peer until the probe lease expires."""
+        if self.state == HALF_OPEN:
+            self._probe_out = False
 
     def record_shed(self, retry_after_s: Optional[float] = None) -> None:
         """A 503/429 shed answer (alive peer refusing load). Not a

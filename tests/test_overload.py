@@ -338,6 +338,49 @@ def test_breaker_trips_on_shed_rate_and_honors_retry_after():
     assert br.shedding() is False or True  # shedding() is time-based
 
 
+def test_breaker_cancelled_probe_returns_slot():
+    """A half-open probe abandoned without an outcome (hedged read
+    losing its race) must return the slot via record_cancelled, or the
+    breaker refuses the peer until the probe lease expires."""
+    clk = FakeClock()
+    br = CircuitBreaker("p:5", fail_threshold=2, open_s=0.5, clock=clk)
+    br.record_failure()
+    br.record_failure()
+    clk.advance(0.6)
+    assert br.allow()  # half-open probe out
+    assert not br.allow() and br.blocked()
+    br.record_cancelled()  # caller cancelled: no verdict, slot back
+    assert br.state == "half_open" and not br.blocked()
+    assert br.allow()  # next caller probes immediately
+    br.record_success()
+    assert br.state == "closed"
+    # cancellation outside half-open is a no-op on the state machine
+    br.record_cancelled()
+    assert br.state == "closed" and br.allow()
+
+
+def test_breaker_probe_lease_reclaims_leaked_slot():
+    """Backstop for callers that never report at all: the probe slot
+    leases for probe_timeout_s, after which allow() hands it out again
+    instead of refusing the peer until process restart."""
+    clk = FakeClock()
+    br = CircuitBreaker(
+        "p:6", fail_threshold=2, open_s=0.5, probe_timeout_s=5.0,
+        clock=clk,
+    )
+    br.record_failure()
+    br.record_failure()
+    clk.advance(0.6)
+    assert br.allow()  # probe out, never reported
+    assert not br.allow() and br.blocked()
+    clk.advance(5.1)  # lease expired
+    assert not br.blocked()
+    assert br.allow()  # reclaimed: a fresh probe goes out
+    assert not br.allow()  # and holds its own lease
+    br.record_success()
+    assert br.state == "closed"
+
+
 def test_breaker_shedding_window():
     clk = FakeClock()
     br = CircuitBreaker("p:4", clock=clk)
@@ -443,6 +486,33 @@ def test_retry_async_delay_floor_honors_retry_after(monkeypatch):
     assert len(sleeps) == 2 and all(d >= 0.25 for d in sleeps)
 
 
+def test_retry_async_shared_budget_deposits_exactly_once():
+    """The transports (FastHTTPClient.request / Stub.call) deposit every
+    completed response into the shared budget — retry_async must NOT
+    deposit shared-budget successes too, or the effective retry cap is
+    ~2x the configured ratio. An explicitly passed budget (not fed by
+    any transport) still deposits here."""
+
+    async def ok():
+        return "ok"
+
+    async def main():
+        shared = RetryBudget(ratio=0.1, max_tokens=10.0)
+        shared.tokens = 6.0
+        configure_retry_budget(shared)
+        try:
+            assert await retry_async(ok, op="t-dep") == "ok"
+            assert shared.tokens == 6.0  # no deposit: transports own it
+        finally:
+            configure_retry_budget(None)
+        own = RetryBudget(ratio=0.1, max_tokens=10.0)
+        own.tokens = 6.0
+        assert await retry_async(ok, budget=own, op="t-dep") == "ok"
+        assert own.tokens == pytest.approx(6.1)  # explicit budget deposits
+
+    asyncio.run(main())
+
+
 # ------------------------------------------ fasthttp client seam duties --
 
 
@@ -476,6 +546,239 @@ def test_client_deadline_fires_and_breaker_counts_it(monkeypatch):
             assert time.perf_counter() - t0 < 5.0
             br = overload.BREAKERS.peek(f"127.0.0.1:{port}")
             assert br is not None and br._consec_fail >= 1
+        finally:
+            await http.close()
+            await srv.stop()
+
+    asyncio.run(main())
+
+
+def test_connect_timeout_is_breaker_failure_and_builtin_timeout(
+    monkeypatch,
+):
+    """wait_for's connect deadline raises asyncio.TimeoutError — on 3.10
+    neither an OSError nor the builtin TimeoutError, so it would slip
+    past both the breaker's `except OSError` and callers catching
+    TimeoutError. The client must record the failure (a SYN-dropping
+    peer has to trip eventually) and surface builtin TimeoutError."""
+    monkeypatch.setenv("SEAWEEDFS_TPU_BREAKER", "1")
+    from seaweedfs_tpu.util import fasthttp
+
+    async def main():
+        http = fasthttp.FastHTTPClient()
+
+        async def never_connects(hostport, timeout=None):
+            raise asyncio.TimeoutError()
+
+        http._get = never_connects
+        with pytest.raises(TimeoutError):
+            await http.request("GET", "sinkhole:79", "/x", timeout=0.01)
+        br = overload.BREAKERS.peek("sinkhole:79")
+        assert br is not None and br._consec_fail == 1
+
+    asyncio.run(main())
+
+
+def test_stale_retry_uses_remaining_deadline(monkeypatch):
+    """The one clean retry after a stale pooled connection runs against
+    the REMAINING deadline, not a fresh copy of the original — one
+    logical request never spends ~2x its stated budget."""
+    monkeypatch.setenv("SEAWEEDFS_TPU_BREAKER", "0")
+    from seaweedfs_tpu.util import fasthttp
+
+    class _FakeTransport:
+        def __init__(self):
+            self._closing = False
+
+        def write(self, data):
+            pass
+
+        def close(self):
+            self._closing = True
+
+        def is_closing(self):
+            return self._closing
+
+    class _FakeConn:
+        def __init__(self, loop, fail):
+            self._loop = loop
+            self.closed = False
+            self.transport = _FakeTransport()
+            self._fail = fail
+
+        def begin(self):
+            fut = self._loop.create_future()
+            if self._fail:
+                fut.set_exception(ConnectionResetError("stale"))
+            else:
+                fut.set_result((200, b"ok", False, None))
+            return fut
+
+    seen: list = []
+
+    async def main():
+        http = fasthttp.FastHTTPClient()
+        loop = asyncio.get_running_loop()
+        conns = [_FakeConn(loop, True), _FakeConn(loop, False)]
+
+        async def fake_get(hostport, timeout=None):
+            seen.append(timeout)
+            await asyncio.sleep(0.05)  # measurable spend before failing
+            return conns.pop(0)
+
+        http._get = fake_get
+        assert await http.request(
+            "GET", "x:1", "/k", timeout=2.0
+        ) == (200, b"ok")
+
+    asyncio.run(main())
+    assert len(seen) == 2
+    assert seen[0] is not None and 2.0 - 0.01 <= seen[0] <= 2.0
+    assert seen[1] is not None and seen[1] <= 2.0 - 0.04
+
+
+def test_response_deadline_armed_with_remaining_budget(monkeypatch):
+    """One logical request spends ONE deadline across its phases: after
+    time spent connecting, the response timer is armed with the
+    remaining budget, not a fresh copy of the original timeout."""
+    monkeypatch.setenv("SEAWEEDFS_TPU_BREAKER", "0")
+    from seaweedfs_tpu.util.fasthttp import FastHTTPClient, render_response
+
+    async def handler(req):
+        await asyncio.sleep(30)
+        return render_response(200, b"late")
+
+    async def main():
+        srv = _fast_server(handler)
+        await srv.start("127.0.0.1", 0)
+        port = srv._server.sockets[0].getsockname()[1]
+        http = FastHTTPClient()
+        try:
+            real_get = http._get
+
+            async def slow_connect(hostport, timeout=None):
+                await asyncio.sleep(0.15)  # eats over half the budget
+                return await real_get(hostport, timeout)
+
+            http._get = slow_connect
+            t0 = time.perf_counter()
+            with pytest.raises(OSError):  # deadline, not 2x deadline
+                await http.request(
+                    "GET", f"127.0.0.1:{port}", "/x", timeout=0.25
+                )
+            assert time.perf_counter() - t0 < 0.4  # not 0.15 + 0.25
+        finally:
+            await http.close()
+            await srv.stop()
+
+    asyncio.run(main())
+
+
+def test_stale_retry_returns_half_open_probe_before_recursing(monkeypatch):
+    """The one clean retry after a stale pooled connection re-enters
+    request() and thus allow(): if the first attempt held the half-open
+    probe slot, it must be handed back first — otherwise the retry
+    fast-fails with CircuitOpenError against a now-healthy peer and the
+    slot leaks for the rest of its lease."""
+    monkeypatch.setenv("SEAWEEDFS_TPU_BREAKER", "1")
+    from seaweedfs_tpu.util import fasthttp
+
+    class _FakeTransport:
+        def __init__(self):
+            self._closing = False
+
+        def write(self, data):
+            pass
+
+        def close(self):
+            self._closing = True
+
+        def is_closing(self):
+            return self._closing
+
+    class _FakeConn:
+        def __init__(self, loop, fail):
+            self._loop = loop
+            self.closed = False
+            self.transport = _FakeTransport()
+            self._fail = fail
+
+        def begin(self):
+            fut = self._loop.create_future()
+            if self._fail:
+                fut.set_exception(ConnectionResetError("stale"))
+            else:
+                fut.set_result((200, b"ok", False, None))
+            return fut
+
+    async def main():
+        peer = "probe-retry:1"
+        br = overload.peer_breaker(peer)
+        for _ in range(br.fail_threshold):
+            br.record_failure()
+        assert br.state == "open"
+        await asyncio.sleep(br.open_s + 0.05)
+        http = fasthttp.FastHTTPClient()
+        loop = asyncio.get_running_loop()
+        conns = [_FakeConn(loop, True), _FakeConn(loop, False)]
+
+        async def fake_get(hostport, timeout=None):
+            return conns.pop(0)
+
+        http._get = fake_get
+        # this request IS the half-open probe; its stale-conn retry must
+        # succeed (and close the breaker), not raise CircuitOpenError
+        assert await http.request("GET", peer, "/k") == (200, b"ok")
+        assert br.state == "closed"
+
+    asyncio.run(main())
+
+
+def test_gate_identity_unique_per_process():
+    """Server names repeat in in-process clusters (three volume servers
+    are all 'volume'): every gate carries a per-process unique id in its
+    stats — the shell merge and metric series key on it so distinct
+    same-named gates can no longer collapse into one."""
+    a = overload.AdmissionGate("volume")
+    b = overload.AdmissionGate("volume")
+    assert a.stats()["server"] == b.stats()["server"] == "volume"
+    assert a.stats()["gate"] != b.stats()["gate"]
+
+
+def test_cancelled_inflight_request_returns_half_open_probe(monkeypatch):
+    """A hedged read losing its race is cancelled mid-flight; if it held
+    the half-open probe slot the slot must come back immediately, or
+    every future call to the peer raises CircuitOpenError until the
+    probe lease expires."""
+    monkeypatch.setenv("SEAWEEDFS_TPU_BREAKER", "1")
+    from seaweedfs_tpu.util.fasthttp import FastHTTPClient, render_response
+
+    async def handler(req):
+        await asyncio.sleep(30)
+        return render_response(200, b"late")
+
+    async def main():
+        srv = _fast_server(handler)
+        await srv.start("127.0.0.1", 0)
+        port = srv._server.sockets[0].getsockname()[1]
+        hostport = f"127.0.0.1:{port}"
+        http = FastHTTPClient()
+        try:
+            br = overload.peer_breaker(hostport)
+            for _ in range(br.fail_threshold):
+                with pytest.raises(OSError):
+                    await http.request("GET", hostport, "/x", timeout=0.02)
+            assert br.state == "open"
+            await asyncio.sleep(br.open_s + 0.05)
+            task = asyncio.ensure_future(
+                http.request("GET", hostport, "/x", timeout=30)
+            )
+            await asyncio.sleep(0.1)  # in flight: holds the probe slot
+            assert br.state == "half_open" and br.blocked()
+            task.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await task
+            assert not br.blocked()  # slot returned: peer probe-able now
         finally:
             await http.close()
             await srv.stop()
