@@ -3,12 +3,21 @@
 
 from __future__ import annotations
 
+import json
+import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, Iterable, Optional
 
 from .entry import Attr, Entry, FileChunk, new_directory_entry
 from .filer_store import FilerStore
 from .meta_log import MetaLog
+
+# durable ledger of fids referenced by MORE than one entry (S3
+# UploadPartCopy's chunk-aligned fast path shares source fids with the
+# copied part instead of re-uploading bytes). Stored as a hidden entry in
+# the filer store itself so refcounts survive restarts with the entries
+# they protect.
+FID_REFS_PATH = "/.seaweedfs/fid_refs"
 
 
 class Filer:
@@ -24,9 +33,82 @@ class Filer:
         # meta change log feeding SubscribeMetadata streams + `weed watch`
         # (ref filer.go:38 LocalMetaLogBuffer)
         self.meta_log = MetaLog()
+        self._fid_refs_cache: Optional[dict[str, int]] = None
+        self._fid_refs_lock = threading.Lock()
         root = self.store.find_entry("/")
         if root is None:
             self.store.insert_entry(new_directory_entry("/", 0o775))
+
+    # --- shared-fid refcount ledger (UploadPartCopy chunk referencing) ---
+    def _fid_refs(self) -> dict[str, int]:
+        """EXTRA references per shared fid (a fid listed by K entries has
+        K-1 extra refs); loaded lazily from the durable ledger entry."""
+        if self._fid_refs_cache is None:
+            refs: dict[str, int] = {}
+            e = self.store.find_entry(FID_REFS_PATH)
+            if e is not None:
+                try:
+                    refs = {
+                        k: int(v)
+                        for k, v in json.loads(
+                            e.extended.get("refs", "{}")
+                        ).items()
+                        if int(v) > 0
+                    }
+                except (ValueError, TypeError, AttributeError):
+                    refs = {}
+            self._fid_refs_cache = refs
+        return self._fid_refs_cache
+
+    def _save_fid_refs(self) -> None:
+        refs = {k: v for k, v in self._fid_refs().items() if v > 0}
+        self._fid_refs_cache = refs
+        now = time.time()
+        self._ensure_parents(FID_REFS_PATH)
+        # internal bookkeeping: no meta-log event, no notification
+        self.store.insert_entry(
+            Entry(
+                full_path=FID_REFS_PATH,
+                attr=Attr(mtime=now, crtime=now),
+                extended={"refs": json.dumps(refs)},
+            )
+        )
+
+    def add_fid_refs(self, fids: Iterable[str]) -> None:
+        """Register one EXTRA reference per listed fid — called BEFORE a
+        second entry starts listing a fid it does not own, so a racing
+        delete of the original owner can only decrement, never free."""
+        fids = [f for f in fids if f]
+        if not fids:
+            return
+        with self._fid_refs_lock:
+            refs = self._fid_refs()
+            for fid in fids:
+                refs[fid] = refs.get(fid, 0) + 1
+            self._save_fid_refs()
+
+    def release_fids(self, fids: Iterable[str]) -> None:
+        """The single chunk-release gate: every path that used to hand
+        fids straight to `on_delete_chunks` routes here. A fid with extra
+        references burns one instead of being enqueued for deletion —
+        whichever referencing entry dies LAST actually frees the needle."""
+        fids = sorted({f for f in fids if f})
+        if not fids:
+            return
+        free: list[str] = []
+        with self._fid_refs_lock:
+            refs = self._fid_refs()
+            changed = False
+            for fid in fids:
+                if refs.get(fid, 0) > 0:
+                    refs[fid] -= 1
+                    changed = True
+                else:
+                    free.append(fid)
+            if changed:
+                self._save_fid_refs()
+        if free and self.on_delete_chunks:
+            self.on_delete_chunks(free)
 
     def _notify(
         self,
@@ -88,12 +170,12 @@ class Filer:
         existing = self.store.find_entry(entry.full_path)
         if exclusive and existing is not None:
             raise FileExistsError(entry.full_path)
-        if existing is not None and self.on_delete_chunks and existing.chunks:
+        if existing is not None and existing.chunks:
             old_fids = {c.fid for c in existing.chunks} - {
                 c.fid for c in entry.chunks
             }
             if old_fids:
-                self.on_delete_chunks(sorted(old_fids))
+                self.release_fids(old_fids)
         self.store.insert_entry(entry)
         from ..notification import EVENT_CREATE, EVENT_UPDATE
 
@@ -135,8 +217,8 @@ class Filer:
         else:
             collected.extend(entry.chunks)
         self.store.delete_entry(full_path)
-        if delete_chunks and self.on_delete_chunks and collected:
-            self.on_delete_chunks(sorted({c.fid for c in collected}))
+        if delete_chunks and collected:
+            self.release_fids({c.fid for c in collected})
         from ..notification import EVENT_DELETE
 
         # per-child events so deeper-prefix subscribers see their deletions
@@ -209,12 +291,12 @@ class Filer:
             self.store.delete_folder_children(old_path)
         # an overwritten destination FILE must free its chunks (mirror of
         # create_entry's replace path)
-        if dest is not None and self.on_delete_chunks and dest.chunks:
+        if dest is not None and dest.chunks:
             old_fids = {c.fid for c in dest.chunks} - {
                 c.fid for c in entry.chunks
             }
             if old_fids:
-                self.on_delete_chunks(sorted(old_fids))
+                self.release_fids(old_fids)
         entry_new = Entry(
             full_path=new_path,
             attr=entry.attr,

@@ -149,6 +149,39 @@ class SqliteFilerStore:
             ).fetchall()
         return [Entry.from_dict(json.loads(r[0])) for r in rows]
 
+    def scan_directory_entries(
+        self,
+        dir_path: str,
+        start_file_name: str,
+        inclusive: bool,
+        limit: int,
+        upper_bound: str = "",
+    ) -> list[Entry]:
+        """list_directory_entries with the scan's UPPER bound pushed into
+        the indexed range predicate (PR 7 follow-up): a prefix-bounded
+        LIST page over this store pulls only rows inside
+        [start, upper_bound), never a full generic page it then discards
+        — scanned-rows-per-page matches the in-memory stores'
+        O(max-keys) bound. The (directory, name) primary key makes both
+        bounds one index range."""
+        if not upper_bound:
+            return self.list_directory_entries(
+                dir_path, start_file_name, inclusive, limit
+            )
+        op = ">=" if inclusive else ">"
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT meta FROM filemeta WHERE directory=? AND name {op} ?"
+                " AND name < ? ORDER BY name LIMIT ?",
+                (
+                    dir_path.rstrip("/") or "/",
+                    start_file_name,
+                    upper_bound,
+                    limit,
+                ),
+            ).fetchall()
+        return [Entry.from_dict(json.loads(r[0])) for r in rows]
+
 
 # ------------- S3-key-order subtree range scan (ISSUE 7 LIST path) -------------
 #
@@ -183,18 +216,31 @@ class ScanStats:
         self.scanned = 0
 
 
-def _iter_dir_entries(store, dir_path: str, floor: str, stats, page: int):
+def _iter_dir_entries(
+    store, dir_path: str, floor: str, stats, page: int, upper: str = ""
+):
     """Entries of one directory in name order starting at `floor`
     (inclusive), streamed in `page`-sized rounds through the store's
     bounded range scan (`list_directory_entries` resumes AT the cursor
     on every store family, so each round costs O(page) regardless of
     directory size — the LSM store additionally range-filters its
-    memtable source before sorting). Every PULLED entry counts into
-    `stats`, whether or not the consumer keeps it: the disclosed
-    scanned-entries number is store work done, not results returned."""
+    memtable source before sorting). When the caller knows the scan's
+    UPPER bound (a prefix's successor) and the store can push it into
+    its query (`scan_directory_entries`, the sqlite store's indexed
+    range predicate), the final page pulls only in-range rows instead
+    of a generic page the consumer would discard. Every PULLED entry
+    counts into `stats`, whether or not the consumer keeps it: the
+    disclosed scanned-entries number is store work done, not results
+    returned."""
+    scan = getattr(store, "scan_directory_entries", None) if upper else None
     cursor, inclusive = floor, True
     while True:
-        batch = store.list_directory_entries(dir_path, cursor, inclusive, page)
+        if scan is not None:
+            batch = scan(dir_path, cursor, inclusive, page, upper)
+        else:
+            batch = store.list_directory_entries(
+                dir_path, cursor, inclusive, page
+            )
         if stats is not None:
             stats.scanned += len(batch)
         for e in batch:
@@ -298,7 +344,7 @@ def _scan_dir(store, dir_path, rel, start_at, prefix, stats, page, descend):
                 return
             yield (rel + name, e)
 
-    it = _iter_dir_entries(store, dir_path, floor, stats, page)
+    it = _iter_dir_entries(store, dir_path, floor, stats, page, upper=stop_at)
     heap: list = []
     seq = 0
     last = ""

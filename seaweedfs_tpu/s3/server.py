@@ -836,6 +836,71 @@ class S3Server:
             offset += piece_len
         return chunks, md5.hexdigest()
 
+    async def _copy_part_chunks(self, entry, start: int, length: int):
+        """UploadPartCopy chunk path (PR 7 follow-up): whole source chunks
+        fully covered by the copy range are REFERENCED — the part's
+        manifest lists the existing fid and the filer's shared-fid ledger
+        gains a reference, so whichever entry dies last frees the needle —
+        and only the unaligned head/tail edges are read and re-uploaded
+        through the byte path. A copy of a chunk-aligned range moves
+        metadata, not object bytes.
+
+        The part ETag on this path is a composite (md5 over the
+        referenced chunks' etags + the re-uploaded edges' bytes), the
+        same construction CompleteMultipartUpload already uses for the
+        object ETag — S3 multipart ETags are opaque composites anyway.
+        -> (chunks, etag_hex)."""
+        import hashlib
+
+        from ..filer import FileChunk
+
+        visibles = non_overlapping_visible_intervals(entry.chunks)
+        by_fid = {c.fid: c for c in entry.chunks}
+        rng_stop = start + length
+        md5 = hashlib.md5()
+        chunks: list[FileChunk] = []
+        shared: list[str] = []
+        edges: list[tuple[int, int]] = []  # file-absolute [lo, hi) spans
+        for iv in visibles:
+            lo, hi = max(iv.start, start), min(iv.stop, rng_stop)
+            if lo >= hi:
+                continue
+            c = by_fid.get(iv.fid)
+            whole_chunk_visible = (
+                c is not None
+                and iv.start == c.offset
+                and iv.stop == c.offset + c.size
+            )
+            if whole_chunk_visible and lo == iv.start and hi == iv.stop:
+                chunks.append(
+                    FileChunk(
+                        fid=c.fid,
+                        offset=lo - start,
+                        size=c.size,
+                        mtime_ns=c.mtime_ns,
+                        etag=c.etag,
+                        cipher_key=c.cipher_key,
+                    )
+                )
+                shared.append(c.fid)
+                md5.update(("ref:%s:%d;" % (c.etag or c.fid, c.size)).encode())
+            else:
+                edges.append((lo, hi))
+        if not shared:
+            # nothing aligns: the plain byte path (single md5 over bytes)
+            return await self._copy_chunks(entry, start, length)
+        # the referenced fids must be protected BEFORE the part manifest
+        # exists — a racing delete of the source can then only decrement
+        self.filer.add_fid_refs(shared)
+        for lo, hi in edges:
+            piece = await self._read_span(visibles, lo, hi - lo)
+            md5.update(piece)
+            chunks.extend(
+                await self.fs._write_chunks(piece, base_offset=lo - start)
+            )
+        chunks.sort(key=lambda c: c.offset)
+        return chunks, md5.hexdigest()
+
     async def _copy_object(
         self, request: web.Request, bucket: str, key: str
     ) -> web.Response:
@@ -1050,12 +1115,27 @@ class S3Server:
                 if start > end or end >= size:
                     return _error("InvalidRange", rng, 400)
                 length = end - start + 1
-            chunks, etag = await self._copy_chunks(src_entry, start, length)
-            entry = self.filer.touch(
-                f"{self._upload_dir(upload_id)}/{part_number:05d}.part",
-                "",
-                chunks,
+            chunks, etag = await self._copy_part_chunks(
+                src_entry, start, length
             )
+            part_path = (
+                f"{self._upload_dir(upload_id)}/{part_number:05d}.part"
+            )
+            # a RETRIED/overwritten copy part re-registered refs for fids
+            # the previous part entry already holds; the replace below
+            # keeps those fids (old − new = ∅, nothing released), so the
+            # duplicate refs must be burned here or they back no entry
+            # and the needles leak forever. Only referenced fids can
+            # overlap (byte-path chunks are freshly leased).
+            prev = self.filer.find_entry(part_path)
+            dup = (
+                {c.fid for c in prev.chunks} & {c.fid for c in chunks}
+                if prev is not None and prev.chunks
+                else set()
+            )
+            entry = self.filer.touch(part_path, "", chunks)
+            if dup:
+                self.filer.release_fids(dup)
             entry.extended["etag"] = etag
             self.filer.update_entry(entry)
             root = ET.Element("CopyPartResult")

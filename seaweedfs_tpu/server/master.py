@@ -41,8 +41,15 @@ from ..topology.repair import (
 )
 from ..topology.volume_growth import NoFreeSpaceError, grow_count_for_copy_level
 from ..topology.vacuum_plan import plan_vacuums
+from ..topology.lifecycle import (
+    LifecycleConfig,
+    plan_ec_conversions,
+    plan_reinflations,
+)
 from ..util.metrics import (
     ANTIENTROPY_DIVERGED,
+    LIFECYCLE_CONVERSIONS,
+    LIFECYCLE_QUEUE_DEPTH,
     REPAIR_SECONDS,
     VACUUM_QUEUE_DEPTH,
 )
@@ -71,6 +78,10 @@ class MasterServer:
         repair_concurrency: int = 2,
         auto_vacuum: Optional[bool] = None,
         vacuum_concurrency: int = 2,
+        auto_lifecycle: Optional[bool] = None,
+        lifecycle_concurrency: int = 1,
+        lifecycle_config: Optional[LifecycleConfig] = None,
+        lifecycle_ec_shards: str = "",
     ):
         self.jwt_signing_key = jwt_signing_key
         self.jwt_expires_seconds = jwt_expires_seconds
@@ -141,6 +152,38 @@ class MasterServer:
         self.vacuum_log: list[dict] = []
         self._vacuum_task: Optional[asyncio.Task] = None
         self._vacuum_inflight: set[int] = set()
+        # lifecycle plane (ISSUE 10): access heat rides heartbeats the way
+        # garbage ratios do; cold+full volumes auto-EC into the warm tier,
+        # hot EC volumes re-inflate — the Haystack→f4 arc as a background
+        # scheduler in the vacuum/repair shape. Background loop opt-in
+        # (SEAWEEDFS_TPU_AUTO_LIFECYCLE / auto_lifecycle=True);
+        # run_lifecycle_once() is always callable (shell, tests, bench).
+        if auto_lifecycle is None:
+            auto_lifecycle = os.environ.get(
+                "SEAWEEDFS_TPU_AUTO_LIFECYCLE", ""
+            ).lower() in ("1", "true", "on", "yes")
+        self.auto_lifecycle = auto_lifecycle
+        self.lifecycle_concurrency = lifecycle_concurrency
+        self.lifecycle_config = lifecycle_config or LifecycleConfig.from_env()
+        # conversion RS geometry "k.m" ("" = the volume servers' default)
+        lifecycle_ec_shards = lifecycle_ec_shards or os.environ.get(
+            "SEAWEEDFS_TPU_LIFECYCLE_SHARDS", ""
+        )
+        self.lifecycle_data_shards = self.lifecycle_parity_shards = 0
+        if lifecycle_ec_shards:
+            try:
+                k, _, m = lifecycle_ec_shards.partition(".")
+                if int(k) >= 1 and int(m) >= 1:
+                    self.lifecycle_data_shards = int(k)
+                    self.lifecycle_parity_shards = int(m)
+            except ValueError:
+                pass
+        self.lifecycle_queue = RepairQueue(
+            rng=random.Random(), depth_gauge=LIFECYCLE_QUEUE_DEPTH
+        )
+        self.lifecycle_log: list[dict] = []
+        self._lifecycle_task: Optional[asyncio.Task] = None
+        self._lifecycle_inflight: set[int] = set()
         self._clients: dict[str, asyncio.Queue] = {}
         self._option_cache: dict[tuple, GrowOption] = {}
         self._admin_token: Optional[tuple[int, float]] = None  # (token, ts)
@@ -207,6 +250,7 @@ class MasterServer:
         svc.unary("GetMasterConfiguration")(self._grpc_get_configuration)
         svc.unary("RepairStatus")(self._grpc_repair_status)
         svc.unary("VacuumStatus")(self._grpc_vacuum_status)
+        svc.unary("LifecycleStatus")(self._grpc_lifecycle_status)
         svc.unary("RaftRequestVote")(self._grpc_raft_request_vote)
         svc.unary("RaftAppendEntries")(self._grpc_raft_append_entries)
         self._grpc_server = await serve(grpc_address(self.address), svc)
@@ -219,6 +263,10 @@ class MasterServer:
             self._repair_task = asyncio.ensure_future(self._anti_entropy_loop())
         if self.auto_vacuum:
             self._vacuum_task = asyncio.ensure_future(self._auto_vacuum_loop())
+        if self.auto_lifecycle:
+            self._lifecycle_task = asyncio.ensure_future(
+                self._auto_lifecycle_loop()
+            )
 
     async def _maintenance_loop(self) -> None:
         """Leader-only periodic admin scripts (ref: master_server.go:191-246
@@ -263,6 +311,12 @@ class MasterServer:
             self._vacuum_task.cancel()
             try:
                 await self._vacuum_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._lifecycle_task is not None:
+            self._lifecycle_task.cancel()
+            try:
+                await self._lifecycle_task
             except (asyncio.CancelledError, Exception):
                 pass
         if self._maintenance_task is not None:
@@ -672,6 +726,11 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
                         new_vids.append(int(info["id"]))
 
                 if hb.get("ec_shards") is not None or hb.get("has_no_ec_shards"):
+                    # full EC state doubles as a heat snapshot (lifecycle)
+                    dn.ec_heat = {
+                        int(m["id"]): float(m.get("read_heat", 0.0))
+                        for m in hb.get("ec_shards") or []
+                    }
                     new_ec, deleted_ec = dn.update_ec_shards(
                         hb.get("ec_shards") or []
                     )
@@ -680,6 +739,7 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
                         new_vids.append(vid)
                     for vid, collection, bits in deleted_ec:
                         self.topo.unregister_ec_shards(vid, collection, bits, dn)
+                        self.topo.forget_ec_volume_if_empty(vid)
                 if hb.get("new_ec_shards"):
                     for m in hb["new_ec_shards"]:
                         bits = ShardBits(int(m["ec_index_bits"]))
@@ -699,6 +759,10 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
                         self.topo.unregister_ec_shards(
                             int(m["id"]), m.get("collection", ""), bits, dn
                         )
+                        # explicit delete delta: a fully-emptied EC volume
+                        # is genuinely retired (decode/lifecycle), not a
+                        # silent node — drop the registration
+                        self.topo.forget_ec_volume_if_empty(int(m["id"]))
                         if not dn.ec_shards.get(int(m["id"])):
                             deleted_vids.append(int(m["id"]))
 
@@ -716,9 +780,21 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
                             "read_only",
                             "scrub_corrupt",
                             "garbage_ratio",
+                            "read_heat",
+                            "write_heat",
+                            "size",
                         ):
                             if k in m:
                                 info[k] = m[k]
+
+                if hb.get("ec_heat") is not None:
+                    # lifecycle tick: full snapshot of this node's EC read
+                    # heat (an empty list clears it — the node holds no EC
+                    # volumes any more)
+                    dn.ec_heat = {
+                        int(m["id"]): float(m.get("read_heat", 0.0))
+                        for m in hb["ec_heat"]
+                    }
 
                 if new_vids or deleted_vids:
                     self._broadcast_location(
@@ -1381,9 +1457,14 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
         An in-flight set spans all three dispatch paths (auto loop,
         /vol/vacuum, -run) so one master never double-dispatches a
         volume; the volume server's own is_compacting gate covers the
-        rest (a refused compact/cleanup errors into backoff here)."""
+        rest (a refused compact/cleanup errors into backoff here).
+        Mutual exclusion with the lifecycle plane is TWO-way: a volume
+        mid-conversion must not be compacted (the compaction's
+        os.replace of the .dat under a running EC encode would bake a
+        mixed-generation shard set), just as the lifecycle dispatcher
+        skips volumes mid-vacuum."""
         inflight = self._vacuum_inflight
-        if t.vid in inflight:
+        if t.vid in inflight or t.vid in self._lifecycle_inflight:
             results.append(
                 {**t.to_info(), "skipped": "already dispatching"}
             )
@@ -1509,6 +1590,414 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
             "queue_depth": self.vacuum_queue.depth(),
             "queue": self.vacuum_queue.snapshot(),
             "recent": self.vacuum_log[-10:],
+            **({"ran": ran} if ran is not None else {}),
+        }
+
+    # ---------------- lifecycle scheduler (ISSUE 10: the hot→warm plane in
+    # the vacuum/repair shape — heartbeat-ranked queues, authoritative
+    # per-dispatch re-check, concurrency cap, full-jitter backoff, opt-in
+    # background loop; see docs/perf.md "Lifecycle plane") ----------------
+    async def _auto_lifecycle_loop(self) -> None:
+        """Leader-only background lifecycle: rank candidates off heartbeat
+        heat every few pulses, dispatch under the cap."""
+        interval = max(self.pulse_seconds * 4, 2.0)
+        while not self._shutdown:
+            try:
+                await asyncio.sleep(interval)
+                if not self.is_leader or self._shutdown:
+                    continue
+                await self.run_lifecycle_once()
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                continue  # scheduler errors must never kill the master
+
+    async def run_lifecycle_once(
+        self,
+        max_dispatch: Optional[int] = None,
+        include_all: bool = False,
+    ) -> dict:
+        """One scan+dispatch round: cold+full healthy volumes queue for
+        auto-EC (coldest first), hot EC volumes queue for re-inflation
+        (hottest first); up to the concurrency cap dispatch concurrently,
+        each behind an authoritative VolumeLifecycleCheck so a volume
+        that reheated (or got quarantined) since its heartbeat sample is
+        SKIPPED, never converted. Failures back off with full jitter.
+        include_all waives the cold/full planner gates (forced sweeps) —
+        the dispatcher's heat re-check still applies, and the quarantine
+        gate is never waived."""
+        if not self.is_leader:
+            return {"error": "not leader"}
+        cfg = self.lifecycle_config
+        live = {
+            dn.url
+            for dn in self.topo.live_data_nodes(self.repair_grace_seconds)
+        }
+        states = self.topo.replica_states(live)
+        tasks = plan_ec_conversions(
+            states, self.topo.volume_size_limit, cfg, include_all=include_all
+        )
+        tasks += plan_reinflations(self.topo.ec_heat_states(live), cfg)
+        valid_keys = set()
+        for t in tasks:
+            valid_keys.add(t.key)
+            self.lifecycle_queue.offer(t)
+        # a task mid-retry survives scans whose plan wouldn't re-justify
+        # it (heat drifts between pulses); the promised retry must happen
+        self.lifecycle_queue.prune(
+            valid_keys | self.lifecycle_queue.retry_keys()
+        )
+        ready = self.lifecycle_queue.pop_ready(
+            time.monotonic(), max_dispatch or self.lifecycle_concurrency
+        )
+        results: list[dict] = []
+        from ..util import trace
+
+        cm = (
+            trace.span_root(
+                "lifecycle.round", plane="lifecycle", tasks=len(ready)
+            )
+            if ready
+            else trace.NULL_SPAN
+        )
+        with cm:
+            await asyncio.gather(
+                *(self._dispatch_lifecycle_task(t, results) for t in ready)
+            )
+        self.lifecycle_log = (self.lifecycle_log + results)[-50:]
+        return {
+            "dispatched": results,
+            "queue_depth": self.lifecycle_queue.depth(),
+            "thresholds": {
+                "cold_read_heat": cfg.cold_read_heat,
+                "cold_write_heat": cfg.cold_write_heat,
+                "hot_read_heat": cfg.hot_read_heat,
+                "full_fraction": cfg.full_fraction,
+            },
+        }
+
+    async def _dispatch_lifecycle_task(self, t, results: list) -> None:
+        """One queued conversion, guarded by the in-flight sets: a volume
+        being vacuumed or already converting is skipped (dropped — the
+        next scan re-discovers it if still justified)."""
+        if t.vid in self._lifecycle_inflight or t.vid in self._vacuum_inflight:
+            results.append({**t.to_info(), "skipped": "already dispatching"})
+            return
+        self._lifecycle_inflight.add(t.vid)
+        direction = "ec" if t.kind == "lifecycle_ec" else "inflate"
+        t0 = time.perf_counter()
+        try:
+            if t.kind == "lifecycle_ec":
+                outcome = await self._dispatch_lifecycle_convert(t)
+            else:
+                outcome = await self._dispatch_lifecycle_inflate(t)
+        except Exception as e:
+            LIFECYCLE_CONVERSIONS.inc(direction=direction, result="error")
+            REPAIR_SECONDS.observe(
+                time.perf_counter() - t0, kind=t.kind, result="error"
+            )
+            self.lifecycle_queue.reschedule_failure(t, time.monotonic())
+            results.append({**t.to_info(), "error": str(e)})
+            return
+        finally:
+            self._lifecycle_inflight.discard(t.vid)
+        dt = time.perf_counter() - t0
+        if "skipped" in outcome:
+            LIFECYCLE_CONVERSIONS.inc(direction=direction, result="skipped")
+            REPAIR_SECONDS.observe(dt, kind=t.kind, result="skipped")
+        else:
+            LIFECYCLE_CONVERSIONS.inc(direction=direction, result="ok")
+            REPAIR_SECONDS.observe(dt, kind=t.kind, result="ok")
+        results.append({**t.to_info(), **outcome})
+
+    def _lifecycle_gen_geometry(self) -> dict:
+        if self.lifecycle_data_shards:
+            return {
+                "data_shards": self.lifecycle_data_shards,
+                "parity_shards": self.lifecycle_parity_shards,
+            }
+        return {}
+
+    async def _dispatch_lifecycle_convert(self, t) -> dict:
+        """hot→warm: authoritative re-check -> seal -> encode on one
+        holder -> spread+mount shards (balanced across live nodes) ->
+        retire the source volume everywhere. All conversion I/O is tagged
+        plane="lifecycle", so it draws from the shared MaintenanceBudget
+        and yields under overload pressure."""
+        nodes = self.topo.lookup(t.collection, t.vid)
+        if not nodes:
+            # already converted (the unregister delta is a pulse behind) or
+            # deleted: drop the task — error/backoff would retry forever
+            return {"skipped": "no longer registered"}
+        live = {
+            dn.url
+            for dn in self.topo.live_data_nodes(self.repair_grace_seconds)
+        }
+        urls = sorted({dn.url for dn in nodes if dn.url in live})
+        if not urls:
+            raise LookupError(f"volume {t.vid}: no live holders")
+        cfg = self.lifecycle_config
+
+        checks = {}
+        for u in urls:
+            r = await Stub(grpc_address(u), "volume").call(
+                "VolumeLifecycleCheck", {"volume_id": t.vid}, timeout=30
+            )
+            if r.get("error"):
+                if "not found" in r["error"]:
+                    return {"skipped": f"gone on {u}"}
+                raise IOError(f"lifecycle check on {u}: {r['error']}")
+            if r.get("kind") != "volume":
+                return {"skipped": "already erasure-coded"}
+            checks[u] = r
+        if any(c.get("scrub_corrupt") for c in checks.values()):
+            return {"skipped": "quarantined"}  # never convert damage
+        if any(c.get("is_compacting") for c in checks.values()):
+            return {"skipped": "compacting"}
+        total_heat = sum(
+            float(c.get("read_heat", 0.0)) + float(c.get("write_heat", 0.0))
+            for c in checks.values()
+        )
+        if total_heat > cfg.cold_read_heat + cfg.cold_write_heat:
+            return {"skipped": f"actively hot ({total_heat:.2f})"}
+
+        # seal every replica so no write can land mid-encode; remember
+        # which were writable so a failed conversion can roll that back
+        was_writable = [u for u in urls if not checks[u].get("read_only")]
+        source = max(urls, key=lambda u: int(checks[u].get("size", 0)))
+        sealed = []
+        try:
+            for u in urls:
+                r = await Stub(grpc_address(u), "volume").call(
+                    "VolumeMarkReadonly", {"volume_id": t.vid}
+                )
+                if r.get("error"):
+                    raise IOError(f"seal on {u}: {r['error']}")
+                if u in was_writable:
+                    sealed.append(u)
+            gen_req = {
+                "volume_id": t.vid,
+                "collection": t.collection,
+                "plane": "lifecycle",
+                **self._lifecycle_gen_geometry(),
+            }
+            r = await Stub(grpc_address(source), "volume").call(
+                "VolumeEcShardsGenerate", gen_req, timeout=3600
+            )
+            if r.get("error"):
+                raise IOError(f"generate on {source}: {r['error']}")
+        except Exception:
+            # rollback the seal: a transient failure must not leave the
+            # volume read-only forever (retry re-seals)
+            for u in sealed:
+                try:
+                    await Stub(grpc_address(u), "volume").call(
+                        "VolumeMarkWritable", {"volume_id": t.vid}
+                    )
+                except Exception:
+                    pass
+            raise
+
+        # spread + mount (balanced, like shell ec.encode); from here the
+        # shards exist — failures go to backoff WITHOUT unsealing
+        from ..shell.ec_common import EcNode, plan_balanced_spread
+        from ..storage.erasure_coding import TOTAL_SHARDS_COUNT
+
+        total = (
+            self.lifecycle_data_shards + self.lifecycle_parity_shards
+        ) or TOTAL_SHARDS_COUNT
+        ec_nodes = [
+            EcNode(
+                url=dn.url,
+                free_slots=max(dn.free_space(), 0) * TOTAL_SHARDS_COUNT,
+                shards={
+                    vid: bits for vid, bits in dn.ec_shards.items()
+                },
+            )
+            for dn in self.topo.live_data_nodes(self.repair_grace_seconds)
+        ]
+        assignment = plan_balanced_spread(
+            ec_nodes, t.vid, list(range(total)), source
+        )
+        for target, shard_ids in assignment.items():
+            tstub = Stub(grpc_address(target), "volume")
+            if target != source:
+                r = await tstub.call(
+                    "VolumeEcShardsCopy",
+                    {
+                        "volume_id": t.vid,
+                        "collection": t.collection,
+                        "shard_ids": shard_ids,
+                        "copy_ecx_file": True,
+                        "source_data_node": source,
+                        "plane": "lifecycle",
+                    },
+                    timeout=3600,
+                )
+                if r.get("error"):
+                    raise IOError(f"copy to {target}: {r['error']}")
+            r = await tstub.call(
+                "VolumeEcShardsMount",
+                {
+                    "volume_id": t.vid,
+                    "collection": t.collection,
+                    "shard_ids": shard_ids,
+                },
+            )
+            if r.get("error"):
+                raise IOError(f"mount on {target}: {r['error']}")
+
+        # retire the normal volume on every replica holder: delete WHILE
+        # mounted so the .dat/.idx are genuinely destroyed (an unmount
+        # first would no-op the delete and leave a stale .dat a later
+        # mount scan could resurrect as a writable duplicate); the source
+        # keeps its .vif/.heat sidecars for the EC volume at the same base
+        for u in urls:
+            await Stub(grpc_address(u), "volume").call(
+                "VolumeDelete",
+                {"volume_id": t.vid, "keep_ec_files": u == source},
+            )
+        own = assignment.get(source, [])
+        await Stub(grpc_address(source), "volume").call(
+            "VolumeEcShardsDelete",
+            {
+                "volume_id": t.vid,
+                "collection": t.collection,
+                "shard_ids": [i for i in range(total) if i not in own],
+            },
+        )
+        return {
+            "converted": "ec",
+            "source": source,
+            "spread": {u: s for u, s in assignment.items()},
+        }
+
+    async def _dispatch_lifecycle_inflate(self, t) -> dict:
+        """warm→hot: authoritative heat re-check across shard holders ->
+        collect shards on the best-provisioned holder -> decode back to a
+        normal .dat/.idx volume -> retire the shards -> re-mount (heat
+        seeded with the observed EC heat, so hysteresis survives the
+        conversion)."""
+        locs = self.topo.lookup_ec_shards(t.vid)
+        if locs is None:
+            return {"skipped": "no longer registered"}
+        live = {
+            dn.url
+            for dn in self.topo.live_data_nodes(self.repair_grace_seconds)
+        }
+        by_url: dict[str, set[int]] = {}
+        for sid in range(max(locs.expected_total, 1)):
+            for dn in locs.locations[sid]:
+                if dn.url in live:
+                    by_url.setdefault(dn.url, set()).add(sid)
+        if not by_url:
+            raise LookupError(f"ec volume {t.vid}: no live holders")
+        holders = sorted(by_url)
+        cfg = self.lifecycle_config
+
+        total_heat = 0.0
+        for u in holders:
+            r = await Stub(grpc_address(u), "volume").call(
+                "VolumeLifecycleCheck", {"volume_id": t.vid}, timeout=30
+            )
+            if not r.get("error") and r.get("kind") == "ec":
+                total_heat += float(r.get("read_heat", 0.0))
+        if total_heat < cfg.hot_read_heat:
+            return {"skipped": f"cooled ({total_heat:.2f})"}
+
+        k, m = await self._master_ec_geometry(t.vid, t.collection, holders)
+        target = max(holders, key=lambda u: len(by_url[u]))
+        tstub = Stub(grpc_address(target), "volume")
+        have = set(by_url[target])
+        for u in holders:
+            if u == target:
+                continue
+            pull = sorted(by_url[u] - have)
+            if not pull:
+                continue
+            r = await tstub.call(
+                "VolumeEcShardsCopy",
+                {
+                    "volume_id": t.vid,
+                    "collection": t.collection,
+                    "shard_ids": pull,
+                    "copy_ecx_file": False,
+                    "source_data_node": u,
+                    "plane": "lifecycle",
+                },
+                timeout=3600,
+            )
+            if r.get("error"):
+                raise IOError(f"collect shards from {u}: {r['error']}")
+            have.update(pull)
+        if len([s for s in have if s < k]) < k:
+            # some data shard exists nowhere: rebuild it from parity
+            r = await tstub.call(
+                "VolumeEcShardsRebuild",
+                {"volume_id": t.vid, "collection": t.collection},
+                timeout=3600,
+            )
+            if r.get("error"):
+                raise IOError(f"rebuild for decode: {r['error']}")
+        r = await tstub.call(
+            "VolumeEcShardsToVolume",
+            {
+                "volume_id": t.vid,
+                "collection": t.collection,
+                "plane": "lifecycle",
+            },
+            timeout=3600,
+        )
+        if r.get("error"):
+            raise IOError(f"decode on {target}: {r['error']}")
+        # retire the shards everywhere, then bring the volume online
+        for u in holders:
+            ustub = Stub(grpc_address(u), "volume")
+            await ustub.call(
+                "VolumeEcShardsUnmount",
+                {"volume_id": t.vid, "shard_ids": sorted(by_url[u])},
+            )
+            await ustub.call(
+                "VolumeEcShardsDelete",
+                {
+                    "volume_id": t.vid,
+                    "collection": t.collection,
+                    "shard_ids": list(range(k + m)),
+                },
+            )
+        r = await tstub.call(
+            "VolumeMount",
+            {"volume_id": t.vid, "seed_read_heat": round(total_heat, 4)},
+        )
+        if r.get("error"):
+            raise IOError(f"mount on {target}: {r['error']}")
+        return {"converted": "volume", "target": target}
+
+    async def _grpc_lifecycle_status(self, req, context) -> dict:
+        """Lifecycle-plane introspection for `volume.lifecycle -status`
+        (+ `-run` to force a scan/dispatch round), mirroring
+        VacuumStatus/RepairStatus."""
+        proxied = await self._proxy_to_leader("LifecycleStatus", req)
+        if proxied is not None:
+            return proxied
+        ran = None
+        if req.get("run"):
+            ran = await self.run_lifecycle_once(
+                max_dispatch=int(req.get("max_dispatch", 0) or 0) or None,
+                include_all=bool(req.get("include_all")),
+            )
+        cfg = self.lifecycle_config
+        return {
+            "auto_lifecycle": self.auto_lifecycle,
+            "thresholds": {
+                "cold_read_heat": cfg.cold_read_heat,
+                "cold_write_heat": cfg.cold_write_heat,
+                "hot_read_heat": cfg.hot_read_heat,
+                "full_fraction": cfg.full_fraction,
+            },
+            "queue_depth": self.lifecycle_queue.depth(),
+            "queue": self.lifecycle_queue.snapshot(),
+            "recent": self.lifecycle_log[-10:],
             **({"ran": ran} if ran is not None else {}),
         }
 

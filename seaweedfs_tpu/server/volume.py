@@ -413,6 +413,8 @@ class VolumeServer(EcHandlers):
         svc.unary("VolumeUnmount")(self._grpc_volume_unmount)
         svc.unary("VolumeDelete")(self._grpc_volume_delete)
         svc.unary("VolumeMarkReadonly")(self._grpc_volume_mark_readonly)
+        svc.unary("VolumeMarkWritable")(self._grpc_volume_mark_writable)
+        svc.unary("VolumeLifecycleCheck")(self._grpc_lifecycle_check)
         svc.unary("VolumeConfigure")(self._grpc_volume_configure)
         svc.unary("DeleteCollection")(self._grpc_delete_collection)
         svc.unary("VacuumVolumeCheck")(self._grpc_vacuum_check)
@@ -551,6 +553,10 @@ class VolumeServer(EcHandlers):
                     # master compares CURRENT replica digests, not the ones
                     # frozen at stream connect (our extension)
                     hb["volume_digests"] = self.store.collect_volume_digests()
+                    # lifecycle tick: EC read heat rides the same pulse so
+                    # the re-inflation planner sees warm volumes turning
+                    # hot within seconds, not at the ~17-tick EC refresh
+                    hb["ec_heat"] = self.store.collect_ec_heat()
                 await call.write(hb)
         finally:
             reader_task.cancel()
@@ -1574,7 +1580,17 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
             return {"error": str(e)}
 
     async def _grpc_volume_mount(self, req, context) -> dict:
-        self.store.mount_volume(int(req["volume_id"]))
+        vid = int(req["volume_id"])
+        self.store.mount_volume(vid)
+        if req.get("seed_read_heat") is not None:
+            # lifecycle re-inflation: the freshly-decoded volume inherits
+            # the heat the master aggregated across its EC shard holders.
+            # Without this it would mount near-cold (only the decode
+            # node's share persisted) and could immediately re-qualify
+            # for EC — the exact flap the hysteresis exists to prevent.
+            v = self.store.find_volume(vid)
+            if v is not None:
+                v.heat.seed(float(req["seed_read_heat"]))
         return {}
 
     async def _grpc_volume_unmount(self, req, context) -> dict:
@@ -1586,7 +1602,14 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
 
     async def _grpc_volume_delete(self, req, context) -> dict:
         vid = int(req["volume_id"])
-        self.store.delete_volume(vid)
+        # keep_ec_files: EC conversion retires the source volume but the
+        # freshly-generated shards at the same base name still need the
+        # .vif/.heat sidecars — the .dat/.idx are destroyed either way
+        # (an unmount-then-delete sequence would no-op the delete and
+        # leave a resurrectable .dat behind)
+        self.store.delete_volume(
+            vid, keep_ec_files=bool(req.get("keep_ec_files"))
+        )
         if self.read_cache is not None:
             self.read_cache.invalidate_volume(vid, "volume_delete")
         return {}
@@ -1594,6 +1617,47 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
     async def _grpc_volume_mark_readonly(self, req, context) -> dict:
         self.store.mark_volume_readonly(int(req["volume_id"]))
         return {}
+
+    async def _grpc_volume_mark_writable(self, req, context) -> dict:
+        """Undo VolumeMarkReadonly (ref volume_grpc_admin.go
+        VolumeMarkWritable) — the lifecycle dispatcher's rollback when a
+        conversion fails after sealing the source: a transient encode
+        failure must not leave the volume read-only forever. Refuses
+        quarantined volumes (scrub owns that flag) and sorted-map loads
+        (structurally read-only)."""
+        vid = int(req["volume_id"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return {"error": f"volume {vid} not found"}
+        if v.scrub_corrupt:
+            return {"error": f"volume {vid} is quarantined"}
+        if getattr(v, "needle_map_kind", "") == "sorted":
+            return {"error": f"volume {vid} has a read-only sorted map"}
+        v.no_write_or_delete = False
+        return {}
+
+    async def _grpc_lifecycle_check(self, req, context) -> dict:
+        """Authoritative lifecycle re-check (the VacuumVolumeCheck
+        analogue): live heat/size/flags for a normal volume, or the EC
+        read heat for a local EC volume — consulted by the master's
+        dispatcher before spending conversion I/O, so a stale heartbeat
+        temperature costs one cheap probe, never a wasted conversion."""
+        vid = int(req["volume_id"])
+        v = self.store.find_volume(vid)
+        if v is not None:
+            return {
+                "kind": "volume",
+                "read_heat": v.heat.read_heat(),
+                "write_heat": v.heat.write_heat(),
+                "size": v.data_file_size(),
+                "read_only": v.is_read_only(),
+                "scrub_corrupt": v.scrub_corrupt,
+                "is_compacting": v.is_compacting,
+            }
+        ev = self.store.find_ec_volume(vid)
+        if ev is not None:
+            return {"kind": "ec", "read_heat": ev.heat.read_heat()}
+        return {"error": f"volume {vid} not found"}
 
     async def _grpc_volume_configure(self, req, context) -> dict:
         """Rewrite a live volume's replica placement in its super block

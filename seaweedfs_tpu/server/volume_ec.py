@@ -207,6 +207,17 @@ class EcHandlers:
         )
         loop = asyncio.get_event_loop()
         try:
+            # background-plane callers (lifecycle auto-EC) tag the request
+            # with their plane: the encode's read volume is charged to the
+            # shared maintenance budget BEFORE the I/O burst, so encode
+            # traffic competes with scrub/vacuum/repair under one cap and
+            # yields to foreground pressure (arxiv 1709.05365)
+            if req.get("plane"):
+                try:
+                    dat_size = os.path.getsize(base + ".dat")
+                except OSError:
+                    dat_size = 0
+                await self._charge_maintenance(dat_size, plane=req["plane"])
             await loop.run_in_executor(
                 None, lambda: write_ec_files(base, codec=codec)
             )
@@ -253,6 +264,14 @@ class EcHandlers:
         from ..storage.erasure_coding import write_ec_files_multi
 
         loop = asyncio.get_event_loop()
+        if req.get("plane"):
+            total = 0
+            for _vid, b in bases:
+                try:
+                    total += os.path.getsize(b + ".dat")
+                except OSError:
+                    pass
+            await self._charge_maintenance(total, plane=req["plane"])
         try:
             await loop.run_in_executor(
                 None,
@@ -415,6 +434,9 @@ class EcHandlers:
         collection = req.get("collection", "")
         shard_ids = [int(s) for s in req.get("shard_ids", [])]
         source = req["source_data_node"]
+        # repair pulls by default; the lifecycle dispatcher tags its
+        # spread/collect copies plane="lifecycle" for budget attribution
+        plane = req.get("plane") or "repair"
         loc = max(
             self.store.locations,
             key=lambda l: l.max_volume_count - len(l.volumes),
@@ -435,7 +457,7 @@ class EcHandlers:
                     chunk = msg.get("file_content", b"")
                     # survivor-shard pulls share the maintenance budget
                     # with scrub + vacuum (one cap over all planes)
-                    await self._charge_maintenance(len(chunk))
+                    await self._charge_maintenance(len(chunk), plane=plane)
                     f.write(chunk)
             os.replace(tmp, base + ext)
 
@@ -586,6 +608,13 @@ class EcHandlers:
         loop = asyncio.get_event_loop()
         try:
             dat_size = await loop.run_in_executor(None, find_dat_file_size, base)
+            # re-inflation I/O rides the shared maintenance budget when a
+            # background plane dispatched it (decode reads ~dat_size of
+            # shards and writes dat_size back)
+            if req.get("plane"):
+                await self._charge_maintenance(
+                    2 * dat_size, plane=req["plane"]
+                )
             await loop.run_in_executor(
                 None, write_dat_file, base, dat_size, codec.data_shards
             )
@@ -864,6 +893,9 @@ class EcHandlers:
         in offsets from EcVolume.bulk_locate instead of re-searching). One
         deadline covers the WHOLE needle — retries on interval 1 shrink the
         budget intervals 2..n may spend."""
+        # lifecycle heat: one EC needle read = one heat unit on whichever
+        # server serves it (the master sums across holders)
+        ev.heat.note_read()
         intervals = ev.intervals_for(offset_units, size)
         deadline = deadline_after(EC_READ_DEADLINE_SECONDS)
         chunks = []

@@ -402,6 +402,59 @@ async def cmd_volume_vacuum(env, argv) -> str:
     return f"vacuum: {data}"
 
 
+@command("volume.lifecycle")
+async def cmd_volume_lifecycle(env, argv) -> str:
+    """Lifecycle plane: `volume.lifecycle -status` shows the master's
+    heat thresholds, conversion queue and recent outcomes; `-run` forces
+    one scheduler scan+dispatch round off heartbeat heat (`-all` waives
+    the cold/full planner gates — the dispatcher's authoritative re-check
+    still applies). See docs/perf.md "Lifecycle plane"."""
+    flags = _parse_flags(argv)
+    req: dict = {}
+    if "run" in flags:
+        req["run"] = True
+        if "all" in flags:
+            req["include_all"] = True
+        if "maxDispatch" in flags:
+            req["max_dispatch"] = int(flags["maxDispatch"])
+    r = await env.master_stub.call("LifecycleStatus", req, timeout=3600)
+    if r.get("error"):
+        return f"lifecycle status failed: {r['error']}"
+    th = r.get("thresholds", {})
+    lines = [
+        f"auto_lifecycle: {'on' if r.get('auto_lifecycle') else 'off'} "
+        f"(cold<= {th.get('cold_read_heat')}r/{th.get('cold_write_heat')}w, "
+        f"hot>= {th.get('hot_read_heat')}, "
+        f"full>= {th.get('full_fraction')}x limit) · "
+        f"queue depth: {r.get('queue_depth', 0)}"
+    ]
+    for t in r.get("queue", []):
+        direction = (
+            "auto-EC" if t["kind"] == "lifecycle_ec" else "re-inflate"
+        )
+        lines.append(
+            f"  queued volume {t['volume_id']} ({direction}, "
+            f"attempts {t['attempts']})"
+        )
+    for t in r.get("recent", []):
+        if t.get("error"):
+            outcome = f"ERROR: {t['error']}"
+        elif t.get("skipped"):
+            outcome = f"skipped ({t['skipped']})"
+        elif t.get("converted") == "ec":
+            outcome = f"erasure-coded (spread {t.get('spread')})"
+        else:
+            outcome = f"re-inflated on {t.get('target')}"
+        lines.append(f"  recent volume {t['volume_id']}: {outcome}")
+    if "ran" in r:
+        ran = r["ran"]
+        lines.append(
+            f"ran one round: dispatched {len(ran.get('dispatched', []))},"
+            f" queue depth now {ran.get('queue_depth', 0)}"
+        )
+    return "\n".join(lines)
+
+
 @command("volume.fix.replication")
 async def cmd_volume_fix_replication(env, argv) -> str:
     """Re-replicate under-replicated volumes (ref
@@ -638,9 +691,13 @@ async def _ec_spread(
         if r.get("error"):
             return f"volume {vid}: mount on {target} failed: {r['error']}"
 
-    # drop the source volume + its non-assigned shard files
-    await sstub.call("VolumeUnmount", {"volume_id": vid})
-    await sstub.call("VolumeDelete", {"volume_id": vid})
+    # drop the source volume + its non-assigned shard files. Delete WHILE
+    # mounted (keep_ec_files spares the .vif/.heat the EC volume needs):
+    # the old unmount-then-delete sequence no-op'd the delete, leaving a
+    # stale .dat a later mount scan could resurrect as a writable twin
+    await sstub.call(
+        "VolumeDelete", {"volume_id": vid, "keep_ec_files": True}
+    )
     own = assignment.get(source, [])
     await sstub.call(
         "VolumeEcShardsDelete",

@@ -86,12 +86,12 @@ class DiskLocation:
         with self._lock:
             return self.volumes.get(vid)
 
-    def delete_volume(self, vid: int) -> bool:
+    def delete_volume(self, vid: int, keep_ec_files: bool = False) -> bool:
         with self._lock:
             v = self.volumes.pop(vid, None)
         if v is None:
             return False
-        v.destroy()
+        v.destroy(keep_ec_files=keep_ec_files)
         return True
 
     def unmount_volume(self, vid: int) -> bool:

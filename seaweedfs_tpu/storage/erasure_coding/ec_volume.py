@@ -197,6 +197,12 @@ class EcVolume:
 
         self._ecx_cache = SnapshotCache()
         self._ecx_mutations = 0
+        # lifecycle plane: EC read heat (the re-inflation sensor). The
+        # sidecar shares the volume's base name, so a conversion on the
+        # same node carries the temperature across the format change.
+        from ..heat import HeatTracker
+
+        self.heat = HeatTracker.load(base + ".heat")
 
     def file_name(self) -> str:
         return ec_shard_file_name(self.collection, self.dir, self.volume_id)
@@ -336,6 +342,10 @@ class EcVolume:
             self._ecj.flush()
 
     def close(self) -> None:
+        try:
+            self.heat.save(self.file_name() + ".heat")
+        except Exception:
+            pass
         for s in self.shards:
             s.close()
         with self._ecj_lock:
@@ -350,7 +360,7 @@ class EcVolume:
             except FileNotFoundError:
                 pass
         base = self.file_name()
-        for ext in (".ecx", ".ecj", ".vif"):
+        for ext in (".ecx", ".ecj", ".vif", ".heat"):
             try:
                 os.remove(base + ext)
             except FileNotFoundError:

@@ -87,13 +87,13 @@ class Store:
             self.new_volumes.append(self._volume_message(v))
         return v
 
-    def delete_volume(self, vid: int) -> bool:
+    def delete_volume(self, vid: int, keep_ec_files: bool = False) -> bool:
         v = self.find_volume(vid)
         if v is None:
             return False
         msg = self._volume_message(v)
         for loc in self.locations:
-            if loc.delete_volume(vid):
+            if loc.delete_volume(vid, keep_ec_files=keep_ec_files):
                 with self._lock:
                     self.deleted_volumes.append(msg)
                 return True
@@ -182,6 +182,12 @@ class Store:
             # sweep (the per-dispatch VacuumVolumeCheck stays the
             # authoritative re-check)
             "garbage_ratio": round(v.garbage_level(), 4),
+            # lifecycle plane: decayed access heat rides the same way, so
+            # the master's lifecycle planner ranks hot/cold candidates
+            # straight off heartbeats (VolumeLifecycleCheck re-checks
+            # authoritatively at dispatch)
+            "read_heat": round(v.heat.read_heat(), 4),
+            "write_heat": round(v.heat.write_heat(), 4),
         }
 
     def collect_volume_digests(self) -> list[dict]:
@@ -191,8 +197,12 @@ class Store:
         message (id + digest + frontier + corrupt flag) rides every few
         heartbeat ticks instead."""
         out = []
+        read_total = write_total = 0.0
         for loc in self.locations:
             for v in list(loc.volumes.values()):
+                rh, wh = v.heat.read_heat(), v.heat.write_heat()
+                read_total += rh
+                write_total += wh
                 out.append(
                     {
                         "id": v.id,
@@ -201,8 +211,21 @@ class Store:
                         "read_only": v.is_read_only(),
                         "scrub_corrupt": v.scrub_corrupt,
                         "garbage_ratio": round(v.garbage_level(), 4),
+                        # lifecycle refresh: heat + size must stay current
+                        # between full volume messages or the planner
+                        # compares temperatures frozen at stream connect
+                        "read_heat": round(rh, 4),
+                        "write_heat": round(wh, 4),
+                        "size": v.data_file_size(),
                     }
                 )
+        try:
+            from ..util.metrics import VOLUME_HEAT
+
+            VOLUME_HEAT.set(round(read_total, 4), kind="read")
+            VOLUME_HEAT.set(round(write_total, 4), kind="write")
+        except ImportError:
+            pass
         return out
 
     def collect_heartbeat(self) -> dict:
@@ -234,12 +257,40 @@ class Store:
                         "id": vid,
                         "collection": ev.collection,
                         "ec_index_bits": ev.shard_bits().bits,
+                        "read_heat": round(ev.heat.read_heat(), 4),
                     }
                 )
         return {
             "ec_shards": shard_messages,
             "has_no_ec_shards": len(shard_messages) == 0,
         }
+
+    def collect_ec_heat(self) -> list[dict]:
+        """Slim per-pulse EC heat refresh (the EC analogue of
+        collect_volume_digests): full EC messages only travel every ~17
+        ticks, far too slow for the lifecycle planner to notice a warm
+        volume turning hot. One (id, read_heat) pair per local EC volume
+        rides the anti-entropy tick instead."""
+        out = []
+        total = 0.0
+        for loc in self.locations:
+            for vid, ev in loc.ec_volumes.items():
+                h = ev.heat.read_heat()
+                total += h
+                out.append(
+                    {
+                        "id": vid,
+                        "collection": ev.collection,
+                        "read_heat": round(h, 4),
+                    }
+                )
+        try:
+            from ..util.metrics import VOLUME_HEAT
+
+            VOLUME_HEAT.set(round(total, 4), kind="ec_read")
+        except ImportError:
+            pass
+        return out
 
     def note_volume_changed(self, old_msg: dict, new_msg: dict) -> None:
         """Queue an in-place layout change (e.g. replica placement rewrite)

@@ -327,6 +327,13 @@ class Volume:
         # map's mutation token) + the scrub quarantine flag heartbeats carry
         self._digest_cache: Optional[tuple] = None
         self.scrub_corrupt = False
+        # lifecycle plane: decayed read/write heat, restored from the
+        # sidecar so a clean restart keeps the volume's temperature
+        from .heat import HeatTracker
+
+        self.heat = HeatTracker.load(
+            volume_base_name(directory, collection, vid) + ".heat"
+        )
         # device-resident index snapshot for bulk probes, keyed by the
         # map's mutation token (see bulk_lookup)
         from ..ops.snapshot_cache import SnapshotCache
@@ -634,6 +641,7 @@ class Volume:
                 if existing.cookie != n.cookie:
                     raise CookieMismatch(f"mismatching cookie {n.cookie:x}")
 
+            self.heat.note_write()
             n.append_at_ns = time.time_ns()
             end = self.data_backend.size()
             blob, size_for_index, _ = n.to_bytes(self.version)
@@ -662,6 +670,7 @@ class Volume:
             nv = self.nm.get(n.id)
             if nv is None or nv.size == TOMBSTONE_FILE_SIZE:
                 return 0
+            self.heat.note_write()
             size = nv.size
             n.data = b""
             n.append_at_ns = time.time_ns()
@@ -693,6 +702,7 @@ class Volume:
         token: a later hit is legal only while the live map still points
         the key at the same location (append-only .dat ⇒ same location,
         same bytes; any overwrite/delete moves or tombstones the entry)."""
+        self.heat.note_read()
         with self._lock:
             nv = self.nm.get(key)
             if nv is None or nv.offset_units == 0:
@@ -712,7 +722,12 @@ class Volume:
     def locate_live(self, key: int):
         """(offset_units, size) of the key's live record, or None when the
         key is absent/deleted. One locked map probe — the hot-needle
-        cache's per-hit freshness check."""
+        cache's per-hit freshness check. Cache hits are real reads: they
+        count into the lifecycle heat here (the only per-hit volume
+        touchpoint), or a perfectly-cached volume would look COLD to the
+        lifecycle planner and get erasure-coded out from under its
+        traffic."""
+        self.heat.note_read()
         with self._lock:
             nv = self.nm.get(key)
         if (
@@ -785,6 +800,7 @@ class Volume:
     def read_needle_at(self, offset_units: int, size: int) -> Needle:
         """pread one record at a known index location, under the volume lock
         and with the same TTL-expiry visibility as read_needle."""
+        self.heat.note_read()
         with self._lock:
             n = read_needle_data(
                 self.data_backend, to_actual_offset(offset_units), size, self.version
@@ -802,15 +818,30 @@ class Volume:
         self.data_backend.sync()
 
     def close(self) -> None:
+        # persist the temperature: a clean restart must not look like a
+        # cold start to the lifecycle planner
+        try:
+            self.heat.save(self.file_name() + ".heat")
+        except Exception:
+            pass
         with self._lock:
             self.nm.close()
             self.data_backend.close()
 
-    def destroy(self) -> None:
-        """Remove all files (ref: volume_read_write.go:44-65)."""
+    def destroy(self, keep_ec_files: bool = False) -> None:
+        """Remove all files (ref: volume_read_write.go:44-65).
+
+        keep_ec_files spares the sidecars a just-generated EC volume at
+        the same base name still needs — the .vif (RS geometry) and the
+        .heat temperature — while still destroying the .dat/.idx, so a
+        volume retired by EC conversion can never be re-discovered and
+        resurrected as a writable normal volume by a later mount scan."""
         self.close()
         base = self.file_name()
-        for ext in (".dat", ".idx", ".vif", ".sdx", ".cpd", ".cpx", ".scrub"):
+        exts = (".dat", ".idx", ".sdx", ".cpd", ".cpx", ".scrub")
+        if not keep_ec_files:
+            exts += (".vif", ".heat")
+        for ext in exts:
             try:
                 os.remove(base + ext)
             except FileNotFoundError:

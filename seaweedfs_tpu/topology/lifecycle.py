@@ -1,0 +1,188 @@
+"""Master-side lifecycle planning: the decision half of the hot→warm
+lifecycle plane (pure and unit-testable, like `topology/vacuum_plan.py`;
+dispatch lives in `server/master.py`).
+
+Heartbeats are the sensor: every volume message (and the slim digest
+refresh) carries the replica's decayed read/write heat plus its size,
+and the per-pulse EC heat refresh carries each EC volume's read heat.
+Two planners close the Haystack→f4 arc (PAPER.md) inside one cluster:
+
+- `plan_ec_conversions` qualifies volumes that are COLD (total decayed
+  heat under the cold threshold on every replica), FULL (size past
+  `full_fraction` of the limit, or already sealed read-only) and HEALTHY
+  (never quarantined) for auto-EC through the existing encode pipeline —
+  coldest first, so the volume wasting the most hot-tier bytes for the
+  least traffic converts first.
+- `plan_reinflations` qualifies EC volumes whose aggregated read heat
+  rose past the HOT threshold for decode back into a normal volume —
+  hottest first.
+
+Hysteresis lives in the threshold pair: `hot_read_heat` must sit well
+above `cold_read_heat` (enforced at config construction), so an access
+mix oscillating between the two never flaps EC↔un-EC — a volume must
+genuinely cool below cold to leave the hot tier and genuinely heat past
+hot to come back, and the dispatcher's authoritative
+`VolumeLifecycleCheck` re-check catches anything that changed since the
+heartbeat sample.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from .repair import RepairTask
+
+# priority is an ascending sort key in the shared RepairQueue.
+# auto-EC: coldest-first  -> priority grows with heat.
+# re-inflate: hottest-first -> priority shrinks (negative) with heat.
+_HEAT_SCALE = 1000
+
+
+def coldness_priority(total_heat: float) -> int:
+    return int(round(max(total_heat, 0.0) * _HEAT_SCALE))
+
+
+def hotness_priority(total_heat: float) -> int:
+    return -int(round(max(total_heat, 0.0) * _HEAT_SCALE))
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+@dataclass(frozen=True)
+class LifecycleConfig:
+    """Thresholds of the lifecycle planner. Heat values are decayed op
+    counts (see storage/heat.py): with the default 600s half-life,
+    `cold_read_heat=0.5` roughly means "less than one read in the last
+    ten minutes"."""
+
+    cold_read_heat: float = 0.5
+    cold_write_heat: float = 0.5
+    hot_read_heat: float = 50.0
+    full_fraction: float = 0.85
+
+    def __post_init__(self):
+        if self.hot_read_heat <= self.cold_read_heat:
+            raise ValueError(
+                "lifecycle hysteresis violated: hot_read_heat "
+                f"({self.hot_read_heat}) must exceed cold_read_heat "
+                f"({self.cold_read_heat})"
+            )
+
+    @classmethod
+    def from_env(cls) -> "LifecycleConfig":
+        return cls(
+            cold_read_heat=_env_float(
+                "SEAWEEDFS_TPU_LIFECYCLE_COLD_HEAT", cls.cold_read_heat
+            ),
+            cold_write_heat=_env_float(
+                "SEAWEEDFS_TPU_LIFECYCLE_COLD_WRITE_HEAT",
+                cls.cold_write_heat,
+            ),
+            hot_read_heat=_env_float(
+                "SEAWEEDFS_TPU_LIFECYCLE_HOT_HEAT", cls.hot_read_heat
+            ),
+            full_fraction=_env_float(
+                "SEAWEEDFS_TPU_LIFECYCLE_FULL_FRACTION", cls.full_fraction
+            ),
+        )
+
+
+def volume_total_heat(replicas: list[dict]) -> tuple[float, float]:
+    """(read, write) heat summed across replicas — each replica serves a
+    share of the traffic (round-robin fan-out), so the volume's true
+    temperature is the sum of what every copy observed."""
+    return (
+        sum(float(r.get("read_heat", 0.0)) for r in replicas),
+        sum(float(r.get("write_heat", 0.0)) for r in replicas),
+    )
+
+
+def plan_ec_conversions(
+    volume_states: dict,
+    volume_size_limit: int,
+    cfg: LifecycleConfig,
+    include_all: bool = False,
+) -> list[RepairTask]:
+    """Auto-EC planning over heartbeat-derived state.
+
+    volume_states: {vid: [{url, collection, read_heat, write_heat, size,
+    read_only, scrub_corrupt}, ...]} — one entry per live replica holder
+    (the shape `Topology.replica_states` returns, lifecycle fields
+    included).
+
+    One task per qualifying volume, kind="lifecycle_ec", coldest first.
+    Gates:
+    - HEALTHY: no replica quarantined (`scrub_corrupt`) — a damaged copy
+      belongs to the repair plane; converting it would bake the damage
+      into the warm tier. Never waived, even by include_all.
+    - COLD: summed read AND write heat under the cold thresholds.
+    - FULL: the largest replica past full_fraction * volume_size_limit,
+      or every replica sealed read-only (an operator-sealed volume is
+      done growing regardless of size).
+    include_all waives the cold/full gates (forced sweeps); the
+    dispatcher's authoritative VolumeLifecycleCheck still applies them.
+    """
+    tasks = []
+    for vid, replicas in volume_states.items():
+        if not replicas:
+            continue
+        if any(r.get("scrub_corrupt") for r in replicas):
+            continue
+        read_heat, write_heat = volume_total_heat(replicas)
+        if not include_all:
+            if read_heat > cfg.cold_read_heat:
+                continue
+            if write_heat > cfg.cold_write_heat:
+                continue
+            size = max(int(r.get("size", 0)) for r in replicas)
+            sealed = all(r.get("read_only") for r in replicas)
+            if (
+                not sealed
+                and volume_size_limit > 0
+                and size < cfg.full_fraction * volume_size_limit
+            ):
+                continue
+        tasks.append(
+            RepairTask(
+                kind="lifecycle_ec",
+                vid=int(vid),
+                collection=replicas[0].get("collection", ""),
+                priority=coldness_priority(read_heat + write_heat),
+                survivors=len(replicas),
+            )
+        )
+    tasks.sort(key=lambda t: (t.priority, t.vid))
+    return tasks
+
+
+def plan_reinflations(
+    ec_heat_states: dict, cfg: LifecycleConfig
+) -> list[RepairTask]:
+    """Re-inflation planning over the per-pulse EC heat refresh.
+
+    ec_heat_states: {vid: {"collection": str, "read_heat": float}} with
+    read_heat already summed across live shard holders (the shape
+    `Topology.ec_heat_states` returns). An EC volume past the HOT
+    threshold becomes one kind="lifecycle_inflate" task, hottest first.
+    """
+    tasks = []
+    for vid, st in ec_heat_states.items():
+        heat = float(st.get("read_heat", 0.0))
+        if heat < cfg.hot_read_heat:
+            continue
+        tasks.append(
+            RepairTask(
+                kind="lifecycle_inflate",
+                vid=int(vid),
+                collection=st.get("collection", ""),
+                priority=hotness_priority(heat),
+            )
+        )
+    tasks.sort(key=lambda t: (t.priority, t.vid))
+    return tasks

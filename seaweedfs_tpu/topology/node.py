@@ -97,6 +97,9 @@ class DataNode(Node):
         self.max_volume_count = max_volumes
         self.volumes: Dict[int, dict] = {}  # vid -> volume info message
         self.ec_shards: Dict[int, ShardBits] = {}  # vid -> shard bits
+        # vid -> decayed EC read heat this node last reported (lifecycle
+        # plane; refreshed by full EC messages + the per-pulse heat tick)
+        self.ec_heat: Dict[int, float] = {}
         self.last_seen = time.time()
 
     @property

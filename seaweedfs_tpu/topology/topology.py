@@ -185,6 +185,21 @@ class Topology(Node):
             for shard_id in bits.shard_ids():
                 locs.delete_shard(shard_id, dn)
 
+    def forget_ec_volume_if_empty(self, vid: int) -> bool:
+        """Drop an EC volume's registration once EXPLICIT shard deletes
+        (ec.decode, lifecycle re-inflation) emptied every location list.
+        Only delta/full-state delete processing calls this — a node going
+        silent must NOT forget the volume, or wholly-lost shards would
+        stop looking missing to the repair planner."""
+        with self._ec_lock:
+            for (collection, v), locs in list(self.ec_shard_map.items()):
+                if v == vid and not any(
+                    locs.locations[s] for s in range(32)
+                ):
+                    del self.ec_shard_map[(collection, v)]
+                    return True
+        return False
+
     def lookup_ec_shards(self, vid: int) -> Optional[EcShardLocations]:
         with self._ec_lock:
             for (collection, v), locs in self.ec_shard_map.items():
@@ -256,9 +271,39 @@ class Topology(Node):
                         "scrub_corrupt": bool(info.get("scrub_corrupt")),
                         "read_only": bool(info.get("read_only")),
                         "garbage_ratio": float(info.get("garbage_ratio", 0.0)),
+                        # lifecycle fields (ride full messages + the slim
+                        # digest refresh, like garbage_ratio)
+                        "read_heat": float(info.get("read_heat", 0.0)),
+                        "write_heat": float(info.get("write_heat", 0.0)),
+                        "size": int(info.get("size", 0)),
                     }
                 )
         return states
+
+    def ec_heat_states(self, live_urls: Optional[set] = None) -> dict:
+        """{vid: {collection, read_heat}} with heat SUMMED across live
+        shard holders — the `lifecycle.plan_reinflations` input. Heat per
+        holder comes from the per-pulse EC heat refresh the master stores
+        on each DataNode (`dn.ec_heat`)."""
+        out: Dict[int, dict] = {}
+        with self._ec_lock:
+            registered = {
+                vid: collection
+                for (collection, vid), locs in self.ec_shard_map.items()
+                if locs.expected_total
+            }
+        for dn in self.data_nodes():
+            if live_urls is not None and dn.url not in live_urls:
+                continue
+            for vid, heat in list(getattr(dn, "ec_heat", {}).items()):
+                if vid not in registered or vid not in dn.ec_shards:
+                    continue
+                st = out.setdefault(
+                    int(vid),
+                    {"collection": registered[vid], "read_heat": 0.0},
+                )
+                st["read_heat"] += float(heat)
+        return out
 
     def to_info(self) -> dict:
         return {

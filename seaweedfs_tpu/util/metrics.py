@@ -528,3 +528,27 @@ MAINTENANCE_YIELDS = REGISTRY.counter(
     "maintenance budget consumes that yielded extra time to foreground "
     "pressure (admission gates shedding/queueing), by plane",
 )
+
+# lifecycle plane (see docs/perf.md "Lifecycle plane"): the hot→warm arc
+# made observable — per-server aggregate access heat as sampled into
+# heartbeats, the master's conversion queue depth, and every conversion
+# the planner dispatched counted by direction and outcome, so an
+# operator (and the bench's convergence leg) can assert the loop ran,
+# drained, and did not flap
+VOLUME_HEAT = REGISTRY.gauge(
+    "seaweedfs_tpu_volume_heat",
+    "per-server aggregate decayed access heat, by kind (read/write = "
+    "normal volumes, ec_read = EC volumes); refreshed at the heartbeat "
+    "digest tick",
+)
+LIFECYCLE_QUEUE_DEPTH = REGISTRY.gauge(
+    "seaweedfs_tpu_lifecycle_queue_depth",
+    "lifecycle conversion tasks currently queued on the master "
+    "(coldest-first for auto-EC, hottest-first for re-inflation)",
+)
+LIFECYCLE_CONVERSIONS = REGISTRY.counter(
+    "seaweedfs_tpu_lifecycle_conversions_total",
+    "lifecycle conversions dispatched by the master planner, by "
+    "direction (ec = hot→warm auto-encode, inflate = warm→hot "
+    "re-inflation) and result (ok/error/skipped)",
+)

@@ -357,6 +357,36 @@ def test_s3_gateway_leg_shape():
         assert r["list_walk_complete"] is True
 
 
+def test_lifecycle_convergence_leg_shape():
+    """ISSUE 10 guard: the lifecycle.convergence leg must complete
+    non-zero auto-EC conversions UNDER the open-loop foreground read
+    stream, disclose the foreground p99 with/without ratio, read every
+    converted object back byte-identically, and drain the planner queue
+    to 0. Small/short shape: structure and sanity bounds here, the real
+    acceptance numbers (ratio <= 1.5x) come from the full bench run."""
+    lc = bench.measure_lifecycle_convergence(
+        n_cold_volumes=2,
+        cold_files_per_volume=3,
+        cold_file_bytes=32 * 1024,
+        fg_files=200,
+        window_s=1.2,
+    )
+    assert "error" not in lc, lc.get("error")
+    assert lc["conversions_ec_ok"] > 0  # conversions actually ran
+    assert lc["converted_all"] is True
+    assert lc["byte_identical"] is True  # EC read-back == bytes written
+    assert lc["lifecycle_queue_depth_end"] == 0
+    # the contention ratio is disclosed, computed from two non-zero p99s
+    assert lc["baseline"]["p99_ms"] > 0
+    assert lc["with_conversions"]["p99_ms"] > 0
+    assert lc["fg_p99_ratio"] > 0
+    # conversion I/O was charged to the shared budget under its plane
+    assert lc["maintenance"]["spent_bytes"].get("lifecycle", 0) > 0
+    # the foreground stream genuinely ran in both windows
+    assert lc["baseline"]["count"] > 0
+    assert lc["with_conversions"]["count"] > 0
+
+
 def test_device_history_appends_per_emit(tmp_path, monkeypatch):
     """ISSUE 6 satellite: every bench emit appends {run, device_status}
     to DEVICE_HISTORY.jsonl so stand-in runs stop erasing the record of

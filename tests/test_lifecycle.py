@@ -1,0 +1,531 @@
+"""Lifecycle plane (ISSUE 10): access-heat tracking, the master lifecycle
+planner, and the full hot→warm→hot loop.
+
+- HeatTracker properties: decay is a function of op timestamps only
+  (order-independent across heartbeat batching/flush boundaries), heat
+  survives a clean volume restart no worse than cold-start, and garbage
+  sidecars mean cold start.
+- Planner units: cold+full+healthy gating, coldest-first/hottest-first
+  ordering, quarantine never waived, hysteresis prevents EC↔un-EC
+  flapping under an oscillating read mix.
+- Cluster e2e (the acceptance loop): write hot → cool → auto-EC →
+  byte-identical read-back → reheat via reads → auto–un-EC →
+  byte-identical again, with the queue draining to 0 and no conversion
+  dispatched for a quarantined volume.
+"""
+
+import asyncio
+import os
+import random
+
+import pytest
+
+from seaweedfs_tpu.storage.heat import HeatTracker
+from seaweedfs_tpu.topology.lifecycle import (
+    LifecycleConfig,
+    plan_ec_conversions,
+    plan_reinflations,
+)
+
+
+# ---------------- heat tracker properties ----------------
+
+
+def test_heat_decay_is_order_independent_across_flush_boundaries():
+    """Same ops at the same timestamps ⇒ same heat, no matter where the
+    sampling (heartbeat flush) boundaries fall — sampling folds but never
+    mutates history."""
+    rng = random.Random(1234)
+    for trial in range(20):
+        ops = []
+        t = 100.0
+        for _ in range(rng.randint(5, 60)):
+            t += rng.random() * 5.0
+            ops.append((t, rng.choice(("r", "w"))))
+        end = t + rng.random() * 10.0
+
+        def drive(sample_times):
+            clk = [0.0]
+            tr = HeatTracker(half_life_s=7.5, clock=lambda: clk[0])
+            events = [(tt, "s") for tt in sample_times] + ops
+            events.sort(key=lambda e: (e[0], e[1] != "s"))
+            for tt, kind in events:
+                clk[0] = tt
+                if kind == "r":
+                    tr.note_read(now=tt)
+                elif kind == "w":
+                    tr.note_write(now=tt)
+                else:
+                    tr.read_heat(now=tt)  # a heartbeat sampling "flush"
+                    tr.write_heat(now=tt)
+            clk[0] = end
+            return tr.read_heat(now=end), tr.write_heat(now=end)
+
+        # three different flush schedules: none, per-op, random
+        a = drive([])
+        b = drive([tt + 1e-3 for tt, _ in ops])
+        c = drive([100.0 + rng.random() * (end - 100.0) for _ in range(17)])
+        for x, y in ((a, b), (a, c)):
+            assert x[0] == pytest.approx(y[0], rel=1e-9)
+            assert x[1] == pytest.approx(y[1], rel=1e-9)
+
+
+def test_heat_half_life_decays_as_documented():
+    clk = [0.0]
+    tr = HeatTracker(half_life_s=10.0, clock=lambda: clk[0])
+    tr.note_read(n=8.0)
+    clk[0] = 10.0
+    assert tr.read_heat() == pytest.approx(4.0, rel=1e-9)
+    clk[0] = 30.0
+    assert tr.read_heat() == pytest.approx(1.0, rel=1e-9)
+
+
+def test_heat_survives_volume_restart_no_worse_than_cold_start(tmp_path):
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(str(tmp_path), "", 7)
+    for i in range(5):
+        v.write_needle(Needle(id=i + 1, cookie=1, data=b"x" * 64))
+    for i in range(5):
+        v.read_needle_by_key(i + 1)
+    before_r = v.heat.read_heat()
+    before_w = v.heat.write_heat()
+    assert before_r > 0 and before_w > 0
+    v.close()  # persists the sidecar
+    assert os.path.exists(str(tmp_path / "7.heat"))
+
+    v2 = Volume(str(tmp_path), "", 7, create=False)
+    # restored heat: within decay of the saved value (wall-clock decay
+    # between close and reopen is the only legal loss)
+    assert 0 < v2.heat.read_heat() <= before_r + 1e-6
+    assert v2.heat.read_heat() == pytest.approx(before_r, rel=0.05)
+    assert v2.heat.write_heat() == pytest.approx(before_w, rel=0.05)
+    v2.close()
+
+    # a lost sidecar is a cold start (never an error, never negative)
+    os.remove(str(tmp_path / "7.heat"))
+    v3 = Volume(str(tmp_path), "", 7, create=False)
+    assert v3.heat.read_heat() == 0.0
+    v3.close()
+
+    # a garbage sidecar is a cold start too
+    with open(str(tmp_path / "7.heat"), "w") as f:
+        f.write("{not json")
+    v4 = Volume(str(tmp_path), "", 7, create=False)
+    assert v4.heat.read_heat() == 0.0
+    v4.close()
+
+
+def test_heat_counts_cache_validation_path(tmp_path):
+    """locate_live (the hot-needle cache's per-hit probe) counts heat —
+    a perfectly-cached volume must not look cold."""
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(str(tmp_path), "", 9)
+    v.write_needle(Needle(id=1, cookie=1, data=b"y" * 32))
+    h0 = v.heat.read_heat()
+    for _ in range(10):
+        assert v.locate_live(1) is not None
+    assert v.heat.read_heat() >= h0 + 9.0  # modulo sub-ms decay
+    v.close()
+
+
+# ---------------- planner units ----------------
+
+
+def _replica(
+    read_heat=0.0, write_heat=0.0, size=10_000, read_only=False,
+    scrub_corrupt=False, url="h:1", collection="",
+):
+    return {
+        "url": url,
+        "collection": collection,
+        "read_heat": read_heat,
+        "write_heat": write_heat,
+        "size": size,
+        "read_only": read_only,
+        "scrub_corrupt": scrub_corrupt,
+    }
+
+
+CFG = LifecycleConfig(
+    cold_read_heat=1.0, cold_write_heat=1.0, hot_read_heat=20.0,
+    full_fraction=0.5,
+)
+
+
+def test_config_enforces_hysteresis():
+    with pytest.raises(ValueError):
+        LifecycleConfig(cold_read_heat=5.0, hot_read_heat=5.0)
+
+
+def test_plan_ec_conversions_gates_and_order():
+    limit = 10_000
+    states = {
+        1: [_replica(read_heat=0.2, size=9_000)],      # cold+full -> plan
+        2: [_replica(read_heat=50.0, size=9_000)],     # hot -> no
+        3: [_replica(read_heat=0.1, size=1_000)],      # not full -> no
+        4: [_replica(read_heat=0.1, size=1_000, read_only=True)],  # sealed
+        5: [_replica(read_heat=0.0, size=9_000, scrub_corrupt=True)],
+        6: [_replica(read_heat=0.9, size=9_000)],      # colder than... no,
+        7: [_replica(write_heat=30.0, size=9_000)],    # write-hot -> no
+        8: [],                                          # no replicas -> no
+    }
+    tasks = plan_ec_conversions(states, limit, CFG)
+    vids = [t.vid for t in tasks]
+    assert set(vids) == {1, 4, 6}
+    # coldest first: vid 4 (0.1) before 1 (0.2) before 6 (0.9)
+    assert vids == [4, 1, 6]
+    assert all(t.kind == "lifecycle_ec" for t in tasks)
+
+
+def test_plan_ec_conversions_sums_heat_across_replicas():
+    limit = 10_000
+    states = {
+        1: [
+            _replica(read_heat=0.6, size=9_000, url="a:1"),
+            _replica(read_heat=0.6, size=9_000, url="b:1"),
+        ],
+    }
+    # each replica is individually cold, but the volume's total traffic
+    # (what re-inflation would have to serve) is 1.2 > cold 1.0
+    assert plan_ec_conversions(states, limit, CFG) == []
+
+
+def test_plan_ec_conversions_include_all_never_waives_quarantine():
+    limit = 10_000
+    states = {
+        1: [_replica(read_heat=99.0, size=10)],        # hot+empty: waived
+        2: [_replica(scrub_corrupt=True, size=9_000)],  # never waived
+    }
+    tasks = plan_ec_conversions(states, limit, CFG, include_all=True)
+    assert [t.vid for t in tasks] == [1]
+
+
+def test_plan_reinflations_threshold_and_order():
+    states = {
+        10: {"collection": "", "read_heat": 25.0},
+        11: {"collection": "", "read_heat": 100.0},
+        12: {"collection": "", "read_heat": 5.0},  # below hot -> no
+    }
+    tasks = plan_reinflations(states, CFG)
+    assert [t.vid for t in tasks] == [11, 10]  # hottest first
+    assert all(t.kind == "lifecycle_inflate" for t in tasks)
+
+
+def test_hysteresis_prevents_flapping_under_oscillating_mix():
+    """An access mix oscillating BETWEEN the thresholds (warmer than
+    cold, cooler than hot) must trigger no conversion in either
+    direction, however long it runs; only a genuine excursion past a
+    threshold does."""
+    limit = 10_000
+    rng = random.Random(7)
+    is_ec = False
+    transitions = []
+    for step in range(200):
+        heat = 2.0 + 16.0 * abs((step % 20) - 10) / 10.0  # 2..18 sawtooth
+        heat += rng.random() * 0.5
+        if is_ec:
+            if plan_reinflations(
+                {1: {"collection": "", "read_heat": heat}}, CFG
+            ):
+                transitions.append(("inflate", step))
+                is_ec = False
+        else:
+            if plan_ec_conversions(
+                {1: [_replica(read_heat=heat, size=9_000)]}, limit, CFG
+            ):
+                transitions.append(("ec", step))
+                is_ec = True
+    assert transitions == []  # oscillation inside the band never flaps
+
+    # a genuine cool-down converts exactly once...
+    assert plan_ec_conversions(
+        {1: [_replica(read_heat=0.2, size=9_000)]}, limit, CFG
+    )
+    # ...and a genuine heat-up re-inflates exactly once
+    assert plan_reinflations({1: {"collection": "", "read_heat": 30.0}}, CFG)
+
+
+# ---------------- cluster e2e: the full loop ----------------
+
+
+def test_lifecycle_full_loop_e2e(tmp_path, monkeypatch):
+    """write hot → cool → auto-EC → byte-identical → reheat → auto–un-EC
+    → byte-identical, queue drains to 0, quarantined volume untouched."""
+    import aiohttp
+
+    from test_cluster import Cluster, assign_retry
+    from seaweedfs_tpu.client.operation import read_url, upload_data
+    from seaweedfs_tpu.topology.lifecycle import LifecycleConfig
+    from seaweedfs_tpu.util.metrics import LIFECYCLE_CONVERSIONS
+
+    # short half-life so "going cold" takes a 3s sleep, not ten minutes
+    monkeypatch.setenv("SEAWEEDFS_TPU_HEAT_HALFLIFE", "0.5")
+    cfg = LifecycleConfig(
+        cold_read_heat=2.0, cold_write_heat=2.0, hot_read_heat=30.0,
+        full_fraction=0.0,  # tiny test volumes count as full
+    )
+
+    def counter_value(direction, result):
+        key = tuple(sorted({"direction": direction, "result": result}.items()))
+        return LIFECYCLE_CONVERSIONS._values.get(key, 0.0)
+
+    async def wait_for(predicate, timeout=30.0, what=""):
+        for _ in range(int(timeout / 0.1)):
+            if predicate():
+                return
+            await asyncio.sleep(0.1)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    async def body():
+        cluster = Cluster(tmp_path)
+        # the cluster helper builds the master; rebuild it with lifecycle
+        # config by patching after construction is messier than passing
+        # through — patch the instance before start
+        await cluster.start()
+        master = cluster.master
+        master.lifecycle_config = cfg
+        master.lifecycle_data_shards = 4
+        master.lifecycle_parity_shards = 2
+        master.lifecycle_concurrency = 4
+        ok0 = counter_value("ec", "ok")
+        try:
+            async with aiohttp.ClientSession() as session:
+                payloads: dict[str, bytes] = {}
+                for i in range(12):
+                    ar = await assign_retry(cluster.master.address)
+                    data = random.Random(i).randbytes(1500 + 13 * i)
+                    await upload_data(
+                        session, ar.url, ar.fid, data, filename=f"l{i}.bin"
+                    )
+                    payloads[ar.fid] = data
+                vids = sorted(
+                    {int(f.split(",")[0]) for f in payloads}
+                )
+
+                async def read_all_identical():
+                    for fid, data in payloads.items():
+                        vid = int(fid.split(",")[0])
+                        locs = master._do_lookup(str(vid)).get(
+                            "locations"
+                        )
+                        assert locs, f"no locations for {vid}"
+                        got = None
+                        for loc in locs:
+                            try:
+                                got = await read_url(
+                                    session,
+                                    f"http://{loc['url']}/{fid}",
+                                )
+                                break
+                            except Exception:
+                                continue
+                        assert got == data, f"fid {fid} bytes diverged"
+
+                await read_all_identical()  # hot phase sanity
+
+                # quarantine one volume: it must never convert
+                vid_q = vids[-1]
+                vol_q = None
+                for vs in cluster.volume_servers:
+                    v = vs.store.find_volume(vid_q)
+                    if v is not None:
+                        vol_q = v
+                        v.scrub_corrupt = True
+                assert vol_q is not None
+
+                # cool: no traffic while heat decays well below cold
+                await asyncio.sleep(3.5)
+
+                convert_vids = [v for v in vids if v != vid_q]
+
+                def all_converted():
+                    return all(
+                        master.topo.lookup("", v) is None
+                        and master.topo.lookup_ec_shards(v) is not None
+                        for v in convert_vids
+                    )
+
+                async def run_rounds():
+                    r = await master.run_lifecycle_once()
+                    assert "error" not in r, r
+                    return r
+
+                for _ in range(60):
+                    if all_converted():
+                        break
+                    await run_rounds()
+                    await asyncio.sleep(0.3)
+                assert all_converted(), (
+                    master.lifecycle_log,
+                    [
+                        (v, master.topo.lookup("", v) is not None)
+                        for v in vids
+                    ],
+                )
+                # the quarantined volume is still a normal volume, and no
+                # conversion was ever dispatched for it
+                assert master.topo.lookup("", vid_q) is not None
+                assert master.topo.lookup_ec_shards(vid_q) is None
+                assert not any(
+                    e.get("volume_id") == vid_q and "skipped" not in e
+                    for e in master.lifecycle_log
+                )
+                assert counter_value("ec", "ok") - ok0 >= len(convert_vids)
+
+                # the retired hot-tier files are genuinely destroyed on
+                # every holder (a surviving .dat could be re-discovered
+                # by a later mount scan and resurrect the volume as a
+                # writable twin of its own EC form)
+                for v in convert_vids:
+                    for vs in cluster.volume_servers:
+                        for loc in vs.store.locations:
+                            base = os.path.join(loc.directory, str(v))
+                            assert not os.path.exists(base + ".dat"), (
+                                f"volume {v}: stale .dat on {vs.address}"
+                            )
+                            assert not os.path.exists(base + ".idx")
+
+                # warm tier serves byte-identically (degraded-read path
+                # untouched — plain EC reads through the .ecx holders)
+                await read_all_identical()
+
+                # reheat ONE volume via reads; a pump keeps it hot until
+                # the dispatcher's authoritative re-check runs
+                vid_hot = convert_vids[0]
+                hot_fids = [
+                    f for f in payloads if int(f.split(",")[0]) == vid_hot
+                ]
+                assert hot_fids
+                stop_pump = asyncio.Event()
+
+                async def pump():
+                    while not stop_pump.is_set():
+                        for fid in hot_fids:
+                            locs = master._do_lookup(str(vid_hot)).get(
+                                "locations"
+                            )
+                            if not locs:
+                                continue
+                            try:
+                                await read_url(
+                                    session,
+                                    f"http://{locs[0]['url']}/{fid}",
+                                )
+                            except Exception:
+                                pass
+                        await asyncio.sleep(0.01)
+
+                pump_task = asyncio.ensure_future(pump())
+                try:
+                    # let heat build + ride an ec_heat tick to the master
+                    await wait_for(
+                        lambda: master.topo.ec_heat_states().get(
+                            vid_hot, {}
+                        ).get("read_heat", 0.0) >= cfg.hot_read_heat,
+                        timeout=20.0,
+                        what="ec heat to reach the master",
+                    )
+
+                    def reinflated():
+                        return (
+                            master.topo.lookup("", vid_hot) is not None
+                            and master.topo.lookup_ec_shards(vid_hot)
+                            is None
+                        )
+
+                    for _ in range(60):
+                        if reinflated():
+                            break
+                        await run_rounds()
+                        await asyncio.sleep(0.3)
+                    assert reinflated(), master.lifecycle_log
+                finally:
+                    stop_pump.set()
+                    pump_task.cancel()
+                    try:
+                        await pump_task
+                    except (asyncio.CancelledError, Exception):
+                        pass
+                assert counter_value("inflate", "ok") >= 1
+
+                # back in the hot tier: byte-identical once more (wait for
+                # the mount delta to reach client-visible lookup)
+                await wait_for(
+                    lambda: master._do_lookup(str(vid_hot)).get(
+                        "locations"
+                    ),
+                    what="re-inflated volume registration",
+                )
+                await read_all_identical()
+
+                # the queue drains to 0 once nothing qualifies any more
+                # (the reheated volume is HOT, so nothing re-plans it; the
+                # other EC volumes are cold and stay EC)
+                r = await run_rounds()
+                for _ in range(20):
+                    r = await run_rounds()
+                    if r["queue_depth"] == 0 and not r["dispatched"]:
+                        break
+                    await asyncio.sleep(0.2)
+                assert r["queue_depth"] == 0, r
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_vacuum_skips_volume_mid_lifecycle_conversion(tmp_path):
+    """Mutual exclusion is two-way: the vacuum dispatcher must refuse a
+    volume the lifecycle plane is converting (a compaction's .dat swap
+    under a running EC encode would bake a mixed-generation shard set),
+    just as lifecycle skips volumes mid-vacuum."""
+    from test_cluster import Cluster
+    from seaweedfs_tpu.topology.repair import RepairTask
+
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        try:
+            m = cluster.master
+            m._lifecycle_inflight.add(42)
+            results = []
+            t = RepairTask(kind="vacuum", vid=42)
+            await m._dispatch_vacuum_task(t, 0.3, results)
+            assert results and results[0].get("skipped"), results
+            # and the other direction (already covered by dispatch code):
+            m._vacuum_inflight.add(43)
+            lresults = []
+            lt = RepairTask(kind="lifecycle_ec", vid=43)
+            await m._dispatch_lifecycle_task(lt, lresults)
+            assert lresults and lresults[0].get("skipped"), lresults
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_lifecycle_status_rpc_and_shell(tmp_path, monkeypatch):
+    """LifecycleStatus RPC + `volume.lifecycle -status` render on a live
+    cluster (no conversions required — shape only)."""
+    from test_cluster import Cluster
+
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        try:
+            from seaweedfs_tpu.shell import CommandEnv, run_command
+
+            env = CommandEnv(cluster.master.address)
+            out = await run_command(env, "volume.lifecycle -status")
+            assert "auto_lifecycle" in out
+            assert "queue depth" in out
+            out = await run_command(env, "volume.lifecycle -run")
+            assert "ran one round" in out
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())

@@ -116,6 +116,18 @@ def test_metrics_hygiene_lint():
             )
     assert not problems, "\n".join(problems)
 
+    # the lint's coverage is only as good as registration at import time:
+    # pin the lifecycle-plane families (ISSUE 10) so a refactor that
+    # moves them out of util/metrics.py (and out of this lint's reach)
+    # fails here instead of silently shrinking coverage
+    names = {metric.name for metric in m.REGISTRY.collectors()}
+    for family in (
+        "seaweedfs_tpu_volume_heat",
+        "seaweedfs_tpu_lifecycle_queue_depth",
+        "seaweedfs_tpu_lifecycle_conversions_total",
+    ):
+        assert family in names, f"lifecycle family {family} not registered"
+
 
 # ---------------- acceptance: live-cluster exposition ----------------
 
