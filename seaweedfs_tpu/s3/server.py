@@ -16,6 +16,7 @@ disabled-IAM behavior.
 from __future__ import annotations
 
 import time
+import urllib.parse
 import uuid
 import xml.etree.ElementTree as ET
 from typing import Optional
@@ -30,6 +31,7 @@ from ..filer import (
     read_from_visible_intervals,
 )
 from ..filer.filer_store import ScanStats, prefix_successor, scan_subtree
+from ..util import tenancy
 from ..util.fasthttp import FALLBACK, render_response
 from ..util.metrics import (
     S3_LIST_REQUESTS,
@@ -284,6 +286,7 @@ class S3Server:
         self.port = port
         self.address = f"{host}:{port}"
         self.iam = iam
+        self._ak_tenants: Optional[dict] = None  # access key -> identity
         self._http_runner: Optional[web.AppRunner] = None
         self._core = None
         self._stage_children: dict = {}
@@ -308,7 +311,10 @@ class S3Server:
         # presigned queries) replays against the aiohttp app
         from ..server.serving_core import ServingCore
 
-        self._core = ServingCore("s3", self._fast_dispatch, self.host, self.port)
+        self._core = ServingCore(
+            "s3", self._fast_dispatch, self.host, self.port,
+            tenant_fn=self._tenant_fn,
+        )
         await self._core.start(app)
         self._http_runner = self._core._http_runner
 
@@ -319,6 +325,65 @@ class S3Server:
             await self._http_runner.cleanup()
 
     # ------------- fast-tier HTTP dispatch (server/serving_core.py) -------------
+    def _tenant_fn(self, req):
+        """S3 tenant principal for admission (ISSUE 12): the V4/V2
+        access key (Authorization header or presigned query) mapped to
+        its IAM identity NAME — one tenant per identity, however many
+        key pairs it rotates through. Derivation is pre-verification on
+        purpose (admission must be µs-cheap; the signature is checked by
+        the handler as before): a forged key attributes the request —
+        and its shed — to the claimed tenant, it never grants data
+        access.
+
+        The access key is consulted FIRST, before the shared header/
+        collection derivation: X-Seaweed-Tenant is client-controlled,
+        and letting it override the authenticated identity would make
+        every IAM quota optional (mint a fresh header name per request)
+        and let anyone drain a victim identity's token bucket with
+        requests that fail auth later. The header keeps working for
+        anonymous/raw traffic the gateway cannot attribute itself."""
+        iam = self.iam
+        if iam is None or not iam.enabled:
+            return tenancy.tenant_from_request(req)
+        ak = None
+        auth = req.headers.get(b"authorization")
+        if auth is not None:
+            i = auth.find(b"Credential=")
+            if i >= 0:  # V4: Credential=AK/date/region/s3/aws4_request
+                j = auth.find(b"/", i)
+                if j > 0:
+                    ak = auth[i + 11: j].decode("latin1")
+            elif auth.startswith(b"AWS "):  # V2: "AWS AK:signature"
+                c = auth.find(b":", 4)
+                if c > 0:
+                    ak = auth[4:c].strip().decode("latin1")
+        if ak is None and req.query:
+            q = req.query
+            i = q.find("X-Amz-Credential=")
+            if i >= 0:  # presigned V4 (%2F-encoded slashes)
+                end = q.find("&", i)
+                val = urllib.parse.unquote(
+                    q[i + 17: end if end >= 0 else len(q)]
+                )
+                ak = val.split("/", 1)[0]
+            else:
+                i = q.find("AWSAccessKeyId=")
+                if i >= 0:  # presigned V2
+                    end = q.find("&", i)
+                    ak = q[i + 15: end if end >= 0 else len(q)]
+        if ak:
+            m = self._ak_tenants
+            if m is None:
+                m = self._ak_tenants = {
+                    cred.access_key: ident.name
+                    for ident in iam.identities
+                    for cred in ident.credentials
+                }
+            name = m.get(ak)
+            if name:
+                return name
+        return tenancy.tenant_from_request(req)
+
     async def _fast_dispatch(self, req):
         """Byte-level handlers for the hot object verbs. Anything the
         fast tier does not fully understand — query strings (presigned

@@ -44,7 +44,7 @@ from ..filer import (
 )
 from ..pb import grpc_address
 from ..pb.rpc import Service, Stub, serve
-from ..util import trace
+from ..util import tenancy, trace
 from ..util.fasthttp import FALLBACK, FastHTTPClient, render_response
 
 
@@ -61,14 +61,21 @@ class ChunkUploadGate:
     latency and batches grow on their own under load. Items the volume
     server declines item-wise (replicated placement, missing volume)
     retry through the plain single-needle path, so semantics never
-    diverge from the unbatched tier."""
+    diverge from the unbatched tier.
+
+    Batches are TENANT-PURE (ISSUE 12): the coalescing key is (host,
+    current tenant), and the flush re-enters the batch's tenant context
+    before sending — so the volume server's admission gate attributes
+    every batched needle to the principal that wrote it, instead of
+    whichever request happened to schedule the flush."""
 
     def __init__(self, http, max_batch: int = 64, max_bytes: int = 32 << 20):
         self.http = http
         self.max_batch = max_batch
         self.max_bytes = max_bytes
-        self._pending: dict[str, list] = {}  # host -> [(fid, payload, fut)]
-        self._bytes: dict[str, int] = {}
+        # (host, tenant) -> [(fid, payload, fut, trace ctx)]
+        self._pending: dict[tuple, list] = {}
+        self._bytes: dict[tuple, int] = {}
         self._count = 0
         self._scheduled = False
         self._loop = None
@@ -84,11 +91,12 @@ class ChunkUploadGate:
         fut = loop.create_future()
         # sampled member contexts ride the item: the flush records one
         # span linked to every member trace (ISSUE 8 batch-seam links)
-        self._pending.setdefault(host, []).append(
+        key = (host, tenancy.current())
+        self._pending.setdefault(key, []).append(
             (fid, payload, fut, trace.current_sampled())
         )
-        nbytes = self._bytes.get(host, 0) + len(payload)
-        self._bytes[host] = nbytes
+        nbytes = self._bytes.get(key, 0) + len(payload)
+        self._bytes[key] = nbytes
         self._count += 1
         if self._count >= self.max_batch or nbytes >= self.max_bytes:
             self._flush()
@@ -104,12 +112,12 @@ class ChunkUploadGate:
         pending, self._pending = self._pending, {}
         self._bytes = {}
         self._count = 0
-        for host, items in pending.items():
+        for (host, tenant), items in pending.items():
             self.stats["uploads"] += len(items)
             self.stats["batches"] += 1
             if len(items) > self.stats["largest_batch"]:
                 self.stats["largest_batch"] = len(items)
-            t = asyncio.ensure_future(self._send(host, items))
+            t = asyncio.ensure_future(self._send(host, tenant, items))
             self._tasks.add(t)
             t.add_done_callback(self._tasks.discard)
 
@@ -127,17 +135,30 @@ class ChunkUploadGate:
         except Exception:
             return ""
 
-    async def _send(self, host: str, items: list) -> None:
+    async def _send(self, host: str, tenant, items: list) -> None:
         # the flush span adopts the first sampled member's trace and
         # links all of them; entering the span ALSO makes it the current
         # context, so the batched POST (and any item-wise retries) carry
-        # it downstream — the volume server's span parents to the flush
+        # it downstream — the volume server's span parents to the flush.
+        # The batch's TENANT context is re-entered the same way: this
+        # task was created from whichever submitter scheduled the flush,
+        # so without the reset a tenant-pure batch could still ship
+        # under a different principal's header.
         members = [c for _f, _p, _fut, c in items if c is not None]
         cm = trace.batch_span(
             "gate.chunk_put", members, host=host, batch=len(items)
         )
-        with cm:
-            await self._send_inner(host, items)
+        # set UNCONDITIONALLY (None included): this task inherited the
+        # context of whichever submitter scheduled the flush, so a
+        # DEFAULT-tenant batch flushed from inside a named tenant's
+        # request would otherwise ship with that tenant's header and
+        # bill their quota for anonymous writes
+        tok = tenancy.set_current(tenant)
+        try:
+            with cm:
+                await self._send_inner(host, items)
+        finally:
+            tenancy.reset_current(tok)
 
     async def _send_inner(self, host: str, items: list) -> None:
         try:

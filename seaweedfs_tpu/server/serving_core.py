@@ -49,7 +49,7 @@ from typing import Optional
 
 from aiohttp import web
 
-from ..util import faults, overload, trace
+from ..util import faults, overload, tenancy, trace
 from ..util.fasthttp import (
     DETACHED,
     FALLBACK,
@@ -62,6 +62,8 @@ from ..util.metrics import REQUEST_COUNTER
 _perf = time.perf_counter
 _coin = trace._rand.random
 _classify = overload.classify_method
+_set_tenant = tenancy.set_current
+_reset_tenant = tenancy.reset_current
 
 
 def _make_debug_middleware(name: str, address: str, pprof=None):
@@ -199,11 +201,19 @@ class ServingCore:
     cold tier every FALLBACK replays against."""
 
     def __init__(self, name: str, handler, host: str, port: int,
-                 pprof=None):
+                 pprof=None, tenant_fn=None):
         self.name = name
         self.handler = handler
         self.host = host
         self.port = port
+        # tenant QoS (ISSUE 12): derive the request's tenant principal
+        # BEFORE admission so the gate's weighted-fair dequeue and
+        # per-tenant quotas see it. The default derivation is the
+        # explicit X-Seaweed-Tenant header, else the `collection` query
+        # parameter; servers with richer identity install their own
+        # (S3: V4 access key -> IAM identity; volume: read-path vid ->
+        # collection). None from the fn means the shared default pool.
+        self.tenant_fn = tenant_fn or tenancy.tenant_from_request
         # None = env opt-in (SEAWEEDFS_TPU_PPROF=1), False = refuse the
         # /debug/pprof surface, True = force it on (volume -pprof flag)
         self.pprof = pprof
@@ -292,6 +302,12 @@ class ServingCore:
             # observable WHILE it sheds.
             return FALLBACK
         gate = self.gate
+        # tenant principal (ISSUE 12): derived BEFORE admission so the
+        # gate's per-tenant subqueues and quotas order THIS request, and
+        # set as the current-context tenant so in-cluster hops (filer ->
+        # volume chunk I/O) carry the same principal downstream. None =
+        # the shared default pool — exactly the pre-tenant behavior.
+        tenant = self.tenant_fn(req)
         if gate is not None:
             # priority admission BEFORE any per-request machinery: the
             # wait charged against the class budget is everything since
@@ -300,7 +316,9 @@ class ServingCore:
             # request that would blow its caller's deadline anyway is
             # refused in microseconds with the pre-rendered 503.
             waited = _perf() - req.t_arrive
-            adm = gate.try_admit(_classify(req.method), waited)
+            adm = gate.try_admit(
+                _classify(req.method), waited, tenant, len(req.body)
+            )
             if adm is not True:
                 if adm is not False:
                     adm = await gate.wait_queued(
@@ -311,6 +329,7 @@ class ServingCore:
                         trace.note_shed(
                             f"{self.name}:{req.method}",
                             server=self.name, path=req.path,
+                            tenant=tenant or "default",
                         )
                     return self._shed_resp
         rec = trace.RECORDER
@@ -330,43 +349,54 @@ class ServingCore:
                     f"{self.name}:{req.method}", pctx,
                     server=self.name, addr=self.address, path=req.path,
                 )
-        plan = faults._PLAN
-        if plan is not None:
-            try:
-                out = await self._apply_fault(plan, req)
-            except BaseException:
-                if gate is not None:
-                    gate.release()
-                raise
-            if out is not None:
-                if gate is not None:
-                    gate.release()
-                if sp is not None:
-                    sp.finish()
-                return out
+                if sp is not None and tenant is not None:
+                    sp.tags["tenant"] = tenant
+        tok = None if tenant is None else _set_tenant(tenant)
         try:
-            out = await self.handler(req)
-        except BaseException as e:
-            # BaseException: a CancelledError (peer dropped mid-handler)
-            # must release the admission slot too, or capacity leaks
-            if gate is not None:
-                gate.release()
-            if sp is not None:
-                sp.finish(err=e)
-            raise
+            plan = faults._PLAN
+            if plan is not None:
+                try:
+                    out = await self._apply_fault(plan, req)
+                except BaseException:
+                    if gate is not None:
+                        gate.release(tenant=tenant)
+                    raise
+                if out is not None:
+                    if gate is not None:
+                        gate.release(tenant=tenant)
+                    if sp is not None:
+                        sp.finish()
+                    return out
+            try:
+                out = await self.handler(req)
+            except BaseException as e:
+                # BaseException: a CancelledError (peer dropped
+                # mid-handler) must release the admission slot too, or
+                # capacity leaks
+                if gate is not None:
+                    gate.release(tenant=tenant)
+                if sp is not None:
+                    sp.finish(err=e)
+                raise
+        finally:
+            if tok is not None:
+                _reset_tenant(tok)
         if gate is not None:
             # feed the AIMD limiter from full fast-tier responses only:
             # FALLBACK walls are µs of proxy hand-off and DETACHED walls
             # end at handler return — either would drag the latency
             # signal (and thus the limit) toward fiction
             if out is FALLBACK or out is DETACHED:
-                gate.release()
+                gate.release(tenant=tenant)
             else:
                 now = _perf()
                 # service wall feeds the AIMD limit; wait+service feeds
-                # the admitted-latency histogram (the server-side
-                # "admitted-request p99" in stats/overload.status)
-                gate.release(now - t0, now - req.t_arrive)
+                # the admitted-latency histograms (per-server AND
+                # per-tenant), response bytes the tenant's byte quota
+                gate.release(
+                    now - t0, now - req.t_arrive, tenant,
+                    len(out) if type(out) is bytes else 0,
+                )
         if enabled:
             if out is FALLBACK or out is DETACHED:
                 # FALLBACK walls are µs of proxy hand-off (the real work

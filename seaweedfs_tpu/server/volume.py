@@ -34,6 +34,7 @@ from ..storage.needle import Needle, NotFoundError
 from ..storage.store import Store
 from ..storage.volume import AlreadyDeleted, CookieMismatch, NotFound, Volume
 from ..storage import vacuum as vacuum_mod
+from ..util import tenancy
 from ..util.fasthttp import (
     DETACHED,
     FALLBACK,
@@ -402,6 +403,7 @@ class VolumeServer(EcHandlers):
         self._core = ServingCore(
             "volume", self._fast_dispatch, self.host, self.port,
             pprof=True if self.pprof else None,
+            tenant_fn=self._tenant_fn,
         )
         await self._core.start(app)
         self._fast_server = self._core.fast_server
@@ -566,6 +568,28 @@ class VolumeServer(EcHandlers):
                 pass
 
     # ------------- fast-tier HTTP dispatch (server/serving_core.py) -------------
+    def _tenant_fn(self, req):
+        """Tenant principal for admission (ISSUE 12): the explicit
+        header / collection query param first (the shared derivation —
+        in-cluster hops from the filer carry the gateway's principal in
+        the header), else the data-plane path's vid maps to the mounted
+        volume's collection, so raw-tier reads of a tenant collection
+        are attributed without the client saying anything."""
+        t = tenancy.tenant_from_request(req)
+        if t is not None:
+            return t
+        p = req.path
+        comma = p.find(",")
+        if comma > 1:
+            try:
+                vid = int(p[1:comma])
+            except ValueError:
+                return None
+            v = self.store.find_volume(vid)
+            if v is not None and v.collection:
+                return v.collection
+        return None
+
     async def _fast_dispatch(self, req):
         """Byte-level hot handlers for the data plane. Any request shape
         outside the fully-understood fast cases returns FALLBACK, which the

@@ -1199,8 +1199,12 @@ async def cmd_overload_status(env, argv) -> str:
     clusters share one process: each gate carries a per-process unique
     `gate` id (server NAMES repeat — three in-process volume servers
     are all "volume"), so the merge de-dupes repeated reports of one
-    gate without collapsing distinct same-named gates."""
+    gate without collapsing distinct same-named gates. ``-tenants``
+    adds each gate's per-tenant rows (ISSUE 12): weight, admitted/shed/
+    queued, quota bucket fill, and the bounded metric label the tenant
+    currently maps to (top-K by heat or 'other')."""
     flags = _parse_flags(argv)
+    show_tenants = flags.get("tenants") == "true"
     lines = []
     seen_gates: set = set()
     open_breakers: dict[str, dict] = {}
@@ -1234,6 +1238,25 @@ async def cmd_overload_status(env, argv) -> str:
                 f"admitted={g.get('admitted_total')} shed={g.get('shed_total')} "
                 f"budget_ms={budgets} pressure={g.get('pressure')}"
             )
+            if show_tenants:
+                for name, t in sorted(
+                    (g.get("tenants") or {}).items()
+                ):
+                    quota = t.get("quota")
+                    qs = (
+                        f" quota[qps={quota.get('qps')} "
+                        f"bps={quota.get('byte_ps')} "
+                        f"req_tokens={quota.get('request_tokens')} "
+                        f"byte_tokens={quota.get('byte_tokens')}]"
+                        if quota
+                        else ""
+                    )
+                    lines.append(
+                        f"  tenant {name}: weight={t.get('weight')} "
+                        f"admitted={t.get('admitted')} "
+                        f"shed={t.get('shed')} queued={t.get('queued')} "
+                        f"label={t.get('label')}{qs}"
+                    )
         for peer, b in (st.get("breakers") or {}).items():
             if b.get("state") != "closed" or b.get("opens"):
                 open_breakers[peer] = b

@@ -14,20 +14,112 @@ Two backends ship:
   S3 gateway), via stdlib urllib so the synchronous volume read path can
   call it without touching an event loop. Unsigned requests; for real
   AWS put signing credentials in front (no egress in this environment).
+
+Remote-call discipline (ISSUE 12 satellite): every S3-backend HTTP call
+runs through `_sync_retry` — the synchronous sibling of
+`util/backoff.retry_async` — with bounded attempts, full-jitter sleeps,
+an absolute per-operation deadline that both shrinks each attempt's
+socket timeout and refuses attempts it cannot finish, the peer's
+``Retry-After`` honored as a sleep floor on 429/503 (both the
+delta-seconds and HTTP-date spellings, via
+`util/fasthttp.parse_retry_after`), and the process-wide `RetryBudget`
+(failures withdraw, a dry bucket suppresses further retries) so a sick
+remote tier cannot amplify into a retry storm from the volume path.
 """
 
 from __future__ import annotations
 
 import os
+import random
 import shutil
 import time
 import urllib.error
 import urllib.request
 from typing import Callable, Optional
 
+from ..util.backoff import (
+    BackoffPolicy,
+    deadline_after,
+    remaining,
+    shared_retry_budget,
+)
+
 ProgressFn = Optional[Callable[[int, float], None]]
 
 _COPY_CHUNK = 1 << 20
+
+# per-operation wall deadlines (seconds): reads/deletes are volume-path
+# latencies, transfers are bulk lifecycle I/O
+_READ_DEADLINE_S = 60.0
+_TRANSFER_DEADLINE_S = 600.0
+_RETRY_POLICY = BackoffPolicy(base=0.1, cap=5.0, attempts=4)
+
+
+def _retryable(e: BaseException) -> bool:
+    if isinstance(e, urllib.error.HTTPError):
+        # 5xx/429: the peer may heal; other 4xx are deterministic
+        return e.code in (429, 500, 502, 503, 504)
+    return isinstance(e, (urllib.error.URLError, TimeoutError, OSError))
+
+
+def _sync_retry(
+    fn: Callable[[float], object],
+    op: str,
+    deadline_s: float,
+    policy: BackoffPolicy = _RETRY_POLICY,
+    rng=None,
+):
+    """Run `fn(attempt_timeout_s)` with bounded, budgeted, deadlined
+    retries. `fn` receives the REMAINING wall budget as its socket
+    timeout, so a slow first attempt shrinks every later one and the
+    operation as a whole respects `deadline_s`."""
+    from ..util.fasthttp import parse_retry_after
+
+    rng = rng or random
+    deadline = deadline_after(deadline_s)
+    budget = shared_retry_budget()
+    last: Optional[BaseException] = None
+    for attempt in range(policy.attempts):
+        try:
+            out = fn(remaining(deadline, default=30.0))
+        except Exception as e:
+            if not _retryable(e):
+                raise
+            last = e
+            if budget is not None:
+                budget.on_failure()
+        else:
+            if budget is not None:
+                # deposit: urllib is its own transport here — nothing
+                # else feeds the budget for these calls (the async
+                # clients deposit in FastHTTPClient.request/Stub.call)
+                budget.on_success()
+            return out
+        if attempt == policy.attempts - 1:
+            break
+        if budget is not None and not budget.allow(op):
+            break
+        d = policy.delay(attempt, rng)
+        if (
+            isinstance(last, urllib.error.HTTPError)
+            and last.code in (429, 503)
+            and last.headers is not None
+        ):
+            ra = last.headers.get("Retry-After")
+            if ra:
+                floor = parse_retry_after(ra.encode("latin1"))
+                if floor:
+                    # the peer asked for breathing room: jitter must not
+                    # undercut it (capped — the deadline still wins)
+                    d = max(d, min(floor, policy.cap))
+        left = remaining(deadline)
+        if left is not None:
+            if left <= 0.002:
+                break
+            d = min(d, left)
+        time.sleep(d)
+    assert last is not None
+    raise last
 
 
 class BackendStorage:
@@ -131,11 +223,12 @@ class S3File:
         return self._url
 
     def read_at(self, size: int, offset: int) -> bytes:
-        req = urllib.request.Request(
-            self._url, headers={"Range": f"bytes={offset}-{offset + size - 1}"}
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=30) as resp:
+        def attempt(timeout: float) -> bytes:
+            req = urllib.request.Request(
+                self._url,
+                headers={"Range": f"bytes={offset}-{offset + size - 1}"},
+            )
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
                 data = resp.read()
                 if resp.status == 206:
                     return data
@@ -143,6 +236,11 @@ class S3File:
                 # object — slice out the requested window instead of
                 # handing back the full body as if it started at offset
                 return data[offset : offset + size]
+
+        try:
+            return _sync_retry(
+                attempt, "tier_s3_read", _READ_DEADLINE_S
+            )
         except urllib.error.HTTPError as e:
             if e.code == 416:
                 return b""
@@ -159,9 +257,14 @@ class S3File:
 
     def size(self) -> int:
         if self._size is None:
-            req = urllib.request.Request(self._url, method="HEAD")
-            with urllib.request.urlopen(req, timeout=30) as resp:
-                self._size = int(resp.headers.get("Content-Length", 0))
+            def attempt(timeout: float) -> int:
+                req = urllib.request.Request(self._url, method="HEAD")
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return int(resp.headers.get("Content-Length", 0))
+
+            self._size = _sync_retry(
+                attempt, "tier_s3_head", _READ_DEADLINE_S
+            )
         return self._size
 
     def close(self) -> None:
@@ -195,25 +298,43 @@ class S3Backend(BackendStorage):
         total = os.path.getsize(path)
         with open(path, "rb") as f:
             data = f.read()
-        req = urllib.request.Request(self._url(key), data=data, method="PUT")
-        with urllib.request.urlopen(req, timeout=300):
-            pass
+
+        def attempt(timeout: float) -> None:
+            req = urllib.request.Request(
+                self._url(key), data=data, method="PUT"
+            )
+            with urllib.request.urlopen(req, timeout=timeout):
+                pass
+
+        # PUT is idempotent (same bytes, same key): safe to retry whole
+        _sync_retry(attempt, "tier_s3_put", _TRANSFER_DEADLINE_S)
         if fn is not None:
             fn(total, 100.0)
         return key, total
 
     def download_file(self, file_name: str, key: str, fn: ProgressFn = None) -> int:
-        req = urllib.request.Request(self._url(key))
-        with urllib.request.urlopen(req, timeout=300) as resp:
-            total = int(resp.headers.get("Content-Length", 0))
-            with open(file_name, "wb") as dst:
-                return _progress_copy(resp, dst, total, fn)
+        def attempt(timeout: float) -> int:
+            req = urllib.request.Request(self._url(key))
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                total = int(resp.headers.get("Content-Length", 0))
+                # (re)open per attempt: a mid-stream failure restarts
+                # the download from byte 0 into a truncated file, never
+                # appends onto a torn tail
+                with open(file_name, "wb") as dst:
+                    return _progress_copy(resp, dst, total, fn)
+
+        return _sync_retry(attempt, "tier_s3_get", _TRANSFER_DEADLINE_S)
 
     def delete_file(self, key: str) -> None:
-        req = urllib.request.Request(self._url(key), method="DELETE")
-        try:
-            with urllib.request.urlopen(req, timeout=30):
+        def attempt(timeout: float) -> None:
+            with urllib.request.urlopen(
+                urllib.request.Request(self._url(key), method="DELETE"),
+                timeout=timeout,
+            ):
                 pass
+
+        try:
+            _sync_retry(attempt, "tier_s3_delete", _READ_DEADLINE_S)
         except urllib.error.HTTPError as e:
             if e.code != 404:
                 raise

@@ -31,10 +31,11 @@ import time
 from collections import deque
 from typing import Awaitable, Callable, Optional
 
-from . import faults, overload, trace
+from . import faults, overload, tenancy, trace
 from .backoff import shared_retry_budget
 
 _perf = time.perf_counter  # bound once: stamped per parsed request
+_cur_tenant = tenancy.current  # bound once: read per client request
 
 FALLBACK = object()  # sentinel: "proxy this request to the full app"
 DETACHED = object()  # sentinel: "the handler will write the response itself
@@ -785,6 +786,32 @@ class FastHTTPServer:
 # ---------------------------------------------------------------- client --
 
 
+def parse_retry_after(raw: bytes) -> Optional[float]:
+    """Seconds from a Retry-After header value: the delta-seconds form,
+    or the IMF-fixdate form (RFC 9110 §10.2.3 — standards-faithful peers
+    send an HTTP-date; a quota shed's backoff floor must survive either
+    spelling). None when unparseable. Cold path: only consulted on
+    503/429 responses."""
+    try:
+        return float(raw)
+    except ValueError:
+        pass
+    try:
+        from email.utils import parsedate_to_datetime
+
+        dt = parsedate_to_datetime(raw.decode("latin1").strip())
+    except (TypeError, ValueError, IndexError, UnicodeDecodeError):
+        return None
+    if dt is None:
+        return None
+    if dt.tzinfo is None:
+        # obsolete asctime form carries no zone: the RFC says GMT
+        from datetime import timezone
+
+        dt = dt.replace(tzinfo=timezone.utc)
+    return max(0.0, dt.timestamp() - time.time())
+
+
 class _ClientConn(asyncio.Protocol):
     """Raw-protocol client connection: one buffer, inline response parse,
     exactly ONE await per request (the completion future). The
@@ -878,10 +905,9 @@ class _ClientConn(asyncio.Protocol):
                 nl = lower.find(b"\r\n", idx)
                 if nl < 0:
                     nl = len(head)
-                try:
-                    retry_after = float(head[idx + 12: nl].strip())
-                except ValueError:
-                    retry_after = None  # HTTP-date form: not spoken
+                # delta-seconds or IMF-fixdate (ISSUE 12 satellite):
+                # either spelling floors the backoff
+                retry_after = parse_retry_after(head[idx + 12: nl].strip())
         if chunked:
             done = self._complete_chunked(end, status, keep, eof, retry_after)
         else:
@@ -1128,9 +1154,15 @@ class FastHTTPClient:
                     f"connect to {hostport} exceeded {timeout}s deadline"
                 ) from e
             raise
+        # cross-hop tenant propagation (ISSUE 12): a non-default current
+        # tenant (set by ServingCore dispatch) rides the explicit header
+        # so the downstream server's admission gate sees the SAME
+        # principal the gateway derived. One contextvar load per
+        # request, the trace-context pattern.
+        tenant = _cur_tenant()
         if (
             not body and not content_type and not headers
-            and method == "GET" and ctx is None
+            and method == "GET" and ctx is None and tenant is None
         ):
             # bodyless GET (the read data plane): one f-string render, no
             # part list/join — measurable at serving QPS rates
@@ -1152,6 +1184,11 @@ class FastHTTPClient:
                 parts.append(
                     b"traceparent: %s\r\n"
                     % trace.format_traceparent_bytes(ctx)
+                )
+            if tenant is not None:
+                parts.append(
+                    b"X-Seaweed-Tenant: %s\r\n"
+                    % tenant.encode("latin1", "replace")
                 )
             parts.append(b"\r\n")
             if body:

@@ -37,6 +37,36 @@ class _Labeled:
         self.kind = kind
         self._lock = threading.Lock()
 
+    def _series_dicts(self) -> list:
+        """Every key->value store holding per-label-set series (the
+        subclass's own dicts); remove_label_value edits them in place."""
+        return []
+
+    def remove_label_value(self, label: str, value: str) -> int:
+        """Drop every series whose `label` equals `value` — the registry
+        seam the bounded tenant-label policy uses to retire a displaced
+        tenant's series (util/tenancy.TenantLabelPolicy): cumulative
+        label cardinality stays capped only if retired values stop
+        rendering. Returns the number of series dropped."""
+        pair = (label, str(value))
+        dropped = 0
+        with self._lock:
+            for d in self._series_dicts():
+                for key in [k for k in d if _key_has(k, pair)]:
+                    del d[key]
+                    dropped += 1
+        return dropped
+
+
+def _key_has(key, pair) -> bool:
+    """Does a label-set key (possibly (key, idx)-wrapped for exemplars)
+    contain the (label, value) pair?"""
+    if key and isinstance(key[0], tuple) and key[0] and isinstance(
+        key[0][0], tuple
+    ):
+        key = key[0]  # histogram exemplar key: ((labels...), bucket_idx)
+    return pair in key
+
 
 class Counter(_Labeled):
     def __init__(self, name: str, help_text: str = ""):
@@ -52,6 +82,9 @@ class Counter(_Labeled):
         """Pre-bound label set with O(1) inc — for per-request hot paths
         where tuple(sorted(labels.items())) per call is measurable."""
         return _CounterChild(self, tuple(sorted(labels.items())))
+
+    def _series_dicts(self) -> list:
+        return [self._values]
 
     def render(self, exemplars: bool = False) -> list[str]:
         out = [
@@ -91,6 +124,18 @@ class Gauge(_Labeled):
         key = tuple(sorted(labels.items()))
         with self._lock:
             self._values[key] += amount
+
+    def remove(self, **labels) -> None:
+        """Drop ONE series (exact label set). A gauge whose label value
+        has been retired by the bounded tenant policy must disappear,
+        not be set to 0 — a 0 still renders and re-mints the purged
+        series."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values.pop(key, None)
+
+    def _series_dicts(self) -> list:
+        return [self._values]
 
     def render(self, exemplars: bool = False) -> list[str]:
         out = [
@@ -143,6 +188,9 @@ class Histogram(_Labeled):
         """Pre-bound label set with an O(1)-overhead observe — the
         histogram analogue of Counter.child, for per-request hot paths."""
         return _HistogramChild(self, tuple(sorted(labels.items())))
+
+    def _series_dicts(self) -> list:
+        return [self._counts, self._sums, self._totals, self._exemplars]
 
     def sum_count(self, **labels) -> tuple:
         """(sum, count) snapshot for one label set — bench legs
@@ -498,8 +546,10 @@ MAINTENANCE_BYTES = REGISTRY.counter(
 OVERLOAD_SHED = REGISTRY.counter(
     "seaweedfs_tpu_overload_shed_total",
     "requests shed by the admission gate, by server, priority class "
-    "(read/write/meta/maint) and reason (deadline = waited past the "
-    "class's queue budget, queue_full = class's queue share exhausted)",
+    "(read/write/meta/maint), tenant (top-K by heat + 'other' — see "
+    "docs/robustness.md Tenant QoS) and reason (deadline = waited past "
+    "the class's queue budget, queue_full = class's queue share "
+    "exhausted, quota = tenant rate/byte token bucket dry)",
 )
 ADMISSION_QUEUE_DEPTH = REGISTRY.gauge(
     "seaweedfs_tpu_admission_queue_depth",
@@ -551,4 +601,38 @@ LIFECYCLE_CONVERSIONS = REGISTRY.counter(
     "lifecycle conversions dispatched by the master planner, by "
     "direction (ec = hot→warm auto-encode, inflate = warm→hot "
     "re-inflation) and result (ok/error/skipped)",
+)
+
+# tenant QoS plane (see docs/robustness.md "Tenant QoS"): per-tenant
+# admission visibility with BOUNDED label cardinality — tenant label
+# values pass through util/tenancy.tenant_label (top-K by decayed heat +
+# 'other'; retired tenants' series are purged via remove_label_value at
+# the registry seam), so these families stay <= K+2 tenant values no
+# matter how many principals the box serves
+TENANT_QUEUE_DEPTH = REGISTRY.gauge(
+    "seaweedfs_tpu_tenant_queue_depth",
+    "requests queued behind the admission limit per tenant subqueue "
+    "(deficit-round-robin within each priority class), by server, gate "
+    "and tenant (top-K + other)",
+)
+TENANT_ADMITTED = REGISTRY.counter(
+    "seaweedfs_tpu_tenant_admitted_total",
+    "requests admitted by the gate per tenant (top-K + other), by "
+    "server and tenant",
+)
+TENANT_ADMITTED_SECONDS = REGISTRY.histogram(
+    "seaweedfs_tpu_tenant_admitted_seconds",
+    "server-side latency (admission wait + service) of admitted "
+    "requests per tenant (top-K + other), by server and tenant",
+)
+
+# the registry seam the bounded-cardinality lint checks: every family
+# that carries a `tenant` label MUST be listed here, or a retired
+# tenant's series would survive the purge and grow cardinality without
+# bound (tests/test_metrics_exposition.py pins this)
+TENANT_LABELED_FAMILIES = (
+    OVERLOAD_SHED,
+    TENANT_QUEUE_DEPTH,
+    TENANT_ADMITTED,
+    TENANT_ADMITTED_SECONDS,
 )

@@ -60,13 +60,20 @@ from collections import deque
 from itertools import count
 from typing import Optional
 
+from . import tenancy
 from .metrics import (
     ADMISSION_LIMIT,
     ADMISSION_QUEUE_DEPTH,
     CIRCUIT_OPEN,
     CIRCUIT_TRANSITIONS,
     OVERLOAD_SHED,
+    TENANT_ADMITTED,
+    TENANT_ADMITTED_SECONDS,
+    TENANT_QUEUE_DEPTH,
 )
+
+_DEFAULT_TENANT = tenancy.DEFAULT_TENANT
+_POLICY_NOTE = tenancy.note_heat  # heat feed for the top-K label policy
 
 # ------------------------------------------------------- priority classes --
 
@@ -194,17 +201,24 @@ _LAT_BUCKETS = 64
 
 def latency_percentile(counts: list, p: float) -> float:
     """Seconds at percentile p in [0,100] of a bucket-count list (as
-    `AdmissionGate.admitted_counts` snapshots/deltas); 0.0 when empty."""
+    `AdmissionGate.admitted_counts` snapshots/deltas); 0.0 when empty.
+
+    Interpolates geometrically WITHIN the covering bucket by rank
+    fraction: the raw bucket midpoint quantizes every answer to a
+    sqrt(2) grid, which turns a p99 RATIO of two such numbers into
+    steps of 1.41x — too coarse for the fairness leg's <= 2x
+    acceptance bound (2.828 = sqrt(2)^3 is a three-bucket gap, wherever
+    the truth lies between 2.0 and 4.0)."""
     total = sum(counts)
     if total <= 0:
         return 0.0
     rank = total * p / 100.0
     seen = 0.0
     for i, c in enumerate(counts):
+        if c and seen + c >= rank:
+            frac = (rank - seen) / c
+            return _LAT_BASE * math.exp(_LAT_LOG_G * (i + frac))
         seen += c
-        if seen >= rank:
-            # geometric midpoint of the covering bucket
-            return _LAT_BASE * math.exp(_LAT_LOG_G * (i + 0.5))
     return _LAT_BASE * math.exp(_LAT_LOG_G * _LAT_BUCKETS)
 
 
@@ -220,6 +234,42 @@ _BUDGET_SCALE = (1.0, 0.8, 0.6, 0.2)
 _QUEUE_SHARE = (1.0, 0.5, 0.25, 0.1)
 
 
+class _TenantState:
+    """Per-tenant bookkeeping inside one gate: DRR weight, quota
+    buckets, and counters. Metric children are bound per LABEL (not per
+    tenant) at the gate level, because the bounded label policy can
+    re-map a tenant to 'other' over its lifetime."""
+
+    __slots__ = (
+        "name", "weight", "quota", "admitted", "shed", "queued",
+        "inflight", "admitted_counts", "pinned", "t_seen", "pub_label",
+        "pub_queued",
+    )
+
+    def __init__(self, name: str, weight: float, quota):
+        self.name = name
+        self.weight = weight
+        self.quota = quota
+        self.admitted = 0
+        self.shed = 0
+        self.queued = 0
+        self.inflight = 0  # admitted, release() not yet seen
+        # operator-installed quota/weight (set_tenant_*): never evicted
+        self.pinned = False
+        self.t_seen = 0.0
+        # the label this state's queued count is currently published
+        # under, and the amount — per-LABEL depth gauges are aggregated
+        # incrementally (many cold tenants share 'other'; last-writer-
+        # wins per tenant would under-report and zero out real backlog)
+        self.pub_label = None
+        self.pub_queued = 0
+        # per-tenant twin of AdmissionGate.admitted_counts (log-bucketed
+        # server-side wait+service): the fairness bench judges tenant
+        # isolation on THESE — a saturated open-loop generator's own
+        # client backlog rides the RTT numbers, not the server's
+        self.admitted_counts = [0] * _LAT_BUCKETS
+
+
 class AdmissionGate:
     """Priority admission for one server's fast tier.
 
@@ -227,7 +277,18 @@ class AdmissionGate:
     admitted (caller MUST pair with `release`), False = shed (caller
     answers 503 immediately), else a Future the caller awaits via
     `wait_queued`. Single-event-loop use only (no locking — ServingCore
-    dispatch is the sole caller)."""
+    dispatch is the sole caller).
+
+    Tenant QoS (ISSUE 12): within each priority class the queue is a
+    set of per-tenant subqueues drained by deficit round robin — each
+    rotation visit tops a tenant's deficit up by its weight
+    (util/tenancy, default 1.0) and a grant costs 1, so over any
+    backlogged window each tenant's admitted share tracks its weight
+    share regardless of arrival order; an idle tenant's deficit resets
+    (no banking), and a cancelled queued waiter is skipped without
+    touching ANY tenant's deficit. Per-tenant token-bucket rate/byte
+    quotas shed with reason="quota" before any queueing — the same
+    pre-rendered µs 503 + Retry-After as every other shed."""
 
     def __init__(
         self,
@@ -258,9 +319,26 @@ class AdmissionGate:
         self.inflight = 0
         self.admitted_total = 0
         self.queued = 0
-        self._queues: tuple = tuple(deque() for _ in range(N_CLASSES))
+        # DRR state, per class: tenant -> subqueue of waiter futures,
+        # the tenant rotation (a tenant is in the rotation iff its
+        # subqueue is non-empty), and per-tenant deficits
+        self._tq: tuple = tuple({} for _ in range(N_CLASSES))
+        self._rrq: tuple = tuple(deque() for _ in range(N_CLASSES))
+        self._deficit: tuple = tuple({} for _ in range(N_CLASSES))
+        # live queued waiter -> (tenant name, quota-charged body bytes)
+        self._fut_tenant: dict = {}
+        self._tenants: dict = {}  # name -> _TenantState
+        self._label_queued: dict = {}  # label -> aggregate queued
         self.shed_total = 0
+        # per-label metric-child caches, all invalidated together when
+        # the label policy purges a retirement (generation check): a
+        # stale cached child would re-mint the purged series on its
+        # next inc, and the caches would grow with CUMULATIVE label
+        # churn instead of staying bounded by the live top-K
+        self._children_gen = tenancy.purge_generation()
         self._shed_children: dict = {}
+        self._tadm_children: dict = {}  # label -> TENANT_ADMITTED child
+        self._tlat_children: dict = {}  # label -> latency hist child
         self.last_shed_t = 0.0
         self._depth_gauge = ADMISSION_QUEUE_DEPTH
         self._limit_gauge = ADMISSION_LIMIT
@@ -280,25 +358,240 @@ class AdmissionGate:
             read_budget_s * s for s in _BUDGET_SCALE
         )
 
+    # -- tenants --
+    def _tenant(self, name: str) -> _TenantState:
+        ts = self._tenants.get(name)
+        if ts is None:
+            ts = self._tenants[name] = _TenantState(
+                name,
+                tenancy.CONFIG.weight(name),
+                tenancy.CONFIG.quota_for(name, clock=self._clock),
+            )
+            # stamp recency BEFORE the prune can run: a fresh state at
+            # t_seen=0.0 would sort first among the victims and the
+            # insertion that triggered the prune would evict ITSELF —
+            # the in-flight request would then book against an orphan
+            # (and a set_tenant_quota call would silently lose its
+            # quota before it could pin the state)
+            ts.t_seen = self._clock()
+            if len(self._tenants) > max(128, 8 * tenancy.POLICY.cap):
+                self._prune_tenants(keep=ts)
+        return ts
+
+    def _prune_tenants(self, keep=None) -> None:
+        """Bound the per-gate tenant table: principal names are
+        client-controlled pre-auth (the header, a sprayed access key),
+        so without eviction a million one-shot names is a memory DoS
+        one layer below the bounded label policy. Evict the
+        longest-idle states that hold NO live obligations — nothing
+        queued (and nothing published into a depth gauge), nothing
+        in flight (a released request must find its state to return
+        the inflight count and charge response bytes), not pinned by
+        an operator's set_tenant_* call, and — for quota'd states —
+        idle past the bucket's refill horizon, so eviction grants
+        nothing that natural refill would not have (a tenant cannot
+        spray names to evict its own byte DEBT). A clean config-
+        derived quota state is evictable: re-derived fresh on next
+        sight, which a name-cycling client gets anyway under per-name
+        quotas."""
+        cap = max(128, 8 * tenancy.POLICY.cap)
+        now = self._clock()
+        victims = sorted(
+            (
+                ts
+                for ts in self._tenants.values()
+                if ts is not keep  # never the state being inserted:
+                # when victims are scarce (everything else pinned or
+                # busy) recency alone cannot protect it
+                and not ts.pinned
+                and ts.queued == 0
+                and ts.pub_queued == 0
+                and ts.inflight == 0
+                and ts.name != _DEFAULT_TENANT
+                and (
+                    ts.quota is None
+                    or now - ts.t_seen >= ts.quota.refill_horizon_s()
+                )
+            ),
+            key=lambda ts: ts.t_seen,
+        )
+        drop = len(self._tenants) - cap // 2
+        for ts in victims[:drop]:
+            del self._tenants[ts.name]
+
+    def set_tenant_quota(
+        self, name: str, qps: float = 0.0, byte_ps: float = 0.0,
+        burst_s: float = 1.0,
+    ) -> None:
+        """Install/replace one tenant's quota buckets (bench legs and
+        shell tooling; env config covers the deployed path)."""
+        ts = self._tenant(name)
+        ts.pinned = True  # operator-installed: survives table pruning
+        ts.quota = (
+            tenancy.TenantQuota(
+                qps=qps, byte_ps=byte_ps, burst_s=burst_s,
+                clock=self._clock,
+            )
+            if (qps > 0.0 or byte_ps > 0.0)
+            else None
+        )
+
+    def set_tenant_weight(self, name: str, weight: float) -> None:
+        ts = self._tenant(name)
+        ts.pinned = True  # operator-installed: survives table pruning
+        ts.weight = min(100.0, max(0.1, weight))
+
+    def tenant_admitted_counts(self, name: str) -> list:
+        """Snapshot of one tenant's log-bucketed server-side admitted
+        latency counts (see latency_percentile); zeros when unseen."""
+        ts = self._tenants.get(name)
+        return (
+            list(ts.admitted_counts)
+            if ts is not None
+            else [0] * _LAT_BUCKETS
+        )
+
+    def _tenant_depth(self, ts: _TenantState) -> None:
+        """Publish ts's queued count into the per-LABEL depth gauge.
+        Labels collapse many tenants (everyone past top-K is 'other'),
+        so the gauge must be the SUM over tenants sharing the label —
+        a per-tenant set() would under-report and a drained tenant
+        would zero out another's real backlog. Incremental O(1): each
+        state remembers what it last published where."""
+        label = tenancy.tenant_label(ts.name)
+        lq = self._label_queued
+        old = ts.pub_label
+        if old is None or old == label:
+            lq[label] = lq.get(label, 0) + ts.queued - ts.pub_queued
+        else:
+            # the tenant's label migrated (top-K retirement/admission):
+            # move its published share between the aggregates. The OLD
+            # label's series must never be re-MINTED here — after a
+            # retirement the purge removed it, and a .set() (even to 0)
+            # would re-insert it and grow cumulative cardinality with
+            # every ever-retired name. Drained -> remove the series;
+            # still-shared but retired -> leave it absent (internal
+            # bookkeeping continues; the last publisher removes it).
+            left = lq.get(old, 0) - ts.pub_queued
+            if left > 0:
+                lq[old] = left
+                if (
+                    old == tenancy.OTHER_LABEL
+                    or tenancy.POLICY.peek_label(old) == old
+                ):
+                    TENANT_QUEUE_DEPTH.set(
+                        left, server=self.server, gate=self.gate_id,
+                        tenant=old,
+                    )
+            else:
+                lq.pop(old, None)
+                TENANT_QUEUE_DEPTH.remove(
+                    server=self.server, gate=self.gate_id, tenant=old
+                )
+            lq[label] = lq.get(label, 0) + ts.queued
+        ts.pub_label = label
+        ts.pub_queued = ts.queued
+        TENANT_QUEUE_DEPTH.set(
+            lq[label], server=self.server, gate=self.gate_id,
+            tenant=label,
+        )
+
+    def _check_children_gen(self) -> None:
+        gen = tenancy.purge_generation()
+        if gen != self._children_gen:
+            self._children_gen = gen
+            self._shed_children.clear()
+            self._tadm_children.clear()
+            self._tlat_children.clear()
+
+    def _count_admitted(self, name: str) -> None:
+        self._check_children_gen()
+        label = tenancy.tenant_label(name)
+        child = self._tadm_children.get(label)
+        if child is None:
+            child = self._tadm_children[label] = TENANT_ADMITTED.child(
+                server=self.server, tenant=label
+            )
+        child.inc()
+
     # -- admission --
-    def try_admit(self, cls: int, waited_s: float = 0.0):
+    def try_admit(
+        self,
+        cls: int,
+        waited_s: float = 0.0,
+        tenant: Optional[str] = None,
+        cost_bytes: int = 0,
+    ):
+        name = tenant or _DEFAULT_TENANT
+        ts = self._tenants.get(name)
+        if ts is None:
+            ts = self._tenant(name)
+        _POLICY_NOTE(name)  # heat feeds the top-K label policy
+        ts.t_seen = self._clock()  # recency for the table prune
         if waited_s > self.queue_budget_s[cls]:
-            self._shed(cls, "deadline")
+            self._shed(cls, "deadline", name)
             return False
         if self.inflight < self.limiter.limit:
+            # quota is consulted LAST, only for a request the gate
+            # would otherwise take: charging a token and then shedding
+            # for deadline/queue_full would bill a compliant tenant
+            # twice for one overload
+            if ts.quota is not None and not ts.quota.try_take(
+                cost_bytes
+            ):
+                self._shed(cls, "quota", name)
+                return False
             self.inflight += 1
             self.admitted_total += 1
+            ts.admitted += 1
+            ts.inflight += 1
+            self._count_admitted(name)
             return True
         if self.queued >= self.max_queue * _QUEUE_SHARE[cls]:
-            self._shed(cls, "queue_full")
+            self._shed(cls, "queue_full", name)
+            return False
+        if ts.quota is not None and not ts.quota.try_take(cost_bytes):
+            self._shed(cls, "quota", name)
             return False
         fut = asyncio.get_event_loop().create_future()
-        self._queues[cls].append(fut)
+        tq = self._tq[cls]
+        q = tq.get(name)
+        if q is None:
+            q = tq[name] = deque()
+        if not q:
+            # invariant: a tenant is in the class rotation iff its
+            # subqueue is non-empty (husks included — they drain lazily)
+            self._rrq[cls].append(name)
+        q.append(fut)
+        self._fut_tenant[fut] = (name, cost_bytes)
         self.queued += 1
+        ts.queued += 1
         self._depth_gauge.set(
             self.queued, server=self.server, gate=self.gate_id
         )
+        self._tenant_depth(ts)
         return fut
+
+    def _drop_queued(self, fut) -> None:
+        """A queued waiter stopped waiting (timeout/cancel): stop
+        counting it NOW; the husk itself drains lazily in _next_queued
+        without touching any tenant's deficit. The quota tokens charged
+        at enqueue are REFUNDED — the request was never served, and a
+        kept token would shed the tenant's next compliant request with
+        reason=quota on top of the deadline shed it already paid."""
+        info = self._fut_tenant.pop(fut, None)
+        self.queued -= 1
+        self._depth_gauge.set(
+            self.queued, server=self.server, gate=self.gate_id
+        )
+        if info is not None:
+            name, cost_bytes = info
+            ts = self._tenants.get(name)
+            if ts is not None:
+                ts.queued -= 1
+                if ts.quota is not None:
+                    ts.quota.refund(cost_bytes)
+                self._tenant_depth(ts)
 
     async def wait_queued(self, cls: int, fut, waited_s: float = 0.0) -> bool:
         """Await a queued admission inside the class's remaining budget;
@@ -307,13 +600,12 @@ class AdmissionGate:
         try:
             await asyncio.wait_for(fut, left)
         except asyncio.TimeoutError:
-            # wait_for cancelled the future; _wake skips cancelled
-            # entries lazily — only the live count must drop NOW
-            self.queued -= 1
-            self._depth_gauge.set(
-                self.queued, server=self.server, gate=self.gate_id
-            )
-            self._shed(cls, "deadline")
+            # wait_for cancelled the future; _next_queued skips
+            # cancelled entries lazily — only the live count must drop
+            # NOW
+            info = self._fut_tenant.get(fut)
+            self._drop_queued(fut)
+            self._shed(cls, "deadline", info[0] if info else None)
             return False
         except asyncio.CancelledError:
             # the caller's task died while queued (client disconnect mid
@@ -324,19 +616,31 @@ class AdmissionGate:
             # otherwise the future is a husk — stop counting it toward
             # the queue depth, same as the timeout path.
             if fut.done() and not fut.cancelled():
+                # granted in the race window: hand back the gate slot
+                # AND the per-tenant bookkeeping — a leaked ts.inflight
+                # would pin the state unevictable forever (the prune
+                # requires inflight == 0), and the quota token bought
+                # no service
                 self.inflight -= 1
+                info = self._fut_tenant.pop(fut, None)
+                if info is not None:
+                    ts = self._tenants.get(info[0])
+                    if ts is not None:
+                        if ts.inflight > 0:
+                            ts.inflight -= 1
+                        if ts.quota is not None:
+                            ts.quota.refund(info[1])
                 self._wake()
             else:
                 fut.cancel()
-                self.queued -= 1
-                self._depth_gauge.set(
-                    self.queued, server=self.server, gate=self.gate_id
-                )
+                self._drop_queued(fut)
             raise
+        self._fut_tenant.pop(fut, None)
         return True
 
-    async def admit(self, cls: int, waited_s: float = 0.0) -> bool:
-        r = self.try_admit(cls, waited_s)
+    async def admit(self, cls: int, waited_s: float = 0.0,
+                    tenant: Optional[str] = None) -> bool:
+        r = self.try_admit(cls, waited_s, tenant)
         if r is True or r is False:
             return r
         return await self.wait_queued(cls, r, waited_s)
@@ -345,10 +649,14 @@ class AdmissionGate:
         self,
         latency_s: Optional[float] = None,
         total_s: Optional[float] = None,
+        tenant: Optional[str] = None,
+        resp_bytes: int = 0,
     ) -> None:
         """`latency_s` is the handler service wall (feeds the AIMD
         limiter), `total_s` the full server-side latency since parse
-        completion (wait + service — feeds the admitted histogram)."""
+        completion (wait + service — feeds the admitted histograms);
+        `tenant`/`resp_bytes` charge the response against the tenant's
+        byte quota and its per-tenant latency series."""
         self.inflight -= 1
         if latency_s is not None:
             lim = self.limiter
@@ -367,41 +675,132 @@ class AdmissionGate:
                     _LAT_BUCKETS - 1,
                 )
             self.admitted_counts[i] += 1
+        # unattributed requests were ADMITTED under the default tenant
+        # (try_admit's `tenant or _DEFAULT_TENANT`): release must book
+        # them the same way, or a wildcard byte quota never sees the
+        # default pool's response bytes and its latency series is
+        # asymmetric with its admitted counter
+        name = tenant or _DEFAULT_TENANT
+        ts = self._tenants.get(name)
+        if ts is not None:
+            if ts.inflight > 0:
+                ts.inflight -= 1
+            if ts.quota is not None and resp_bytes:
+                ts.quota.charge_bytes(resp_bytes)
+        if total_s is not None:
+            if ts is not None:
+                ts.admitted_counts[i] += 1
+            self._check_children_gen()
+            label = tenancy.tenant_label(name)
+            child = self._tlat_children.get(label)
+            if child is None:
+                child = self._tlat_children[label] = (
+                    TENANT_ADMITTED_SECONDS.child(
+                        server=self.server, tenant=label
+                    )
+                )
+            child.observe(total_s)
         self._wake()
 
+    def _next_queued(self):
+        """The next waiter to grant: classes in priority order, tenants
+        within a class by deficit round robin. Returns (fut, cls, name)
+        or None. Cancelled husks are dropped WITHOUT touching deficits:
+        tenant A's cancelled waiters can neither spend A's deficit nor
+        leak B's (the PR 9 regression class, per-tenant edition)."""
+        for cls in range(N_CLASSES):
+            rr = self._rrq[cls]
+            if not rr:
+                continue
+            tq = self._tq[cls]
+            dq = self._deficit[cls]
+            # bounded: each full rotation tops every tenant up by >= 0.1
+            # (the clamped min weight), so <= 10 rotations reach a
+            # deficit of 1; the +len guard absorbs husk-only drains
+            guard = 12 * len(rr) + 16
+            while rr and guard > 0:
+                guard -= 1
+                name = rr[0]
+                q = tq.get(name)
+                while q and q[0].done():
+                    # husk (cancelled waiter): already uncounted by
+                    # _drop_queued; deficits untouched
+                    q.popleft()
+                if not q:
+                    # subqueue drained: out of the rotation, deficit
+                    # resets — an idle tenant cannot bank credit
+                    tq.pop(name, None)
+                    dq.pop(name, None)
+                    rr.popleft()
+                    continue
+                d = dq.get(name, 0.0)
+                if d >= 1.0:
+                    fut = q.popleft()
+                    if q:
+                        dq[name] = d - 1.0
+                    else:
+                        del tq[name]
+                        dq.pop(name, None)
+                        rr.popleft()
+                    return fut, cls, name
+                ts = self._tenants.get(name)
+                dq[name] = d + (ts.weight if ts is not None else 1.0)
+                rr.rotate(-1)
+            if guard <= 0 and rr:
+                # defensive: force progress rather than spin (cannot
+                # happen with weights clamped >= 0.1, kept for safety)
+                name = rr[0]
+                q = tq.get(name)
+                if q:
+                    return q.popleft(), cls, name
+        return None
+
     def _wake(self) -> None:
-        """Hand freed slots to queued waiters, highest class first."""
+        """Hand freed slots to queued waiters: highest class first,
+        weighted-fair across tenants within the class."""
         while self.inflight < self.limiter.limit and self.queued:
-            fut = None
-            for q in self._queues:  # class 0 (reads) first
-                while q:
-                    f = q.popleft()
-                    if not f.done():  # done == cancelled by wait_queued
-                        fut = f
-                        break
-                if fut is not None:
-                    break
-            if fut is None:
+            nxt = self._next_queued()
+            if nxt is None:
                 return  # only cancelled husks remained
+            fut, _cls, name = nxt
+            # the map entry survives the grant: wait_queued pops it on
+            # resume — the granted-then-cancelled race needs it to
+            # return the tenant's inflight count and refund the quota
             self.queued -= 1
             self._depth_gauge.set(
                 self.queued, server=self.server, gate=self.gate_id
             )
+            ts = self._tenants.get(name)
+            if ts is not None:
+                ts.queued -= 1
+                ts.admitted += 1
+                ts.inflight += 1
+                self._tenant_depth(ts)
             self.inflight += 1
             self.admitted_total += 1
+            self._count_admitted(name)
             fut.set_result(True)
 
     # -- shedding / pressure --
-    def _shed(self, cls: int, reason: str) -> None:
+    def _shed(
+        self, cls: int, reason: str, tenant: Optional[str] = None
+    ) -> None:
+        name = tenant or _DEFAULT_TENANT
         self.shed_total += 1
         self.last_shed_t = self._clock()
-        key = (cls, reason)
+        ts = self._tenants.get(name)
+        if ts is not None:
+            ts.shed += 1
+        self._check_children_gen()
+        label = tenancy.tenant_label(name)
+        key = (cls, reason, label)
         child = self._shed_children.get(key)
         if child is None:
             child = self._shed_children[key] = OVERLOAD_SHED.child(
                 server=self.server,
                 gate=self.gate_id,
                 reason=reason,
+                tenant=label,
                 **{"class": CLASS_NAMES[cls]},
             )
         child.inc()
@@ -442,7 +841,33 @@ class AdmissionGate:
                 latency_percentile(self.admitted_counts, 99) * 1e3, 3
             ),
             "pressure": round(self.pressure(), 3),
+            "tenants": self.tenant_stats(),
         }
+
+    def tenant_stats(self, limit: int = 24) -> dict:
+        """Per-tenant view (top `limit` by admitted+shed — the stats
+        payload must stay bounded on a million-principal box): weight,
+        admitted/shed/queued counts, and quota bucket fill."""
+        names = sorted(
+            self._tenants,
+            key=lambda n: -(
+                self._tenants[n].admitted + self._tenants[n].shed
+            ),
+        )[:limit]
+        out = {}
+        for n in names:
+            ts = self._tenants[n]
+            row = {
+                "weight": ts.weight,
+                "admitted": ts.admitted,
+                "shed": ts.shed,
+                "queued": ts.queued,
+                "label": tenancy.POLICY.peek_label(n),
+            }
+            if ts.quota is not None:
+                row["quota"] = ts.quota.snapshot()
+            out[n] = row
+        return out
 
 
 # ------------------------------------------------- gate registry/pressure --

@@ -127,6 +127,84 @@ def test_metrics_hygiene_lint():
         "seaweedfs_tpu_lifecycle_conversions_total",
     ):
         assert family in names, f"lifecycle family {family} not registered"
+    # tenant QoS plane (ISSUE 12): pin the per-tenant families
+    for family in (
+        "seaweedfs_tpu_tenant_queue_depth",
+        "seaweedfs_tpu_tenant_admitted_total",
+        "seaweedfs_tpu_tenant_admitted_seconds",
+        "seaweedfs_tpu_overload_shed_total",
+    ):
+        assert family in names, f"tenant family {family} not registered"
+
+
+def test_tenant_label_cardinality_enforced_at_registry_seam():
+    """Two seam guarantees, both order-independent:
+
+    1. every live family minting a `tenant` label is registered in
+       TENANT_LABELED_FAMILIES — the purge list the top-K policy
+       retires through; a family outside it would accumulate unbounded
+       tenant series on a million-principal box (and the retirement
+       purge must actually remove series from every listed kind);
+    2. the label mint itself (util/tenancy.TenantLabelPolicy) emits at
+       most cap + 2 distinct values (top-K + other + default) no
+       matter how many principals flood it."""
+    from seaweedfs_tpu.util import tenancy
+
+    listed = {f.name for f in m.TENANT_LABELED_FAMILIES}
+
+    def label_pairs(key):
+        # histogram exemplar keys are ((label pairs...), bucket_idx);
+        # everything else is a plain tuple of (k, v) pairs — tolerate
+        # both (and empty label sets) without assuming the shape
+        if (
+            len(key) == 2
+            and isinstance(key[1], int)
+            and isinstance(key[0], tuple)
+        ):
+            key = key[0]
+        return [
+            p for p in key if isinstance(p, tuple) and len(p) == 2
+        ]
+
+    problems = []
+    for metric in m.REGISTRY.collectors():
+        minted = False
+        for d in metric._series_dicts():
+            for key in d:
+                if any(k == "tenant" for k, _v in label_pairs(key)):
+                    minted = True
+        if minted and metric.name not in listed:
+            problems.append(
+                f"{metric.name}: mints tenant labels but is not in "
+                "TENANT_LABELED_FAMILIES (retirement purge would miss "
+                "it — unbounded cardinality)"
+            )
+    assert not problems, "\n".join(problems)
+
+    # hermetic flood through a fresh policy: the mint is the cap
+    retired = []
+    pol = tenancy.TenantLabelPolicy(cap=5, on_retire=retired.append)
+    out = {pol.label(tenancy.DEFAULT_TENANT)}
+    for i in range(500):
+        name = f"lint-tenant-{i}"
+        pol.note(name)
+        out.add(pol.label(name))
+    assert len(out) <= 5 + 2, sorted(out)
+
+    # the purge hook removes series from EVERY registered family kind
+    # (counter, gauge, histogram)
+    m.TENANT_ADMITTED.inc(server="lint", tenant="lint-doomed")
+    m.TENANT_QUEUE_DEPTH.set(
+        1.0, server="lint", gate="g", tenant="lint-doomed"
+    )
+    m.TENANT_ADMITTED_SECONDS.observe(
+        0.01, server="lint", tenant="lint-doomed"
+    )
+    tenancy._purge_retired("lint-doomed")
+    for fam in m.TENANT_LABELED_FAMILIES:
+        assert 'tenant="lint-doomed"' not in "\n".join(fam.render()), (
+            fam.name
+        )
 
 
 # ---------------- acceptance: live-cluster exposition ----------------

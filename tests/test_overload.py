@@ -158,8 +158,10 @@ def test_gate_queue_full_sheds_by_class_share():
     asyncio.run(main())
     assert g.queued == 8
     assert g.shed_total == 2
-    assert (CLASS_MAINT, "queue_full") in g._shed_children
-    assert (CLASS_READ, "queue_full") in g._shed_children
+    # shed children are keyed (class, reason, tenant-label) since the
+    # tenant QoS plane (ISSUE 12); unattributed sheds land on "default"
+    assert (CLASS_MAINT, "queue_full", "default") in g._shed_children
+    assert (CLASS_READ, "queue_full", "default") in g._shed_children
 
 
 def test_gate_cancelled_waiter_leaks_no_accounting():
@@ -239,7 +241,7 @@ def test_gate_queued_wait_past_budget_sheds():
         admitted = await g.wait_queued(CLASS_READ, fut, 0.0)
         assert admitted is False  # nobody released within the budget
         assert g.queued == 0  # live count dropped NOW
-        key = (CLASS_READ, "deadline")
+        key = (CLASS_READ, "deadline", "default")
         assert key in g._shed_children
 
     asyncio.run(main())
@@ -868,7 +870,7 @@ def test_serving_core_sheds_with_retry_after_and_counts(monkeypatch):
             assert st == 503 and b"shed" in body
             assert http.retry_after_remaining(hostport) > 0
             assert core.gate.shed_total >= 1
-            key = (CLASS_READ, "deadline")
+            key = (CLASS_READ, "deadline", "default")
             assert key in core.gate._shed_children
             # /metrics stays reachable WHILE shedding (falls back to the
             # cold tier, exempt from admission)

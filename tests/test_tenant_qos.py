@@ -1,0 +1,1224 @@
+"""Tenant QoS plane (ISSUE 12): identity derivation, deficit-round-robin
+weighted-fair dequeue, per-tenant quotas, bounded metric-label policy,
+cross-hop tenant propagation, and the satellite fixes (Retry-After
+HTTP-date parsing, tier-backend retry discipline).
+
+Three layers, all tier-1 fast:
+
+- pure units with fake clocks (quota buckets, label policy, derivation,
+  Retry-After forms, tier-backend retries against a stubbed urlopen);
+- seeded randomized properties over the gate's DRR dequeue (weighted
+  shares under adversarial arrival orders; cancelled waiters leak no
+  deficit — the PR 9 regression class, per-tenant edition);
+- live-seam e2e: ServingCore quota sheds with Retry-After + per-tenant
+  metrics; an S3 -> filer -> volume cluster where the access-key-derived
+  principal arrives at the VOLUME gate via the propagation header.
+"""
+
+import asyncio
+import random
+import time
+from collections import Counter
+
+import pytest
+
+from seaweedfs_tpu.util import overload, tenancy
+from seaweedfs_tpu.util.overload import (
+    CLASS_READ,
+    AdaptiveLimiter,
+    AdmissionGate,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tenancy():
+    """Each test gets env-default weights/quotas and a fresh label
+    policy; restore after so tenant admissions don't leak across the
+    suite (the policy is process-global on purpose)."""
+    tenancy.configure(weights={}, qps={}, bps={})
+    tenancy.reset_policy()
+    yield
+    tenancy.configure()
+    tenancy.reset_policy()
+
+
+# ------------------------------------------------------------ derivation --
+
+
+class _Req:
+    def __init__(self, headers=None, query="", path="/", body=b""):
+        self.headers = headers or {}
+        self.query = query
+        self.path = path
+        self.body = body
+        self.method = "GET"
+
+
+def test_tenant_from_request_header_wins():
+    r = _Req(
+        headers={tenancy.TENANT_HEADER_B: b"alice"},
+        query="collection=photos",
+    )
+    assert tenancy.tenant_from_request(r) == "alice"
+
+
+def test_tenant_from_request_collection_param():
+    assert (
+        tenancy.tenant_from_request(_Req(query="collection=photos"))
+        == "photos"
+    )
+    assert (
+        tenancy.tenant_from_request(
+            _Req(query="count=4&collection=ph&ttl=3m")
+        )
+        == "ph"
+    )
+    # a SUFFIX match must not fire (xcollection= is a different param)
+    assert (
+        tenancy.tenant_from_request(_Req(query="xcollection=ph")) is None
+    )
+    # ...but a rejected substring hit must not stop the scan: the real
+    # parameter can follow one that merely ENDS in "collection"
+    assert (
+        tenancy.tenant_from_request(
+            _Req(query="mycollection=a&collection=beta")
+        )
+        == "beta"
+    )
+    assert tenancy.tenant_from_request(_Req(query="collection=")) is None
+    assert tenancy.tenant_from_request(_Req()) is None
+
+
+# ----------------------------------------------------------- quota units --
+
+
+def test_tenant_quota_rate_bucket_refills_on_clock():
+    clk = FakeClock()
+    q = tenancy.TenantQuota(qps=10.0, burst_s=1.0, clock=clk)
+    granted = sum(1 for _ in range(25) if q.try_take())
+    assert granted == 10  # the burst bucket
+    assert not q.try_take()
+    clk.advance(0.5)  # +5 tokens
+    granted = sum(1 for _ in range(25) if q.try_take())
+    assert granted == 5
+
+
+def test_tenant_quota_byte_debt_blocks_until_paid_off():
+    clk = FakeClock()
+    q = tenancy.TenantQuota(byte_ps=1000.0, burst_s=1.0, clock=clk)
+    assert q.try_take(cost_bytes=100)
+    # a huge response charged at release drives the bucket NEGATIVE
+    q.charge_bytes(5000)
+    assert not q.try_take(cost_bytes=1)
+    clk.advance(2.0)  # +2000 bytes: still in debt (-4100 + 2000 < 0)
+    assert not q.try_take(cost_bytes=1)
+    clk.advance(3.0)  # paid off and capped at burst
+    assert q.try_take(cost_bytes=1)
+
+
+def test_gate_quota_shed_reason_and_per_tenant_counters():
+    clk = FakeClock()
+    g = AdmissionGate(
+        "t", limiter=AdaptiveLimiter(initial=8), clock=clk
+    )
+    g.set_tenant_quota("a", qps=2.0, burst_s=1.0)
+    assert g.try_admit(CLASS_READ, tenant="a") is True
+    assert g.try_admit(CLASS_READ, tenant="a") is True
+    assert g.try_admit(CLASS_READ, tenant="a") is False  # bucket dry
+    assert (CLASS_READ, "quota", "a") in g._shed_children
+    ts = g.stats()["tenants"]["a"]
+    assert ts["admitted"] == 2 and ts["shed"] == 1
+    assert ts["quota"]["qps"] == 2.0
+    # an unquota'd tenant rides free while a's bucket is dry
+    assert g.try_admit(CLASS_READ, tenant="b") is True
+    clk.advance(1.0)
+    assert g.try_admit(CLASS_READ, tenant="a") is True
+
+
+def test_gate_byte_quota_charges_request_and_response_bytes():
+    clk = FakeClock()
+    g = AdmissionGate(
+        "t", limiter=AdaptiveLimiter(initial=8), clock=clk
+    )
+    g.set_tenant_quota("a", byte_ps=1000.0, burst_s=1.0)
+    assert g.try_admit(CLASS_READ, tenant="a", cost_bytes=200) is True
+    g.release(0.001, 0.001, tenant="a", resp_bytes=5000)
+    assert g.try_admit(CLASS_READ, tenant="a") is False
+    assert (CLASS_READ, "quota", "a") in g._shed_children
+    clk.advance(6.0)
+    assert g.try_admit(CLASS_READ, tenant="a") is True
+
+
+def test_gate_quota_not_charged_on_deadline_or_queue_full_shed():
+    """A compliant quota'd tenant must not be billed for requests the
+    gate refuses for OTHER reasons: a deadline/queue_full shed before
+    the token take would drain the bucket during an overload and then
+    shed the tenant a second time as reason=quota once it clears."""
+    clk = FakeClock()
+    g = AdmissionGate(
+        "t", limiter=AdaptiveLimiter(initial=2, min_limit=2),
+        read_budget_s=0.05, clock=clk,
+    )
+    g.set_tenant_quota("a", qps=2.0, burst_s=1.0)
+    # waited past the class budget: shed reason=deadline, token KEPT
+    assert g.try_admit(CLASS_READ, 1.0, tenant="a") is False
+    assert (CLASS_READ, "deadline", "a") in g._shed_children
+    # both banked tokens still admit
+    assert g.try_admit(CLASS_READ, tenant="a") is True
+    assert g.try_admit(CLASS_READ, tenant="a") is True
+    assert (CLASS_READ, "quota", "a") not in g._shed_children
+    # a dry BYTE bucket must not burn the request token either
+    q = tenancy.TenantQuota(qps=10.0, byte_ps=100.0, clock=clk)
+    q.charge_bytes(10_000)  # deep byte debt
+    rt_before = q._rt
+    assert not q.try_take()
+    assert q._rt == rt_before
+
+
+def test_tenant_table_bounded_under_name_spray():
+    """Principal names are client-controlled pre-auth: a spray of
+    one-shot names must not grow the gate's tenant table without bound
+    (the memory-DoS one layer below the bounded label policy). Pinned
+    (operator-quota'd) and queued tenants survive the prune."""
+
+    async def main():
+        tenancy.reset_policy(cap=4)
+        g = AdmissionGate(
+            "t", limiter=AdaptiveLimiter(initial=2, min_limit=2)
+        )
+        g.set_tenant_quota("precious", qps=1000.0)
+        assert g.try_admit(CLASS_READ, tenant="keeper") is True
+        assert g.try_admit(CLASS_READ, tenant="keeper") is True
+        fut = g.try_admit(CLASS_READ, tenant="queued-tenant")
+        assert asyncio.isfuture(fut)
+        # spray one-shot names whose requests are deadline-shed (the
+        # realistic flood shape: refused in µs, nothing queued — a
+        # QUEUED waiter is a live obligation and legitimately pins its
+        # state, but the queue itself is bounded by max_queue)
+        for i in range(2000):
+            assert (
+                g.try_admit(CLASS_READ, 1.0, tenant=f"spray{i}")
+                is False
+            )
+        cap = max(128, 8 * tenancy.POLICY.cap)
+        assert len(g._tenants) <= cap + 3, len(g._tenants)
+        assert "precious" in g._tenants  # pinned survives
+        assert "queued-tenant" in g._tenants  # live waiter survives
+        # the gate still works after pruning
+        g.release(tenant="keeper")
+        assert fut.done()
+
+    asyncio.run(main())
+
+
+def test_default_pool_release_charges_wildcard_byte_quota():
+    """Unattributed requests are admitted under 'default' — release
+    must book their response bytes there too, or a wildcard byte quota
+    (SEAWEEDFS_TPU_TENANT_BPS='*:N') is inert for the default pool's
+    read traffic."""
+    clk = FakeClock()
+    tenancy.configure(bps={"*": 1000.0})
+    g = AdmissionGate(
+        "t", limiter=AdaptiveLimiter(initial=8), clock=clk
+    )
+    assert g.try_admit(CLASS_READ) is True  # tenant=None -> default
+    # release with tenant=None (the unattributed path serving_core
+    # takes): response bytes must land on the default tenant's bucket
+    g.release(0.001, 0.001, tenant=None, resp_bytes=5000)
+    assert g.try_admit(CLASS_READ) is False  # byte debt
+    assert (CLASS_READ, "quota", "default") in g._shed_children
+    clk.advance(6.0)
+    assert g.try_admit(CLASS_READ) is True
+
+
+def test_reset_policy_purges_abandoned_admitted_labels():
+    """Swapping the policy must purge the OLD policy's admitted labels:
+    abandoned series would be unreachable by any future retirement and
+    grow cumulative cardinality forever (this made the test suite
+    order-dependent before the purge)."""
+    from seaweedfs_tpu.util import metrics as m
+
+    tenancy.reset_policy(cap=4)
+    for i in range(3):
+        name = f"abandoned{i}"
+        tenancy.note_heat(name)
+        assert tenancy.tenant_label(name) == name
+        m.TENANT_ADMITTED.inc(server="rp", tenant=name)
+    tenancy.reset_policy(cap=4)
+    rendered = "\n".join(m.TENANT_ADMITTED.render())
+    for i in range(3):
+        assert f'tenant="abandoned{i}"' not in rendered
+
+
+def test_gate_caches_do_not_remint_after_purge():
+    """A gate's cached per-label metric children must be invalidated by
+    a retirement purge: a stale cached child's next inc would silently
+    re-insert the purged series."""
+    from seaweedfs_tpu.util import metrics as m
+
+    clk = FakeClock()
+    tenancy.reset_policy(cap=1, swap_interval_s=0.0, clock=clk)
+    g = AdmissionGate("gen", limiter=AdaptiveLimiter(initial=8))
+    assert g.try_admit(CLASS_READ, tenant="early") is True  # caches child
+    g.release(0.001, 0.001, tenant="early")
+    clk.advance(0.1)
+    for _ in range(16):
+        tenancy.note_heat("usurper")
+    assert tenancy.tenant_label("usurper") == "usurper"  # retires early
+    rendered = "\n".join(m.TENANT_ADMITTED.render())
+    assert 'tenant="early"' not in rendered  # purged
+    # more traffic from the retired tenant books under 'other', not a
+    # re-minted 'early' series via the stale cached child
+    assert g.try_admit(CLASS_READ, tenant="early") is True
+    g.release(0.001, 0.001, tenant="early")
+    for fam in (m.TENANT_ADMITTED, m.TENANT_ADMITTED_SECONDS):
+        rendered = "\n".join(fam.render())
+        assert 'tenant="early"' not in rendered, fam.name
+        assert 'tenant="other"' in rendered, fam.name
+
+
+def test_granted_then_cancelled_returns_tenant_inflight_and_quota():
+    """The grant/cancel race (slot granted by _wake, caller's task
+    cancelled before it resumed) must hand back the PER-TENANT
+    bookkeeping too: a leaked ts.inflight pins the state unevictable
+    forever, and the quota token bought no service."""
+
+    async def main():
+        g = AdmissionGate(
+            "t",
+            limiter=AdaptiveLimiter(initial=1, min_limit=1),
+        )
+        g.set_tenant_quota("a", qps=2.0, burst_s=1.0)
+        assert g.try_admit(CLASS_READ) is True  # occupy (default)
+        fut = g.try_admit(CLASS_READ, tenant="a")  # charges a token
+        assert asyncio.isfuture(fut)
+        t = asyncio.ensure_future(g.wait_queued(CLASS_READ, fut))
+        await asyncio.sleep(0)  # t parked inside wait_for
+        g.release()  # grants fut via _wake: ts.inflight -> 1
+        assert fut.done() and fut.result() is True
+        t.cancel()
+        try:
+            if await t:
+                # 3.10 wait_for semantics: the grant won — the caller
+                # was admitted and releases normally with its tenant
+                g.release(tenant="a")
+        except asyncio.CancelledError:
+            pass  # 3.12+: wait_queued handed everything back
+        ts = g._tenants["a"]
+        assert ts.inflight == 0, "leaked per-tenant inflight"
+        assert g.inflight == 0
+        # the charged token came back on the cancelled path (or was
+        # legitimately spent on the admitted 3.10 path): either way the
+        # tenant still has at least one token
+        assert g.try_admit(CLASS_READ, tenant="a") is True
+
+    asyncio.run(main())
+
+
+def test_prune_never_evicts_the_newborn_state():
+    """The insertion that trips the prune must not evict ITSELF: a
+    fresh state at t_seen=0 would sort first among the victims, and
+    the in-flight request (or a set_tenant_quota about to pin it)
+    would proceed on an orphan."""
+    clk = FakeClock()
+    tenancy.reset_policy(cap=4)
+    g = AdmissionGate(
+        "t", limiter=AdaptiveLimiter(initial=4), clock=clk
+    )
+    cap = max(128, 8 * tenancy.POLICY.cap)
+    for i in range(cap + 1):
+        clk.advance(0.001)
+        r = g.try_admit(CLASS_READ, tenant=f"old{i}")
+        if r is True:
+            # the release contract is symmetric with try_admit: the
+            # SAME tenant, or the per-tenant inflight count leaks and
+            # the state becomes unevictable
+            g.release(tenant=f"old{i}")
+    clk.advance(0.001)
+    g.set_tenant_quota("newborn", qps=7.0)  # triggers a prune path
+    assert "newborn" in g._tenants
+    assert g._tenants["newborn"].quota is not None
+    # and an admit-created newborn survives its own prune too
+    clk.advance(0.001)
+    assert g.try_admit(CLASS_READ, tenant="baby") is True
+    assert "baby" in g._tenants
+    g.release()
+
+
+def test_queued_deadline_shed_refunds_quota_tokens():
+    """A request quota-charged at enqueue that later sheds on the queue
+    deadline gets its tokens BACK — otherwise the tenant is billed
+    twice for one overload and its next compliant requests shed
+    reason=quota despite never receiving its rate."""
+
+    async def main():
+        g = AdmissionGate(
+            "t",
+            limiter=AdaptiveLimiter(initial=1, min_limit=1),
+            read_budget_s=0.02,
+        )
+        g.set_tenant_quota("a", qps=2.0, burst_s=1.0)
+        assert g.try_admit(CLASS_READ) is True  # occupy the slot
+        fut = g.try_admit(CLASS_READ, tenant="a")  # charges 1 token
+        assert asyncio.isfuture(fut)
+        admitted = await g.wait_queued(CLASS_READ, fut)
+        assert admitted is False  # deadline shed while queued
+        assert (CLASS_READ, "deadline", "a") in g._shed_children
+        # both tokens available again: refunded on the drop
+        g.release()
+        assert g.try_admit(CLASS_READ, tenant="a") is True
+        g.release()
+        assert g.try_admit(CLASS_READ, tenant="a") is True
+        assert (CLASS_READ, "quota", "a") not in g._shed_children
+
+    asyncio.run(main())
+
+
+def test_label_migration_does_not_remint_purged_gauge_series():
+    """After the policy retires a tenant (series purged), a queue event
+    that migrates the tenant's published depth to 'other' must not
+    re-insert the retired label's gauge series — not even at 0."""
+    from seaweedfs_tpu.util.metrics import TENANT_QUEUE_DEPTH
+
+    async def main():
+        clk = FakeClock()
+        tenancy.reset_policy(cap=1, swap_interval_s=0.0, clock=clk)
+        g = AdmissionGate(
+            "remint",
+            limiter=AdaptiveLimiter(initial=1, min_limit=1),
+            clock=clk,
+        )
+        assert g.try_admit(CLASS_READ) is True
+        fut = g.try_admit(CLASS_READ, tenant="victim")  # owns the slot
+        assert asyncio.isfuture(fut)
+
+        def series_for(label):
+            key = tuple(
+                sorted(
+                    {
+                        "server": "remint",
+                        "gate": g.gate_id,
+                        "tenant": label,
+                    }.items()
+                )
+            )
+            return TENANT_QUEUE_DEPTH._values.get(key)
+
+        assert series_for("victim") == 1.0
+        # a hotter principal displaces victim: the purge removes its
+        # series everywhere
+        clk.advance(0.1)
+        for _ in range(16):
+            tenancy.note_heat("hotshot")
+        assert tenancy.tenant_label("hotshot") == "hotshot"
+        assert series_for("victim") is None  # purged
+        # victim's waiter drains: depth migrates to 'other' WITHOUT
+        # re-minting the retired label
+        fut.cancel()
+        g._drop_queued(fut)
+        assert series_for("victim") is None, "retired series re-minted"
+
+    asyncio.run(main())
+
+
+def test_prune_respects_quota_debt_and_inflight():
+    """Eviction must not be a quota-evasion primitive: a state in byte
+    DEBT survives the prune until natural refill would have cleared it
+    anyway, and a state with a request in flight survives so release()
+    can find it (inflight return + response-byte charging)."""
+    clk = FakeClock()
+    tenancy.reset_policy(cap=4)
+    tenancy.configure(bps={"debtor": 1000.0})
+    g = AdmissionGate(
+        "t", limiter=AdaptiveLimiter(initial=4), clock=clk
+    )
+    # debtor consumes a big response -> deep byte debt
+    assert g.try_admit(CLASS_READ, tenant="debtor") is True
+    g.release(0.001, 0.001, tenant="debtor", resp_bytes=50_000)
+    assert g.try_admit(CLASS_READ, tenant="debtor") is False  # in debt
+    # inflight holder: admitted, not yet released
+    assert g.try_admit(CLASS_READ, tenant="holder") is True
+    cap = max(128, 8 * tenancy.POLICY.cap)
+    for i in range(cap + 10):
+        clk.advance(0.001)
+        r = g.try_admit(CLASS_READ, tenant=f"spray{i}")
+        if r is True:
+            g.release(tenant=f"spray{i}")
+    assert "debtor" in g._tenants, "debt erased by name-spray eviction"
+    assert "holder" in g._tenants, "inflight state evicted"
+    assert g.try_admit(CLASS_READ, tenant="debtor") is False  # still owes
+    # past the refill horizon the state is evictable like any other
+    clk.advance(120.0)
+    for i in range(cap + 10):
+        clk.advance(0.001)
+        r = g.try_admit(CLASS_READ, tenant=f"spray2-{i}")
+        if r is True:
+            g.release(tenant=f"spray2-{i}")
+    assert "debtor" not in g._tenants  # debt would have refilled anyway
+
+
+def test_default_chunk_batch_does_not_inherit_flusher_tenant():
+    """A (host, None) chunk batch whose flush happens to be scheduled
+    from inside a named tenant's context must ship WITHOUT that
+    tenant's header — anonymous writes must not bill a bystander."""
+    from seaweedfs_tpu.server.filer import ChunkUploadGate
+
+    seen = []
+
+    class _StubHTTP:
+        async def request(self, method, host, target, **kw):
+            seen.append(tenancy.current())
+            return 201, b'{"eTag": "x"}'
+
+    async def main():
+        gate = ChunkUploadGate(_StubHTTP())
+        # anonymous submit (current tenant None at submit time)
+        fut = gate.submit("h:1", "1,ab", b"data")
+        # the flush callback fires from a context where a NAMED tenant
+        # is current (another request won the call_soon scheduling)
+        tok = tenancy.set_current("alice")
+        try:
+            gate._flush()
+            await fut
+        finally:
+            tenancy.reset_current(tok)
+        assert seen == [None], seen  # no inherited principal
+
+    asyncio.run(main())
+
+
+def test_tenant_depth_gauge_aggregates_across_other_label():
+    """Many cold tenants collapse into the 'other' label: the depth
+    gauge must be the SUM of their queued counts, and one tenant
+    draining must not zero out another's backlog."""
+    from seaweedfs_tpu.util.metrics import TENANT_QUEUE_DEPTH
+
+    async def main():
+        tenancy.reset_policy(cap=1)
+        g = AdmissionGate(
+            "depth-agg", limiter=AdaptiveLimiter(initial=1, min_limit=1)
+        )
+        assert g.try_admit(CLASS_READ) is True  # occupy ("default")
+
+        def other_gauge() -> float:
+            key = tuple(
+                sorted(
+                    {
+                        "server": "depth-agg",
+                        "gate": g.gate_id,
+                        "tenant": tenancy.OTHER_LABEL,
+                    }.items()
+                )
+            )
+            return TENANT_QUEUE_DEPTH._values.get(key, 0.0)
+
+        # cap=1: "default" occupies... first NON-default name takes the
+        # one slot; the next two collapse into 'other'
+        g.try_admit(CLASS_READ, tenant="first")
+        fa = g.try_admit(CLASS_READ, tenant="cold-a")
+        fb1 = g.try_admit(CLASS_READ, tenant="cold-b")
+        fb2 = g.try_admit(CLASS_READ, tenant="cold-b")
+        assert all(asyncio.isfuture(f) for f in (fa, fb1, fb2))
+        assert other_gauge() == 3.0  # 1 (cold-a) + 2 (cold-b), summed
+        # cold-a cancels: only ITS share leaves the aggregate
+        fa.cancel()
+        g._drop_queued(fa)
+        assert other_gauge() == 2.0
+
+    asyncio.run(main())
+
+
+# --------------------------------------------- DRR weighted-fair dequeue --
+
+
+def _drain_one_grant(g, pending):
+    """Release one slot; return the tenant of the single waiter the DRR
+    granted (limit=1 gates grant exactly one per release)."""
+    g.release()
+    for fut, tenant in list(pending.items()):
+        if fut.done() and not fut.cancelled():
+            del pending[fut]
+            return tenant
+    return None
+
+
+def test_drr_weighted_share_property():
+    """Under continuous backlog, each tenant's admitted share tracks its
+    weight share regardless of arrival order — seeded adversarial
+    orders (sorted runs, bursts, shuffles) all converge to 4:2:1."""
+    weights = {"a": 4.0, "b": 2.0, "c": 1.0}
+    tenancy.configure(weights=weights)
+    total_w = sum(weights.values())
+
+    async def run_order(seed: int) -> Counter:
+        rng = random.Random(seed)
+        g = AdmissionGate(
+            "t",
+            limiter=AdaptiveLimiter(initial=1, min_limit=1),
+            max_queue=100000,
+        )
+        assert g.try_admit(CLASS_READ) is True  # occupy the one slot
+        pending: dict = {}
+
+        def enqueue(t: str) -> None:
+            fut = g.try_admit(CLASS_READ, tenant=t)
+            assert asyncio.isfuture(fut)
+            pending[fut] = t
+
+        # adversarial initial burst: one tenant's whole backlog first,
+        # or interleaved, or shuffled — by seed
+        burst = (
+            ["a"] * 40 + ["b"] * 40 + ["c"] * 40
+            if seed % 3 == 0
+            else ["a", "b", "c"] * 40
+        )
+        if seed % 3 == 2:
+            rng.shuffle(burst)
+        for t in burst:
+            enqueue(t)
+        grants: Counter = Counter()
+        for _ in range(350):
+            t = _drain_one_grant(g, pending)
+            assert t is not None
+            grants[t] += 1
+            enqueue(t)  # keep the backlog continuous
+        return grants
+
+    async def main():
+        for seed in (1, 2, 3, 4):
+            grants = await run_order(seed)
+            total = sum(grants.values())
+            for t, w in weights.items():
+                share = grants[t] / total
+                expected = w / total_w
+                assert abs(share - expected) < 0.08, (
+                    seed, t, share, expected, dict(grants)
+                )
+
+    asyncio.run(main())
+
+
+def test_drr_cancelled_waiters_leak_no_deficit():
+    """Tenant a's cancelled queued waiters (the PR 9 regression class)
+    must neither spend a's deficit nor leak into b's: after a storm of
+    cancellations, fresh a/b waiters still split 1:1, and the gate's
+    queue accounting returns to zero."""
+
+    async def main():
+        g = AdmissionGate(
+            "t",
+            limiter=AdaptiveLimiter(initial=1, min_limit=1),
+            max_queue=10000,
+        )
+        assert g.try_admit(CLASS_READ) is True
+        pending: dict = {}
+
+        def enqueue(t: str):
+            fut = g.try_admit(CLASS_READ, tenant=t)
+            assert asyncio.isfuture(fut)
+            pending[fut] = t
+            return fut
+
+        # a cancellation storm from tenant a, interleaved with live b
+        husks = []
+        for _ in range(50):
+            husks.append(enqueue("a"))
+            enqueue("b")
+        for fut in husks:
+            # what wait_queued's CancelledError arm does for a still-
+            # queued waiter
+            fut.cancel()
+            g._drop_queued(fut)
+            del pending[fut]
+        assert g.queued == 50  # only live b waiters count
+        assert g.stats()["tenants"]["a"]["queued"] == 0
+        # all 50 live b waiters drain despite 50 a-husks in the queues
+        got_b = 0
+        for _ in range(50):
+            t = _drain_one_grant(g, pending)
+            assert t == "b"
+            got_b += 1
+        assert got_b == 50
+        assert g.queued == 0
+
+        # fresh 1:1 fairness survives the storm (no banked/leaked
+        # deficit from the cancelled cohort)
+        for _ in range(40):
+            enqueue("a")
+            enqueue("b")
+        grants: Counter = Counter()
+        for _ in range(80):
+            t = _drain_one_grant(g, pending)
+            grants[t] += 1
+        assert grants["a"] == 40 and grants["b"] == 40
+        # queue bookkeeping fully drained
+        assert g.queued == 0
+        st = g.stats()["tenants"]
+        assert st["a"]["queued"] == 0 and st["b"]["queued"] == 0
+
+    asyncio.run(main())
+
+
+def test_drr_idle_tenant_banks_no_deficit():
+    """A tenant whose queue drains leaves the rotation and its deficit
+    resets: returning later, it cannot burst ahead of tenants that
+    queued the whole time."""
+
+    async def main():
+        tenancy.configure(weights={"a": 1.0, "b": 1.0})
+        g = AdmissionGate(
+            "t",
+            limiter=AdaptiveLimiter(initial=1, min_limit=1),
+            max_queue=10000,
+        )
+        assert g.try_admit(CLASS_READ) is True
+        pending: dict = {}
+
+        def enqueue(t: str) -> None:
+            fut = g.try_admit(CLASS_READ, tenant=t)
+            pending[fut] = t
+
+        enqueue("a")
+        assert _drain_one_grant(g, pending) == "a"  # a drains, leaves
+        assert g._deficit[CLASS_READ] == {}  # deficit reset with it
+        for _ in range(10):
+            enqueue("b")
+        enqueue("a")
+        grants = [_drain_one_grant(g, pending) for _ in range(5)]
+        # a reappears with deficit 0 and must round-robin, not burst
+        assert grants.count("a") <= 2
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------- bounded label policy --
+
+
+def test_label_policy_caps_distinct_values():
+    clk = FakeClock()
+    retired = []
+    pol = tenancy.TenantLabelPolicy(
+        cap=3, clock=clk, on_retire=retired.append
+    )
+    labels = set()
+    for i in range(40):
+        name = f"t{i}"
+        pol.note(name)
+        labels.add(pol.label(name))
+    # 3 admitted + other (default is always allowed on top)
+    assert len(labels) <= 4
+    assert tenancy.OTHER_LABEL in labels
+    assert pol.label("t0") == "t0"  # early admits keep their label
+
+
+def test_label_policy_heat_promotion_retires_coldest():
+    clk = FakeClock()
+    retired = []
+    pol = tenancy.TenantLabelPolicy(
+        cap=2, half_life_s=10.0, swap_interval_s=0.0, clock=clk,
+        on_retire=retired.append,
+    )
+    pol.note("cold")
+    assert pol.label("cold") == "cold"
+    pol.note("warm")
+    assert pol.label("warm") == "warm"
+    # a newcomer gets 'other' until it out-heats the coldest 2x
+    pol.note("hot")
+    clk.advance(0.1)
+    assert pol.label("hot") == tenancy.OTHER_LABEL
+    for _ in range(10):
+        pol.note("hot")
+        pol.note("warm")
+    clk.advance(0.1)
+    assert pol.label("hot") == "hot"  # displaced the cold one
+    assert retired == ["cold"]
+    assert pol.label("cold") == tenancy.OTHER_LABEL
+
+
+def test_label_retirement_purges_metric_series():
+    """The registry seam: a retired tenant's series disappear from every
+    tenant-labeled family — the purge is what keeps CUMULATIVE label
+    cardinality capped, not just the instantaneous admit set."""
+    from seaweedfs_tpu.util import metrics as m
+
+    m.TENANT_ADMITTED.inc(server="t", tenant="doomed")
+    m.TENANT_ADMITTED_SECONDS.observe(0.01, server="t", tenant="doomed")
+    m.OVERLOAD_SHED.inc(
+        server="t", gate="x", reason="quota", tenant="doomed",
+        **{"class": "read"},
+    )
+    tenancy._purge_retired("doomed")
+    for fam in m.TENANT_LABELED_FAMILIES:
+        rendered = "\n".join(fam.render())
+        assert 'tenant="doomed"' not in rendered, fam.name
+
+
+def test_gate_label_cardinality_bounded_under_tenant_flood():
+    """A gate flooded by hundreds of distinct principals keeps every
+    tenant-labeled family within cap+2 distinct values (top-K + other +
+    default) — the million-user box cannot mint a million series."""
+    from seaweedfs_tpu.util import metrics as m
+
+    tenancy.reset_policy(cap=4)
+    g = AdmissionGate("flood", limiter=AdaptiveLimiter(initial=4))
+    for i in range(300):
+        name = f"flood{i}"
+        r = g.try_admit(CLASS_READ, tenant=name)
+        if r is True:
+            g.release(0.001, 0.001, tenant=name)
+        # quota-less flood also sheds on queue_full eventually; both
+        # paths mint labels through the policy
+    for fam in m.TENANT_LABELED_FAMILIES:
+        values = set()
+        for d in fam._series_dicts():
+            for key in d:
+                # exemplar keys are ((label pairs...), bucket_idx)
+                if (
+                    len(key) == 2
+                    and isinstance(key[1], int)
+                    and isinstance(key[0], tuple)
+                ):
+                    key = key[0]
+                values.update(
+                    v
+                    for p in key
+                    if isinstance(p, tuple) and len(p) == 2
+                    for k, v in (p,)
+                    if k == "tenant"
+                )
+        flood_values = {v for v in values if v.startswith("flood")}
+        assert len(flood_values) <= 4, (fam.name, sorted(flood_values))
+
+
+# --------------------------------------------------- Retry-After parsing --
+
+
+def test_parse_retry_after_delta_and_http_date():
+    from email.utils import formatdate
+
+    from seaweedfs_tpu.util.fasthttp import parse_retry_after
+
+    assert parse_retry_after(b"3") == 3.0
+    assert parse_retry_after(b"0.5") == 0.5
+    future = formatdate(time.time() + 60, usegmt=True).encode()
+    v = parse_retry_after(future)
+    assert 55.0 < v <= 60.5
+    past = formatdate(time.time() - 60, usegmt=True).encode()
+    assert parse_retry_after(past) == 0.0  # stale date floors at 0
+    assert parse_retry_after(b"not a date") is None
+    assert parse_retry_after(b"") is None
+
+
+def test_client_honors_http_date_retry_after():
+    """A standards-faithful peer shedding with an IMF-fixdate
+    Retry-After still floors the client's backoff (fasthttp satellite:
+    the delta-seconds-only parse dropped the hint entirely)."""
+    from email.utils import formatdate
+
+    from seaweedfs_tpu.util.fasthttp import (
+        FastHTTPClient,
+        FastHTTPServer,
+        render_response,
+    )
+
+    async def main():
+        date = formatdate(time.time() + 30, usegmt=True)
+        resp = render_response(
+            503,
+            b'{"error":"shed"}',
+            extra=b"Retry-After: %s\r\n" % date.encode(),
+        )
+
+        async def handler(req):
+            return resp
+
+        srv = FastHTTPServer(handler)
+        await srv.start("127.0.0.1", 0)
+        port = srv._server.sockets[0].getsockname()[1]
+        hostport = f"127.0.0.1:{port}"
+        http = FastHTTPClient()
+        try:
+            st, _ = await http.request("GET", hostport, "/x")
+            assert st == 503
+            rem = http.retry_after_remaining(hostport)
+            assert 25.0 < rem <= 30.5, rem
+        finally:
+            await http.close()
+            await srv.stop()
+
+    asyncio.run(main())
+
+
+# ----------------------------------------------- tier-backend discipline --
+
+
+def _install_urlopen(monkeypatch, script):
+    """Stub urllib.request.urlopen with a scripted sequence; records
+    the timeout passed per attempt."""
+    import urllib.request
+
+    calls = []
+
+    class _Resp:
+        status = 206
+
+        def __init__(self, body=b"ok"):
+            self._body = body
+            self.headers = {"Content-Length": str(len(body))}
+
+        def read(self):
+            return self._body
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    def fake_urlopen(req, timeout=None):
+        calls.append(timeout)
+        step = script.pop(0)
+        if isinstance(step, BaseException):
+            raise step
+        return _Resp(step)
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    return calls
+
+
+def test_tier_backend_read_retries_transient_then_succeeds(monkeypatch):
+    import urllib.error
+
+    from seaweedfs_tpu.storage.tier_backend import S3File, _RETRY_POLICY
+    from seaweedfs_tpu.util.backoff import (
+        BackoffPolicy,
+        configure_retry_budget,
+    )
+
+    monkeypatch.setattr(
+        "seaweedfs_tpu.storage.tier_backend._RETRY_POLICY",
+        BackoffPolicy(base=0.0001, cap=0.001, attempts=4),
+    )
+    configure_retry_budget(None)  # isolate from other tests' budgets
+    calls = _install_urlopen(
+        monkeypatch,
+        [
+            urllib.error.URLError("conn reset"),
+            TimeoutError("slow"),
+            b"payload",
+        ],
+    )
+    f = S3File("http://remote", "b", "k")
+    assert f.read_at(7, 0) == b"payload"
+    assert len(calls) == 3
+    # deadline propagation: each attempt's socket timeout shrinks
+    assert all(t is not None for t in calls)
+    assert calls[2] <= calls[0]
+
+
+def test_tier_backend_non_retryable_4xx_raises_once(monkeypatch):
+    import urllib.error
+
+    from seaweedfs_tpu.storage.tier_backend import S3File
+    from seaweedfs_tpu.util.backoff import configure_retry_budget
+
+    configure_retry_budget(None)
+    err = urllib.error.HTTPError(
+        "http://remote/b/k", 403, "forbidden", {}, None
+    )
+    calls = _install_urlopen(monkeypatch, [err, b"never"])
+    f = S3File("http://remote", "b", "k")
+    with pytest.raises(urllib.error.HTTPError):
+        f.read_at(4, 0)
+    assert len(calls) == 1  # deterministic failure: no retry burned
+
+
+def test_tier_backend_retry_budget_suppresses_storm(monkeypatch):
+    """A drained RetryBudget suppresses tier-backend retries: each call
+    pays ONE attempt instead of the full policy, so a dead remote tier
+    costs the volume path O(calls), not O(calls x attempts)."""
+    import urllib.error
+
+    from seaweedfs_tpu.storage.tier_backend import S3File
+    from seaweedfs_tpu.util.backoff import (
+        BackoffPolicy,
+        RetryBudget,
+        configure_retry_budget,
+    )
+
+    monkeypatch.setattr(
+        "seaweedfs_tpu.storage.tier_backend._RETRY_POLICY",
+        BackoffPolicy(base=0.0001, cap=0.001, attempts=4),
+    )
+    budget = RetryBudget(ratio=0.1, max_tokens=4.0)
+    for _ in range(10):
+        budget.on_failure()  # drained by earlier failures
+    configure_retry_budget(budget)
+    try:
+        calls = _install_urlopen(
+            monkeypatch,
+            [urllib.error.URLError("down")] * 8,
+        )
+        f = S3File("http://remote", "b", "k")
+        with pytest.raises(urllib.error.URLError):
+            f.read_at(4, 0)
+        assert len(calls) == 1  # suppressed after the first failure
+    finally:
+        configure_retry_budget(None)
+
+
+def test_tier_backend_honors_retry_after_floor(monkeypatch):
+    import urllib.error
+
+    from seaweedfs_tpu.storage.tier_backend import S3File
+    from seaweedfs_tpu.util.backoff import (
+        BackoffPolicy,
+        configure_retry_budget,
+    )
+
+    monkeypatch.setattr(
+        "seaweedfs_tpu.storage.tier_backend._RETRY_POLICY",
+        BackoffPolicy(base=0.0001, cap=0.5, attempts=2),
+    )
+    configure_retry_budget(None)
+    err = urllib.error.HTTPError(
+        "http://remote/b/k", 503, "busy", {"Retry-After": "0.2"}, None
+    )
+    calls = _install_urlopen(monkeypatch, [err, b"ok"])
+    slept = []
+    monkeypatch.setattr(
+        "seaweedfs_tpu.storage.tier_backend.time.sleep", slept.append
+    )
+    f = S3File("http://remote", "b", "k")
+    assert f.read_at(2, 0) == b"ok"
+    assert slept and slept[0] >= 0.2  # the peer's floor, not jitter
+
+
+# ------------------------------------------------------------- live e2e --
+
+
+def test_serving_core_quota_shed_and_tenant_metrics():
+    """One live ServingCore: a quota'd tenant's overage is refused with
+    the pre-rendered 503 + Retry-After, counted per (class, reason,
+    tenant), while another tenant keeps being served; per-tenant
+    admitted series exist; /debug/overload reports tenant stats."""
+    import json
+
+    from aiohttp import web
+
+    from seaweedfs_tpu.server.serving_core import ServingCore
+    from seaweedfs_tpu.util.fasthttp import (
+        FastHTTPClient,
+        render_response,
+    )
+    from seaweedfs_tpu.util.metrics import OVERLOAD_SHED
+
+    async def main():
+        ok = render_response(200, b"served")
+
+        async def handler(req):
+            return ok
+
+        core = ServingCore("t", handler, "127.0.0.1", 0)
+        app = web.Application()
+        await core.start(app)
+        port = core.fast_server._server.sockets[0].getsockname()[1]
+        hostport = f"127.0.0.1:{port}"
+        http = FastHTTPClient()
+        try:
+            gate = core.gate
+            assert gate is not None
+            gate.set_tenant_quota("greedy", qps=2.0, burst_s=1.0)
+            statuses = []
+            for _ in range(6):
+                st, body = await http.request(
+                    "GET", hostport, "/x",
+                    headers={"X-Seaweed-Tenant": "greedy"},
+                )
+                statuses.append(st)
+            assert statuses.count(200) == 2
+            assert statuses.count(503) == 4
+            assert http.retry_after_remaining(hostport) > 0
+            # the polite tenant is untouched by greedy's dry bucket
+            st, body = await http.request(
+                "GET", hostport, "/y",
+                headers={"X-Seaweed-Tenant": "polite"},
+            )
+            assert (st, body) == (200, b"served")
+            # counters: shed carries (class, reason=quota, tenant)
+            sheds = {
+                dict(k).get("tenant"): v
+                for k, v in OVERLOAD_SHED._values.items()
+                if dict(k).get("server") == "t"
+                and dict(k).get("reason") == "quota"
+            }
+            assert sheds.get("greedy") == 4
+            # per-tenant stats ride /debug/overload for the shell
+            st, body = await http.request(
+                "GET", hostport, "/debug/overload"
+            )
+            assert st == 200
+            payload = json.loads(body)
+            gates = {
+                g["gate"]: g for g in payload["gates"]
+            }
+            tstats = gates[gate.gate_id]["tenants"]
+            assert tstats["greedy"]["shed"] == 4
+            assert tstats["greedy"]["quota"]["qps"] == 2.0
+            assert tstats["polite"]["admitted"] == 1
+        finally:
+            await http.close()
+            await core.stop()
+
+    asyncio.run(main())
+
+
+def test_s3_access_key_tenant_reaches_volume_gate(tmp_path):
+    """The acceptance identity chain: a V4-signed S3 PUT/GET is
+    attributed to its IAM identity at the S3 gate, the principal rides
+    the filer's chunk I/O (contextvar -> X-Seaweed-Tenant header), and
+    the VOLUME server's gate books the same tenant — master/volume/
+    filer/S3 all see one principal."""
+    from test_cluster import free_port_pair
+
+    from seaweedfs_tpu.pb.rpc import close_all_channels
+    from seaweedfs_tpu.s3.auth import (
+        IdentityAccessManagement,
+        sign_request,
+    )
+    from seaweedfs_tpu.s3.server import S3Server
+    from seaweedfs_tpu.server.filer import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from seaweedfs_tpu.util.fasthttp import FastHTTPClient
+
+    iam = IdentityAccessManagement.from_config(
+        {
+            "identities": [
+                {
+                    "name": "acme",
+                    "credentials": [
+                        {"accessKey": "AKacme", "secretKey": "SKacme"}
+                    ],
+                    "actions": ["Admin"],
+                }
+            ]
+        }
+    )
+
+    async def body():
+        ms = MasterServer(port=free_port_pair(), pulse_seconds=0.2)
+        await ms.start()
+        d = tmp_path / "vol"
+        d.mkdir(exist_ok=True)
+        vs = VolumeServer(
+            master=ms.address,
+            directories=[str(d)],
+            port=free_port_pair(),
+            pulse_seconds=0.2,
+            max_volume_counts=[10],
+        )
+        await vs.start()
+        fs = FilerServer(
+            master=ms.address, port=free_port_pair(), chunk_size=1024
+        )
+        await fs.start()
+        s3 = S3Server(fs, port=free_port_pair(), iam=iam)
+        await s3.start()
+        http = FastHTTPClient()
+        try:
+            for _ in range(100):
+                if ms.topo.data_nodes():
+                    break
+                await asyncio.sleep(0.1)
+
+            def signed(method, path, payload=b""):
+                hs = sign_request(
+                    method, f"http://{s3.address}{path}", {}, payload,
+                    "AKacme", "SKacme",
+                )
+                return {
+                    k: v for k, v in hs.items() if k.lower() != "host"
+                }
+
+            st, _ = await http.request(
+                "PUT", s3.address, "/tq-bucket",
+                headers=signed("PUT", "/tq-bucket"),
+            )
+            assert st == 200
+            body_b = b"tenant-payload" * 300  # multi-chunk at 1KB
+            st, _ = await http.request(
+                "PUT", s3.address, "/tq-bucket/obj",
+                body=body_b,
+                headers=signed("PUT", "/tq-bucket/obj", body_b),
+            )
+            assert st == 200
+            st, got = await http.request(
+                "GET", s3.address, "/tq-bucket/obj",
+                headers=signed("GET", "/tq-bucket/obj"),
+            )
+            assert st == 200 and got == body_b
+            # the S3 gate attributed the signed verbs to the identity
+            s3_tenants = s3._core.gate.stats()["tenants"]
+            assert s3_tenants.get("acme", {}).get("admitted", 0) >= 2
+            # and the VOLUME gate saw the SAME principal via the
+            # propagation header on the filer's chunk I/O
+            vol_tenants = vs._core.gate.stats()["tenants"]
+            assert vol_tenants.get("acme", {}).get("admitted", 0) > 0
+        finally:
+            await http.close()
+            await s3.stop()
+            await fs.stop()
+            await vs.stop()
+            await ms.stop()
+            await close_all_channels()
+
+    asyncio.run(body())
+
+
+def test_overload_status_shell_tenants_flag(tmp_path, monkeypatch):
+    """`overload.status -tenants` renders per-tenant rows (weight,
+    admitted/shed, quota fill, bounded label) under each gate."""
+    monkeypatch.setenv("SEAWEEDFS_TPU_ADMIT", "1")
+    from test_cluster import Cluster
+
+    from seaweedfs_tpu.shell.command_env import CommandEnv
+    from seaweedfs_tpu.shell.commands import run_command
+    from seaweedfs_tpu.util.fasthttp import FastHTTPClient
+
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        http = FastHTTPClient()
+        try:
+            vs = cluster.volume_servers[0]
+            vs._core.gate.set_tenant_quota("metered", qps=1.0)
+            for _ in range(4):
+                await http.request(
+                    "GET", vs.address, "/nonexistent",
+                    headers={"X-Seaweed-Tenant": "metered"},
+                )
+            env = CommandEnv(cluster.master.address)
+            out = await run_command(env, "overload.status -tenants")
+            assert "tenant metered:" in out, out
+            assert "quota[qps=1.0" in out
+            assert "label=metered" in out
+            # without the flag the per-tenant rows stay out of the way
+            out2 = await run_command(env, "overload.status")
+            assert "tenant metered:" not in out2
+        finally:
+            await http.close()
+            await cluster.stop()
+
+    asyncio.run(body())
