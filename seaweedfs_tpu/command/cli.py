@@ -76,8 +76,9 @@ def _add_volume_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "-index",
         default="memory",
-        choices=["memory", "leveldb", "sorted"],
-        help="needle map kind (ref NeedleMapKind, weed/storage/needle_map.go:14)",
+        choices=["memory", "leveldb", "sorted", "lsm"],
+        help="needle map kind (ref NeedleMapKind, weed/storage/needle_map.go:14;"
+        " lsm = memory-bounded out-of-core map with O(tail) snapshot mount)",
     )
     p.add_argument(
         "-jwtSigningKey",
@@ -291,7 +292,10 @@ def cmd_server(argv: list[str]) -> int:
         "vectorized bulk lookup (device IndexSnapshot when attached)",
     )
     p.add_argument("-tierConfig", default="")
-    p.add_argument("-index", default="memory", choices=["memory", "leveldb", "sorted"])
+    p.add_argument(
+        "-index", default="memory",
+        choices=["memory", "leveldb", "sorted", "lsm"],
+    )
     p.add_argument("-cpuprofile", default="", help="cpu profile output file")
     p.add_argument("-memprofile", default="", help="memory profile output file")
     p.add_argument(
@@ -803,6 +807,11 @@ def _fix(args) -> int:
 
     scan_volume_file(dat, sb, visit, read_body=False)
     nm.save_to_idx(base + ".idx")
+    # the .idx was rewritten wholesale (key-sorted): a persisted lsm
+    # needle-map snapshot folding the old log must not survive
+    from ..storage.needle_map.lsm_map import invalidate_snapshot
+
+    invalidate_snapshot(base)
     dat.close()
     print(f"rebuilt {base}.idx with {len(nm)} entries")
     return 0
@@ -847,7 +856,7 @@ port = 8080
 dir = "./data"
 max = "7"
 mserver = "127.0.0.1:9333"
-index = "memory"          # memory | leveldb | sorted
+index = "memory"          # memory | leveldb | sorted | lsm
 
 [server]
 volumePort = 8080

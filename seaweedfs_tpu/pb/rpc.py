@@ -25,9 +25,12 @@ import grpc
 import grpc.aio
 import msgpack
 
+from urllib.parse import quote as _quote
+
 from . import http_address
 from ..util import faults, overload, trace
 from ..util.backoff import shared_retry_budget
+from ..util.tenancy import current as _tenancy_current
 
 UNARY_UNARY = "unary_unary"
 UNARY_STREAM = "unary_stream"
@@ -58,6 +61,29 @@ def _trace_metadata(context) -> "trace.SpanCtx | None":
     return None
 
 
+def _tenant_metadata(context) -> "str | None":
+    """Tenant principal from call metadata (Stub.call injects it from
+    the contextvar, same propagation as traceparent) — the identity the
+    per-tenant byte quota charges gRPC message bytes against. Values
+    travel percent-encoded (see Stub.call): gRPC metadata must be
+    ASCII, but tenant names derive from client-controlled headers and
+    collection params that need not be."""
+    try:
+        md = context.invocation_metadata()
+    except Exception:
+        return None
+    if not md:
+        return None
+    for item in md:
+        if item[0] == "x-seaweed-tenant":
+            if not item[1]:
+                return None
+            from urllib.parse import unquote
+
+            return unquote(item[1])
+    return None
+
+
 @dataclass
 class _Method:
     kind: str
@@ -65,10 +91,20 @@ class _Method:
 
 
 class Service:
-    """One named gRPC service; register handlers then add to a server."""
+    """One named gRPC service; register handlers then add to a server.
 
-    def __init__(self, name: str):
+    `gate` (settable any time before a call arrives) is the owning
+    server's AdmissionGate: when present, every unary handler charges
+    its request/response MESSAGE bytes against the caller tenant's byte
+    quota (util/tenancy.TenantQuota) — the same buckets the HTTP plane
+    bills, closing the "quotas are HTTP-only" gap (a tenant could move
+    bulk bytes over BatchRead/VolumeCopy for free). Over-quota calls
+    abort RESOURCE_EXHAUSTED in microseconds, counted
+    overload_shed_total{class="rpc", reason="quota"}."""
+
+    def __init__(self, name: str, gate=None):
         self.name = name
+        self.gate = gate
         self._methods: Dict[str, _Method] = {}
 
     def unary(self, method_name: str):
@@ -97,30 +133,65 @@ class Service:
         for mname, m in self._methods.items():
             if m.kind == UNARY_UNARY:
 
-                def make_uu(handler, method=mname, service=self.name):
+                def make_uu(handler, method=mname, service=self.name,
+                            svc=self):
                     async def call(request, context):
-                        # trace join over the gRPC seam: a `traceparent`
-                        # metadata entry (Stub.call injects it) makes the
-                        # handler a span of the caller's trace — master
-                        # leases, repair dispatches and vacuum RPCs all
-                        # line up in one timeline
-                        pctx = _trace_metadata(context)
-                        if pctx is None:
-                            return _pack(
-                                await handler(_unpack(request), context)
-                            )
-                        sp = trace.begin_request(
-                            f"rpc:{method}", pctx, service=service,
-                        )
+                        # per-tenant byte quota at the message seam
+                        # (ISSUE 13): request bytes consult the caller
+                        # tenant's bucket BEFORE any work; the tenant
+                        # rides the contextvar through the handler so
+                        # nested hops keep the principal
+                        gate = svc.gate
+                        tenant = _tenant_metadata(context)
+                        tok = None
+                        if gate is not None:
+                            if not gate.charge_rpc_bytes(
+                                tenant, len(request)
+                            ):
+                                await context.abort(
+                                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                                    "tenant byte quota exceeded",
+                                )
+                        if tenant is not None:
+                            from ..util import tenancy as _tenancy
+
+                            tok = _tenancy.set_current(tenant)
                         try:
-                            out = await handler(_unpack(request), context)
-                        except Exception as e:
-                            if sp is not None:
-                                sp.finish(err=e)
-                            raise
-                        if sp is not None:
-                            sp.finish()
-                        return _pack(out)
+                            # trace join over the gRPC seam: a
+                            # `traceparent` metadata entry (Stub.call
+                            # injects it) makes the handler a span of the
+                            # caller's trace — master leases, repair
+                            # dispatches and vacuum RPCs all line up in
+                            # one timeline
+                            pctx = _trace_metadata(context)
+                            if pctx is None:
+                                out = _pack(
+                                    await handler(_unpack(request), context)
+                                )
+                            else:
+                                sp = trace.begin_request(
+                                    f"rpc:{method}", pctx, service=service,
+                                )
+                                try:
+                                    out = _pack(
+                                        await handler(
+                                            _unpack(request), context
+                                        )
+                                    )
+                                except Exception as e:
+                                    if sp is not None:
+                                        sp.finish(err=e)
+                                    raise
+                                if sp is not None:
+                                    sp.finish()
+                        finally:
+                            if tok is not None:
+                                from ..util import tenancy as _tenancy
+
+                                _tenancy.reset_current(tok)
+                        if gate is not None:
+                            gate.charge_rpc_response(tenant, len(out))
+                        return out
 
                     return call
 
@@ -218,14 +289,23 @@ class Stub:
                 request_serializer=_pack,
                 response_deserializer=_unpack,
             )
+            md = []
             ctx = trace._CTX.get()
             if ctx is not None:
+                md.append(("traceparent", trace.format_traceparent(ctx)))
+            # tenant principal propagation (same contextvar the HTTP
+            # client injects): the callee's handler seam charges message
+            # bytes to the originating tenant, not the hop. ALWAYS
+            # percent-encoded: gRPC rejects non-ASCII metadata values,
+            # and a cosmetic tenant name must never hard-fail the RPC
+            # issued under it (quote/unquote is bijective when applied
+            # unconditionally, so '50%off' round-trips exactly too).
+            tenant = _tenancy_current()
+            if tenant is not None:
+                md.append(("x-seaweed-tenant", _quote(tenant, safe="")))
+            if md:
                 out = await fn(
-                    request,
-                    timeout=timeout,
-                    metadata=(
-                        ("traceparent", trace.format_traceparent(ctx)),
-                    ),
+                    request, timeout=timeout, metadata=tuple(md)
                 )
             else:
                 out = await fn(request, timeout=timeout)

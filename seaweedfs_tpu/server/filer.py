@@ -63,25 +63,31 @@ class ChunkUploadGate:
     retry through the plain single-needle path, so semantics never
     diverge from the unbatched tier.
 
-    Batches are TENANT-PURE (ISSUE 12): the coalescing key is (host,
-    current tenant), and the flush re-enters the batch's tenant context
-    before sending — so the volume server's admission gate attributes
-    every batched needle to the principal that wrote it, instead of
-    whichever request happened to schedule the flush."""
+    Batches are MIXED-TENANT (ISSUE 13, superseding ISSUE 12's
+    tenant-pure keying): the coalescing key is the HOST alone — pure
+    batches fragmented under a many-tenant write mix, costing a full
+    HTTP hop per tenant per tick — and every item carries its OWN
+    principal inside the frame (the tenant-tagged `!batch/put` layout).
+    The volume server re-attributes each member's bytes to that
+    principal at release (AdmissionGate.charge_member_bytes), so
+    billing stays exact while the wire amortization recovers. Item-wise
+    retries still re-enter the member's tenant context, and a member
+    over its byte quota is declined item-wise (err="quota") so its
+    retry faces its own principal's full admission path."""
 
     def __init__(self, http, max_batch: int = 64, max_bytes: int = 32 << 20):
         self.http = http
         self.max_batch = max_batch
         self.max_bytes = max_bytes
-        # (host, tenant) -> [(fid, payload, fut, trace ctx)]
-        self._pending: dict[tuple, list] = {}
-        self._bytes: dict[tuple, int] = {}
+        # host -> [(fid, payload, fut, trace ctx, tenant)]
+        self._pending: dict[str, list] = {}
+        self._bytes: dict[str, int] = {}
         self._count = 0
         self._scheduled = False
         self._loop = None
         self._tasks: set = set()
         self.stats = {"uploads": 0, "batches": 0, "largest_batch": 0,
-                      "item_retries": 0}
+                      "item_retries": 0, "mixed_batches": 0}
 
     def submit(self, host: str, fid: str, payload):
         """Awaitable -> etag str (raises IOError on upload failure)."""
@@ -91,12 +97,11 @@ class ChunkUploadGate:
         fut = loop.create_future()
         # sampled member contexts ride the item: the flush records one
         # span linked to every member trace (ISSUE 8 batch-seam links)
-        key = (host, tenancy.current())
-        self._pending.setdefault(key, []).append(
-            (fid, payload, fut, trace.current_sampled())
+        self._pending.setdefault(host, []).append(
+            (fid, payload, fut, trace.current_sampled(), tenancy.current())
         )
-        nbytes = self._bytes.get(key, 0) + len(payload)
-        self._bytes[key] = nbytes
+        nbytes = self._bytes.get(host, 0) + len(payload)
+        self._bytes[host] = nbytes
         self._count += 1
         if self._count >= self.max_batch or nbytes >= self.max_bytes:
             self._flush()
@@ -112,20 +117,29 @@ class ChunkUploadGate:
         pending, self._pending = self._pending, {}
         self._bytes = {}
         self._count = 0
-        for (host, tenant), items in pending.items():
+        for host, items in pending.items():
             self.stats["uploads"] += len(items)
             self.stats["batches"] += 1
             if len(items) > self.stats["largest_batch"]:
                 self.stats["largest_batch"] = len(items)
-            t = asyncio.ensure_future(self._send(host, tenant, items))
+            if len({t for _f, _p, _fut, _c, t in items}) > 1:
+                self.stats["mixed_batches"] += 1
+            t = asyncio.ensure_future(self._send(host, items))
             self._tasks.add(t)
             t.add_done_callback(self._tasks.discard)
 
-    async def _single(self, host: str, fid: str, payload) -> str:
-        st, body = await self.http.request(
-            "POST", host, "/" + fid, body=payload,
-            content_type="application/octet-stream",
-        )
+    async def _single(self, host: str, fid: str, payload, tenant=None) -> str:
+        # item-wise sends/retries run under the ITEM's own principal —
+        # the volume server's full admission path is authoritative for
+        # this needle (quota declines land on the right tenant)
+        tok = tenancy.set_current(tenant)
+        try:
+            st, body = await self.http.request(
+                "POST", host, "/" + fid, body=payload,
+                content_type="application/octet-stream",
+            )
+        finally:
+            tenancy.reset_current(tok)
         if st >= 300:
             raise IOError(
                 f"chunk upload {fid}: status {st} {bytes(body)[:160]!r}"
@@ -135,25 +149,21 @@ class ChunkUploadGate:
         except Exception:
             return ""
 
-    async def _send(self, host: str, tenant, items: list) -> None:
+    async def _send(self, host: str, items: list) -> None:
         # the flush span adopts the first sampled member's trace and
         # links all of them; entering the span ALSO makes it the current
         # context, so the batched POST (and any item-wise retries) carry
         # it downstream — the volume server's span parents to the flush.
-        # The batch's TENANT context is re-entered the same way: this
-        # task was created from whichever submitter scheduled the flush,
-        # so without the reset a tenant-pure batch could still ship
-        # under a different principal's header.
-        members = [c for _f, _p, _fut, c in items if c is not None]
+        # The CARRIER tenant context is reset to None unconditionally:
+        # the frame is mixed-tenant now, every member's principal rides
+        # inside it, and a carrier header inherited from whichever
+        # request scheduled the flush would bill that tenant's quota for
+        # the whole frame body at the volume gate.
+        members = [c for _f, _p, _fut, c, _t in items if c is not None]
         cm = trace.batch_span(
             "gate.chunk_put", members, host=host, batch=len(items)
         )
-        # set UNCONDITIONALLY (None included): this task inherited the
-        # context of whichever submitter scheduled the flush, so a
-        # DEFAULT-tenant batch flushed from inside a named tenant's
-        # request would otherwise ship with that tenant's header and
-        # bill their quota for anonymous writes
-        tok = tenancy.set_current(tenant)
+        tok = tenancy.set_current(None)
         try:
             with cm:
                 await self._send_inner(host, items)
@@ -163,18 +173,26 @@ class ChunkUploadGate:
     async def _send_inner(self, host: str, items: list) -> None:
         try:
             if len(items) == 1:
-                fid, payload, fut, _ctx = items[0]
-                etag = await self._single(host, fid, payload)
+                fid, payload, fut, _ctx, tenant = items[0]
+                etag = await self._single(host, fid, payload, tenant)
                 if not fut.done():
                     fut.set_result(etag)
                 return
             import struct as _struct
 
-            parts = [_struct.pack("<I", len(items))]
-            for fid, payload, _fut, _ctx in items:
+            # tenant-tagged frame (high bit of the count word): per item
+            # [u16 fid_len][u16 tenant_len][u32 body_len][fid][tenant]
+            # [body] — the member principal travels IN the frame so the
+            # volume server can re-attribute each needle's bytes
+            parts = [_struct.pack("<I", len(items) | 0x80000000)]
+            for fid, payload, _fut, _ctx, tenant in items:
                 fb = fid.encode("latin1")
-                parts.append(_struct.pack("<HI", len(fb), len(payload)))
+                tb = (tenant or "").encode("utf-8")
+                parts.append(
+                    _struct.pack("<HHI", len(fb), len(tb), len(payload))
+                )
                 parts.append(fb)
+                parts.append(tb)
                 parts.append(payload)
             st, resp = await self.http.request(
                 "POST", host, "/!batch/put", body=b"".join(parts),
@@ -183,15 +201,16 @@ class ChunkUploadGate:
             if st != 200:
                 raise IOError(f"batch put: status {st} {resp[:160]!r}")
             by_fid = {r.get("f"): r for r in json.loads(resp)}
-            for fid, payload, fut, _ctx in items:
+            for fid, payload, fut, _ctx, tenant in items:
                 if fut.done():
                     continue
                 r = by_fid.get(fid)
                 if r is not None and "err" not in r:
                     fut.set_result(r.get("e", ""))
                     continue
-                # item-wise decline (replicated volume, jwt, missing):
-                # the plain single path is authoritative
+                # item-wise decline (replicated volume, jwt, missing,
+                # over-quota member): the plain single path under the
+                # item's own principal is authoritative
                 self.stats["item_retries"] += 1
 
                 def resolve(t, fut=fut):
@@ -203,7 +222,9 @@ class ChunkUploadGate:
                     else:
                         fut.set_result(t.result())
 
-                rt = asyncio.ensure_future(self._single(host, fid, payload))
+                rt = asyncio.ensure_future(
+                    self._single(host, fid, payload, tenant)
+                )
                 self._tasks.add(rt)
                 rt.add_done_callback(self._tasks.discard)
                 rt.add_done_callback(resolve)
@@ -211,7 +232,7 @@ class ChunkUploadGate:
             # resolve every still-pending waiter; a future whose item-wise
             # retry is in flight checks done() before resolving, so the
             # two paths can't double-resolve
-            for _fid, _payload, fut, _ctx in items:
+            for _fid, _payload, fut, _ctx, _tenant in items:
                 if not fut.done():
                     fut.set_exception(IOError(str(e)))
 
@@ -319,7 +340,7 @@ class FilerServer:
         await self._core.start(app)
         self._http_runner = self._core._http_runner
 
-        svc = Service("filer")
+        svc = Service("filer", gate=self._core.gate)
         svc.unary("LookupDirectoryEntry")(self._grpc_lookup_entry)
         svc.unary("ListEntries")(self._grpc_list_entries)
         svc.unary("CreateEntry")(self._grpc_create_entry)

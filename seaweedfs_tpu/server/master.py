@@ -235,7 +235,7 @@ class MasterServer:
         self._fast_server = self._core.fast_server
         self._http_runner = self._core._http_runner
 
-        svc = Service("master")
+        svc = Service("master", gate=self._core.gate)
         svc.bidi_stream("SendHeartbeat")(self._send_heartbeat)
         svc.bidi_stream("KeepConnected")(self._keep_connected)
         svc.unary("Assign")(self._grpc_assign)

@@ -39,13 +39,22 @@ MAX_BATCH_REQUESTS = 128
 
 @dataclass
 class _Request:
-    needle: Needle
+    needle: Optional[Needle]
     is_write: bool
     future: asyncio.Future
     enqueued_at: float = 0.0
     # sampled trace context of the enqueuer, so the fsync-batch flush can
     # record one span linked to every member trace (ISSUE 8)
     ctx: object = None
+    # multi-needle frame (ISSUE 13): the whole list appends as ONE
+    # coalesced .dat extent + ONE .idx extent via write_needle_batch;
+    # the future resolves with the per-needle result list
+    needles: Optional[list] = None
+
+    def data_bytes(self) -> int:
+        if self.needles is not None:
+            return sum(len(n.data) for n in self.needles)
+        return len(self.needle.data)
 
 
 class GroupCommitWorker:
@@ -90,6 +99,20 @@ class GroupCommitWorker:
         )
         return await fut
 
+    async def write_many(self, needles: list) -> list:
+        """Append a whole multi-needle frame through the worker: the
+        frame lands as one .dat extent + one .idx extent
+        (Volume.write_needle_batch) inside the shared fsync batch.
+        Returns the per-needle result list (tuples or Exceptions)."""
+        fut = asyncio.get_event_loop().create_future()
+        await self.queue.put(
+            _Request(
+                None, True, fut, enqueued_at=time.perf_counter(),
+                ctx=trace.current_sampled(), needles=needles,
+            )
+        )
+        return await fut
+
     async def _run(self) -> None:
         while True:
             batch = [await self.queue.get()]
@@ -100,7 +123,7 @@ class GroupCommitWorker:
                 # queue has already drained to a lone writer the yield is
                 # skipped and the flush is immediate — no fixed window.
                 await asyncio.sleep(0)
-            bytes_queued = len(batch[0].needle.data)
+            bytes_queued = batch[0].data_bytes()
             # drain whatever is immediately available, bounded like the
             # reference's 4MB/128 limits
             while (
@@ -110,7 +133,7 @@ class GroupCommitWorker:
             ):
                 req = self.queue.get_nowait()
                 batch.append(req)
-                bytes_queued += len(req.needle.data)
+                bytes_queued += req.data_bytes()
             self._concurrent = len(batch) > 1 or not self.queue.empty()
             self.stats["batches"] += 1
             self.stats["requests"] += len(batch)
@@ -139,7 +162,9 @@ class GroupCommitWorker:
         results: list[tuple[_Request, object]] = []
         for req in batch:
             try:
-                if req.is_write:
+                if req.needles is not None:
+                    out = v.write_needle_batch(req.needles)
+                elif req.is_write:
                     out = v.write_needle(req.needle, sync=False)
                 else:
                     out = v.delete_needle(req.needle)

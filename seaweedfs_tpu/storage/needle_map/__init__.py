@@ -12,6 +12,11 @@ from .compact_map import CompactMap
 from .memdb import MemDb
 from .metric import MapMetric
 from .mapper import NeedleMap, new_needle_map, load_needle_map
+from .lsm_map import (
+    LsmNeedleMap,
+    load_lsm_needle_map,
+    new_lsm_needle_map,
+)
 
 __all__ = [
     "NeedleValue",
@@ -21,4 +26,7 @@ __all__ = [
     "NeedleMap",
     "new_needle_map",
     "load_needle_map",
+    "LsmNeedleMap",
+    "load_lsm_needle_map",
+    "new_lsm_needle_map",
 ]

@@ -154,6 +154,33 @@ class SqliteNeedleMap(_MetricProperties):
             self.metric.log_put(key, old[0] if old else 0, size)
             self._mutations += 1
 
+    def put_batch(self, entries) -> None:
+        """Many puts, one .idx append + one executemany (the batch
+        append's map half for the leveldb-class mapper). A `pending`
+        overlay keeps intra-batch duplicate keys honest: the deferred
+        executemany means the SELECT alone would miss an earlier entry
+        of the same batch and under-count the superseded copy's
+        deletion bytes (the metric vacuum's garbage ratio feeds on)."""
+        with self._db_lock:
+            blob = bytearray()
+            rows = []
+            pending: dict = {}
+            for key, offset_units, size in entries:
+                old_size = pending.get(key)
+                if old_size is None:
+                    row = self.db.execute(
+                        "SELECT size FROM needles WHERE key=?", (key,)
+                    ).fetchone()
+                    old_size = row[0] if row else 0
+                blob += entry_to_bytes(key, offset_units, size)
+                rows.append((key, offset_units, size))
+                self.metric.log_put(key, old_size, size)
+                pending[key] = size
+                self._mutations += 1
+            if blob:
+                self._idx.append(bytes(blob))
+                self._put_rows(rows)
+
     def get(self, key: int) -> Optional[NeedleValue]:
         with self._db_lock:
             row = self.db.execute(

@@ -29,6 +29,17 @@ class NeedleMap:
         self.metric.log_put(key, old_size, size)
         self._idx.append(entry_to_bytes(key, offset_units, size))
 
+    def put_batch(self, entries) -> None:
+        """Apply many (key, offset_units, size) puts with ONE .idx
+        append — the multi-needle batch append's map half."""
+        blob = bytearray()
+        for key, offset_units, size in entries:
+            _, old_size = self.m.set(key, offset_units, size)
+            self.metric.log_put(key, old_size, size)
+            blob += entry_to_bytes(key, offset_units, size)
+        if blob:
+            self._idx.append(bytes(blob))
+
     def get(self, key: int) -> Optional[NeedleValue]:
         return self.m.get(key)
 

@@ -221,6 +221,13 @@ def commit_compact(v: Volume) -> Volume:
                 raise
             os.rename(base + ".cpd", base + ".dat")
             os.rename(base + ".cpx", base + ".idx")
+            # the .idx was just replaced wholesale: any persisted lsm
+            # needle-map snapshot folds a prefix of the OLD log and must
+            # not survive the swap (the reload below would otherwise
+            # lean on the last-entry binding alone to reject it)
+            from .needle_map.lsm_map import invalidate_snapshot
+
+            invalidate_snapshot(base)
     finally:
         v.is_compacting = False
     return Volume(

@@ -626,6 +626,34 @@ TENANT_ADMITTED_SECONDS = REGISTRY.histogram(
     "requests per tenant (top-K + other), by server and tenant",
 )
 
+# needle-index-at-scale plane (see docs/perf.md "Needle index at
+# scale"): the out-of-core LSM needle map's memory story and mount
+# behavior made observable — resident memtable bytes (the bound the map
+# enforces), run counts (compaction health), how stale the snapshot a
+# mount consumed was, and how many tail entries it had to replay past
+# the fold frontier (the O(tail) claim, measurable in production)
+NEEDLE_MAP_RESIDENT_BYTES = REGISTRY.gauge(
+    "seaweedfs_tpu_needle_map_resident_bytes",
+    "estimated resident memory held by needle-map memtables on this "
+    "server, by map kind (the LSM map's byte bound; runs are mmap'd "
+    "page cache and excluded on purpose)",
+)
+NEEDLE_MAP_RUN_COUNT = REGISTRY.gauge(
+    "seaweedfs_tpu_needle_map_run_count",
+    "immutable sorted runs currently backing needle maps on this "
+    "server, by map kind (tiered merges keep this bounded)",
+)
+NEEDLE_MAP_SNAPSHOT_AGE = REGISTRY.gauge(
+    "seaweedfs_tpu_needle_map_snapshot_age_seconds",
+    "age of the persisted needle-map snapshot the most recent mount "
+    "loaded, by map kind (how far behind the fold frontier was)",
+)
+NEEDLE_MAP_TAIL_REPLAY = REGISTRY.counter(
+    "seaweedfs_tpu_needle_map_tail_replay_entries_total",
+    "index entries replayed past the snapshot fold frontier at mount "
+    "(the O(tail) mount cost actually paid)",
+)
+
 # the registry seam the bounded-cardinality lint checks: every family
 # that carries a `tenant` label MUST be listed here, or a retired
 # tenant's series would survive the purge and grow cardinality without

@@ -781,10 +781,92 @@ class AdmissionGate:
             self._count_admitted(name)
             fut.set_result(True)
 
+    # -- out-of-band byte attribution (ISSUE 13 satellites) --
+    def charge_member_bytes(
+        self,
+        tenant: Optional[str],
+        nbytes: int,
+        carrier: Optional[str] = None,
+    ) -> bool:
+        """Re-attribute one member's share of an admitted MIXED-tenant
+        batch frame (the filer's host-coalesced `!batch/put`) from the
+        carrier principal to the member's own: consult + charge the
+        member's byte bucket, then hand the same bytes back to the
+        carrier's bucket (which paid for the whole frame body at
+        admission) — each needle's bytes end up billed to exactly the
+        principal that wrote it. False = the member is over its byte
+        quota; the item declines item-wise (reason=quota counted here)
+        and the client retries it through the single-needle path under
+        the member's own principal, where the full admission path is
+        authoritative."""
+        name = tenant or _DEFAULT_TENANT
+        ts = self._tenant(name)
+        _POLICY_NOTE(name)
+        ts.t_seen = self._clock()
+        # the member pays its FULL quota — request token + bytes — the
+        # same bill its needle would have paid as an unbatched volume
+        # HTTP request (each chunk was one request before coalescing),
+        # so host-coalesced batching cannot become a qps-quota bypass
+        ok = ts.quota is None or ts.quota.try_take(nbytes)
+        # the carrier is refunded EITHER way: on success the bytes now
+        # bill the member; on decline the item is never written and a
+        # kept charge would let one over-quota member's sustained
+        # traffic drain the default pool's bucket and shed unrelated
+        # anonymous writes (cross-tenant leakage through the carrier)
+        cname = carrier or _DEFAULT_TENANT
+        if cname != name:
+            cts = self._tenants.get(cname)
+            if cts is not None and cts.quota is not None:
+                cts.quota.refund_bytes(nbytes)
+        if not ok:
+            self._shed(CLASS_WRITE, "quota", name)
+            return False
+        return True
+
+    def charge_rpc_bytes(self, tenant: Optional[str], nbytes: int) -> bool:
+        """gRPC request-message bytes against the tenant's byte quota —
+        the pb/rpc.py handler seam (quotas were HTTP-only before; the
+        gRPC plane moved volume copies and bulk reads for free). False
+        = over quota: the handler refuses with RESOURCE_EXHAUSTED and
+        the shed is counted class="rpc", reason="quota".
+
+        UNTENANTED calls (no x-seaweed-tenant metadata) are exempt on
+        purpose: the gRPC plane's anonymous traffic is the cluster's
+        own control plane — master repair/vacuum/lifecycle dispatches,
+        heartbeat side-calls — and a wildcard byte quota drained by
+        tenant HTTP traffic must never shed cluster MAINTENANCE (the
+        coupling would let foreground load starve repairs). A tenant
+        principal only rides the metadata when a real request context
+        flows through the hop, which is exactly the traffic the quota
+        is for."""
+        if tenant is None:
+            return True
+        ts = self._tenant(tenant)
+        _POLICY_NOTE(tenant)
+        ts.t_seen = self._clock()
+        if ts.quota is not None and not ts.quota.try_take_bytes(nbytes):
+            self._shed("rpc", "quota", tenant)
+            return False
+        return True
+
+    def charge_rpc_response(
+        self, tenant: Optional[str], nbytes: int
+    ) -> None:
+        """Response-message bytes at RPC completion (may drive the
+        bucket negative, exactly like the HTTP release path). Exempt
+        for untenanted control-plane calls like charge_rpc_bytes."""
+        if tenant is None:
+            return
+        ts = self._tenants.get(tenant)
+        if ts is not None and ts.quota is not None and nbytes:
+            ts.quota.charge_bytes(nbytes)
+
     # -- shedding / pressure --
     def _shed(
-        self, cls: int, reason: str, tenant: Optional[str] = None
+        self, cls, reason: str, tenant: Optional[str] = None
     ) -> None:
+        # cls: priority-class index, or a literal class label for
+        # traffic outside the HTTP class lattice (e.g. "rpc")
         name = tenant or _DEFAULT_TENANT
         self.shed_total += 1
         self.last_shed_t = self._clock()
@@ -801,7 +883,11 @@ class AdmissionGate:
                 gate=self.gate_id,
                 reason=reason,
                 tenant=label,
-                **{"class": CLASS_NAMES[cls]},
+                **{
+                    "class": (
+                        CLASS_NAMES[cls] if isinstance(cls, int) else cls
+                    )
+                },
             )
         child.inc()
 

@@ -212,6 +212,25 @@ class TenantQuota:
         if self.byte_ps and n:
             self._bt -= n
 
+    def try_take_bytes(self, n: int) -> bool:
+        """Byte-bucket-only consult + charge — no request token moves.
+        The seam for traffic that is not a whole HTTP request of its
+        own: a gRPC message's bytes, or one member's share of an
+        admitted mixed-tenant batch frame. False = over byte quota."""
+        self._refill()
+        if self.byte_ps and self._bt <= 0.0:
+            return False
+        if self.byte_ps and n:
+            self._bt -= n
+        return True
+
+    def refund_bytes(self, n: int) -> None:
+        """Hand back bytes only (no request token): the carrier of a
+        mixed-tenant batch was charged the whole frame at admission;
+        each member's re-attribution returns the carrier's share."""
+        if self.byte_ps and n:
+            self._bt = min(self.byte_ps * self.burst_s, self._bt + n)
+
     def refill_horizon_s(self) -> float:
         """Seconds until the buckets refill to their fresh (full-burst)
         state. The gate's tenant-table prune only evicts a quota'd

@@ -468,6 +468,48 @@ def test_lifecycle_convergence_leg_shape():
     assert lc["with_conversions"]["count"] > 0
 
 
+def test_needle_map_mount_leg_shape():
+    """ISSUE 13 guard: the needle_map.mount leg must mount the same log
+    both ways, disclose both walls + the speedup, the resident-byte
+    story (lsm bounded below dict), the tail-replay count, and a
+    byte-identical probe sample. Small shape here; the >=10x / >=2M
+    acceptance numbers come from the full bench run."""
+    r = bench.measure_needle_map_mount(
+        n_keys=120_000, tail_entries=400, sample=800
+    )
+    assert r["total_entries"] > r["n_keys"]
+    assert r["mount_dict_s"] > 0
+    assert r["mount_lsm_s"] > 0
+    assert r["mount_lsm_cold_s"] > 0
+    assert r["loaded_from_snapshot"] is True
+    assert r["tail_replayed"] == 400
+    assert r["mount_speedup"] > 1.0  # lsm wins even at this tiny shape
+    assert r["identical"] is True and r["probe_mismatches"] == 0
+    assert r["file_counts_equal"] is True
+    assert r["resident_dict_bytes"] > 0
+    assert r["resident_lsm_bytes"] > 0
+    assert r["resident_bounded_below_dict"] is True
+    assert r["resident_ratio"] > 10.0  # the memory story is the point
+
+
+def test_needle_map_lookup_leg_shape():
+    """ISSUE 13 guard: the needle_map.lookup leg must drive the same
+    CO-corrected zipf open-loop stream against both maps, keep answers
+    identical entry-wise, achieve its offered rate, and disclose a
+    bounded p99 ratio (the read path stays flat)."""
+    r = bench.measure_needle_map_lookup(
+        n_keys=120_000, probes=30_000, rate=25_000.0
+    )
+    assert r["identical"] is True and r["probe_mismatches"] == 0
+    assert r["hot_share_top1pct"] > 0.5  # the stream really is zipfian
+    for leg in ("dict", "lsm"):
+        assert r[leg]["p99_us"] > 0
+        assert r[leg]["p50_us"] <= r[leg]["p99_us"] <= r[leg]["p999_us"]
+        assert r[leg]["achieved_over_offered"] > 0.8
+    assert 0 < r["p99_ratio_lsm_over_dict"] <= 12.0
+    assert r["lsm_runs"] >= 1
+
+
 def test_device_history_appends_per_emit(tmp_path, monkeypatch):
     """ISSUE 6 satellite: every bench emit appends {run, device_status}
     to DEVICE_HISTORY.jsonl so stand-in runs stop erasing the record of

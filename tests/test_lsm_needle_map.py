@@ -1,0 +1,713 @@
+"""ISSUE 13: out-of-core LSM needle map + instant mount + batch append.
+
+Map layer: seeded oracle property through interleaved put/delete/
+overwrite with forced flushes/merges, crash shapes (torn snapshot, torn
+run, torn idx tail, no-close restart), and the manifest binding that
+rejects a wholesale .idx rewrite. The reference semantic for every
+reopen is a fresh dict replay of the same log (load_needle_map) — the
+pre-ISSUE mount path IS the oracle.
+
+Volume layer: vacuum-commit-swap and tail-sync against the lsm kind,
+including the crash window where a stale snapshot survives the commit's
+renames; the coalesced write_needle_batch; the group-commit frame path.
+
+Server layer: the tenant-tagged `!batch/put` frame end-to-end and the
+gRPC byte-quota seam.
+"""
+
+import asyncio
+import os
+import random
+import shutil
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.types import TOMBSTONE_FILE_SIZE
+from seaweedfs_tpu.storage.needle_map import (
+    LsmNeedleMap,
+    load_lsm_needle_map,
+    load_needle_map,
+    new_lsm_needle_map,
+)
+from seaweedfs_tpu.storage.needle_map.disk_maps import (
+    metric_from_index_file,
+)
+from seaweedfs_tpu.storage.needle_map.lsm_map import (
+    MANIFEST_EXT,
+    fold_live_columns,
+    invalidate_snapshot,
+    sweep_snapshot_files,
+)
+
+
+def _small_map(idx_path, memtable=48, runs=3) -> LsmNeedleMap:
+    m = new_lsm_needle_map(str(idx_path))
+    m.memtable_limit = memtable  # force frequent flushes/merges
+    m.max_runs = runs
+    return m
+
+
+def _drive(m, rng, ops, keyspace=300):
+    """Interleaved put/overwrite/delete stream; returns the live oracle."""
+    oracle = {}
+    for _ in range(ops):
+        key = rng.randrange(1, keyspace)
+        if rng.random() < 0.72:
+            off, size = rng.randrange(1, 1 << 20), rng.randrange(1, 4096)
+            m.put(key, off, size)
+            oracle[key] = (off, size)
+        else:
+            m.delete(key, rng.randrange(1, 1 << 20))
+            oracle.pop(key, None)
+    return oracle
+
+
+def _assert_matches_oracle(m, oracle, keyspace=300, tag=""):
+    for key in range(1, keyspace):
+        nv = m.get(key)
+        live = (
+            nv is not None
+            and nv.offset_units != 0
+            and nv.size != TOMBSTONE_FILE_SIZE
+        )
+        if key in oracle:
+            assert live, (tag, key, nv)
+            assert (nv.offset_units, nv.size) == oracle[key], (tag, key)
+        else:
+            assert not live, (tag, key, nv)
+    keys, offs, sizes = m.snapshot()
+    assert keys.tolist() == sorted(oracle), tag
+    for k, o, s in zip(keys.tolist(), offs.tolist(), sizes.tolist()):
+        assert oracle[k] == (o, s), (tag, k)
+
+
+def _assert_matches_dict_replay(idx_path, m, keyspace=300, tag=""):
+    """The dict mapper's replay of the SAME log is the semantic oracle
+    (what `memory`-kind mount would serve)."""
+    ref = load_needle_map(str(idx_path))
+    try:
+        for key in range(1, keyspace):
+            a, b = ref.get(key), m.get(key)
+            at = (
+                None
+                if a is None
+                or a.offset_units == 0
+                or a.size == TOMBSTONE_FILE_SIZE
+                else (a.offset_units, a.size)
+            )
+            bt = (
+                None
+                if b is None
+                or b.offset_units == 0
+                or b.size == TOMBSTONE_FILE_SIZE
+                else (b.offset_units, b.size)
+            )
+            assert at == bt, (tag, key, at, bt)
+        assert (
+            ref.file_count,
+            ref.deleted_count,
+            ref.content_size,
+            ref.deleted_size,
+            ref.max_file_key,
+        ) == (
+            m.file_count,
+            m.deleted_count,
+            m.content_size,
+            m.deleted_size,
+            m.max_file_key,
+        ), tag
+    finally:
+        ref.close()
+
+
+@pytest.mark.parametrize("seed", [1, 7, 23])
+def test_lsm_oracle_property_with_reopens(tmp_path, seed):
+    """Interleaved mutations with tiny memtable/run bounds; every reopen
+    flavor (clean close -> snapshot, crash -> tail replay) must match
+    the dict-replay oracle of the same log, metrics included."""
+    idx = tmp_path / "1.idx"
+    rng = random.Random(seed)
+    m = _small_map(idx)
+    oracle = _drive(m, rng, 1500)
+    _assert_matches_oracle(m, oracle, tag="live")
+    _assert_matches_dict_replay(idx, m, tag="live-vs-dict")
+
+    # clean close: reopen loads the snapshot, replays nothing
+    m.close()
+    m2 = load_lsm_needle_map(str(idx))
+    assert m2.loaded_from_snapshot and m2.tail_entries_replayed == 0
+    _assert_matches_oracle(m2, oracle, tag="snapshot-reopen")
+    _assert_matches_dict_replay(idx, m2, tag="snapshot-vs-dict")
+
+    # keep writing, then CRASH (no close): the reopen replays the tail
+    m2.memtable_limit = 10_000  # keep the tail in the memtable
+    oracle2 = dict(oracle)
+    for key in range(500, 560):
+        m2.put(key, key * 8, 64)
+        oracle2[key] = (key * 8, 64)
+    m2._idx.close()  # abrupt: no snapshot save
+    m3 = load_lsm_needle_map(str(idx))
+    assert m3.loaded_from_snapshot
+    assert m3.tail_entries_replayed == 60
+    _assert_matches_oracle(m3, oracle2, keyspace=600, tag="tail-reopen")
+    _assert_matches_dict_replay(idx, m3, keyspace=600, tag="tail-vs-dict")
+    m3.close()
+
+
+def test_lsm_metric_equivalence(tmp_path):
+    """The vectorized metric fold equals the per-entry replay metric on
+    a churny log (incl. zero-size puts and repeat deletes)."""
+    idx = tmp_path / "1.idx"
+    m = _small_map(idx)
+    rng = random.Random(5)
+    for _ in range(800):
+        key = rng.randrange(1, 120)
+        r = rng.random()
+        if r < 0.6:
+            m.put(key, rng.randrange(1, 1 << 18), rng.randrange(0, 2048))
+        else:
+            m.delete(key, rng.randrange(1, 1 << 18))
+    m.close()
+    ref = metric_from_index_file(str(idx))
+    got = load_lsm_needle_map(str(idx))
+    assert got.loaded_from_snapshot
+    assert (
+        ref.file_count, ref.deletion_count, ref.file_byte_count,
+        ref.deletion_byte_count, ref.maximum_file_key,
+    ) == (
+        got.file_count, got.deleted_count, got.content_size,
+        got.deleted_size, got.max_file_key,
+    )
+    got.close()
+
+
+@pytest.mark.parametrize("tear", ["manifest", "run", "idx"])
+def test_lsm_crash_torn_artifacts(tmp_path, tear):
+    """Torn snapshot artifacts (garbage manifest, truncated run file)
+    degrade to a correct full rebuild; a torn idx tail (crash mid
+    append) floors to the last complete entry — all three match the
+    dict replay of whatever log survived."""
+    idx = tmp_path / "1.idx"
+    m = _small_map(idx)
+    rng = random.Random(11)
+    _drive(m, rng, 900)
+    m.close()
+    base = str(idx)[: -len(".idx")]
+    if tear == "manifest":
+        with open(base + MANIFEST_EXT, "r+b") as f:
+            f.write(b"\x00garbage\xff")
+    elif tear == "run":
+        runs = [
+            fn for fn in os.listdir(tmp_path) if ".nmr-" in fn
+        ]
+        assert runs
+        victim = os.path.join(tmp_path, sorted(runs)[0])
+        os.truncate(victim, os.path.getsize(victim) // 2)
+    else:
+        os.truncate(idx, os.path.getsize(idx) - 9)
+    m2 = load_lsm_needle_map(str(idx))
+    if tear in ("manifest", "run"):
+        assert not m2.loaded_from_snapshot  # rejected, rebuilt
+    _assert_matches_dict_replay(idx, m2, tag=f"torn-{tear}")
+    m2.close()
+
+
+def test_lsm_manifest_binding_rejects_rewritten_idx(tmp_path):
+    """A wholesale .idx rewrite that dodges explicit invalidation (the
+    crash window between a vacuum commit's renames and its
+    invalidate_snapshot) must be caught by the last-entry binding: the
+    stale snapshot folds the OLD log and may not be consulted."""
+    from seaweedfs_tpu.storage.idx import entries_to_bytes, parse_index_bytes
+
+    idx = tmp_path / "1.idx"
+    m = _small_map(idx)
+    rng = random.Random(3)
+    _drive(m, rng, 600)
+    m.close()
+    base = str(idx)[: -len(".idx")]
+    # stash the snapshot files (simulated crash keeps them around)
+    stash = tmp_path / "stash"
+    stash.mkdir()
+    side = [
+        fn
+        for fn in os.listdir(tmp_path)
+        if ".nmr-" in fn or fn.endswith(MANIFEST_EXT)
+    ]
+    for fn in side:
+        shutil.copy2(tmp_path / fn, stash / fn)
+    # rewrite the idx wholesale: the live set, key-sorted (what vacuum
+    # and `weed fix` produce), then PADDED with fresh entries so the new
+    # log is at least as long as the manifest's covered prefix — the
+    # size check alone cannot reject it
+    with open(idx, "rb") as f:
+        keys, offs, sizes = parse_index_bytes(f.read())
+    lk, lo, ls = fold_live_columns(keys, offs, sizes)
+    extra = max(0, len(keys) - len(lk)) + 2
+    pad_k = np.arange(10_000, 10_000 + extra, dtype=np.uint64)
+    with open(idx, "wb") as f:
+        f.write(entries_to_bytes(lk, lo, ls))
+        f.write(
+            entries_to_bytes(
+                pad_k,
+                np.full(extra, 7, dtype=np.uint64),
+                np.full(extra, 55, dtype=np.uint32),
+            )
+        )
+    for fn in side:
+        shutil.copy2(stash / fn, tmp_path / fn)
+    m2 = load_lsm_needle_map(str(idx))
+    assert not m2.loaded_from_snapshot, "stale snapshot was consulted"
+    _assert_matches_dict_replay(idx, m2, keyspace=10_100, tag="binding")
+    m2.close()
+
+
+def test_lsm_sealed_snapshot_zero_copy_and_tombstone_discipline(tmp_path):
+    """A sealed map (single live run, empty memtable) serves snapshot()
+    straight off the mmap'd run columns; tombstones shadow older runs
+    until a rank-0 merge drops them."""
+    idx = tmp_path / "1.idx"
+    m = _small_map(idx, memtable=10, runs=2)
+    for key in range(1, 41):
+        m.put(key, key * 2, 100)
+    m.delete(7, 999)
+    # force everything into runs and merge down to rank 0
+    m._flush_memtable()
+    while len(m._runs) > 1:
+        m._merge_smallest_adjacent()
+    m._persist_manifest()
+    assert len(m._runs) == 1 and m._runs[0].tombs == 0
+    assert m.get(7) is None  # tombstone dropped at rank 0 == absent
+    keys, offs, sizes = m.snapshot()
+    assert 7 not in keys.tolist()
+    # zero-copy: the snapshot IS the run's memmap-backed columns
+    assert isinstance(keys, np.memmap) or isinstance(
+        getattr(keys, "base", None), np.memmap
+    )
+    m.close()
+
+
+def test_lsm_put_batch_matches_sequential(tmp_path):
+    """put_batch == the same puts applied one by one: identical idx
+    bytes, identical state."""
+    a = new_lsm_needle_map(str(tmp_path / "a.idx"))
+    b = new_lsm_needle_map(str(tmp_path / "b.idx"))
+    entries = [(k, k * 3 + 1, 100 + k) for k in range(1, 60)]
+    entries += [(5, 777, 64)]  # overwrite inside the batch
+    for k, o, s in entries:
+        a.put(k, o, s)
+    b.put_batch(entries)
+    with open(tmp_path / "a.idx", "rb") as fa, open(
+        tmp_path / "b.idx", "rb"
+    ) as fb:
+        assert fa.read() == fb.read()
+    assert (a.file_count, a.content_size, a.deleted_size) == (
+        b.file_count, b.content_size, b.deleted_size,
+    )
+    for k in range(1, 60):
+        assert a.get(k) == b.get(k), k
+    a.close()
+    b.close()
+
+
+def test_lsm_put_batch_flush_crossing_survives_crash(tmp_path):
+    """Review fix: a put_batch that crosses the memtable limit must keep
+    the snapshot manifest and the .idx log in lock-step — the flush
+    fires AFTER the whole blob is appended, so a crash right after the
+    batch (no close) reopens to exactly the dict-replay state."""
+    idx = tmp_path / "1.idx"
+    m = new_lsm_needle_map(str(idx))
+    m.memtable_limit = 20
+    m.max_runs = 3
+    for k in range(1, 15):
+        m.put(k, k * 2, 50)
+    # one batch pushes the memtable well past the limit
+    m.put_batch([(k, k * 3, 60) for k in range(15, 80)])
+    assert len(m._mem) < 20  # the end-of-batch flush ran
+    m._idx.close()  # crash: no save_snapshot
+    m2 = load_lsm_needle_map(str(idx))
+    _assert_matches_dict_replay(idx, m2, keyspace=90, tag="batch-flush")
+    m2.close()
+
+
+def test_charge_member_bytes_refunds_carrier_on_decline():
+    """Review fix: a declined (over-quota) member's bytes must still be
+    handed back to the carrier's bucket — sustained over-quota traffic
+    from one tenant must not drain the default pool."""
+    from seaweedfs_tpu.util.overload import AdmissionGate
+
+    gate = AdmissionGate("refund", clock=lambda: 0.0)  # frozen: no refill
+    gate.set_tenant_quota("carrier", byte_ps=1000.0, burst_s=1.0)
+    gate.set_tenant_quota("member", byte_ps=100.0, burst_s=1.0)
+    carrier_q = gate._tenants["carrier"].quota
+    member_q = gate._tenants["member"].quota
+    # the frame body was charged to the carrier at admission
+    carrier_q.charge_bytes(400)
+    before = carrier_q._bt
+    # member over quota: decline, but the carrier gets its share back
+    member_q._bt = -1e6
+    assert gate.charge_member_bytes("member", 400, carrier="carrier") is False
+    assert carrier_q._bt == before + 400
+    # successful attribution refunds the carrier too and bills the member
+    ok_before_member = member_q._bt = 100.0
+    before = carrier_q._bt
+    assert gate.charge_member_bytes("member", 80, carrier="carrier") is True
+    assert member_q._bt == ok_before_member - 80
+    assert carrier_q._bt == min(1000.0, before + 80)
+
+
+def test_untenanted_rpc_exempt_from_quota():
+    """Round-2 review fix: a drained default/wildcard byte bucket must
+    never shed UNTENANTED gRPC calls — anonymous gRPC is the cluster's
+    own control plane (repair/vacuum dispatch)."""
+    from seaweedfs_tpu.util.overload import AdmissionGate
+
+    gate = AdmissionGate("ctrl", clock=lambda: 0.0)
+    gate.set_tenant_quota("default", byte_ps=10.0, burst_s=1.0)
+    gate._tenants["default"].quota._bt = -1e9  # drained by HTTP traffic
+    assert gate.charge_rpc_bytes(None, 1 << 20) is True
+    gate.charge_rpc_response(None, 1 << 20)  # no-op, no crash
+    # a named tenant still gets refused on the same gate
+    gate.set_tenant_quota("t", byte_ps=10.0, burst_s=1.0)
+    gate._tenants["t"].quota._bt = -1e9
+    assert gate.charge_rpc_bytes("t", 100) is False
+
+
+def test_charge_member_bytes_takes_request_token():
+    """Round-2 review fix: the member pays its request token too —
+    host-coalesced batching must not bypass a qps quota (each chunk was
+    one volume request before coalescing)."""
+    from seaweedfs_tpu.util.overload import AdmissionGate
+
+    gate = AdmissionGate("tok", clock=lambda: 0.0)
+    gate.set_tenant_quota("alice", qps=2.0, burst_s=1.0)
+    assert gate.charge_member_bytes("alice", 10) is True
+    assert gate.charge_member_bytes("alice", 10) is True
+    # frozen clock: the two burst tokens are gone
+    assert gate.charge_member_bytes("alice", 10) is False
+
+
+def test_sqlite_put_batch_intra_batch_duplicate_metrics(tmp_path):
+    """Round-2 review fix: SqliteNeedleMap.put_batch's deferred
+    executemany must not blind the metric to intra-batch duplicate
+    keys (the superseded copy's bytes feed the vacuum garbage ratio)."""
+    from seaweedfs_tpu.storage.needle_map.disk_maps import SqliteNeedleMap
+
+    a = SqliteNeedleMap(str(tmp_path / "a.idx"))
+    b = SqliteNeedleMap(str(tmp_path / "b.idx"))
+    entries = [(1, 10, 100), (2, 20, 200), (1, 30, 150)]
+    for k, o, s in entries:
+        a.put(k, o, s)
+    b.put_batch(entries)
+    assert (a.file_count, a.deleted_count, a.content_size, a.deleted_size) \
+        == (b.file_count, b.deleted_count, b.content_size, b.deleted_size)
+    assert b.deleted_size == 100  # the superseded first copy counted
+    a.destroy()
+    b.destroy()
+
+
+# ---------------------------------------------------------- volume layer --
+
+
+def _fill_volume(v, n, size=64, start=1):
+    from seaweedfs_tpu.storage.needle import Needle
+
+    blobs = {}
+    for i in range(start, start + n):
+        nd = Needle(cookie=0xC0, id=i, data=(b"%06d" % i) * (size // 6))
+        v.write_needle(nd)
+        blobs[i] = bytes(nd.data)
+    return blobs
+
+
+def test_volume_lsm_vacuum_commit_swap_and_stale_snapshot(tmp_path):
+    """Vacuum commit under the lsm kind: the swap invalidates the
+    persisted snapshot, reads stay byte-identical, the next mount uses
+    a FRESH snapshot — and the crash window where the OLD snapshot
+    survives the renames is closed by the manifest binding."""
+    from seaweedfs_tpu.storage import vacuum as vac
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    d = tmp_path / "vol"
+    d.mkdir()
+    v = Volume(str(d), "", 9, needle_map_kind="lsm")
+    blobs = _fill_volume(v, 120)
+    for i in range(1, 120, 3):
+        v.delete_needle(Needle(cookie=0xC0, id=i))
+        del blobs[i]
+    base = v.file_name()
+    # persist a snapshot of the PRE-vacuum log, stash it (the crash
+    # window artifact), then vacuum
+    v.nm.save_snapshot()
+    stash = tmp_path / "stash"
+    stash.mkdir()
+    side = [
+        fn
+        for fn in os.listdir(d)
+        if ".nmr-" in fn or fn.endswith(MANIFEST_EXT)
+    ]
+    for fn in side:
+        shutil.copy2(d / fn, stash / fn)
+    vac.compact2(v)
+    v2 = vac.commit_compact(v)
+    assert v2.needle_map_kind == "lsm"
+    for i, data in blobs.items():
+        assert bytes(v2.read_needle_by_key(i).data) == data, i
+    v2.close()
+    # normal remount: fresh snapshot, correct
+    v3 = Volume(str(d), "", 9, create=False, needle_map_kind="lsm")
+    assert v3.nm.loaded_from_snapshot
+    assert v3.file_count() == len(blobs)
+    v3.close()
+    # crash window: restore the PRE-vacuum snapshot files over the
+    # post-vacuum idx — load must reject them and still serve right
+    for fn in os.listdir(d):
+        if ".nmr-" in fn or fn.endswith(MANIFEST_EXT):
+            os.remove(d / fn)
+    for fn in side:
+        shutil.copy2(stash / fn, d / fn)
+    v4 = Volume(str(d), "", 9, create=False, needle_map_kind="lsm")
+    for i, data in blobs.items():
+        assert bytes(v4.read_needle_by_key(i).data) == data, i
+    assert v4.file_count() == len(blobs)
+    v4.destroy()
+
+
+def test_volume_lsm_tail_sync_then_remount(tmp_path):
+    """apply_incremental (the VolumeTailSync worker) replays pulled
+    records through the lsm map's put/delete — the snapshot stays a
+    valid prefix and the next mount is still snapshot+tail."""
+    from seaweedfs_tpu.storage.volume import Volume
+    from seaweedfs_tpu.storage.volume_backup import (
+        apply_incremental,
+        incremental_changes,
+    )
+
+    src_d = tmp_path / "src"
+    rep_d = tmp_path / "rep"
+    src_d.mkdir()
+    rep_d.mkdir()
+    src = Volume(str(src_d), "", 4, needle_map_kind="memory")
+    blobs = _fill_volume(src, 40)
+    src.sync()
+    # replica = file copy of the prefix, mounted lsm
+    for ext in (".dat", ".idx"):
+        shutil.copy2(src.file_name() + ext, str(rep_d / ("4" + ext)))
+    rep = Volume(str(rep_d), "", 4, create=False, needle_map_kind="lsm")
+    rep.nm.save_snapshot()
+    since = rep.last_append_at_ns
+    blobs.update(_fill_volume(src, 25, start=100))
+    data = b"".join(incremental_changes(src, since))
+    applied = apply_incremental(rep, data)
+    assert applied == 25
+    for i, d_ in blobs.items():
+        assert bytes(rep.read_needle_by_key(i).data) == d_, i
+    rep.close()
+    rep2 = Volume(str(rep_d), "", 4, create=False, needle_map_kind="lsm")
+    assert rep2.nm.loaded_from_snapshot
+    for i, d_ in blobs.items():
+        assert bytes(rep2.read_needle_by_key(i).data) == d_, i
+    rep2.close()
+    src.close()
+
+
+def test_volume_write_needle_batch_one_extent(tmp_path):
+    """write_needle_batch: byte-identical reads vs the single-needle
+    path on a twin volume, identical .idx entry streams, per-item
+    errors isolated (a cookie mismatch fails its slot only)."""
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import CookieMismatch, Volume
+
+    da, db = tmp_path / "a", tmp_path / "b"
+    da.mkdir()
+    db.mkdir()
+    va = Volume(str(da), "", 2, needle_map_kind="lsm")
+    vb = Volume(str(db), "", 2, needle_map_kind="lsm")
+    payloads = {i: os.urandom(200 + i) for i in range(1, 30)}
+    for i, p in payloads.items():
+        va.write_needle(Needle(cookie=0xAB, id=i, data=p))
+    res = vb.write_needle_batch(
+        [Needle(cookie=0xAB, id=i, data=p) for i, p in payloads.items()]
+    )
+    assert all(not isinstance(r, Exception) for r in res)
+    for i, p in payloads.items():
+        assert bytes(va.read_needle_by_key(i).data) == p
+        assert bytes(vb.read_needle_by_key(i).data) == p
+    with open(va.file_name() + ".idx", "rb") as fa, open(
+        vb.file_name() + ".idx", "rb"
+    ) as fb:
+        assert fa.read() == fb.read()
+    # mixed batch: one slot fails its cookie check, the rest land
+    res = vb.write_needle_batch(
+        [
+            Needle(cookie=0xAB, id=1, data=b"updated-1"),
+            Needle(cookie=0xEE, id=2, data=b"wrong-cookie"),
+            Needle(cookie=0xAB, id=3, data=b"updated-3"),
+        ]
+    )
+    assert isinstance(res[1], CookieMismatch)
+    assert not isinstance(res[0], Exception)
+    assert not isinstance(res[2], Exception)
+    assert bytes(vb.read_needle_by_key(1).data) == b"updated-1"
+    assert bytes(vb.read_needle_by_key(2).data) == payloads[2]
+    assert bytes(vb.read_needle_by_key(3).data) == b"updated-3"
+    va.destroy()
+    vb.destroy()
+
+
+# ---------------------------------------------------------- server layer --
+
+
+def test_batch_put_tenant_tagged_frame_e2e(tmp_path, monkeypatch):
+    """The tenant-tagged `!batch/put` frame through a live volume
+    server: one frame carries two tenants' needles; both land through
+    the group-commit coalesced path byte-identically, each member's
+    bytes are re-attributed to its OWN principal (heat/quota state
+    exists per member), and a member over its byte quota declines
+    item-wise with err='quota' while the rest of the frame lands."""
+    import json
+    import struct
+
+    monkeypatch.setenv("SEAWEEDFS_TPU_ADMIT", "1")
+    from test_cluster import Cluster, assign_retry
+
+    from seaweedfs_tpu.util.fasthttp import FastHTTPClient
+
+    async def body():
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        http = FastHTTPClient()
+        try:
+            ar = await assign_retry(cluster.master.address)
+            vs = cluster.volume_servers[0]
+            vid = int(ar.fid.split(",")[0])
+            fids = [ar.fid] + [f"{ar.fid}_{i}" for i in range(1, 8)]
+            tenants = ["alice", "bob", "alice", "bob", "", "alice",
+                       "bob", "alice"]
+            payloads = [os.urandom(300 + i) for i in range(8)]
+            parts = [struct.pack("<I", len(fids) | 0x80000000)]
+            for fid, tenant, payload in zip(fids, tenants, payloads):
+                fb = fid.encode()
+                tb = tenant.encode()
+                parts.append(
+                    struct.pack("<HHI", len(fb), len(tb), len(payload))
+                )
+                parts += [fb, tb, payload]
+            st, resp = await http.request(
+                "POST", vs.address, "/!batch/put",
+                body=b"".join(parts),
+                content_type="application/octet-stream",
+            )
+            assert st == 200, resp
+            out = json.loads(resp)
+            assert all("err" not in r for r in out), out
+            for fid, payload in zip(fids, payloads):
+                st, got = await http.request("GET", vs.address, "/" + fid)
+                assert st == 200 and got == payload, fid
+            # member principals were attributed at the volume gate
+            gate = vs._core.gate
+            assert "alice" in gate._tenants and "bob" in gate._tenants
+            # a member whose byte quota is dry declines item-wise
+            gate.set_tenant_quota("broke", byte_ps=1.0, burst_s=1.0)
+            gate._tenants["broke"].quota._bt = -10_000.0
+            parts = [struct.pack("<I", 2 | 0x80000000)]
+            refused_fid = f"{ar.fid}_20"
+            accepted_fid = f"{ar.fid}_21"
+            for fid, tenant, payload in (
+                (refused_fid, "broke", b"refused-bytes"),
+                (accepted_fid, "alice", b"accepted-bytes"),
+            ):
+                fb, tb = fid.encode(), tenant.encode()
+                parts.append(
+                    struct.pack("<HHI", len(fb), len(tb), len(payload))
+                )
+                parts += [fb, tb, payload]
+            st, resp = await http.request(
+                "POST", vs.address, "/!batch/put",
+                body=b"".join(parts),
+                content_type="application/octet-stream",
+            )
+            assert st == 200
+            out = {r["f"]: r for r in json.loads(resp)}
+            assert out[refused_fid].get("err") == "quota"
+            assert "err" not in out[accepted_fid]
+            # the quota shed was counted against the member
+            assert gate._tenants["broke"].shed >= 1
+            # group commit actually carried frames (coalesced appends)
+            gc = vs._group_committers.get(vid)
+            assert gc is not None and gc.stats["batches"] >= 1
+        finally:
+            await http.close()
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_grpc_byte_quota_seam(tmp_path):
+    """gRPC per-tenant byte quota (pb/rpc.py handler seam): a unary
+    call whose caller tenant is over its byte bucket aborts
+    RESOURCE_EXHAUSTED in the handler wrapper (no handler work), the
+    shed is counted class='rpc' reason='quota', and response bytes
+    charge the bucket at completion."""
+    import grpc
+
+    from test_cluster import free_port
+
+    from seaweedfs_tpu.pb.rpc import Service, Stub, serve
+    from seaweedfs_tpu.util import tenancy
+    from seaweedfs_tpu.util.overload import AdmissionGate
+
+    async def body():
+        gate = AdmissionGate("rpcquota")
+        gate.set_tenant_quota("metered", byte_ps=50.0, burst_s=1.0)
+        svc = Service("volume", gate=gate)
+        calls = []
+
+        @svc.unary("Echo")
+        async def _echo(req, context):
+            calls.append(req)
+            return {"echo": req.get("blob", b"")}
+
+        addr = f"127.0.0.1:{free_port()}"
+        server = await serve(addr, svc)
+        from seaweedfs_tpu.pb.rpc import new_channel
+
+        ch = new_channel(addr)
+        stub = Stub(addr, "volume", channel=ch)
+        try:
+            tok = tenancy.set_current("metered")
+            try:
+                out = await stub.call("Echo", {"blob": b"x" * 100})
+                assert out["echo"] == b"x" * 100
+                # drain the bucket: response+request bytes charged; a
+                # following oversized message must be refused
+                gate._tenants["metered"].quota._bt = -100_000.0
+                with pytest.raises(grpc.aio.AioRpcError) as ei:
+                    await stub.call("Echo", {"blob": b"y" * 100})
+                assert (
+                    ei.value.code()
+                    == grpc.StatusCode.RESOURCE_EXHAUSTED
+                )
+            finally:
+                tenancy.reset_current(tok)
+            assert len(calls) == 1  # the refused call never ran
+            assert gate._tenants["metered"].shed >= 1
+            # an unmetered tenant sails through the same seam
+            out = await stub.call("Echo", {"blob": b"z" * 50})
+            assert out["echo"] == b"z" * 50
+            # review fix: a non-ASCII tenant name must not hard-fail
+            # the RPC (metadata travels percent-encoded) and must
+            # round-trip exactly into the handler-side gate state
+            tok = tenancy.set_current("café-50%off")
+            try:
+                out = await stub.call("Echo", {"blob": b"q"})
+                assert out["echo"] == b"q"
+            finally:
+                tenancy.reset_current(tok)
+            assert "café-50%off" in gate._tenants
+        finally:
+            await ch.close()
+            await server.stop(0.2)
+
+    asyncio.run(body())

@@ -135,6 +135,15 @@ def test_metrics_hygiene_lint():
         "seaweedfs_tpu_overload_shed_total",
     ):
         assert family in names, f"tenant family {family} not registered"
+    # needle-index-at-scale plane (ISSUE 13): pin the lsm map families
+    # (resident bound, run/compaction health, snapshot age, tail cost)
+    for family in (
+        "seaweedfs_tpu_needle_map_resident_bytes",
+        "seaweedfs_tpu_needle_map_run_count",
+        "seaweedfs_tpu_needle_map_snapshot_age_seconds",
+        "seaweedfs_tpu_needle_map_tail_replay_entries_total",
+    ):
+        assert family in names, f"needle_map family {family} not registered"
 
 
 def test_tenant_label_cardinality_enforced_at_registry_seam():
