@@ -81,15 +81,41 @@ class IndexSnapshot:
     MIN_BUCKETED = 4096
     MAX_BUCKETS = 1 << 25
 
+    @staticmethod
+    def prepare_host_columns(
+        keys: np.ndarray, offsets: np.ndarray, sizes: np.ndarray
+    ):
+        """Host-side arrays the device upload consumes, prepared WITHOUT
+        copying dtype-matching inputs: a sealed LSM needle map's
+        `snapshot()` hands in the run's mmap'd columns (keys u64, offsets
+        u32, sizes u32), and `np.asarray`/`np.ascontiguousarray` are
+        no-op views on them — so the `jnp.asarray` upload reads the
+        on-disk pages directly (one DMA from page cache) instead of
+        transiting a heap copy (`.astype()` copies unconditionally; this
+        was the last copy on the lookup_gate refresh path of a sealed
+        volume). The (hi, lo) u32 key planes are derived compute — the
+        only allocation left. Returns (keys_u64, khi, klo, off_u32,
+        sizes_u32)."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        khi, klo = _split_u64(keys)
+        return (
+            keys,
+            khi,
+            klo,
+            np.asarray(offsets, dtype=np.uint32),
+            np.asarray(sizes, dtype=np.uint32),
+        )
+
     def __init__(self, keys: np.ndarray, offsets: np.ndarray, sizes: np.ndarray):
         assert len(keys) == len(offsets) == len(sizes)
         self.n = len(keys)
-        keys = np.ascontiguousarray(keys, dtype=np.uint64)
-        khi, klo = _split_u64(keys)
+        keys, khi, klo, off_u32, sizes_u32 = self.prepare_host_columns(
+            keys, offsets, sizes
+        )
         self.khi = jnp.asarray(khi)
         self.klo = jnp.asarray(klo)
-        self.offsets = jnp.asarray(offsets.astype(np.uint32))
-        self.sizes = jnp.asarray(sizes.astype(np.uint32))
+        self.offsets = jnp.asarray(off_u32)
+        self.sizes = jnp.asarray(sizes_u32)
         self.steps = max(1, int(np.ceil(np.log2(max(self.n, 1)))) + 1)
 
         # interpolation buckets (skipped for tiny tables and for key spans

@@ -76,9 +76,15 @@ class LogHistogram:
             self.max = seconds
 
     def percentile(self, p: float) -> float:
-        """Latency (seconds) at percentile p in [0, 100]: the geometric
-        midpoint of the covering bucket (upper-bounded by the observed
-        max, so a lone outlier reports itself, not its bucket ceiling)."""
+        """Latency (seconds) at percentile p in [0, 100]: geometrically
+        interpolated WITHIN the covering bucket by rank fraction
+        (upper-bounded by the observed max, so a lone outlier reports
+        itself, not its bucket ceiling). Raw bucket midpoints quantized
+        p99 RATIOS to 1.25x steps — two runs one bucket apart read as a
+        1.25-1.56x "regression" that never happened (the same fix the
+        tenant gate's latency_percentile got in PR 12); interpolation
+        keeps the error within the bucket while making ratios of two
+        histograms continuous."""
         if self.count == 0:
             return 0.0
         target = self.count * p / 100.0
@@ -90,8 +96,9 @@ class LogHistogram:
                     # overflow bucket: its midpoint means nothing — the
                     # observed max is the only honest answer there
                     return self.max
-                mid = self.base * self.growth ** (i + 0.5)
-                return min(mid, self.max) if self.max else mid
+                frac = (target - (cum - c)) / c  # rank position in bucket
+                val = self.base * self.growth ** (i + frac)
+                return min(val, self.max) if self.max else val
         return self.max
 
     @property

@@ -1,0 +1,243 @@
+"""In-tree HTTP blob server: the cold tier's stand-in object store.
+
+A minimal flat blob store (PUT/GET/HEAD/DELETE, single-range GETs) served
+through the shared `ServingCore`, so "the cloud" participates in every
+cross-cutting plane exactly like a cluster server: the server-side fault
+seam fires on it (`FaultRule(op="http:GET", target="<blob addr>")`
+brownouts the remote tier — the chaos surface cold-tier tests need),
+admission gates shed under overload, requests join distributed traces,
+and `/metrics`/`/debug/*` render on the cold tier.
+
+The URL namespace is S3-shaped (`/{bucket}/{key}`), so
+`storage/tier_backend.S3Backend` speaks to it unmodified: PUT stores the
+body (tmp + atomic rename — a torn upload can never be read back as a
+complete object), GET honors a single `Range: bytes=a-b` with 206 +
+Content-Range, HEAD reports Content-Length, DELETE removes (404-safe).
+Keys are sanitized against path escapes; nested keys become
+subdirectories.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from aiohttp import web
+
+from ..util.fasthttp import FALLBACK, render_response
+from ..util.http_range import parse_range
+
+_OCTET = b"application/octet-stream"
+
+
+class BlobServer:
+    """One directory of blobs behind a ServingCore two-tier HTTP front."""
+
+    def __init__(self, directory: str, port: int, host: str = "127.0.0.1"):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.host = host
+        self.port = port
+        self.address = f"{host}:{port}"
+        self._core = None
+
+    async def start(self) -> None:
+        from .serving_core import ServingCore
+
+        app = web.Application(client_max_size=1 << 30)
+        app.router.add_route("*", "/{tail:.*}", self._cold_dispatch)
+        self._core = ServingCore(
+            "blob", self._fast_dispatch, self.host, self.port
+        )
+        await self._core.start(app)
+
+    async def stop(self) -> None:
+        if self._core is not None:
+            await self._core.stop()
+
+    # ---------------- key handling ----------------
+    def _blob_path(self, url_path: str) -> Optional[str]:
+        """Filesystem path for a request path, or None when the key
+        escapes the root (every component is checked — no '..', no
+        absolute jumps)."""
+        key = url_path.lstrip("/")
+        if not key or "\x00" in key:
+            return None
+        parts = [p for p in key.split("/") if p not in ("", ".")]
+        if not parts or any(p == ".." for p in parts):
+            return None
+        return os.path.join(self.directory, *parts)
+
+    # ---------------- fast tier ----------------
+    async def _fast_dispatch(self, req):
+        """Blocking file I/O runs in the executor: a 1MB shard-span GET
+        or an upload's write+fsync inline on the loop would stall every
+        request behind it — in single-process benches/tests the blob
+        server SHARES the loop with the cluster it serves, so an inline
+        fsync here would bill the cold tier's disk latency straight onto
+        foreground read tails."""
+        import asyncio
+
+        path = self._blob_path(req.path)
+        if path is None:
+            return render_response(400, b'{"error":"bad blob key"}')
+        method = req.method
+        loop = asyncio.get_event_loop()
+        if method in ("GET", "HEAD"):
+            return await loop.run_in_executor(
+                None, self._serve_read, req, path, method == "HEAD"
+            )
+        if method in ("PUT", "POST"):
+            return await loop.run_in_executor(
+                None, self._serve_write, path, req.body
+            )
+        if method == "DELETE":
+            return await loop.run_in_executor(
+                None, self._serve_delete, path
+            )
+        return FALLBACK
+
+    def _serve_read(self, req, path: str, head_only: bool):
+        try:
+            f = open(path, "rb")
+        except (FileNotFoundError, IsADirectoryError, NotADirectoryError):
+            return render_response(404, b'{"error":"blob not found"}')
+        try:
+            total = os.fstat(f.fileno()).st_size
+            rng = req.headers.get(b"range")
+            if rng is not None:
+                r = parse_range(rng.decode("latin1"), total)
+                if r == "invalid-range":
+                    return render_response(
+                        416,
+                        b"",
+                        extra=b"Content-Range: bytes */%d\r\n" % total,
+                    )
+                if r is not None:
+                    start, end = r
+                    body = (
+                        b""
+                        if head_only
+                        else os.pread(f.fileno(), end - start + 1, start)
+                    )
+                    return render_response(
+                        206,
+                        body,
+                        content_type=_OCTET,
+                        extra=b"Content-Range: bytes %d-%d/%d\r\n"
+                        % (start, end, total),
+                        head_only=head_only,
+                    )
+            if head_only:
+                # Content-Length advertises the BODY size a GET would
+                # carry (S3File.size() HEADs it) without allocating it
+                return (
+                    b"HTTP/1.1 200 OK\r\n"
+                    b"Content-Type: application/octet-stream\r\n"
+                    b"Content-Length: %d\r\n"
+                    b"Connection: keep-alive\r\n\r\n" % total
+                )
+            return render_response(
+                200, os.pread(f.fileno(), total, 0), content_type=_OCTET
+            )
+        finally:
+            f.close()
+
+    def _write_blob(self, path: str, body: bytes) -> tuple[int, str]:
+        """(status, error) — shared by both tiers so the fallback can
+        never report a different outcome than the fast path would."""
+        tmp = path + ".tmp"
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(tmp, "wb") as f:
+                f.write(body)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError as e:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return 500, str(e)
+        return 200, ""
+
+    def _delete_blob(self, path: str) -> tuple[int, str]:
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            return 404, "blob not found"
+        except OSError as e:
+            return 500, str(e)
+        return 200, ""
+
+    def _serve_write(self, path: str, body: bytes):
+        status, err = self._write_blob(path, body)
+        if status != 200:
+            return render_response(
+                500, b'{"error":"%s"}' % err.encode()[:120]
+            )
+        return render_response(200, b"{}")
+
+    def _serve_delete(self, path: str):
+        status, err = self._delete_blob(path)
+        if status != 200:
+            return render_response(
+                status, b'{"error":"%s"}' % err.encode()[:120]
+            )
+        return render_response(200, b"{}")
+
+    # ---------------- cold tier (FALLBACK replay: chunked bodies etc.) ----
+    async def _cold_dispatch(self, request: web.Request) -> web.Response:
+        path = self._blob_path(request.path)
+        if path is None:
+            return web.json_response({"error": "bad blob key"}, status=400)
+        if request.method in ("GET", "HEAD"):
+            try:
+                with open(path, "rb") as f:
+                    total = os.fstat(f.fileno()).st_size
+                    rng = request.headers.get("Range")
+                    if rng:
+                        r = parse_range(rng, total)
+                        if r == "invalid-range":
+                            return web.Response(
+                                status=416,
+                                headers={
+                                    "Content-Range": f"bytes */{total}"
+                                },
+                            )
+                        if r is not None:
+                            start, end = r
+                            body = os.pread(
+                                f.fileno(), end - start + 1, start
+                            )
+                            return web.Response(
+                                status=206,
+                                body=b"" if request.method == "HEAD" else body,
+                                headers={
+                                    "Content-Range": (
+                                        f"bytes {start}-{end}/{total}"
+                                    )
+                                },
+                            )
+                    if request.method == "HEAD":
+                        return web.Response(
+                            headers={"Content-Length": str(total)}
+                        )
+                    return web.Response(body=os.pread(f.fileno(), total, 0))
+            except (FileNotFoundError, IsADirectoryError, NotADirectoryError):
+                return web.json_response(
+                    {"error": "blob not found"}, status=404
+                )
+        if request.method in ("PUT", "POST"):
+            body = await request.read()
+            status, err = self._write_blob(path, body)
+            return web.json_response(
+                {"error": err} if err else {}, status=status
+            )
+        if request.method == "DELETE":
+            status, err = self._delete_blob(path)
+            return web.json_response(
+                {"error": err} if err else {}, status=status
+            )
+        return web.json_response({"error": "method not allowed"}, status=405)

@@ -44,6 +44,8 @@ from ..topology.vacuum_plan import plan_vacuums
 from ..topology.lifecycle import (
     LifecycleConfig,
     plan_ec_conversions,
+    plan_offloads,
+    plan_recalls,
     plan_reinflations,
 )
 from ..util.metrics import (
@@ -53,6 +55,25 @@ from ..util.metrics import (
     REPAIR_SECONDS,
     VACUUM_QUEUE_DEPTH,
 )
+
+
+def _ec_tier_bits(messages: list) -> dict:
+    """{vid: (local_bits, offloaded_bits)} off an EC heartbeat/heat-tick
+    message list. Older senders carry no split: their ec_index_bits count
+    as local (nothing offloaded) — the planner stays backward-safe."""
+    out = {}
+    for m in messages:
+        try:
+            local = int(
+                m.get("ec_local_bits", m.get("ec_index_bits", 0)) or 0
+            )
+            out[int(m["id"])] = (
+                local,
+                int(m.get("ec_offloaded_bits", 0) or 0),
+            )
+        except (KeyError, TypeError, ValueError):
+            continue
+    return out
 
 
 class MasterServer:
@@ -184,6 +205,9 @@ class MasterServer:
         self.lifecycle_log: list[dict] = []
         self._lifecycle_task: Optional[asyncio.Task] = None
         self._lifecycle_inflight: set[int] = set()
+        # cold tier anti-flap: vid -> monotonic time its recall finished
+        # (plan_offloads exempts these for cfg.offload_holddown_s)
+        self._lifecycle_recall_at: dict[int, float] = {}
         self._clients: dict[str, asyncio.Queue] = {}
         self._option_cache: dict[tuple, GrowOption] = {}
         self._admin_token: Optional[tuple[int, float]] = None  # (token, ts)
@@ -731,6 +755,7 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
                         int(m["id"]): float(m.get("read_heat", 0.0))
                         for m in hb.get("ec_shards") or []
                     }
+                    dn.ec_tier = _ec_tier_bits(hb.get("ec_shards") or [])
                     new_ec, deleted_ec = dn.update_ec_shards(
                         hb.get("ec_shards") or []
                     )
@@ -790,11 +815,13 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
                 if hb.get("ec_heat") is not None:
                     # lifecycle tick: full snapshot of this node's EC read
                     # heat (an empty list clears it — the node holds no EC
-                    # volumes any more)
+                    # volumes any more); the cold-tier planners read the
+                    # local/offloaded split off the same tick
                     dn.ec_heat = {
                         int(m["id"]): float(m.get("read_heat", 0.0))
                         for m in hb["ec_heat"]
                     }
+                    dn.ec_tier = _ec_tier_bits(hb["ec_heat"])
 
                 if new_vids or deleted_vids:
                     self._broadcast_location(
@@ -1637,7 +1664,24 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
         tasks = plan_ec_conversions(
             states, self.topo.volume_size_limit, cfg, include_all=include_all
         )
-        tasks += plan_reinflations(self.topo.ec_heat_states(live), cfg)
+        ec_states = self.topo.ec_heat_states(live)
+        tasks += plan_reinflations(ec_states, cfg)
+        # cold tier (ISSUE 14): the coldest band descends to the remote
+        # backend; sustained heat climbs back — same queue, same backoff.
+        # Recently recalled volumes sit out the offload planner for the
+        # holddown window (anti-flap), and entries past it are dropped so
+        # the map stays bounded by the churn of one window.
+        now_mono = time.monotonic()
+        for vid in [
+            v
+            for v, ts in self._lifecycle_recall_at.items()
+            if now_mono - ts >= cfg.offload_holddown_s
+        ]:
+            del self._lifecycle_recall_at[vid]
+        tasks += plan_offloads(
+            ec_states, cfg, self._lifecycle_recall_at, now_mono
+        )
+        tasks += plan_recalls(ec_states, cfg)
         valid_keys = set()
         for t in tasks:
             valid_keys.add(t.key)
@@ -1673,7 +1717,10 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
                 "cold_write_heat": cfg.cold_write_heat,
                 "hot_read_heat": cfg.hot_read_heat,
                 "full_fraction": cfg.full_fraction,
+                "offload_read_heat": cfg.offload_read_heat,
+                "recall_read_heat": cfg.recall_read_heat,
             },
+            "cold_backend": cfg.cold_backend,
         }
 
     async def _dispatch_lifecycle_task(self, t, results: list) -> None:
@@ -1684,11 +1731,20 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
             results.append({**t.to_info(), "skipped": "already dispatching"})
             return
         self._lifecycle_inflight.add(t.vid)
-        direction = "ec" if t.kind == "lifecycle_ec" else "inflate"
+        direction = {
+            "lifecycle_ec": "ec",
+            "lifecycle_inflate": "inflate",
+            "lifecycle_offload": "offload",
+            "lifecycle_recall": "recall",
+        }.get(t.kind, "inflate")
         t0 = time.perf_counter()
         try:
             if t.kind == "lifecycle_ec":
                 outcome = await self._dispatch_lifecycle_convert(t)
+            elif t.kind == "lifecycle_offload":
+                outcome = await self._dispatch_lifecycle_offload(t)
+            elif t.kind == "lifecycle_recall":
+                outcome = await self._dispatch_lifecycle_recall(t)
             else:
                 outcome = await self._dispatch_lifecycle_inflate(t)
         except Exception as e:
@@ -1901,6 +1957,11 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
                 "VolumeLifecycleCheck", {"volume_id": t.vid}, timeout=30
             )
             if not r.get("error") and r.get("kind") == "ec":
+                if int(r.get("offloaded_shards", 0)):
+                    # cold tier: decode needs local shard files — the
+                    # recall dispatcher (triggered at a lower threshold)
+                    # brings them back first, then inflate re-qualifies
+                    return {"skipped": f"shards offloaded on {u}"}
                 total_heat += float(r.get("read_heat", 0.0))
         if total_heat < cfg.hot_read_heat:
             return {"skipped": f"cooled ({total_heat:.2f})"}
@@ -1973,6 +2034,178 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
             raise IOError(f"mount on {target}: {r['error']}")
         return {"converted": "volume", "target": target}
 
+    async def _live_ec_holders(self, vid: int) -> Optional[list[str]]:
+        """Live shard-holder urls of an EC volume, or None when it is no
+        longer registered (the task should drop, not backoff-loop)."""
+        locs = self.topo.lookup_ec_shards(vid)
+        if locs is None:
+            return None
+        live = {
+            dn.url
+            for dn in self.topo.live_data_nodes(self.repair_grace_seconds)
+        }
+        holders = set()
+        for sid in range(max(locs.expected_total, 1)):
+            for dn in locs.locations[sid]:
+                if dn.url in live:
+                    holders.add(dn.url)
+        return sorted(holders)
+
+    async def _ec_holder_heat_check(
+        self, vid: int, holders: list[str], field: str
+    ):
+        """Shared authoritative re-check of the offload/recall
+        dispatchers: per-holder VolumeLifecycleCheck summed into
+        (total_heat, holders whose `field` count is non-zero,
+        skip_reason_or_None). A holder that lost the volume is ignored
+        (others may still serve); a non-EC answer means the volume left
+        the warm tier entirely."""
+        total_heat = 0.0
+        matching: list[str] = []
+        for u in holders:
+            r = await Stub(grpc_address(u), "volume").call(
+                "VolumeLifecycleCheck", {"volume_id": vid}, timeout=30
+            )
+            if r.get("error"):
+                if "not found" in r["error"]:
+                    continue
+                raise IOError(f"lifecycle check on {u}: {r['error']}")
+            if r.get("kind") != "ec":
+                return 0.0, [], "not erasure-coded any more"
+            total_heat += float(r.get("read_heat", 0.0))
+            if int(r.get(field, 0)):
+                matching.append(u)
+        return total_heat, matching, None
+
+    async def _dispatch_lifecycle_offload(self, t) -> dict:
+        """warm→cold: authoritative heat re-check across shard holders →
+        every holder uploads its local shard files to the configured
+        remote backend (crash-safe per-shard manifest on each holder).
+        ROLLBACK on a mid-flight failure: holders that already offloaded
+        are recalled (delete_remote included), so a transient backend
+        failure leaves the volume uniformly local and the task retries
+        from a clean state — never a half-cold volume wedged in backoff."""
+        cfg = self.lifecycle_config
+        if not cfg.cold_backend:
+            return {"skipped": "no cold backend configured"}
+        holders = await self._live_ec_holders(t.vid)
+        if holders is None:
+            return {"skipped": "no longer registered"}
+        if not holders:
+            raise LookupError(f"ec volume {t.vid}: no live holders")
+
+        total_heat, with_local, skip = await self._ec_holder_heat_check(
+            t.vid, holders, "local_shards"
+        )
+        if skip is not None:
+            return {"skipped": skip}
+        if total_heat > cfg.offload_read_heat:
+            return {"skipped": f"warmed ({total_heat:.2f})"}
+        if not with_local:
+            return {"skipped": "already offloaded"}
+
+        attempted: list[str] = []
+        offloaded: dict = {}
+        total_bytes = 0
+        try:
+            for u in with_local:
+                # append BEFORE the call: a holder that fails mid-burst
+                # may have offloaded a shard subset, and the rollback
+                # must recall ITS partial progress too — not only the
+                # holders that completed
+                attempted.append(u)
+                r = await Stub(grpc_address(u), "volume").call(
+                    "VolumeEcShardsOffload",
+                    {
+                        "volume_id": t.vid,
+                        "collection": t.collection,
+                        "backend": cfg.cold_backend,
+                        "plane": "lifecycle",
+                    },
+                    timeout=3600,
+                )
+                if r.get("error"):
+                    raise IOError(f"offload on {u}: {r['error']}")
+                offloaded[u] = r.get("offloaded_shard_ids", [])
+                total_bytes += int(r.get("bytes", 0))
+        except Exception:
+            # rollback: bring every attempted holder back fully local so
+            # the retry starts from a uniform state (recall is idempotent
+            # and crash-safe per shard; a failed rollback leaves the
+            # manifest pointing at valid remote copies — still no loss)
+            for u in attempted:
+                try:
+                    await Stub(grpc_address(u), "volume").call(
+                        "VolumeEcShardsRecall",
+                        {
+                            "volume_id": t.vid,
+                            "collection": t.collection,
+                            "plane": "lifecycle",
+                        },
+                        timeout=3600,
+                    )
+                except Exception:
+                    pass
+            raise
+        return {
+            "offloaded": offloaded,
+            "backend": cfg.cold_backend,
+            "bytes": total_bytes,
+        }
+
+    async def _dispatch_lifecycle_recall(self, t) -> dict:
+        """cold→warm: authoritative heat re-check → every holder recalls
+        its offloaded shards back to local disk (download + atomic rename
+        + manifest uncommit + remote delete, per shard). Per-holder recall
+        walls ride the outcome (and tier_recall_seconds), so the bench can
+        disclose recall p99 — the latency a reheating volume pays before
+        it serves at local-disk prices again."""
+        cfg = self.lifecycle_config
+        holders = await self._live_ec_holders(t.vid)
+        if holders is None:
+            return {"skipped": "no longer registered"}
+        if not holders:
+            raise LookupError(f"ec volume {t.vid}: no live holders")
+
+        total_heat, with_remote, skip = await self._ec_holder_heat_check(
+            t.vid, holders, "offloaded_shards"
+        )
+        if skip is not None:
+            return {"skipped": skip}
+        if not with_remote:
+            return {"skipped": "already local"}
+        if total_heat < cfg.recall_read_heat:
+            return {"skipped": f"cooled ({total_heat:.2f})"}
+
+        recalled: dict = {}
+        walls: dict = {}
+        total_bytes = 0
+        for u in with_remote:
+            r = await Stub(grpc_address(u), "volume").call(
+                "VolumeEcShardsRecall",
+                {
+                    "volume_id": t.vid,
+                    "collection": t.collection,
+                    "plane": "lifecycle",
+                },
+                timeout=3600,
+            )
+            if r.get("error"):
+                # shards already recalled stay local (strictly safer than
+                # remote); the failed holder retries via backoff
+                raise IOError(f"recall on {u}: {r['error']}")
+            recalled[u] = r.get("recalled_shard_ids", [])
+            walls[u] = float(r.get("recall_s", 0.0))
+            total_bytes += int(r.get("bytes", 0))
+        # anti-flap holddown: the bytes just moved hot-ward must not
+        # immediately reverse when the heat pulse decays
+        self._lifecycle_recall_at[t.vid] = time.monotonic()
+        return {
+            "recalled": recalled,
+            "recall_s": walls,
+            "bytes": total_bytes,
+        }
+
     async def _grpc_lifecycle_status(self, req, context) -> dict:
         """Lifecycle-plane introspection for `volume.lifecycle -status`
         (+ `-run` to force a scan/dispatch round), mirroring
@@ -1994,7 +2227,10 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
                 "cold_write_heat": cfg.cold_write_heat,
                 "hot_read_heat": cfg.hot_read_heat,
                 "full_fraction": cfg.full_fraction,
+                "offload_read_heat": cfg.offload_read_heat,
+                "recall_read_heat": cfg.recall_read_heat,
             },
+            "cold_backend": cfg.cold_backend,
             "queue_depth": self.lifecycle_queue.depth(),
             "queue": self.lifecycle_queue.snapshot(),
             "recent": self.lifecycle_log[-10:],

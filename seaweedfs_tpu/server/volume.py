@@ -1755,7 +1755,15 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
             }
         ev = self.store.find_ec_volume(vid)
         if ev is not None:
-            return {"kind": "ec", "read_heat": ev.heat.read_heat()}
+            return {
+                "kind": "ec",
+                "read_heat": ev.heat.read_heat(),
+                # cold tier: the offload/recall dispatchers gate on the
+                # live split, and the inflate dispatcher refuses a volume
+                # whose shards are still remote (recall first)
+                "local_shards": len(ev.shards),
+                "offloaded_shards": len(ev.remote_shards),
+            }
         return {"error": f"volume {vid} not found"}
 
     async def _grpc_volume_configure(self, req, context) -> dict:

@@ -47,6 +47,7 @@ from ..storage.erasure_coding import (
 )
 from ..storage.erasure_coding.ec_volume import (
     EcVolume,
+    EcVolumeShard,
     NeedleNotFound,
     ShardBits,
     rebuild_ecx_file,
@@ -172,6 +173,8 @@ class EcHandlers:
         svc.unary("VolumeEcBlobDelete")(self._grpc_ec_blob_delete)
         svc.unary("VolumeEcShardsToVolume")(self._grpc_ec_shards_to_volume)
         svc.unary("VolumeEcShardsInfo")(self._grpc_ec_info)
+        svc.unary("VolumeEcShardsOffload")(self._grpc_ec_offload)
+        svc.unary("VolumeEcShardsRecall")(self._grpc_ec_recall)
 
     def _base_name(self, collection: str, vid: int) -> Optional[str]:
         v = self.store.find_volume(vid)
@@ -490,6 +493,29 @@ class EcHandlers:
             return {}
         # cached degraded-read spans may embed this generation's bytes
         self._ec_degraded_cache().invalidate(vid)
+        self._cold_cache().invalidate(vid)
+        # cold tier: an explicitly deleted OFFLOADED shard must drop its
+        # remote object and manifest entry too (manifest uncommit FIRST —
+        # a crash between the two leaves an orphaned remote blob, never a
+        # manifest naming a deleted one)
+        from ..storage import cold_tier, tier_backend
+
+        manifest = cold_tier.load_manifest(base)
+        ev = self.store.find_ec_volume(vid)
+        doomed = [sid for sid in shard_ids if sid in manifest]
+        for sid in doomed:
+            ent = manifest.pop(sid)
+            cold_tier.save_manifest(base, manifest)
+            if ev is not None:
+                ev.note_shard_recalled(sid)  # drops the in-memory entry
+            backend = tier_backend.get_backend(ent.get("backend", ""))
+            if backend is not None:
+                try:
+                    await asyncio.get_event_loop().run_in_executor(
+                        None, backend.delete_file, ent["key"]
+                    )
+                except Exception:
+                    pass  # an orphaned blob is bytes, never lost data
         for shard_id in shard_ids:
             try:
                 os.remove(base + to_ext(shard_id))
@@ -497,9 +523,9 @@ class EcHandlers:
                 pass
         remaining = [
             i for i in range(32) if os.path.exists(base + to_ext(i))
-        ]
+        ] or sorted(manifest)
         if not remaining:
-            for ext in (".ecx", ".ecj", ".vif"):
+            for ext in (".ecx", ".ecj", ".vif", ".ctm"):
                 try:
                     os.remove(base + ext)
                 except FileNotFoundError:
@@ -533,6 +559,7 @@ class EcHandlers:
         vid = int(req["volume_id"])
         shard_ids = [int(s) for s in req.get("shard_ids", [])]
         self._ec_degraded_cache().invalidate(vid)
+        self._cold_cache().invalidate(vid)
         removed = ShardBits()
         for shard_id in shard_ids:
             for loc in self.store.locations:
@@ -550,9 +577,17 @@ class EcHandlers:
         offset = int(req.get("offset", 0))
         size = int(req.get("size", 0))
         shard = self.store.find_ec_shard(vid, shard_id)
+        cold_ev = None
         if shard is None:
-            yield {"error": f"ec shard {vid}.{shard_id} not found"}
-            return
+            # cold tier: a shard this server offloaded still streams to
+            # peers — through the read-through cache, so a repairing /
+            # degraded-reading neighbour doesn't force a recall
+            ev = self.store.find_ec_volume(vid)
+            if ev is not None and ev.remote_shard(shard_id) is not None:
+                cold_ev = ev
+            else:
+                yield {"error": f"ec shard {vid}.{shard_id} not found"}
+                return
         # optional liveness check of the whole needle (ref :283-298)
         if req.get("file_key"):
             ev = self.store.find_ec_volume(vid)
@@ -567,7 +602,18 @@ class EcHandlers:
         remaining = size
         pos = offset
         while remaining > 0:
-            chunk = shard.read_at(min(1 << 20, remaining), pos)
+            if cold_ev is not None:
+                chunk = await self._read_cold_interval(
+                    cold_ev, shard_id, pos, min(1 << 20, remaining)
+                )
+                if chunk is None:
+                    yield {
+                        "error": f"ec shard {vid}.{shard_id}: remote tier "
+                        "read failed"
+                    }
+                    return
+            else:
+                chunk = shard.read_at(min(1 << 20, remaining), pos)
             if not chunk:
                 break
             yield {"data": chunk}
@@ -597,6 +643,7 @@ class EcHandlers:
         # the vid returns to (and may later re-leave) the normal-volume
         # world: cached spans must not survive into the next generation
         self._ec_degraded_cache().invalidate(vid)
+        self._cold_cache().invalidate(vid)
         codec = self._codec_from_vif(base)
         missing = [
             i
@@ -735,7 +782,20 @@ class EcHandlers:
     ) -> Optional[bytes]:
         shard = ev.find_shard(shard_id)
         if shard is not None:
-            return shard.read_at(size, offset)
+            try:
+                return shard.read_at(size, offset)
+            except OSError:
+                # offload race: the shard moved to the remote tier between
+                # find_shard and the pread (fd closed) — fall through to
+                # the cold-tier read instead of erroring the request
+                if ev.remote_shard(shard_id) is None:
+                    raise
+        # cold tier: a shard THIS server offloaded serves through the
+        # byte-range read-through cache (one ranged remote GET per
+        # readahead span, then page-cache-priced hits)
+        data = await self._read_cold_interval(ev, shard_id, offset, size)
+        if data is not None:
+            return data
         if deadline is None:
             deadline = deadline_after(EC_READ_DEADLINE_SECONDS)
         await self._refresh_shard_locations(ev)
@@ -790,6 +850,168 @@ class EcHandlers:
             cache = self._degraded_cache = DegradedIntervalCache()
         return cache
 
+    # ---------------- cold tier (ISSUE 14) ----------------
+    def _cold_cache(self):
+        """Per-server byte-range read-through cache over offloaded shard
+        extents (the DegradedIntervalCache pattern applied to the remote
+        tier)."""
+        cache = getattr(self, "_cold_extent_cache", None)
+        if cache is None:
+            from ..storage.cold_tier import RemoteExtentCache
+
+            cache = self._cold_extent_cache = RemoteExtentCache()
+        return cache
+
+    async def _read_cold_interval(
+        self, ev: EcVolume, shard_id: int, offset: int, size: int
+    ) -> Optional[bytes]:
+        """Read [offset, offset+size) of an OFFLOADED shard through the
+        read-through cache; the blocking remote GET (urllib) runs in the
+        executor. Returns None when the shard is not offloaded / backend
+        unknown; remote failures surface as None too so the caller falls
+        through to remote holders and reconstruction."""
+        from ..storage import cold_tier, tier_backend
+
+        if ev.remote_shard(shard_id) is None:
+            return None
+        loop = asyncio.get_event_loop()
+        try:
+            return await loop.run_in_executor(
+                None,
+                lambda: cold_tier.read_remote_extent(
+                    ev,
+                    shard_id,
+                    offset,
+                    size,
+                    self._cold_cache(),
+                    tier_backend.get_backend,
+                ),
+            )
+        except Exception:
+            return None
+
+    async def _grpc_ec_offload(self, req, context) -> dict:
+        """Move this server's LOCAL shard files of an EC volume onto the
+        named remote backend (cold tier): upload → crash-safe manifest
+        commit → unlink, per shard — no kill point loses the only copy.
+        Transfer bytes are charged to the shared maintenance budget
+        BEFORE the burst (plane from the request, lifecycle by default),
+        so offload I/O yields under foreground pressure like every other
+        background plane."""
+        from ..storage import cold_tier, tier_backend
+
+        vid = int(req["volume_id"])
+        backend_name = req.get("backend", "")
+        ev = self.store.find_ec_volume(vid)
+        if ev is None:
+            return {"error": f"ec volume {vid} not found"}
+        backend = tier_backend.get_backend(backend_name)
+        if backend is None:
+            return {
+                "error": f"backend {backend_name!r} not registered, "
+                f"supported: {sorted(tier_backend.BACKEND_STORAGES)}"
+            }
+        local = ev.shard_ids()
+        if not local:
+            return {"offloaded_shard_ids": [], "bytes": 0}
+        # per-SHARD budget pacing (not one pre-burst lump): the transfer
+        # itself is spread at the budget rate, so a multi-shard offload
+        # cannot slam the serving loops with one unthrottled burst after
+        # paying its whole charge up front
+        from ..storage.maintenance import plane_bucket
+
+        bucket = plane_bucket(req.get("plane") or "lifecycle")
+        throttle = bucket.consume if bucket is not None else None
+        loop = asyncio.get_event_loop()
+        try:
+            out = await loop.run_in_executor(
+                None,
+                lambda: cold_tier.offload_shards(
+                    ev, backend, throttle=throttle
+                ),
+            )
+        except Exception as e:
+            return {"error": str(e)}
+        # the union of (local | offloaded) bits is unchanged, so no
+        # shard delta rides the heartbeat; the per-pulse ec_heat tick
+        # carries the new split to the planner within seconds
+        return {
+            "offloaded_shard_ids": sorted(out),
+            "bytes": sum(out.values()),
+        }
+
+    async def _grpc_ec_recall(self, req, context) -> dict:
+        """Bring every offloaded shard of an EC volume back to local disk
+        (download → atomic rename → manifest uncommit → remote delete,
+        per shard), remount the shard files, and drop the volume's
+        read-through spans. Recall I/O is budget-charged like offload."""
+        from ..storage import cold_tier, tier_backend
+        from ..util.metrics import TIER_RECALL_SECONDS
+
+        vid = int(req["volume_id"])
+        collection = req.get("collection", "")
+        ev = self.store.find_ec_volume(vid)
+        if ev is None:
+            return {"error": f"ec volume {vid} not found"}
+        remote = dict(ev.remote_shards)
+        if not remote:
+            return {"recalled_shard_ids": [], "bytes": 0}
+        t0 = time.perf_counter()
+        from ..storage.maintenance import plane_bucket
+
+        bucket = plane_bucket(req.get("plane") or "lifecycle")
+        throttle = bucket.consume if bucket is not None else None
+        loop = asyncio.get_event_loop()
+        recall_err: Optional[Exception] = None
+        out: dict = {}
+        try:
+            out = await loop.run_in_executor(
+                None,
+                lambda: cold_tier.recall_shards(
+                    ev,
+                    tier_backend.get_backend,
+                    throttle=throttle,
+                    delete_remote=bool(req.get("delete_remote", True)),
+                ),
+            )
+        except Exception as e:
+            recall_err = e
+        # remount EVERY on-disk shard file that lacks a live
+        # EcVolumeShard — not just this call's downloads: a PARTIAL
+        # recall (failure after some shards landed) already dropped
+        # those sids from the manifest, so a remount keyed off the
+        # current call's result would leave them invisible (out of
+        # ev.shards AND ev.remote_shards) until a server restart
+        mount_errs = []
+        for loc in self.store.locations:
+            if loc.find_ec_volume(vid) is ev:
+                for sid in range(32):
+                    if ev.find_shard(sid) is not None:
+                        continue
+                    if not os.path.exists(ev.file_name() + to_ext(sid)):
+                        continue
+                    try:
+                        ev.add_shard(
+                            EcVolumeShard(
+                                loc.directory, ev.collection, vid, sid
+                            )
+                        )
+                    except OSError as e:
+                        mount_errs.append(f"shard {sid}: {e}")
+                break
+        self._cold_cache().invalidate(vid)
+        if recall_err is not None:
+            return {"error": str(recall_err)}
+        if mount_errs:
+            return {"error": "remount " + "; ".join(mount_errs)}
+        wall = time.perf_counter() - t0
+        TIER_RECALL_SECONDS.observe(wall)
+        return {
+            "recalled_shard_ids": sorted(out),
+            "bytes": sum(out.values()),
+            "recall_s": round(wall, 4),
+        }
+
     def _note_ec_tombstone(self, ev: EcVolume) -> None:
         """A needle was tombstoned in this volume's .ecx/.ecj: reconstructed
         spans may embed its bytes — drop them."""
@@ -829,6 +1051,12 @@ class EcHandlers:
             shard = ev.find_shard(shard_id)
             if shard is not None:
                 b = shard.read_at(span_size, span_start)
+            elif ev.remote_shard(shard_id) is not None:
+                # cold tier: an offloaded survivor feeds reconstruction
+                # through the read-through cache (one ranged remote GET)
+                b = await self._read_cold_interval(
+                    ev, shard_id, span_start, span_size
+                )
             else:
                 try:
                     b = await self._read_remote_shard_interval(

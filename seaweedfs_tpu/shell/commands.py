@@ -421,17 +421,25 @@ async def cmd_volume_lifecycle(env, argv) -> str:
     if r.get("error"):
         return f"lifecycle status failed: {r['error']}"
     th = r.get("thresholds", {})
+    cold_backend = r.get("cold_backend") or "off"
     lines = [
         f"auto_lifecycle: {'on' if r.get('auto_lifecycle') else 'off'} "
         f"(cold<= {th.get('cold_read_heat')}r/{th.get('cold_write_heat')}w, "
         f"hot>= {th.get('hot_read_heat')}, "
         f"full>= {th.get('full_fraction')}x limit) · "
+        f"cold tier: {cold_backend} "
+        f"(offload<= {th.get('offload_read_heat')}, "
+        f"recall>= {th.get('recall_read_heat')}) · "
         f"queue depth: {r.get('queue_depth', 0)}"
     ]
+    _DIRECTIONS = {
+        "lifecycle_ec": "auto-EC",
+        "lifecycle_inflate": "re-inflate",
+        "lifecycle_offload": "offload",
+        "lifecycle_recall": "recall",
+    }
     for t in r.get("queue", []):
-        direction = (
-            "auto-EC" if t["kind"] == "lifecycle_ec" else "re-inflate"
-        )
+        direction = _DIRECTIONS.get(t["kind"], t["kind"])
         lines.append(
             f"  queued volume {t['volume_id']} ({direction}, "
             f"attempts {t['attempts']})"
@@ -443,6 +451,17 @@ async def cmd_volume_lifecycle(env, argv) -> str:
             outcome = f"skipped ({t['skipped']})"
         elif t.get("converted") == "ec":
             outcome = f"erasure-coded (spread {t.get('spread')})"
+        elif t.get("offloaded") is not None:
+            outcome = (
+                f"offloaded to {t.get('backend')} ({t.get('bytes', 0)} B)"
+            )
+        elif t.get("recalled") is not None:
+            walls = t.get("recall_s") or {}
+            slowest = max(walls.values(), default=0.0)
+            outcome = (
+                f"recalled ({t.get('bytes', 0)} B, slowest holder "
+                f"{slowest:.3f}s)"
+            )
         else:
             outcome = f"re-inflated on {t.get('target')}"
         lines.append(f"  recent volume {t['volume_id']}: {outcome}")

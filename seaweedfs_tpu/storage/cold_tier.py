@@ -1,0 +1,481 @@
+"""Cold tier: remote offload of sealed EC shard files + read-through recall.
+
+The third verse of the paper's tiering arc (Haystack hot -> f4 warm -> cloud
+cold, PAPER.md layer map / `weed/storage/backend/`): the lifecycle planner's
+coldest band moves sealed `.ecNN` shard files onto a remote object backend
+(`storage/tier_backend.py` registry — in tests and benches an in-tree HTTP
+blob server served through `ServingCore`, so fault plans, admission gates
+and tracing fire on "the cloud" too), keeping only the `.ecx`/`.vif` index
+sidecars (and `.heat`) local. Reads of an offloaded shard go through a
+byte-range read-through cache (`RemoteExtentCache`, the
+`DegradedIntervalCache` pattern applied to remote extents), and sustained
+heat recalls the shards to local disk the way re-inflation already works.
+
+Crash discipline (the `.nmm`/`.cpx` shadow-write + sweep construction):
+placement is recorded in a per-volume tier manifest `<base>.ctm` written
+shadow-first (`<base>.ctm.shadow` -> fsync -> atomic rename), and the
+offload/recall step order guarantees NO kill point can lose the only copy
+of a shard:
+
+offload, per shard:   (1) upload to the backend (deterministic key, so a
+                          retried upload overwrites — shards are sealed)
+                      (2) commit the manifest entry (shadow + rename)
+                      (3) unlink the local shard file
+recall, per shard:    (1) download to `<shard>.ctmp` (swept at load)
+                      (2) atomic rename into place
+                      (3) drop the manifest entry (shadow + rename)
+                      (4) delete the remote object
+
+A crash between (1) and (2) of offload leaves a remote orphan and the local
+file — safe, the retry re-uploads over the same key. A crash between (2)
+and (3) leaves BOTH copies with the manifest naming the remote one — safe
+in either direction (resume-offload verifies the remote size then unlinks;
+resume-recall sees the local file, drops the entry, deletes the remote).
+Only after the manifest durably names the remote copy is the local file
+ever unlinked. `tests/test_cold_tier.py` drives a kill-point grid over
+every step to pin this.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional
+
+MANIFEST_EXT = ".ctm"
+SHADOW_EXT = ".ctm.shadow"
+RECALL_TMP_EXT = ".ctmp"
+
+# read-through cache sizing: spans widened to this alignment (readahead —
+# neighbouring needles on the same offloaded shard land in one remote GET)
+COLD_READ_SPAN = (
+    int(os.environ.get("SEAWEEDFS_TPU_COLD_READ_SPAN_KB", "128") or 128)
+    * 1024
+)
+COLD_CACHE_BYTES = (
+    int(os.environ.get("SEAWEEDFS_TPU_COLD_CACHE_MB", "32") or 32) << 20
+)
+
+
+# ---------------------------------------------------------------- manifest --
+
+
+def manifest_path(base: str) -> str:
+    return base + MANIFEST_EXT
+
+
+def sweep_manifest_shadow(base: str) -> bool:
+    """Drop a torn shadow left by a crash mid-commit (the `.cpd` sweep
+    discipline: a shadow is never read as authority). Returns True when
+    one was swept."""
+    shadow = base + SHADOW_EXT
+    if os.path.exists(shadow):
+        try:
+            os.remove(shadow)
+            return True
+        except OSError:
+            pass
+    return False
+
+
+def sweep_recall_tmps(base: str) -> int:
+    """Drop torn `.ecNN.ctmp` downloads left by a crash mid-recall.
+    Probes the 32 candidate names directly (shard ids are bounded by the
+    ShardBits width) instead of listing the directory — this runs in
+    every EcVolume constructor, and an os.listdir here would make a
+    10k-volume mount O(volumes x directory-entries)."""
+    from .erasure_coding import to_ext
+
+    swept = 0
+    for sid in range(32):
+        tmp = base + to_ext(sid) + RECALL_TMP_EXT
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+                swept += 1
+            except OSError:
+                pass
+    return swept
+
+
+def load_manifest(base: str) -> dict:
+    """{shard_id: {"key": str, "size": int, "backend": str}} from
+    `<base>.ctm`; {} when absent or unparseable (an unparseable manifest
+    means shards may exist remotely that we cannot name — refuse to guess:
+    the local files, if any, are the copies we trust)."""
+    sweep_manifest_shadow(base)
+    path = manifest_path(base)
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError):
+        return {}
+    out: dict[int, dict] = {}
+    for sid, ent in (d.get("shards") or {}).items():
+        try:
+            out[int(sid)] = {
+                "key": str(ent["key"]),
+                "size": int(ent.get("size", 0)),
+                "backend": str(ent.get("backend", "")),
+            }
+        except (KeyError, TypeError, ValueError):
+            continue
+    return out
+
+
+def save_manifest(base: str, shards: dict) -> None:
+    """Commit the manifest crash-atomically: full shadow write + fsync +
+    rename. An EMPTY manifest is removed outright (a volume with nothing
+    offloaded carries no sidecar)."""
+    path = manifest_path(base)
+    if not shards:
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            pass
+        return
+    shadow = base + SHADOW_EXT
+    payload = json.dumps(
+        {
+            "version": 1,
+            "shards": {
+                str(sid): {
+                    "key": ent["key"],
+                    "size": int(ent.get("size", 0)),
+                    "backend": ent.get("backend", ""),
+                }
+                for sid, ent in shards.items()
+            },
+        },
+        sort_keys=True,
+    )
+    with open(shadow, "w") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(shadow, path)
+
+
+# ------------------------------------------------------------ offload/recall --
+
+# step-hook names, in execution order per shard — the kill-point grid in
+# tests/test_cold_tier.py enumerates exactly these
+OFFLOAD_STEPS = ("upload", "commit", "unlink")
+RECALL_STEPS = ("download", "rename", "uncommit", "remote_delete")
+
+
+def offload_shards(
+    ev,
+    backend,
+    shard_ids: Optional[list[int]] = None,
+    step_hook: Optional[Callable[[str, int], None]] = None,
+    throttle: Optional[Callable[[int], object]] = None,
+) -> dict:
+    """Move an EcVolume's LOCAL shard files onto `backend`; returns
+    {shard_id: bytes_uploaded}. Blocking (urllib/file I/O) — callers run
+    it in an executor. `step_hook(step, shard_id)` fires before each step
+    (the kill-point seam); `throttle(n)` is the maintenance-budget charge
+    per shard (plane=lifecycle).
+
+    Resume semantics: a shard whose manifest entry already exists skips
+    the upload after verifying the remote size (a crash landed between
+    commit and unlink) and proceeds straight to the unlink. The local
+    file is ONLY unlinked after the manifest durably names the remote
+    copy."""
+    base = ev.file_name()
+    manifest = load_manifest(base)
+    todo = list(shard_ids) if shard_ids is not None else ev.shard_ids()
+    out: dict[int, int] = {}
+    for sid in todo:
+        shard = ev.find_shard(sid)
+        if shard is None:
+            continue
+        path = shard.file_name() + _to_ext(sid)
+        size = os.path.getsize(path)
+        if throttle is not None:
+            throttle(size)
+        ent = manifest.get(sid)
+        if ent is None or not _remote_size_matches(backend, ent, size):
+            if step_hook is not None:
+                step_hook("upload", sid)
+            key, uploaded = backend.copy_file(
+                path,
+                {
+                    "volumeId": str(ev.volume_id),
+                    "collection": ev.collection,
+                    "ext": _to_ext(sid),
+                },
+            )
+            if uploaded != size:
+                raise IOError(
+                    f"shard {ev.volume_id}.{sid}: uploaded {uploaded} of "
+                    f"{size} bytes"
+                )
+            manifest[sid] = {
+                "key": key,
+                "size": size,
+                "backend": backend.name,
+            }
+            if step_hook is not None:
+                step_hook("commit", sid)
+            save_manifest(base, manifest)
+        if step_hook is not None:
+            step_hook("unlink", sid)
+        # order matters: unlink BEFORE dropping the in-memory shard so a
+        # concurrent read holding the EcVolumeShard still preads the
+        # unlinked-but-open file. The fd is deliberately NOT closed here:
+        # a peer stream mid-VolumeEcShardRead may hold the shard object
+        # across awaits, and closing under it would turn its next pread
+        # into EBADF (or, after fd reuse, another file's bytes) — the
+        # last reference releasing the file object closes the fd.
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            pass
+        ev.note_shard_offloaded(sid, manifest[sid])
+        ev.delete_shard(sid)
+        out[sid] = size
+        _count_tier_bytes(size, "offload")
+    return out
+
+
+def recall_shards(
+    ev,
+    get_backend: Callable[[str], object],
+    step_hook: Optional[Callable[[str, int], None]] = None,
+    throttle: Optional[Callable[[int], object]] = None,
+    delete_remote: bool = True,
+) -> dict:
+    """Bring every offloaded shard of an EcVolume back to local disk;
+    returns {shard_id: bytes_downloaded}. Blocking — callers run it in an
+    executor. The remote object is deleted only AFTER the manifest entry
+    is durably dropped; a shard whose local file already exists (crash
+    between rename and uncommit) skips the download."""
+    base = ev.file_name()
+    manifest = load_manifest(base)
+    out: dict[int, int] = {}
+    for sid in sorted(manifest):
+        ent = manifest[sid]
+        backend = get_backend(ent.get("backend", ""))
+        if backend is None:
+            raise ValueError(
+                f"shard {ev.volume_id}.{sid}: backend "
+                f"{ent.get('backend')!r} not registered"
+            )
+        path = base + _to_ext(sid)
+        size = int(ent.get("size", 0))
+        if throttle is not None:
+            throttle(size)
+        if not os.path.exists(path):
+            if step_hook is not None:
+                step_hook("download", sid)
+            tmp = path + RECALL_TMP_EXT
+            got = backend.download_file(tmp, ent["key"])
+            if size and got != size:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise IOError(
+                    f"shard {ev.volume_id}.{sid}: recalled {got} of "
+                    f"{size} bytes"
+                )
+            with open(tmp, "rb") as f:
+                os.fsync(f.fileno())
+            if step_hook is not None:
+                step_hook("rename", sid)
+            os.replace(tmp, path)
+        if step_hook is not None:
+            step_hook("uncommit", sid)
+        del manifest[sid]
+        save_manifest(base, manifest)
+        ev.note_shard_recalled(sid)
+        if delete_remote:
+            if step_hook is not None:
+                step_hook("remote_delete", sid)
+            try:
+                backend.delete_file(ent["key"])
+            except Exception:
+                pass  # an orphan is bytes, never lost data
+        out[sid] = size or os.path.getsize(path)
+        _count_tier_bytes(out[sid], "recall")
+    return out
+
+
+def _remote_size_matches(backend, ent: dict, size: int) -> bool:
+    """Resume check: trust an existing manifest entry only when the
+    remote object is really there at the recorded size."""
+    try:
+        f = backend.new_storage_file(ent["key"])
+    except Exception:
+        return False
+    try:
+        return int(ent.get("size", -1)) == size and f.size() == size
+    except Exception:
+        return False
+    finally:
+        try:
+            f.close()
+        except Exception:
+            pass
+
+
+def _to_ext(shard_id: int) -> str:
+    from .erasure_coding import to_ext
+
+    return to_ext(shard_id)
+
+
+def _count_tier_bytes(n: int, direction: str) -> None:
+    try:
+        from ..util.metrics import TIER_OFFLOAD_BYTES
+
+        TIER_OFFLOAD_BYTES.inc(n, direction=direction)
+    except ImportError:
+        pass
+
+
+# ------------------------------------------------------- read-through cache --
+
+
+class RemoteExtentCache:
+    """Byte-bounded LRU of remote shard extents, keyed by
+    (volume_id, shard_id, span_start) — the `DegradedIntervalCache`
+    pattern applied to remote byte ranges.
+
+    A read of an offloaded shard widens its interval to COLD_READ_SPAN
+    alignment, fetches the whole span with ONE ranged remote GET, caches
+    it, and serves any later interval falling inside a cached span — a
+    hot offloaded shard costs one remote round trip per span instead of
+    per needle. Shard files are sealed (immutable once encoded), so spans
+    never go stale; recall/unmount/delete drop a volume's spans because
+    the shard is no longer remote at all."""
+
+    def __init__(
+        self,
+        capacity_bytes: int = COLD_CACHE_BYTES,
+        span: int = COLD_READ_SPAN,
+    ):
+        self.capacity = capacity_bytes
+        self.span = max(span, 4096)
+        self._spans: "OrderedDict[tuple, bytes]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.stats = {"hits": 0, "misses": 0}
+
+    def span_for(
+        self, offset: int, size: int, shard_size: Optional[int]
+    ) -> tuple[int, int]:
+        """Aligned (span_start, span_size) covering [offset, offset+size);
+        clamped to the shard end so the remote GET never reads short."""
+        if not shard_size or offset + size > shard_size:
+            return offset, size
+        start = offset - (offset % self.span)
+        end = offset + size
+        end += (-end) % self.span
+        return start, min(end, shard_size) - start
+
+    def get(
+        self, vid: int, shard_id: int, offset: int, size: int
+    ) -> Optional[bytes]:
+        start = offset - (offset % self.span)
+        with self._lock:
+            for key in ((vid, shard_id, start), (vid, shard_id, offset)):
+                span = self._spans.get(key)
+                if span is not None and key[2] + len(span) >= offset + size:
+                    self._spans.move_to_end(key)
+                    self.stats["hits"] += 1
+                    _count_cache(True)
+                    return span[offset - key[2] : offset - key[2] + size]
+            self.stats["misses"] += 1
+            _count_cache(False)
+        return None
+
+    def put(
+        self, vid: int, shard_id: int, span_start: int, data: bytes
+    ) -> None:
+        if len(data) > self.capacity:
+            return
+        key = (vid, shard_id, span_start)
+        with self._lock:
+            old = self._spans.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old)
+            self._spans[key] = data
+            self._bytes += len(data)
+            while self._bytes > self.capacity and self._spans:
+                _k, v = self._spans.popitem(last=False)
+                self._bytes -= len(v)
+
+    def invalidate(self, vid: int) -> int:
+        with self._lock:
+            doomed = [k for k in self._spans if k[0] == vid]
+            for k in doomed:
+                self._bytes -= len(self._spans.pop(k))
+            return len(doomed)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+
+def _count_cache(hit: bool) -> None:
+    try:
+        from ..util.metrics import (
+            TIER_REMOTE_CACHE_HITS,
+            TIER_REMOTE_CACHE_MISSES,
+        )
+
+        (TIER_REMOTE_CACHE_HITS if hit else TIER_REMOTE_CACHE_MISSES).inc()
+    except ImportError:
+        pass
+
+
+def read_remote_extent(
+    ev,
+    shard_id: int,
+    offset: int,
+    size: int,
+    cache: Optional[RemoteExtentCache],
+    get_backend: Callable[[str], object],
+) -> Optional[bytes]:
+    """Read [offset, offset+size) of an OFFLOADED shard through the
+    read-through cache (blocking — callers run it in an executor).
+    Returns None when the shard is not offloaded or the backend is
+    unknown; raises on remote I/O failure (the caller decides whether to
+    fall through to reconstruction)."""
+    ent = ev.remote_shard(shard_id)
+    if ent is None:
+        return None
+    if cache is not None:
+        hit = cache.get(ev.volume_id, shard_id, offset, size)
+        if hit is not None:
+            return hit
+    backend = get_backend(ent.get("backend", ""))
+    if backend is None:
+        return None
+    shard_size = int(ent.get("size", 0)) or None
+    if cache is not None:
+        span_start, span_size = cache.span_for(offset, size, shard_size)
+    else:
+        span_start, span_size = offset, size
+    f = backend.new_storage_file(ent["key"])
+    try:
+        data = f.read_at(span_size, span_start)
+    finally:
+        try:
+            f.close()
+        except Exception:
+            pass
+    if len(data) != span_size:
+        raise IOError(
+            f"shard {ev.volume_id}.{shard_id}: remote read returned "
+            f"{len(data)} of {span_size} bytes at {span_start}"
+        )
+    if cache is not None:
+        cache.put(ev.volume_id, shard_id, span_start, data)
+    return data[offset - span_start : offset - span_start + size]

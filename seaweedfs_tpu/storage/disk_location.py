@@ -15,6 +15,7 @@ from .volume import Volume
 _DAT_RE = re.compile(r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.dat$")
 _VIF_RE = re.compile(r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.vif$")
 _EC_RE = re.compile(r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.ec(?P<shard>[0-9][0-9])$")
+_CTM_RE = re.compile(r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.ctm$")
 
 
 def parse_volume_file_name(name: str) -> Optional[tuple[str, int]]:
@@ -126,6 +127,24 @@ class DiskLocation:
             ev.add_shard(shard)
         return shard
 
+    def load_cold_ec_volume(self, collection: str, vid: int) -> Optional[EcVolume]:
+        """Mount an EC volume whose shard files live entirely on the
+        remote tier (`.ecx` + `.ctm`, zero local `.ecNN`) — reads serve
+        through the read-through cache until heat recalls the shards."""
+        with self._lock:
+            ev = self.ec_volumes.get(vid)
+            if ev is not None:
+                return ev
+            try:
+                ev = EcVolume(self.directory, collection, vid)
+            except (FileNotFoundError, OSError):
+                return None
+            if not ev.remote_shards:
+                ev.close()
+                return None
+            self.ec_volumes[vid] = ev
+            return ev
+
     def unload_ec_shard(self, vid: int, shard_id: int) -> bool:
         with self._lock:
             ev = self.ec_volumes.get(vid)
@@ -135,7 +154,10 @@ class DiskLocation:
             if shard is None:
                 return False
             shard.close()
-            if not ev.shards:
+            # a volume with shards on the remote tier stays mounted: it
+            # still serves reads (through the cold cache) and must keep
+            # heartbeating its offloaded bits
+            if not ev.shards and not ev.remote_shards:
                 ev.close()
                 del self.ec_volumes[vid]
             return True
@@ -165,4 +187,21 @@ class DiskLocation:
                 count += 1
             except Exception:
                 continue
+        # cold tier: volumes whose every shard is offloaded leave no .ecNN
+        # behind — discover them via the .ctm manifest + .ecx pair
+        for name in sorted(os.listdir(self.directory)):
+            m = _CTM_RE.match(name)
+            if not m:
+                continue
+            collection = m.group("collection") or ""
+            vid = int(m.group("vid"))
+            base = (
+                os.path.join(self.directory, f"{collection}_{vid}")
+                if collection
+                else os.path.join(self.directory, str(vid))
+            )
+            if vid in self.ec_volumes or not os.path.exists(base + ".ecx"):
+                continue
+            if self.load_cold_ec_volume(collection, vid) is not None:
+                count += 1
         return count

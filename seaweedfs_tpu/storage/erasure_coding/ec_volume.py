@@ -187,6 +187,14 @@ class EcVolume:
                 self.data_shards = info.data_shards
                 self.parity_shards = info.parity_shards
         self.shards: list[EcVolumeShard] = []
+        # cold tier (ISSUE 14): shard_id -> {key, size, backend} for shard
+        # files offloaded to a remote backend (the crash-safe `.ctm`
+        # manifest is the authority; torn shadows/recall tmps are swept
+        # here exactly like the vacuum .cpd sweep at volume load)
+        from ..cold_tier import load_manifest, sweep_recall_tmps
+
+        sweep_recall_tmps(base)
+        self.remote_shards: dict[int, dict] = load_manifest(base)
         # shard_id -> list of server addresses, refreshed from master
         self.shard_locations: dict[int, list[str]] = {}
         self.shard_locations_lock = threading.RLock()
@@ -237,10 +245,37 @@ class EcVolume:
         return b
 
     def shard_size(self) -> int:
-        return self.shards[0].size if self.shards else 0
+        if self.shards:
+            return self.shards[0].size
+        # fully offloaded volume: interval math still needs the sealed
+        # shard size — the manifest recorded it at offload time
+        for ent in self.remote_shards.values():
+            if ent.get("size"):
+                return int(ent["size"])
+        return 0
 
     def size(self) -> int:
-        return sum(s.size for s in self.shards)
+        return sum(s.size for s in self.shards) + sum(
+            int(e.get("size", 0)) for e in self.remote_shards.values()
+        )
+
+    # --- cold tier (offloaded shards) ---
+    def remote_shard(self, shard_id: int) -> Optional[dict]:
+        return self.remote_shards.get(shard_id)
+
+    def offloaded_bits(self) -> ShardBits:
+        b = ShardBits()
+        for sid in self.remote_shards:
+            b = b.add(sid)
+        return b
+
+    def note_shard_offloaded(self, shard_id: int, ent: dict) -> None:
+        """Bookkeeping hook fired by cold_tier.offload_shards after the
+        manifest commit (the in-memory view mirrors the durable one)."""
+        self.remote_shards[shard_id] = dict(ent)
+
+    def note_shard_recalled(self, shard_id: int) -> None:
+        self.remote_shards.pop(shard_id, None)
 
     # --- lookup ---
     def find_needle_from_ecx(self, needle_id: int) -> tuple[int, int]:
@@ -360,7 +395,10 @@ class EcVolume:
             except FileNotFoundError:
                 pass
         base = self.file_name()
-        for ext in (".ecx", ".ecj", ".vif", ".heat"):
+        # .ctm last: destroying a volume drops the local index files; the
+        # remote objects it names become orphaned BYTES, never lost data
+        # (the delete RPC path deletes them explicitly before this)
+        for ext in (".ecx", ".ecj", ".vif", ".heat", ".ctm"):
             try:
                 os.remove(base + ext)
             except FileNotFoundError:

@@ -252,11 +252,19 @@ class Store:
         shard_messages = []
         for loc in self.locations:
             for vid, ev in loc.ec_volumes.items():
+                # cold tier: ec_index_bits = local | offloaded — this
+                # server still SERVES an offloaded shard (through the
+                # remote read-through path), so lookup/read routing is
+                # unchanged; the split rides alongside for the planner
+                local = ev.shard_bits()
+                offloaded = ev.offloaded_bits()
                 shard_messages.append(
                     {
                         "id": vid,
                         "collection": ev.collection,
-                        "ec_index_bits": ev.shard_bits().bits,
+                        "ec_index_bits": local.plus(offloaded).bits,
+                        "ec_local_bits": local.bits,
+                        "ec_offloaded_bits": offloaded.bits,
                         "read_heat": round(ev.heat.read_heat(), 4),
                     }
                 )
@@ -282,6 +290,11 @@ class Store:
                         "id": vid,
                         "collection": ev.collection,
                         "read_heat": round(h, 4),
+                        # cold tier: the offload/recall planners rank off
+                        # this same slim refresh (seconds-fresh, like the
+                        # re-inflation sensor)
+                        "ec_local_bits": ev.shard_bits().bits,
+                        "ec_offloaded_bits": ev.offloaded_bits().bits,
                     }
                 )
         try:

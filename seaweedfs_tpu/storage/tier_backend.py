@@ -34,9 +34,11 @@ import random
 import shutil
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Callable, Optional
 
+from ..util import faults as _faults
 from ..util.backoff import (
     BackoffPolicy,
     deadline_after,
@@ -53,6 +55,57 @@ _COPY_CHUNK = 1 << 20
 _READ_DEADLINE_S = 60.0
 _TRANSFER_DEADLINE_S = 600.0
 _RETRY_POLICY = BackoffPolicy(base=0.1, cap=5.0, attempts=4)
+
+
+def _consult_remote_faults(
+    method: str, url: str, timeout: Optional[float] = None
+) -> None:
+    """Client-side fault seam for the synchronous urllib remote-tier
+    path (ISSUE 14 satellite): the same `FaultPlan` rules that brownout
+    the async clients fire here, with op ``http:<METHOD>`` and the
+    remote endpoint's host:port as target — so cold-tier chaos tests are
+    seed-deterministic like every other plane (docs/robustness.md fault
+    matrix, row "remote"). Injected shapes map onto what urllib would
+    really raise: reset/partition -> URLError(ConnectionResetError),
+    hang -> sleeps out the caller's socket timeout then
+    URLError(TimeoutError), http_error -> HTTPError(status) with
+    Retry-After on shed-shaped statuses (exercising `_sync_retry`'s
+    honor path), latency sleeps, crash kills the plan (SimulatedCrash
+    thereafter, like every sync seam)."""
+    plan = _faults._PLAN
+    if plan is None:
+        return
+    target = urllib.parse.urlsplit(url).netloc or url
+    ev = plan.match(f"http:{method}", target)
+    if ev is None:
+        return
+    kind = ev.kind
+    if kind == "latency":
+        time.sleep(ev.delay)
+        return
+    if kind == "crash":
+        plan.mark_dead()
+        raise _faults.SimulatedCrash(f"crash in http:{method} to {target}")
+    if kind == "http_error":
+        import email.message
+
+        hdrs = email.message.Message()
+        if ev.rule.status in (429, 503):
+            hdrs["Retry-After"] = "1"
+        raise urllib.error.HTTPError(
+            url, ev.rule.status, "injected fault", hdrs, None
+        )
+    if kind == "hang":
+        bounds = [w for w in (ev.delay or None, timeout) if w is not None]
+        time.sleep(min(bounds) if bounds else 30.0)
+        raise urllib.error.URLError(
+            TimeoutError(f"injected hang: http:{method} to {target}")
+        )
+    if kind in ("reset", "partition"):
+        raise urllib.error.URLError(
+            ConnectionResetError(f"injected {kind}: {target}")
+        )
+    raise urllib.error.URLError(_faults.injected_eio(target))
 
 
 def _retryable(e: BaseException) -> bool:
@@ -224,6 +277,7 @@ class S3File:
 
     def read_at(self, size: int, offset: int) -> bytes:
         def attempt(timeout: float) -> bytes:
+            _consult_remote_faults("GET", self._url, timeout)
             req = urllib.request.Request(
                 self._url,
                 headers={"Range": f"bytes={offset}-{offset + size - 1}"},
@@ -258,6 +312,7 @@ class S3File:
     def size(self) -> int:
         if self._size is None:
             def attempt(timeout: float) -> int:
+                _consult_remote_faults("HEAD", self._url, timeout)
                 req = urllib.request.Request(self._url, method="HEAD")
                 with urllib.request.urlopen(req, timeout=timeout) as resp:
                     return int(resp.headers.get("Content-Length", 0))
@@ -294,26 +349,57 @@ class S3Backend(BackendStorage):
         return S3File(self.endpoint, self.bucket, key, known_size)
 
     def copy_file(self, path: str, attributes: dict, fn: ProgressFn = None):
+        import mmap
+
         key = _tier_key(attributes, path)
         total = os.path.getsize(path)
+        # mmap, not read(): sealed EC shards run to GBs, and a heap copy
+        # per upload (x retry attempts, x concurrent offloads) would OOM
+        # the volume server this tier exists to relieve — the socket
+        # sends straight from page cache, and the buffer is re-readable
+        # so _sync_retry's whole-PUT retries need no rewind bookkeeping
         with open(path, "rb") as f:
-            data = f.read()
-
-        def attempt(timeout: float) -> None:
-            req = urllib.request.Request(
-                self._url(key), data=data, method="PUT"
+            buf = (
+                mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+                if total
+                else b""
             )
-            with urllib.request.urlopen(req, timeout=timeout):
-                pass
+            try:
+                def attempt(timeout: float) -> None:
+                    _consult_remote_faults("PUT", self._url(key), timeout)
+                    if total:
+                        # http.client streams read()-able bodies from
+                        # their CURRENT position: an attempt that died
+                        # mid-send leaves the mmap advanced, and the
+                        # retry would send fewer bytes than its
+                        # Content-Length claims — rewind per attempt
+                        buf.seek(0)
+                    req = urllib.request.Request(
+                        self._url(key), data=buf, method="PUT"
+                    )
+                    # explicit length: urllib would otherwise see the
+                    # read()-able body as a stream and switch to
+                    # Transfer-Encoding: chunked, where a mid-send
+                    # failure's remainder could parse as a COMPLETE
+                    # (truncated) object on lenient endpoints
+                    req.add_unredirected_header(
+                        "Content-Length", str(total)
+                    )
+                    with urllib.request.urlopen(req, timeout=timeout):
+                        pass
 
-        # PUT is idempotent (same bytes, same key): safe to retry whole
-        _sync_retry(attempt, "tier_s3_put", _TRANSFER_DEADLINE_S)
+                # PUT is idempotent (same bytes, same key): safe to retry
+                _sync_retry(attempt, "tier_s3_put", _TRANSFER_DEADLINE_S)
+            finally:
+                if total:
+                    buf.close()
         if fn is not None:
             fn(total, 100.0)
         return key, total
 
     def download_file(self, file_name: str, key: str, fn: ProgressFn = None) -> int:
         def attempt(timeout: float) -> int:
+            _consult_remote_faults("GET", self._url(key), timeout)
             req = urllib.request.Request(self._url(key))
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 total = int(resp.headers.get("Content-Length", 0))
@@ -327,6 +413,7 @@ class S3Backend(BackendStorage):
 
     def delete_file(self, key: str) -> None:
         def attempt(timeout: float) -> None:
+            _consult_remote_faults("DELETE", self._url(key), timeout)
             with urllib.request.urlopen(
                 urllib.request.Request(self._url(key), method="DELETE"),
                 timeout=timeout,

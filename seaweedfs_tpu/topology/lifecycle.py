@@ -15,21 +15,35 @@ Two planners close the Haystack→f4 arc (PAPER.md) inside one cluster:
   least traffic converts first.
 - `plan_reinflations` qualifies EC volumes whose aggregated read heat
   rose past the HOT threshold for decode back into a normal volume —
-  hottest first.
+  hottest first (offloaded volumes are excluded: their shards must
+  recall to local disk first, which `plan_recalls` handles at a lower
+  threshold — by the time a volume is hot enough to re-inflate it is
+  already local again).
 
-Hysteresis lives in the threshold pair: `hot_read_heat` must sit well
-above `cold_read_heat` (enforced at config construction), so an access
-mix oscillating between the two never flaps EC↔un-EC — a volume must
-genuinely cool below cold to leave the hot tier and genuinely heat past
-hot to come back, and the dispatcher's authoritative
-`VolumeLifecycleCheck` re-check catches anything that changed since the
-heartbeat sample.
+The cold tier (ISSUE 14) extends the arc one band further down:
+
+- `plan_offloads` qualifies EC volumes whose aggregated read heat fell
+  below `offload_read_heat` (a band BELOW cold) for shard-file offload
+  onto the configured remote backend — coldest first, and only when a
+  `cold_backend` is configured.
+- `plan_recalls` qualifies offloaded volumes whose heat rose past
+  `recall_read_heat` for recall to local disk — hottest first.
+
+Hysteresis lives in the threshold pairs: `hot_read_heat` must sit well
+above `cold_read_heat`, and `recall_read_heat` well above
+`offload_read_heat` (both enforced at config construction), so an access
+mix oscillating inside a band never flaps EC↔un-EC or offload↔recall —
+a volume must genuinely cool below the lower edge to descend a tier and
+genuinely heat past the upper edge to climb back, and the dispatcher's
+authoritative `VolumeLifecycleCheck` re-check catches anything that
+changed since the heartbeat sample.
 """
 
 from __future__ import annotations
 
 import os
 from dataclasses import dataclass
+from typing import Optional
 
 from .repair import RepairTask
 
@@ -65,6 +79,25 @@ class LifecycleConfig:
     cold_write_heat: float = 0.5
     hot_read_heat: float = 50.0
     full_fraction: float = 0.85
+    # cold tier (ISSUE 14): a band BELOW cold — sealed EC shards of
+    # volumes this cold move to the remote backend; sustained heat past
+    # recall brings them back. Disabled until a backend is named
+    # (SEAWEEDFS_TPU_COLD_BACKEND, e.g. "s3.cold" / "local.default").
+    offload_read_heat: float = 0.05
+    recall_read_heat: float = 5.0
+    cold_backend: str = ""
+    # anti-flap holddown: a volume the plane just RECALLED is exempt
+    # from offload planning for this long, however cold it looks — the
+    # heat thresholds alone are hysteresis in VALUE, this is hysteresis
+    # in TIME (a short heat half-life would otherwise let a recalled
+    # volume's heat collapse across the whole band between two scans
+    # and ping-pong transfer bytes through the backend)
+    offload_holddown_s: float = 600.0
+    # optional scope: comma-separated collection names the lifecycle
+    # plane may touch ("" = every collection). Operators pin archival
+    # collections into the arc without exposing latency-sensitive ones
+    # to conversion churn; benches scope the plane to their cold corpus.
+    collections: str = ""
 
     def __post_init__(self):
         if self.hot_read_heat <= self.cold_read_heat:
@@ -72,6 +105,12 @@ class LifecycleConfig:
                 "lifecycle hysteresis violated: hot_read_heat "
                 f"({self.hot_read_heat}) must exceed cold_read_heat "
                 f"({self.cold_read_heat})"
+            )
+        if self.recall_read_heat <= self.offload_read_heat:
+            raise ValueError(
+                "cold-tier hysteresis violated: recall_read_heat "
+                f"({self.recall_read_heat}) must exceed offload_read_heat "
+                f"({self.offload_read_heat})"
             )
 
     @classmethod
@@ -90,7 +129,32 @@ class LifecycleConfig:
             full_fraction=_env_float(
                 "SEAWEEDFS_TPU_LIFECYCLE_FULL_FRACTION", cls.full_fraction
             ),
+            offload_read_heat=_env_float(
+                "SEAWEEDFS_TPU_LIFECYCLE_OFFLOAD_HEAT",
+                cls.offload_read_heat,
+            ),
+            recall_read_heat=_env_float(
+                "SEAWEEDFS_TPU_LIFECYCLE_RECALL_HEAT",
+                cls.recall_read_heat,
+            ),
+            cold_backend=os.environ.get(
+                "SEAWEEDFS_TPU_COLD_BACKEND", ""
+            ).strip(),
+            offload_holddown_s=_env_float(
+                "SEAWEEDFS_TPU_LIFECYCLE_OFFLOAD_HOLDDOWN",
+                cls.offload_holddown_s,
+            ),
+            collections=os.environ.get(
+                "SEAWEEDFS_TPU_LIFECYCLE_COLLECTIONS", ""
+            ).strip(),
         )
+
+    def collection_allowed(self, collection: str) -> bool:
+        if not self.collections:
+            return True
+        return collection in {
+            c.strip() for c in self.collections.split(",")
+        }
 
 
 def volume_total_heat(replicas: list[dict]) -> tuple[float, float]:
@@ -131,6 +195,8 @@ def plan_ec_conversions(
     tasks = []
     for vid, replicas in volume_states.items():
         if not replicas:
+            continue
+        if not cfg.collection_allowed(replicas[0].get("collection", "")):
             continue
         if any(r.get("scrub_corrupt") for r in replicas):
             continue
@@ -173,8 +239,15 @@ def plan_reinflations(
     """
     tasks = []
     for vid, st in ec_heat_states.items():
+        if not cfg.collection_allowed(st.get("collection", "")):
+            continue
         heat = float(st.get("read_heat", 0.0))
         if heat < cfg.hot_read_heat:
+            continue
+        if int(st.get("offloaded_bits", 0)):
+            # shards on the remote tier: decode needs them local, and the
+            # recall planner already fired at a LOWER threshold — inflate
+            # re-qualifies on the scan after the recall lands
             continue
         tasks.append(
             RepairTask(
@@ -182,6 +255,90 @@ def plan_reinflations(
                 vid=int(vid),
                 collection=st.get("collection", ""),
                 priority=hotness_priority(heat),
+            )
+        )
+    tasks.sort(key=lambda t: (t.priority, t.vid))
+    return tasks
+
+
+def _bit_count(bits: int) -> int:
+    from ..storage.erasure_coding.ec_volume import ShardBits
+
+    return ShardBits(int(bits)).count()
+
+
+def plan_offloads(
+    ec_heat_states: dict,
+    cfg: LifecycleConfig,
+    recalled_at: Optional[dict] = None,
+    now: float = 0.0,
+) -> list[RepairTask]:
+    """Cold-tier offload planning over the per-pulse EC heat refresh.
+
+    An EC volume whose summed read heat sits below `offload_read_heat`
+    and that still has LOCAL shard files becomes one
+    kind="lifecycle_offload" task, coldest first — but only when the
+    config names a `cold_backend` (no backend, no cold tier). Volumes
+    inside the recall holddown window (`recalled_at`: {vid: monotonic
+    recall-completion time}) are exempt, however cold: a transfer the
+    plane just paid for in the hot direction must not immediately
+    reverse. The dispatcher's authoritative VolumeLifecycleCheck
+    re-applies the heat gate per holder before any transfer I/O is
+    spent.
+    """
+    if not cfg.cold_backend:
+        return []
+    recalled_at = recalled_at or {}
+    tasks = []
+    for vid, st in ec_heat_states.items():
+        if not cfg.collection_allowed(st.get("collection", "")):
+            continue
+        heat = float(st.get("read_heat", 0.0))
+        if heat > cfg.offload_read_heat:
+            continue
+        if not int(st.get("local_bits", 0)):
+            continue  # nothing left to offload
+        t_rec = recalled_at.get(int(vid))
+        if t_rec is not None and now - t_rec < cfg.offload_holddown_s:
+            continue  # anti-flap: just recalled, hold it local
+        tasks.append(
+            RepairTask(
+                kind="lifecycle_offload",
+                vid=int(vid),
+                collection=st.get("collection", ""),
+                priority=coldness_priority(heat),
+                survivors=_bit_count(st.get("local_bits", 0)),
+            )
+        )
+    tasks.sort(key=lambda t: (t.priority, t.vid))
+    return tasks
+
+
+def plan_recalls(
+    ec_heat_states: dict, cfg: LifecycleConfig
+) -> list[RepairTask]:
+    """Cold-tier recall planning: an offloaded EC volume whose summed
+    read heat rose past `recall_read_heat` becomes one
+    kind="lifecycle_recall" task, hottest first. Recall fires well below
+    the re-inflation threshold (enforced hysteresis), so a warming
+    volume lands back on local disk before it could qualify to decode.
+    """
+    tasks = []
+    for vid, st in ec_heat_states.items():
+        if not cfg.collection_allowed(st.get("collection", "")):
+            continue
+        heat = float(st.get("read_heat", 0.0))
+        if heat < cfg.recall_read_heat:
+            continue
+        if not int(st.get("offloaded_bits", 0)):
+            continue
+        tasks.append(
+            RepairTask(
+                kind="lifecycle_recall",
+                vid=int(vid),
+                collection=st.get("collection", ""),
+                priority=hotness_priority(heat),
+                survivors=_bit_count(st.get("offloaded_bits", 0)),
             )
         )
     tasks.sort(key=lambda t: (t.priority, t.vid))

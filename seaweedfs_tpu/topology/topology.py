@@ -281,10 +281,12 @@ class Topology(Node):
         return states
 
     def ec_heat_states(self, live_urls: Optional[set] = None) -> dict:
-        """{vid: {collection, read_heat}} with heat SUMMED across live
-        shard holders — the `lifecycle.plan_reinflations` input. Heat per
-        holder comes from the per-pulse EC heat refresh the master stores
-        on each DataNode (`dn.ec_heat`)."""
+        """{vid: {collection, read_heat, local_bits, offloaded_bits}}
+        with heat SUMMED (and tier bits OR-ed) across live shard holders —
+        the input of `lifecycle.plan_reinflations` / `plan_offloads` /
+        `plan_recalls`. Heat and the cold-tier split per holder come from
+        the per-pulse EC heat refresh the master stores on each DataNode
+        (`dn.ec_heat` / `dn.ec_tier`)."""
         out: Dict[int, dict] = {}
         with self._ec_lock:
             registered = {
@@ -295,14 +297,25 @@ class Topology(Node):
         for dn in self.data_nodes():
             if live_urls is not None and dn.url not in live_urls:
                 continue
+            tier = getattr(dn, "ec_tier", {})
             for vid, heat in list(getattr(dn, "ec_heat", {}).items()):
                 if vid not in registered or vid not in dn.ec_shards:
                     continue
                 st = out.setdefault(
                     int(vid),
-                    {"collection": registered[vid], "read_heat": 0.0},
+                    {
+                        "collection": registered[vid],
+                        "read_heat": 0.0,
+                        "local_bits": 0,
+                        "offloaded_bits": 0,
+                    },
                 )
                 st["read_heat"] += float(heat)
+                local, offloaded = tier.get(
+                    vid, (dn.ec_shards.get(vid, ShardBits()).bits, 0)
+                )
+                st["local_bits"] |= int(local)
+                st["offloaded_bits"] |= int(offloaded)
         return out
 
     def to_info(self) -> dict:

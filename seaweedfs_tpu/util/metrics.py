@@ -654,6 +654,35 @@ NEEDLE_MAP_TAIL_REPLAY = REGISTRY.counter(
     "(the O(tail) mount cost actually paid)",
 )
 
+# cold-tier plane (ISSUE 14, see docs/perf.md "Cold tier"): the
+# hot→warm→cold arc's third band made observable — bytes moved between
+# local disk and the remote backend by direction, per-holder recall
+# walls (the latency a reheating volume pays before it is local again),
+# and the remote read-through cache's hit economics (each miss is one
+# ranged remote GET)
+TIER_OFFLOAD_BYTES = REGISTRY.counter(
+    "seaweedfs_tpu_tier_offload_bytes_total",
+    "EC shard bytes moved between local disk and the remote cold-tier "
+    "backend, by direction (offload = local→remote, recall = "
+    "remote→local)",
+)
+TIER_RECALL_SECONDS = REGISTRY.histogram(
+    "seaweedfs_tpu_tier_recall_seconds",
+    "wall seconds one holder spent recalling a volume's offloaded "
+    "shards back to local disk (download + rename + manifest commit + "
+    "remote delete, per VolumeEcShardsRecall)",
+)
+TIER_REMOTE_CACHE_HITS = REGISTRY.counter(
+    "seaweedfs_tpu_tier_remote_cache_hits_total",
+    "reads of offloaded EC shards served from the byte-range "
+    "read-through cache (no remote round trip)",
+)
+TIER_REMOTE_CACHE_MISSES = REGISTRY.counter(
+    "seaweedfs_tpu_tier_remote_cache_misses_total",
+    "reads of offloaded EC shards that paid a ranged remote GET "
+    "(readahead-widened span fetched and cached)",
+)
+
 # the registry seam the bounded-cardinality lint checks: every family
 # that carries a `tenant` label MUST be listed here, or a retired
 # tenant's series would survive the purge and grow cardinality without

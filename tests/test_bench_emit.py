@@ -468,6 +468,44 @@ def test_lifecycle_convergence_leg_shape():
     assert lc["with_conversions"]["count"] > 0
 
 
+def test_cold_tier_leg_shape():
+    """ISSUE 14 guard: the lifecycle.cold_tier leg must run the whole
+    offload → remote-read → recall arc to completion under the open-loop
+    foreground stream, disclose a non-zero recall p99 and a cache hit
+    rate, read byte-identically at every stage, drain the planner queue,
+    and charge the transfer I/O to plane=lifecycle on the shared budget.
+    Small/short shape here; the acceptance ratio (fg p99 <= 1.5x) comes
+    from the full bench run."""
+    ct = bench.measure_cold_tier(
+        n_cold_volumes=2,
+        cold_files_per_volume=3,
+        cold_file_bytes=32 * 1024,
+        fg_files=200,
+        window_s=1.2,
+    )
+    assert "error" not in ct, ct.get("error")
+    # the arc genuinely completed, byte-identical at every stage
+    assert ct["identity"]["ec"] is True
+    assert ct["identity"]["offloaded"] is True
+    assert ct["identity"]["offloaded_cached"] is True
+    assert ct["identity"]["recalled"] is True
+    assert ct["byte_identical"] is True
+    # recall really happened and its latency is disclosed
+    assert ct["recall_walls_s"], "no recall walls recorded"
+    assert ct["recall_p99_ms"] > 0
+    # the read-through cache served the repeat pass
+    assert ct["cache_misses"] > 0
+    assert ct["cache_hits"] > 0
+    assert 0 < ct["cache_hit_rate"] <= 1
+    # foreground stream ran in both windows; the ratio is disclosed
+    assert ct["baseline"]["count"] > 0
+    assert ct["with_cold_tier"]["count"] > 0
+    assert ct["fg_p99_ratio"] > 0
+    # planner drained; transfer bytes rode plane=lifecycle
+    assert ct["lifecycle_queue_depth_end"] == 0
+    assert ct["maintenance"]["spent_bytes"].get("lifecycle", 0) > 0
+
+
 def test_needle_map_mount_leg_shape():
     """ISSUE 13 guard: the needle_map.mount leg must mount the same log
     both ways, disclose both walls + the speedup, the resident-byte

@@ -1,0 +1,777 @@
+"""Cold tier (ISSUE 14): remote offload of sealed EC shards with
+read-through recall.
+
+- Manifest crash discipline: shadow-write + atomic rename, torn shadows
+  and recall tmps swept at load, empty manifest removed.
+- Kill-point property test (the PR 1 construction): a seeded grid of
+  SimulatedCrash points across every offload/recall step must never
+  leave a shard without at least one valid copy, and a restart must
+  resume to a clean fully-offloaded (then fully-recalled, byte-identical)
+  state.
+- RemoteExtentCache: byte-bounded LRU, readahead spans, hit/miss
+  accounting, random-offset correctness against the raw shard bytes.
+- Blob server: PUT/GET(Range)/HEAD/DELETE through the ServingCore fast
+  tier; the client-side urllib fault seam fires deterministically on
+  op="http:GET" remote targets.
+- Cluster e2e: write → cool → auto-EC → auto-offload (only .ecx/.vif/
+  .heat/.ctm left local) → remote reads byte-identical through the
+  read-through cache → reheat → auto-recall → byte-identical again,
+  remote objects deleted.
+"""
+
+import asyncio
+import os
+import random
+
+import pytest
+
+from seaweedfs_tpu.storage import cold_tier
+from seaweedfs_tpu.storage.cold_tier import (
+    OFFLOAD_STEPS,
+    RECALL_STEPS,
+    RemoteExtentCache,
+    load_manifest,
+    save_manifest,
+    sweep_manifest_shadow,
+    sweep_recall_tmps,
+)
+from seaweedfs_tpu.storage.disk_location import DiskLocation
+from seaweedfs_tpu.storage.erasure_coding import to_ext, write_ec_files
+from seaweedfs_tpu.storage.erasure_coding import write_sorted_file_from_idx
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.tier_backend import (
+    BACKEND_STORAGES,
+    LocalTierBackend,
+    S3Backend,
+    get_backend,
+    register_backend,
+)
+from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.util.faults import (
+    FaultPlan,
+    FaultRule,
+    SimulatedCrash,
+    clear_plan,
+    install_plan,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_registry_and_plan():
+    saved = dict(BACKEND_STORAGES)
+    BACKEND_STORAGES.clear()
+    yield
+    BACKEND_STORAGES.clear()
+    BACKEND_STORAGES.update(saved)
+    clear_plan()
+
+
+def _build_ec_volume(directory: str, vid: int = 5, k: int = 4, m: int = 2):
+    """A small EC volume (k.m geometry keeps the kill grid fast) loaded
+    through DiskLocation; returns (location, ec_volume, base,
+    {shard_id: original_bytes})."""
+    from seaweedfs_tpu.tpu.coder import get_codec
+
+    v = Volume(directory, "", vid)
+    rng = random.Random(vid)
+    for i in range(1, 40):
+        v.write_needle(
+            Needle(cookie=7, id=i, data=rng.randbytes(600 + 13 * i))
+        )
+    v.close()
+    base = os.path.join(directory, str(vid))
+    codec = get_codec("cpu", k, m)
+    write_ec_files(base, codec=codec)
+    write_sorted_file_from_idx(base)
+    os.remove(base + ".dat")
+    os.remove(base + ".idx")
+    loc = DiskLocation(directory)
+    loc.load_all_ec_shards()
+    ev = loc.find_ec_volume(vid)
+    assert ev is not None and len(ev.shards) == k + m
+    orig = {}
+    for sid in ev.shard_ids():
+        with open(base + to_ext(sid), "rb") as f:
+            orig[sid] = f.read()
+    return loc, ev, base, orig
+
+
+# ---------------- manifest discipline ----------------
+
+
+def test_manifest_shadow_write_sweep_and_empty_removal(tmp_path):
+    base = str(tmp_path / "9")
+    ents = {3: {"key": "9.ec03", "size": 100, "backend": "local.default"}}
+    save_manifest(base, ents)
+    assert load_manifest(base) == ents
+    assert not os.path.exists(base + ".ctm.shadow")
+
+    # a torn shadow is swept, never read as authority
+    with open(base + ".ctm.shadow", "w") as f:
+        f.write('{"version": 1, "shards": {"3": {"key": "WRONG"')
+    assert load_manifest(base) == ents
+    assert not os.path.exists(base + ".ctm.shadow")
+    assert sweep_manifest_shadow(base) is False  # already gone
+
+    # garbage manifest -> {} (local files stay the trusted copies)
+    with open(base + ".ctm", "w") as f:
+        f.write("{not json")
+    assert load_manifest(base) == {}
+    save_manifest(base, ents)
+
+    # empty manifest is removed outright
+    save_manifest(base, {})
+    assert not os.path.exists(base + ".ctm")
+
+    # torn recall tmps are swept
+    with open(base + ".ec03.ctmp", "wb") as f:
+        f.write(b"torn")
+    assert sweep_recall_tmps(base) == 1
+    assert not os.path.exists(base + ".ec03.ctmp")
+
+
+# ---------------- kill-point property test ----------------
+
+
+def _assert_no_copy_lost(base: str, tier_dir: str, orig: dict) -> None:
+    """The acceptance invariant: every shard has at least one VALID copy
+    — the local file, or the manifest-named remote object — and that
+    copy is byte-identical to the original shard."""
+    manifest = load_manifest(base)
+    for sid, want in orig.items():
+        local = base + to_ext(sid)
+        if os.path.exists(local):
+            with open(local, "rb") as f:
+                assert f.read() == want, f"shard {sid}: local copy diverged"
+            continue
+        ent = manifest.get(sid)
+        assert ent is not None, (
+            f"shard {sid}: no local file and no manifest entry — the only "
+            "copy is unreachable"
+        )
+        remote = os.path.join(tier_dir, ent["key"])
+        assert os.path.exists(remote), (
+            f"shard {sid}: manifest names {ent['key']} but the remote "
+            "object is missing — data lost"
+        )
+        with open(remote, "rb") as f:
+            assert f.read() == want, f"shard {sid}: remote copy diverged"
+
+
+def test_offload_recall_kill_point_grid_never_loses_a_shard(tmp_path):
+    """SimulatedCrash at EVERY offload step boundary and every recall
+    step boundary of a 4.2 volume: after each crash the no-copy-lost
+    invariant holds, a restarted (freshly loaded) volume resumes the
+    interrupted direction to completion, and a final recall restores
+    every shard byte-identically."""
+    import shutil
+
+    k, m = 4, 2
+    stash = tmp_path / "stash"
+    stash.mkdir()
+    _loc, _ev, _base, orig = _build_ec_volume(str(stash), vid=5, k=k, m=m)
+    n_shards = k + m
+    offload_points = n_shards * len(OFFLOAD_STEPS)
+    recall_points = n_shards * len(RECALL_STEPS)
+
+    def fresh_case(name: str):
+        d = tmp_path / name
+        shutil.copytree(stash, d)
+        tier = str(d / "tier")
+        be = LocalTierBackend("default", tier)
+        BACKEND_STORAGES.clear()
+        register_backend(be)
+        loc = DiskLocation(str(d))
+        loc.load_all_ec_shards()
+        return d, tier, be, loc.find_ec_volume(5)
+
+    def killer_at(n: int):
+        calls = [0]
+
+        def hook(step: str, sid: int) -> None:
+            calls[0] += 1
+            if calls[0] == n:
+                raise SimulatedCrash(f"kill at {step} of shard {sid}")
+
+        return hook
+
+    # --- offload kill grid ---
+    for point in range(1, offload_points + 1):
+        d, tier, be, ev = fresh_case(f"off{point}")
+        with pytest.raises(SimulatedCrash):
+            cold_tier.offload_shards(ev, be, step_hook=killer_at(point))
+        base = str(d / "5")
+        _assert_no_copy_lost(base, tier, orig)
+        # "restart": a fresh load sweeps tmps/shadows and resumes clean
+        loc2 = DiskLocation(str(d))
+        loc2.load_all_ec_shards()
+        ev2 = loc2.find_ec_volume(5)
+        assert ev2 is not None
+        cold_tier.offload_shards(ev2, be)
+        assert len(ev2.remote_shards) == n_shards and not ev2.shards
+        _assert_no_copy_lost(base, tier, orig)
+        cold_tier.recall_shards(ev2, get_backend)
+        for sid, want in orig.items():
+            with open(base + to_ext(sid), "rb") as f:
+                assert f.read() == want, f"shard {sid} diverged after recall"
+        assert load_manifest(base) == {}
+        shutil.rmtree(d, ignore_errors=True)
+
+    # --- recall kill grid (volume fully offloaded first) ---
+    for point in range(1, recall_points + 1):
+        d, tier, be, ev = fresh_case(f"rec{point}")
+        cold_tier.offload_shards(ev, be)
+        base = str(d / "5")
+        with pytest.raises(SimulatedCrash):
+            cold_tier.recall_shards(
+                ev, get_backend, step_hook=killer_at(point)
+            )
+        _assert_no_copy_lost(base, tier, orig)
+        loc2 = DiskLocation(str(d))
+        loc2.load_all_ec_shards()
+        ev2 = loc2.find_ec_volume(5)
+        assert ev2 is not None
+        cold_tier.recall_shards(ev2, get_backend)
+        for sid, want in orig.items():
+            with open(base + to_ext(sid), "rb") as f:
+                assert f.read() == want, f"shard {sid} diverged after recall"
+        assert load_manifest(base) == {}
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def test_offload_resume_after_commit_before_unlink_verifies_remote(tmp_path):
+    """The both-copies state (crash between manifest commit and unlink)
+    resumes by VERIFYING the remote size instead of blindly re-uploading;
+    a corrupted remote copy is re-uploaded from the local one."""
+    _loc, ev, base, orig = _build_ec_volume(str(tmp_path), vid=7)
+    tier = str(tmp_path / "tier")
+    be = LocalTierBackend("default", tier)
+    register_backend(be)
+    # hand-craft the both-copies state for shard 0
+    key, size = be.copy_file(
+        base + to_ext(0), {"volumeId": "7", "ext": ".ec00"}
+    )
+    save_manifest(
+        base, {0: {"key": key, "size": size, "backend": be.name}}
+    )
+    # corrupt the remote copy: resume must NOT trust it
+    with open(os.path.join(tier, key), "wb") as f:
+        f.write(b"short and wrong")
+    loc2 = DiskLocation(str(tmp_path))
+    loc2.load_all_ec_shards()
+    ev2 = loc2.find_ec_volume(7)
+    cold_tier.offload_shards(ev2, be)
+    with open(os.path.join(tier, key), "rb") as f:
+        assert f.read() == orig[0], "resume trusted a corrupt remote copy"
+
+
+# ---------------- planner units: holddown + collection scope ----------------
+
+
+def test_plan_offloads_holddown_and_collection_scope():
+    from seaweedfs_tpu.topology.lifecycle import (
+        LifecycleConfig,
+        plan_offloads,
+        plan_recalls,
+    )
+
+    cfg = LifecycleConfig(
+        cold_backend="s3.cold",
+        offload_read_heat=0.5,
+        recall_read_heat=5.0,
+        offload_holddown_s=60.0,
+    )
+    cold = {
+        1: {"collection": "", "read_heat": 0.0, "local_bits": 3,
+            "offloaded_bits": 0},
+        2: {"collection": "", "read_heat": 0.0, "local_bits": 3,
+            "offloaded_bits": 0},
+    }
+    # no holddown: both plan
+    assert {t.vid for t in plan_offloads(cold, cfg)} == {1, 2}
+    # vid 1 was recalled 10s ago -> exempt until the window passes
+    recalled_at = {1: 100.0}
+    assert {t.vid for t in plan_offloads(cold, cfg, recalled_at, 110.0)} == {2}
+    # window elapsed -> plans again
+    assert {t.vid for t in plan_offloads(cold, cfg, recalled_at, 161.0)} == {
+        1,
+        2,
+    }
+    # zero-config (no backend) plans nothing at all
+    assert plan_offloads(cold, LifecycleConfig()) == []
+
+    # collection scope restricts every planner
+    scoped = LifecycleConfig(
+        cold_backend="s3.cold",
+        offload_read_heat=0.5,
+        recall_read_heat=5.0,
+        collections="cold,archive",
+    )
+    assert scoped.collection_allowed("cold")
+    assert scoped.collection_allowed("archive")
+    assert not scoped.collection_allowed("")
+    assert not scoped.collection_allowed("hot")
+    mixed = {
+        1: {"collection": "cold", "read_heat": 0.0, "local_bits": 3,
+            "offloaded_bits": 0},
+        2: {"collection": "web", "read_heat": 0.0, "local_bits": 3,
+            "offloaded_bits": 0},
+    }
+    assert [t.vid for t in plan_offloads(mixed, scoped)] == [1]
+    hot = {
+        1: {"collection": "cold", "read_heat": 50.0, "local_bits": 0,
+            "offloaded_bits": 3},
+        2: {"collection": "web", "read_heat": 50.0, "local_bits": 0,
+            "offloaded_bits": 3},
+    }
+    assert [t.vid for t in plan_recalls(hot, scoped)] == [1]
+    # hysteresis enforced at construction
+    with pytest.raises(ValueError):
+        LifecycleConfig(offload_read_heat=5.0, recall_read_heat=5.0)
+
+
+def test_plan_recall_offload_no_flap_under_decaying_pulse():
+    """The failure shape the holddown exists for: a read pulse recalls a
+    volume, then (short half-life) its heat collapses below the offload
+    threshold within seconds — without the holddown the next scans would
+    ping-pong the shards through the backend."""
+    from seaweedfs_tpu.topology.lifecycle import (
+        LifecycleConfig,
+        plan_offloads,
+        plan_recalls,
+    )
+
+    cfg = LifecycleConfig(
+        cold_backend="s3.cold",
+        offload_read_heat=0.5,
+        recall_read_heat=5.0,
+        offload_holddown_s=30.0,
+    )
+    recalled_at: dict = {}
+    transfers = 0
+    offloaded = True
+    heat = 10.0  # the pulse just fired
+    for step in range(60):  # 60s of 1s scans, heat halves every second
+        st = {
+            1: {
+                "collection": "",
+                "read_heat": heat,
+                "local_bits": 0 if offloaded else 3,
+                "offloaded_bits": 3 if offloaded else 0,
+            }
+        }
+        if offloaded and plan_recalls(st, cfg):
+            offloaded = False
+            recalled_at[1] = float(step)
+            transfers += 1
+        elif not offloaded and plan_offloads(
+            st, cfg, recalled_at, float(step)
+        ):
+            offloaded = True
+            transfers += 1
+        heat *= 0.5
+    # one recall; the re-offload happens AT MOST once, after the
+    # holddown expired (not within it)
+    assert transfers <= 2
+    assert 1 in recalled_at and recalled_at[1] <= 1.0
+
+
+# ---------------- read-through cache ----------------
+
+
+def test_remote_extent_cache_correctness_and_bounds(tmp_path):
+    _loc, ev, base, orig = _build_ec_volume(str(tmp_path), vid=11)
+    be = LocalTierBackend("default", str(tmp_path / "tier"))
+    register_backend(be)
+    cold_tier.offload_shards(ev, be)
+
+    cache = RemoteExtentCache(capacity_bytes=256 * 1024, span=16 * 1024)
+    rng = random.Random(42)
+    shard_len = len(orig[0])
+    for _ in range(120):
+        sid = rng.choice(sorted(orig))
+        off = rng.randrange(0, shard_len - 1)
+        size = rng.randrange(1, min(8 * 1024, shard_len - off) + 1)
+        got = cold_tier.read_remote_extent(
+            ev, sid, off, size, cache, get_backend
+        )
+        assert got == orig[sid][off : off + size], (sid, off, size)
+    st = cache.stats
+    assert st["hits"] > 0 and st["misses"] > 0
+    assert st["hits"] + st["misses"] == 120
+    # byte bound holds under churn
+    assert sum(len(v) for v in cache._spans.values()) <= cache.capacity
+
+    # a second read inside an already-fetched span is a pure hit
+    h0 = cache.stats["hits"]
+    a = cold_tier.read_remote_extent(ev, 0, 0, 512, cache, get_backend)
+    b = cold_tier.read_remote_extent(ev, 0, 128, 64, cache, get_backend)
+    assert a == orig[0][:512] and b == orig[0][128:192]
+    assert cache.stats["hits"] >= h0 + 1
+
+    # invalidation drops the volume's spans
+    assert cache.invalidate(ev.volume_id) > 0
+    assert len(cache) == 0
+
+
+# ---------------- blob server + fault seams ----------------
+
+
+def test_blob_server_roundtrip_and_fault_seams(tmp_path):
+    """PUT/GET/Range/HEAD/DELETE against the ServingCore-fronted blob
+    server via the S3 backend's urllib path; then the deterministic
+    client-side fault seam: an http_error rule on op="http:GET" with the
+    blob address as target makes the first read attempt fail and the
+    bounded retry succeed, all counted on the plan."""
+    from test_cluster import free_port_pair
+
+    from seaweedfs_tpu.server.blob import BlobServer
+    from seaweedfs_tpu.storage.tier_backend import S3File
+
+    async def body():
+        port = free_port_pair()
+        blob = BlobServer(str(tmp_path / "blobs"), port=port)
+        await blob.start()
+        loop = asyncio.get_event_loop()
+        try:
+            be = S3Backend("cold", f"http://{blob.address}", "tier")
+            payload = bytes(range(256)) * 64  # 16 KiB
+            src = tmp_path / "obj.bin"
+            src.write_bytes(payload)
+            key, size = await loop.run_in_executor(
+                None,
+                lambda: be.copy_file(
+                    str(src), {"volumeId": "3", "ext": ".ec01"}
+                ),
+            )
+            assert size == len(payload)
+            f = be.new_storage_file(key)
+            assert await loop.run_in_executor(None, f.size) == len(payload)
+            got = await loop.run_in_executor(
+                None, lambda: f.read_at(100, 1000)
+            )
+            assert got == payload[1000:1100]
+            # whole-object read + 416 shape
+            whole = await loop.run_in_executor(
+                None, lambda: f.read_at(len(payload), 0)
+            )
+            assert whole == payload
+            past = await loop.run_in_executor(
+                None, lambda: f.read_at(10, len(payload) + 5)
+            )
+            assert past == b""
+
+            # deterministic client-seam fault: first GET 500s, retry wins
+            plan = FaultPlan(
+                seed=3,
+                rules=[
+                    FaultRule(
+                        op="http:GET",
+                        target=blob.address,
+                        fault="http_error",
+                        status=503,
+                        nth=1,
+                    )
+                ],
+            )
+            install_plan(plan)
+            got = await loop.run_in_executor(
+                None, lambda: f.read_at(64, 0)
+            )
+            assert got == payload[:64]
+            assert plan.fired("http:GET") == 1
+            clear_plan()
+
+            # delete is 404-safe
+            await loop.run_in_executor(None, be.delete_file, key)
+            await loop.run_in_executor(None, be.delete_file, key)
+            f2 = S3File(f"http://{blob.address}", "tier", key)
+            with pytest.raises(Exception):
+                await loop.run_in_executor(None, lambda: f2.read_at(4, 0))
+        finally:
+            clear_plan()
+            await blob.stop()
+
+    asyncio.run(body())
+
+
+def test_blob_server_server_side_seam_fires(tmp_path):
+    """The blob server rides ServingCore, so SERVER-side fault rules
+    (latency here — injected before the handler) apply to remote-tier
+    traffic exactly like any cluster server's."""
+    import time as _time
+
+    from test_cluster import free_port_pair
+
+    from seaweedfs_tpu.server.blob import BlobServer
+    from seaweedfs_tpu.storage.tier_backend import S3File
+
+    async def body():
+        port = free_port_pair()
+        blob = BlobServer(str(tmp_path / "blobs"), port=port)
+        await blob.start()
+        loop = asyncio.get_event_loop()
+        try:
+            be = S3Backend("cold", f"http://{blob.address}", "t")
+            src = tmp_path / "o.bin"
+            src.write_bytes(b"z" * 4096)
+            key, _ = await loop.run_in_executor(
+                None, lambda: be.copy_file(str(src), {"volumeId": "1"})
+            )
+            plan = FaultPlan(
+                seed=5,
+                rules=[
+                    FaultRule(
+                        op="http:GET",
+                        target=blob.address,
+                        fault="latency",
+                        delay=0.15,
+                        nth=1,
+                    )
+                ],
+            )
+            install_plan(plan)
+            f = S3File(f"http://{blob.address}", "t", key)
+            t0 = _time.perf_counter()
+            got = await loop.run_in_executor(None, lambda: f.read_at(16, 0))
+            wall = _time.perf_counter() - t0
+            assert got == b"z" * 16
+            # the rule fired exactly once, on ONE of the two seams the
+            # address is visible from (client urllib seam or ServingCore
+            # server seam — nth=1 burns on whichever consults first), and
+            # the injected delay is visible in the wall
+            assert plan.fired("http:GET") == 1
+            assert wall >= 0.14
+        finally:
+            clear_plan()
+            await blob.stop()
+
+    asyncio.run(body())
+
+
+# ---------------- restart discovery ----------------
+
+
+def test_cold_volume_survives_restart_and_serves_reads(tmp_path):
+    """A fully offloaded volume (zero local .ecNN) is rediscovered from
+    its .ctm+.ecx pair at store load and serves interval reads through
+    the remote tier."""
+    from seaweedfs_tpu.storage.store import Store
+
+    _loc, ev, base, orig = _build_ec_volume(str(tmp_path), vid=21)
+    be = LocalTierBackend("default", str(tmp_path / "tier"))
+    register_backend(be)
+    cold_tier.offload_shards(ev, be)
+
+    store = Store("127.0.0.1", 0, "", [str(tmp_path)], [7])
+    store.load()
+    ev2 = store.find_ec_volume(21)
+    assert ev2 is not None, "cold EC volume must be discovered via .ctm"
+    assert not ev2.shards and len(ev2.remote_shards) == 6
+    assert ev2.shard_size() == len(orig[0])
+    # the heartbeat advertises the union bits + the split
+    hb = store.collect_ec_heartbeat()
+    msg = [m for m in hb["ec_shards"] if m["id"] == 21][0]
+    assert msg["ec_local_bits"] == 0
+    assert msg["ec_offloaded_bits"] == msg["ec_index_bits"] != 0
+    got = cold_tier.read_remote_extent(
+        ev2, 2, 5, 700, RemoteExtentCache(), get_backend
+    )
+    assert got == orig[2][5:705]
+    store.close()
+
+
+# ---------------- cluster e2e: the full cold-tier loop ----------------
+
+
+def test_cold_tier_full_loop_e2e(tmp_path, monkeypatch):
+    """write → cool → auto-EC → auto-offload (only index sidecars left
+    local) → remote reads byte-identical through the read-through cache →
+    reheat → auto-recall (shards local again, remote objects gone) →
+    byte-identical."""
+    import aiohttp
+
+    from test_cluster import Cluster, assign_retry, free_port_pair
+    from seaweedfs_tpu.client.operation import read_url, upload_data
+    from seaweedfs_tpu.server.blob import BlobServer
+    from seaweedfs_tpu.topology.lifecycle import LifecycleConfig
+    from seaweedfs_tpu.util.metrics import (
+        TIER_REMOTE_CACHE_HITS,
+        TIER_REMOTE_CACHE_MISSES,
+    )
+
+    monkeypatch.setenv("SEAWEEDFS_TPU_HEAT_HALFLIFE", "0.5")
+
+    async def body():
+        blob = BlobServer(
+            str(tmp_path / "blobs"), port=free_port_pair()
+        )
+        await blob.start()
+        register_backend(
+            S3Backend("cold", f"http://{blob.address}", "tier")
+        )
+        cluster = Cluster(tmp_path)
+        await cluster.start()
+        master = cluster.master
+        master.lifecycle_config = LifecycleConfig(
+            cold_read_heat=2.0,
+            cold_write_heat=2.0,
+            hot_read_heat=100_000.0,  # never inflate in this test
+            full_fraction=0.0,
+            offload_read_heat=0.6,
+            recall_read_heat=6.0,
+            cold_backend="s3.cold",
+        )
+        master.lifecycle_data_shards = 4
+        master.lifecycle_parity_shards = 2
+        master.lifecycle_concurrency = 4
+        try:
+            async with aiohttp.ClientSession() as session:
+                payloads = {}
+                for i in range(8):
+                    ar = await assign_retry(master.address)
+                    data = random.Random(100 + i).randbytes(2000 + 31 * i)
+                    await upload_data(
+                        session, ar.url, ar.fid, data, filename=f"c{i}.bin"
+                    )
+                    payloads[ar.fid] = data
+                vids = sorted({int(f.split(",")[0]) for f in payloads})
+
+                async def read_all_identical(tag):
+                    for fid, data in payloads.items():
+                        vid = int(fid.split(",")[0])
+                        locs = master._do_lookup(str(vid)).get("locations")
+                        assert locs, f"{tag}: no locations for {vid}"
+                        got = None
+                        for loc in locs:
+                            try:
+                                got = await read_url(
+                                    session, f"http://{loc['url']}/{fid}"
+                                )
+                                break
+                            except Exception:
+                                continue
+                        assert got == data, f"{tag}: {fid} bytes diverged"
+
+                await read_all_identical("hot")
+                await asyncio.sleep(3.5)  # cool well below cold AND offload
+
+                def all_ec():
+                    return all(
+                        master.topo.lookup("", v) is None
+                        and master.topo.lookup_ec_shards(v) is not None
+                        for v in vids
+                    )
+
+                for _ in range(60):
+                    if all_ec():
+                        break
+                    r = await master.run_lifecycle_once()
+                    assert "error" not in r, r
+                    await asyncio.sleep(0.3)
+                assert all_ec(), master.lifecycle_log
+
+                # drive rounds until every shard file has left local disk
+                def local_shard_files():
+                    found = []
+                    for vs in cluster.volume_servers:
+                        for loc in vs.store.locations:
+                            for name in os.listdir(loc.directory):
+                                for v in vids:
+                                    if name.startswith(f"{v}.ec") and (
+                                        name[-2:].isdigit()
+                                    ):
+                                        found.append(name)
+                    return found
+
+                for _ in range(80):
+                    if not local_shard_files():
+                        break
+                    r = await master.run_lifecycle_once()
+                    assert "error" not in r, r
+                    await asyncio.sleep(0.25)
+                assert not local_shard_files(), (
+                    local_shard_files(),
+                    master.lifecycle_log,
+                )
+                # manifests exist; blob store holds the shard objects
+                ctms = [
+                    name
+                    for vs in cluster.volume_servers
+                    for loc in vs.store.locations
+                    for name in os.listdir(loc.directory)
+                    if name.endswith(".ctm")
+                ]
+                assert ctms, "offloaded volumes must carry .ctm manifests"
+                blob_files = []
+                for root, _dirs, files in os.walk(str(tmp_path / "blobs")):
+                    blob_files += files
+                assert blob_files, "remote tier holds no shard objects"
+
+                # remote reads: byte-identical through the cold path,
+                # cache counters move
+                h0 = TIER_REMOTE_CACHE_HITS._values.get((), 0.0)
+                m0 = TIER_REMOTE_CACHE_MISSES._values.get((), 0.0)
+                await read_all_identical("offloaded")
+                await read_all_identical("offloaded-again")  # hits now
+                h1 = TIER_REMOTE_CACHE_HITS._values.get((), 0.0)
+                m1 = TIER_REMOTE_CACHE_MISSES._values.get((), 0.0)
+                assert m1 > m0, "remote reads never touched the cold path"
+                assert h1 > h0, "repeat remote reads never hit the cache"
+
+                # reheat ONE volume via reads until recall fires
+                vid_hot = vids[0]
+                hot_fids = [
+                    f for f in payloads if int(f.split(",")[0]) == vid_hot
+                ]
+
+                def recalled():
+                    for vs in cluster.volume_servers:
+                        ev = vs.store.find_ec_volume(vid_hot)
+                        if ev is not None and ev.remote_shards:
+                            return False
+                    return any(
+                        vs.store.find_ec_volume(vid_hot) is not None
+                        and vs.store.find_ec_volume(vid_hot).shards
+                        for vs in cluster.volume_servers
+                    )
+
+                for _ in range(120):
+                    if recalled():
+                        break
+                    for fid in hot_fids:
+                        locs = master._do_lookup(str(vid_hot)).get(
+                            "locations"
+                        )
+                        if locs:
+                            try:
+                                await read_url(
+                                    session,
+                                    f"http://{locs[0]['url']}/{fid}",
+                                )
+                            except Exception:
+                                pass
+                    r = await master.run_lifecycle_once()
+                    assert "error" not in r, r
+                    await asyncio.sleep(0.2)
+                assert recalled(), master.lifecycle_log
+
+                # the recalled volume's manifest is gone and its remote
+                # objects were deleted
+                for vs in cluster.volume_servers:
+                    for loc in vs.store.locations:
+                        assert not os.path.exists(
+                            os.path.join(loc.directory, f"{vid_hot}.ctm")
+                        )
+                remaining = []
+                for root, _dirs, files in os.walk(str(tmp_path / "blobs")):
+                    remaining += [
+                        f for f in files if f.startswith(f"{vid_hot}.ec")
+                    ]
+                assert not remaining, remaining
+                await read_all_identical("recalled")
+        finally:
+            await cluster.stop()
+            await blob.stop()
+
+    asyncio.run(body())

@@ -287,6 +287,44 @@ def test_lsm_sealed_snapshot_zero_copy_and_tombstone_discipline(tmp_path):
     m.close()
 
 
+def test_sealed_run_columns_reach_device_upload_uncopied(tmp_path):
+    """ISSUE 14 satellite (PR 13 follow-up): the IndexSnapshot host-side
+    preparation consumes a sealed map's mmap'd run columns WITHOUT
+    copying the dtype-matching ones — offsets/sizes pass through as
+    views of the on-disk pages, so the device upload is one DMA from
+    page cache instead of transiting a heap `.astype()` copy (only the
+    derived u32 (hi, lo) key planes are allocated)."""
+    pytest.importorskip("jax")
+    from seaweedfs_tpu.ops.index_kernel import IndexSnapshot
+
+    idx = tmp_path / "1.idx"
+    m = _small_map(idx, memtable=10, runs=2)
+    for key in range(1, 41):
+        m.put(key, key * 2, 100)
+    m._flush_memtable()
+    while len(m._runs) > 1:
+        m._merge_smallest_adjacent()
+    m._persist_manifest()
+    keys, offs, sizes = m.snapshot()
+    assert isinstance(offs, np.memmap) or isinstance(
+        getattr(offs, "base", None), np.memmap
+    )
+    k64, _khi, _klo, off_u32, sizes_u32 = IndexSnapshot.prepare_host_columns(
+        keys, offs, sizes
+    )
+    # dtype-matching columns are the SAME memory (no-op views)
+    assert np.shares_memory(k64, keys)
+    if offs.dtype == np.uint32:  # 5-byte-offset builds stay host-side
+        assert np.shares_memory(off_u32, offs)
+    assert np.shares_memory(sizes_u32, sizes)
+    # and a full build over the sealed snapshot still answers correctly
+    snap = IndexSnapshot(keys, offs, sizes)
+    o, s, found = snap.lookup(np.array([3, 999], dtype=np.uint64))
+    assert bool(found[0]) and not bool(found[1])
+    assert int(o[0]) == 6 and int(s[0]) == 100
+    m.close()
+
+
 def test_lsm_put_batch_matches_sequential(tmp_path):
     """put_batch == the same puts applied one by one: identical idx
     bytes, identical state."""

@@ -144,6 +144,16 @@ def test_metrics_hygiene_lint():
         "seaweedfs_tpu_needle_map_tail_replay_entries_total",
     ):
         assert family in names, f"needle_map family {family} not registered"
+    # cold-tier plane (ISSUE 14): pin the offload/recall/read-through
+    # families (bytes by direction, per-holder recall walls, cache
+    # economics) so they can never silently fall out of the exposition
+    for family in (
+        "seaweedfs_tpu_tier_offload_bytes_total",
+        "seaweedfs_tpu_tier_recall_seconds",
+        "seaweedfs_tpu_tier_remote_cache_hits_total",
+        "seaweedfs_tpu_tier_remote_cache_misses_total",
+    ):
+        assert family in names, f"cold-tier family {family} not registered"
 
 
 def test_tenant_label_cardinality_enforced_at_registry_seam():

@@ -11,6 +11,12 @@ turns a brownout into an outage). Three request layers, three checks:
 - **fasthttp / gRPC defaults**: `FastHTTPClient.request` and
   `Stub.call` default to a bounded per-request timeout —
   `timeout=None` is an explicit opt-in reserved for streaming shapes;
+- **urllib (ISSUE 14 satellite)**: the cold-tier remote path
+  (`storage/tier_backend.py`) speaks stdlib urllib from the
+  synchronous volume read path — every `urlopen(...)` call site must
+  pass an explicit `timeout=` (urllib's default is the OS socket
+  default, i.e. effectively unbounded; a hung remote tier would wedge
+  executor threads);
 - **explicit opt-outs**: any call site passing `timeout=None` to
   `.request(` / `.call(` / `ClientSession(` must be on the allowlist
   below with a reason (today: none — `Stub.server_stream` IS the
@@ -72,6 +78,23 @@ def _scan() -> list:
                         "util/http_timeouts.client_timeout())"
                     )
                     continue
+            if name == "urlopen":
+                # the cold-tier remote path (storage/tier_backend.py)
+                # and any future urllib caller: urllib's default socket
+                # timeout is unbounded — every urlopen must carry one
+                if "timeout" not in kw:
+                    violations.append(
+                        f"{rel}:{node.lineno}: urllib.request.urlopen() "
+                        "without timeout= — unbounded remote I/O (pass "
+                        "the remaining _sync_retry deadline)"
+                    )
+                    continue
+                tv = kw["timeout"]
+                if isinstance(tv, ast.Constant) and tv.value is None:
+                    violations.append(
+                        f"{rel}:{node.lineno}: urlopen(timeout=None) is "
+                        "an unbounded wait on the remote tier"
+                    )
             if name in ("ClientSession", "call", "request", "server_stream"):
                 tv = kw.get("timeout")
                 if (
