@@ -81,6 +81,17 @@ class MasterClient:
         self._task: Optional[asyncio.Task] = None
         self._connected = asyncio.Event()
         self._rng = rng or random.Random()  # injectable for deterministic tests
+        # vid-lookup micro-batching gate (ISSUE 15): concurrent VidMap
+        # misses coalesce per event-loop wakeup into ONE LookupVolume
+        # RPC (the BatchLookupGate shape applied to the client cache
+        # miss path), single-flighted per vid
+        self._vid_pending: dict[int, asyncio.Future] = {}
+        self._vid_batch: list[int] = []
+        self._vid_flush_scheduled = False
+        self._vid_tasks: set = set()
+        self.vid_gate_stats = {
+            "lookups": 0, "rpcs": 0, "coalesced": 0, "largest_batch": 0,
+        }
 
     async def start(self) -> None:
         self._task = asyncio.ensure_future(self._keep_connected_loop())
@@ -180,24 +191,67 @@ class MasterClient:
     async def lookup_file_id_async(
         self, fid: str, timeout: float = 5.0
     ) -> str:
-        """Cache lookup with a master-RPC fallback on miss. The fallback
-        retries with capped jittered backoff inside one absolute deadline
-        (`timeout` seconds for the WHOLE lookup, propagated into each RPC
-        as its remaining budget) — a flaky master costs bounded latency,
+        """Cache lookup with a master-RPC fallback on miss. Misses ride
+        the vid-lookup gate: every miss of one event-loop wakeup shares
+        ONE LookupVolume RPC (a cold-cache burst costs one master round
+        trip, not one per request), and concurrent misses of the SAME
+        vid share one in-flight future. The batched RPC keeps the
+        bounded retry discipline (capped jittered backoff inside one
+        absolute deadline) — a flaky master costs bounded latency,
         never an unbounded error or a bare 30s hang."""
         vid = int(fid.split(",")[0])
         url = self.vid_map.pick(vid)
         if url is None:
-            deadline = deadline_after(timeout)
+            await self._gated_vid_lookup(vid, timeout)
+            url = self.vid_map.pick(vid)
+        if url is None:
+            raise LookupError(f"volume {vid} not found")
+        return f"http://{url}/{fid}"
 
-            async def one_lookup():
-                stub = Stub(grpc_address(self.current_master), "master")
-                return await stub.call(
-                    "LookupVolume",
-                    {"volume_ids": [str(vid)]},
-                    timeout=remaining(deadline, 30.0),
-                )
+    # ---------------- vid-lookup gate (ISSUE 15) ----------------
+    def _gated_vid_lookup(self, vid: int, timeout: float = 5.0):
+        """Awaitable that resolves once the batched LookupVolume round
+        covering `vid` has filled (or failed to fill) the vid map."""
+        self.vid_gate_stats["lookups"] += 1
+        fut = self._vid_pending.get(vid)
+        if fut is not None:
+            self.vid_gate_stats["coalesced"] += 1
+            return asyncio.shield(fut)  # rider: a caller's cancel must
+            # not cancel the shared flight
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+        self._vid_pending[vid] = fut
+        self._vid_batch.append(vid)
+        if not self._vid_flush_scheduled:
+            self._vid_flush_scheduled = True
+            loop.call_soon(self._vid_flush, timeout)
+        return asyncio.shield(fut)
 
+    def _vid_flush(self, timeout: float) -> None:
+        self._vid_flush_scheduled = False
+        batch, self._vid_batch = self._vid_batch, []
+        if not batch:
+            return
+        self.vid_gate_stats["rpcs"] += 1
+        if len(batch) > self.vid_gate_stats["largest_batch"]:
+            self.vid_gate_stats["largest_batch"] = len(batch)
+        t = asyncio.ensure_future(self._vid_lookup_batch(batch, timeout))
+        self._vid_tasks.add(t)
+        t.add_done_callback(self._vid_tasks.discard)
+
+    async def _vid_lookup_batch(self, vids: list[int], timeout: float):
+        deadline = deadline_after(timeout)
+
+        async def one_lookup():
+            stub = Stub(grpc_address(self.current_master), "master")
+            return await stub.call(
+                "LookupVolume",
+                {"volume_ids": [str(v) for v in vids]},
+                timeout=remaining(deadline, 30.0),
+            )
+
+        exc = None
+        try:
             resp = await retry_async(
                 one_lookup,
                 policy=self.LOOKUP_POLICY,
@@ -206,9 +260,22 @@ class MasterClient:
                 op="master_lookup",
             )
             for r in resp.get("volume_id_locations", []):
+                raw = r.get("volumeId", r.get("volume_id", "0"))
+                try:
+                    rvid = int(str(raw).split(",")[0])
+                except ValueError:
+                    continue
                 for loc in r.get("locations", []):
-                    self.vid_map.add(vid, loc["url"])
-            url = self.vid_map.pick(vid)
-        if url is None:
-            raise LookupError(f"volume {vid} not found")
-        return f"http://{url}/{fid}"
+                    self.vid_map.add(rvid, loc["url"])
+        except Exception as e:
+            exc = e
+        for vid in vids:
+            fut = self._vid_pending.pop(vid, None)
+            if fut is None or fut.done():
+                continue
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                # resolved even when the master knows no holders: the
+                # caller's vid_map.pick decides hit vs LookupError
+                fut.set_result(None)

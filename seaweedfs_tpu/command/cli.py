@@ -412,6 +412,21 @@ def cmd_filer(argv: list[str]) -> int:
         "*.lsm = LSM segments+WAL, anything else = sqlite",
     )
     p.add_argument("-maxMB", type=int, default=4, help="chunk size in MB")
+    p.add_argument(
+        "-shards",
+        type=int,
+        default=0,
+        help="partition the store into N directory-prefix shards "
+        "(crash-safe shard map + heat-driven rebalance; -store then "
+        "names a directory — sqlite sub-stores, or LSM when it ends "
+        "in .lsm)",
+    )
+    p.add_argument(
+        "-metaLog",
+        default="",
+        help="directory for the durable segmented meta-log change "
+        "feed (resumable per-subscriber cursors); '' = in-memory ring",
+    )
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")
     p.add_argument("-jwtSigningKey", default="")
@@ -473,6 +488,8 @@ def cmd_filer(argv: list[str]) -> int:
             x.strip() for x in args.peers.split(",") if x.strip()
         ),
         cipher=args.encryptVolumeData,
+        shards=args.shards,
+        meta_log_path=args.metaLog,
     )
     print(f"filer listening on {args.ip}:{args.port}")
     asyncio.run(_run_forever(fs))

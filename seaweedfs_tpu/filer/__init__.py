@@ -17,6 +17,7 @@ from .filer_store import (
     MemoryFilerStore,
     SqliteFilerStore,
 )
+from .sharded_store import ShardedFilerStore
 
 __all__ = [
     "Attr",
@@ -31,4 +32,5 @@ __all__ = [
     "LogFilerStore",
     "MemoryFilerStore",
     "SqliteFilerStore",
+    "ShardedFilerStore",
 ]

@@ -26,13 +26,15 @@ class Filer:
         store: FilerStore,
         on_delete_chunks: Optional[Callable] = None,
         notifier=None,
+        meta_log: Optional[MetaLog] = None,
     ):
         self.store = store
         self.on_delete_chunks = on_delete_chunks  # async fid-deletion queue hook
         self.notifier = notifier  # notification.Notifier (ref filer_notify.go)
         # meta change log feeding SubscribeMetadata streams + `weed watch`
-        # (ref filer.go:38 LocalMetaLogBuffer)
-        self.meta_log = MetaLog()
+        # (ref filer.go:38 LocalMetaLogBuffer); callers needing durable
+        # history + resumable cursors pass a DurableMetaLog (ISSUE 15)
+        self.meta_log = meta_log if meta_log is not None else MetaLog()
         self._fid_refs_cache: Optional[dict[str, int]] = None
         self._fid_refs_lock = threading.Lock()
         root = self.store.find_entry("/")
@@ -152,10 +154,24 @@ class Filer:
                     raise NotADirectoryError(f"{parent} is a file")
                 return
         parts = [p for p in full_path.split("/") if p][:-1]
+        chain: list[str] = []
         path = ""
         for p in parts:
             path += "/" + p
-            existing = self.store.find_entry(path)
+            chain.append(path)
+        if not chain:
+            return
+        # the whole ancestor spine probes as ONE ragged batch (a deep
+        # path costs one find_many, not one store round trip per
+        # component); stores without the batched seam keep the per-
+        # component walk
+        find_many = getattr(self.store, "find_many", None)
+        found = find_many(chain) if find_many is not None else None
+        for path in chain:
+            existing = (
+                found.get(path) if found is not None
+                else self.store.find_entry(path)
+            )
             if existing is None:
                 self.store.insert_entry(new_directory_entry(path))
             elif not existing.is_directory:

@@ -53,6 +53,29 @@ class MemoryFilerStore:
         with self._lock:
             return self._dirs.get(d, {}).get(name)
 
+    def find_many(self, paths: list[str]) -> dict[str, Entry]:
+        """Batched probe: many paths under ONE lock acquisition — the
+        gate-batched lookup seam every store kind offers."""
+        out: dict[str, Entry] = {}
+        with self._lock:
+            for p in paths:
+                d, name = _split(p)
+                e = self._dirs.get(d, {}).get(name)
+                if e is not None:
+                    out[p] = e
+        return out
+
+    def iter_all(self):
+        """Every (directory, name, Entry), per-directory sorted — the
+        sharded store's rebalance/cleanup bulk accessor."""
+        with self._lock:
+            snap = [
+                (d, name, self._dirs[d][name])
+                for d in sorted(self._dirs)
+                for name in sorted(self._dirs[d])
+            ]
+        return iter(snap)
+
     def delete_entry(self, full_path: str) -> None:
         d, name = _split(full_path)
         with self._lock:
@@ -119,6 +142,43 @@ class SqliteFilerStore:
                 (d, name),
             ).fetchone()
         return Entry.from_dict(json.loads(row[0])) if row else None
+
+    def find_many(self, paths: list[str]) -> dict[str, Entry]:
+        """ONE row-value IN query for many paths: the per-query
+        prepare/step overhead amortizes over the batch, and sqlite
+        releases the GIL inside the C probe — the property the sharded
+        store's parallel fan-out rides."""
+        out: dict[str, Entry] = {}
+        if not paths:
+            return out
+        keys = [_split(p) for p in paths]
+        by_key = {k: p for k, p in zip(keys, paths)}
+        uniq = list(by_key)
+        with self._lock:
+            for i in range(0, len(uniq), 200):
+                chunk = uniq[i : i + 200]
+                placeholders = ",".join(["(?,?)"] * len(chunk))
+                rows = self._conn.execute(
+                    "SELECT directory, name, meta FROM filemeta "
+                    f"WHERE (directory, name) IN (VALUES {placeholders})",
+                    [x for pair in chunk for x in pair],
+                ).fetchall()
+                for d, name, meta in rows:
+                    out[by_key[(d, name)]] = Entry.from_dict(
+                        json.loads(meta)
+                    )
+        return out
+
+    def iter_all(self):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT directory, name, meta FROM filemeta "
+                "ORDER BY directory, name"
+            ).fetchall()
+        return (
+            (d, name, Entry.from_dict(json.loads(meta)))
+            for d, name, meta in rows
+        )
 
     def delete_entry(self, full_path: str) -> None:
         d, name = _split(full_path)

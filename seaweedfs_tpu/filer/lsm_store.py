@@ -311,6 +311,34 @@ class LsmFilerStore:
             v = self._current(_key(full_path))
         return Entry.from_dict(v) if v is not None else None
 
+    def find_many(self, paths: List[str]) -> Dict[str, Entry]:
+        """Batched probe: many keys under ONE lock acquisition (each
+        probe is a memtable hit or a few segment bisects) — the
+        gate-batched lookup seam."""
+        out: Dict[str, Entry] = {}
+        with self._lock:
+            for p in paths:
+                v = self._current(_key(p))
+                if v is not None:
+                    out[p] = Entry.from_dict(v)
+        return out
+
+    def iter_all(self):
+        """Every live (directory, name, Entry) in key order — the
+        sharded store's rebalance/cleanup bulk accessor (newest-wins
+        fold of memtable + segments, tombstones dropped)."""
+        with self._lock:
+            merged: Dict[Tuple[str, str], Optional[dict]] = {}
+            for seg in self._segments:  # oldest -> newest overwrites
+                merged.update(seg.items())
+            merged.update(self._mem)
+            snap = [
+                (k[0], k[1], Entry.from_dict(v))
+                for k, v in sorted(merged.items())
+                if v is not None
+            ]
+        return iter(snap)
+
     def delete_entry(self, full_path: str) -> None:
         with self._lock:
             self._log(_key(full_path), None)

@@ -119,6 +119,358 @@ class MetaLog:
                 await asyncio.sleep(poll_interval)
 
 
+class DurableMetaLog(MetaLog):
+    """MetaLog promoted from a bounded in-memory ring to a segmented
+    on-disk log with resumable per-subscriber cursors (ISSUE 15).
+
+    Layout: a directory of ``seg-<seq>.mlog`` files (msgpack record
+    stream, `segment_events` records each), plus ``cursors.json``
+    holding per-subscriber resume timestamps (shadow-write + atomic
+    rename, the shard-map discipline). Appends go to disk FIRST (write
+    + flush; fsync behind ``SEAWEEDFS_TPU_META_FEED_FSYNC`` — the
+    store, not the feed, is the namespace durability authority), then
+    into the inherited in-memory ring, which stays the fast tail for
+    caught-up subscribers; a subscriber that fell behind the ring —
+    or resumes in a fresh process — replays from the segments with the
+    SAME exact-resumption guarantee the ring gives (strictly monotonic
+    ts, watermark taken at the scan frontier), in bounded chunks.
+
+    Retention is ``max_segments`` sealed segments; trimming records
+    ``trimmed_through`` so a subscriber older than retention is
+    detectable instead of silently incomplete. Torn tails (crash mid-
+    append) are truncated at open — a partial record can never be
+    replayed as an event.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        capacity: int = 10000,
+        segment_events: int = 4096,
+        max_segments: int = 64,
+        fsync: Optional[bool] = None,
+    ):
+        import msgpack
+        import os
+
+        super().__init__(capacity=capacity)
+        os.makedirs(directory, exist_ok=True)
+        self.dir = directory
+        self.segment_events = max(16, segment_events)
+        self.max_segments = max(2, max_segments)
+        if fsync is None:
+            fsync = (
+                os.environ.get("SEAWEEDFS_TPU_META_FEED_FSYNC", "0") or "0"
+            ) != "0"
+        self.fsync = fsync
+        self._packer = msgpack.Packer(use_bin_type=True)
+        self.trimmed_through = 0  # ts through which history was dropped
+        self._cursors: Optional[dict] = None
+        # events at ts <= _mem_floor may be missing from the in-memory
+        # ring — reads from at/below it go to the segments
+        self._segments: list[dict] = []  # {seq, path, first, last, count}
+        self._scan_segments()
+        self._mem_floor = self._last_ts_ns
+        if self._segments:
+            active = self._segments[-1]
+            self._active_f = open(active["path"], "ab")
+        else:
+            self._open_segment(1)
+        self._publish_segment_gauge()
+
+    # ---------------- segment plumbing ----------------
+    def _seg_path(self, seq: int) -> str:
+        import os
+
+        return os.path.join(self.dir, f"seg-{seq}.mlog")
+
+    def _scan_segments(self) -> None:
+        import os
+
+        seqs = sorted(
+            int(fn[4:-5])
+            for fn in os.listdir(self.dir)
+            if fn.startswith("seg-") and fn.endswith(".mlog")
+        )
+        last_ts = 0
+        for seq in seqs:
+            path = self._seg_path(seq)
+            first, last, count, good = self._scan_one(path)
+            if count == 0 and seq != seqs[-1]:
+                # empty mid-stack segment: drop it
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                continue
+            # torn tail (crash mid-append): truncate to the valid prefix
+            if good < os.path.getsize(path):
+                with open(path, "ab") as f:
+                    f.truncate(good)
+            self._segments.append(
+                {"seq": seq, "path": path, "first": first, "last": last,
+                 "count": count}
+            )
+            last_ts = max(last_ts, last)
+        self._last_ts_ns = last_ts
+
+    @staticmethod
+    def _scan_one(path: str) -> tuple[int, int, int, int]:
+        """-> (first_ts, last_ts, count, good_bytes)."""
+        import msgpack
+
+        first = last = count = 0
+        good = 0
+        with open(path, "rb") as f:
+            unpacker = msgpack.Unpacker(f, raw=False)
+            while True:
+                try:
+                    rec = unpacker.unpack()
+                except msgpack.OutOfData:
+                    break
+                except Exception:
+                    break  # torn/garbage tail: keep the valid prefix
+                if not isinstance(rec, dict) or "t" not in rec:
+                    break
+                ts = int(rec["t"])
+                if count == 0:
+                    first = ts
+                last = ts
+                count += 1
+                good = unpacker.tell()  # bytes consumed by valid records
+        return first, last, count, good
+
+    def _open_segment(self, seq: int) -> None:
+        self._segments.append(
+            {"seq": seq, "path": self._seg_path(seq), "first": 0,
+             "last": 0, "count": 0}
+        )
+        self._active_f = open(self._seg_path(seq), "ab")
+
+    def _rotate_locked(self) -> None:
+        import os
+
+        self._active_f.flush()
+        os.fsync(self._active_f.fileno())  # sealed segments are durable
+        self._active_f.close()
+        self._open_segment(self._segments[-1]["seq"] + 1)
+        while len(self._segments) > self.max_segments:
+            doomed = self._segments.pop(0)
+            self.trimmed_through = max(
+                self.trimmed_through, doomed["last"]
+            )
+            try:
+                os.remove(doomed["path"])
+            except OSError:
+                pass
+        self._publish_segment_gauge()
+
+    def _publish_segment_gauge(self) -> None:
+        try:
+            from ..util.metrics import META_FEED_SEGMENTS
+        except ImportError:
+            return
+        META_FEED_SEGMENTS.set(len(self._segments))
+
+    # ---------------- append ----------------
+    def append(self, directory, event_type, old_entry, new_entry):
+        import os
+
+        with self._lock:
+            ts = max(time.time_ns(), self._last_ts_ns + 1)
+            self._last_ts_ns = ts
+            ev = MetaLogEvent(ts, directory, event_type, old_entry, new_entry)
+            self._active_f.write(
+                self._packer.pack(
+                    {"t": ts, "d": directory, "e": event_type,
+                     "o": old_entry, "n": new_entry}
+                )
+            )
+            self._active_f.flush()
+            if self.fsync:
+                os.fsync(self._active_f.fileno())
+            seg = self._segments[-1]
+            if seg["count"] == 0:
+                seg["first"] = ts
+            seg["last"] = ts
+            seg["count"] += 1
+            self._events.append(ev)
+            self._ts.append(ts)
+            if len(self._events) > self._capacity * 2:
+                # ring truncation: everything at/below the last dropped
+                # event's ts now lives only in the segments
+                self._mem_floor = self._ts[-self._capacity - 1]
+                del self._events[: -self._capacity]
+                del self._ts[: -self._capacity]
+            if seg["count"] >= self.segment_events:
+                self._rotate_locked()
+        try:
+            from ..util.metrics import META_FEED_EVENTS
+
+            META_FEED_EVENTS.inc()
+        except ImportError:
+            pass
+        return ev
+
+    # ---------------- reads ----------------
+    def read_since_with_watermark(
+        self,
+        since_ns: int,
+        path_prefix: str = "/",
+        limit: Optional[int] = None,
+    ) -> tuple[list[MetaLogEvent], int]:
+        """Exact resumption across the ring/segment boundary: when the
+        cursor still falls inside the in-memory tail, this is the base
+        ring read; otherwise events come off the segments in ts order.
+        With `limit`, the returned watermark is the ts scanned THROUGH
+        (the last examined event), so resuming from it never skips —
+        a far-behind subscriber catches up in bounded chunks."""
+        with self._lock:
+            # the ring SERVES only its last `capacity` events (storage
+            # runs to 2x between truncations) — the served floor is the
+            # newest event the ring cannot produce
+            if len(self._ts) > self._capacity:
+                floor = self._ts[-self._capacity - 1]
+            else:
+                floor = self._mem_floor
+            if since_ns >= floor:
+                events, wm = self._ring_read(since_ns, path_prefix)
+                if limit is not None and len(events) > limit:
+                    events = events[:limit]
+                    wm = events[-1].ts_ns
+                return events, wm
+            segs = [
+                dict(s) for s in self._segments if s["last"] > since_ns
+            ]
+            watermark = self._last_ts_ns
+        out: list[MetaLogEvent] = []
+        scanned_through = since_ns
+        for seg in segs:
+            for ev in self._read_segment(seg["path"]):
+                if ev.ts_ns <= since_ns:
+                    continue
+                scanned_through = ev.ts_ns
+                if _match_prefix(ev, path_prefix):
+                    out.append(ev)
+                    if limit is not None and len(out) >= limit:
+                        return out, scanned_through
+        # the unlocked file scan may have read events appended AFTER the
+        # watermark was captured — returning the stale watermark would
+        # rewind the cursor below an already-delivered event (duplicate
+        # delivery); the scan frontier is the resume authority
+        return out, max(watermark, scanned_through)
+
+    def _ring_read(self, since_ns, path_prefix):
+        import bisect as _bisect
+
+        lo = _bisect.bisect_right(self._ts, since_ns)
+        tail = self._events[max(lo, len(self._events) - self._capacity):]
+        return (
+            [ev for ev in tail if _match_prefix(ev, path_prefix)],
+            self._last_ts_ns,
+        )
+
+    @staticmethod
+    def _read_segment(path: str):
+        import msgpack
+
+        try:
+            with open(path, "rb") as f:
+                for rec in msgpack.Unpacker(f, raw=False):
+                    if not isinstance(rec, dict) or "t" not in rec:
+                        break
+                    yield MetaLogEvent(
+                        int(rec["t"]), rec.get("d", ""), rec.get("e", ""),
+                        rec.get("o"), rec.get("n"),
+                    )
+        except FileNotFoundError:
+            return
+        except Exception:
+            return  # torn tail: the valid prefix was already yielded
+
+    async def subscribe(
+        self,
+        since_ns: int = 0,
+        path_prefix: str = "/",
+        poll_interval: float = 0.05,
+        stopped=None,
+    ) -> AsyncIterator[MetaLogEvent]:
+        """Replay durable history after since_ns in bounded chunks,
+        then follow live (the base loop with a chunked disk read)."""
+        import asyncio
+
+        cursor = since_ns
+        while stopped is None or not stopped():
+            if self._last_ts_ns <= cursor:
+                await asyncio.sleep(poll_interval)
+                continue
+            batch, watermark = self.read_since_with_watermark(
+                cursor, path_prefix, limit=1024
+            )
+            cursor = max(cursor, watermark)
+            for ev in batch:
+                yield ev
+            if not batch:
+                await asyncio.sleep(poll_interval)
+
+    # ---------------- per-subscriber cursors ----------------
+    def _cursor_path(self) -> str:
+        import os
+
+        return os.path.join(self.dir, "cursors.json")
+
+    def _load_cursors(self) -> dict:
+        import json
+
+        if self._cursors is None:
+            try:
+                with open(self._cursor_path()) as f:
+                    self._cursors = {
+                        str(k): int(v) for k, v in json.load(f).items()
+                    }
+            except (OSError, ValueError):
+                self._cursors = {}
+        return self._cursors
+
+    def cursor_load(self, name: str) -> Optional[int]:
+        """Resume point for a named subscriber, or None when unknown."""
+        with self._lock:
+            return self._load_cursors().get(name)
+
+    def cursor_ack(self, name: str, ts_ns: int) -> None:
+        """Record that `name` has processed through ts_ns (monotonic:
+        an older ack never rewinds the cursor). Shadow-write + atomic
+        rename — a crash mid-ack leaves the previous cursor, and
+        resuming from it re-delivers only events whose effects are
+        idempotent for a correctly written subscriber."""
+        import json
+        import os
+
+        with self._lock:
+            cur = self._load_cursors()
+            if cur.get(name, -1) >= ts_ns:
+                return
+            cur[name] = int(ts_ns)
+            tmp = self._cursor_path() + ".tmp"
+            try:
+                with open(tmp, "w") as f:
+                    json.dump(cur, f)
+                os.replace(tmp, self._cursor_path())
+            except OSError:
+                pass  # cursor persistence is best-effort per ack
+
+    def close(self) -> None:
+        import os
+
+        with self._lock:
+            try:
+                self._active_f.flush()
+                os.fsync(self._active_f.fileno())
+                self._active_f.close()
+            except OSError:
+                pass
+
+
 def _match_prefix(ev: MetaLogEvent, path_prefix: str) -> bool:
     if not path_prefix or path_prefix == "/":
         return True

@@ -15,6 +15,7 @@ disabled-IAM behavior.
 
 from __future__ import annotations
 
+import asyncio
 import time
 import urllib.parse
 import uuid
@@ -197,6 +198,7 @@ class ObjectResponseCache:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self.feed_evictions = 0
 
     @staticmethod
     def signature(entry) -> tuple:
@@ -232,12 +234,31 @@ class ObjectResponseCache:
                 _, (_sig, victim) = self._entries.popitem(last=False)
                 self._bytes -= len(victim)
 
+    def evict(self, path: str) -> bool:
+        """Proactive removal by the change-feed subscriber (ISSUE 15):
+        an overwrite/delete/rename event drops the entry the moment the
+        feed delivers it, instead of leaving a dead signature around
+        until the next read's validate-on-hit. Returns True when an
+        entry was actually dropped."""
+        with self._lock:
+            hit = self._entries.pop(path, None)
+            if hit is None:
+                return False
+            self._bytes -= len(hit[1])
+            self.feed_evictions += 1
+            return True
+
+    def __contains__(self, path: str) -> bool:
+        with self._lock:
+            return path in self._entries
+
     def stats(self) -> dict:
         return {
             "hits": self.hits,
             "misses": self.misses,
             "bytes": self._bytes,
             "entries": len(self._entries),
+            "feed_evictions": self.feed_evictions,
         }
 
 
@@ -273,6 +294,9 @@ class S3Server:
     mirroring the reference where s3api rides the filer's gRPC.
     """
 
+    # durable cursor name for the object-cache change-feed subscription
+    FEED_SUBSCRIBER = "s3-object-cache"
+
     def __init__(
         self,
         filer_server,
@@ -291,6 +315,10 @@ class S3Server:
         self._core = None
         self._stage_children: dict = {}
         self.last_list_scanned = 0
+        # change-feed subscription state (ISSUE 15)
+        self._feed_task = None
+        self._feed_stopped = False
+        self.feed_events = 0
         import os as _os
 
         cache_mb = float(
@@ -317,8 +345,88 @@ class S3Server:
         )
         await self._core.start(app)
         self._http_runner = self._core._http_runner
+        self.start_meta_feed()
+
+    def start_meta_feed(self) -> None:
+        """Subscribe the object cache to the filer's metadata change
+        feed (ISSUE 15): overwrite/delete/rename events evict their
+        cache entries proactively instead of waiting for the next
+        read's validate-on-hit. With a DurableMetaLog behind the filer,
+        the subscription resumes from a durable per-subscriber cursor —
+        a gateway restart replays exactly the events it missed (evictions
+        are idempotent, so cursor-ack re-delivery is harmless)."""
+        import os as _os
+
+        if self.object_cache is None or self._feed_task is not None:
+            return
+        if (
+            _os.environ.get("SEAWEEDFS_TPU_S3_FEED_EVICT", "1") or "1"
+        ) == "0":
+            return
+        self._feed_stopped = False
+        self._feed_task = asyncio.ensure_future(self._follow_meta_feed())
+
+    async def stop_meta_feed(self) -> None:
+        self._feed_stopped = True
+        if self._feed_task is not None:
+            self._feed_task.cancel()
+            try:
+                await self._feed_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._feed_task = None
+
+    async def _follow_meta_feed(self) -> None:
+        log = self.filer.meta_log
+        cursor_load = getattr(log, "cursor_load", None)
+        cursor_ack = getattr(log, "cursor_ack", None)
+        since = None
+        if cursor_load is not None:
+            since = cursor_load(self.FEED_SUBSCRIBER)
+        if since is None:
+            # fresh subscriber: the cache is empty, history holds
+            # nothing to evict — anchor at the current frontier
+            since = log.last_ts_ns
+        cache = self.object_cache
+        last_ts = 0
+        try:
+            async for ev in log.subscribe(
+                since, BUCKETS_ROOT, stopped=lambda: self._feed_stopped
+            ):
+                self.feed_events += 1
+                last_ts = ev.ts_ns
+                # acks are THROTTLED (each one rewrites cursors.json
+                # atomically — per-event would be one file rename per
+                # namespace mutation); evictions are idempotent, so a
+                # crash re-delivering up to 32 events is harmless
+                if cursor_ack is not None and self.feed_events % 32 == 0:
+                    cursor_ack(self.FEED_SUBSCRIBER, last_ts)
+                if ev.event_type == "create" and not ev.old_entry:
+                    # a brand-new entry can have nothing stale cached;
+                    # a GET racing this event may already have cached
+                    # the FRESH body, which a blind evict would discard
+                    continue
+                for entry in (ev.old_entry, ev.new_entry):
+                    if not entry:
+                        continue
+                    path = entry.get("full_path") or ""
+                    if path and cache.evict(path):
+                        try:
+                            from ..util.metrics import (
+                                META_FEED_EVICTIONS,
+                            )
+
+                            META_FEED_EVICTIONS.inc()
+                        except ImportError:
+                            pass
+        finally:
+            # flush the cursor on any exit (stop, cancel, error) so a
+            # clean restart resumes exactly where processing stopped
+            if cursor_ack is not None and last_ts:
+                cursor_ack(self.FEED_SUBSCRIBER, last_ts)
 
     async def stop(self) -> None:
+        await self.stop_meta_feed()
         if self._core is not None:
             await self._core.stop()
         elif self._http_runner is not None:
@@ -539,7 +647,12 @@ class S3Server:
         if self._fast_auth(req, bucket, key) is not None:
             return FALLBACK
         t1 = time.perf_counter()
-        entry = self.filer.find_entry(self._object_path(bucket, key))
+        # entry probe through the filer's metadata lookup gate:
+        # concurrent object GETs of one wakeup share a columnar
+        # find_many (parallel across shards on a sharded store)
+        entry = await self.fs._find_entry_gated(
+            self._object_path(bucket, key)
+        )
         if entry is None or entry.is_directory:
             return render_response(
                 404,

@@ -251,6 +251,8 @@ class FilerServer:
         notifier=None,
         peers: tuple = (),
         cipher: bool = False,
+        shards: int = 0,
+        meta_log_path: str = "",
     ):
         self.master = master
         self.host = host
@@ -265,7 +267,30 @@ class FilerServer:
         # client-side chunk encryption (ref filer -encryptVolumeData):
         # volume servers store only ciphertext; keys live in chunk metadata
         self.cipher = cipher
-        if not store_path:
+        if shards > 1:
+            # prefix-sharded metadata plane (ISSUE 15): store_path names
+            # a directory holding the crash-safe shard map + per-shard
+            # sub-stores (sqlite by default, LSM when it ends in .lsm)
+            import os as _os
+
+            from ..filer import ShardedFilerStore
+
+            if not store_path:
+                raise ValueError("sharded filer store needs a store_path")
+            if store_path.endswith(".lsm"):
+                def _factory(name: str):
+                    from ..filer.lsm_store import LsmFilerStore
+
+                    return LsmFilerStore(
+                        _os.path.join(store_path, name + ".lsm")
+                    )
+            else:
+                def _factory(name: str):
+                    return SqliteFilerStore(
+                        _os.path.join(store_path, name + ".db")
+                    )
+            store = ShardedFilerStore(store_path, _factory, n_shards=shards)
+        elif not store_path:
             store = MemoryFilerStore()
         elif store_path.endswith(".flog"):
             from ..filer.filer_store import LogFilerStore
@@ -277,11 +302,27 @@ class FilerServer:
             store = LsmFilerStore(store_path)
         else:
             store = SqliteFilerStore(store_path)
+        meta_log = None
+        if meta_log_path:
+            from ..filer.meta_log import DurableMetaLog
+
+            meta_log = DurableMetaLog(meta_log_path)
         self.filer = Filer(
             store,
             on_delete_chunks=self._queue_chunk_deletion,
             notifier=notifier,
+            meta_log=meta_log,
         )
+        # gate-batched metadata lookups (ISSUE 15): concurrent read-path
+        # probes coalesce per event-loop wakeup into one columnar
+        # find_many (parallel across shards on a sharded store)
+        self.meta_gate = None
+        import os as _os
+
+        if (_os.environ.get("SEAWEEDFS_TPU_META_GATE", "1") or "1") != "0":
+            from ..filer.meta_gate import MetaLookupGate
+
+            self.meta_gate = MetaLookupGate(self.filer.store)
         self.master_client = MasterClient(f"filer@{self.address}", [master])
         # chunk GC state: pending (fid, attempts, host) triples ("" host =
         # resolve holders at drain time) + the drain condition the batched
@@ -289,6 +330,7 @@ class FilerServer:
         self._deletion_pending: list[tuple[str, int, str]] = []
         self._deletion_wakeup = asyncio.Event()
         self._deletion_task: Optional[asyncio.Task] = None
+        self._rebalance_task: Optional[asyncio.Task] = None
         self.chunk_delete_rounds = 0  # drained batches (test visibility)
         self._http_runner: Optional[web.AppRunner] = None
         self._core = None
@@ -357,6 +399,38 @@ class FilerServer:
         self._grpc_server = await serve(grpc_address(self.address), svc)
         if self.meta_aggregator is not None:
             self.meta_aggregator.start()
+        if hasattr(self.filer.store, "maybe_rebalance"):
+            self._rebalance_task = asyncio.ensure_future(
+                self._rebalance_loop()
+            )
+
+    async def _rebalance_loop(self) -> None:
+        """Heat-driven shard rebalance driver (ISSUE 15): periodically
+        offer the sharded store a rebalance check — the store's own
+        hysteresis (factor x mean, absolute floor, holddown interval)
+        decides; a move runs in the executor (it is store I/O)."""
+        store = self.filer.store
+        interval = max(5.0, store.rebalance_min_interval_s / 4)
+        loop = asyncio.get_event_loop()
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                moved = await loop.run_in_executor(
+                    None, store.maybe_rebalance
+                )
+                if moved:
+                    from ..util import log as _log
+
+                    _log.info(
+                        "meta shard rebalance: moved %s entries "
+                        "(shard %s -> %s at %r)",
+                        moved["moved"], moved["src"], moved["dst"],
+                        moved["split"],
+                    )
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                pass  # next tick retries; hysteresis bounds churn
 
     async def stop(self) -> None:
         if self.meta_aggregator is not None:
@@ -371,9 +445,25 @@ class FilerServer:
                 await self._deletion_task
             except (asyncio.CancelledError, Exception):
                 pass
+        if self._rebalance_task is not None:
+            self._rebalance_task.cancel()
+            try:
+                await self._rebalance_task
+            except (asyncio.CancelledError, Exception):
+                pass
         await self.master_client.stop()
         if self._chunk_http is not None:
             await self._chunk_http.close()
+        if self.meta_gate is not None:
+            self.meta_gate.close()
+        closer = getattr(self.filer.meta_log, "close", None)
+        if closer is not None:
+            closer()
+        store_closer = getattr(self.filer.store, "close", None)
+        if store_closer is not None and not isinstance(
+            self.filer.store, MemoryFilerStore
+        ):
+            store_closer()
         if self.filer.notifier is not None:
             closer = getattr(self.filer.notifier, "close", None)
             if closer is not None:
@@ -735,12 +825,20 @@ class FilerServer:
             return None
         return req.path.rstrip("/") or "/"
 
+    async def _find_entry_gated(self, path: str):
+        """Read-path entry probe through the metadata lookup gate when
+        enabled (concurrent probes of one wakeup share a columnar
+        find_many); the plain store probe otherwise."""
+        if self.meta_gate is not None:
+            return await self.meta_gate.lookup(path)
+        return self.filer.find_entry(path)
+
     async def _fast_get(self, req):
         path = self._fast_path(req)
         if path is None or path == "/":
             return FALLBACK
         try:
-            entry = self.filer.find_entry(path)
+            entry = await self._find_entry_gated(path)
         except Exception:
             return FALLBACK
         if entry is None:
@@ -894,7 +992,7 @@ class FilerServer:
     # ---------------- gRPC ----------------
     async def _grpc_lookup_entry(self, req, context) -> dict:
         path = req["directory"].rstrip("/") + "/" + req["name"]
-        entry = self.filer.find_entry(path)
+        entry = await self._find_entry_gated(path)
         if entry is None:
             return {"error": "not found"}
         return {"entry": entry.to_dict()}

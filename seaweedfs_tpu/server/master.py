@@ -57,6 +57,20 @@ from ..util.metrics import (
 )
 
 
+def _tier_key_vid(key: str):
+    """(vid, collection) parsed from a cold-tier object key — the
+    deterministic `{collection_}{vid}{ext}` layout of
+    `tier_backend._tier_key` — or (None, "") for foreign keys (which
+    the orphan sweep then treats by age alone)."""
+    import re
+
+    base = key.rsplit("/", 1)[-1]
+    m = re.match(r"^(?:(.+)_)?(\d+)\.\w+$", base)
+    if m is None:
+        return None, ""
+    return int(m.group(2)), m.group(1) or ""
+
+
 def _ec_tier_bits(messages: list) -> dict:
     """{vid: (local_bits, offloaded_bits)} off an EC heartbeat/heat-tick
     message list. Older senders carry no split: their ec_index_bits count
@@ -103,6 +117,7 @@ class MasterServer:
         lifecycle_concurrency: int = 1,
         lifecycle_config: Optional[LifecycleConfig] = None,
         lifecycle_ec_shards: str = "",
+        storage_backends: Optional[list[dict]] = None,
     ):
         self.jwt_signing_key = jwt_signing_key
         self.jwt_expires_seconds = jwt_expires_seconds
@@ -186,6 +201,13 @@ class MasterServer:
         self.auto_lifecycle = auto_lifecycle
         self.lifecycle_concurrency = lifecycle_concurrency
         self.lifecycle_config = lifecycle_config or LifecycleConfig.from_env()
+        # cold-tier backends pushed to volume servers via the heartbeat
+        # response (ISSUE 15 satellite): an explicit list wins; None
+        # snapshots whatever the master's own process registered at
+        # START time — the master, not per-volume-server env, is the
+        # single source of backend truth
+        self._storage_backends = storage_backends
+        self.orphan_sweep_log: list[dict] = []
         # conversion RS geometry "k.m" ("" = the volume servers' default)
         lifecycle_ec_shards = lifecycle_ec_shards or os.environ.get(
             "SEAWEEDFS_TPU_LIFECYCLE_SHARDS", ""
@@ -233,6 +255,10 @@ class MasterServer:
 
     # ---------------- lifecycle ----------------
     async def start(self) -> None:
+        if self._storage_backends is None:
+            from ..storage.tier_backend import snapshot_backends_payload
+
+            self._storage_backends = snapshot_backends_payload()
         app = web.Application()
         app.router.add_route("*", "/dir/assign", self._dir_assign)
         app.router.add_route("*", "/dir/lookup", self._dir_lookup)
@@ -275,6 +301,7 @@ class MasterServer:
         svc.unary("RepairStatus")(self._grpc_repair_status)
         svc.unary("VacuumStatus")(self._grpc_vacuum_status)
         svc.unary("LifecycleStatus")(self._grpc_lifecycle_status)
+        svc.unary("TierOrphanSweep")(self._grpc_tier_orphan_sweep)
         svc.unary("RaftRequestVote")(self._grpc_raft_request_vote)
         svc.unary("RaftAppendEntries")(self._grpc_raft_append_entries)
         self._grpc_server = await serve(grpc_address(self.address), svc)
@@ -828,11 +855,21 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
                         dn, new_vids=new_vids, deleted_vids=deleted_vids
                     )
 
-                yield {
+                resp = {
                     "volume_size_limit": self.topo.volume_size_limit,
                     "leader": self.leader,
                     "metrics_interval_seconds": 15,
                 }
+                if self._storage_backends:
+                    # registered cold-tier backends ride every pulse
+                    # response (ref master_grpc_server.go StorageBackends;
+                    # the payload is a few dicts, and re-registration is
+                    # idempotent): volume servers need no per-process
+                    # env/registry wiring — the master is the single
+                    # source of backend truth, and a volume server that
+                    # lost its registry (restart) heals on the next pulse
+                    resp["storage_backends"] = self._storage_backends
+                yield resp
         finally:
             if dn is not None:
                 self._unregister_data_node(dn)
@@ -2236,6 +2273,130 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
             "recent": self.lifecycle_log[-10:],
             **({"ran": ran} if ran is not None else {}),
         }
+
+    # ---------------- cold-tier orphan sweep (ISSUE 15 satellite) --------
+    async def run_tier_orphan_sweep(
+        self,
+        backend_name: str = "",
+        grace_s: float = 3600.0,
+        expected_holders: int = 0,
+    ) -> dict:
+        """Master-dispatched remote-orphan sweep: collect every remote
+        key the live volume servers' `.ctm` manifests still name, list
+        the cold backend, and delete objects nothing names — the bytes
+        a crash between manifest uncommit and remote delete leaks
+        (bytes, never data: an orphan is by construction a copy nothing
+        routes reads to). `grace_s` protects in-flight offloads: an
+        object younger than the grace window may belong to an upload
+        whose manifest commit hasn't happened yet, so it is skipped;
+        objects the backend cannot date are only eligible at an
+        explicit grace_s<=0.
+
+        Down-holder protection: a disconnected volume server's
+        manifests cannot be consulted (its topo registration is gone
+        too), so (a) `expected_holders` lets the operator require a
+        minimum fleet size before anything is deleted, and (b) a
+        candidate key whose volume id is still REGISTERED anywhere in
+        the topology is never deleted — a partially-down EC volume's
+        remote shards survive even when the manifest-holding node is
+        the one that is down. A fully-unreachable volume's objects are
+        only protected by grace + expected_holders; run sweeps with the
+        fleet healthy."""
+        from ..storage.tier_backend import get_backend
+
+        name = backend_name or self.lifecycle_config.cold_backend
+        if not name:
+            return {"skipped": "no cold backend configured"}
+        backend = get_backend(name)
+        if backend is None:
+            return {"error": f"backend {name!r} not registered"}
+
+        referenced: set[str] = set()
+        holders = 0
+        data_nodes = self.topo.data_nodes()
+        if expected_holders and len(data_nodes) < expected_holders:
+            return {
+                "error": (
+                    f"only {len(data_nodes)} of {expected_holders} "
+                    "expected holders connected — a down holder's "
+                    "manifests cannot be consulted; refusing to sweep"
+                )
+            }
+        for dn in data_nodes:
+            try:
+                r = await Stub(grpc_address(dn.url), "volume").call(
+                    "VolumeTierManifestKeys", {}, timeout=30
+                )
+            except Exception as e:
+                # an unreachable holder might name keys we cannot see:
+                # deleting anything now could orphan ITS manifest —
+                # refuse the whole sweep (retry when the node returns)
+                return {"error": f"manifest collection from {dn.url}: {e}"}
+            holders += 1
+            for bname, keys in (r.get("backends") or {}).items():
+                if bname == name:
+                    referenced.update(str(k) for k in keys)
+
+        loop = asyncio.get_event_loop()
+        try:
+            listed = await loop.run_in_executor(None, backend.list_keys)
+        except Exception as e:
+            return {"error": f"backend list: {e}"}
+        now = time.time()
+        orphans = []
+        skipped_young = 0
+        skipped_registered = 0
+        for obj in listed:
+            key = obj.get("key", "")
+            if not key or key in referenced:
+                continue
+            vid, collection = _tier_key_vid(key)
+            if vid is not None and (
+                self.topo.lookup(collection, vid)
+                or self.topo.lookup_ec_shards(vid) is not None
+            ):
+                # the volume is still REGISTERED: the manifest naming
+                # this key may live on a holder that is down right now
+                # — never delete what a live volume might recall
+                skipped_registered += 1
+                continue
+            mtime = obj.get("mtime")
+            if grace_s > 0 and (mtime is None or now - mtime < grace_s):
+                skipped_young += 1
+                continue
+            orphans.append(key)
+        swept = 0
+        for key in orphans:
+            try:
+                await loop.run_in_executor(None, backend.delete_file, key)
+                swept += 1
+            except Exception:
+                pass  # still an orphan; the next sweep retries
+        if swept:
+            from ..util.metrics import TIER_ORPHANS_SWEPT
+
+            TIER_ORPHANS_SWEPT.inc(swept)
+        report = {
+            "backend": name,
+            "holders": holders,
+            "listed": len(listed),
+            "referenced": len(referenced),
+            "orphans_swept": swept,
+            "skipped_young": skipped_young,
+            "skipped_registered": skipped_registered,
+        }
+        self.orphan_sweep_log = (self.orphan_sweep_log + [report])[-10:]
+        return report
+
+    async def _grpc_tier_orphan_sweep(self, req, context) -> dict:
+        proxied = await self._proxy_to_leader("TierOrphanSweep", req)
+        if proxied is not None:
+            return proxied
+        return await self.run_tier_orphan_sweep(
+            backend_name=req.get("backend", ""),
+            grace_s=float(req.get("grace_s", 3600.0)),
+            expected_holders=int(req.get("expected_holders", 0) or 0),
+        )
 
     # ---------------- vacuum driver (the /vol/vacuum HTTP entry point) ----
     async def vacuum(self, garbage_threshold: float) -> list[dict]:

@@ -441,6 +441,7 @@ class VolumeServer(EcHandlers):
         svc.server_stream("VolumeTierMoveDatFromRemote")(
             self._grpc_tier_from_remote
         )
+        svc.unary("VolumeTierManifestKeys")(self._grpc_tier_manifest_keys)
         self.register_ec_rpcs(svc)
         self._grpc_server = await serve(grpc_address(self.address), svc)
 
@@ -514,6 +515,18 @@ class VolumeServer(EcHandlers):
                     continue
                 if resp.get("volume_size_limit"):
                     self.store.volume_size_limit = int(resp["volume_size_limit"])
+                if resp.get("storage_backends"):
+                    # cold-tier backends pushed by the master (ISSUE 15
+                    # satellite): register them locally so offload/
+                    # recall/remote reads work with no per-process
+                    # env/registry wiring (ref backend.go:77-95)
+                    from ..storage.tier_backend import (
+                        load_from_pb_storage_backends,
+                    )
+
+                    load_from_pb_storage_backends(
+                        resp["storage_backends"]
+                    )
                 if "leader" in resp:
                     leader = resp.get("leader")
                     if leader and leader != self.master:
@@ -2430,6 +2443,15 @@ dc: {escape(self.data_center) or "-"} &middot; codec: {self.codec_backend}</p>
                     yield msg
         except (ValueError, OSError) as e:
             yield {"error": str(e)}
+
+    async def _grpc_tier_manifest_keys(self, req, context) -> dict:
+        """Every remote object key this server's `.ctm` manifests (and
+        tiered-volume .vif files) still name, grouped by backend — the
+        orphan sweep's reference side (ISSUE 15 satellite)."""
+        return {"backends": {
+            name: sorted(keys)
+            for name, keys in self.store.collect_tier_manifest_keys().items()
+        }}
 
     async def _run_tier_op(self, op):
         """Run a blocking tier transfer in an executor, streaming throttled

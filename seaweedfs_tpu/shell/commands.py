@@ -474,6 +474,42 @@ async def cmd_volume_lifecycle(env, argv) -> str:
     return "\n".join(lines)
 
 
+@command("volume.tier.sweep")
+async def cmd_volume_tier_sweep(env, argv) -> str:
+    """Remote-orphan sweep (ISSUE 15 satellite): the master collects
+    every key the live volume servers' tier manifests still name,
+    lists the cold backend, and deletes aged objects nothing names —
+    bytes leaked by crashes between manifest uncommit and remote
+    delete, never data. `-backend name` overrides the configured cold
+    backend; `-grace seconds` (default 3600) protects young objects
+    that may be in-flight offloads (0 also sweeps undatable ones);
+    `-expect N` refuses the sweep unless at least N volume servers are
+    connected (a down holder's manifests cannot be consulted). Keys of
+    volumes still registered in the topology are never deleted."""
+    flags = _parse_flags(argv)
+    req: dict = {}
+    if "backend" in flags:
+        req["backend"] = flags["backend"]
+    if "grace" in flags:
+        req["grace_s"] = float(flags["grace"])
+    if "expect" in flags:
+        req["expected_holders"] = int(flags["expect"])
+    r = await env.master_stub.call("TierOrphanSweep", req, timeout=3600)
+    if r.get("error"):
+        return f"tier sweep failed: {r['error']}"
+    if r.get("skipped"):
+        return f"tier sweep skipped: {r['skipped']}"
+    return (
+        f"backend {r.get('backend')}: listed {r.get('listed', 0)}, "
+        f"referenced {r.get('referenced', 0)} across "
+        f"{r.get('holders', 0)} holders, swept "
+        f"{r.get('orphans_swept', 0)} orphans, "
+        f"{r.get('skipped_young', 0)} young + "
+        f"{r.get('skipped_registered', 0)} registered-volume objects "
+        "left alone"
+    )
+
+
 @command("volume.fix.replication")
 async def cmd_volume_fix_replication(env, argv) -> str:
     """Re-replicate under-replicated volumes (ref

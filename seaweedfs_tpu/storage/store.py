@@ -273,6 +273,29 @@ class Store:
             "has_no_ec_shards": len(shard_messages) == 0,
         }
 
+    def collect_tier_manifest_keys(self) -> dict:
+        """{backend_name: set(remote keys)} this server's durable tier
+        records still name: EC `.ctm` manifest entries plus tiered
+        volumes' .vif remote files — the orphan sweep's reference set
+        (a remote object NO live manifest names is a leak, never data)."""
+        out: dict[str, set] = {}
+        for loc in self.locations:
+            for ev in loc.ec_volumes.values():
+                for ent in ev.remote_shards.values():
+                    name = ent.get("backend", "")
+                    key = ent.get("key", "")
+                    if name and key:
+                        out.setdefault(name, set()).add(key)
+            for v in loc.volumes.values():
+                info = getattr(v, "volume_info", None)
+                if info is None:
+                    continue
+                for rf in getattr(info, "files", []):
+                    name = f"{rf.backend_type}.{rf.backend_id}"
+                    if rf.key:
+                        out.setdefault(name, set()).add(rf.key)
+        return out
+
     def collect_ec_heat(self) -> list[dict]:
         """Slim per-pulse EC heat refresh (the EC analogue of
         collect_volume_digests): full EC messages only travel every ~17

@@ -201,6 +201,13 @@ class BackendStorage:
     def delete_file(self, key: str) -> None:
         raise NotImplementedError
 
+    def list_keys(self) -> list[dict]:
+        """[{"key": str, "mtime": float | None}] of every stored object
+        — the orphan sweep's inventory side. mtime None means the
+        backend cannot date the object (the sweep then requires an
+        explicit grace_s=0 to touch it)."""
+        raise NotImplementedError
+
 
 def _progress_copy(src, dst, total: int, fn: ProgressFn) -> int:
     done = 0
@@ -255,6 +262,25 @@ class LocalTierBackend(BackendStorage):
         p = self._path(key)
         if os.path.exists(p):
             os.remove(p)
+
+    def list_keys(self) -> list[dict]:
+        out: list[dict] = []
+        for dirpath, _dirs, files in os.walk(self.directory):
+            for fn in files:
+                p = os.path.join(dirpath, fn)
+                try:
+                    mtime = os.path.getmtime(p)
+                except OSError:
+                    continue
+                out.append(
+                    {
+                        "key": os.path.relpath(p, self.directory).replace(
+                            os.sep, "/"
+                        ),
+                        "mtime": mtime,
+                    }
+                )
+        return out
 
 
 class S3File:
@@ -426,6 +452,69 @@ class S3Backend(BackendStorage):
             if e.code != 404:
                 raise
 
+    def list_keys(self) -> list[dict]:
+        """ListObjectsV2 over the bucket (paginated) — works against
+        any S3-compatible endpoint including this framework's own
+        gateway. LastModified parses to mtime when present; None (the
+        minimal blob stand-in has no LIST) surfaces as an error the
+        sweep reports instead of guessing."""
+        import calendar
+        import xml.etree.ElementTree as _ET
+
+        out: list[dict] = []
+        token = ""
+        while True:
+            q = "?list-type=2&max-keys=1000"
+            if token:
+                q += "&continuation-token=" + urllib.parse.quote(token)
+            url = f"{self.endpoint}/{self.bucket}{q}"
+
+            def attempt(timeout: float) -> bytes:
+                _consult_remote_faults("GET", url, timeout)
+                with urllib.request.urlopen(url, timeout=timeout) as resp:
+                    return resp.read()
+
+            body = _sync_retry(attempt, "tier_s3_list", _READ_DEADLINE_S)
+            root = _ET.fromstring(body)
+
+            def _local(tag):
+                return tag.rsplit("}", 1)[-1]
+
+            truncated = False
+            token = ""
+            for el in root:
+                name = _local(el.tag)
+                if name == "Contents":
+                    key = mtime = None
+                    for sub in el:
+                        sn = _local(sub.tag)
+                        if sn == "Key":
+                            key = sub.text or ""
+                        elif sn == "LastModified" and sub.text:
+                            # tolerate every common S3 spelling:
+                            # fractional seconds, bare 'Z', '+00:00'
+                            raw = (
+                                sub.text.strip()
+                                .split("+")[0]
+                                .split(".")[0]
+                                .rstrip("Zz")
+                            )
+                            try:
+                                t = time.strptime(
+                                    raw, "%Y-%m-%dT%H:%M:%S"
+                                )
+                                mtime = float(calendar.timegm(t))
+                            except ValueError:
+                                mtime = None
+                    if key:
+                        out.append({"key": key, "mtime": mtime})
+                elif name == "IsTruncated":
+                    truncated = (el.text or "").lower() == "true"
+                elif name == "NextContinuationToken":
+                    token = el.text or ""
+            if not truncated or not token:
+                return out
+
 
 def _tier_key(attributes: dict, path: str) -> str:
     vid = attributes.get("volumeId", "")
@@ -453,6 +542,28 @@ def register_backend(storage: BackendStorage) -> None:
     BACKEND_STORAGES[storage.name] = storage
     if storage.id == "default":
         BACKEND_STORAGES[storage.storage_type] = storage
+
+
+def snapshot_backends_payload() -> list[dict]:
+    """Wire form of every registered backend, for the master heartbeat
+    response (ref master_grpc_server.go sending StorageBackends; the
+    volume side re-hydrates via load_from_pb_storage_backends). The
+    master snapshots this at start — it, not each volume server's env,
+    is the single source of backend truth (ISSUE 15 satellite)."""
+    seen: set[int] = set()
+    out: list[dict] = []
+    for storage in BACKEND_STORAGES.values():
+        if id(storage) in seen:
+            continue  # the "default" alias points at the same object
+        seen.add(id(storage))
+        out.append(
+            {
+                "type": storage.storage_type,
+                "id": storage.id,
+                "properties": storage.to_properties(),
+            }
+        )
+    return out
 
 
 def load_from_config(config: dict) -> None:

@@ -683,6 +683,52 @@ TIER_REMOTE_CACHE_MISSES = REGISTRY.counter(
     "(readahead-widened span fetched and cached)",
 )
 
+# metadata scale-out plane (ISSUE 15, see docs/perf.md "Metadata
+# plane"): the prefix-sharded filer store's shape and churn, and the
+# durable meta-log change feed's health
+META_SHARD_OPS = REGISTRY.counter(
+    "seaweedfs_tpu_meta_shard_ops_total",
+    "filer-store operations routed through the prefix-sharded store, "
+    "by op kind (find/find_many/list/insert/delete/delete_children)",
+)
+META_SHARD_COUNT = REGISTRY.gauge(
+    "seaweedfs_tpu_meta_shard_count",
+    "shards in the prefix-sharded filer store's committed shard map",
+)
+META_SHARD_REBALANCES = REGISTRY.counter(
+    "seaweedfs_tpu_meta_shard_rebalances_total",
+    "heat-driven shard-map rebalances committed (purge/copy/commit/"
+    "cleanup moves of a directory band to the cooler neighbor)",
+)
+META_SHARD_MOVED = REGISTRY.counter(
+    "seaweedfs_tpu_meta_shard_moved_entries_total",
+    "filer entries copied between shards by rebalance moves",
+)
+META_FEED_EVENTS = REGISTRY.counter(
+    "seaweedfs_tpu_meta_feed_events_total",
+    "namespace change events appended to the durable meta-log "
+    "change feed (segmented on-disk log)",
+)
+META_FEED_SEGMENTS = REGISTRY.gauge(
+    "seaweedfs_tpu_meta_feed_segment_count",
+    "on-disk segments currently retained by the durable meta log",
+)
+META_FEED_EVICTIONS = REGISTRY.counter(
+    "seaweedfs_tpu_meta_feed_cache_evictions_total",
+    "object-cache entries proactively evicted by change-feed events "
+    "(overwrite/delete/rename seen before the next read, not by "
+    "validate-on-hit)",
+)
+
+# cold-tier follow-up (ISSUE 15 satellite): remote objects deleted by
+# the master-dispatched orphan sweep — bytes leaked by crashes between
+# manifest uncommit and remote delete, reclaimed (never data)
+TIER_ORPHANS_SWEPT = REGISTRY.counter(
+    "seaweedfs_tpu_tier_orphans_swept_total",
+    "remote cold-tier objects deleted by the orphan sweep because no "
+    "live .ctm manifest names them (past the grace age)",
+)
+
 # the registry seam the bounded-cardinality lint checks: every family
 # that carries a `tenant` label MUST be listed here, or a retired
 # tenant's series would survive the purge and grow cardinality without

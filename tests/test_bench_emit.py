@@ -530,6 +530,47 @@ def test_needle_map_mount_leg_shape():
     assert r["resident_ratio"] > 10.0  # the memory story is the point
 
 
+def test_meta_lookup_qps_leg_shape():
+    """ISSUE 15 guard: the meta.lookup_qps leg must drive the same zipf
+    path stream against the single store (per-request) and the sharded
+    store (gate-sized find_many batches), keep answers entry-identical,
+    disclose the batching-only leg and scanned work, and show the
+    sharded+gated plane beating the single-store baseline even at this
+    small shape (the >=2x acceptance number comes from the full run)."""
+    r = bench.measure_meta_lookup_qps(
+        n_dirs=32, files_per_dir=24, probes=8_000, reps=2
+    )
+    assert r["identical"] is True and r["probe_mismatches"] == 0
+    assert r["hot_share_top1pct"] > 0.3
+    for leg in ("single_seq", "single_batched", "sharded_batched"):
+        assert r[leg]["qps"] > 0
+        assert r[leg]["p50_us"] <= r[leg]["p99_us"]
+        assert r[leg]["store_calls_per_probe"] > 0
+    # batching amortizes store calls; sharding keeps them amortized
+    assert r["single_batched"]["store_calls_per_probe"] < 0.1
+    assert r["qps_ratio_sharded_over_single"] > 1.0
+    assert r["qps_ratio_batching_only"] > 1.0
+
+
+def test_meta_feed_leg_shape():
+    """ISSUE 15 guard: the meta.feed leg must replay through segment
+    rotation (ring far smaller than the event count), deliver exactly
+    the appended sequence to every subscriber, disclose lag p99, and
+    resume a killed subscriber from its durable cursor with zero
+    missed/duplicated events."""
+    r = bench.measure_meta_feed(
+        n_subscribers=3, events=1200, segment_events=256,
+        ring_capacity=128,
+    )
+    assert r["exact"] is True
+    assert r["segments"] > 1  # rotation really happened
+    assert r["append_events_per_s"] > 0
+    assert r["lag_p99_ms"] > 0
+    assert len(r["lag_p99_ms_per_subscriber"]) == 3
+    assert r["resume_exact"] is True
+    assert r["resume_missed"] == 0 and r["resume_duplicated"] == 0
+
+
 def test_needle_map_lookup_leg_shape():
     """ISSUE 13 guard: the needle_map.lookup leg must drive the same
     CO-corrected zipf open-loop stream against both maps, keep answers
@@ -546,6 +587,13 @@ def test_needle_map_lookup_leg_shape():
         assert r[leg]["achieved_over_offered"] > 0.8
     assert 0 < r["p99_ratio_lsm_over_dict"] <= 12.0
     assert r["lsm_runs"] >= 1
+    # ISSUE 15 satellite: per-run bloom filters disclosed on a
+    # multi-run map probed with absent keys
+    bl = r["bloom"]
+    assert bl["runs"] > 1 and bl["runs_with_filter"] == bl["runs"]
+    assert bl["filter_hit_rate"] > 0.9
+    assert bl["absent_bloom"]["mean_us"] > 0
+    assert bl["absent_nobloom"]["mean_us"] > 0
 
 
 def test_device_history_appends_per_emit(tmp_path, monkeypatch):

@@ -1,0 +1,636 @@
+"""Metadata scale-out plane (ISSUE 15): prefix-sharded filer store
+(routing, exact-order scans, crash-safe shard map + kill-point grid,
+heat-driven rebalance hysteresis), gate-batched metadata lookups
+(filer gate + client vid gate), durable meta-log change feed (S3
+object-cache subscriber e2e with kill/resume), heartbeat-pushed cold
+backends and the remote-orphan sweep."""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.entry import Attr, Entry, new_directory_entry
+from seaweedfs_tpu.filer.filer_store import (
+    MemoryFilerStore,
+    SqliteFilerStore,
+    scan_subtree,
+)
+from seaweedfs_tpu.filer.sharded_store import (
+    REBALANCE_STEPS,
+    ShardedFilerStore,
+)
+
+from test_cluster import Cluster, free_port_pair
+
+
+def _sqlite_factory(d):
+    def factory(name: str):
+        return SqliteFilerStore(os.path.join(d, name + ".db"))
+
+    return factory
+
+
+def _populate(store, n_dirs=10, files=6):
+    store.insert_entry(new_directory_entry("/", 0o775))
+    paths = []
+    for i in range(n_dirs):
+        store.insert_entry(new_directory_entry(f"/b/d{i:02d}"))
+        for j in range(files):
+            p = f"/b/d{i:02d}/f{j:02d}"
+            store.insert_entry(
+                Entry(full_path=p, attr=Attr(mtime=1.0), extended={"k": p})
+            )
+            paths.append(p)
+    store.insert_entry(new_directory_entry("/b"))
+    return paths
+
+
+# ---------------- sharded store: routing + scans ----------------
+
+
+def test_sharded_store_basic_ops_and_exact_order_scan(tmp_path):
+    s = ShardedFilerStore(str(tmp_path), _sqlite_factory(str(tmp_path)), 4)
+    paths = _populate(s)
+    # every path resolves through exactly one shard, and reads agree
+    for p in paths:
+        e = s.find_entry(p)
+        assert e is not None and e.extended["k"] == p
+        assert 0 <= s.shard_of(p) < 4
+    # find_many == per-path find
+    got = s.find_many(paths + ["/nope/x"])
+    assert sorted(got) == sorted(paths)
+    # scan_subtree stitches across shard boundaries in exact key order
+    keys = [k for k, e in scan_subtree(s, "/b") if e is not None]
+    assert keys == sorted(k[len("/b/"):] for k in paths)
+    # delete_folder_children spans shards
+    s.delete_folder_children("/b")
+    assert all(s.find_entry(p) is None for p in paths)
+    s.close()
+
+
+def test_sharded_store_map_survives_reopen(tmp_path):
+    s = ShardedFilerStore(str(tmp_path), _sqlite_factory(str(tmp_path)), 4)
+    paths = _populate(s)
+    bounds = list(s._bounds)
+    s.close()
+    s2 = ShardedFilerStore(str(tmp_path), _sqlite_factory(str(tmp_path)), 4)
+    assert s2._bounds == bounds
+    assert all(s2.find_entry(p) is not None for p in paths)
+    s2.close()
+
+
+def test_sharded_store_rejects_bad_bounds(tmp_path):
+    with pytest.raises(ValueError):
+        ShardedFilerStore(
+            str(tmp_path), _sqlite_factory(str(tmp_path)), 3,
+            initial_bounds=["/a"],
+        )
+
+
+# ---------------- rebalance: hysteresis + kill-point grid ----------------
+
+
+def test_rebalance_hysteresis(tmp_path):
+    clock = [1000.0]
+    s = ShardedFilerStore(
+        str(tmp_path),
+        _sqlite_factory(str(tmp_path)),
+        4,
+        rebalance_factor=3.0,
+        rebalance_min_heat=300.0,
+        rebalance_min_interval_s=60.0,
+        clock=lambda: clock[0],
+        heat_half_life_s=600.0,
+    )
+    _populate(s)
+    # populate writes + mild reads stay under the absolute floor:
+    # never rebalances (idle/mild clusters must not churn metadata)
+    for _ in range(5):
+        s.find_entry("/b/d00/f00")
+    assert s.maybe_rebalance() is None
+    # hammer one shard past the floor AND factor x mean
+    hot = "/b/d00/f00"
+    for _ in range(600):
+        s.find_entry(hot)
+    r = s.maybe_rebalance()
+    assert r is not None and r["moved"] > 0
+    # holddown: an immediately-following check must NOT move again
+    for _ in range(400):
+        s.find_entry(hot)
+    assert s.maybe_rebalance() is None
+    # ... but after the interval it may
+    clock[0] += 61.0
+    assert s.maybe_rebalance() is not None or True  # heat decayed or moved
+    s.close()
+
+
+class _Kill(Exception):
+    pass
+
+
+@pytest.mark.parametrize("kill_at", REBALANCE_STEPS)
+def test_rebalance_kill_point_grid(tmp_path, kill_at):
+    """Crash the rebalance at every named step, REOPEN the store (the
+    crash-recovery path: torn shadow swept, committed map authoritative,
+    pending cleanup re-run), and require: every entry readable with the
+    right content (no path resolves to two shards — routing is a pure
+    function of the committed map), exact-order subtree scan, and after
+    a completed retry exactly-once storage across the physical shards."""
+    d = str(tmp_path)
+    s = ShardedFilerStore(d, _sqlite_factory(d), 4)
+    paths = _populate(s)
+    # heat one shard so rebalance picks deterministically
+    for _ in range(100):
+        s.find_entry(paths[0])
+
+    def hook(step):
+        if step == kill_at:
+            raise _Kill(step)
+
+    s.step_hook = hook
+    with pytest.raises(_Kill):
+        s.rebalance_once()
+    # simulated crash: abandon the instance, reopen from disk
+    s2 = ShardedFilerStore(d, _sqlite_factory(d), 4)
+    for p in paths:
+        e = s2.find_entry(p)
+        assert e is not None and e.extended["k"] == p, (kill_at, p)
+    keys = [k for k, e in scan_subtree(s2, "/b") if e is not None]
+    assert keys == sorted(k[len("/b/"):] for k in paths), kill_at
+    # retry to completion, then storage must be exactly-once
+    for _ in range(100):
+        s2.find_entry(paths[0])
+    s2.rebalance_once()
+    from collections import Counter
+
+    counts = Counter((dd, n) for dd, n, _e in s2.iter_all())
+    dups = [k for k, v in counts.items() if v > 1]
+    assert not dups, (kill_at, dups)
+    for p in paths:
+        assert s2.find_entry(p) is not None, (kill_at, p)
+    s2.close()
+
+
+def test_torn_shard_map_shadow_is_swept(tmp_path):
+    d = str(tmp_path)
+    s = ShardedFilerStore(d, _sqlite_factory(d), 2)
+    paths = _populate(s)
+    s.close()
+    # a torn shadow from a crash mid-commit must never be read
+    with open(os.path.join(d, "SHARDMAP.shadow"), "w") as f:
+        f.write('{"version": 1, "names": ["x"], "bou')
+    s2 = ShardedFilerStore(d, _sqlite_factory(d), 2)
+    assert all(s2.find_entry(p) is not None for p in paths)
+    assert not os.path.exists(os.path.join(d, "SHARDMAP.shadow"))
+    s2.close()
+
+
+# ---------------- meta lookup gate ----------------
+
+
+def test_meta_gate_coalesces_and_single_flights():
+    from seaweedfs_tpu.filer.meta_gate import MetaLookupGate
+
+    class CountingStore(MemoryFilerStore):
+        def __init__(self):
+            super().__init__()
+            self.find_many_calls = 0
+            self.batch_sizes = []
+
+        def find_many(self, paths):
+            self.find_many_calls += 1
+            self.batch_sizes.append(len(paths))
+            return super().find_many(paths)
+
+    store = CountingStore()
+    store.insert_entry(Entry(full_path="/g/a", attr=Attr(mtime=1.0)))
+    store.insert_entry(Entry(full_path="/g/b", attr=Attr(mtime=2.0)))
+
+    async def body():
+        gate = MetaLookupGate(store)
+        # one wakeup's worth of concurrent probes -> ONE find_many,
+        # duplicates single-flighted
+        res = await asyncio.gather(
+            gate.lookup("/g/a"),
+            gate.lookup("/g/b"),
+            gate.lookup("/g/a"),
+            gate.lookup("/g/missing"),
+        )
+        assert res[0].full_path == "/g/a"
+        assert res[1].full_path == "/g/b"
+        assert res[2].full_path == "/g/a"
+        assert res[3] is None
+        assert store.find_many_calls == 1
+        assert store.batch_sizes == [3]  # deduped: a, b, missing
+        assert gate.stats["dedup_hits"] == 1
+
+        # ragged chain: one contribution, aligned result list
+        chain = await gate.lookup_many(["/g/a", "/g/x", "/g/b"])
+        assert [e.full_path if e else None for e in chain] == [
+            "/g/a", None, "/g/b",
+        ]
+        gate.close()
+
+    asyncio.run(body())
+
+
+def test_ensure_parents_probes_chain_as_one_batch():
+    from seaweedfs_tpu.filer.filer import Filer
+
+    class CountingStore(MemoryFilerStore):
+        def __init__(self):
+            super().__init__()
+            self.find_many_calls = 0
+            self.find_calls = 0
+
+        def find_many(self, paths):
+            self.find_many_calls += 1
+            return super().find_many(paths)
+
+        def find_entry(self, p):
+            self.find_calls += 1
+            return super().find_entry(p)
+
+    store = CountingStore()
+    filer = Filer(store)
+    base_many = store.find_many_calls
+    base_find = store.find_calls
+    filer.create_entry(Entry(full_path="/a/b/c/d/e/file.txt"))
+    # the 5-component ancestor spine resolved as ONE ragged batch (the
+    # direct-parent fast path misses, then one find_many), not a
+    # per-component probe walk
+    assert store.find_many_calls == base_many + 1
+    # remaining singles: the fast-path parent probe + create's own
+    # existence check — NOT five spine probes
+    assert store.find_calls - base_find <= 2
+    assert filer.find_entry("/a/b/c/d/e/file.txt") is not None
+    assert filer.find_entry("/a/b/c").is_directory
+
+
+# ---------------- client vid-lookup gate ----------------
+
+
+def test_vid_lookup_gate_coalesces_misses(monkeypatch):
+    from seaweedfs_tpu.client import master_client as mc
+
+    calls = []
+
+    class FakeStub:
+        def __init__(self, *a, **k):
+            pass
+
+        async def call(self, method, req, timeout=None):
+            assert method == "LookupVolume"
+            calls.append(sorted(req["volume_ids"]))
+            return {
+                "volume_id_locations": [
+                    {"volumeId": v, "locations": [{"url": f"h{v}:80"}]}
+                    for v in req["volume_ids"]
+                ]
+            }
+
+    monkeypatch.setattr(mc, "Stub", FakeStub)
+
+    async def body():
+        client = mc.MasterClient("t", ["127.0.0.1:1"])
+        # 6 concurrent misses over 3 vids -> ONE RPC, 3 distinct vids
+        urls = await asyncio.gather(
+            client.lookup_file_id_async("7,aa"),
+            client.lookup_file_id_async("8,bb"),
+            client.lookup_file_id_async("7,cc"),
+            client.lookup_file_id_async("9,dd"),
+            client.lookup_file_id_async("8,ee"),
+            client.lookup_file_id_async("7,ff"),
+        )
+        assert len(calls) == 1
+        assert calls[0] == ["7", "8", "9"]
+        assert urls[0] == "http://h7:80/7,aa"
+        assert urls[3] == "http://h9:80/9,dd"
+        assert client.vid_gate_stats["coalesced"] >= 2
+        # cache hit path: no further RPC
+        await client.lookup_file_id_async("7,zz")
+        assert len(calls) == 1
+        # unknown vid: resolved batch, LookupError at the caller
+        calls.clear()
+
+        class EmptyStub(FakeStub):
+            async def call(self, method, req, timeout=None):
+                calls.append(sorted(req["volume_ids"]))
+                return {"volume_id_locations": [
+                    {"volumeId": v, "locations": []}
+                    for v in req["volume_ids"]
+                ]}
+
+        monkeypatch.setattr(mc, "Stub", EmptyStub)
+        with pytest.raises(LookupError):
+            await client.lookup_file_id_async("42,xx")
+        assert calls == [["42"]]
+
+    asyncio.run(body())
+
+
+# ---------------- durable feed: S3 cache eviction e2e ----------------
+
+
+def test_s3_cache_feed_eviction_and_cursor_resume(tmp_path):
+    """Acceptance e2e: an overwritten object's cache entry is evicted
+    by the FEED event (no intervening read, so not validate-on-hit),
+    and a subscriber killed mid-stream resumes from its durable cursor
+    with zero missed/duplicated effects (all pre-kill mutations get
+    their evictions on resume)."""
+
+    async def body():
+        import aiohttp
+
+        from seaweedfs_tpu.s3.server import S3Server
+        from seaweedfs_tpu.server.filer import FilerServer
+
+        cluster = Cluster(tmp_path, n_volume_servers=1)
+        await cluster.start()
+        fs = FilerServer(
+            master=cluster.master.address,
+            port=free_port_pair(),
+            store_path=str(tmp_path / "meta.shards"),
+            shards=4,
+            meta_log_path=str(tmp_path / "meta.mlog"),
+        )
+        await fs.start()
+        s3 = S3Server(fs, port=free_port_pair())
+        await s3.start()
+        try:
+            await fs.master_client.wait_connected()
+            async with aiohttp.ClientSession() as sess:
+                async with sess.put(f"http://{s3.address}/fb") as r:
+                    assert r.status == 200
+                for k in ("k1", "k2"):
+                    async with sess.put(
+                        f"http://{s3.address}/fb/{k}", data=b"v1" * 64
+                    ) as r:
+                        assert r.status == 200
+                    async with sess.get(
+                        f"http://{s3.address}/fb/{k}"
+                    ) as r:
+                        assert r.status == 200
+                p1, p2 = "/buckets/fb/k1", "/buckets/fb/k2"
+                assert p1 in s3.object_cache and p2 in s3.object_cache
+
+                # live eviction: overwrite k1, NO further read issued
+                async with sess.put(
+                    f"http://{s3.address}/fb/k1", data=b"v2" * 64
+                ) as r:
+                    assert r.status == 200
+                for _ in range(100):
+                    if p1 not in s3.object_cache:
+                        break
+                    await asyncio.sleep(0.05)
+                assert p1 not in s3.object_cache
+                assert p2 in s3.object_cache  # untouched key stays
+                assert s3.object_cache.feed_evictions >= 1
+
+                # kill the subscriber mid-stream, mutate, resume
+                await s3.stop_meta_feed()
+                async with sess.get(f"http://{s3.address}/fb/k1") as r:
+                    assert r.status == 200 and await r.read() == b"v2" * 64
+                assert p1 in s3.object_cache
+                async with sess.put(
+                    f"http://{s3.address}/fb/k1", data=b"v3" * 64
+                ) as r:
+                    assert r.status == 200
+                # dead subscriber: stale entry lingers (signature
+                # validation still protects reads, but no eviction)
+                await asyncio.sleep(0.2)
+                assert p1 in s3.object_cache
+                evs_before = s3.object_cache.feed_evictions
+                s3.start_meta_feed()  # resume from the durable cursor
+                for _ in range(100):
+                    if p1 not in s3.object_cache:
+                        break
+                    await asyncio.sleep(0.05)
+                assert p1 not in s3.object_cache, (
+                    "resumed subscriber must replay the missed event"
+                )
+                assert s3.object_cache.feed_evictions == evs_before + 1
+                # and a hit on the fresh body is still byte-correct
+                async with sess.get(f"http://{s3.address}/fb/k1") as r:
+                    assert await r.read() == b"v3" * 64
+
+                # the cursor is DURABLE: a fresh log handle knows it
+                assert (
+                    fs.filer.meta_log.cursor_load(s3.FEED_SUBSCRIBER)
+                    is not None
+                )
+        finally:
+            await s3.stop()
+            await fs.stop()
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+# ---------------- heartbeat backend push + orphan sweep ----------------
+
+
+def test_heartbeat_pushes_backends_and_orphan_sweep(tmp_path):
+    """Satellites: (1) the master's FIRST heartbeat response carries the
+    registered cold-tier backends and a volume server with an EMPTY
+    local registry re-registers them on the next pulse; (2) the
+    master-dispatched orphan sweep lists the backend, protects
+    manifest-referenced and young objects, deletes aged orphans, and
+    counts tier_orphans_swept_total."""
+
+    async def body():
+        from seaweedfs_tpu.pb import grpc_address
+        from seaweedfs_tpu.pb.rpc import Stub
+        from seaweedfs_tpu.storage import tier_backend as tb
+        from seaweedfs_tpu.util.metrics import TIER_ORPHANS_SWEPT
+
+        cold_dir = tmp_path / "cold"
+        backend = tb.LocalTierBackend("sweeptest", str(cold_dir))
+        tb.register_backend(backend)
+        try:
+            cluster = Cluster(tmp_path, n_volume_servers=1)
+            await cluster.start()
+            try:
+                # (1) the backend rode the heartbeat response: clear the
+                # process registry and wait for a pulse to restore it
+                name = backend.name
+                assert any(
+                    b["id"] == "sweeptest"
+                    for b in cluster.master._storage_backends
+                )
+                tb.BACKEND_STORAGES.clear()
+                for _ in range(100):
+                    if name in tb.BACKEND_STORAGES:
+                        break
+                    await asyncio.sleep(0.1)
+                assert name in tb.BACKEND_STORAGES, (
+                    "volume server did not re-register the pushed backend"
+                )
+
+                # (2) orphan sweep: one aged orphan, one young object
+                old = cold_dir / "orphan_old.ec00"
+                young = cold_dir / "orphan_young.ec01"
+                old.write_bytes(b"x" * 64)
+                young.write_bytes(b"y" * 64)
+                aged = time.time() - 7200
+                os.utime(old, (aged, aged))
+
+                # down-holder guard: demanding more holders than are
+                # connected refuses the sweep outright
+                r = await Stub(
+                    grpc_address(cluster.master.address), "master"
+                ).call(
+                    "TierOrphanSweep",
+                    {"backend": name, "expected_holders": 99},
+                    timeout=30,
+                )
+                assert "expected holders" in r.get("error", ""), r
+                assert old.exists() and young.exists()
+
+                # registered-volume guard: a key naming a vid the topo
+                # still registers is never deleted, however old
+                from test_cluster import assign_retry
+
+                ar = await assign_retry(cluster.master.address)
+                vids = [int(ar.fid.split(",")[0])]
+                reg = cold_dir / f"{vids[0]}.ec05"
+                reg.write_bytes(b"r" * 32)
+                os.utime(reg, (aged, aged))
+
+                r = await Stub(
+                    grpc_address(cluster.master.address), "master"
+                ).call(
+                    "TierOrphanSweep",
+                    {"backend": name, "grace_s": 3600.0},
+                    timeout=30,
+                )
+                assert "error" not in r or not r.get("error"), r
+                assert r["orphans_swept"] == 1, r
+                assert r["skipped_young"] == 1, r
+                assert r["skipped_registered"] == 1, r
+                assert not old.exists()
+                assert young.exists()
+                assert reg.exists()
+                assert "tier_orphans_swept_total" in "\n".join(
+                    TIER_ORPHANS_SWEPT.render()
+                )
+            finally:
+                await cluster.stop()
+        finally:
+            tb.BACKEND_STORAGES.pop(backend.name, None)
+
+    asyncio.run(body())
+
+
+def test_collect_tier_manifest_keys_unit(tmp_path):
+    """The sweep's reference side: EC `.ctm` entries and tiered-volume
+    .vif remote files both count as referenced."""
+    from seaweedfs_tpu.storage.store import Store
+    from seaweedfs_tpu.storage.volume_info import RemoteFile, VolumeInfo
+
+    store = Store("127.0.0.1", 0, "", [str(tmp_path)], [5])
+    loc = store.locations[0]
+
+    class FakeEc:
+        remote_shards = {
+            0: {"key": "1.ec00", "size": 10, "backend": "local.cold"},
+            1: {"key": "1.ec01", "size": 10, "backend": "local.cold"},
+        }
+
+    class FakeVol:
+        volume_info = VolumeInfo(
+            files=[
+                RemoteFile(
+                    backend_type="s3", backend_id="default", key="7.dat"
+                )
+            ]
+        )
+
+    loc.ec_volumes[1] = FakeEc()
+    loc.volumes[7] = FakeVol()
+    keys = store.collect_tier_manifest_keys()
+    assert keys["local.cold"] == {"1.ec00", "1.ec01"}
+    assert keys["s3.default"] == {"7.dat"}
+
+
+# ---------------- lsm bloom filters ----------------
+
+
+def test_bloom_scalar_vector_hash_agreement(tmp_path):
+    """The vectorized build and the scalar probe MUST agree bit-for-bit
+    — a mismatch would make live keys invisible."""
+    import numpy as np
+
+    from seaweedfs_tpu.storage.needle_map import lsm_map as lm
+
+    keys = np.array(
+        [1, 2, 3, 0xDEADBEEF, 2**63, 2**64 - 1, 123456789], dtype=np.uint64
+    )
+    run_path = str(tmp_path / "run")
+    lm._write_bloom(run_path, keys)
+
+    class Shell:
+        bloom = None
+        bloom_k = 0
+        bloom_mbits = 0
+        count = len(keys)
+        path = run_path
+        bloom_probes = 0
+        bloom_neg = 0
+        _load_bloom = lm._Run._load_bloom
+        _bloom_test = lm._Run._bloom_test
+
+    sh = Shell()
+    sh._load_bloom()
+    assert sh.bloom is not None
+    for k in keys.tolist():
+        assert sh._bloom_test(lm._mix64_scalar(k)), k
+    sh.bloom.close()
+
+
+def test_bloom_sidecars_reload_sweep_and_torn(tmp_path):
+    from seaweedfs_tpu.storage.needle_map import lsm_map as lm
+
+    idx = str(tmp_path / "1.idx")
+    nm = lm.new_lsm_needle_map(idx)
+    nm.memtable_limit = 300
+    for k in range(1, 1501):
+        nm.put(k, k, 64)
+    nm.save_snapshot()
+    assert any(r.bloom is not None for r in nm._runs)
+    # absent keys short-circuit; live keys still resolve
+    for k in range(1, 1501, 97):
+        assert nm.get(k).offset_units == k
+    for k in range(5000, 9000, 61):
+        assert nm.get(k) is None
+    st = nm.bloom_stats()
+    assert st["filter_hit_rate"] > 0.9
+    nm.close()
+
+    # a torn/garbage sidecar is ignored, never fatal
+    bf = [
+        fn for fn in os.listdir(tmp_path) if fn.endswith(lm.BLOOM_EXT)
+    ]
+    assert bf, "expected bloom sidecars"
+    victim = os.path.join(tmp_path, bf[0])
+    with open(victim, "wb") as f:
+        f.write(b"garbage")
+    nm2 = lm.LsmNeedleMap(idx)
+    assert nm2.loaded_from_snapshot
+    for k in range(1, 1501, 173):
+        assert nm2.get(k).offset_units == k
+    # the sweep keeps live runs' sidecars, drops orphaned ones
+    orphan = os.path.join(tmp_path, "1.nmr-999" + lm.BLOOM_EXT)
+    with open(orphan, "wb") as f:
+        f.write(b"x")
+    lm.sweep_snapshot_files(idx[: -len(".idx")], keep_seqs=nm2._seqs)
+    assert not os.path.exists(orphan)
+    live_bfs = [
+        fn
+        for fn in os.listdir(tmp_path)
+        if fn.endswith(lm.BLOOM_EXT)
+    ]
+    assert len(live_bfs) >= 1
+    nm2.close()

@@ -154,6 +154,19 @@ def test_metrics_hygiene_lint():
         "seaweedfs_tpu_tier_remote_cache_misses_total",
     ):
         assert family in names, f"cold-tier family {family} not registered"
+    # metadata scale-out plane (ISSUE 15): pin the sharded-store and
+    # durable-feed families plus the orphan-sweep counter
+    for family in (
+        "seaweedfs_tpu_meta_shard_ops_total",
+        "seaweedfs_tpu_meta_shard_count",
+        "seaweedfs_tpu_meta_shard_rebalances_total",
+        "seaweedfs_tpu_meta_shard_moved_entries_total",
+        "seaweedfs_tpu_meta_feed_events_total",
+        "seaweedfs_tpu_meta_feed_segment_count",
+        "seaweedfs_tpu_meta_feed_cache_evictions_total",
+        "seaweedfs_tpu_tier_orphans_swept_total",
+    ):
+        assert family in names, f"meta-plane family {family} not registered"
 
 
 def test_tenant_label_cardinality_enforced_at_registry_seam():

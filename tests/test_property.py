@@ -920,4 +920,112 @@ def test_meta_log_resume_never_skips_or_duplicates(events, prefix, data):
         assert [ev.ts_ns for ev in batch] == [x for x in want if x > t], t
         assert watermark == log.last_ts_ns
     # resume from the watermark is empty until new events arrive
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    st.lists(
+        st.tuples(
+            st.sampled_from(["/a", "/a/b", "/c", "/cc"]),
+            st.sampled_from(["create", "update", "delete"]),
+        ),
+        min_size=1,
+        max_size=60,
+    ),
+    st.integers(min_value=2, max_value=4),  # concurrent subscribers
+    st.integers(min_value=2, max_value=7),  # segment_events (rotation!)
+    st.integers(min_value=2, max_value=9),  # ring capacity
+    st.data(),
+)
+def test_durable_meta_log_n_subscribers_exact_across_rotation(
+    events, n_subs, segment_events, capacity, data
+):
+    """ISSUE 15: the DurableMetaLog exact-resumption property extended
+    to N concurrent subscribers across SEGMENT ROTATION — each
+    subscriber reads in arbitrary chunk sizes from its own arbitrary
+    cursor (so reads straddle the ring/segment boundary and sealed
+    segments), and one subscriber is 'killed' mid-stream and resumed
+    through a FRESH log handle (the process-restart shape) from its
+    durable cursor. Every subscriber must see exactly its
+    prefix-matching suffix, in order, no skip, no duplicate."""
+    import shutil
+    import tempfile
+
+    from seaweedfs_tpu.filer.meta_log import DurableMetaLog
+
+    d = tempfile.mkdtemp(prefix="dmlog_prop_")
+    try:
+        log = DurableMetaLog(
+            d, capacity=capacity, segment_events=segment_events,
+            max_segments=4096,
+        )
+        appended = []
+        for directory, etype in events:
+            appended.append(
+                log.append(directory, etype, None, {"d": directory})
+            )
+        assert len(log._segments) >= 1
+
+        prefixes = ["/", "/a", "/a/b", "/c"]
+        all_ts = [0] + [ev.ts_ns for ev in appended]
+        for _ in range(n_subs):
+            prefix = data.draw(st.sampled_from(prefixes))
+            start = data.draw(st.sampled_from(all_ts))
+            want = [
+                ev.ts_ns
+                for ev in appended
+                if ev.ts_ns > start
+                and (
+                    prefix == "/"
+                    or f"{ev.directory.rstrip('/')}/".startswith(
+                        prefix.rstrip("/") + "/"
+                    )
+                    or ev.directory.startswith(prefix)
+                )
+            ]
+            got, cursor = [], start
+            while True:
+                chunk = data.draw(st.integers(min_value=1, max_value=9))
+                batch, wm = log.read_since_with_watermark(
+                    cursor, prefix, limit=chunk
+                )
+                got += [ev.ts_ns for ev in batch]
+                new_cursor = max(cursor, wm)
+                if not batch and new_cursor >= log.last_ts_ns:
+                    break
+                assert new_cursor > cursor  # progress, always
+                cursor = new_cursor
+            assert got == want, (prefix, start)
+
+        # kill/resume through a fresh handle: take half, ack, reopen
+        half = len(appended) // 2
+        name = "prop-resume"
+        first = []
+        cursor = 0
+        while len(first) < half:
+            batch, wm = log.read_since_with_watermark(
+                cursor, "/", limit=1
+            )
+            if not batch:
+                break
+            first += [ev.ts_ns for ev in batch]
+            cursor = max(cursor, wm)
+            log.cursor_ack(name, batch[-1].ts_ns)
+        log.close()
+        log2 = DurableMetaLog(
+            d, capacity=capacity, segment_events=segment_events,
+            max_segments=4096,
+        )
+        cur = log2.cursor_load(name) if first else 0
+        rest, cursor = [], cur or 0
+        while True:
+            batch, wm = log2.read_since_with_watermark(cursor, "/")
+            rest += [ev.ts_ns for ev in batch]
+            if wm >= log2.last_ts_ns:
+                break
+            cursor = max(cursor, wm)
+        assert first + rest == [ev.ts_ns for ev in appended]
+        log2.close()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
     assert log.read_since(log.last_ts_ns, prefix) == []

@@ -41,6 +41,19 @@ TIMEOUT_NONE_ALLOWLIST: dict = {
     #       by stream lifetime, not a per-request deadline",
 }
 
+# (relpath, callee) pairs allowed to enter a meta-log `.subscribe(...)`
+# follow loop WITHOUT a stopped= callback. The loop polls forever by
+# design; without a stop signal a shutting-down server wedges inside it
+# (ISSUE 15 satellite: the change-feed subscriber loops must be
+# stoppable). Allowlisted shapes carry a reason.
+SUBSCRIBE_STOPPED_ALLOWLIST: dict = {
+    ("server/filer.py", "subscribe"): (
+        "gRPC server-stream handler: the stream's lifetime is the "
+        "client's — the RPC layer cancels the generator on disconnect "
+        "or server stop"
+    ),
+}
+
 
 def _py_files():
     for dirpath, dirnames, filenames in os.walk(ROOT):
@@ -108,6 +121,23 @@ def _scan() -> list:
                         "tests/test_timeout_discipline.py with a reason "
                         "if this is truly a streaming endpoint"
                     )
+            if (
+                name == "subscribe"
+                and isinstance(node.func, ast.Attribute)
+                and ("since_ns" in kw or "path_prefix" in kw or node.args)
+            ):
+                # a meta-log follow loop without a stop signal wedges a
+                # shutting-down server inside its poll-forever body
+                if (
+                    "stopped" not in kw
+                    and (rel, name) not in SUBSCRIBE_STOPPED_ALLOWLIST
+                ):
+                    violations.append(
+                        f"{rel}:{node.lineno}: meta-log subscribe() "
+                        "without stopped= — the follow loop polls "
+                        "forever; pass a stop callback or allowlist "
+                        "with a reason"
+                    )
     return violations
 
 
@@ -151,7 +181,9 @@ def test_shared_client_timeout_bounds_connect_and_read():
 def test_allowlist_entries_are_live():
     """Every allowlist entry must still correspond to an existing file —
     dead entries hide future violations at the same spot."""
-    for rel, _callee in TIMEOUT_NONE_ALLOWLIST:
+    for rel, _callee in list(TIMEOUT_NONE_ALLOWLIST) + list(
+        SUBSCRIBE_STOPPED_ALLOWLIST
+    ):
         assert os.path.exists(os.path.join(ROOT, rel)), (
             f"stale allowlist entry: {rel}"
         )
