@@ -5679,6 +5679,11 @@ def measure_meta_lookup_qps(
         def run_batched(store) -> dict:
             svc = LogHistogram()  # amortized per-probe service time
             fm = store.find_many
+            # snapshot so the disclosure is per-RUN scanned work, not a
+            # cumulative count inflated by warmup + earlier reps
+            base_calls = (
+                store.stats["batches"] if hasattr(store, "stats") else None
+            )
             now = time.perf_counter
             t0 = now()
             for i in range(0, probes, batch):
@@ -5692,8 +5697,8 @@ def measure_meta_lookup_qps(
             wall = now() - t0
             s = svc.summary_ms()
             calls = (
-                store.stats["batches"]
-                if hasattr(store, "stats")
+                store.stats["batches"] - base_calls
+                if base_calls is not None
                 else (probes + batch - 1) // batch
             )
             return {

@@ -88,6 +88,7 @@ class MasterClient:
         self._vid_pending: dict[int, asyncio.Future] = {}
         self._vid_batch: list[int] = []
         self._vid_flush_scheduled = False
+        self._vid_loop: Optional[asyncio.AbstractEventLoop] = None
         self._vid_tasks: set = set()
         self.vid_gate_stats = {
             "lookups": 0, "rpcs": 0, "coalesced": 0, "largest_batch": 0,
@@ -103,6 +104,13 @@ class MasterClient:
                 await self._task
             except (asyncio.CancelledError, Exception):
                 pass
+        # in-flight vid-lookup batches: cancel and AWAIT them, so their
+        # pending futures get failed (not stranded for later callers to
+        # coalesce onto) before the loop that owns them goes away
+        for t in list(self._vid_tasks):
+            t.cancel()
+        if self._vid_tasks:
+            await asyncio.gather(*self._vid_tasks, return_exceptions=True)
 
     async def wait_connected(self, timeout: float = 10.0) -> None:
         await asyncio.wait_for(self._connected.wait(), timeout)
@@ -202,7 +210,16 @@ class MasterClient:
         vid = int(fid.split(",")[0])
         url = self.vid_map.pick(vid)
         if url is None:
-            await self._gated_vid_lookup(vid, timeout)
+            # per-CALLER deadline: a rider coalescing onto a flight
+            # opened with a longer budget still returns within its own
+            # timeout (the shared flight keeps running for the other
+            # riders; wait_for cancels only our shield). TimeoutError
+            # PROPAGATES: a timed-out lookup is transient-unavailable
+            # (callers retry), only a resolved flight with no holders
+            # becomes the authoritative LookupError below
+            await asyncio.wait_for(
+                self._gated_vid_lookup(vid, timeout), timeout
+            )
             url = self.vid_map.pick(vid)
         if url is None:
             raise LookupError(f"volume {vid} not found")
@@ -213,12 +230,31 @@ class MasterClient:
         """Awaitable that resolves once the batched LookupVolume round
         covering `vid` has filled (or failed to fill) the vid map."""
         self.vid_gate_stats["lookups"] += 1
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = asyncio.get_event_loop()
+        if self._vid_loop is not loop:
+            # fresh event loop (restart / embedded reuse — the meta
+            # gate's rebind, applied here): state parked on the old
+            # loop can never fire; fail it best-effort and start clean
+            for stale in self._vid_pending.values():
+                try:
+                    if not stale.done():
+                        stale.set_exception(
+                            LookupError("vid gate rebound to a new loop")
+                        )
+                except RuntimeError:
+                    pass
+            self._vid_pending = {}
+            self._vid_batch = []
+            self._vid_flush_scheduled = False
+            self._vid_loop = loop
         fut = self._vid_pending.get(vid)
         if fut is not None:
             self.vid_gate_stats["coalesced"] += 1
             return asyncio.shield(fut)  # rider: a caller's cancel must
             # not cancel the shared flight
-        loop = asyncio.get_event_loop()
         fut = loop.create_future()
         self._vid_pending[vid] = fut
         self._vid_batch.append(vid)
@@ -228,6 +264,12 @@ class MasterClient:
         return asyncio.shield(fut)
 
     def _vid_flush(self, timeout: float) -> None:
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is not None and running is not self._vid_loop:
+            return  # stale flush scheduled on a since-replaced loop
         self._vid_flush_scheduled = False
         batch, self._vid_batch = self._vid_batch, []
         if not batch:
@@ -250,7 +292,7 @@ class MasterClient:
                 timeout=remaining(deadline, 30.0),
             )
 
-        exc = None
+        exc: Optional[BaseException] = None
         try:
             resp = await retry_async(
                 one_lookup,
@@ -267,15 +309,29 @@ class MasterClient:
                     continue
                 for loc in r.get("locations", []):
                     self.vid_map.add(rvid, loc["url"])
-        except Exception as e:
+        except BaseException as e:
+            # BaseException: CancelledError (3.8+) must ALSO resolve the
+            # riders — a cancelled batch that strands its futures makes
+            # every later lookup of these vids coalesce onto a dead
+            # flight and hang forever
             exc = e
-        for vid in vids:
-            fut = self._vid_pending.pop(vid, None)
-            if fut is None or fut.done():
-                continue
-            if exc is not None:
-                fut.set_exception(exc)
-            else:
-                # resolved even when the master knows no holders: the
-                # caller's vid_map.pick decides hit vs LookupError
-                fut.set_result(None)
+        finally:
+            for vid in vids:
+                fut = self._vid_pending.pop(vid, None)
+                if fut is None or fut.done():
+                    continue
+                if exc is None:
+                    # resolved even when the master knows no holders: the
+                    # caller's vid_map.pick decides hit vs LookupError
+                    fut.set_result(None)
+                elif isinstance(exc, asyncio.CancelledError):
+                    # riders are shielded from their own cancellation, so
+                    # surface the shared flight's death as the documented
+                    # failure shape, not a phantom CancelledError
+                    fut.set_exception(
+                        LookupError("vid lookup batch cancelled")
+                    )
+                else:
+                    fut.set_exception(exc)
+        if exc is not None and not isinstance(exc, Exception):
+            raise exc  # CancelledError/KeyboardInterrupt/... propagate

@@ -82,12 +82,31 @@ class MetaAggregator:
 
     # ---------------- followers ----------------
     async def _follow_local(self) -> None:
-        async for ev in self.filer.meta_log.subscribe(
-            0, "/", stopped=lambda: self._stopped
-        ):
-            self.log.append(
-                ev.directory, ev.event_type, ev.old_entry, ev.new_entry
-            )
+        from ..util import log as _log
+        from .meta_log import MetaLogTrimmed
+
+        since = 0
+        while not self._stopped:
+            try:
+                async for ev in self.filer.meta_log.subscribe(
+                    since, "/", stopped=lambda: self._stopped
+                ):
+                    since = ev.ts_ns
+                    self.log.append(
+                        ev.directory, ev.event_type, ev.old_entry,
+                        ev.new_entry,
+                    )
+                return
+            except MetaLogTrimmed as e:
+                # the local durable log lost a range (retention outran
+                # this follower, or a corrupt segment): the aggregate
+                # ring is lossy by design — log the gap and resume past
+                # it instead of dying silently
+                _log.warning(
+                    "local meta feed gap (%d, %d]: resuming past it",
+                    e.since_ns, e.trimmed_through,
+                )
+                since = max(since, e.trimmed_through)
 
     async def _follow_peer(self, peer: str) -> None:
         """Follow one peer's SubscribeLocalMetadata stream forever,

@@ -68,9 +68,29 @@ class MetaLookupGate:
         return self._enqueue(tuple(paths))
 
     def _enqueue(self, paths: tuple):
-        loop = self._loop
-        if loop is None:
-            loop = self._loop = asyncio.get_event_loop()
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = asyncio.get_event_loop()
+        if self._loop is not loop:
+            # a different (fresh) event loop: the server was restarted /
+            # the gate is being reused (tests, embedded). Rebind cleanly
+            # instead of scheduling call_soon on a closed loop forever;
+            # futures parked on the previous loop are failed best-effort
+            # (usually their awaiters died with that loop, but if it is
+            # somehow still alive they must not hang)
+            stale, self._pending = self._pending, []
+            for _p, fut in stale:
+                try:
+                    if not fut.done():
+                        fut.set_exception(
+                            LookupError("meta gate rebound to a new loop")
+                        )
+                except RuntimeError:
+                    pass  # future's loop already closed
+            self._count = 0
+            self._flush_scheduled = False
+            self._loop = loop
         fut = loop.create_future()
         self._pending.append((paths, fut))
         self._count += len(paths)
@@ -82,6 +102,14 @@ class MetaLookupGate:
         return fut
 
     def _flush(self) -> None:
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None  # direct synchronous flush (no loop running)
+        if running is not None and running is not self._loop:
+            # a flush scheduled on a since-replaced loop must not touch
+            # (and resolve cross-thread) the NEW loop's pending futures
+            return
         self._flush_scheduled = False
         if not self._pending:
             return
@@ -149,10 +177,14 @@ class MetaLookupGate:
 
     def close(self) -> None:
         for _paths, fut in self._pending:
-            if not fut.done():
-                fut.set_exception(LookupError("meta gate closed"))
+            try:
+                if not fut.done():
+                    fut.set_exception(LookupError("meta gate closed"))
+            except RuntimeError:
+                pass  # future parked on an already-closed loop
         self._pending = []
         self._count = 0
+        self._loop = None
 
 
 async def _first(fut):

@@ -20,6 +20,23 @@ import time
 from typing import AsyncIterator, Optional
 
 
+class MetaLogTrimmed(RuntimeError):
+    """Events in (since_ns, trimmed_through] can never be delivered —
+    either the subscriber's resume cursor is older than retention, or a
+    sealed segment in that range is unreadable (corruption). Raised
+    instead of silently resuming past the hole; the subscriber decides
+    (rebuild its derived state, re-anchor past `trimmed_through`,
+    alert)."""
+
+    def __init__(self, since_ns: int, trimmed_through: int):
+        super().__init__(
+            f"meta-log history unavailable through {trimmed_through}; "
+            f"cannot resume exactly from {since_ns}"
+        )
+        self.since_ns = since_ns
+        self.trimmed_through = trimmed_through
+
+
 class MetaLogEvent:
     __slots__ = ("ts_ns", "directory", "event_type", "old_entry", "new_entry")
 
@@ -136,8 +153,11 @@ class DurableMetaLog(MetaLog):
     ts, watermark taken at the scan frontier), in bounded chunks.
 
     Retention is ``max_segments`` sealed segments; trimming records
-    ``trimmed_through`` so a subscriber older than retention is
-    detectable instead of silently incomplete. Torn tails (crash mid-
+    ``trimmed_through``, and a read whose resume cursor falls below it
+    raises :class:`MetaLogTrimmed` — a subscriber older than retention
+    is an ERROR, never silently incomplete (cursor 0 is exempt: it is
+    the explicit "replay whatever history is retained" request of a
+    fresh subscriber, not a resume point). Torn tails (crash mid-
     append) are truncated at open — a partial record can never be
     replayed as an event.
     """
@@ -165,6 +185,11 @@ class DurableMetaLog(MetaLog):
         self.fsync = fsync
         self._packer = msgpack.Packer(use_bin_type=True)
         self.trimmed_through = 0  # ts through which history was dropped
+        # cursors are independent of ring/segment state: their own lock
+        # keeps the synchronous cursors.json rewrite in cursor_ack from
+        # blocking every append (= every namespace mutation) behind
+        # file-system I/O
+        self._cursor_lock = threading.Lock()
         self._cursors: Optional[dict] = None
         # events at ts <= _mem_floor may be missing from the in-memory
         # ring — reads from at/below it go to the segments
@@ -192,6 +217,16 @@ class DurableMetaLog(MetaLog):
             for fn in os.listdir(self.dir)
             if fn.startswith("seg-") and fn.endswith(".mlog")
         )
+        # the trim frontier survives restarts: the TRIM marker (written
+        # at each trim) is exact; without one, a seq gap at the FRONT
+        # still proves retention trimmed history in a previous process
+        # life (sealed segments are never empty, so only trimming
+        # removes the oldest) and we reconstruct an upper bound
+        marker = self._load_trim_marker()
+        if marker is not None:
+            self.trimmed_through = marker
+        elif seqs and seqs[0] > 1:
+            self.trimmed_through = -1  # fixed up after the scan below
         last_ts = 0
         for seq in seqs:
             path = self._seg_path(seq)
@@ -213,6 +248,19 @@ class DurableMetaLog(MetaLog):
             )
             last_ts = max(last_ts, last)
         self._last_ts_ns = last_ts
+        if self.trimmed_through < 0:
+            # no marker (legacy dir, or the marker file was removed):
+            # bound the gap by the first retained event. This may
+            # over-claim by up to one inter-segment gap (a cursor
+            # between the true trim frontier and first_ts-1 raises
+            # spuriously — recovery is a harmless cache drop / resume,
+            # never data loss). With nothing retained at all there is
+            # no bound: degrade to 0 (fresh-log behavior) rather than
+            # a sentinel no follower could ever resume past.
+            first_ts = next(
+                (s["first"] for s in self._segments if s["count"]), 0
+            )
+            self.trimmed_through = max(0, first_ts - 1)
 
     @staticmethod
     def _scan_one(path: str) -> tuple[int, int, int, int]:
@@ -247,6 +295,38 @@ class DurableMetaLog(MetaLog):
         )
         self._active_f = open(self._seg_path(seq), "ab")
 
+    def _trim_marker_path(self) -> str:
+        import os
+
+        return os.path.join(self.dir, "TRIM")
+
+    def _load_trim_marker(self) -> Optional[int]:
+        import json
+
+        try:
+            with open(self._trim_marker_path()) as f:
+                return int(json.load(f)["trimmed_through"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def _save_trim_marker(self, value: int) -> bool:
+        """Persist the exact trim frontier (tmp + atomic rename).
+        Returns False on failure — the caller then SKIPS the trim, so
+        the marker can over-claim (crash between save and removal:
+        segments still readable) but never under-claim (a stale marker
+        silently skipping trimmed events after restart)."""
+        import json
+        import os
+
+        try:
+            tmp = self._trim_marker_path() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"trimmed_through": value}, f)
+            os.replace(tmp, self._trim_marker_path())
+            return True
+        except OSError:
+            return False
+
     def _rotate_locked(self) -> None:
         import os
 
@@ -254,15 +334,22 @@ class DurableMetaLog(MetaLog):
         os.fsync(self._active_f.fileno())  # sealed segments are durable
         self._active_f.close()
         self._open_segment(self._segments[-1]["seq"] + 1)
-        while len(self._segments) > self.max_segments:
-            doomed = self._segments.pop(0)
-            self.trimmed_through = max(
-                self.trimmed_through, doomed["last"]
+        if len(self._segments) > self.max_segments:
+            doomed = self._segments[: len(self._segments) - self.max_segments]
+            new_tt = max(
+                [self.trimmed_through] + [s["last"] for s in doomed]
             )
-            try:
-                os.remove(doomed["path"])
-            except OSError:
-                pass
+            # marker BEFORE removal: if the frontier cannot be made
+            # durable, keep the segments (retention overruns a little;
+            # data kept is always safe, data silently lost never is)
+            if self._save_trim_marker(new_tt):
+                self.trimmed_through = new_tt
+                del self._segments[: len(doomed)]
+                for s in doomed:
+                    try:
+                        os.remove(s["path"])
+                    except OSError:
+                        pass
         self._publish_segment_gauge()
 
     def _publish_segment_gauge(self) -> None:
@@ -324,8 +411,13 @@ class DurableMetaLog(MetaLog):
         ring read; otherwise events come off the segments in ts order.
         With `limit`, the returned watermark is the ts scanned THROUGH
         (the last examined event), so resuming from it never skips —
-        a far-behind subscriber catches up in bounded chunks."""
+        a far-behind subscriber catches up in bounded chunks.
+
+        Raises :class:`MetaLogTrimmed` when a non-zero cursor is older
+        than retention (see class doc)."""
         with self._lock:
+            if 0 < since_ns < self.trimmed_through:
+                raise MetaLogTrimmed(since_ns, self.trimmed_through)
             # the ring SERVES only its last `capacity` events (storage
             # runs to 2x between truncations) — the served floor is the
             # newest event the ring cannot produce
@@ -346,14 +438,35 @@ class DurableMetaLog(MetaLog):
         out: list[MetaLogEvent] = []
         scanned_through = since_ns
         for seg in segs:
-            for ev in self._read_segment(seg["path"]):
-                if ev.ts_ns <= since_ns:
-                    continue
-                scanned_through = ev.ts_ns
-                if _match_prefix(ev, path_prefix):
-                    out.append(ev)
-                    if limit is not None and len(out) >= limit:
-                        return out, scanned_through
+            seg_scanned = 0  # highest ts actually read from this file
+            try:
+                for ev in self._read_segment(seg["path"]):
+                    seg_scanned = ev.ts_ns
+                    if ev.ts_ns <= since_ns:
+                        continue
+                    scanned_through = ev.ts_ns
+                    if _match_prefix(ev, path_prefix):
+                        out.append(ev)
+                        if limit is not None and len(out) >= limit:
+                            return out, scanned_through
+            except FileNotFoundError:
+                # vanished segment: a retention trim raced this unlocked
+                # scan — TRANSIENT. Events in the hole were not
+                # delivered, so the head watermark must not become the
+                # cursor; resume authority is the last ts actually
+                # scanned, and the retry (now seeing the trim in
+                # trimmed_through) surfaces MetaLogTrimmed
+                return out, scanned_through
+            if seg_scanned < seg["last"]:
+                # the file EXISTS but decodes short of what was durably
+                # written: corruption, which no retry will heal. Deliver
+                # the healthy prefix first (a follower must not lose the
+                # readable history BEFORE the hole); once the cursor sits
+                # at the wall and no progress is possible, surface the
+                # undeliverable range instead of re-scanning forever
+                if scanned_through > since_ns:
+                    return out, scanned_through
+                raise MetaLogTrimmed(since_ns, seg["last"])
         # the unlocked file scan may have read events appended AFTER the
         # watermark was captured — returning the stale watermark would
         # rewind the cursor below an already-delivered event (duplicate
@@ -372,10 +485,15 @@ class DurableMetaLog(MetaLog):
 
     @staticmethod
     def _read_segment(path: str):
+        """Yield the valid prefix of one segment file. A missing file
+        raises FileNotFoundError (the caller distinguishes a trim race
+        from corruption); any decode trouble ends the stream early —
+        the caller detects the shortfall against the segment's durable
+        last-ts."""
         import msgpack
 
-        try:
-            with open(path, "rb") as f:
+        with open(path, "rb") as f:  # FileNotFoundError propagates
+            try:
                 for rec in msgpack.Unpacker(f, raw=False):
                     if not isinstance(rec, dict) or "t" not in rec:
                         break
@@ -383,10 +501,8 @@ class DurableMetaLog(MetaLog):
                         int(rec["t"]), rec.get("d", ""), rec.get("e", ""),
                         rec.get("o"), rec.get("n"),
                     )
-        except FileNotFoundError:
-            return
-        except Exception:
-            return  # torn tail: the valid prefix was already yielded
+            except Exception:
+                return  # torn tail: the valid prefix was already yielded
 
     async def subscribe(
         self,
@@ -434,7 +550,7 @@ class DurableMetaLog(MetaLog):
 
     def cursor_load(self, name: str) -> Optional[int]:
         """Resume point for a named subscriber, or None when unknown."""
-        with self._lock:
+        with self._cursor_lock:
             return self._load_cursors().get(name)
 
     def cursor_ack(self, name: str, ts_ns: int) -> None:
@@ -446,7 +562,7 @@ class DurableMetaLog(MetaLog):
         import json
         import os
 
-        with self._lock:
+        with self._cursor_lock:
             cur = self._load_cursors()
             if cur.get(name, -1) >= ts_ns:
                 return

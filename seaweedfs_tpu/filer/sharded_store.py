@@ -34,7 +34,21 @@ TPUs" (arxiv 2112.09017) applied to the metadata plane:
   source authoritative (copies inert, re-purged on retry); a kill after
   commit leaves the destination authoritative (the recorded obligation
   re-runs cleanup at the next open). `tests/test_meta_plane.py` drives
-  a kill-point grid over every step.
+  a kill-point grid over every step;
+- **moves never lose live traffic**: store ops take a shared (reader)
+  slot on a writer-preferring RW lock. Without coordination, a write
+  routed to the source shard between the copy pass and cleanup would
+  be swept by cleanup (lost write), a delete in the same window would
+  resurrect from the destination copy, and a read could probe stale
+  routing around the bounds flip. But the O(range) copy pass must not
+  stall the serving event loop either, so a move holds the exclusive
+  (writer) slot only BRIEFLY: it opens a dirty window, releases the
+  lock for purge+copy (routing still points at the source, so the
+  destination copies are invisible and concurrent mutators proceed —
+  each records its path if it lands in the moving range), then
+  re-acquires exclusivity to replay that delta, flip the bounds, and
+  clean up. The exclusive window is O(mutations-during-copy), not
+  O(range).
 
 `find_many` is the gate-batched lookup seam (`filer/meta_gate.py`):
 paths group by shard and the per-shard batches run in parallel worker
@@ -51,6 +65,7 @@ import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
+from contextlib import contextmanager
 from typing import Callable, Optional
 
 from .entry import Entry
@@ -75,7 +90,9 @@ REBALANCE_MIN_INTERVAL_S = float(
 # write-ahead record of the move range: without it, a crash between
 # copy and commit would strand copies in the destination that a LATER
 # retry (possibly choosing a different split) would never purge.
-REBALANCE_STEPS = ("intent", "purge", "copy", "commit", "cleanup")
+# "delta" marks the end of the unlocked copy window: mutations recorded
+# during purge/copy are replayed under the exclusive lock right after.
+REBALANCE_STEPS = ("intent", "purge", "copy", "delta", "commit", "cleanup")
 
 # find_many batches below this run their per-shard probes inline:
 # measured on the dev host, worker-thread dispatch + GIL ping-pong
@@ -110,6 +127,56 @@ def _fsync_dir(path: str) -> None:
         os.fsync(fd)
     finally:
         os.close(fd)
+
+
+class _RWLock:
+    """Writer-preferring readers-writer lock for shard topology.
+
+    Store ops are readers: they may run concurrently (each sub-store
+    serializes its own state) but must observe a stable bounds/route
+    and must never land inside a move's copy->cleanup window. A
+    rebalance move is the writer: exclusive, so no concurrent mutator
+    can be swept by cleanup or resurrected from a stale copy. Writer
+    preference (new readers queue once a writer waits) keeps a steady
+    read load from starving the rebalance forever.
+    """
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    @contextmanager
+    def read(self):
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._readers -= 1
+                if self._readers == 0:
+                    self._cond.notify_all()
+
+    @contextmanager
+    def write(self):
+        with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writer or self._readers:
+                    self._cond.wait()
+            finally:
+                self._writers_waiting -= 1
+            self._writer = True
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._writer = False
+                self._cond.notify_all()
 
 
 def _count_shard_op(op: str) -> None:
@@ -156,8 +223,21 @@ class ShardedFilerStore:
             if rebalance_min_interval_s is not None
             else REBALANCE_MIN_INTERVAL_S
         )
-        self._lock = threading.RLock()
+        # _rw: topology lock — ops shared, move delta/commit exclusive
+        # (see _RWLock); _lock: small mutex for lazy-init + dirty state
+        self._rw = _RWLock()
+        self._lock = threading.Lock()
         self._pool: Optional[ThreadPoolExecutor] = None
+        # in-flight move dirty window: (move_lo, move_hi) while the
+        # unlocked copy pass runs; mutators landing in the range record
+        # their paths for the pre-commit delta replay. _move_mutex
+        # serializes whole moves against each other (bounds + the
+        # pending-cleanup obligation are single-writer) without
+        # touching the reader path.
+        self._move_prep: Optional[tuple[str, str]] = None
+        self._move_dirty: set = set()
+        self._move_dirty_full = False
+        self._move_mutex = threading.Lock()
         self._last_rebalance = 0.0
         self.stats = {
             "ops": 0,
@@ -284,31 +364,49 @@ class ShardedFilerStore:
         return self._index_for_dir(d)
 
     # ---------------- FilerStore interface ----------------
+    def _note_move_dirty(self, d: str, full_path: str) -> None:
+        """Record a mutation landing inside an in-flight move's range.
+        The copy pass runs without the exclusive lock (so it cannot see
+        this write); the mover replays the dirty set under the lock
+        before committing the bounds — no write is ever swept by
+        cleanup, no delete ever resurrects from a stale copy. Called
+        with the read lock held, so the window flags cannot flip
+        mid-op."""
+        mp = self._move_prep
+        if mp is not None and mp[0] <= d < mp[1]:
+            with self._lock:
+                self._move_dirty.add(full_path)
+
     def insert_entry(self, entry: Entry) -> None:
-        d, _ = _split(entry.full_path)
-        i = self._index_for_dir(d)
-        self._heat[i].note_write()
-        self.stats["ops"] += 1
-        _count_shard_op("insert")
-        self._stores[i].insert_entry(entry)
+        with self._rw.read():
+            d, _ = _split(entry.full_path)
+            i = self._index_for_dir(d)
+            self._heat[i].note_write()
+            self.stats["ops"] += 1
+            _count_shard_op("insert")
+            self._note_move_dirty(d, entry.full_path)
+            self._stores[i].insert_entry(entry)
 
     update_entry = insert_entry
 
     def find_entry(self, full_path: str) -> Optional[Entry]:
-        d, _ = _split(full_path)
-        i = self._index_for_dir(d)
-        self._heat[i].note_read()
-        self.stats["ops"] += 1
-        _count_shard_op("find")
-        return self._stores[i].find_entry(full_path)
+        with self._rw.read():
+            d, _ = _split(full_path)
+            i = self._index_for_dir(d)
+            self._heat[i].note_read()
+            self.stats["ops"] += 1
+            _count_shard_op("find")
+            return self._stores[i].find_entry(full_path)
 
     def delete_entry(self, full_path: str) -> None:
-        d, _ = _split(full_path)
-        i = self._index_for_dir(d)
-        self._heat[i].note_write()
-        self.stats["ops"] += 1
-        _count_shard_op("delete")
-        self._stores[i].delete_entry(full_path)
+        with self._rw.read():
+            d, _ = _split(full_path)
+            i = self._index_for_dir(d)
+            self._heat[i].note_write()
+            self.stats["ops"] += 1
+            _count_shard_op("delete")
+            self._note_move_dirty(d, full_path)
+            self._stores[i].delete_entry(full_path)
 
     def delete_folder_children(self, full_path: str) -> None:
         """A subtree spans shards: its directories occupy the string
@@ -319,23 +417,32 @@ class ShardedFilerStore:
 
         prefix = full_path.rstrip("/")
         hi = prefix_successor(prefix + "/") or "\U0010ffff"
-        self.stats["ops"] += 1
-        _count_shard_op("delete_children")
-        for i in self._indices_for_range(prefix, hi):
-            self._heat[i].note_write()
-            self._stores[i].delete_folder_children(full_path)
+        with self._rw.read():
+            self.stats["ops"] += 1
+            _count_shard_op("delete_children")
+            mp = self._move_prep
+            if mp is not None and mp[0] < hi and prefix < mp[1]:
+                # a range op intersecting the moving range: per-path
+                # dirty tracking cannot name its victims — mark the
+                # whole move dirty so the delta replay recopies exactly
+                with self._lock:
+                    self._move_dirty_full = True
+            for i in self._indices_for_range(prefix, hi):
+                self._heat[i].note_write()
+                self._stores[i].delete_folder_children(full_path)
 
     def list_directory_entries(
         self, dir_path: str, start_file_name: str, inclusive: bool, limit: int
     ) -> list[Entry]:
-        d = dir_path.rstrip("/") or "/"
-        i = self._index_for_dir(d)
-        self._heat[i].note_read()
-        self.stats["ops"] += 1
-        _count_shard_op("list")
-        return self._stores[i].list_directory_entries(
-            dir_path, start_file_name, inclusive, limit
-        )
+        with self._rw.read():
+            d = dir_path.rstrip("/") or "/"
+            i = self._index_for_dir(d)
+            self._heat[i].note_read()
+            self.stats["ops"] += 1
+            _count_shard_op("list")
+            return self._stores[i].list_directory_entries(
+                dir_path, start_file_name, inclusive, limit
+            )
 
     def scan_directory_entries(
         self,
@@ -347,17 +454,18 @@ class ShardedFilerStore:
     ) -> list[Entry]:
         """Upper-bound pushdown passthrough: the owning shard's indexed
         range scan when it has one (sqlite), its plain page otherwise."""
-        d = dir_path.rstrip("/") or "/"
-        i = self._index_for_dir(d)
-        self._heat[i].note_read()
-        store = self._stores[i]
-        scan = getattr(store, "scan_directory_entries", None)
-        if scan is not None:
-            return scan(dir_path, start_file_name, inclusive, limit,
-                        upper_bound)
-        return store.list_directory_entries(
-            dir_path, start_file_name, inclusive, limit
-        )
+        with self._rw.read():
+            d = dir_path.rstrip("/") or "/"
+            i = self._index_for_dir(d)
+            self._heat[i].note_read()
+            store = self._stores[i]
+            scan = getattr(store, "scan_directory_entries", None)
+            if scan is not None:
+                return scan(dir_path, start_file_name, inclusive, limit,
+                            upper_bound)
+            return store.list_directory_entries(
+                dir_path, start_file_name, inclusive, limit
+            )
 
     # ---------------- batched lookups (the gate seam) ----------------
     def find_many(self, paths: list[str]) -> dict[str, Entry]:
@@ -368,37 +476,47 @@ class ShardedFilerStore:
         concurrent probes through here."""
         if not paths:
             return {}
-        self.stats["batched_lookups"] += len(paths)
-        self.stats["batches"] += 1
-        _count_shard_op("find_many")
-        by_shard: dict[int, list[str]] = {}
-        for p in paths:
-            d, _ = _split(p)
-            by_shard.setdefault(self._index_for_dir(d), []).append(p)
-        for i in by_shard:
-            self._heat[i].note_read(len(by_shard[i]))
-        # thread fan-out only pays once the per-shard batches amortize
-        # the dispatch/wakeup cost; a gate-tick-sized batch runs the
-        # per-shard probes inline (each is one lock + one C query)
-        if len(by_shard) == 1 or len(paths) < _PARALLEL_THRESHOLD:
-            out: dict[str, Entry] = {}
-            for i, group in by_shard.items():
-                out.update(self._shard_find_many(self._stores[i], group))
+        with self._rw.read():
+            self.stats["batched_lookups"] += len(paths)
+            self.stats["batches"] += 1
+            _count_shard_op("find_many")
+            by_shard: dict[int, list[str]] = {}
+            for p in paths:
+                d, _ = _split(p)
+                by_shard.setdefault(self._index_for_dir(d), []).append(p)
+            for i in by_shard:
+                self._heat[i].note_read(len(by_shard[i]))
+            # thread fan-out only pays once the per-shard batches
+            # amortize the dispatch/wakeup cost; a gate-tick-sized batch
+            # runs the per-shard probes inline (each is one lock + one
+            # C query)
+            if len(by_shard) == 1 or len(paths) < _PARALLEL_THRESHOLD:
+                out: dict[str, Entry] = {}
+                for i, group in by_shard.items():
+                    out.update(
+                        self._shard_find_many(self._stores[i], group)
+                    )
+                return out
+            pool = self._pool
+            if pool is None:
+                # double-checked: find_many runs concurrently from many
+                # gate executor threads (readers share _rw) — exactly
+                # one of them may create the pool
+                with self._lock:
+                    pool = self._pool
+                    if pool is None:
+                        pool = self._pool = ThreadPoolExecutor(
+                            max_workers=len(self._stores),
+                            thread_name_prefix="meta-shard",
+                        )
+            futs = [
+                pool.submit(self._shard_find_many, self._stores[i], group)
+                for i, group in by_shard.items()
+            ]
+            out = {}
+            for f in futs:
+                out.update(f.result())
             return out
-        pool = self._pool
-        if pool is None:
-            pool = self._pool = ThreadPoolExecutor(
-                max_workers=len(self._stores),
-                thread_name_prefix="meta-shard",
-            )
-        futs = [
-            pool.submit(self._shard_find_many, self._stores[i], group)
-            for i, group in by_shard.items()
-        ]
-        out = {}
-        for f in futs:
-            out.update(f.result())
-        return out
 
     @staticmethod
     def _shard_find_many(store, paths: list[str]) -> dict[str, Entry]:
@@ -440,10 +558,35 @@ class ShardedFilerStore:
         self, src: Optional[int] = None, now: Optional[float] = None
     ) -> Optional[dict]:
         """Move half of one shard's directories to its cooler adjacent
-        neighbor (purge -> copy -> commit -> cleanup; see module doc for
-        the crash analysis). Returns a move report or None when the
-        shard cannot shed (single directory, no neighbor)."""
-        with self._lock:
+        neighbor (intent -> purge -> copy -> delta -> commit -> cleanup;
+        see module doc for the crash analysis). The exclusive writer
+        slot is held only for the intent and the delta+commit — every
+        O(range) pass (candidate enumeration, purge, copy, cleanup)
+        runs with concurrent ops flowing: routing still points the
+        range at its committed owner throughout, and `_move_mutex`
+        serializes whole moves against each other. A failed move rolls
+        back in place (destination purged, intent cleared) so a retry
+        starts clean without waiting for a process restart. Returns a
+        move report or None when the shard cannot shed (single
+        directory, no neighbor, or another move in flight)."""
+        hook = self.step_hook or (lambda step: None)
+        if not self._move_mutex.acquire(blocking=False):
+            return None  # another move is mid-flight
+        try:
+            if self._pending_move:
+                # a previous in-process attempt failed to roll back
+                # (e.g. the abort's own map write failed): finish that
+                # rollback before starting a new move, or its strays
+                # would be orphaned by our intent overwrite
+                self._abort_pending_move()
+            if self._pending_cleanup:
+                # likewise a cleanup that failed mid-delete: finish it
+                # before commit durably overwrites the obligation with
+                # our own (idempotent, same as the at-open recovery)
+                self._run_cleanup()
+            # candidate selection reads bounds + enumerates the shard
+            # WITHOUT any topology lock: only moves mutate bounds, and
+            # the move mutex is ours
             heats = self.shard_heats(now)
             if src is None:
                 src = max(range(len(heats)), key=heats.__getitem__)
@@ -471,39 +614,119 @@ class ShardedFilerStore:
                 move_lo, move_hi = split, hi
                 new_bounds = list(self._bounds)
                 new_bounds[src] = split
-            hook = self.step_hook or (lambda step: None)
 
-            # (intent) write-ahead record of the move range: a crash
-            # anywhere before commit rolls back by purging exactly this
-            # range from the destination at the next open — a retry is
-            # free to choose a different split
-            hook("intent")
-            self._pending_move = {
-                "src": src, "dst": dst, "lo": move_lo, "hi": move_hi,
-            }
-            self._commit_map()
+            with self._rw.write():
+                # (intent) write-ahead record of the move range: a crash
+                # anywhere before commit rolls back by purging exactly
+                # this range from the destination at the next open — a
+                # retry is free to choose a different split
+                hook("intent")
+                self._pending_move = {
+                    "src": src, "dst": dst, "lo": move_lo, "hi": move_hi,
+                }
+                self._commit_map()
+                # open the dirty window before surrendering exclusivity
+                self._move_prep = (move_lo, move_hi)
+                self._move_dirty = set()
+                self._move_dirty_full = False
 
-            # (purge) clear stale copies an earlier same-range attempt
-            # may have left in the destination — an entry deleted at the
-            # source since then must not resurrect through the old copy
-            hook("purge")
-            for _d, _n, e in list(self._iter_store(dst, move_lo, move_hi)):
-                self._stores[dst].delete_entry(e.full_path)
+            try:
+                # (purge)+(copy) run WITHOUT the exclusive lock: the
+                # committed map still routes the range to the source, so
+                # the destination copies stay invisible; concurrent
+                # mutators proceed and are delta-recorded
+                # (purge) clear stale copies an earlier same-range
+                # attempt may have left in the destination — an entry
+                # deleted at the source since then must not resurrect
+                hook("purge")
+                for _d, _n, e in list(
+                    self._iter_store(dst, move_lo, move_hi)
+                ):
+                    self._stores[dst].delete_entry(e.full_path)
 
-            hook("copy")
-            moved = 0
-            for _d, _n, e in list(self._iter_store(src, move_lo, move_hi)):
-                self._stores[dst].insert_entry(e)
-                moved += 1
+                hook("copy")
+                moved = 0
+                for _d, _n, e in list(
+                    self._iter_store(src, move_lo, move_hi)
+                ):
+                    self._stores[dst].insert_entry(e)
+                    moved += 1
+                # (delta-point) mutations recorded up to here live only
+                # in the source + the dirty set; the replay below is
+                # what carries them across
+                hook("delta")
 
-            hook("commit")
-            self._bounds = new_bounds
-            self._pending_move = None
-            self._pending_cleanup = {
-                "shard": src, "lo": move_lo, "hi": move_hi,
-            }
-            self._commit_map()
+                with self._rw.write():
+                    try:
+                        # (delta) replay what changed during the
+                        # unlocked copy: the source is still
+                        # authoritative for the range, so re-reading
+                        # each dirty path gives the final word
+                        if self._move_dirty_full:
+                            # a subtree delete crossed the range —
+                            # recopy exactly
+                            for _d, _n, e in list(
+                                self._iter_store(dst, move_lo, move_hi)
+                            ):
+                                self._stores[dst].delete_entry(e.full_path)
+                            moved = 0
+                            for _d, _n, e in list(
+                                self._iter_store(src, move_lo, move_hi)
+                            ):
+                                self._stores[dst].insert_entry(e)
+                                moved += 1
+                        else:
+                            for p in self._move_dirty:
+                                e = self._stores[src].find_entry(p)
+                                if e is None:
+                                    self._stores[dst].delete_entry(p)
+                                else:
+                                    self._stores[dst].insert_entry(e)
 
+                        hook("commit")
+                        old_state = (
+                            self._bounds,
+                            self._pending_move,
+                            self._pending_cleanup,
+                        )
+                        self._bounds = new_bounds
+                        self._pending_move = None
+                        self._pending_cleanup = {
+                            "shard": src, "lo": move_lo, "hi": move_hi,
+                        }
+                        try:
+                            self._commit_map()
+                        except BaseException:
+                            # the durable map still holds the OLD bounds
+                            # + intent: memory must agree, or writes
+                            # routed by the new bounds would be purged
+                            # as intent strays at the next open
+                            (
+                                self._bounds,
+                                self._pending_move,
+                                self._pending_cleanup,
+                            ) = old_state
+                            raise
+                    finally:
+                        self._move_prep = None
+                        self._move_dirty = set()
+                        self._move_dirty_full = False
+            except BaseException:
+                # roll back IN PLACE (the at-open recovery shape, minus
+                # the restart): close the dirty window, purge the
+                # attempted copies, clear the intent — a later move
+                # with a different split must not inherit strays
+                with self._rw.write():
+                    self._move_prep = None
+                    self._move_dirty = set()
+                    self._move_dirty_full = False
+                self._abort_pending_move()
+                raise
+
+            # (cleanup) runs WITHOUT the exclusive lock too: the
+            # committed bounds no longer route the moved range to the
+            # source, so live traffic cannot touch what it deletes; the
+            # move mutex keeps it ordered before any next move
             hook("cleanup")
             self._run_cleanup()
 
@@ -536,6 +759,8 @@ class ShardedFilerStore:
             return {
                 "src": src, "dst": dst, "split": split, "moved": moved,
             }
+        finally:
+            self._move_mutex.release()
 
     def _shard_range(self, i: int) -> tuple[str, str]:
         lo = self._bounds[i - 1] if i > 0 else ""

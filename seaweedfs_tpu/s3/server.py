@@ -248,6 +248,16 @@ class ObjectResponseCache:
             self.feed_evictions += 1
             return True
 
+    def clear(self) -> None:
+        """Drop everything — the feed subscriber's recovery when its
+        cursor fell behind retention (events it can no longer replay
+        might have named ANY cached path). Correctness never depended
+        on this (validate-on-hit re-checks every signature); it just
+        restores the proactive-eviction invariant wholesale."""
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
     def __contains__(self, path: str) -> bool:
         with self._lock:
             return path in self._entries
@@ -377,6 +387,8 @@ class S3Server:
             self._feed_task = None
 
     async def _follow_meta_feed(self) -> None:
+        from ..filer.meta_log import MetaLogTrimmed
+
         log = self.filer.meta_log
         cursor_load = getattr(log, "cursor_load", None)
         cursor_ack = getattr(log, "cursor_ack", None)
@@ -388,6 +400,21 @@ class S3Server:
             # nothing to evict — anchor at the current frontier
             since = log.last_ts_ns
         cache = self.object_cache
+        while not self._feed_stopped:
+            try:
+                await self._feed_loop(log, since, cursor_ack, cache)
+                return
+            except MetaLogTrimmed:
+                # our cursor fell behind retention: the missed events
+                # could have named any cached path, so drop the whole
+                # cache (reads stay byte-correct either way —
+                # validate-on-hit) and re-anchor at the frontier
+                cache.clear()
+                since = log.last_ts_ns
+                if cursor_ack is not None:
+                    cursor_ack(self.FEED_SUBSCRIBER, since)
+
+    async def _feed_loop(self, log, since, cursor_ack, cache) -> None:
         last_ts = 0
         try:
             async for ev in log.subscribe(
@@ -395,30 +422,31 @@ class S3Server:
             ):
                 self.feed_events += 1
                 last_ts = ev.ts_ns
-                # acks are THROTTLED (each one rewrites cursors.json
-                # atomically — per-event would be one file rename per
-                # namespace mutation); evictions are idempotent, so a
-                # crash re-delivering up to 32 events is harmless
+                if ev.event_type != "create" or ev.old_entry:
+                    # (pure creates are skipped: a brand-new entry can
+                    # have nothing stale cached, and a GET racing this
+                    # event may already hold the FRESH body, which a
+                    # blind evict would discard)
+                    for entry in (ev.old_entry, ev.new_entry):
+                        if not entry:
+                            continue
+                        path = entry.get("full_path") or ""
+                        if path and cache.evict(path):
+                            try:
+                                from ..util.metrics import (
+                                    META_FEED_EVICTIONS,
+                                )
+
+                                META_FEED_EVICTIONS.inc()
+                            except ImportError:
+                                pass
+                # ack AFTER the event's evictions are applied (at-least-
+                # once: a crash between evict and ack re-delivers, which
+                # is harmless; ack-before-evict could under-deliver) and
+                # THROTTLED (each ack rewrites cursors.json atomically —
+                # per-event would be one file rename per mutation)
                 if cursor_ack is not None and self.feed_events % 32 == 0:
                     cursor_ack(self.FEED_SUBSCRIBER, last_ts)
-                if ev.event_type == "create" and not ev.old_entry:
-                    # a brand-new entry can have nothing stale cached;
-                    # a GET racing this event may already have cached
-                    # the FRESH body, which a blind evict would discard
-                    continue
-                for entry in (ev.old_entry, ev.new_entry):
-                    if not entry:
-                        continue
-                    path = entry.get("full_path") or ""
-                    if path and cache.evict(path):
-                        try:
-                            from ..util.metrics import (
-                                META_FEED_EVICTIONS,
-                            )
-
-                            META_FEED_EVICTIONS.inc()
-                        except ImportError:
-                            pass
         finally:
             # flush the cursor on any exit (stop, cancel, error) so a
             # clean restart resumes exactly where processing stopped

@@ -1089,6 +1089,9 @@ class FilerServer:
             yield out
 
     async def _subscribe(self, log, req):
+        from ..filer.meta_log import MetaLogTrimmed
+        from ..util import log as _log
+
         since_ns = int(req.get("since_ns", 0))
         if since_ns < 0:
             # "from now" anchored to the server-side event sequence: a skewed
@@ -1096,8 +1099,26 @@ class FilerServer:
             # ones, and any event appended after this point has ts > anchor
             since_ns = log.last_ts_ns
         prefix = req.get("path_prefix", "/") or "/"
-        async for ev in log.subscribe(since_ns, prefix):
-            yield ev.to_dict()
+        while True:
+            try:
+                async for ev in log.subscribe(since_ns, prefix):
+                    since_ns = ev.ts_ns
+                    yield ev.to_dict()
+                return
+            except MetaLogTrimmed as e:
+                # remote follower older than retention (or a corrupt
+                # segment range): resume past the undeliverable range —
+                # lossy like the reference's LogBuffer window, but LOUD,
+                # never a silently wedged redial loop. In-process
+                # subscribers keep the strict error and decide for
+                # themselves (the S3 cache drops itself and re-anchors).
+                _log.warning(
+                    "meta subscriber %r behind retention: events in "
+                    "(%d, %d] are gone; resuming from there",
+                    req.get("client_name", ""), e.since_ns,
+                    e.trimmed_through,
+                )
+                since_ns = max(since_ns, e.trimmed_through)
 
     async def _grpc_configuration(self, req, context) -> dict:
         # cipher is part of the contract: direct-to-volume uploaders

@@ -2334,7 +2334,11 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
                 return {"error": f"manifest collection from {dn.url}: {e}"}
             holders += 1
             for bname, keys in (r.get("backends") or {}).items():
-                if bname == name:
+                # manifests record the RESOLVED backend name
+                # ("s3.default"); the operator may have configured the
+                # bare-type alias ("s3") — match either, or the whole
+                # manifest-reference protection silently nullifies
+                if bname in (name, backend.name):
                     referenced.update(str(k) for k in keys)
 
         loop = asyncio.get_event_loop()

@@ -320,11 +320,14 @@ class _Run:
         if (
             magic != _BLOOM_MAGIC
             or ver != 1
+            or k != 2  # get()'s probe is unrolled for exactly k=2: a
+            # foreign/other-k sidecar would yield FALSE NEGATIVES
+            # (live needles reported absent) — run unfiltered instead
             or count != self.count
             or mbits & (mbits - 1)
             or size != _BLOOM_HEADER.size + (mbits >> 3)
         ):
-            return  # stale/torn sidecar: run without a filter
+            return  # stale/torn/incompatible sidecar: no filter
         import mmap as _mmap
 
         with open(path, "rb") as f:

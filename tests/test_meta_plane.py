@@ -120,9 +120,12 @@ def test_rebalance_hysteresis(tmp_path):
     for _ in range(400):
         s.find_entry(hot)
     assert s.maybe_rebalance() is None
-    # ... but after the interval it may
+    # ... but after the interval the gate is RE-ARMED: with the skew
+    # rebuilt well past factor x mean, the next check fires again
     clock[0] += 61.0
-    assert s.maybe_rebalance() is not None or True  # heat decayed or moved
+    for _ in range(1500):
+        s.find_entry(hot)
+    assert s.maybe_rebalance() is not None
     s.close()
 
 
@@ -171,6 +174,156 @@ def test_rebalance_kill_point_grid(tmp_path, kill_at):
     for p in paths:
         assert s2.find_entry(p) is not None, (kill_at, p)
     s2.close()
+
+
+def test_failed_move_rolls_back_in_place(tmp_path):
+    """A move dying mid-flight (store error, not a crash) must roll
+    back IN PLACE: destination copies purged, intent cleared — so a
+    later in-process retry with a possibly different split never
+    inherits stray copies that only a restart would have swept."""
+    d = str(tmp_path)
+    s = ShardedFilerStore(d, _sqlite_factory(d), 4)
+    paths = _populate(s)
+    for _ in range(100):
+        s.find_entry(paths[0])
+
+    def hook(step):
+        if step == "delta":  # post-copy: the destination holds copies
+            raise _Kill(step)
+
+    s.step_hook = hook
+    with pytest.raises(_Kill):
+        s.rebalance_once()
+    assert s._pending_move is None and s._move_prep is None
+    from collections import Counter
+
+    counts = Counter((dd, n) for dd, n, _e in s.iter_all())
+    assert not [k for k, v in counts.items() if v > 1], "strays survived"
+    # the next in-process move starts clean and completes
+    s.step_hook = None
+    for _ in range(100):
+        s.find_entry(paths[0])
+    assert s.rebalance_once() is not None
+    for p in paths:
+        assert s.find_entry(p) is not None, p
+    counts = Counter((dd, n) for dd, n, _e in s.iter_all())
+    assert not [k for k, v in counts.items() if v > 1]
+    s.close()
+
+
+def test_rebalance_delta_replay_no_lost_writes(tmp_path):
+    """Mutations landing while the move's UNLOCKED copy pass runs must
+    survive the move: the copy window records them and the pre-commit
+    delta replay carries them across — an insert is never swept by
+    cleanup, a delete never resurrects from the stale copy — while
+    ops during the O(range) copy do NOT block (the exclusive lock is
+    held only for the delta+commit)."""
+    import threading
+
+    d = str(tmp_path)
+    s = ShardedFilerStore(d, _sqlite_factory(d), 4)
+    paths = _populate(s)
+    for _ in range(100):
+        s.find_entry(paths[0])
+
+    in_copy = threading.Event()
+    release = threading.Event()
+    mutated = threading.Event()
+    late = "/b/d00/late"
+    victims = [paths[0], paths[-1]]  # one per move half, whichever moves
+
+    def hook(step):
+        if step == "copy":
+            in_copy.set()
+            assert release.wait(10)
+        if step == "delta":
+            # post-copy, pre-replay: these mutations exist ONLY in the
+            # source store + the dirty set — the delta replay is the
+            # only thing that can carry them into the destination
+            s.insert_entry(
+                Entry(full_path=late, attr=Attr(mtime=9.0),
+                      extended={"k": late})
+            )
+            for v in victims:
+                s.delete_entry(v)
+            mutated.set()
+
+    s.step_hook = hook
+    mover = threading.Thread(target=s.rebalance_once)
+    mover.start()
+    assert in_copy.wait(10)
+    # liveness: a write during the copy phase completes without waiting
+    # for the move (the old whole-move exclusive lock would block here)
+    t0 = time.monotonic()
+    s.insert_entry(
+        Entry(full_path="/b/d01/during", attr=Attr(mtime=8.0),
+              extended={"k": "/b/d01/during"})
+    )
+    assert time.monotonic() - t0 < 5.0
+    release.set()
+    mover.join(10)
+    assert mutated.is_set()
+    assert s.find_entry(late) is not None
+    assert s.find_entry("/b/d01/during") is not None
+    for v in victims:
+        assert s.find_entry(v) is None, v  # deletes never resurrect
+    for p in paths:
+        if p not in victims:
+            assert s.find_entry(p) is not None, p
+    from collections import Counter
+
+    counts = Counter((dd, n) for dd, n, _e in s.iter_all())
+    assert not [k for k, v in counts.items() if v > 1]
+    s.close()
+
+
+def test_find_many_pool_created_once_under_race(tmp_path):
+    """Concurrent large batches from gate executor threads must share
+    ONE worker pool (the lazy init is double-checked), not leak one
+    executor per racer."""
+    import threading
+
+    from seaweedfs_tpu.filer import sharded_store as ss
+
+    d = str(tmp_path)
+    # bounds inside /b so the batch genuinely spans shards (the pooled
+    # fan-out only engages for multi-shard batches)
+    s = ShardedFilerStore(
+        d, _sqlite_factory(d), 4,
+        initial_bounds=["/b/d02", "/b/d05", "/b/d08"],
+    )
+    paths = _populate(s, n_dirs=10, files=4)
+    assert len({s.shard_of(p) for p in paths}) > 1
+    big = [p for p in paths for _ in range(3)]
+
+    orig_thresh = ss._PARALLEL_THRESHOLD
+    real_pool = ss.ThreadPoolExecutor
+    created = []
+
+    class CountingPool(real_pool):
+        def __init__(self, *a, **k):
+            created.append(self)
+            super().__init__(*a, **k)
+
+    ss._PARALLEL_THRESHOLD = 1  # force the pooled path
+    ss.ThreadPoolExecutor = CountingPool
+    try:
+        barrier = threading.Barrier(4)
+
+        def probe():
+            barrier.wait()
+            s.find_many(big)
+
+        threads = [threading.Thread(target=probe) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert len(created) == 1, "racing batches each created a pool"
+    finally:
+        ss._PARALLEL_THRESHOLD = orig_thresh
+        ss.ThreadPoolExecutor = real_pool
+        s.close()
 
 
 def test_torn_shard_map_shadow_is_swept(tmp_path):
@@ -329,6 +482,200 @@ def test_vid_lookup_gate_coalesces_misses(monkeypatch):
         assert calls == [["42"]]
 
     asyncio.run(body())
+
+
+def test_meta_gate_survives_event_loop_restart():
+    """The gate must not pin the first event loop it saw: a server
+    restarted on a fresh loop (tests, embedded reuse) re-binds instead
+    of scheduling call_soon on the closed loop forever."""
+    from seaweedfs_tpu.filer.meta_gate import MetaLookupGate
+
+    store = MemoryFilerStore()
+    store.insert_entry(Entry(full_path="/g/a", attr=Attr(mtime=1.0)))
+    gate = MetaLookupGate(store)
+
+    async def probe():
+        return await gate.lookup("/g/a")
+
+    e1 = asyncio.run(probe())
+    assert e1 is not None and e1.full_path == "/g/a"
+    # second asyncio.run = a brand-new loop; the first one is closed
+    e2 = asyncio.run(probe())
+    assert e2 is not None and e2.full_path == "/g/a"
+    gate.close()
+
+
+def test_vid_gate_cancelled_batch_fails_riders(monkeypatch):
+    """A cancelled in-flight LookupVolume batch must FAIL its pending
+    futures (not strand them): later lookups of the same vids would
+    otherwise coalesce onto the dead shielded flight and hang forever.
+    stop() cancels outstanding batches for the same reason."""
+    from seaweedfs_tpu.client import master_client as mc
+
+    async def body():
+        started = asyncio.Event()
+
+        class HangStub:
+            def __init__(self, *a, **k):
+                pass
+
+            async def call(self, method, req, timeout=None):
+                started.set()
+                await asyncio.sleep(3600)
+
+        monkeypatch.setattr(mc, "Stub", HangStub)
+        client = mc.MasterClient("t", ["127.0.0.1:1"])
+        r1 = asyncio.ensure_future(client.lookup_file_id_async("7,aa"))
+        r2 = asyncio.ensure_future(client.lookup_file_id_async("7,bb"))
+        await asyncio.wait_for(started.wait(), 5)
+        assert client._vid_pending
+        await client.stop()  # cancels the in-flight batch
+        with pytest.raises(LookupError):
+            await asyncio.wait_for(r1, 5)
+        with pytest.raises(LookupError):
+            await asyncio.wait_for(r2, 5)
+        assert not client._vid_pending  # nothing stranded
+
+        # the gate recovers: a fresh lookup opens a fresh flight
+        class GoodStub(HangStub):
+            async def call(self, method, req, timeout=None):
+                return {
+                    "volume_id_locations": [
+                        {"volumeId": v, "locations": [{"url": f"h{v}:80"}]}
+                        for v in req["volume_ids"]
+                    ]
+                }
+
+        monkeypatch.setattr(mc, "Stub", GoodStub)
+        assert await client.lookup_file_id_async("7,cc") == (
+            "http://h7:80/7,cc"
+        )
+
+    asyncio.run(body())
+
+
+# ---------------- durable feed: retention + trim races ----------------
+
+
+def test_meta_log_stale_cursor_raises_trimmed(tmp_path):
+    """A resume cursor older than retention is an ERROR
+    (MetaLogTrimmed), never a silent skip; cursor 0 stays the explicit
+    'replay retained history' request of a fresh subscriber."""
+    from seaweedfs_tpu.filer.meta_log import DurableMetaLog, MetaLogTrimmed
+
+    log = DurableMetaLog(
+        str(tmp_path), capacity=4, segment_events=16, max_segments=2
+    )
+    appended = [
+        log.append("/t", "create", None, {"i": i}) for i in range(80)
+    ]
+    assert log.trimmed_through > 0  # retention actually trimmed
+    with pytest.raises(MetaLogTrimmed):
+        log.read_since_with_watermark(appended[0].ts_ns, "/")
+    # cursor 0: fresh subscriber, gets exactly the retained suffix
+    got, wm = log.read_since_with_watermark(0, "/")
+    assert [e.ts_ns for e in got] == [
+        e.ts_ns for e in appended if e.ts_ns > log.trimmed_through
+    ]
+    assert wm == log.last_ts_ns
+    # a cursor at/above the trim point resumes exactly
+    got2, _ = log.read_since_with_watermark(log.trimmed_through, "/")
+    assert [e.ts_ns for e in got2] == [e.ts_ns for e in got]
+    log.close()
+
+    # the trim frontier SURVIVES restart: a stale durable cursor errors
+    # in the next process life too, instead of silently skipping the
+    # gap. The TRIM marker carries the EXACT value...
+    tt = log.trimmed_through
+    log2 = DurableMetaLog(
+        str(tmp_path), capacity=4, segment_events=16, max_segments=2
+    )
+    assert log2.trimmed_through == tt
+    with pytest.raises(MetaLogTrimmed):
+        log2.read_since_with_watermark(appended[0].ts_ns, "/")
+    fresh, wm2 = log2.read_since_with_watermark(0, "/")
+    assert [e.ts_ns for e in fresh] == [
+        e.ts_ns for e in appended if e.ts_ns > tt
+    ]
+    assert wm2 == log2.last_ts_ns
+    log2.close()
+    # ...and without the marker (legacy dir / lost best-effort write)
+    # the front seq gap still reconstructs an upper bound that catches
+    # the stale cursor
+    os.remove(os.path.join(str(tmp_path), "TRIM"))
+    log3 = DurableMetaLog(
+        str(tmp_path), capacity=4, segment_events=16, max_segments=2
+    )
+    assert log3.trimmed_through >= tt
+    with pytest.raises(MetaLogTrimmed):
+        log3.read_since_with_watermark(appended[0].ts_ns, "/")
+    log3.close()
+
+
+def test_meta_log_short_segment_scan_never_skips(tmp_path):
+    """A segment vanishing mid-scan (retention trim racing the unlocked
+    read) must cap the returned watermark at the last ts actually
+    scanned — returning the head watermark would advance the cursor
+    past events that were never delivered."""
+    from seaweedfs_tpu.filer.meta_log import DurableMetaLog
+
+    log = DurableMetaLog(
+        str(tmp_path), capacity=2, segment_events=16, max_segments=4096
+    )
+    appended = [
+        log.append("/t", "create", None, {"i": i}) for i in range(40)
+    ]
+    # simulate the race: seg-2 disappears while the segment list (and
+    # trimmed_through) still predate the trim
+    assert len(log._segments) >= 3
+    os.remove(log._segments[1]["path"])
+    got, wm = log.read_since_with_watermark(0, "/")
+    # everything before the hole delivered, nothing after it skipped:
+    # the watermark stops at the end of seg-1, NOT at the head
+    assert [e.ts_ns for e in got] == [e.ts_ns for e in appended[:16]]
+    assert wm == appended[15].ts_ns
+    assert wm < log.last_ts_ns
+    log.close()
+
+
+def test_meta_log_corrupt_segment_raises_not_stalls(tmp_path):
+    """A sealed segment PRESENT on disk but decoding short of its
+    durable last-ts is corruption — no retry heals it, so the read
+    raises MetaLogTrimmed over the undeliverable range instead of
+    re-scanning to the same wall forever (a missing file stays the
+    transient trim-race path, see the test above)."""
+    from seaweedfs_tpu.filer.meta_log import DurableMetaLog, MetaLogTrimmed
+
+    log = DurableMetaLog(
+        str(tmp_path), capacity=2, segment_events=16, max_segments=4096
+    )
+    appended = [
+        log.append("/t", "create", None, {"i": i}) for i in range(40)
+    ]
+    victim = log._segments[1]
+    size = os.path.getsize(victim["path"])
+    with open(victim["path"], "r+b") as f:
+        f.truncate(size // 2)
+    # first read: the readable history BEFORE the hole is delivered
+    # (seg-1 + the corrupt segment's valid prefix), watermark capped at
+    # the wall — never the head
+    got1, wm1 = log.read_since_with_watermark(0, "/")
+    assert len(got1) >= 16  # at least all of seg-1
+    assert [e.ts_ns for e in got1] == [
+        e.ts_ns for e in appended[: len(got1)]
+    ]
+    assert wm1 == got1[-1].ts_ns < victim["last"]
+    # at the wall no progress is possible: raise, naming the range
+    with pytest.raises(MetaLogTrimmed) as ei:
+        log.read_since_with_watermark(wm1, "/")
+    assert ei.value.trimmed_through == victim["last"]
+    # a subscriber resuming past the undeliverable range gets the rest
+    got2, wm2 = log.read_since_with_watermark(victim["last"], "/")
+    assert [e.ts_ns for e in got2] == [
+        e.ts_ns for e in appended if e.ts_ns > victim["last"]
+    ]
+    assert wm2 == log.last_ts_ns
+    log.close()
 
 
 # ---------------- durable feed: S3 cache eviction e2e ----------------
