@@ -2280,7 +2280,6 @@ def measure_serving_overload(
     import asyncio
     import shutil
     import tempfile
-    import threading
 
     d = tempfile.mkdtemp(
         prefix="bench_ov_", dir="/dev/shm" if os.path.isdir("/dev/shm") else None
@@ -2293,77 +2292,17 @@ def measure_serving_overload(
     saved_breaker = os.environ.get("SEAWEEDFS_TPU_BREAKER")
     os.environ["SEAWEEDFS_TPU_BREAKER"] = "0"
 
-    import socket
-
-    mport = _free_port_pair()
-    # hold mport while picking vport: nothing is bound yet, so a second
-    # scan would hand back the same pair
-    with socket.socket() as _hold:
-        _hold.bind(("127.0.0.1", mport))
-        vport = _free_port_pair()
-    ready = threading.Event()
-    hold: dict = {}
-
-    def server_main() -> None:
-        async def run() -> None:
-            from seaweedfs_tpu.pb.rpc import close_all_channels
-            from seaweedfs_tpu.server.master import MasterServer
-            from seaweedfs_tpu.server.volume import VolumeServer
-            from seaweedfs_tpu.util.fasthttp import (
-                FastHTTPServer,
-                render_response,
-            )
-
-            stop = asyncio.Event()
-            hold["stop"] = stop
-            hold["loop"] = asyncio.get_event_loop()
-            ms = MasterServer(port=mport, pulse_seconds=0.2)
-            await ms.start()
-            vs = VolumeServer(
-                master=ms.address,
-                directories=[d],
-                port=vport,
-                pulse_seconds=0.2,
-                max_volume_counts=[20],
-            )
-            await vs.start()
-            resp = render_response(200, b'{"ok": 1}')
-
-            async def ping_handler(req):
-                return resp
-
-            psrv = FastHTTPServer(ping_handler)
-            await psrv.start("127.0.0.1", 0)
-            hold["ping_port"] = psrv._server.sockets[0].getsockname()[1]
-            hold["ms"], hold["vs"] = ms, vs
-            ready.set()
-            try:
-                await stop.wait()
-            finally:
-                await psrv.stop()
-                await vs.stop()
-                await ms.stop()
-                await close_all_channels()
-
-        try:
-            asyncio.run(run())
-        except Exception as e:  # surfaced to the client thread
-            hold["error"] = repr(e)
-            ready.set()
-
-    thread = threading.Thread(target=server_main, daemon=True)
-    thread.start()
-    if not ready.wait(30) or "error" in hold:
+    # shared threaded fixture (closes the PR 12 round-5 drift: this leg
+    # carried its own inline copy of the cluster-thread scaffolding)
+    try:
+        hold, thread = _start_cluster_thread(
+            d, max_volumes=20, with_ping=True
+        )
+    except RuntimeError as e:
         # the early exit owes the same cleanup the finally below does:
         # a leaked SEAWEEDFS_TPU_BREAKER=0 would silently disable
         # breakers for every LATER bench leg in this process
-        try:
-            if "loop" in hold and "stop" in hold:
-                hold["loop"].call_soon_threadsafe(hold["stop"].set)
-        except Exception:
-            pass
-        thread.join(5)
-        out["error"] = hold.get("error", "server thread failed to start")
+        out["error"] = str(e)
         if saved_breaker is None:
             os.environ.pop("SEAWEEDFS_TPU_BREAKER", None)
         else:
@@ -2656,11 +2595,7 @@ def measure_serving_overload(
     try:
         asyncio.run(body())
     finally:
-        try:
-            hold["loop"].call_soon_threadsafe(hold["stop"].set)
-            thread.join(30)
-        except Exception as e:
-            out.setdefault("error", f"server thread stop: {e!r}")
+        _stop_cluster_thread(hold, thread)
         if saved_breaker is None:
             os.environ.pop("SEAWEEDFS_TPU_BREAKER", None)
         else:
@@ -2676,13 +2611,17 @@ def _start_cluster_thread(
     iam_cfg: Optional[dict] = None,
     chunk_size: int = 64 * 1024,
     max_volumes: int = 50,
+    with_ping: bool = False,
 ):
     """Master + volume (+ filer + S3) on a DEDICATED thread/event loop —
     the serving.overload construction (see measure_serving_overload's
     docstring for why: on a shared loop the generator throttles itself
     before the server backlogs, and server-side admission is the thing
     under test). Returns (hold, thread); hold carries ms/vs (+fs/s3),
-    the loop and its stop event. Caller MUST _stop_cluster_thread."""
+    the loop and its stop event; with_ping adds a trivial-200 fast-tier
+    endpoint ON the server loop (hold["ping_port"]) — the refuse-one-
+    request cost floor the overload leg discloses. Caller MUST
+    _stop_cluster_thread."""
     import asyncio
     import threading
 
@@ -2739,12 +2678,31 @@ def _start_cluster_thread(
                 )
                 s3 = S3Server(fs, port=sport, iam=iam)
                 await s3.start()
+            psrv = None
+            if with_ping:
+                from seaweedfs_tpu.util.fasthttp import (
+                    FastHTTPServer,
+                    render_response,
+                )
+
+                resp = render_response(200, b'{"ok": 1}')
+
+                async def ping_handler(req):
+                    return resp
+
+                psrv = FastHTTPServer(ping_handler)
+                await psrv.start("127.0.0.1", 0)
+                hold["ping_port"] = (
+                    psrv._server.sockets[0].getsockname()[1]
+                )
             hold["ms"], hold["vs"] = ms, vs
             hold["fs"], hold["s3"] = fs, s3
             ready.set()
             try:
                 await stop.wait()
             finally:
+                if psrv is not None:
+                    await psrv.stop()
                 if s3 is not None:
                     await s3.stop()
                 if fs is not None:
@@ -3593,6 +3551,703 @@ def measure_multitenant_soak(
         out.setdefault("error", f"{type(e).__name__}: {e}")
     finally:
         _stop_cluster_thread(hold, thread)
+        if saved_breaker is None:
+            os.environ.pop("SEAWEEDFS_TPU_BREAKER", None)
+        else:
+            os.environ["SEAWEEDFS_TPU_BREAKER"] = saved_breaker
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
+def measure_production_soak(
+    total_keys: int = 10_000_000,
+    tenants: int = 16,
+    key_bytes: int = 64,
+    s3_fraction: float = 0.004,
+    s3_obj_bytes: int = 1024,
+    batch: int = 512,
+    write_workers: int = 8,
+    volumes: int = 3,
+    filers: int = 2,
+    delete_fraction: float = 0.08,
+    soak_window_s: float = 20.0,
+    offered_fraction: float = 0.5,
+    write_mix: float = 0.05,
+    fault_count: int = 3,
+    seed: int = 31,
+    goodput_floor: float = 0.6,
+    p99_ceiling_ms: float = 500.0,
+    needle_map: str = "lsm",
+    needle_map_mb: float = 0.25,
+    time_cap_s: float = 600.0,
+    quiesce_timeout_s: float = 45.0,
+    read_timeout_s: float = 2.0,
+) -> dict:
+    """soak.production leg (ISSUE 16): ONE sustained, hostile,
+    production-shaped proof over a REAL multi-process cluster.
+
+    The cluster is master + `volumes` volume servers + a `filers`-node
+    filer fleet + S3 gateway + blob-backend cold tier, every role its
+    own OS process (ops/proc_cluster.py) spawned through the `weed-tpu`
+    entry points — the first leg where SIGKILL means what it means in
+    production. ALL background planes run live via their env gates
+    (anti-entropy repair, vacuum, lifecycle incl. cold-tier
+    offload/recall against the blob process, scrub budget, orphan
+    sweep's reference side), volume servers run the LSM needle map so
+    multi-run maps + bloom sidecars appear under sustained load.
+
+    Phases: (1) corpus — >= `total_keys` keys across >= `tenants`
+    tenants via batched raw frames + per-tenant V4-signed S3 objects
+    (per-tenant BUCKET-SCOPED IAM: Read/Write/List on the tenant's own
+    bucket only, so cross-tenant denial is a policy fact the leg can
+    probe, not an artifact of Admin-for-everyone); a `delete_fraction`
+    slice is deleted to feed the vacuum plane real garbage. (2) chaos
+    soak — open-loop zipf traffic (PR 6 CO-corrected percentiles, reads
+    + a `write_mix` write stream) at `offered_fraction` x a measured
+    closed-loop ceiling, while a SEEDED process-fault schedule
+    (util/faults.process_fault_schedule) restarts (SIGKILL + respawn +
+    wait-ready) and pauses (SIGSTOP/SIGCONT) volume servers and
+    hard-kills one filer, all reproducible bit-for-bit from `seed`
+    (disclosed as schedule + schedule_reproducible). (3) quiesce — wait
+    out the schedule, then score SLO terms: goodput >= `goodput_floor`
+    x offered, foreground CO-corrected p99 <= `p99_ceiling_ms`, ZERO
+    byte-identity violations (every verified read byte-compared against
+    the tenant's deterministic corpus, including a post-chaos sample
+    through the restarted process), ZERO tenant-isolation violations
+    (cross-tenant signed GETs must be denied), and every maintenance
+    queue (repair/vacuum/lifecycle) drained to depth 0. Bloom-sidecar
+    consultation economics are scraped from each live volume process's
+    /debug/needle_map and disclosed in the lookup tail."""
+    import asyncio
+    import shutil
+    import struct
+    import tempfile
+
+    from seaweedfs_tpu.ops.proc_cluster import ProcCluster, sum_metric
+    from seaweedfs_tpu.util.faults import (
+        process_fault_schedule,
+        process_schedule_to_dicts,
+    )
+
+    d = tempfile.mkdtemp(
+        prefix="bench_prod_",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None,
+    )
+    out: dict = {
+        "target_keys": total_keys,
+        "tenants": tenants,
+        "volumes": volumes,
+        "filers": filers,
+        "seed": seed,
+        "key_bytes": key_bytes,
+    }
+    names = [f"tenant{i}" for i in range(tenants)]
+    # bucket-scoped IAM: tenant i can touch ONLY soak-tenant{i} — the
+    # isolation probe below depends on denial being policy, not luck.
+    # PutBucket needs Admin (s3/server.py _required_action), so a
+    # separate admin identity does bucket setup and nothing else.
+    iam_cfg = {
+        "identities": [
+            {
+                "name": "soakadmin",
+                "credentials": [
+                    {"accessKey": "AKsoakadmin", "secretKey": "SKsoakadmin"}
+                ],
+                "actions": ["Admin"],
+            }
+        ]
+        + [
+            {
+                "name": n,
+                "credentials": [
+                    {"accessKey": f"AK{n}", "secretKey": f"SK{n}"}
+                ],
+                "actions": [f"Read:soak-{n}", f"Write:soak-{n}"],
+            }
+            for n in names
+        ]
+    }
+    child_env = {
+        # every background plane LIVE (the gates the threaded legs
+        # flip per-plane, all at once):
+        "SEAWEEDFS_TPU_AUTO_REPAIR": "1",
+        "SEAWEEDFS_TPU_AUTO_VACUUM": "1",
+        "SEAWEEDFS_TPU_AUTO_LIFECYCLE": "1",
+        "SEAWEEDFS_TPU_SCRUB_MBPS": "20",
+        "SEAWEEDFS_TPU_MAINT_MBPS": "200",
+        "SEAWEEDFS_TPU_COLD_BACKEND": "s3.default",
+        # small memtable so the LSM maps seal real runs (bloom
+        # sidecars) within a quick-budget corpus
+        "SEAWEEDFS_TPU_NEEDLE_MAP_MB": str(needle_map_mb),
+    }
+    saved_breaker = os.environ.get("SEAWEEDFS_TPU_BREAKER")
+    os.environ["SEAWEEDFS_TPU_BREAKER"] = "0"
+    cluster = ProcCluster(
+        d,
+        volumes=volumes,
+        filers=filers,
+        with_s3=True,
+        with_blob=True,
+        iam_cfg=iam_cfg,
+        env=child_env,
+        needle_map=needle_map,
+    )
+    try:
+        cluster.start()
+    except Exception as e:
+        out["error"] = f"cluster start: {type(e).__name__}: {e}"
+        cluster.stop()
+        if saved_breaker is None:
+            os.environ.pop("SEAWEEDFS_TPU_BREAKER", None)
+        else:
+            os.environ["SEAWEEDFS_TPU_BREAKER"] = saved_breaker
+        shutil.rmtree(d, ignore_errors=True)
+        return out
+
+    out["pids"] = cluster.pids()
+    out["distinct_pids"] = len(set(out["pids"].values())) == len(
+        out["pids"]
+    )
+
+    async def body() -> None:
+        from seaweedfs_tpu.client.operation import AssignLease, http_assign
+        from seaweedfs_tpu.command.benchmark import fake_payload
+        from seaweedfs_tpu.ops.loadgen import (
+            LogHistogram,
+            ZipfKeys,
+            arrival_count,
+            run_open_loop,
+        )
+        from seaweedfs_tpu.s3.auth import sign_request
+        from seaweedfs_tpu.util.fasthttp import FastHTTPClient
+
+        http = FastHTTPClient(pool_per_host=96)
+        maddr = cluster.master_address
+        s3addr = cluster.address("s3")
+        t_leg0 = time.perf_counter()
+
+        def capped() -> bool:
+            return time.perf_counter() - t_leg0 > time_cap_s
+
+        def payload(tidx: int, i: int, size: int) -> bytes:
+            # tenant-disjoint seed space: any cross-tenant fid/entry
+            # mixup is a guaranteed byte mismatch
+            return fake_payload((tidx << 56) | i, size)
+
+        def signed_headers(method, url, body_b, n):
+            signed = sign_request(
+                method, url, {}, body_b, f"AK{n}", f"SK{n}"
+            )
+            return {
+                k: v for k, v in signed.items() if k.lower() != "host"
+            }
+
+        try:
+            # ---- phase 1: corpus ----
+            s3_per_tenant = int(total_keys * s3_fraction / tenants)
+            raw_per_tenant = (
+                total_keys - s3_per_tenant * tenants
+            ) // tenants
+
+            async def fetch_lease(count: int):
+                for _ in range(8):
+                    try:
+                        return await http_assign(http, maddr, count)
+                    except RuntimeError as e:
+                        if "503" not in str(e):
+                            raise
+                        await asyncio.sleep(
+                            max(0.05, min(
+                                http.retry_after_remaining(maddr), 1.0
+                            ))
+                        )
+                return await http_assign(http, maddr, count)
+
+            lease = AssignLease(fetch=fetch_lease, batch=4096)
+            fids: list = [[] for _ in range(tenants)]
+            errors = [0]
+            write_sheds = [0]
+            violations = [0]
+            isolation_violations = [0]
+
+            async def req_with_retry(method, host, target, **kw):
+                # writers HONOR the admission plane: 503 sleeps out the
+                # Retry-After floor and retries; sheds are disclosed
+                st = resp = None
+                for _ in range(8):
+                    st, resp = await http.request(
+                        method, host, target, **kw
+                    )
+                    if st != 503:
+                        return st, resp
+                    write_sheds[0] += 1
+                    await asyncio.sleep(
+                        max(0.02, min(
+                            http.retry_after_remaining(host), 1.0
+                        ))
+                    )
+                return st, resp
+
+            t0 = time.perf_counter()
+            work: list = []
+            for tidx in range(tenants):
+                i = 0
+                while i < raw_per_tenant:
+                    n = min(batch, raw_per_tenant - i)
+                    work.append((tidx, i, n))
+                    i += n
+            work.reverse()  # pop() drains tenant-interleaved
+            stopped = [False]
+
+            async def raw_writer() -> None:
+                while work and not stopped[0]:
+                    if capped():
+                        stopped[0] = True
+                        return
+                    tidx, start, n = work.pop()
+                    items = []
+                    for j in range(n):
+                        ar = await lease.take()
+                        items.append((ar, start + j))
+                    parts = [struct.pack("<I", len(items))]
+                    for ar, idx in items:
+                        fb = ar.fid.encode()
+                        body_b = payload(tidx, idx, key_bytes)
+                        parts.append(
+                            struct.pack("<HI", len(fb), len(body_b))
+                        )
+                        parts.append(fb)
+                        parts.append(body_b)
+                    url = items[0][0].url
+                    st, resp = await req_with_retry(
+                        "POST", url, "/!batch/put",
+                        body=b"".join(parts),
+                        content_type="application/octet-stream",
+                        headers={"X-Seaweed-Tenant": names[tidx]},
+                    )
+                    if st != 200:
+                        errors[0] += n
+                        continue
+                    results = json.loads(resp)
+                    for (ar, idx), r in zip(items, results):
+                        if r.get("err"):
+                            st2, _ = await req_with_retry(
+                                "POST", ar.url, "/" + ar.fid,
+                                body=payload(tidx, idx, key_bytes),
+                                content_type="application/octet-stream",
+                                headers={
+                                    "X-Seaweed-Tenant": names[tidx]
+                                },
+                            )
+                            if st2 != 201:
+                                errors[0] += 1
+                                continue
+                        fids[tidx].append((ar.fid, ar.url, idx))
+
+            await asyncio.gather(
+                *(raw_writer() for _ in range(write_workers))
+            )
+            raw_written = sum(len(f) for f in fids)
+            out["raw_keys_written"] = raw_written
+            out["raw_write_wall_s"] = round(time.perf_counter() - t0, 2)
+            out["raw_write_qps"] = round(
+                raw_written / max(time.perf_counter() - t0, 1e-9)
+            )
+            if not raw_written:
+                out["error"] = "corpus write produced no fids"
+                return
+
+            # S3 objects: per-tenant buckets under bucket-scoped creds
+            t0 = time.perf_counter()
+            s3_objs: list = [[] for _ in range(tenants)]
+            for tidx, n in enumerate(names):
+                st, _ = await http.request(
+                    "PUT", s3addr, f"/soak-{n}",
+                    headers=signed_headers(
+                        "PUT", f"http://{s3addr}/soak-{n}", b"",
+                        "soakadmin",
+                    ),
+                )
+                if st != 200:
+                    out["error"] = f"bucket create for {n}: {st}"
+                    return
+            s3_work = [
+                (tidx, i)
+                for i in range(s3_per_tenant)
+                for tidx in range(tenants)
+            ]
+            s3_work.reverse()
+
+            async def s3_writer() -> None:
+                while s3_work and not stopped[0]:
+                    if capped():
+                        stopped[0] = True
+                        return
+                    tidx, i = s3_work.pop()
+                    n = names[tidx]
+                    body_b = payload(tidx, (1 << 48) | i, s3_obj_bytes)
+                    url = f"http://{s3addr}/soak-{n}/k{i:08d}"
+                    st, _ = await req_with_retry(
+                        "PUT", s3addr, f"/soak-{n}/k{i:08d}",
+                        body=body_b,
+                        content_type="application/octet-stream",
+                        headers=signed_headers("PUT", url, body_b, n),
+                    )
+                    if st == 200:
+                        s3_objs[tidx].append(i)
+                    else:
+                        errors[0] += 1
+
+            await asyncio.gather(
+                *(s3_writer() for _ in range(write_workers))
+            )
+            s3_written = sum(len(o) for o in s3_objs)
+            out["s3_keys_written"] = s3_written
+            out["keys_written"] = raw_written + s3_written
+            out["write_errors"] = errors[0]
+            out["write_sheds_honored"] = write_sheds[0]
+            out["time_capped"] = stopped[0]
+            if stopped[0]:
+                out["note_cap"] = (
+                    f"write phase stopped at time_cap_s={time_cap_s}: "
+                    f"{out['keys_written']} of {total_keys} keys — "
+                    "acceptance target NOT met this run"
+                )
+
+            # vacuum feed: delete a slice so compaction has real work
+            deleted = [0]
+            for tidx in range(tenants):
+                cut = int(len(fids[tidx]) * delete_fraction)
+                doomed, fids[tidx] = (
+                    fids[tidx][:cut], fids[tidx][cut:]
+                )
+                for fid, url, _idx in doomed:
+                    st, _ = await http.request("DELETE", url, "/" + fid)
+                    if st < 300:
+                        deleted[0] += 1
+            out["keys_deleted"] = deleted[0]
+
+            # ---- tenant-isolation probe: every tenant's creds against
+            # its NEIGHBOR's object must be denied ----
+            denied = 0
+            probes = 0
+            for tidx in range(tenants):
+                other = (tidx + 1) % tenants
+                if not s3_objs[other]:
+                    continue
+                n_mine, n_other = names[tidx], names[other]
+                i = s3_objs[other][0]
+                url = f"http://{s3addr}/soak-{n_other}/k{i:08d}"
+                st, _ = await http.request(
+                    "GET", s3addr, f"/soak-{n_other}/k{i:08d}",
+                    headers=signed_headers("GET", url, b"", n_mine),
+                )
+                probes += 1
+                if st == 200:
+                    isolation_violations[0] += 1
+                else:
+                    denied += 1
+            out["isolation_probes"] = probes
+            out["isolation_denied"] = denied
+
+            # ---- phase 2: chaos soak ----
+            # closed-loop calibration: the read ceiling the offered
+            # rate anchors against
+            all_fids = [
+                (tidx, fid, url, idx)
+                for tidx in range(tenants)
+                for fid, url, idx in fids[tidx]
+            ]
+            cal_hist = LogHistogram()
+            cal_q = list(range(0, len(all_fids), max(
+                1, len(all_fids) // 1200
+            )))[:1200]
+            t0 = time.perf_counter()
+
+            async def cal_worker() -> None:
+                while cal_q:
+                    k = cal_q.pop()
+                    tidx, fid, url, idx = all_fids[k]
+                    t1 = time.perf_counter()
+                    st, _b = await http.request(
+                        "GET", url, "/" + fid, timeout=read_timeout_s
+                    )
+                    if st == 200:
+                        cal_hist.record(time.perf_counter() - t1)
+
+            n_cal = len(cal_q)
+            await asyncio.gather(*(cal_worker() for _ in range(16)))
+            ceiling = n_cal / max(time.perf_counter() - t0, 1e-9)
+            out["closed_loop_ceiling_qps"] = round(ceiling)
+            offered = max(50.0, ceiling * offered_fraction)
+            out["offered_qps"] = round(offered)
+
+            # seeded process-fault schedule: restart/pause cycles over
+            # the volume fleet + one hard filer kill, reproducible from
+            # `seed` alone (regenerated + compared below)
+            vol_targets = [f"volume-{i}" for i in range(volumes)]
+
+            def build_schedule() -> list:
+                sched = process_fault_schedule(
+                    seed, vol_targets, soak_window_s * 0.75,
+                    count=fault_count, kinds=("restart", "pause"),
+                    start_s=soak_window_s * 0.1, pause_s=1.0,
+                )
+                if filers >= 2:
+                    sched += process_fault_schedule(
+                        seed + 1, [f"filer-{filers - 1}"],
+                        soak_window_s * 0.5, count=1, kinds=("kill",),
+                        start_s=soak_window_s * 0.2,
+                    )
+                return sorted(
+                    sched, key=lambda f: (f.at_s, f.target, f.kind)
+                )
+
+            schedule = build_schedule()
+            out["fault_schedule"] = process_schedule_to_dicts(schedule)
+            out["schedule_reproducible"] = (
+                process_schedule_to_dicts(build_schedule())
+                == out["fault_schedule"]
+            )
+
+            zipf = ZipfKeys(
+                len(all_fids), s=1.1, seed=seed, cold_fraction=0.05
+            )
+            n_arr = arrival_count(offered, soak_window_s)
+            keys = zipf.draw(n_arr).tolist()
+            rng = np.random.default_rng(seed)
+            is_write = (rng.random(n_arr) < write_mix).tolist()
+            chaos_writes = []  # (tidx, marker_idx, fid, url)
+            wctr = [0]
+            read_ok = LogHistogram()
+            fg_errors = [0]
+
+            async def soak_op(i: int) -> bool:
+                if is_write[i]:
+                    # foreground write stream: new keys keep arriving
+                    # while processes die — landed fids are verified
+                    # at quiesce
+                    tidx = i % tenants
+                    widx = (1 << 52) | wctr[0]
+                    wctr[0] += 1
+                    try:
+                        ar = await lease.take()
+                        st, _ = await http.request(
+                            "POST", ar.url, "/" + ar.fid,
+                            body=payload(tidx, widx, key_bytes),
+                            content_type="application/octet-stream",
+                            headers={"X-Seaweed-Tenant": names[tidx]},
+                            timeout=read_timeout_s,
+                        )
+                    except Exception:
+                        fg_errors[0] += 1
+                        return False
+                    if st == 201:
+                        chaos_writes.append(
+                            (tidx, widx, ar.fid, ar.url)
+                        )
+                        return True
+                    fg_errors[0] += 1
+                    return False
+                tidx, fid, url, idx = all_fids[keys[i]]
+                t1 = time.perf_counter()
+                try:
+                    st, body_b = await http.request(
+                        "GET", url, "/" + fid, timeout=read_timeout_s
+                    )
+                except Exception:
+                    fg_errors[0] += 1
+                    return False
+                if st != 200:
+                    fg_errors[0] += 1
+                    return False
+                if body_b != payload(tidx, idx, key_bytes):
+                    violations[0] += 1
+                    return False
+                read_ok.record(time.perf_counter() - t1)
+                return True
+
+            cluster.run_fault_schedule(schedule)
+            res = await run_open_loop(
+                soak_op, rate=offered, duration=soak_window_s,
+                seed=seed, workers=128,
+            )
+            cluster.join_fault_schedule(timeout=soak_window_s + 60)
+            out["soak"] = res.summary()
+            out["soak"]["service_rtt"] = read_ok.summary_ms()
+            out["soak"]["errors"] = fg_errors[0]
+            out["chaos_writes_landed"] = len(chaos_writes)
+            goodput = res.completed / max(res.duration, 1e-9)
+            out["goodput_qps"] = round(goodput)
+            out["goodput_over_offered"] = round(
+                goodput / max(offered, 1e-9), 3
+            )
+            out["fg_p99_ms"] = out["soak"]["p99_ms"]
+
+            # ---- phase 3: quiesce + SLO scoring ----
+            # every pause has resumed (driver joined + resume timers
+            # are schedule-bounded); give straggling SIGCONTs a beat
+            await asyncio.sleep(1.5)
+            out["fault_events"] = cluster.fault_events
+            fired = [
+                e for e in cluster.fault_events if "error" not in e
+            ]
+            kinds_fired = sorted({e["kind"] for e in fired})
+            out["process_faults_fired"] = len(fired)
+            out["process_fault_kinds"] = kinds_fired
+            restarted = [
+                e for e in fired
+                if e["kind"] == "restart" and e.get("pid_after")
+            ]
+            out["sigkill_recovered"] = bool(
+                restarted
+                and all(
+                    e["pid_after"] != e["pid_before"] for e in restarted
+                )
+            )
+
+            # post-chaos byte identity: a sample per tenant THROUGH the
+            # restarted processes, plus every landed chaos write
+            post_verified = 0
+            for tidx in range(tenants):
+                for fid, url, idx in fids[tidx][:24]:
+                    st, body_b = await http.request(
+                        "GET", url, "/" + fid, timeout=read_timeout_s
+                    )
+                    if st != 200:
+                        fg_errors[0] += 1
+                        continue
+                    if body_b != payload(tidx, idx, key_bytes):
+                        violations[0] += 1
+                    post_verified += 1
+            for tidx, widx, fid, url in chaos_writes[:256]:
+                st, body_b = await http.request(
+                    "GET", url, "/" + fid, timeout=read_timeout_s
+                )
+                if st != 200:
+                    fg_errors[0] += 1
+                    continue
+                if body_b != payload(tidx, widx, key_bytes):
+                    violations[0] += 1
+                post_verified += 1
+            out["post_chaos_reads_verified"] = post_verified
+
+            # S3 read-back (isolation-scoped creds, byte-verified)
+            s3_verified = 0
+            for tidx in range(tenants):
+                n = names[tidx]
+                for i in s3_objs[tidx][:50]:
+                    url = f"http://{s3addr}/soak-{n}/k{i:08d}"
+                    st, body_b = await http.request(
+                        "GET", s3addr, f"/soak-{n}/k{i:08d}",
+                        headers=signed_headers("GET", url, b"", n),
+                    )
+                    if st != 200:
+                        errors[0] += 1
+                        continue
+                    if body_b != payload(
+                        tidx, (1 << 48) | i, s3_obj_bytes
+                    ):
+                        violations[0] += 1
+                    s3_verified += 1
+            out["s3_reads_verified"] = s3_verified
+            out["identity_violations"] = violations[0]
+            out["isolation_violations"] = isolation_violations[0]
+
+            # maintenance queues drained: poll the master's queue-depth
+            # gauges to 0 (scrape = the only window into a subprocess)
+            queue_metrics = {
+                "repair": "seaweedfs_tpu_repair_queue_depth",
+                "vacuum": "seaweedfs_tpu_vacuum_queue_depth",
+                "lifecycle": "seaweedfs_tpu_lifecycle_queue_depth",
+            }
+            deadline = time.monotonic() + quiesce_timeout_s
+            depths = {}
+            while True:
+                m = cluster.scrape_metrics("master")
+                depths = {
+                    k: sum_metric(m, v)
+                    for k, v in queue_metrics.items()
+                }
+                if all(v == 0 for v in depths.values()):
+                    break
+                if time.monotonic() > deadline:
+                    break
+                await asyncio.sleep(0.5)
+            out["queue_depths_at_quiesce"] = depths
+            out["queues_drained"] = all(
+                v == 0 for v in depths.values()
+            )
+
+            # plane activity + bloom disclosure from the live children
+            mm = cluster.scrape_metrics("master")
+            planes = {
+                "faults_injected": 0.0,
+                "scrub_bytes": 0.0,
+                "resyncs": sum_metric(
+                    mm, "seaweedfs_tpu_antientropy_resyncs_total"
+                ),
+            }
+            bloom = {
+                "runs": 0, "runs_with_filter": 0, "probes": 0,
+                "negatives": 0,
+            }
+            for i in range(volumes):
+                name = f"volume-{i}"
+                if not cluster.children[name].alive():
+                    continue
+                vm = cluster.scrape_metrics(name)
+                planes["faults_injected"] += sum_metric(
+                    vm, "seaweedfs_tpu_faults_injected_total"
+                )
+                planes["scrub_bytes"] += sum_metric(
+                    vm, "seaweedfs_tpu_scrub_bytes_total"
+                )
+                try:
+                    nm = cluster.debug_json(name, "/debug/needle_map")
+                    for k in bloom:
+                        bloom[k] += nm["aggregate"].get(k, 0)
+                except Exception:
+                    pass
+            bloom["filter_hit_rate"] = (
+                round(bloom["negatives"] / bloom["probes"], 4)
+                if bloom["probes"] else 0.0
+            )
+            out["plane_activity"] = planes
+            out["bloom"] = bloom
+
+            # ---- SLO scorecard ----
+            out["slo"] = {
+                "goodput_floor": goodput_floor,
+                "goodput_ok": bool(
+                    out["goodput_over_offered"] >= goodput_floor
+                ),
+                "p99_ceiling_ms": p99_ceiling_ms,
+                "p99_ok": bool(out["fg_p99_ms"] <= p99_ceiling_ms),
+                "identity_violations": violations[0],
+                "isolation_violations": isolation_violations[0],
+                "queues_drained": out["queues_drained"],
+                "faults_fired": len(fired),
+                "sigkill_recovered": out["sigkill_recovered"],
+            }
+            out["slo"]["pass"] = bool(
+                out["slo"]["goodput_ok"]
+                and out["slo"]["p99_ok"]
+                and violations[0] == 0
+                and isolation_violations[0] == 0
+                and out["queues_drained"]
+                and len(fired) >= 2
+                and out["sigkill_recovered"]
+            )
+        finally:
+            await http.close()
+
+    try:
+        asyncio.run(body())
+    except Exception as e:
+        out.setdefault("error", f"{type(e).__name__}: {e}")
+    finally:
+        cluster.stop()
         if saved_breaker is None:
             os.environ.pop("SEAWEEDFS_TPU_BREAKER", None)
         else:
@@ -6715,6 +7370,66 @@ def main() -> None:
         pass
     except Exception as e:
         extra.append({"metric": "soak.multi_tenant", "error": str(e)[:200]})
+
+    try:
+        if not budgeted("soak.production", 240):
+            raise _Skip()
+        pk = measure_production_soak(
+            total_keys=int(
+                os.environ.get("BENCH_PROD_SOAK_KEYS", 10_000_000)
+            ),
+            tenants=int(os.environ.get("BENCH_PROD_SOAK_TENANTS", 16)),
+            volumes=int(os.environ.get("BENCH_PROD_SOAK_VOLUMES", 3)),
+            soak_window_s=float(
+                os.environ.get("BENCH_PROD_SOAK_WINDOW_S", 60.0)
+            ),
+            time_cap_s=min(540.0, max(180.0, remaining() - 90.0)),
+        )
+        slo = pk.get("slo", {})
+        extra.append(
+            {
+                "metric": "soak.production",
+                "value": pk.get("goodput_over_offered"),
+                "unit": "goodput/offered",
+                "vs_baseline": 1.0 if slo.get("pass") else 0.0,
+                "keys_written": pk.get("keys_written"),
+                "process_faults_fired": pk.get("process_faults_fired"),
+                "sigkill_recovered": pk.get("sigkill_recovered"),
+                "identity_violations": pk.get("identity_violations"),
+                "isolation_violations": pk.get("isolation_violations"),
+                "queues_drained": pk.get("queues_drained"),
+                "schedule_reproducible": pk.get(
+                    "schedule_reproducible"
+                ),
+                "fg_p99_ms": pk.get("fg_p99_ms"),
+                "bloom": pk.get("bloom"),
+                "time_capped": pk.get("time_capped"),
+                "detail": pk,
+                "note": "production chaos soak (ISSUE 16 tentpole): ONE "
+                "sustained SLO-scored run over a REAL multi-process "
+                "cluster (master + volume fleet + filer fleet + S3 "
+                "gateway + blob cold tier, each its own OS process via "
+                "ops/proc_cluster) with ALL background planes live "
+                "(repair, vacuum, lifecycle/cold tier, scrub) while a "
+                "SEEDED process-fault schedule SIGKILLs+respawns and "
+                "SIGSTOPs volume servers and hard-kills a filer; value "
+                "= goodput/offered during the chaos window (open-loop "
+                "zipf, CO-corrected percentiles); vs_baseline = 1 only "
+                "if EVERY SLO term holds: goodput floor, fg p99 "
+                "ceiling, ZERO byte-identity violations, ZERO "
+                "tenant-isolation violations (cross-tenant signed GETs "
+                "denied by bucket-scoped IAM), all maintenance queues "
+                "drained at quiesce, >= 2 process faults fired with "
+                "SIGKILL recovery, and the fault schedule regenerates "
+                "bit-identically from its seed; detail.bloom is the "
+                "per-run LSM bloom consultation tail scraped from each "
+                "volume process's /debug/needle_map",
+            }
+        )
+    except _Skip:
+        pass
+    except Exception as e:
+        extra.append({"metric": "soak.production", "error": str(e)[:200]})
 
     try:
         if not budgeted("serving_write_budget", 25):

@@ -1,7 +1,7 @@
 """`weed-tpu` multi-command CLI (ref: weed/command/command.go:10-31).
 
-Commands: master, volume, server (combined), shell, benchmark, upload,
-download, export, fix, compact, scaffold, version.
+Commands: master, volume, server (combined), filer, s3, blob, shell,
+benchmark, upload, download, export, fix, compact, scaffold, version.
 """
 
 from __future__ import annotations
@@ -41,6 +41,13 @@ def _add_master_flags(p: argparse.ArgumentParser) -> None:
         default="",
         help="persist raft term/vote/max-volume-id to this path so a "
         "restarted master cannot double-vote in its term; '' = in-memory",
+    )
+    p.add_argument(
+        "-tierConfig",
+        default="",
+        help="JSON file configuring storage.backend tiers; the master "
+        "snapshots backends registered at start and pushes them to "
+        "volume servers via heartbeat responses (ref backend.go:77-95)",
     )
 
 
@@ -172,15 +179,7 @@ def _apply_config_defaults(
 def _build_volume_server(args, port_offset: int = 0):
     from ..server.volume import VolumeServer
 
-    tier_cfg = getattr(args, "tierConfig", "")
-    if tier_cfg:
-        import json
-
-        from ..storage.tier_backend import load_from_config
-
-        with open(tier_cfg) as f:
-            load_from_config(json.load(f))
-
+    _load_tier_config(getattr(args, "tierConfig", ""))
     dirs = args.dir.split(",")
     maxes = [int(m) for m in args.max.split(",")]
     if len(maxes) == 1:
@@ -202,6 +201,7 @@ def _build_volume_server(args, port_offset: int = 0):
             x for x in getattr(args, "whiteList", "").split(",") if x
         ),
         batch_lookup=getattr(args, "batchLookup", "off"),
+        **_pulse_kwargs(),
     )
 
 
@@ -214,6 +214,30 @@ async def _run_forever(*servers) -> None:
     finally:
         for s in servers:
             await s.stop()
+
+
+def _pulse_kwargs() -> dict:
+    """SEAWEEDFS_TPU_PULSE_SECONDS -> pulse_seconds for master/volume.
+    The heartbeat cadence is an in-process constructor knob the bench
+    legs tune (0.2s clusters converge in tier-1 budgets); subprocess
+    clusters (ops/proc_cluster.py) reach it only through the child's
+    environment, so the CLI honors the env var instead of growing a
+    flag every spawner must thread through."""
+    v = os.environ.get("SEAWEEDFS_TPU_PULSE_SECONDS", "").strip()
+    if not v:
+        return {}
+    return {"pulse_seconds": float(v)}
+
+
+def _load_tier_config(path: str) -> None:
+    if not path:
+        return
+    import json
+
+    from ..storage.tier_backend import load_from_config
+
+    with open(path) as f:
+        load_from_config(json.load(f))
 
 
 def _maintenance_kwargs(cfg) -> dict:
@@ -237,6 +261,7 @@ def cmd_master(argv: list[str]) -> int:
     args = p.parse_args(argv)
     from ..server.master import MasterServer
 
+    _load_tier_config(getattr(args, "tierConfig", ""))
     ms = MasterServer(
         host=args.ip,
         port=args.port,
@@ -248,6 +273,7 @@ def cmd_master(argv: list[str]) -> int:
         sequencer_file=args.sequencerFile,
         raft_state_file=args.raftStateFile,
         **_maintenance_kwargs(cfg),
+        **_pulse_kwargs(),
     )
     print(f"master listening on {args.ip}:{args.port}")
     asyncio.run(_run_forever(ms))
@@ -291,7 +317,7 @@ def cmd_server(argv: list[str]) -> int:
         help="micro-batch concurrent read index probes through one "
         "vectorized bulk lookup (device IndexSnapshot when attached)",
     )
-    p.add_argument("-tierConfig", default="")
+    # -tierConfig comes from _add_master_flags (shared with cmd_master)
     p.add_argument(
         "-index", default="memory",
         choices=["memory", "leveldb", "sorted", "lsm"],
@@ -529,6 +555,24 @@ def cmd_s3(argv: list[str]) -> int:
     s3 = S3Server(fs, host=args.ip, port=args.port, iam=iam)
     print(f"s3 gateway on {args.ip}:{args.port} (filer on :{args.filerPort})")
     asyncio.run(_run_forever(fs, s3))
+    return 0
+
+
+def cmd_blob(argv: list[str]) -> int:
+    """In-tree blob server (server/blob.py): the cold tier's stand-in
+    object store as a standalone process, so multi-process clusters
+    (ops/proc_cluster.py) get a remote tier that is subject to the same
+    process-level chaos as every other role."""
+    p = argparse.ArgumentParser(prog="weed-tpu blob")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8334)
+    p.add_argument("-dir", default="./blob", help="blob storage directory")
+    args = p.parse_args(argv)
+    from ..server.blob import BlobServer
+
+    bs = BlobServer(args.dir, args.port, host=args.ip)
+    print(f"blob server listening on {args.ip}:{args.port}")
+    asyncio.run(_run_forever(bs))
     return 0
 
 
@@ -1367,6 +1411,7 @@ COMMANDS = {
     "server": cmd_server,
     "filer": cmd_filer,
     "s3": cmd_s3,
+    "blob": cmd_blob,
     "webdav": cmd_webdav,
     "msgBroker": cmd_msg_broker,
     "shell": cmd_shell,

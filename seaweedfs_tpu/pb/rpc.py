@@ -240,6 +240,15 @@ _KEEPALIVE_OPTIONS = [
     ("grpc.keepalive_timeout_ms", 10_000),
     ("grpc.max_send_message_length", 64 << 20),
     ("grpc.max_receive_message_length", 64 << 20),
+    # cached channels survive peer crashes; grpc's default reconnect
+    # backoff grows to 120s, which would leave a KILLed-and-respawned
+    # peer unreachable through its cached channel long after it is back
+    # up. Cap the backoff at 2s so recovery time is set by the process
+    # restart, not by a client-side timer (the per-peer breaker still
+    # sheds while the peer is actually down).
+    ("grpc.initial_reconnect_backoff_ms", 100),
+    ("grpc.min_reconnect_backoff_ms", 100),
+    ("grpc.max_reconnect_backoff_ms", 2_000),
 ]
 
 

@@ -66,7 +66,7 @@ _set_tenant = tenancy.set_current
 _reset_tenant = tenancy.reset_current
 
 
-def _make_debug_middleware(name: str, address: str, pprof=None):
+def _make_debug_middleware(name: str, address: str, pprof=None, ext=None):
     """Cold-tier middleware serving the shared observability surface and
     re-joining traces on fallback-replayed requests.
 
@@ -80,7 +80,9 @@ def _make_debug_middleware(name: str, address: str, pprof=None):
     async def middleware(request, handler):
         path = request.path
         if path == "/metrics" or path.startswith("/debug/"):
-            return await _serve_debug(name, address, request, path, pprof)
+            return await _serve_debug(
+                name, address, request, path, pprof, ext
+            )
         tp = request.headers.get("traceparent")
         if tp is None:
             return await handler(request)
@@ -112,7 +114,16 @@ def _make_debug_middleware(name: str, address: str, pprof=None):
 
 
 async def _serve_debug(name: str, address: str, request, path: str,
-                       pprof=None):
+                       pprof=None, ext=None):
+    # server-specific debug extensions (e.g. the volume server's
+    # /debug/needle_map bloom-sidecar disclosure). Checked FIRST so an
+    # extension can also specialize a shared path; handlers must close
+    # over leaf state (a store, not the server) — see the middleware
+    # factory's cycle warning.
+    if ext:
+        handler_fn = ext.get(path)
+        if handler_fn is not None:
+            return await handler_fn(request)
     if path == "/metrics":
         from ..util.metrics import REGISTRY
 
@@ -201,8 +212,14 @@ class ServingCore:
     cold tier every FALLBACK replays against."""
 
     def __init__(self, name: str, handler, host: str, port: int,
-                 pprof=None, tenant_fn=None):
+                 pprof=None, tenant_fn=None, debug_handlers=None):
         self.name = name
+        # extra /debug/* paths this server exposes: {path: async handler}.
+        # Handlers must close over leaf state only (a Store, a registry)
+        # — never the server object — so the middleware closure does not
+        # resurrect the app->core->runner->app cycle documented on
+        # _make_debug_middleware.
+        self.debug_handlers = debug_handlers or None
         self.handler = handler
         self.host = host
         self.port = port
@@ -239,7 +256,9 @@ class ServingCore:
 
     async def start(self, app: web.Application) -> None:
         app.middlewares.append(
-            _make_debug_middleware(self.name, self.address, self.pprof)
+            _make_debug_middleware(
+                self.name, self.address, self.pprof, self.debug_handlers
+            )
         )
         self._http_runner = web.AppRunner(app, access_log=None)
         await self._http_runner.setup()

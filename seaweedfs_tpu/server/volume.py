@@ -269,6 +269,39 @@ def _decode_keys(req: dict):
     return np.asarray(raw, dtype=np.uint64)
 
 
+def _make_needle_map_debug(store):
+    """/debug/needle_map handler: per-volume + aggregate bloom-sidecar
+    economics (LsmNeedleMap.bloom_stats) for every live volume whose map
+    kind carries filters. A plain closure over the Store so the debug
+    middleware holds leaf state, never the server object (cycle warning
+    on serving_core._make_debug_middleware)."""
+
+    async def handler(request):
+        per_volume = {}
+        agg = {"runs": 0, "runs_with_filter": 0, "probes": 0,
+               "negatives": 0}
+        for loc in store.locations:
+            for vid, v in list(loc.volumes.items()):
+                stats_fn = getattr(v.nm, "bloom_stats", None)
+                if stats_fn is None:
+                    continue
+                st = stats_fn()
+                per_volume[str(vid)] = st
+                for k in agg:
+                    agg[k] += st.get(k, 0)
+        agg["filter_hit_rate"] = (
+            round(agg["negatives"] / agg["probes"], 4)
+            if agg["probes"] else 0.0
+        )
+        return web.json_response({
+            "kind": store.needle_map_kind,
+            "aggregate": agg,
+            "volumes": per_volume,
+        })
+
+    return handler
+
+
 class VolumeServer(EcHandlers):
     def __init__(
         self,
@@ -404,6 +437,14 @@ class VolumeServer(EcHandlers):
             "volume", self._fast_dispatch, self.host, self.port,
             pprof=True if self.pprof else None,
             tenant_fn=self._tenant_fn,
+            # bloom-sidecar economics per live volume (closes over the
+            # store, not the server — see ServingCore.debug_handlers):
+            # multi-run LSM maps appear under sustained load, and the
+            # soak harness scrapes this to disclose sidecar hit rates
+            # from OUTSIDE the process
+            debug_handlers={
+                "/debug/needle_map": _make_needle_map_debug(self.store)
+            },
         )
         await self._core.start(app)
         self._fast_server = self._core.fast_server

@@ -460,6 +460,101 @@ def apply_bitflip(ev: FaultEvent, data, file_offset: int = 0) -> bytes:
     return bytes(buf)
 
 
+# ------------------------------------------------ process-level faults --
+#
+# The seams above fire INSIDE a process; chaos soaks against real
+# subprocess clusters (ops/proc_cluster.py) also need faults delivered TO
+# processes: SIGKILL (machine loss), SIGSTOP/SIGCONT (a wedged or
+# GC-storming peer — the process-level brownout), and kill+respawn
+# (restart-with-recovery). A `ProcessFault` is one scheduled delivery; a
+# schedule is generated deterministically from a seed with the same
+# per-slot RNG discipline as FaultPlan rules, so a soak run's process
+# chaos is bit-reproducible from (seed, targets, duration) alone. The
+# schedule serializes like a plan (to_dict/from_dict) so the driver that
+# owns the PIDs — never this module — executes it.
+
+PROCESS_FAULT_KINDS = ("kill", "pause", "restart")
+
+
+@dataclass
+class ProcessFault:
+    """One scheduled process-level fault.
+
+    kind: "kill" (SIGKILL, no respawn), "pause" (SIGSTOP, SIGCONT after
+    duration_s), "restart" (SIGKILL, respawn after duration_s, wait
+    ready). target names a process in the owning cluster fixture
+    ("volume-1"), at_s is seconds after schedule start."""
+
+    at_s: float
+    kind: str
+    target: str
+    duration_s: float = 0.0
+
+    def to_dict(self) -> dict:
+        d = {"at_s": self.at_s, "kind": self.kind, "target": self.target}
+        if self.duration_s:
+            d["duration_s"] = self.duration_s
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ProcessFault":
+        return cls(
+            at_s=float(d["at_s"]),
+            kind=str(d["kind"]),
+            target=str(d["target"]),
+            duration_s=float(d.get("duration_s", 0.0)),
+        )
+
+
+def process_fault_schedule(
+    seed: int,
+    targets: list[str],
+    duration_s: float,
+    count: int = 3,
+    kinds: tuple = PROCESS_FAULT_KINDS,
+    start_s: float = 0.0,
+    pause_s: float = 1.0,
+    restart_s: float = 0.0,
+) -> list[ProcessFault]:
+    """Deterministic process-fault schedule: `count` faults over
+    [start_s, duration_s), each drawn from its OWN seeded stream
+    (Random(f"{seed}:proc:{i}")) so fault i's (time, kind, target) is
+    independent of how many faults precede it — the FaultPlan per-rule
+    discipline applied to the process dimension. Same arguments, same
+    schedule, bit-for-bit; kinds cycle so every requested kind appears
+    before any repeats (a 2-fault schedule over ("kill", "pause") always
+    carries one of each — acceptance gates like ">= 1 SIGKILL" hold by
+    construction, with the seed choosing victims and times)."""
+    if not targets or count <= 0 or not kinds:
+        return []
+    faults = []
+    span = max(duration_s - start_s, 0.0)
+    for i in range(count):
+        rng = Random(f"{seed}:proc:{i}")
+        at = start_s + span * (i + rng.random()) / count
+        kind = kinds[i % len(kinds)]
+        f = ProcessFault(
+            at_s=round(at, 3),
+            kind=kind,
+            target=rng.choice(list(targets)),
+        )
+        if kind == "pause":
+            f.duration_s = round(pause_s * (0.5 + rng.random()), 3)
+        elif kind == "restart":
+            f.duration_s = round(restart_s, 3)
+        faults.append(f)
+    faults.sort(key=lambda f: (f.at_s, f.target, f.kind))
+    return faults
+
+
+def process_schedule_to_dicts(schedule: list[ProcessFault]) -> list[dict]:
+    return [f.to_dict() for f in schedule]
+
+
+def process_schedule_from_dicts(dicts: list[dict]) -> list[ProcessFault]:
+    return [ProcessFault.from_dict(d) for d in dicts]
+
+
 async def async_fault(
     plan: FaultPlan, op: str, target: str, timeout: Optional[float] = None
 ) -> Optional[FaultEvent]:

@@ -369,6 +369,56 @@ def test_multitenant_soak_leg_shape():
     assert sk["time_capped"] is False
 
 
+def test_production_soak_leg_shape():
+    """ISSUE 16 guard: a quick-budget soak.production run must stand up
+    a REAL subprocess cluster (distinct PIDs per role), fire >= 2
+    seeded process faults including >= 1 SIGKILL with recovery (new
+    pid, data intact), finish with ZERO byte-identity violations, ZERO
+    tenant-isolation violations, every maintenance queue drained, and
+    a fault schedule that regenerates bit-identically from its seed.
+    Goodput/p99 are disclosed SLO terms, not asserted at this scale."""
+    pk = bench.measure_production_soak(
+        total_keys=3000,
+        tenants=4,
+        volumes=2,
+        filers=2,
+        soak_window_s=7.0,
+        fault_count=2,
+        write_workers=4,
+        batch=128,
+        quiesce_timeout_s=30.0,
+        time_cap_s=240.0,
+    )
+    assert "error" not in pk, pk.get("error")
+    # real processes, one per role
+    assert pk["distinct_pids"] is True
+    assert len(pk["pids"]) >= 2 + 2 + 2  # master+blob, volumes, filers
+    assert pk["keys_written"] >= 3000 * 0.9
+    assert pk["s3_keys_written"] > 0
+    # seeded chaos actually happened, with hard-kill recovery
+    assert pk["process_faults_fired"] >= 2
+    assert pk["sigkill_recovered"] is True
+    assert pk["schedule_reproducible"] is True
+    # SLO invariants that hold at ANY scale
+    assert pk["identity_violations"] == 0
+    assert pk["isolation_violations"] == 0
+    assert pk["isolation_probes"] > 0
+    assert pk["isolation_denied"] == pk["isolation_probes"]
+    assert pk["queues_drained"] is True
+    assert pk["post_chaos_reads_verified"] > 0
+    assert pk["s3_reads_verified"] > 0
+    # disclosed terms present and non-degenerate
+    assert pk["goodput_qps"] > 0
+    assert pk["fg_p99_ms"] > 0
+    assert pk["soak"]["completed"] > 0
+    assert pk["slo"]["goodput_floor"] > 0
+    assert "pass" in pk["slo"]
+    # bloom consultation tail disclosed from the volume processes
+    assert pk["bloom"]["runs"] >= 1
+    assert "filter_hit_rate" in pk["bloom"]
+    assert pk["time_capped"] is False
+
+
 def test_trace_overhead_leg_shape():
     """ISSUE 8 guard: the serving.trace_overhead leg must emit BOTH QPS
     numbers (tracing-off and tracing-on-at-1%) with their ratio, and the

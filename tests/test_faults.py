@@ -448,3 +448,74 @@ def test_cluster_chaos_read_workload(tmp_path):
             await cluster.stop()
 
     asyncio.run(body())
+
+
+# ---------------- process-level fault schedules (ISSUE 16) ----------------
+
+
+def test_process_fault_schedule_deterministic():
+    """The chaos soak's reproducibility claim: same (seed, targets,
+    window) regenerates the IDENTICAL schedule, different seeds don't."""
+    from seaweedfs_tpu.util.faults import (
+        process_fault_schedule,
+        process_schedule_to_dicts,
+    )
+
+    targets = ["volume-0", "volume-1", "volume-2"]
+    a = process_fault_schedule(7, targets, 60.0, count=6)
+    b = process_fault_schedule(7, targets, 60.0, count=6)
+    assert process_schedule_to_dicts(a) == process_schedule_to_dicts(b)
+    c = process_fault_schedule(8, targets, 60.0, count=6)
+    assert process_schedule_to_dicts(a) != process_schedule_to_dicts(c)
+
+
+def test_process_fault_schedule_kinds_cycle():
+    """Every requested kind appears before any repeats — the guarantee
+    the soak leans on for '>= 1 SIGKILL with recovery'."""
+    from seaweedfs_tpu.util.faults import process_fault_schedule
+
+    sched = process_fault_schedule(
+        3, ["volume-0"], 30.0, count=3, kinds=("kill", "pause", "restart")
+    )
+    assert sorted(f.kind for f in sched) == ["kill", "pause", "restart"]
+    only_restart = process_fault_schedule(
+        3, ["volume-0"], 30.0, count=2, kinds=("restart",)
+    )
+    assert {f.kind for f in only_restart} == {"restart"}
+
+
+def test_process_fault_schedule_shape():
+    from seaweedfs_tpu.util.faults import (
+        PROCESS_FAULT_KINDS,
+        process_fault_schedule,
+    )
+
+    sched = process_fault_schedule(
+        11, ["volume-0", "filer-1"], 45.0, count=8, start_s=5.0
+    )
+    assert len(sched) == 8
+    assert sched == sorted(sched, key=lambda f: (f.at_s, f.target, f.kind))
+    for f in sched:
+        assert 5.0 <= f.at_s <= 50.0
+        assert f.kind in PROCESS_FAULT_KINDS
+        assert f.target in ("volume-0", "filer-1")
+        if f.kind == "pause":
+            assert f.duration_s > 0
+
+
+def test_process_fault_serialization_round_trip():
+    from seaweedfs_tpu.util.faults import (
+        process_fault_schedule,
+        process_schedule_from_dicts,
+        process_schedule_to_dicts,
+    )
+
+    sched = process_fault_schedule(21, ["volume-0", "volume-1"], 40.0,
+                                   count=5)
+    dicts = process_schedule_to_dicts(sched)
+    back = process_schedule_from_dicts(dicts)
+    assert process_schedule_to_dicts(back) == dicts
+    # json-clean: the soak publishes the schedule in its result dict
+    import json as _json
+
+    assert _json.loads(_json.dumps(dicts)) == dicts

@@ -1,0 +1,126 @@
+"""Subprocess cluster fixture lifecycle edges (ISSUE 16).
+
+The chaos soak's value rests on the fixture's guarantees, so each one
+gets a direct test: readiness failure modes raise StartupError (with
+the child's log tail) AND leave no orphaned processes; teardown on an
+exception inside the `with` body reaps every child; a fault plan keyed
+by role reaches exactly the children of that role through the
+SEAWEEDFS_TPU_FAULTS env seam (asserted by scraping faults_injected
+out of the CHILD's /metrics — the only window into another process);
+and SIGKILL + respawn comes back as a NEW pid serving the same port.
+"""
+
+import os
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.ops.proc_cluster import (
+    ProcCluster,
+    StartupError,
+    sum_metric,
+)
+from seaweedfs_tpu.util.faults import FaultPlan, FaultRule
+
+
+def _gone(pid: int, wait_s: float = 5.0) -> bool:
+    """True once `pid` no longer exists (zombies already reaped by the
+    fixture's wait())."""
+    deadline = time.monotonic() + wait_s
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _collect_pids(cluster: ProcCluster) -> list:
+    return [
+        c.proc.pid for c in cluster.children.values() if c.proc is not None
+    ]
+
+
+def test_readiness_timeout_raises_and_reaps(tmp_path):
+    # a deadline no python process can meet: the probe must time out,
+    # name the child, include its log tail, and reap what was spawned
+    cluster = ProcCluster(str(tmp_path), volumes=0, ready_timeout=0.05)
+    with pytest.raises(StartupError) as ei:
+        cluster.start()
+    assert "not ready" in str(ei.value)
+    for pid in _collect_pids(cluster):
+        assert _gone(pid), f"orphaned child pid {pid} after timeout"
+
+
+def test_child_death_during_startup_raises_and_reaps(tmp_path):
+    # a non-numeric pulse makes every child die in arg parsing
+    # (float() raises) — the probe must report the EXIT, not wait out
+    # the full readiness deadline
+    cluster = ProcCluster(
+        str(tmp_path), volumes=0, pulse_seconds="bogus", ready_timeout=30.0
+    )
+    t0 = time.monotonic()
+    with pytest.raises(StartupError) as ei:
+        cluster.start()
+    assert "exited" in str(ei.value)
+    assert time.monotonic() - t0 < 20.0, "waited out deadline on a corpse"
+    for pid in _collect_pids(cluster):
+        assert _gone(pid), f"orphaned child pid {pid} after startup death"
+
+
+def test_teardown_on_exception_leaves_no_orphans(tmp_path):
+    pids = []
+    with pytest.raises(RuntimeError):
+        with ProcCluster(str(tmp_path), volumes=1) as cluster:
+            pids = _collect_pids(cluster)
+            assert len(pids) >= 2  # master + volume at minimum
+            raise RuntimeError("body blew up mid-test")
+    assert pids, "cluster never started"
+    for pid in pids:
+        assert _gone(pid), f"orphaned child pid {pid} after exception"
+
+
+def test_fault_plan_env_reaches_role_children_only(tmp_path):
+    # plan keyed by ROLE: the volume child must load it from
+    # SEAWEEDFS_TPU_FAULTS at import and fire it; the master (no plan)
+    # must fire nothing — proven via each child's own /metrics
+    plan = FaultPlan(
+        seed=0xBEEF,
+        rules=[
+            FaultRule(
+                op="http:GET", target="*", nth=1,
+                fault="latency", delay=0.005,
+            )
+        ],
+    )
+    with ProcCluster(
+        str(tmp_path), volumes=1, fault_plans={"volume": plan}
+    ) as cluster:
+        addr = cluster.address("volume-0")
+        # any GET at the volume trips the nth=1 latency rule
+        with urllib.request.urlopen(
+            f"http://{addr}/status", timeout=5
+        ) as r:
+            assert r.status == 200
+        fired = sum_metric(
+            cluster.scrape_metrics("volume-0"),
+            "seaweedfs_tpu_faults_injected_total",
+        )
+        assert fired >= 1, "seeded fault plan never fired in the child"
+        master_fired = sum_metric(
+            cluster.scrape_metrics("master"),
+            "seaweedfs_tpu_faults_injected_total",
+        )
+        assert master_fired == 0, "plan leaked into a role without one"
+
+
+def test_restart_recovers_with_new_pid(tmp_path):
+    with ProcCluster(str(tmp_path), volumes=1) as cluster:
+        before = cluster.children["volume-0"].pid
+        served_before = cluster.served_pid("volume-0")
+        assert served_before == before
+        after = cluster.restart("volume-0")
+        assert after != before
+        assert cluster.served_pid("volume-0") == after
