@@ -44,12 +44,17 @@ DEFAULT_CHUNK = 4 * 1024 * 1024  # per-shard streaming chunk
 # benchmark/diagnostic introspection, not part of the encode contract
 LAST_ROUTE: dict = {}
 
-# per-stage wall seconds of the last write_ec_files run (read / kernel /
-# shard-write, or fused/splice where stages aren't separable). Diagnostic
-# only — filled by the NON-pipelined row encoders (the pipelined device
-# path overlaps stages, so per-stage walls would double-count there) and
-# not synchronized across concurrent write_ec_files_multi volumes.
+# per-stage wall seconds of the last write_ec_files run. The synchronous
+# routes fill read_s / kernel_s / shard_write_s (or fused/splice where
+# stages aren't separable). The STREAMED pipeline route fills the
+# five-stage budget read_s / stage_s / kernel_s / write_s / sync_s plus
+# pipeline_depth and coverage_of_wall: read/stage/sync are main-thread
+# walls that PARTITION the run (their sum over total_s is the disclosed
+# coverage), while kernel_s (pool) and write_s (writer thread) are
+# overlapped walls whose ratio to total_s discloses overlap efficiency.
+# Not synchronized across concurrent write_ec_files_multi volumes.
 LAST_STAGES: dict = {}
+_STAGE_LOCK = threading.Lock()
 
 # per-stage wall seconds of the last rebuild_ec_files run (read_s /
 # decode_s / write_s / total_s) — the repair-plane mirror of LAST_STAGES.
@@ -68,6 +73,29 @@ LAST_REBUILD_ROUTE: dict = {}
 
 def _stage_add(key: str, dt: float) -> None:
     LAST_STAGES[key] = LAST_STAGES.get(key, 0.0) + dt
+
+
+def _stage_add_locked(key: str, dt: float) -> None:
+    # the streamed pipeline adds kernel_s/write_s from pool and writer
+    # threads concurrently with the main thread's read_s/stage_s: lock
+    with _STAGE_LOCK:
+        LAST_STAGES[key] = LAST_STAGES.get(key, 0.0) + dt
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+def _sweep_stale_tmp(base_file_name: str, total_shards: int) -> None:
+    """Remove .ecNN.tmp leftovers a crashed encode/rebuild left behind —
+    a torn .tmp must never be mistaken for (or block) a fresh output."""
+    for i in range(total_shards):
+        tmp = base_file_name + to_ext(i) + ".tmp"
+        if os.path.exists(tmp):
+            os.remove(tmp)
 
 
 def _rebuild_stage_add(key: str, dt: float) -> None:
@@ -235,84 +263,294 @@ def _encode_rows_mmap(
             done += this
 
 
-def _encode_rows_pipelined(
-    dat_f,
-    outputs,
-    codec,
-    start_offset: int,
-    block_size: int,
-    rows: int,
-    chunk: int,
-    workers: int = 2,
-) -> None:
-    """Same bytes as _encode_rows, but the per-chunk encode (host pack ->
-    device upload -> kernel -> parity download) runs on a small worker pool
-    so disk reads/writes overlap device work, and chunk i+1's upload
-    overlaps chunk i's download. Shard writes stay strictly in stream order.
+def _stream_items(
+    n_large: int, large_block: int, n_small: int, small_block: int,
+    chunk: int, k: int, group: bool = True,
+) -> list:
+    """The streamed pipeline's work list, in shard stream order:
+    (start, block, done, width, g) where `start` is the .dat offset of the
+    first covered row, `g` rows are grouped into one dispatch (small blocks
+    only — GF columns are independent, so G concatenated blocks per shard
+    encode identically to G per-row encodes, amortizing per-dispatch
+    latency), and `done`/`width` chunk the inside of one large block.
 
-    The reference pipeline is a synchronous 256KB loop
-    (ref: ec_encoder.go:120-136); this is the TPU-first replacement that
-    keeps the device fed.
-    """
+    group=False emits one item per small-block row instead: the zero-copy
+    mmap route dispatches strided (k, width) VIEWS of the source mapping,
+    and a grouped item's per-shard bytes are not expressible as one such
+    view (its g segments per shard are discontiguous)."""
+    items = []
+    offset = 0
+    for rows, block in ((n_large, large_block), (n_small, small_block)):
+        if block >= chunk or not group:
+            for row in range(rows):
+                row_start = offset + row * block * k
+                done = 0
+                while done < block:
+                    width = min(chunk, block - done)
+                    items.append((row_start, block, done, width, 1))
+                    done += width
+        else:
+            g_max = max(1, chunk // block)
+            row = 0
+            while row < rows:
+                g = min(g_max, rows - row)
+                items.append((offset + row * block * k, block, 0, block, g))
+                row += g
+        offset += rows * block * k
+    return items
+
+
+def _encode_streamed(
+    base_file_name: str,
+    dat_f,
+    codec,
+    n_large: int,
+    large_block: int,
+    n_small: int,
+    small_block: int,
+    chunk: int,
+    depth: int,
+    splice_data,
+    dat_path: str,
+) -> bool:
+    """The streamed, depth-N double-buffered encode pipeline (the route the
+    device codec prefers; any codec runs it with pipeline=True).
+
+    Chunked reads of the .dat feed a bounded ring of depth+2 REUSED host
+    staging slots (the pinned-buffer pool a real device runtime would
+    register for DMA). Two input routes feed the ring:
+
+    - mmap (default when the host route race hasn't proven pread faster):
+      each chunk is a zero-copy strided (k, width) VIEW of the mapping —
+      per-shard rows are contiguous segments `block` apart — prefetched
+      with madvise(WILLNEED) one item ahead so page population overlaps
+      compute; the ring slot is then only a backpressure token. Only an
+      item whose source region crosses EOF stages through a copy (it needs
+      the zero tail materialized).
+    - preadv: every chunk is copied into a staging slot (no mapping
+      available, or calibration proved the guest fault path slow).
+
+    Each chunk's kernel dispatch (host->device upload + matmul + download,
+    or the host-kernel dispatch the codec substitutes on the CPU stand-in)
+    runs on a pool of `depth` workers so it overlaps the NEXT chunk's disk
+    read (main thread) and the PREVIOUS chunk's shard writes (dedicated
+    writer thread). Output is in-order into .ecNN.tmp files renamed into
+    place only when the whole stream succeeds — a mid-stream crash leaves
+    only .tmp files for the next run's sweep, never a torn shard
+    masquerading as complete.
+
+    Per-stage walls land in LAST_STAGES: read_s (main-thread preadv, or
+    view construction + readahead on the mmap route), stage_s (ring
+    backpressure: free-slot waits + pad/submit/handoff), sync_s (final
+    drain + flush + rename) partition the main-thread wall — their sum
+    over total_s is the disclosed coverage_of_wall; kernel_s (pool) and
+    write_s (writer) are the overlapped walls. Returns (spliced, input)
+    where `input` is the route that fed the ring ("mmap" or "pread")."""
     import concurrent.futures as cf
-    from collections import deque
+    import mmap as mmap_mod
+    import queue as queue_mod
+    import time as _time
 
     k = codec.data_shards
-    # small blocks are grouped G rows per device call (GF columns are
-    # independent, so encoding G concatenated blocks per shard equals G
-    # per-row encodes) — this amortizes per-dispatch latency that would
-    # otherwise dominate 1MB-block rows
-    group = max(1, chunk // block_size) if block_size < chunk else 1
+    m = codec.parity_shards
+    total = codec.total_shards
 
-    # every device call uses the same buffer width (zero-padded tail, parity
-    # sliced on write): zero columns give zero parity, and a single shape
-    # means a single kernel compile for the whole stream
-    full_width = group * block_size if group > 1 else min(chunk, block_size)
+    _sweep_stale_tmp(base_file_name, total)
 
-    def items():
-        row = 0
-        while row < rows:
-            if group > 1:
-                g = min(group, rows - row)
-                yield row, 0, block_size, g
-                row += g
-            else:
-                done = 0
-                while done < block_size:
-                    this = min(chunk, block_size - done)
-                    yield row, done, this, 1
-                    done += this
-                row += 1
+    spliced = False
+    if splice_data is None or splice_data:
+        t0 = _time.perf_counter()
+        spliced = _splice_data_shards(
+            dat_path, base_file_name, k,
+            n_large, large_block, n_small, small_block,
+            suffix=".tmp",
+        )
+        if spliced:
+            _stage_add("splice_s", _time.perf_counter() - t0)
 
-    def read_item(row: int, done: int, width: int, g: int) -> np.ndarray:
-        buf = np.zeros((k, full_width), dtype=np.uint8)
-        for gi in range(g):
-            row_start = start_offset + (row + gi) * block_size * k
-            sl = slice(gi * width, gi * width + width)
-            for i in range(k):
-                _read_into(dat_f, buf[i, sl], row_start + i * block_size + done)
-        return buf
+    t_setup = _time.perf_counter()
+    try:
+        dat_size = os.fstat(dat_f.fileno()).st_size
+    except (OSError, AttributeError):
+        dat_size = 0
+    mm = None
+    mm_arr = None
+    # calibration ('sync' = pread beat everything mmap-backed on this
+    # host's fault path) is the only reason to copy when a mapping works
+    if dat_size > 0 and _HOST_ROUTE != "sync":
+        try:
+            mm = mmap_mod.mmap(
+                dat_f.fileno(), 0, access=mmap_mod.ACCESS_READ
+            )
+            mm_arr = np.frombuffer(mm, dtype=np.uint8)
+        except (ValueError, OSError, AttributeError):
+            mm = None
+            mm_arr = None
 
-    def drain(entry) -> None:
-        width, g, buf, fut = entry
-        parity = np.ascontiguousarray(fut.result())
-        for gi in range(g):
-            sl = slice(gi * width, gi * width + width)
-            for i in range(k):
-                if outputs[i] is not None:
-                    outputs[i].write(buf[i, sl].data)
-            for p in range(codec.parity_shards):
-                outputs[k + p].write(parity[p, sl].data)
+    items = _stream_items(
+        n_large, large_block, n_small, small_block, chunk, k,
+        group=mm_arr is None,
+    )
+    full_width = max((w * g for _s, _b, _d, w, g in items), default=0)
+    dispatch = getattr(codec, "pipeline_encode", None) or codec.encode
+    # the device dispatch keeps ONE compile shape (zero-padded tail, parity
+    # sliced on write: zero columns encode to zero parity); host kernels
+    # take the narrow tail directly
+    pad_tail = getattr(codec, "pipeline_dispatch_kind", "host") == "device"
 
-    with cf.ThreadPoolExecutor(workers) as pool:
-        pending: deque = deque()
-        for row, done, width, g in items():
-            buf = read_item(row, done, width, g)
-            pending.append((width, g, buf, pool.submit(codec.encode, buf)))
-            while len(pending) > workers:
-                drain(pending.popleft())
-        while pending:
-            drain(pending.popleft())
+    def prefetch(index: int) -> None:
+        """Async readahead for item `index`'s source range: on disk-backed
+        files WILLNEED starts the IO while earlier chunks compute/write;
+        on tmpfs it is a no-op-priced hint."""
+        if mm is None or index >= len(items) or not hasattr(mm, "madvise"):
+            return
+        start, block, done, width, g = items[index]
+        first = start + done
+        span = (k - 1) * block + width * g
+        first_pg = first - (first % mmap_mod.PAGESIZE)
+        try:
+            mm.madvise(
+                mmap_mod.MADV_WILLNEED, first_pg,
+                min(first + span, dat_size) - first_pg,
+            )
+        except (OSError, ValueError):
+            pass
+
+    outputs = [
+        None if (spliced and i < k)
+        else open(base_file_name + to_ext(i) + ".tmp", "wb")
+        for i in range(total)
+    ]
+    n_slots = depth + 2
+    freeq: queue_mod.Queue = queue_mod.Queue()
+    for _ in range(n_slots):
+        # slots materialize on first staging use: on the mmap route most
+        # items are views and the token is pure backpressure
+        freeq.put(None)
+    outq: queue_mod.Queue = queue_mod.Queue()
+    err: list = [None]
+
+    def run_kernel(view: np.ndarray) -> np.ndarray:
+        t0 = _time.perf_counter()
+        out = np.asarray(dispatch(view))
+        _stage_add_locked("kernel_s", _time.perf_counter() - t0)
+        return out
+
+    def writer() -> None:
+        while True:
+            entry = outq.get()
+            if entry is None:
+                return
+            buf, used, fut, slot = entry
+            try:
+                parity = fut.result()
+                t0 = _time.perf_counter()
+                for i in range(k):
+                    if outputs[i] is not None:
+                        outputs[i].write(buf[i, :used].data)
+                for p in range(m):
+                    outputs[k + p].write(parity[p, :used].data)
+                _stage_add_locked("write_s", _time.perf_counter() - t0)
+            except BaseException as e:  # keep consuming: the main thread
+                # must never deadlock on a dead writer's unreturned slots
+                if err[0] is None:
+                    err[0] = e
+            finally:
+                freeq.put(slot)
+
+    writer_t = threading.Thread(
+        target=writer, name="ec-stream-writer", daemon=True
+    )
+    ok = False
+    try:
+        with cf.ThreadPoolExecutor(depth) as pool:
+            writer_t.start()
+            # pool/writer/ring setup charges to stage_s: the coverage
+            # partition must account for every main-thread second
+            _stage_add_locked("stage_s", _time.perf_counter() - t_setup)
+            prefetch(0)
+            for idx, (start, block, done, width, g) in enumerate(items):
+                if err[0] is not None:
+                    break
+                t0 = _time.perf_counter()
+                slot = freeq.get()
+                t1 = _time.perf_counter()
+                _stage_add_locked("stage_s", t1 - t0)
+                used = width * g
+                first = start + done
+                view = None
+                if (
+                    mm_arr is not None
+                    and g == 1
+                    and first + (k - 1) * block + width <= dat_size
+                ):
+                    view = np.lib.stride_tricks.as_strided(
+                        mm_arr[first:], shape=(k, width),
+                        strides=(block, 1), writeable=False,
+                    )
+                    buf = view
+                else:
+                    if slot is None:
+                        slot = np.empty(
+                            (k, max(full_width, 1)), dtype=np.uint8
+                        )
+                    for gi in range(g):
+                        row_start = start + gi * block * k
+                        sl = slice(gi * width, gi * width + width)
+                        for i in range(k):
+                            _read_into(
+                                dat_f, slot[i, sl],
+                                row_start + i * block + done,
+                            )
+                    buf = slot
+                prefetch(idx + 1)
+                t2 = _time.perf_counter()
+                _stage_add_locked("read_s", t2 - t1)
+                if view is None:
+                    if used < full_width and pad_tail:
+                        slot[:, used:] = 0
+                        kview = slot
+                    else:
+                        kview = slot if used == full_width else slot[:, :used]
+                else:
+                    kview = view
+                outq.put((buf, used, pool.submit(run_kernel, kview), slot))
+                _stage_add_locked("stage_s", _time.perf_counter() - t2)
+            t0 = _time.perf_counter()
+            outq.put(None)
+            writer_t.join()
+        if err[0] is not None:
+            raise err[0]
+        for f in outputs:
+            if f is not None:
+                f.flush()
+                f.close()
+        for i in range(total):
+            os.replace(
+                base_file_name + to_ext(i) + ".tmp", base_file_name + to_ext(i)
+            )
+        ok = True
+        _stage_add_locked("sync_s", _time.perf_counter() - t0)
+    finally:
+        if not ok:
+            if writer_t.is_alive():
+                outq.put(None)
+                writer_t.join()
+            for f in outputs:
+                if f is not None:
+                    try:
+                        f.close()
+                    except OSError:
+                        pass
+            _sweep_stale_tmp(base_file_name, total)
+        if mm is not None:
+            mm_arr = view = buf = kview = None  # drop buffer exports
+            try:
+                mm.close()
+            except (BufferError, OSError):
+                pass  # a straggling view still exports the buffer: the
+                # mapping closes when it is collected
+    return spliced, "mmap" if mm is not None else "pread"
 
 
 def _fs_type_of(path: str) -> str:
@@ -613,6 +851,7 @@ def _splice_data_shards(
     large_block: int,
     n_small: int,
     small_block: int,
+    suffix: str = "",
 ) -> bool:
     """Assemble the k data-shard files as kernel-side copies of the .dat
     (copy_file_range) — their content is a pure interleaving of the source,
@@ -638,7 +877,7 @@ def _splice_data_shards(
         with open(dat_path, "rb") as src:
             sfd = src.fileno()
             for i in range(k):
-                path = base_file_name + to_ext(i)
+                path = base_file_name + to_ext(i) + suffix
                 with open(path, "wb") as out:
                     written.append(path)
                     ofd = out.fileno()
@@ -692,10 +931,16 @@ def write_ec_files(
 ) -> None:
     """Generate .ec00-.ec13 from .dat (ref WriteEcFiles, ec_encoder.go:57).
 
-    pipeline=None follows the codec's preference: the TPU codec overlaps
-    disk IO with device encode (_encode_rows_pipelined); the CPU codec
-    keeps the reference's synchronous structure. splice_data=None tries the
-    kernel-side data-shard splice and falls back to inline writes.
+    pipeline=None follows the codec's preference: the TPU codec takes the
+    streamed depth-N double-buffered route (_encode_streamed: bounded ring
+    of reused staging buffers, overlapped read/kernel/write, in-order
+    .ecNN.tmp outputs renamed on success, five-stage wall budget in
+    LAST_STAGES); the CPU codec keeps the reference's synchronous
+    structure. The streamed route's chunk and depth are env-tunable:
+    SEAWEEDFS_TPU_EC_PIPELINE_CHUNK (bytes, default codec.preferred_chunk)
+    and SEAWEEDFS_TPU_EC_PIPELINE_DEPTH (default codec.pipeline_workers).
+    splice_data=None tries the kernel-side data-shard splice and falls
+    back to inline writes.
     mmap_input=None picks the zero-copy mmapped-read path automatically
     (row-pointer host codec, no pipeline); True forces it for a non-pipelined
     host codec, False disables it.
@@ -745,15 +990,10 @@ def write_ec_files(
             mmap_input and not pipeline and hasattr(codec, "encode_rows")
         )
     if pipeline and chunk == DEFAULT_CHUNK:
-        chunk = getattr(codec, "preferred_chunk", chunk)
-    if pipeline:
-        workers = getattr(codec, "pipeline_workers", 2)
-
-        def encode_rows(*a):
-            _encode_rows_pipelined(*a, workers=workers)
-
-    else:
-        encode_rows = _encode_rows
+        chunk = _env_int(
+            "SEAWEEDFS_TPU_EC_PIPELINE_CHUNK",
+            getattr(codec, "preferred_chunk", chunk),
+        )
     k = codec.data_shards
     dat_path = base_file_name + ".dat"
     dat_size = os.path.getsize(dat_path)
@@ -764,6 +1004,43 @@ def write_ec_files(
     n_large, n_small = _row_counts(
         dat_size, k, large_block_size, small_block_size
     )
+
+    if pipeline:
+        depth = max(1, _env_int(
+            "SEAWEEDFS_TPU_EC_PIPELINE_DEPTH",
+            getattr(codec, "pipeline_workers", 2),
+        ))
+        try:
+            with open(dat_path, "rb") as dat_f:
+                spliced, input_kind = _encode_streamed(
+                    base_file_name, dat_f, codec,
+                    n_large, large_block_size, n_small, small_block_size,
+                    chunk, depth, splice_data, dat_path,
+                )
+            LAST_ROUTE = {
+                "route": "pipeline",
+                "spliced": spliced,
+                "input": input_kind,
+                "kernel": getattr(codec, "pipeline_dispatch_kind", "host"),
+                "pipeline_depth": depth,
+            }
+        finally:
+            total = _time.perf_counter() - _t_enter
+            LAST_STAGES["total_s"] = total
+            LAST_STAGES["pipeline_depth"] = depth
+            # coverage = the main-thread (blocking) stages over the wall:
+            # kernel_s/write_s are overlapped walls and deliberately NOT
+            # summed here — the PR 2 write-budget disclosure discipline
+            blocking = sum(
+                LAST_STAGES.get(s, 0.0)
+                for s in ("read_s", "stage_s", "sync_s", "splice_s",
+                          "calibrate_s")
+            )
+            LAST_STAGES["coverage_of_wall"] = round(
+                blocking / max(total, 1e-9), 3
+            )
+            LAST_STAGES.setdefault("ecx_s", 0.0)
+        return
 
     if onepass and dat_size > 0:
         if _encode_onepass(
@@ -793,7 +1070,7 @@ def write_ec_files(
     # introspection for benchmarks/diagnostics: which structure actually
     # ran (the roofline model differs when data shards were spliced)
     LAST_ROUTE = {
-        "route": "pipeline" if pipeline else ("mmap" if use_mmap else "pread"),
+        "route": "mmap" if use_mmap else "pread",
         "spliced": spliced,
     }
 
@@ -803,7 +1080,7 @@ def write_ec_files(
     ]
     try:
         with open(dat_path, "rb") as dat_f:
-            small_chunk = chunk if pipeline else min(chunk, small_block_size)
+            small_chunk = min(chunk, small_block_size)
             if use_mmap:
                 import mmap as mmap_mod
 
@@ -828,12 +1105,10 @@ def write_ec_files(
                     if mm is not None:
                         mm.close()
             else:
-                encode_rows(
+                _encode_rows(
                     dat_f, outputs, codec, 0, large_block_size, n_large, chunk
                 )
-                # the pipelined path groups multiple small rows per call, so
-                # it keeps the full chunk; the sync path clamps to one block
-                encode_rows(
+                _encode_rows(
                     dat_f, outputs, codec, n_large * large_row,
                     small_block_size, n_small, small_chunk,
                 )
@@ -889,6 +1164,27 @@ def _piece_iter(
         processed += rows * block * k
 
 
+def _mesh_encode(codec, mesh, buf: np.ndarray) -> np.ndarray:
+    """Encode one wide batch through the parallel/sharded_ec mesh path:
+    columns pad to the mesh's 4*blk packing unit (zero columns encode to
+    zero parity and are stripped), the batch rides as one [1, k, N]
+    volume sharded over (vol, blk). The multi-chip leg of the encode
+    plane — byte-identical to codec.encode by GF linearity."""
+    from ...parallel.sharded_ec import sharded_encode
+
+    n = buf.shape[1]
+    unit = 4 * mesh.shape["blk"]
+    pad = (-n) % unit
+    if pad:
+        buf = np.concatenate(
+            [buf, np.zeros((buf.shape[0], pad), dtype=np.uint8)], axis=1
+        )
+    out = np.asarray(
+        sharded_encode(codec.parity_matrix, buf[None], mesh)
+    )[0]
+    return out[:, :n] if pad else out
+
+
 def write_ec_files_multi(
     base_file_names,
     codec=None,
@@ -896,6 +1192,7 @@ def write_ec_files_multi(
     small_block_size: int = EC_SMALL_BLOCK_SIZE,
     chunk: int = DEFAULT_CHUNK,
     workers: Optional[int] = None,
+    mesh=None,
 ) -> None:
     """Encode MANY volumes' .dat files through shared wide encode batches
     (BASELINE.json config 3 — batched multi-volume ec.encode).
@@ -1009,13 +1306,19 @@ def write_ec_files_multi(
                 for p in range(codec.parity_shards):
                     outputs[k + p].write(parity[p, sl].data)
 
+        if mesh is not None:
+            def encode_batch(buf: np.ndarray) -> np.ndarray:
+                return _mesh_encode(codec, mesh, buf)
+        else:
+            encode_batch = codec.encode
+
         depth = max(1, workers or 2)  # device pipeline depth
         with cf.ThreadPoolExecutor(depth) as pool:
             pending: deque = deque()
             for width, items in rounds():
                 buf = read_batch(width, items)
                 pending.append(
-                    (width, items, buf, pool.submit(codec.encode, buf))
+                    (width, items, buf, pool.submit(encode_batch, buf))
                 )
                 while len(pending) > depth:
                     drain(pending.popleft())
@@ -1160,10 +1463,7 @@ def _rebuild_survey(base_file_name: str, codec) -> tuple[list[int], list[int]]:
     than k survivors remain or survivors disagree on size (a truncated
     survivor would otherwise zero-fill into every rebuilt shard)."""
     k = codec.data_shards
-    for i in range(codec.total_shards):
-        tmp = base_file_name + to_ext(i) + ".tmp"
-        if os.path.exists(tmp):
-            os.remove(tmp)
+    _sweep_stale_tmp(base_file_name, codec.total_shards)
     have = [
         os.path.exists(base_file_name + to_ext(i))
         for i in range(codec.total_shards)
@@ -1380,6 +1680,24 @@ def _rebuild_ec_files_unlocked(
                 except OSError:
                     pass
         LAST_REBUILD_STAGES["total_s"] = _time.perf_counter() - t_enter
+        if "sync_s" in LAST_REBUILD_STAGES:
+            # streamed ring ran: the blocking (main-thread) stages
+            # partition the wall — decode_s/write_s are overlapped walls.
+            # On the mmap route read_s is worker-side view assembly (~0),
+            # so the sum stays an honest main-thread account either way.
+            blocking = ("read_s", "stage_s", "sync_s", "calibrate_s")
+            if "pipeline_depth" in LAST_REBUILD_STAGES:
+                LAST_REBUILD_ROUTE["pipeline_depth"] = LAST_REBUILD_STAGES[
+                    "pipeline_depth"
+                ]
+        else:
+            blocking = ("read_s", "decode_s", "write_s", "fused_s",
+                        "calibrate_s")
+        LAST_REBUILD_STAGES["coverage_of_wall"] = round(
+            sum(LAST_REBUILD_STAGES.get(s, 0.0) for s in blocking)
+            / max(LAST_REBUILD_STAGES["total_s"], 1e-9),
+            3,
+        )
         try:
             from ...util.metrics import EC_REBUILD_STAGE_SECONDS
 
@@ -1516,38 +1834,72 @@ def _rebuild_ring(
     shard_size: int, chunk: int, workers: int, allocate, stage, decode,
     write_outs,
 ) -> None:
-    """The double-buffered ring both pipelined rebuild routes share:
-    `allocate()` builds one slot's buffers, `stage(offset, width, bufs)`
-    runs in the MAIN thread (survivor reads; a no-op on the mmap route),
-    `decode(offset, width, bufs)` runs on the pool, `write_outs(outs)`
-    writes in stream order. A slot recycles only after its decode result
+    """The streamed ring both pipelined rebuild routes share (the rebuild
+    mirror of _encode_streamed): `allocate()` builds one slot's buffers,
+    `stage(offset, width, bufs)` runs in the MAIN thread (survivor reads;
+    a no-op on the mmap route), `decode(offset, width, bufs)` runs on the
+    pool, and a dedicated writer thread calls `write_outs(outs)` in stream
+    order — so chunk i+1's survivor read overlaps chunk i's decode AND
+    chunk i-1's shard writes. A slot recycles only after its decode result
     is written, bounding memory at (workers+2) slots with zero
-    steady-state allocation."""
+    steady-state allocation. stage_s (free-slot waits + handoff) and
+    sync_s (final drain) land in LAST_REBUILD_STAGES next to the
+    read_s/decode_s/write_s the callbacks record; pipeline_depth too."""
     import concurrent.futures as cf
-    from collections import deque
+    import queue as queue_mod
+    import time as _time
 
-    free = [allocate() for _ in range(workers + 2)]
-    pending: deque = deque()
+    depth = max(1, workers)
+    freeq: queue_mod.Queue = queue_mod.Queue()
+    for _ in range(depth + 2):
+        freeq.put(allocate())
+    outq: queue_mod.Queue = queue_mod.Queue()
+    err: list = [None]
 
-    def drain() -> None:
-        bufs, fut = pending.popleft()
-        write_outs(fut.result())
-        free.append(bufs)
+    def writer() -> None:
+        while True:
+            entry = outq.get()
+            if entry is None:
+                return
+            bufs, fut = entry
+            try:
+                write_outs(fut.result())
+            except BaseException as e:  # keep consuming: the main thread
+                # must never deadlock on a dead writer's unreturned slots
+                if err[0] is None:
+                    err[0] = e
+            finally:
+                freeq.put(bufs)
 
-    with cf.ThreadPoolExecutor(workers) as pool:
-        offset = 0
-        while offset < shard_size:
-            width = min(chunk, shard_size - offset)
-            if not free:
-                drain()
-            bufs = free.pop()
-            stage(offset, width, bufs)
-            pending.append((bufs, pool.submit(decode, offset, width, bufs)))
-            while len(pending) > workers:
-                drain()
-            offset += width
-        while pending:
-            drain()
+    writer_t = threading.Thread(
+        target=writer, name="ec-rebuild-writer", daemon=True
+    )
+    writer_t.start()
+    with _REBUILD_STAGE_LOCK:
+        LAST_REBUILD_STAGES["pipeline_depth"] = depth
+    try:
+        with cf.ThreadPoolExecutor(depth) as pool:
+            offset = 0
+            while offset < shard_size and err[0] is None:
+                width = min(chunk, shard_size - offset)
+                t0 = _time.perf_counter()
+                bufs = freeq.get()
+                _rebuild_stage_add("stage_s", _time.perf_counter() - t0)
+                stage(offset, width, bufs)
+                t0 = _time.perf_counter()
+                outq.put((bufs, pool.submit(decode, offset, width, bufs)))
+                _rebuild_stage_add("stage_s", _time.perf_counter() - t0)
+                offset += width
+            t0 = _time.perf_counter()
+            outq.put(None)
+            writer_t.join()
+        _rebuild_stage_add("sync_s", _time.perf_counter() - t0)
+    finally:
+        if writer_t.is_alive():
+            outq.put(None)
+            writer_t.join()
+    if err[0] is not None:
+        raise err[0]
 
 
 def _rebuild_mmap(

@@ -88,6 +88,13 @@ BLOOM_ENABLED = (
 BLOOM_BITS_PER_KEY = int(
     os.environ.get("SEAWEEDFS_TPU_NEEDLE_MAP_BLOOM_BITS", "10") or 10
 )
+# minimum run count before lookups consult the filters at all (ISSUE 17
+# satellite, carried from PR 15): below it one searchsorted happens either
+# way and the filter is pure overhead; deployments whose run shapes differ
+# (e.g. many tiny runs with hot absent-key traffic) can lower/raise it
+BLOOM_MIN_RUNS = int(
+    os.environ.get("SEAWEEDFS_TPU_BLOOM_MIN_RUNS", "2") or 2
+)
 _BLOOM_MAGIC = b"SWBF"
 _BLOOM_HEADER = struct.Struct("<4sBBHQI")  # magic|ver|k|pad|mbits|count
 _BLOOM_BASE = _BLOOM_HEADER.size  # bitmap offset in the sidecar file
@@ -831,7 +838,8 @@ class LsmNeedleMap:
             return v
         runs = self._runs
         bh = None
-        multi = len(runs) > 1  # single-run maps skip filters outright
+        # below the (env-tunable) threshold maps skip filters outright
+        multi = len(runs) >= BLOOM_MIN_RUNS
         for r in reversed(runs):
             if multi and bh is None and r.bloom is not None:
                 bh = _mix64_scalar(key)
@@ -1028,17 +1036,29 @@ class LsmNeedleMap:
     def bloom_stats(self) -> dict:
         """Aggregate per-run filter economics (the needle_map.lookup
         bench leg's disclosure): probes that consulted a filter, probes
-        a filter short-circuited, and how many runs carry one."""
+        a filter short-circuited, how many runs carry one, the active
+        consultation threshold, and the per-run consult/hit counts
+        (newest run last, matching probe order reversed)."""
         with self._lock:
             probes = sum(r.bloom_probes for r in self._runs)
             neg = sum(r.bloom_neg for r in self._runs)
             filtered = sum(1 for r in self._runs if r.bloom is not None)
+            per_run = [
+                {
+                    "probes": r.bloom_probes,
+                    "negatives": r.bloom_neg,
+                    "has_filter": r.bloom is not None,
+                }
+                for r in self._runs
+            ]
         return {
             "runs": len(self._runs),
             "runs_with_filter": filtered,
+            "min_runs": BLOOM_MIN_RUNS,
             "probes": probes,
             "negatives": neg,
             "filter_hit_rate": round(neg / probes, 4) if probes else 0.0,
+            "per_run": per_run,
         }
 
     # metrics accessors mirroring the reference mapper

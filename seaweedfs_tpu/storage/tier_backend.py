@@ -209,6 +209,39 @@ class BackendStorage:
         raise NotImplementedError
 
 
+class _LifecycleCharge:
+    """Charge bulk tier transfer bytes through the shared
+    MaintenanceBudget's lifecycle band (ISSUE 17 satellite, carried from
+    PR 14): raw-.dat tier_upload/tier_download moves pace at the budget
+    rate and yield under overload pressure exactly like EC shard offload,
+    instead of bursting past the planes' shaper. Progress callbacks
+    report CUMULATIVE done bytes, so the wrapper charges deltas as the
+    copy proceeds (spreading the transfer, not pre-bursting one lump);
+    `settle` charges whatever a coarse backend never reported. The
+    caller's own fn still sees the original (done, pct) stream."""
+
+    def __init__(self, fn: ProgressFn):
+        from .maintenance import plane_bucket
+
+        self._bucket = plane_bucket("lifecycle")
+        self._fn = fn
+        self._last = 0
+
+    def __call__(self, done: int, pct: float) -> None:
+        if self._bucket is not None:
+            delta = done - self._last
+            if delta > 0:
+                self._last = done
+                self._bucket.consume(delta)
+        if self._fn is not None:
+            self._fn(done, pct)
+
+    def settle(self, total: int) -> None:
+        if self._bucket is not None and total > self._last:
+            self._bucket.consume(total - self._last)
+            self._last = total
+
+
 def _progress_copy(src, dst, total: int, fn: ProgressFn) -> int:
     done = 0
     while True:
@@ -630,7 +663,9 @@ def tier_upload(volume, dest_backend_name: str, fn: ProgressFn = None, keep_loca
         "collection": volume.collection,
         "ext": ".dat",
     }
-    key, size = storage.copy_file(dat_path, attributes, fn)
+    charge = _LifecycleCharge(fn)
+    key, size = storage.copy_file(dat_path, attributes, charge)
+    charge.settle(size)
     info.files.append(
         RemoteFile(
             backend_type=backend_type,
@@ -671,7 +706,9 @@ def tier_download(volume, fn: ProgressFn = None):
             f" supported: {sorted(BACKEND_STORAGES)}"
         )
     dat_path = volume.file_name() + ".dat"
-    size = storage.download_file(dat_path, key, fn)
+    charge = _LifecycleCharge(fn)
+    size = storage.download_file(dat_path, key, charge)
+    charge.settle(size)
     with volume._lock:
         volume.data_backend.close()
         volume.data_backend = DiskFile(dat_path, create=False)

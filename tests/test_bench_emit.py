@@ -644,6 +644,14 @@ def test_needle_map_lookup_leg_shape():
     assert bl["filter_hit_rate"] > 0.9
     assert bl["absent_bloom"]["mean_us"] > 0
     assert bl["absent_nobloom"]["mean_us"] > 0
+    # ISSUE 17 satellite: the consultation threshold and the per-run
+    # consult/hit tail are disclosed (evidence for tuning
+    # SEAWEEDFS_TPU_BLOOM_MIN_RUNS)
+    assert bl["min_runs"] >= 1
+    assert len(bl["per_run"]) == bl["runs"]
+    assert all(pr["has_filter"] for pr in bl["per_run"])
+    assert sum(pr["probes"] for pr in bl["per_run"]) > 0
+    assert any(pr["negatives"] > 0 for pr in bl["per_run"])
 
 
 def test_device_history_appends_per_emit(tmp_path, monkeypatch):
@@ -655,9 +663,24 @@ def test_device_history_appends_per_emit(tmp_path, monkeypatch):
         "vs_baseline": 1.0, "device_status": "tpu", "extra": [],
     }
     lines, _ = _run_emit(tmp_path, monkeypatch, dict(head))
+    # ISSUE 17 satellite: legs that disclose their own device_status are
+    # recorded PER LEG in the history entry (run-level status alone can't
+    # say which executor each metric actually landed on)
     lines, _ = _run_emit(
         tmp_path, monkeypatch,
-        {**head, "device_status": "cpu_standin", "value": 0.5},
+        {
+            **head, "device_status": "cpu_standin", "value": 0.5,
+            "extra": [
+                {"metric": "ec.encode.e2e", "value": 1.2,
+                 "device_status": "cpu_standin"},
+                {"metric": "ec.encode.sharded", "value": 0.3,
+                 "device_status": "cpu_standin"},
+                {"metric": "kernel_mxu_bitslice",
+                 "skipped": "no MXU on CPU stand-in",
+                 "device_status": "cpu_standin"},
+                {"metric": "no_status_leg", "value": 1.0},
+            ],
+        },
     )
     hist_path = tmp_path / "DEVICE_HISTORY.jsonl"
     entries = [
@@ -665,6 +688,12 @@ def test_device_history_appends_per_emit(tmp_path, monkeypatch):
     ]
     assert [e["run"] for e in entries] == [1, 2]
     assert [e["device_status"] for e in entries] == ["tpu", "cpu_standin"]
+    assert "legs" not in entries[0]  # no leg disclosed a status
+    assert entries[1]["legs"] == {
+        "ec.encode.e2e": "cpu_standin",
+        "ec.encode.sharded": "cpu_standin",
+        "kernel_mxu_bitslice": "cpu_standin",
+    }
     # the final line carries the pointer, not the (unbounded) history
     parsed = json.loads(lines[-1])
     assert parsed["device_history_file"] == "DEVICE_HISTORY.jsonl"
@@ -708,3 +737,56 @@ time.sleep(60)  # simulated mid-run hang
     d = json.loads(line)
     assert d["value"] == 1.5
     assert any(e.get("metric") == "watchdog" for e in d["extra"])
+
+
+def test_encode_e2e_entry_discloses_stage_budget(tmp_path):
+    """ISSUE 17 tier-1 shape guard: the ec.encode.e2e entry must disclose
+    non-zero per-stage walls whose blocking sum covers the wall (coverage
+    in [0.7, 1.3]) plus a pipeline_depth label — so a future refactor
+    can't silently ship an e2e number whose time is unaccounted for.
+
+    Runs a real (small) streamed encode so the stage walls come from the
+    shipping pipeline, then feeds the captured stages through
+    _e2e_results the way measure_encode_e2e does."""
+    import numpy as np
+
+    from seaweedfs_tpu.ops.rs_kernel import TpuRSCodec
+    from seaweedfs_tpu.storage.erasure_coding import encoder as enc
+
+    rng = np.random.default_rng(17)
+    base = str(tmp_path / "v_e2e")
+    # non-chunk-aligned extent: final item exercises the staging tail
+    data = rng.integers(0, 256, (4 << 20) + 12345, dtype=np.uint8)
+    with open(base + ".dat", "wb") as f:
+        f.write(data.tobytes())
+    enc.write_ec_files(
+        base, codec=TpuRSCodec(), large_block_size=1 << 20,
+        small_block_size=1 << 17, chunk=1 << 20, pipeline=True,
+    )
+    stages = dict(enc.LAST_STAGES)
+    route = dict(enc.LAST_ROUTE)
+    assert route["route"] == "pipeline"
+
+    entry = bench._e2e_results(
+        {
+            "ref_gbps": 0.34,
+            "tpu_gbps": 1.2,
+            "tpu_parity": True,
+            "tpu_stages": stages,
+            "tpu_route": route,
+            "tpu_size_bytes": data.size,
+            "device_status": "cpu_standin",
+        }
+    )[0]
+    assert entry["metric"] == "ec.encode.e2e"
+    bd = entry["stage_breakdown"]
+    for wall in ("read_s", "stage_s", "kernel_s", "write_s", "sync_s"):
+        assert bd[wall] > 0, (wall, bd)
+    # blocking stages partition the wall; kernel_s/write_s are the
+    # overlapped walls and deliberately excluded from the sum
+    assert 0.7 <= entry["coverage_of_wall"] <= 1.3, bd
+    assert entry["pipeline_depth"] >= 1
+    assert entry["kernel_dispatch"] in (
+        "device", "host_standin", "device_emulated",
+    )
+    assert entry["device_status"] == "cpu_standin"

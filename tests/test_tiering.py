@@ -97,6 +97,36 @@ def test_tier_upload_download_roundtrip_local(tmp_path):
     v.close()
 
 
+def test_tier_transfer_charges_lifecycle_budget(tmp_path):
+    """ISSUE 17 satellite: raw-.dat tier_upload/tier_download charge their
+    bytes through the shared MaintenanceBudget's lifecycle band (like EC
+    shard offload) instead of bursting past the planes' shaper."""
+    from seaweedfs_tpu.storage.maintenance import (
+        MaintenanceBudget,
+        configure_shared,
+    )
+
+    register_backend(LocalTierBackend("default", str(tmp_path / "tier")))
+    v, _ = make_volume(tmp_path)
+    # high rate: the test asserts accounting, not pacing
+    budget = MaintenanceBudget(100_000.0)
+    configure_shared(budget)
+    try:
+        progress = []
+        key, size = tier_upload(
+            v, "local.default", lambda done, pct: progress.append(done)
+        )
+        assert budget.snapshot()["spent_bytes"].get("lifecycle") == size
+        # the caller's own progress fn still sees the cumulative stream
+        assert progress and progress[-1] == size
+        dsize = tier_download(v)
+        assert dsize == size
+        assert budget.snapshot()["spent_bytes"]["lifecycle"] == 2 * size
+    finally:
+        configure_shared(None)
+    v.close()
+
+
 def test_tiered_volume_reload_reads_remote(tmp_path):
     register_backend(LocalTierBackend("default", str(tmp_path / "tier")))
     v, payloads = make_volume(tmp_path, vid=9)
