@@ -661,6 +661,324 @@ def measure_lookup_gate_decomposition(
     }
 
 
+def measure_needle_map_device_lookup(
+    n_volumes: int = 4,
+    entries_per_volume: int = 40_000,
+    window_s: float = 1.2,
+    concurrency: int = 256,
+    seed: int = 18,
+) -> dict:
+    """The MEASURED metadata device-lookup leg (ISSUE 18), superseding
+    `lookup_gate.decomposition`'s projection: real multi-run LSM needle
+    maps behind the REAL `BatchLookupGate` seam, the arena backend
+    scored against the host backend on the same seeded workload in the
+    same credit window, entry-wise identity asserted in-leg (the gate's
+    identity check re-derives EVERY device answer from the host map),
+    and the ragged kernel's stage walls (pack/upload/dispatch/readback)
+    measured at the batch-size distribution the gate itself produced
+    under concurrent load — not at round numbers someone liked.
+    """
+    import asyncio
+    import shutil
+    import tempfile
+
+    from seaweedfs_tpu.ops.ragged_lookup import DeviceColumnArena
+    from seaweedfs_tpu.server.lookup_gate import BatchLookupGate
+    from seaweedfs_tpu.storage.needle_map.lsm_map import LsmNeedleMap
+
+    rng = np.random.default_rng(seed)
+    root = tempfile.mkdtemp(prefix="bench_devlookup_")
+
+    from seaweedfs_tpu.types import TOMBSTONE_FILE_SIZE
+
+    class _Vol:
+        """Volume stand-in exposing exactly the two seams the gate
+        probes: nm.get and Volume.bulk_lookup's HOST path (nm.get loop
+        with tombstone filtering) — the real device path is the arena
+        backend under test, not bulk_lookup's per-volume snapshot."""
+
+        def __init__(self, nm):
+            self.nm = nm
+
+        def bulk_lookup(self, keys, use_device=None):
+            offs = np.zeros(len(keys), dtype=np.uint32)
+            szs = np.zeros(len(keys), dtype=np.uint32)
+            fnd = np.zeros(len(keys), dtype=bool)
+            get = self.nm.get
+            for i, k in enumerate(keys.tolist()):
+                nv = get(int(k))
+                if (
+                    nv is not None
+                    and nv.offset_units != 0
+                    and nv.size != TOMBSTONE_FILE_SIZE
+                ):
+                    offs[i] = nv.offset_units
+                    szs[i] = nv.size
+                    fnd[i] = True
+            return offs, szs, fnd
+
+    class _Store:
+        def __init__(self):
+            self.vols = {}
+
+        def find_volume(self, vid):
+            return self.vols.get(vid)
+
+    store = _Store()
+    oracle: dict = {}
+    all_keys: dict = {}
+    try:
+        for vid in range(1, n_volumes + 1):
+            # memtable sized so each volume seals ~5 runs (multi-run maps
+            # are the case the bloom pre-filter exists for)
+            nm = LsmNeedleMap(
+                os.path.join(root, f"v{vid}.idx"),
+                memtable_bytes=entries_per_volume * 120 // 5,
+            )
+            keys = rng.choice(
+                np.arange(1, entries_per_volume * 16, dtype=np.uint64),
+                size=entries_per_volume,
+                replace=False,
+            )
+            chunk = max(1024, entries_per_volume // 7)
+            for c0 in range(0, entries_per_volume, chunk):
+                part = keys[c0 : c0 + chunk]
+                nm.put_batch(
+                    (int(k), c0 + j + 1, 100 + ((c0 + j) % 900))
+                    for j, k in enumerate(part.tolist())
+                )
+            oracle.update(
+                {
+                    (vid, int(k)): (i + 1, 100 + (i % 900))
+                    for i, k in enumerate(keys.tolist())
+                }
+            )
+            for k in keys[:: max(1, entries_per_volume // 200)].tolist():
+                nm.delete(int(k), 0)
+                oracle.pop((vid, int(k)), None)
+            store.vols[vid] = _Vol(nm)
+            all_keys[vid] = keys
+        run_counts = {
+            vid: len(v.nm._runs) for vid, v in store.vols.items()
+        }
+
+        def probe_plan(n: int, miss_rate: float = 0.1):
+            """Seeded (vid, key) sequence: mostly hits across all
+            volumes, a slice of misses (the bloom pre-filter's case)."""
+            vids = rng.integers(1, n_volumes + 1, size=n)
+            out = []
+            for vid in vids.tolist():
+                ks = all_keys[vid]
+                if rng.random() < miss_rate:
+                    out.append((vid, int(rng.integers(1 << 40, 1 << 41))))
+                else:
+                    out.append((vid, int(ks[rng.integers(0, len(ks))])))
+            return out
+
+        def drive(gate, plan, concurrency: int, budget_s: float):
+            """Same-loop concurrent probers (the gate's production
+            shape): `concurrency` clients walk the shared seeded plan,
+            each await lands in the gate's per-wakeup flush. Returns
+            (per-probe latencies, probes done, elapsed)."""
+            lat: list = []
+
+            async def client(idx):
+                i = idx
+                t_end = time.perf_counter() + budget_s
+                while time.perf_counter() < t_end:
+                    vid, key = plan[i % len(plan)]
+                    i += concurrency
+                    t0 = time.perf_counter()
+                    await gate.lookup(vid, key)
+                    lat.append(time.perf_counter() - t0)
+
+            async def main():
+                await asyncio.gather(
+                    *(client(i) for i in range(concurrency))
+                )
+
+            t0 = time.perf_counter()
+            asyncio.run(main())
+            return lat, len(lat), time.perf_counter() - t0
+
+        plan = probe_plan(8192)
+
+        # -- scrape the batch-size distribution the gate itself produces
+        scrape_gate = BatchLookupGate(store)
+        drive(scrape_gate, plan, concurrency=concurrency, budget_s=0.3)
+        batch_hist = dict(sorted(scrape_gate.batch_hist.items()))
+
+        # -- host backend window
+        host_gate = BatchLookupGate(store)
+        h_lat, h_n, h_wall = drive(
+            host_gate, plan, concurrency=concurrency, budget_s=window_s
+        )
+
+        # -- arena backend window (scored): identity OFF here so the
+        # credit-window comparison is production-config vs production-
+        # config; the dedicated window below asserts identity on every
+        # dispatch
+        arena = DeviceColumnArena()
+        dev_gate = BatchLookupGate(
+            store, arena=arena, identity_check=False
+        )
+        # warm: register every volume's run set, then block on one
+        # double-buffered upload (serving-path dispatches never block)
+        for vid, v in store.vols.items():
+            _hits, segs = v.nm.arena_view(all_keys[vid][:1])
+            arena.ensure(segs)
+        arena.refresh_sync()
+        d_lat, d_n, d_wall = drive(
+            dev_gate, plan, concurrency=concurrency, budget_s=window_s
+        )
+
+        # -- identity window: every dispatch re-derived from the host
+        # map inside the gate, plus a dict-oracle pass on the results
+        idg = BatchLookupGate(store, arena=arena, identity_check=True)
+        drive(idg, plan, concurrency=concurrency, budget_s=min(0.4, window_s))
+
+        def pct(xs, q):
+            return float(np.percentile(np.asarray(xs), q)) if xs else 0.0
+
+        # -- ragged kernel stage walls at the SCRAPED distribution
+        sizes, weights = zip(*batch_hist.items())
+        w = np.asarray(weights, dtype=np.float64)
+        w /= w.sum()
+        timings: dict = {}
+        kern_probes = 0
+        views = {
+            vid: v.nm.arena_view(all_keys[vid][:1])[1]
+            for vid, v in store.vols.items()
+        }
+        # ragged batches pre-built OUTSIDE the timed loop so the four
+        # stage walls partition the dispatch wall (coverage_of_wall)
+        n_disp = 24
+        dispatch_batches = []
+        for _ in range(n_disp):
+            b = int(sizes[int(rng.choice(len(sizes), p=w))])
+            groups: dict = {}
+            for vid, key in probe_plan(b):
+                groups.setdefault(vid, []).append(key)
+            dispatch_batches.append(
+                [
+                    (views[vid], np.asarray(ks, dtype=np.uint64))
+                    for vid, ks in groups.items()
+                ]
+            )
+        t_kern0 = time.perf_counter()
+        for gl in dispatch_batches:
+            res = arena.probe_groups(gl, timings)
+            kern_probes += sum(len(ks) for _s, ks in gl)
+            if any(r is None for r in res):
+                raise RuntimeError("arena went cold mid-bench")
+        kern_wall = time.perf_counter() - t_kern0
+
+        # -- entry-wise identity: gate answers vs the dict oracle
+        oracle_checked = 0
+        oracle_bad = 0
+        # each drive() ran under its own asyncio.run loop; rebind the
+        # gate before parking new futures on the fresh loop
+        idg._loop = None
+
+        async def oracle_pass():
+            nonlocal oracle_checked, oracle_bad
+            picks = probe_plan(2048)
+            res = await asyncio.gather(
+                *(idg.lookup(vid, k) for vid, k in picks)
+            )
+            for (vid, k), r in zip(picks, res):
+                oracle_checked += 1
+                if r != oracle.get((vid, k)):
+                    oracle_bad += 1
+
+        asyncio.run(oracle_pass())
+
+        status = _device_status()
+        p99_host = pct(h_lat, 99)
+        p99_dev = pct(d_lat, 99)
+        overhead = (p99_dev / p99_host) if p99_host else float("inf")
+        overhead_ok = overhead <= 1.5
+        identity_ok = (
+            idg.stats["identity_mismatches"] == 0
+            and idg.stats["device_batches"] > 0
+            and oracle_bad == 0
+        )
+        stage_sum = sum(
+            timings.get(k, 0.0)
+            for k in ("pack_s", "upload_s", "dispatch_s", "readback_s")
+        )
+        stages = {
+            k: round(timings.get(k, 0.0), 4)
+            for k in ("pack_s", "upload_s", "dispatch_s", "readback_s")
+        }
+        stages["total_s"] = round(kern_wall, 4)
+        # the four stages PARTITION each dispatch's wall (they are
+        # sequential inside probe_groups); packing python + group
+        # bookkeeping outside the timed stages keeps coverage < 1
+        stages["coverage_of_wall"] = round(
+            stage_sum / kern_wall, 3
+        ) if kern_wall else 0.0
+        return {
+            "n_volumes": n_volumes,
+            "entries_per_volume": entries_per_volume,
+            "runs_per_volume": run_counts,
+            "batch_size_dist": {str(k): v for k, v in batch_hist.items()},
+            "host_gate": {
+                "probes_per_s": round(h_n / h_wall) if h_wall else 0,
+                "p50_ms": round(pct(h_lat, 50) * 1e3, 3),
+                "p99_ms": round(p99_host * 1e3, 3),
+                "probes": h_n,
+            },
+            "device_gate": {
+                "probes_per_s": round(d_n / d_wall) if d_wall else 0,
+                "p50_ms": round(pct(d_lat, 50) * 1e3, 3),
+                "p99_ms": round(p99_dev * 1e3, 3),
+                "probes": d_n,
+                "device_batches": dev_gate.stats["device_batches"],
+                "host_fallbacks": dev_gate.stats["host_fallbacks"],
+            },
+            "overhead_x_p99": round(overhead, 3),
+            "overhead_ok": overhead_ok,
+            "identity": {
+                "checked_every_dispatch": True,
+                "device_batches_checked": idg.stats["device_batches"],
+                "gate_mismatches": idg.stats["identity_mismatches"],
+                "oracle_checked": oracle_checked,
+                "oracle_mismatches": oracle_bad,
+                "ok": identity_ok,
+            },
+            "kernel": {
+                "dispatches": n_disp,
+                "probes_per_s": (
+                    round(kern_probes / kern_wall) if kern_wall else 0
+                ),
+                "stage_breakdown": stages,
+                "standin": status != "tpu",
+            },
+            "arena": arena.stats(),
+            "device_status": status,
+            # a stand-in run is still VALID as a gate-overhead proof
+            # (same host serves both backends); only the kernel
+            # throughput claim needs the chip
+            "valid": identity_ok and (status == "tpu" or overhead_ok),
+            "note": (
+                "measured end-to-end through the real gate seam; "
+                "identity asserted on every dispatch"
+                if status == "tpu"
+                else "gate overhead + identity measured on CPU stand-in "
+                "(valid: same host serves both backends); kernel "
+                "probes/s characterizes the stand-in, not the chip"
+            ),
+        }
+    finally:
+        for v in store.vols.values():
+            try:
+                v.nm.close()
+            except Exception:
+                pass
+        shutil.rmtree(root, ignore_errors=True)
+
+
 async def _drive_ping(
     http, hostport: str, n: int, concurrency: int, target: str = "/ping"
 ) -> dict:
@@ -6954,26 +7272,39 @@ def main() -> None:
         extra.append({"metric": "needle_lookup_qps", "error": str(e)[:200]})
 
     try:
-        if not budgeted("lookup_gate.decomposition", 150):
+        if not budgeted("needle_map.device_lookup", 150):
             raise _Skip()
-        dec = measure_lookup_gate_decomposition()
-        extra.append(
-            {
-                "metric": "lookup_gate.decomposition",
-                "value": dec["projected_local_qps"].get("65536"),
-                "unit": "projected #/sec",
-                "detail": dec,
-                "note": "device lookup gate decomposed: per-dispatch "
-                "tunnel RTT vs on-device kernel time (VERDICT r4 item 6); "
-                "value = projected QPS for a LOCALLY-attached chip at "
-                "batch=64k under the stated assumptions",
-            }
-        )
+        dl = measure_needle_map_device_lookup()
+        entry = {
+            "metric": "needle_map.device_lookup",
+            "value": dl["device_gate"]["probes_per_s"],
+            "unit": "#/sec",
+            "vs_baseline": round(
+                dl["device_gate"]["probes_per_s"]
+                / max(1, dl["host_gate"]["probes_per_s"]),
+                3,
+            ),
+            "detail": dl,
+            "device_status": dl["device_status"],
+            "stage_breakdown": dl["kernel"]["stage_breakdown"],
+            "coverage_of_wall": dl["kernel"]["stage_breakdown"][
+                "coverage_of_wall"
+            ],
+            "identity_ok": dl["identity"]["ok"],
+            "valid": dl["valid"],
+            "note": "MEASURED ragged device lookups through the real "
+            "gate seam (supersedes lookup_gate.decomposition's "
+            "projection): arena-backed gate vs host gate in the same "
+            "credit window at the gate's own scraped batch-size "
+            "distribution, entry-wise identity asserted on every "
+            "dispatch; " + dl["note"],
+        }
+        extra.append(entry)
     except _Skip:
         pass
     except Exception as e:
         extra.append(
-            {"metric": "lookup_gate.decomposition", "error": str(e)[:200]}
+            {"metric": "needle_map.device_lookup", "error": str(e)[:200]}
         )
 
     try:

@@ -70,9 +70,11 @@ def _add_volume_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "-batchLookup",
         default="off",
-        choices=["off", "auto", "host", "device"],
+        choices=["off", "auto", "host", "device", "arena"],
         help="micro-batch concurrent read index probes through one "
-        "vectorized bulk lookup (device IndexSnapshot when attached)",
+        "vectorized bulk lookup (device IndexSnapshot when attached); "
+        "'arena' answers each wakeup as ONE ragged dispatch over the "
+        "HBM-resident column arena, falling back to host when cold",
     )
     p.add_argument(
         "-tierConfig",
@@ -313,9 +315,11 @@ def cmd_server(argv: list[str]) -> int:
     p.add_argument(
         "-batchLookup",
         default="off",
-        choices=["off", "auto", "host", "device"],
+        choices=["off", "auto", "host", "device", "arena"],
         help="micro-batch concurrent read index probes through one "
-        "vectorized bulk lookup (device IndexSnapshot when attached)",
+        "vectorized bulk lookup (device IndexSnapshot when attached); "
+        "'arena' answers each wakeup as ONE ragged dispatch over the "
+        "HBM-resident column arena, falling back to host when cold",
     )
     # -tierConfig comes from _add_master_flags (shared with cmd_master)
     p.add_argument(

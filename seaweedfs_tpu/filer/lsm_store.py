@@ -13,6 +13,7 @@ listings are range scans over the (dir, name) key order.
 
 from __future__ import annotations
 
+import hashlib
 import heapq
 import os
 import struct
@@ -25,6 +26,20 @@ from .entry import Entry
 from .filer_store import _split as _key  # same (dir, name) rule as every store
 
 _FRAME = struct.Struct("<II")  # key-bytes length, value-bytes length
+
+
+def path_hash64(d: str, n: str) -> int:
+    """Stable 64-bit key for one (dir, name) — how string path keys ride
+    the u64-keyed ragged device kernel. blake2b is keyed-collision-free
+    enough that a collision is a per-probe host re-check, not a design
+    concern; '\\x00' can't appear in either component so the pairing is
+    injective."""
+    return int.from_bytes(
+        hashlib.blake2b(
+            (d + "\x00" + n).encode("utf-8"), digest_size=8
+        ).digest(),
+        "little",
+    )
 
 
 def _group_sorted(it):
@@ -78,6 +93,7 @@ class _Segment:
             self._offsets.append((pos, vlen))
             pos += vlen
         self._f = open(path, "rb")
+        self._arena_seg = None
 
     def get(self, key: Tuple[str, str]) -> Optional[Tuple[bool, Optional[dict]]]:
         """-> (found, entry_dict_or_None-for-tombstone) or None if absent."""
@@ -120,6 +136,37 @@ class _Segment:
             pos += vlen
             out.append(((key[0], key[1]), val))
         return out
+
+    def arena_segment(self):
+        """Immutable DeviceColumnArena descriptor: the segment's keys as
+        a SORTED u64 hash column, offs carrying the permutation back to
+        the original row (so a device hit decodes to `keys[off]` /
+        `_value(off)` host-side), sizes all-ones (unused; the kernel's
+        column layout wants one). Built once and cached — segments never
+        change content. No bloom: filer stores cap at max_segments=4, so
+        the pre-filter buys little here."""
+        seg = self._arena_seg
+        if seg is None:
+            import numpy as np
+
+            from ..ops.ragged_lookup import ArenaSegment
+
+            h = np.fromiter(
+                (path_hash64(d, n) for d, n in self.keys),
+                dtype=np.uint64,
+                count=len(self.keys),
+            )
+            perm = np.argsort(h, kind="stable").astype(np.uint32)
+            seg = self._arena_seg = ArenaSegment(
+                keys=np.ascontiguousarray(h[perm]),
+                offs=perm,
+                sizes=np.ones(len(perm), dtype=np.uint32),
+                source=self,
+                # compaction closes merged-away segments; the arena
+                # prunes them at its next refresh
+                alive=lambda s=self: not s._f.closed,
+            )
+        return seg
 
     def close(self) -> None:
         self._f.close()
@@ -370,6 +417,38 @@ class LsmFilerStore:
             for k in sorted(out)
             if self._current(k) is not None
         ]
+
+    def arena_view(self, paths: List[str]):
+        """One consistent view for a ragged device dispatch (the
+        needle map's `arena_view` twin): memtable hits host-side —
+        tombstones included, they must shadow the segments — plus the
+        current segment set newest-first as arena descriptors, both
+        taken under one lock acquisition."""
+        with self._lock:
+            mem_hits = {}
+            for p in paths:
+                k = _key(p)
+                if k in self._mem:
+                    mem_hits[p] = self._mem[k]
+            segments = [
+                s.arena_segment() for s in reversed(self._segments)
+            ]
+        return mem_hits, segments
+
+    def arena_decode(self, seg, row: int, path: str):
+        """Verify-and-decode one device hit against the segment the
+        arena answered from. Returns (ok, value) — ok False on a hash
+        collision or a segment compacted away underneath (caller
+        re-probes authoritatively); value None == tombstone."""
+        src = seg.source
+        key = _key(path)
+        try:
+            with self._lock:
+                if src.keys[row] != key:
+                    return False, None
+                return True, src._value(row)
+        except Exception:
+            return False, None
 
     def _current(self, key: Tuple[str, str]) -> Optional[dict]:
         if key in self._mem:

@@ -26,6 +26,7 @@ one store hit.
 from __future__ import annotations
 
 import asyncio
+import os
 from typing import Optional
 
 # below this many distinct paths the inline find_many is a few µs —
@@ -36,11 +37,33 @@ _EXECUTOR_THRESHOLD = 64
 class MetaLookupGate:
     """Coalesces concurrent path probes per event-loop wakeup and
     flushes them through `store.find_many` (falling back to per-path
-    `find_entry` on stores without the batched seam)."""
+    `find_entry` on stores without the batched seam).
 
-    def __init__(self, store, max_batch: int = 4096):
+    arena: a DeviceColumnArena routes each flush's distinct paths —
+    hashed to u64 via `lsm_store.path_hash64` — through ONE ragged
+    device dispatch over the store's resident segment hash columns
+    (ISSUE 18's filer path-spine leg); values decode host-side with a
+    collision/compaction verify, and ANY unavailability (cold arena,
+    killed arena, non-LSM store, device absent) silently serves the
+    host `find_many` instead. identity_check (default: env
+    SEAWEEDFS_TPU_ARENA_IDENTITY, on) re-answers from the host and
+    serves the host result on disagreement."""
+
+    def __init__(
+        self,
+        store,
+        max_batch: int = 4096,
+        arena=None,
+        identity_check: Optional[bool] = None,
+    ):
         self.store = store
         self.max_batch = max_batch
+        self.arena = arena
+        if identity_check is None:
+            identity_check = (
+                os.environ.get("SEAWEEDFS_TPU_ARENA_IDENTITY", "1") != "0"
+            )
+        self.identity_check = identity_check
         self._pending: list[tuple] = []  # (paths tuple, future)
         self._count = 0
         self._flush_scheduled = False
@@ -52,6 +75,9 @@ class MetaLookupGate:
             "largest_batch": 0,
             "dedup_hits": 0,
             "chains": 0,
+            "device_batches": 0,
+            "host_fallbacks": 0,
+            "identity_mismatches": 0,
         }
 
     def lookup(self, path: str):
@@ -155,6 +181,31 @@ class MetaLookupGate:
         self._resolve_all(pending, found, None)
 
     def _find_many(self, distinct: list[str]) -> dict:
+        if self.arena is not None and distinct:
+            found = self._find_many_arena(distinct)
+            if found is not None:
+                if self.identity_check:
+                    host = self._find_many_host(distinct)
+                    if host != found:
+                        bad = sum(
+                            1
+                            for p in distinct
+                            if host.get(p) != found.get(p)
+                        )
+                        self.stats["identity_mismatches"] += bad
+                        try:
+                            from ..util.metrics import (
+                                NEEDLE_MAP_DEVICE_IDENTITY_MISMATCH,
+                            )
+
+                            NEEDLE_MAP_DEVICE_IDENTITY_MISMATCH.inc(bad)
+                        except ImportError:
+                            pass
+                        return host
+                return found
+        return self._find_many_host(distinct)
+
+    def _find_many_host(self, distinct: list[str]) -> dict:
         fm = getattr(self.store, "find_many", None)
         if fm is not None:
             return fm(distinct)
@@ -164,6 +215,70 @@ class MetaLookupGate:
             if e is not None:
                 out[p] = e
         return out
+
+    def _find_many_arena(self, distinct: list[str]):
+        """One ragged device dispatch for the whole flush; None means
+        'host-serve this flush' (never an error — the arena is an
+        accelerator, not an authority)."""
+        view = getattr(self.store, "arena_view", None)
+        decode = getattr(self.store, "arena_decode", None)
+        if view is None or decode is None:
+            self._note_fallback("no_arena_view")
+            return None
+        import numpy as np
+
+        from .entry import Entry
+        from .filer_store import _split
+        from .lsm_store import path_hash64
+
+        mem_hits, segments = view(distinct)
+        if segments is None:
+            self._note_fallback("no_segments")
+            return None
+        keys = np.fromiter(
+            (path_hash64(*_split(p)) for p in distinct),
+            dtype=np.uint64,
+            count=len(distinct),
+        )
+        try:
+            res = self.arena.probe_groups([(segments, keys)])[0]
+        except Exception:
+            res = None
+        if res is None:
+            self._note_fallback("arena_cold")
+            return None
+        out: dict = {}
+        for i, p in enumerate(distinct):
+            if p in mem_hits:
+                v = mem_hits[p]  # includes tombstones (None)
+            elif res["found"][i]:
+                ok, v = decode(
+                    segments[int(res["rank"][i])],
+                    int(res["off"][i]),
+                    p,
+                )
+                if not ok:
+                    # hash collision or segment compacted underneath:
+                    # authoritative host re-probe for this one path
+                    e = self.store.find_entry(p)
+                    if e is not None:
+                        out[p] = e
+                    continue
+            else:
+                continue  # absent on device == absent (no false negatives)
+            if v is not None:
+                out[p] = Entry.from_dict(v)
+        self.stats["device_batches"] += 1
+        return out
+
+    def _note_fallback(self, reason: str) -> None:
+        self.stats["host_fallbacks"] += 1
+        try:
+            from ..util.metrics import NEEDLE_MAP_DEVICE_FALLBACKS
+
+            NEEDLE_MAP_DEVICE_FALLBACKS.inc(reason=reason)
+        except ImportError:
+            pass
 
     @staticmethod
     def _resolve_all(pending: list, found, exc) -> None:

@@ -19,6 +19,7 @@ in serving overlaps with device compute.
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +44,20 @@ def _bulk_lookup(steps: int, khi, klo, offsets, sizes, phi, plo):
 
 def _search_range(steps: int, khi, klo, offsets, sizes, phi, plo, lo, hi):
     n = khi.shape[0]
+    return _search_range_bounded(
+        steps, khi, klo, offsets, sizes, phi, plo, lo, hi, n
+    )
+
+
+def _search_range_bounded(
+    steps: int, khi, klo, offsets, sizes, phi, plo, lo, hi, end
+):
+    """The shared binary-search body with a per-probe exclusive upper
+    bound `end` on where a hit may land. For a single-table search `end`
+    is just n; the ragged arena kernel (ops/ragged_lookup.py) passes each
+    probe's segment end so a search that walks off its segment's last row
+    can never match an equal key at the start of the NEXT segment."""
+    n = khi.shape[0]
 
     def body(_, carry):
         lo, hi = carry
@@ -54,7 +69,7 @@ def _search_range(steps: int, khi, klo, offsets, sizes, phi, plo, lo, hi):
 
     lo, hi = jax.lax.fori_loop(0, steps, body, (lo, hi))
     idx = jnp.minimum(lo, n - 1)
-    found = (lo < n) & (khi[idx] == phi) & (klo[idx] == plo)
+    found = (lo < end) & (khi[idx] == phi) & (klo[idx] == plo)
     return (
         jnp.where(found, offsets[idx], 0),
         jnp.where(found, sizes[idx], 0),
@@ -109,6 +124,17 @@ class IndexSnapshot:
     def __init__(self, keys: np.ndarray, offsets: np.ndarray, sizes: np.ndarray):
         assert len(keys) == len(offsets) == len(sizes)
         self.n = len(keys)
+        # reusable probe staging (ISSUE 18 satellite): one padded buffer
+        # set per snapshot, grown to the largest batch seen, written
+        # in-place per call — a gate flush no longer allocates 5 fresh
+        # arrays (pad + hi/lo split + bucket + u64 scratch) per wakeup
+        self._stage_lock = threading.Lock()
+        self._stage_cap = 0
+        self._stage_keys = None  # u64[cap]
+        self._stage_tmp = None  # u64[cap] scratch for split/bucket math
+        self._stage_hi = None  # u32[cap]
+        self._stage_lo = None  # u32[cap]
+        self._stage_bucket = None  # i32[cap]
         keys, khi, klo, off_u32, sizes_u32 = self.prepare_host_columns(
             keys, offsets, sizes
         )
@@ -159,6 +185,40 @@ class IndexSnapshot:
         b = (p - np.uint64(self.kmin)) // np.uint64(self.bstep)
         return np.minimum(b, np.uint64(self.nb - 1)).astype(np.int32)
 
+    def _stage(self, probe_keys: np.ndarray, p2: int):
+        """Pad + hi/lo split (+ bucket) written into the snapshot's
+        reusable staging buffers, in place. Returns (phi, plo, bucket)
+        u32/u32/i32 views of length p2 (bucket is None when unbucketed).
+        The caller must hold `_stage_lock` until the device upload has
+        consumed the views (jnp.asarray copies on upload)."""
+        p = len(probe_keys)
+        if self._stage_cap < p2:
+            self._stage_cap = p2
+            self._stage_keys = np.zeros(p2, dtype=np.uint64)
+            self._stage_tmp = np.zeros(p2, dtype=np.uint64)
+            self._stage_hi = np.zeros(p2, dtype=np.uint32)
+            self._stage_lo = np.zeros(p2, dtype=np.uint32)
+            self._stage_bucket = np.zeros(p2, dtype=np.int32)
+        pk = self._stage_keys[:p2]
+        tmp = self._stage_tmp[:p2]
+        phi = self._stage_hi[:p2]
+        plo = self._stage_lo[:p2]
+        pk[:p] = probe_keys
+        pk[p:] = 0
+        np.right_shift(pk, np.uint64(32), out=tmp)
+        np.copyto(phi, tmp, casting="unsafe")
+        np.bitwise_and(pk, np.uint64(0xFFFFFFFF), out=tmp)
+        np.copyto(plo, tmp, casting="unsafe")
+        if self.starts is None:
+            return phi, plo, None
+        bucket = self._stage_bucket[:p2]
+        np.maximum(pk, np.uint64(self.kmin), out=tmp)
+        np.subtract(tmp, np.uint64(self.kmin), out=tmp)
+        np.floor_divide(tmp, np.uint64(self.bstep), out=tmp)
+        np.minimum(tmp, np.uint64(self.nb - 1), out=tmp)
+        np.copyto(bucket, tmp, casting="unsafe")
+        return phi, plo, bucket
+
     def lookup(self, probe_keys: np.ndarray):
         """probe_keys u64[P] -> (offset_units u32[P], sizes u32[P], found bool[P])."""
         if self.n == 0:
@@ -170,36 +230,57 @@ class IndexSnapshot:
         # pad the batch to a power of two so arbitrary client batch sizes
         # don't each compile (and cache) a fresh executable
         p2 = max(64, 1 << (p - 1).bit_length())
-        if p2 != p:
-            probe_keys = np.pad(probe_keys, (0, p2 - p))
-        phi, plo = _split_u64(probe_keys)
-        if self.starts is not None:
-            off, size, found = _bulk_lookup_bucketed(
-                self.bsteps,
-                self.khi,
-                self.klo,
-                self.offsets,
-                self.sizes,
-                self.starts,
-                jnp.asarray(phi),
-                jnp.asarray(plo),
-                jnp.asarray(self._bucket_of(probe_keys)),
+        # concurrent probers (two gate flushes overlapping in the
+        # executor) can't share the staging buffers; the loser of the
+        # try-lock pays the old allocate-per-call path instead of waiting
+        locked = self._stage_lock.acquire(blocking=False)
+        try:
+            if locked:
+                phi, plo, bucket = self._stage(probe_keys, p2)
+            else:
+                padded = (
+                    np.pad(probe_keys, (0, p2 - p)) if p2 != p else probe_keys
+                )
+                phi, plo = _split_u64(padded)
+                bucket = (
+                    self._bucket_of(padded)
+                    if self.starts is not None
+                    else None
+                )
+            if self.starts is not None:
+                off, size, found = _bulk_lookup_bucketed(
+                    self.bsteps,
+                    self.khi,
+                    self.klo,
+                    self.offsets,
+                    self.sizes,
+                    self.starts,
+                    jnp.asarray(phi),
+                    jnp.asarray(plo),
+                    jnp.asarray(bucket),
+                )
+            else:
+                off, size, found = _bulk_lookup(
+                    self.steps,
+                    self.khi,
+                    self.klo,
+                    self.offsets,
+                    self.sizes,
+                    jnp.asarray(phi),
+                    jnp.asarray(plo),
+                )
+            # readback INSIDE the staging lock: np.asarray blocks until
+            # the dispatch consumed its inputs, so the next call can't
+            # overwrite the staging buffers under an in-flight program
+            # (jnp.asarray may alias host memory on the CPU backend)
+            return (
+                np.asarray(off)[:p],
+                np.asarray(size)[:p],
+                np.asarray(found)[:p],
             )
-        else:
-            off, size, found = _bulk_lookup(
-                self.steps,
-                self.khi,
-                self.klo,
-                self.offsets,
-                self.sizes,
-                jnp.asarray(phi),
-                jnp.asarray(plo),
-            )
-        return (
-            np.asarray(off)[:p],
-            np.asarray(size)[:p],
-            np.asarray(found)[:p],
-        )
+        finally:
+            if locked:
+                self._stage_lock.release()
 
 
 from .snapshot_cache import SnapshotCache  # noqa: E402,F401  (re-export)

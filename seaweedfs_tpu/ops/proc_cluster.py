@@ -221,6 +221,7 @@ class ProcCluster:
         pulse_seconds: float = 0.25,
         ready_timeout: float = 30.0,
         needle_map: str = "memory",
+        batch_lookup: str = "off",
         max_volumes: int = 50,
     ):
         self.root = os.path.abspath(root)
@@ -234,6 +235,7 @@ class ProcCluster:
         self.pulse_seconds = pulse_seconds
         self.ready_timeout = ready_timeout
         self.needle_map = needle_map
+        self.batch_lookup = batch_lookup
         self.max_volumes = max_volumes
         self.children: dict[str, Child] = {}
         self.fault_events: list[dict] = []
@@ -355,6 +357,7 @@ class ProcCluster:
                     "-max", str(self.max_volumes),
                     "-mserver", maddr,
                     "-index", self.needle_map,
+                    "-batchLookup", self.batch_lookup,
                 ],
             )
 

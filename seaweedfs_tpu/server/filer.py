@@ -315,14 +315,26 @@ class FilerServer:
         )
         # gate-batched metadata lookups (ISSUE 15): concurrent read-path
         # probes coalesce per event-loop wakeup into one columnar
-        # find_many (parallel across shards on a sharded store)
+        # find_many (parallel across shards on a sharded store).
+        # SEAWEEDFS_TPU_META_GATE=device (ISSUE 18) additionally routes
+        # each flush through the ragged device arena — path-spine chains
+        # become one dispatch over resident segment hash columns, with
+        # automatic host fallback whenever the arena can't answer
         self.meta_gate = None
         import os as _os
 
-        if (_os.environ.get("SEAWEEDFS_TPU_META_GATE", "1") or "1") != "0":
+        _mg = _os.environ.get("SEAWEEDFS_TPU_META_GATE", "1") or "1"
+        if _mg != "0":
             from ..filer.meta_gate import MetaLookupGate
 
-            self.meta_gate = MetaLookupGate(self.filer.store)
+            if _mg == "device":
+                from ..ops.ragged_lookup import get_default_arena
+
+                self.meta_gate = MetaLookupGate(
+                    self.filer.store, arena=get_default_arena()
+                )
+            else:
+                self.meta_gate = MetaLookupGate(self.filer.store)
         self.master_client = MasterClient(f"filer@{self.address}", [master])
         # chunk GC state: pending (fid, attempts, host) triples ("" host =
         # resolve holders at drain time) + the drain condition the batched

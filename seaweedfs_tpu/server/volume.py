@@ -269,12 +269,15 @@ def _decode_keys(req: dict):
     return np.asarray(raw, dtype=np.uint64)
 
 
-def _make_needle_map_debug(store):
+def _make_needle_map_debug(store, arena=None, gate=None):
     """/debug/needle_map handler: per-volume + aggregate bloom-sidecar
     economics (LsmNeedleMap.bloom_stats) for every live volume whose map
-    kind carries filters. A plain closure over the Store so the debug
-    middleware holds leaf state, never the server object (cycle warning
-    on serving_core._make_debug_middleware)."""
+    kind carries filters, plus — when the arena backend is on — the
+    DeviceColumnArena's residency/eviction/dispatch stats and the gate's
+    device-vs-fallback counters (the soak harness scrapes this to prove
+    host fallback from OUTSIDE the process). Plain closures over leaf
+    state, never the server object (cycle warning on
+    serving_core._make_debug_middleware)."""
 
     async def handler(request):
         per_volume = {}
@@ -293,11 +296,16 @@ def _make_needle_map_debug(store):
             round(agg["negatives"] / agg["probes"], 4)
             if agg["probes"] else 0.0
         )
-        return web.json_response({
+        body = {
             "kind": store.needle_map_kind,
             "aggregate": agg,
             "volumes": per_volume,
-        })
+        }
+        if arena is not None:
+            body["device"] = arena.stats()
+        if gate is not None:
+            body["gate"] = dict(gate.stats)
+        return web.json_response(body)
 
     return handler
 
@@ -371,9 +379,20 @@ class VolumeServer(EcHandlers):
         self._group_committers: dict[int, object] = {}
         self._replica_loc_cache: dict[int, tuple[float, list]] = {}
         # cross-request probe batching (north-star #2 serving path):
-        # off | auto (bulk_lookup's device policy) | host | device
+        # off | auto (bulk_lookup's device policy) | host | device |
+        # arena (ISSUE 18: the whole wakeup as ONE ragged dispatch over
+        # the HBM-resident column arena, host fallback when cold/absent)
         self.lookup_gate = None
-        if batch_lookup not in ("off", "", None):
+        self.lookup_arena = None
+        if batch_lookup == "arena":
+            from ..ops.ragged_lookup import get_default_arena
+            from .lookup_gate import BatchLookupGate
+
+            self.lookup_arena = get_default_arena()
+            self.lookup_gate = BatchLookupGate(
+                self.store, arena=self.lookup_arena
+            )
+        elif batch_lookup not in ("off", "", None):
             from .lookup_gate import BatchLookupGate
 
             self.lookup_gate = BatchLookupGate(
@@ -443,7 +462,11 @@ class VolumeServer(EcHandlers):
             # soak harness scrapes this to disclose sidecar hit rates
             # from OUTSIDE the process
             debug_handlers={
-                "/debug/needle_map": _make_needle_map_debug(self.store)
+                "/debug/needle_map": _make_needle_map_debug(
+                    self.store,
+                    arena=self.lookup_arena,
+                    gate=self.lookup_gate,
+                )
             },
         )
         await self._core.start(app)

@@ -127,18 +127,26 @@ def _mix64_scalar(x: int) -> int:
     return x
 
 
+def mix64_batch(keys: np.ndarray) -> np.ndarray:
+    """Vectorized murmur3 finalizer — MUST agree bit-for-bit with
+    `_mix64_scalar` (shared by the sidecar build, the ragged device
+    kernel's host-side bloom addressing, and the scalar probe path)."""
+    h = np.asarray(keys, dtype=np.uint64).copy()
+    h ^= h >> np.uint64(33)
+    h *= np.uint64(0xFF51AFD7ED558CCD)
+    h ^= h >> np.uint64(33)
+    h *= np.uint64(0xC4CEB9FE1A85EC53)
+    h ^= h >> np.uint64(33)
+    return h
+
+
 def _write_bloom(run_path: str, keys: np.ndarray) -> None:
     """Sidecar `<run>.bf` built from the sealed run's key column
     (vectorized double hashing; tmp + rename so a torn write is never
     loaded)."""
     count = len(keys)
     mbits, k = _bloom_geometry(count)
-    h = keys.astype(np.uint64, copy=True)
-    h ^= h >> np.uint64(33)
-    h *= np.uint64(0xFF51AFD7ED558CCD)
-    h ^= h >> np.uint64(33)
-    h *= np.uint64(0xC4CEB9FE1A85EC53)
-    h ^= h >> np.uint64(33)
+    h = mix64_batch(keys)
     mask = np.uint64(mbits - 1)
     h1 = h & mask
     h2 = (h >> np.uint64(32)) | np.uint64(1)
@@ -280,10 +288,12 @@ class _Run:
     __slots__ = (
         "path", "count", "tombs", "keys", "offs", "sizes",
         "bloom", "bloom_k", "bloom_mbits", "bloom_probes", "bloom_neg",
+        "_arena_seg",
     )
 
     def __init__(self, path: str):
         self.path = path
+        self._arena_seg = None  # lazily-built DeviceColumnArena descriptor
         self.bloom = None
         self.bloom_k = 0
         self.bloom_mbits = 0
@@ -401,6 +411,36 @@ class _Run:
 
     def columns(self):
         return self.keys, self.offs, self.sizes
+
+    def arena_segment(self):
+        """Immutable DeviceColumnArena descriptor for this run, built
+        once and cached (runs never change content, so residency keyed
+        by the descriptor's handle can never go stale). The bloom
+        sidecar's bitmap rides along as a u32 word view over the same
+        mmap — the device-side pre-filter for multi-run probes."""
+        seg = self._arena_seg
+        if seg is None:
+            from ...ops.ragged_lookup import ArenaSegment
+
+            bloom_words = None
+            mbits = 0
+            if self.bloom is not None and self.bloom_k == 2:
+                bloom_words = np.frombuffer(
+                    memoryview(self.bloom)[_BLOOM_BASE:], dtype="<u4"
+                )
+                mbits = self.bloom_mbits
+            seg = self._arena_seg = ArenaSegment(
+                keys=self.keys,
+                offs=self.offs,
+                sizes=self.sizes,
+                bloom_words=bloom_words,
+                bloom_mbits=mbits,
+                source=self,
+                # compaction closes superseded runs; the arena prunes
+                # them at its next refresh instead of re-pinning forever
+                alive=lambda run=self: run.keys is not None,
+            )
+        return seg
 
     def close(self) -> None:
         # np.memmap holds the mapping via ._mmap; dropping the views is
@@ -977,6 +1017,28 @@ class LsmNeedleMap:
 
     def snapshot_token(self) -> int:
         return self._mutations
+
+    def arena_view(self, keys):
+        """One consistent view for a ragged device dispatch: under the
+        map lock, probe the MEMTABLE host-side for every key (cheap dict
+        hits; includes tombstones, which must shadow the runs) and hand
+        back the current run set as newest-first arena descriptors. The
+        two move together under the lock on purpose: a memtable flush
+        between them would seal keys into a run the device batch never
+        probes. Returns (mem_hits {key: (offset_units, size)}, segments
+        newest-first) — segments is None when this map can't feed the
+        arena (5-byte offsets exceed the kernel's u32 columns)."""
+        if OFFSET_SIZE != 4:
+            return {}, None
+        with self._lock:
+            mem = self._mem
+            mem_hits = {}
+            for k in keys:
+                v = mem.get(int(k))
+                if v is not None:
+                    mem_hits[int(k)] = v
+            segments = [r.arena_segment() for r in reversed(self._runs)]
+        return mem_hits, segments
 
     def ascending_visit(self, visit) -> None:
         keys, offs, sizes = self._merged_columns(drop_tombstones=False)

@@ -654,6 +654,57 @@ NEEDLE_MAP_TAIL_REPLAY = REGISTRY.counter(
     "(the O(tail) mount cost actually paid)",
 )
 
+# metadata device-kernel plane (ISSUE 18, see docs/perf.md "Metadata
+# device kernel"): the ragged-batch lookup arena made observable —
+# what is pinned HBM-resident, how often whole gate wakeups run as one
+# device dispatch vs fall back to host maps, and the identity-check
+# verdicts that keep the arena an accelerator rather than an authority
+NEEDLE_MAP_DEVICE_RESIDENT = REGISTRY.gauge(
+    "seaweedfs_tpu_needle_map_device_resident_bytes",
+    "bytes of sealed-run index columns pinned device-resident by the "
+    "current DeviceColumnArena generation (LRU-bounded by "
+    "SEAWEEDFS_TPU_ARENA_MB)",
+)
+NEEDLE_MAP_DEVICE_SEGMENTS = REGISTRY.gauge(
+    "seaweedfs_tpu_needle_map_device_segments",
+    "sealed segments resident in the current DeviceColumnArena "
+    "generation (needle-map runs and filer path-spine segments share "
+    "one arena)",
+)
+NEEDLE_MAP_DEVICE_DISPATCHES = REGISTRY.counter(
+    "seaweedfs_tpu_needle_map_device_dispatches_total",
+    "ragged-batch lookup dispatches answered on the device (one per "
+    "gate wakeup routed to the arena, regardless of how many volumes "
+    "or spine chains it spanned)",
+)
+NEEDLE_MAP_DEVICE_PROBES = REGISTRY.counter(
+    "seaweedfs_tpu_needle_map_device_probes_total",
+    "(key, segment) probe slots answered by ragged device dispatches "
+    "(a key probing a 4-run volume counts 4)",
+)
+NEEDLE_MAP_DEVICE_FALLBACKS = REGISTRY.counter(
+    "seaweedfs_tpu_needle_map_device_fallbacks_total",
+    "gate flushes served by the host maps instead of the arena, by "
+    "reason (cold arena, device absent, arena killed, oversize "
+    "offsets)",
+)
+NEEDLE_MAP_DEVICE_UPLOADS = REGISTRY.counter(
+    "seaweedfs_tpu_needle_map_device_uploads_total",
+    "double-buffered arena generation uploads completed (each builds "
+    "the next resident set while the previous keeps serving)",
+)
+NEEDLE_MAP_DEVICE_EVICTIONS = REGISTRY.counter(
+    "seaweedfs_tpu_needle_map_device_evictions_total",
+    "segments denied residency by the arena's LRU byte budget at a "
+    "generation refresh",
+)
+NEEDLE_MAP_DEVICE_IDENTITY_MISMATCH = REGISTRY.counter(
+    "seaweedfs_tpu_needle_map_device_identity_mismatch_total",
+    "device answers that disagreed with the host map under the "
+    "identity check (the host answer is served; any non-zero value is "
+    "a kernel bug)",
+)
+
 # cold-tier plane (ISSUE 14, see docs/perf.md "Cold tier"): the
 # hot→warm→cold arc's third band made observable — bytes moved between
 # local disk and the remote backend by direction, per-holder recall

@@ -654,6 +654,51 @@ def test_needle_map_lookup_leg_shape():
     assert any(pr["negatives"] > 0 for pr in bl["per_run"])
 
 
+def test_needle_map_device_lookup_leg_shape():
+    """ISSUE 18 guard: the needle_map.device_lookup leg must be a
+    MEASURED end-to-end run through the real gate seam — non-zero
+    pack/upload/dispatch/readback stage walls that partition the kernel
+    wall, entry-wise identity asserted in-leg, the scraped batch-size
+    distribution disclosed, and a device_status provenance label."""
+    r = bench.measure_needle_map_device_lookup(
+        n_volumes=2, entries_per_volume=9000, window_s=0.25,
+        concurrency=192,
+    )
+    # stage walls: each stage really ran and together they partition the
+    # kernel wall (python bookkeeping keeps coverage a bit under 1.0)
+    st = r["kernel"]["stage_breakdown"]
+    for k in ("pack_s", "upload_s", "dispatch_s", "readback_s"):
+        assert st[k] > 0, k
+    assert 0.7 <= st["coverage_of_wall"] <= 1.3
+    assert r["kernel"]["dispatches"] > 0
+    assert r["kernel"]["probes_per_s"] > 0
+    # identity: every device batch identity-checked plus a dict-oracle
+    # pass, zero mismatches anywhere
+    ident = r["identity"]
+    assert ident["checked_every_dispatch"] is True
+    assert ident["device_batches_checked"] > 0
+    assert ident["gate_mismatches"] == 0
+    assert ident["oracle_checked"] > 0 and ident["oracle_mismatches"] == 0
+    assert ident["ok"] is True
+    # the scored window really routed through the arena backend
+    assert r["device_gate"]["device_batches"] > 0
+    assert r["host_gate"]["probes_per_s"] > 0
+    assert r["overhead_x_p99"] > 0
+    # scraped ragged batch-size distribution disclosed (drives the
+    # kernel leg's dispatch shapes)
+    assert r["batch_size_dist"] and sum(
+        r["batch_size_dist"].values()
+    ) > 0
+    # provenance: stand-in runs must label the kernel number as such
+    assert r["device_status"] in ("tpu", "cpu_standin", "cpu")
+    if r["device_status"] != "tpu":
+        assert r["kernel"]["standin"] is True
+        assert "stand-in" in r["note"]
+    assert r["runs_per_volume"] and all(
+        c >= 1 for c in r["runs_per_volume"]
+    )
+
+
 def test_device_history_appends_per_emit(tmp_path, monkeypatch):
     """ISSUE 6 satellite: every bench emit appends {run, device_status}
     to DEVICE_HISTORY.jsonl so stand-in runs stop erasing the record of

@@ -736,21 +736,31 @@ def test_cold_tier_full_loop_e2e(tmp_path, monkeypatch):
                         for vs in cluster.volume_servers
                     )
 
+                async def _reheat_read(fid):
+                    locs = master._do_lookup(str(vid_hot)).get(
+                        "locations"
+                    )
+                    if locs:
+                        try:
+                            await read_url(
+                                session,
+                                f"http://{locs[0]['url']}/{fid}",
+                            )
+                        except Exception:
+                            pass
+
                 for _ in range(120):
                     if recalled():
                         break
-                    for fid in hot_fids:
-                        locs = master._do_lookup(str(vid_hot)).get(
-                            "locations"
+                    # concurrent reads, several rounds per lifecycle
+                    # tick: under full-suite load the heartbeat that
+                    # carries heat to the master can lag whole decay
+                    # half-lives, so the read rate must drive heat WELL
+                    # past the recall threshold, not marginally over it
+                    for _ in range(3):
+                        await asyncio.gather(
+                            *(_reheat_read(fid) for fid in hot_fids)
                         )
-                        if locs:
-                            try:
-                                await read_url(
-                                    session,
-                                    f"http://{locs[0]['url']}/{fid}",
-                                )
-                            except Exception:
-                                pass
                     r = await master.run_lifecycle_once()
                     assert "error" not in r, r
                     await asyncio.sleep(0.2)

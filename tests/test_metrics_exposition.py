@@ -167,6 +167,20 @@ def test_metrics_hygiene_lint():
         "seaweedfs_tpu_tier_orphans_swept_total",
     ):
         assert family in names, f"meta-plane family {family} not registered"
+    # metadata device-kernel plane (ISSUE 18): pin the ragged arena
+    # families (residency, dispatch/fallback economics, double-buffer
+    # uploads, LRU evictions, and the identity-check verdict counter)
+    for family in (
+        "seaweedfs_tpu_needle_map_device_resident_bytes",
+        "seaweedfs_tpu_needle_map_device_segments",
+        "seaweedfs_tpu_needle_map_device_dispatches_total",
+        "seaweedfs_tpu_needle_map_device_probes_total",
+        "seaweedfs_tpu_needle_map_device_fallbacks_total",
+        "seaweedfs_tpu_needle_map_device_uploads_total",
+        "seaweedfs_tpu_needle_map_device_evictions_total",
+        "seaweedfs_tpu_needle_map_device_identity_mismatch_total",
+    ):
+        assert family in names, f"device-kernel family {family} not registered"
 
 
 def test_tenant_label_cardinality_enforced_at_registry_seam():

@@ -1,0 +1,416 @@
+"""Ragged-batch device lookup plane (ISSUE 18).
+
+Covers the four contracts the arena must keep:
+- packing correctness: seeded ragged batches (random segment counts and
+  lengths, empty segments, single-probe tails) answered in one dispatch
+  agree entry-wise with per-segment host `IndexSnapshot.lookup` AND a
+  plain dict oracle;
+- double-buffer safety: a probe in flight during a generation swap
+  stays byte-identical (generations are immutable; the swap is a
+  pointer);
+- LRU eviction: a byte budget denies residency to the least-recently
+  ensured segments and the arena says so (cold -> host fallback), it
+  never serves wrong answers;
+- proven host fallback on BOTH dispatch-capable paths: killing the
+  arena under the volume lookup gate and under the filer meta gate
+  degrades to host lookups with zero identity violations.
+"""
+
+import asyncio
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops.index_kernel import IndexSnapshot
+from seaweedfs_tpu.ops.ragged_lookup import (
+    ArenaSegment,
+    DeviceColumnArena,
+)
+
+
+def _make_segment(rng, n, key_space=1_000_000):
+    if n:
+        keys = np.sort(
+            rng.choice(
+                np.arange(1, key_space, dtype=np.uint64),
+                size=n,
+                replace=False,
+            )
+        )
+    else:
+        keys = np.zeros(0, dtype=np.uint64)
+    offs = rng.integers(1, 1 << 30, size=n).astype(np.uint32)
+    sizes = rng.integers(1, 1 << 20, size=n).astype(np.uint32)
+    return ArenaSegment(keys=keys, offs=offs, sizes=sizes)
+
+
+def _host_answer(segments, key):
+    """Newest-first host oracle over raw columns."""
+    for rank, s in enumerate(segments):
+        i = np.searchsorted(s.keys, np.uint64(key))
+        if i < s.count and s.keys[i] == key:
+            return rank, int(s.offs[i]), int(s.sizes[i])
+    return None
+
+
+@pytest.mark.parametrize("seed", [0, 7, 23])
+def test_ragged_packing_matches_host_and_oracle(seed):
+    """Random segment shapes — including EMPTY segments and single-probe
+    tail groups — packed into one dispatch agree with per-segment
+    IndexSnapshot.lookup and with the dict oracle, entry-wise."""
+    rng = np.random.default_rng(seed)
+    arena = DeviceColumnArena()
+    try:
+        groups = []
+        for gi in range(5):
+            n_segs = int(rng.integers(1, 5))
+            sizes_pool = [0, 1, 3, 97, 800, 5000]
+            segs = [
+                _make_segment(rng, int(rng.choice(sizes_pool)))
+                for _ in range(n_segs)
+            ]
+            # probes: known keys from random segments + guaranteed misses
+            # + a single-probe tail group at the end
+            known = [
+                int(s.keys[rng.integers(0, s.count)])
+                for s in segs
+                for _ in range(3)
+                if s.count
+            ]
+            misses = rng.integers(
+                2_000_000, 3_000_000, size=4, dtype=np.uint64
+            ).tolist()
+            probes = np.asarray(known + misses, dtype=np.uint64)
+            if gi == 4:  # single-probe tail
+                probes = probes[:1]
+            groups.append((segs, probes))
+        for segs, _p in groups:
+            arena.ensure(segs)
+        arena.refresh_sync()
+        results = arena.probe_groups(groups)
+        assert all(r is not None for r in results)
+        for (segs, probes), res in zip(groups, results):
+            # per-segment host snapshots (skip empties: IndexSnapshot
+            # requires rows; an empty run can't answer anything anyway)
+            snaps = [
+                (rank, IndexSnapshot(s.keys, s.offs, s.sizes))
+                for rank, s in enumerate(segs)
+                if s.count
+            ]
+            for i, key in enumerate(probes.tolist()):
+                want = _host_answer(segs, key)
+                got = (
+                    (
+                        int(res["rank"][i]),
+                        int(res["off"][i]),
+                        int(res["size"][i]),
+                    )
+                    if res["found"][i]
+                    else None
+                )
+                assert got == want, (key, got, want)
+                # cross-check against the single-table device kernel
+                snap_hit = None
+                for rank, snap in snaps:
+                    o, s_, f = snap.lookup(
+                        np.asarray([key], dtype=np.uint64)
+                    )
+                    if bool(f[0]):
+                        snap_hit = (rank, int(o[0]), int(s_[0]))
+                        break
+                assert snap_hit == want, (key, snap_hit, want)
+    finally:
+        arena.close()
+
+
+def test_segment_end_bound_blocks_cross_segment_match():
+    """A probe whose own segment lacks the key must NOT match an equal
+    key living in the NEXT segment's rows (the `end` bound in
+    _search_range_bounded) — the regression the bounded search exists
+    for."""
+    rng = np.random.default_rng(1)
+    shared = np.asarray([500_000], dtype=np.uint64)
+    a = ArenaSegment(
+        keys=np.asarray([1, 2], dtype=np.uint64),
+        offs=np.asarray([11, 12], dtype=np.uint32),
+        sizes=np.asarray([1, 1], dtype=np.uint32),
+    )
+    b = ArenaSegment(
+        keys=shared,
+        offs=np.asarray([99], dtype=np.uint32),
+        sizes=np.asarray([7], dtype=np.uint32),
+    )
+    arena = DeviceColumnArena()
+    try:
+        arena.ensure([a, b])
+        arena.refresh_sync()
+        # group probing ONLY segment a: 500000 must be absent even
+        # though segment b (adjacent rows in the arena) holds it
+        res = arena.probe_groups([([a], shared)])[0]
+        assert res is not None
+        assert not res["found"][0]
+        # and via both segments it IS found, from b (rank 1)
+        res2 = arena.probe_groups([([a, b], shared)])[0]
+        assert res2["found"][0] and int(res2["rank"][0]) == 1
+        assert int(res2["off"][0]) == 99
+    finally:
+        arena.close()
+
+
+def test_refresh_race_probe_stays_byte_identical():
+    """Probes racing a double-buffered generation swap return byte-
+    identical answers throughout: in-flight dispatches keep their
+    reference to the old immutable generation while the new one
+    uploads."""
+    rng = np.random.default_rng(3)
+    arena = DeviceColumnArena()
+    try:
+        segs = [_make_segment(rng, 3000), _make_segment(rng, 900)]
+        probes = np.concatenate(
+            [
+                segs[0].keys[rng.integers(0, 3000, size=40)],
+                rng.integers(2_000_000, 3_000_000, size=8, dtype=np.uint64),
+            ]
+        )
+        arena.ensure(segs)
+        arena.refresh_sync()
+        baseline = arena.probe_groups([(segs, probes)])[0]
+        assert baseline is not None
+        errs: list = []
+        stop = threading.Event()
+
+        def prober():
+            while not stop.is_set():
+                res = arena.probe_groups([(segs, probes)])[0]
+                if res is None:
+                    errs.append("went cold during refresh")
+                    return
+                for k in ("found", "rank", "off", "size"):
+                    if not np.array_equal(res[k], baseline[k]):
+                        errs.append(f"{k} diverged during swap")
+                        return
+
+        t = threading.Thread(target=prober)
+        t.start()
+        try:
+            # churn generations underneath the prober
+            for _ in range(6):
+                extra = _make_segment(rng, 1200)
+                arena.ensure(segs + [extra])
+                arena.refresh_sync()
+        finally:
+            stop.set()
+            t.join(10)
+        assert not errs, errs
+    finally:
+        arena.close()
+
+
+def test_lru_eviction_under_byte_budget():
+    """Segments past the byte budget lose residency least-recently-
+    ensured first; probing an evicted set answers None (host fallback),
+    never wrong data."""
+    rng = np.random.default_rng(5)
+    seg_a = _make_segment(rng, 4000)  # 64 KB columns each
+    seg_b = _make_segment(rng, 4000)
+    budget = seg_a.nbytes + seg_b.nbytes // 2  # fits one, not both
+    arena = DeviceColumnArena(budget_bytes=budget)
+    try:
+        arena.ensure([seg_a])
+        arena.refresh_sync()
+        assert arena.probe_groups([([seg_a], seg_a.keys[:5])])[0] is not None
+        # touch b more recently; the next refresh must evict a
+        arena.ensure([seg_b])
+        arena.refresh_sync()
+        assert arena.counters["evictions"] > 0
+        assert arena.probe_groups([([seg_b], seg_b.keys[:5])])[0] is not None
+        res_a = arena.probe_groups([([seg_a], seg_a.keys[:5])])[0]
+        assert res_a is None  # cold -> caller host-serves
+        st = arena.stats()
+        assert st["resident_bytes"] <= budget
+    finally:
+        arena.close()
+
+
+def _build_lsm_volume(tmp_path, rng, vid, n=3000):
+    from seaweedfs_tpu.storage.needle_map.lsm_map import LsmNeedleMap
+
+    nm = LsmNeedleMap(
+        os.path.join(str(tmp_path), f"v{vid}.idx"), memtable_bytes=1
+    )
+    keys = rng.choice(
+        np.arange(1, 400_000, dtype=np.uint64), size=n, replace=False
+    )
+    for i, k in enumerate(keys.tolist()):
+        nm.put(int(k), i + 1, 100 + (i % 50))
+    for k in keys[:25].tolist():
+        nm.delete(int(k), 0)
+    return nm, keys
+
+
+class _Vol:
+    def __init__(self, nm):
+        self.nm = nm
+
+
+class _Store:
+    def __init__(self):
+        self.vols = {}
+
+    def find_volume(self, vid):
+        return self.vols.get(vid)
+
+
+def test_volume_gate_arena_kill_degrades_to_host(tmp_path, monkeypatch):
+    """The volume needle-map gate's proven host fallback: warm arena
+    serves device batches; killing it mid-stream degrades every later
+    wakeup to host lookups with zero identity violations."""
+    from seaweedfs_tpu.server import lookup_gate as lg
+
+    monkeypatch.setattr(lg, "_ARENA_MIN_WAKEUP", 8)
+    rng = np.random.default_rng(11)
+    store = _Store()
+    nms = {}
+    for vid in (1, 2):
+        nm, keys = _build_lsm_volume(tmp_path, rng, vid)
+        store.vols[vid] = _Vol(nm)
+        nms[vid] = keys
+    arena = DeviceColumnArena()
+    gate = lg.BatchLookupGate(store, arena=arena, identity_check=True)
+    try:
+
+        async def probe_round(n):
+            futs, checks = [], []
+            for vid in (1, 2):
+                keys = nms[vid]
+                for k in rng.integers(1, 400_000, size=n).tolist():
+                    futs.append(gate.lookup(vid, int(k)))
+                    checks.append((vid, int(k)))
+                for k in keys[30:50].tolist():
+                    futs.append(gate.lookup(vid, int(k)))
+                    checks.append((vid, int(k)))
+            res = await asyncio.gather(*futs)
+            for (vid, k), r in zip(checks, res):
+                nv = store.vols[vid].nm.get(k)
+                from seaweedfs_tpu.types import TOMBSTONE_FILE_SIZE
+
+                want = (
+                    (nv.offset_units, nv.size)
+                    if nv is not None
+                    and nv.offset_units != 0
+                    and nv.size != TOMBSTONE_FILE_SIZE
+                    else None
+                )
+                assert r == want, (vid, k, r, want)
+
+        async def main():
+            await probe_round(60)  # cold -> host fallback
+            arena.refresh_sync()
+            await probe_round(60)  # warm -> device
+            assert gate.stats["device_batches"] > 0
+            arena.kill()  # chaos: arena dies mid-serving
+            await probe_round(60)  # degraded -> host, still correct
+            assert gate.stats["identity_mismatches"] == 0
+            assert gate.stats["host_fallbacks"] > 0
+
+        asyncio.run(main())
+    finally:
+        gate.close()
+        arena.close()
+        for v in store.vols.values():
+            v.nm.close()
+
+
+def test_meta_gate_arena_kill_degrades_to_host(tmp_path):
+    """The filer path-spine resolution path's proven host fallback:
+    ragged spine chains answered by the arena, then by the host after a
+    kill — entry-for-entry identical throughout."""
+    from seaweedfs_tpu.filer.entry import Entry
+    from seaweedfs_tpu.filer.lsm_store import LsmFilerStore
+    from seaweedfs_tpu.filer.meta_gate import MetaLookupGate
+
+    store = LsmFilerStore(
+        str(tmp_path / "filer"), memtable_limit=40, fsync=False
+    )
+    arena = DeviceColumnArena()
+    gate = MetaLookupGate(store, arena=arena, identity_check=True)
+    paths = []
+    try:
+        for i in range(300):
+            p = f"/b/dir{i % 9}/f-{i}"
+            store.insert_entry(Entry(full_path=p))
+            paths.append(p)
+        for p in paths[:8]:
+            store.delete_entry(p)
+
+        async def spine_round():
+            futs = [
+                gate.lookup_many(
+                    [p, "/b", f"/b/dir{i % 9}", f"/miss-{i}"]
+                )
+                for i, p in enumerate(paths[5:90])
+            ]
+            rs = await asyncio.gather(*futs)
+            for (i, p), r in zip(enumerate(paths[5:90]), rs):
+                if p in paths[:8]:
+                    assert r[0] is None
+                else:
+                    assert r[0] is not None and r[0].full_path == p
+                assert r[3] is None  # the miss slot
+
+        async def main():
+            await spine_round()  # cold -> host
+            arena.refresh_sync()
+            await spine_round()  # warm -> device
+            assert gate.stats["device_batches"] > 0
+            arena.kill()
+            await spine_round()  # degraded -> host
+            assert gate.stats["identity_mismatches"] == 0
+            assert gate.stats["host_fallbacks"] > 0
+
+        asyncio.run(main())
+    finally:
+        gate.close()
+        arena.close()
+        store.close()
+
+
+def test_filer_tombstone_and_memtable_shadowing(tmp_path):
+    """Memtable state — including tombstones — must shadow device
+    answers from sealed segments: delete a sealed path, re-insert
+    another, both visible correctly through the arena path."""
+    from seaweedfs_tpu.filer.entry import Entry
+    from seaweedfs_tpu.filer.lsm_store import LsmFilerStore
+    from seaweedfs_tpu.filer.meta_gate import MetaLookupGate
+
+    store = LsmFilerStore(
+        str(tmp_path / "filer"), memtable_limit=20, fsync=False
+    )
+    arena = DeviceColumnArena()
+    gate = MetaLookupGate(store, arena=arena, identity_check=False)
+    try:
+        paths = [f"/d/f-{i}" for i in range(60)]
+        for p in paths:
+            store.insert_entry(Entry(full_path=p))
+        # all sealed now (memtable_limit 20); mutate IN the memtable
+        store.delete_entry(paths[0])
+        store.insert_entry(
+            Entry(full_path=paths[1], extended={"v": "new"})
+        )
+        arena.ensure(store.arena_view(paths)[1])
+        arena.refresh_sync()
+
+        async def main():
+            r = await gate.lookup_many([paths[0], paths[1], paths[2]])
+            assert r[0] is None  # memtable tombstone shadows segment
+            assert r[1] is not None and r[1].extended.get("v") == "new"
+            assert r[2] is not None
+            assert gate.stats["device_batches"] > 0
+
+        asyncio.run(main())
+    finally:
+        gate.close()
+        arena.close()
+        store.close()
