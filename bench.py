@@ -4707,6 +4707,301 @@ def measure_production_soak(
     return out
 
 
+def measure_geo_soak(
+    pre_files: int = 30,
+    during_files: int = 30,
+    post_files: int = 15,
+    payload_bytes: int = 2048,
+    partition_start_s: float = 12.0,
+    partition_duration_s: float = 8.0,
+    lag_bound_s: float = 30.0,
+    drain_timeout_s: float = 60.0,
+    p99_ceiling_ms: float = 500.0,
+    time_cap_s: float = 240.0,
+    seed: int = 7,
+) -> dict:
+    """soak.geo leg (ISSUE 19): two REAL subprocess clusters in two DCs
+    with async geo-replication between them, scored through a WAN
+    partition.
+
+    Cluster A (dc-a) is the primary; cluster B (dc-b) runs a filer with
+    `-geoSource` tailing A's meta-log and shipping chunk bytes. B's filer
+    child carries a windowed `wan_partition_plan` naming EVERY listen
+    address of A (HTTP + gRPC twins), so `partition_duration_s` seconds
+    of hard WAN cut fire inside the subprocess `partition_start_s`
+    seconds after it imports — all cross-cluster traffic originates at
+    the second site, so cutting its egress IS the WAN link.
+
+    Phases: (1) pre-corpus on A, wait for B to converge (replication
+    provably live before the cut); (2) keep writing on A through the
+    partition window while sampling A-side read latency and B's
+    GeoStatus (connected flag, lag); (3) after heal, write a post batch
+    and wait for full drain, then diff the namespaces byte-for-byte.
+
+    SLO terms (vs_baseline = 1 only if ALL hold): primary writes NEVER
+    failed during the cut; the cut was actually observed (disconnect or
+    lag >= half the window — a leg that never partitioned proves
+    nothing); post-heal lag drains under `lag_bound_s`; ZERO lost and
+    ZERO duplicated mutations (namespace diff: no missing, no extra, no
+    byte mismatch — split-brain shows up as extra/mismatch); primary
+    same-DC read p99 under `p99_ceiling_ms` THROUGH the partition."""
+    import asyncio
+    import hashlib
+    import shutil
+    import tempfile
+
+    from seaweedfs_tpu.ops.proc_cluster import (
+        ProcCluster,
+        sum_metric,
+        wan_partition_plan,
+    )
+    from seaweedfs_tpu.pb import grpc_address
+    from seaweedfs_tpu.pb.rpc import Stub
+
+    d = tempfile.mkdtemp(
+        prefix="bench_geo_",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None,
+    )
+    out: dict = {
+        "seed": seed,
+        "partition": {
+            "start_s": partition_start_s,
+            "duration_s": partition_duration_s,
+            "scope": "second-site egress (all cross-cluster calls)",
+        },
+    }
+    t_leg0 = time.perf_counter()
+
+    def capped() -> bool:
+        return time.perf_counter() - t_leg0 > time_cap_s
+
+    def payload(i: int) -> bytes:
+        h = hashlib.sha256(f"{seed}:{i}".encode()).digest()
+        return (h * (payload_bytes // len(h) + 1))[:payload_bytes]
+
+    a = ProcCluster(
+        os.path.join(d, "A"), volumes=1, filers=1,
+        data_center="dc-a", racks=["r0"], durable_filers=True,
+    )
+    b = None
+    try:
+        a.start()
+        fa = a.address("filer-0")
+        a_addrs = [a.master_address, a.address("volume-0"), fa]
+        plan = wan_partition_plan(
+            a_addrs, start=partition_start_s,
+            duration=partition_duration_s, seed=seed,
+        )
+        b = ProcCluster(
+            os.path.join(d, "B"), volumes=1, filers=1,
+            data_center="dc-b", racks=["r0"], durable_filers=True,
+            geo_source=fa, fault_plans={"filer-0": plan},
+        )
+        b.start()
+        fb = b.address("filer-0")
+        out["pids"] = {"A": a.pids(), "B": b.pids()}
+    except Exception as e:
+        out["error"] = f"cluster start: {type(e).__name__}: {e}"
+        if b is not None:
+            b.stop()
+        a.stop()
+        shutil.rmtree(d, ignore_errors=True)
+        return out
+    t_b_up = time.perf_counter()
+
+    async def body() -> None:
+        from seaweedfs_tpu.ops.loadgen import LogHistogram
+        from seaweedfs_tpu.util.fasthttp import FastHTTPClient
+
+        http = FastHTTPClient(pool_per_host=16)
+        fa = a.address("filer-0")
+        fb = b.address("filer-0")
+        geo_stub = Stub(grpc_address(fb), "filer")
+        written: list = []
+        write_failures = 0
+        read_hist = LogHistogram()
+        geo_samples: list = []
+        max_lag = 0.0
+        disconnects = 0
+
+        async def put(i: int) -> None:
+            nonlocal write_failures
+            st, _ = await http.request(
+                "PUT", fa, f"/geo/f{i}.bin", body=payload(i),
+                content_type="application/octet-stream", timeout=10.0,
+            )
+            if st in (200, 201):
+                written.append(i)
+            else:
+                write_failures += 1
+
+        async def sample_geo() -> dict:
+            nonlocal max_lag, disconnects
+            try:
+                g = await geo_stub.call("GeoStatus", {}, timeout=5.0)
+            except Exception as e:
+                g = {"error": str(e)}
+            geo_samples.append(g)
+            if g.get("configured"):
+                max_lag = max(max_lag, float(g.get("last_lag_seconds", 0)))
+                if not g.get("connected"):
+                    disconnects += 1
+            return g
+
+        async def wait_applied(target: int, timeout: float) -> bool:
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < timeout and not capped():
+                g = await sample_geo()
+                if int(g.get("applied", 0)) >= target:
+                    return True
+                await asyncio.sleep(0.3)
+            return False
+
+        try:
+            # ---- phase 1: pre-corpus, prove replication is live ----
+            for i in range(pre_files):
+                await put(i)
+            out["pre_converged"] = await wait_applied(pre_files, 30.0)
+
+            # ---- phase 2: write THROUGH the partition window ----
+            # the window clock starts when the B-filer CHILD imports the
+            # faults module (env-delivered plans are install-relative),
+            # which is up to a few seconds before b.start() returned —
+            # so the window opens somewhere in [t_b_up + start_s -
+            # startup_gap, t_b_up + start_s]. Pace the during-writes
+            # EVENLY across the whole plausible span so several
+            # mutations are guaranteed to land inside the cut, instead
+            # of bursting them before it opens.
+            t_end = t_b_up + partition_start_s + partition_duration_s + 4.0
+            span = max(t_end - time.perf_counter() - 1.0, 1.0)
+            pace = span / max(during_files, 1)
+            i = pre_files
+            next_put = time.perf_counter()
+            while time.perf_counter() < t_end and not capped():
+                if (
+                    i < pre_files + during_files
+                    and time.perf_counter() >= next_put
+                ):
+                    await put(i)
+                    i += 1
+                    next_put += pace
+                # same-DC read against the PRIMARY filer: the partition
+                # must not touch it
+                j = written[i % len(written)] if written else 0
+                t0 = time.perf_counter()
+                st, body_b = await http.request(
+                    "GET", fa, f"/geo/f{j}.bin", timeout=10.0
+                )
+                if st == 200:
+                    read_hist.record(time.perf_counter() - t0)
+                await sample_geo()
+                await asyncio.sleep(min(pace, 0.3))
+            while i < pre_files + during_files and not capped():
+                await put(i)
+                i += 1
+
+            # ---- phase 3: heal, post batch, drain, verify ----
+            for k in range(during_files + pre_files,
+                           pre_files + during_files + post_files):
+                await put(k)
+            total = len(written)
+            out["drained"] = await wait_applied(total, drain_timeout_s)
+
+            missing = extra = mismatch = 0
+            for k in written:
+                st, got = await http.request(
+                    "GET", fb, f"/geo/f{k}.bin", timeout=10.0
+                )
+                if st != 200:
+                    missing += 1
+                elif bytes(got) != payload(k):
+                    mismatch += 1
+            ls = await Stub(grpc_address(fb), "filer").call(
+                "ListEntries", {"directory": "/geo", "limit": 4096},
+                timeout=10.0,
+            )
+            peer_names = {
+                e["full_path"].rsplit("/", 1)[-1]
+                for e in ls.get("entries", [])
+                if not e.get("is_directory")
+            }
+            extra = len(
+                peer_names - {f"f{k}.bin" for k in written}
+            )
+            g = await sample_geo()
+            # ground truth from INSIDE the child: the partition seam's
+            # own fire counter, scraped off the B-filer /metrics
+            try:
+                pm = b.scrape_metrics("filer-0")
+                faults_fired = int(
+                    sum_metric(pm, "seaweedfs_tpu_faults_injected_total")
+                )
+            except Exception:
+                faults_fired = -1
+
+            out.update(
+                files_written=total,
+                write_failures=write_failures,
+                missing_on_peer=missing,
+                extra_on_peer=extra,
+                byte_mismatches=mismatch,
+                applied=int(g.get("applied", 0)),
+                skipped=int(g.get("skipped", 0)),
+                retried=int(g.get("retried", 0)),
+                resync_required=bool(g.get("resync_required")),
+                max_lag_s=round(max_lag, 3),
+                post_heal_lag_s=float(g.get("last_lag_seconds", 0.0)),
+                lag_p99_s=float(g.get("lag_p99_seconds", 0.0)),
+                disconnect_samples=disconnects,
+                partition_faults_fired=faults_fired,
+                # "observed" needs BOTH the seam firing in-child AND a
+                # visible degradation signal (stalled apply shows up as
+                # lag >= a quarter of the window, a cut stream as a
+                # disconnect or retry) — a window that expired during
+                # child startup proves nothing and must fail the SLO
+                partition_observed=(
+                    faults_fired > 0
+                    and (
+                        disconnects > 0
+                        or int(g.get("retried", 0)) > 0
+                        or max_lag >= partition_duration_s * 0.25
+                    )
+                ),
+                primary_read_p99_ms=round(
+                    read_hist.percentile(99) * 1e3, 2
+                )
+                if read_hist.count
+                else None,
+                time_capped=capped(),
+            )
+            out["slo"] = {
+                "writes_survived_partition": write_failures == 0,
+                "partition_observed": out["partition_observed"],
+                "zero_lost": missing == 0 and mismatch == 0,
+                "zero_dup": extra == 0,
+                "lag_drained": bool(out["drained"])
+                and out["post_heal_lag_s"] <= lag_bound_s,
+                "primary_p99_held": (
+                    read_hist.count > 0
+                    and read_hist.percentile(99) * 1e3 <= p99_ceiling_ms
+                ),
+                "no_resync_required": not out["resync_required"],
+            }
+            out["slo"]["pass"] = all(out["slo"].values())
+        finally:
+            await http.close()
+
+    try:
+        asyncio.run(body())
+    except Exception as e:
+        out.setdefault("error", f"{type(e).__name__}: {e}")
+    finally:
+        b.stop()
+        a.stop()
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def _dispatch_tracing_overhead_us(sample: float, iters: int = 100000) -> float:
     """Per-request cost of the tracing plane on the serving fast path,
     measured in situ as (enabled block) - (disabled check): a tight loop
@@ -8044,6 +8339,83 @@ def main() -> None:
         pass
     except Exception as e:
         extra.append({"metric": "soak.production", "error": str(e)[:200]})
+
+    try:
+        if not budgeted("soak.geo", 150):
+            raise _Skip()
+        gk = measure_geo_soak(
+            pre_files=int(os.environ.get("BENCH_GEO_PRE_FILES", 30)),
+            during_files=int(
+                os.environ.get("BENCH_GEO_DURING_FILES", 30)
+            ),
+            post_files=int(os.environ.get("BENCH_GEO_POST_FILES", 15)),
+            partition_duration_s=float(
+                os.environ.get("BENCH_GEO_PARTITION_S", 8.0)
+            ),
+            time_cap_s=min(240.0, max(120.0, remaining() - 60.0)),
+        )
+        gslo = gk.get("slo", {})
+        extra.append(
+            {
+                "metric": "geo.replication_lag",
+                "value": gk.get("lag_p99_s"),
+                "unit": "seconds (p99)",
+                "vs_baseline": None,
+                "detail": {
+                    k: gk.get(k)
+                    for k in (
+                        "max_lag_s",
+                        "post_heal_lag_s",
+                        "applied",
+                        "skipped",
+                        "retried",
+                        "partition",
+                    )
+                },
+                "note": "cross-DC async replication lag p99 from the "
+                "second site's GeoStatus histogram (event-ts to "
+                "applied-on-peer), measured across the SAME run as "
+                "soak.geo — the tail includes the WAN-partition window, "
+                "so it is an upper bound on steady-state lag",
+            }
+        )
+        extra.append(
+            {
+                "metric": "soak.geo",
+                "value": gk.get("files_written"),
+                "unit": "files replicated cross-DC",
+                "vs_baseline": 1.0 if gslo.get("pass") else 0.0,
+                "partition_observed": gk.get("partition_observed"),
+                "missing_on_peer": gk.get("missing_on_peer"),
+                "extra_on_peer": gk.get("extra_on_peer"),
+                "byte_mismatches": gk.get("byte_mismatches"),
+                "primary_read_p99_ms": gk.get("primary_read_p99_ms"),
+                "time_capped": gk.get("time_capped"),
+                "detail": gk,
+                "note": "two-site geo soak (ISSUE 19 tentpole): TWO real "
+                "multi-process clusters in dc-a/dc-b, the second site's "
+                "filer tailing the primary's durable meta-log "
+                "(-geoSource) and shipping chunk bytes, with a windowed "
+                "WAN partition (wan_partition_plan on the second site's "
+                "filer child: every primary listen address, HTTP + gRPC "
+                "twins) cutting the link mid-run; value = files written "
+                "on the primary, all byte-verified on the peer after "
+                "heal; vs_baseline = 1 only if EVERY SLO term holds: "
+                "primary writes never failed during the cut, the "
+                "partition was actually observed (disconnect or lag >= "
+                "half the window), post-heal lag drained under bound, "
+                "ZERO lost and ZERO duplicated mutations (namespace "
+                "diff: no missing/extra/mismatched files on the peer — "
+                "split-brain would surface as extra or mismatch), "
+                "primary same-DC read p99 held THROUGH the partition, "
+                "and no full-resync was required (cursor resumed "
+                "exactly)",
+            }
+        )
+    except _Skip:
+        pass
+    except Exception as e:
+        extra.append({"metric": "soak.geo", "error": str(e)[:200]})
 
     try:
         if not budgeted("serving_write_budget", 25):

@@ -45,12 +45,21 @@ class ReplicaReader:
         hedge_floor_s: float = 0.002,
         hedge_cap_s: float = 0.25,
         min_samples: int = 100,
+        cross_dc_hedge_s: float = 0.05,
     ):
         self.http = http
         self.vid_map = vid_map
         self.hedge_floor_s = hedge_floor_s
         self.hedge_cap_s = hedge_cap_s
         self.min_samples = min_samples
+        # latency budget before hedging across a DC boundary (ISSUE 19):
+        # a DC-aware vid map orders same-DC replicas first, so when the
+        # next hedge target is REMOTE the p99 trigger is floored at this
+        # budget — a local blip shorter than the budget waits out the
+        # local primary instead of paying a WAN round-trip. Correctness
+        # hedges (error cross-check, dead-primary failover) ignore it:
+        # a wrong answer is worse than a slow one.
+        self.cross_dc_hedge_s = cross_dc_hedge_s
         # how long an ERROR answer (exception / 404 / 5xx) waits for a
         # slower peer that might still produce a 200 before being
         # accepted: generous relative to the hedge cap (the error might
@@ -63,6 +72,8 @@ class ReplicaReader:
         self.hedge_wins = 0  # reads answered by the hedge, not the primary
         self.hedges_suppressed = 0  # hedges withheld: target pool was
         # shedding / breaker open, or the shared retry budget ran dry
+        self.cross_dc_hedges_deferred = 0  # latency hedges whose trigger
+        # was raised to the cross-DC budget (remote next-replica)
         self._vid_of: dict[str, int] = {}  # fid -> vid memo (fids are
         # immutable strings; the split+int per read is measurable at
         # serving QPS rates on a shared core)
@@ -110,6 +121,20 @@ class ReplicaReader:
     def _blocked(reg, url: str) -> bool:
         br = reg.peek(url)
         return br is not None and br.blocked()
+
+    def _cross_dc(self, url: str) -> bool:
+        """Whether `url` sits in a different data center than this
+        reader's vid map. Duck-typed: plain VidMaps (and the bare stand-ins
+        tests use) have no DC labels and always read as local."""
+        vm = self.vid_map
+        local = getattr(vm, "local_dc", "")
+        if not local:
+            return False
+        dc_of = getattr(vm, "location_dc", None)
+        if dc_of is None:
+            return False
+        dc = dc_of(url)
+        return bool(dc) and dc != local
 
     def _may_hedge(self, peer: str, correctness: bool = False) -> bool:
         """Gate every EXTRA request: paused while the target is shedding
@@ -169,13 +194,21 @@ class ReplicaReader:
             return await self.http.request("GET", order[0], target)
         t0 = time.perf_counter()
 
+        threshold = self.hedge_threshold()
+        if self._cross_dc(order[1]):
+            # next replica is across the WAN: only hedge past the
+            # cross-DC latency budget (a local p99 blip is cheaper to
+            # wait out than a remote round-trip is to launch)
+            if self.cross_dc_hedge_s > threshold:
+                threshold = self.cross_dc_hedge_s
+                self.cross_dc_hedges_deferred += 1
         primary = asyncio.ensure_future(
             self.http.request("GET", order[0], target)
         )
         fast = None
         try:
             fast = await asyncio.wait_for(
-                asyncio.shield(primary), self.hedge_threshold()
+                asyncio.shield(primary), threshold
             )
         except asyncio.TimeoutError:
             pass
@@ -339,5 +372,6 @@ class ReplicaReader:
             "hedges": self.hedges,
             "hedge_wins": self.hedge_wins,
             "hedges_suppressed": self.hedges_suppressed,
+            "cross_dc_hedges_deferred": self.cross_dc_hedges_deferred,
             "hedge_threshold_ms": round(self.hedge_threshold() * 1e3, 2),
         }

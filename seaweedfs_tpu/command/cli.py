@@ -489,6 +489,24 @@ def cmd_filer(argv: list[str]) -> int:
     p.add_argument("-notifyBucket", default="")
     p.add_argument("-notifyAccessKey", default="")
     p.add_argument("-notifySecretKey", default="")
+    p.add_argument(
+        "-dataCenter",
+        default="",
+        help="this filer's data center label: reads prefer same-DC "
+        "replicas, geo-shipped chunks land on same-DC volumes",
+    )
+    p.add_argument(
+        "-geoSource",
+        default="",
+        help="PRIMARY cluster filer (host:port) to geo-replicate FROM: "
+        "this filer becomes the second site, tailing the primary's "
+        "meta-log under an exactly-resuming durable cursor",
+    )
+    p.add_argument(
+        "-geoState",
+        default="",
+        help="durable geo cursor file (default: <-store>.geo.json)",
+    )
     _apply_config_defaults(p, argv, ["filer", "security", "notification"])
     args = p.parse_args(argv)
     from ..notification import Notifier, build_sink
@@ -520,6 +538,9 @@ def cmd_filer(argv: list[str]) -> int:
         cipher=args.encryptVolumeData,
         shards=args.shards,
         meta_log_path=args.metaLog,
+        data_center=args.dataCenter,
+        geo_source=args.geoSource,
+        geo_state_path=args.geoState,
     )
     print(f"filer listening on {args.ip}:{args.port}")
     asyncio.run(_run_forever(fs))

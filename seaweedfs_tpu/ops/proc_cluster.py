@@ -48,7 +48,7 @@ import urllib.request
 from dataclasses import dataclass, field
 from typing import Optional
 
-from ..util.faults import ProcessFault
+from ..util.faults import FaultPlan, ProcessFault, partition
 
 # distinct band from bench.py's _free_port_pair (18200-19200): a soak
 # leg running inside the bench process must not race its threaded legs
@@ -59,6 +59,40 @@ _GRPC_OFFSET = 10000
 
 class StartupError(RuntimeError):
     """A child failed to come up (probe timeout or early exit)."""
+
+
+def wan_partition_plan(
+    peer_addrs: list,
+    start: float = 0.0,
+    duration: Optional[float] = None,
+    seed: int = 0,
+) -> FaultPlan:
+    """A per-child fault plan cutting the WAN toward `peer_addrs` (the
+    OTHER cluster's listen addresses, host:port): every RPC/HTTP call
+    from the child toward any of those addresses raises ConnectionError
+    for `duration` seconds starting `start` seconds after the child
+    imports (ISSUE 19 cross-cluster partition seam).
+
+    Install it on BOTH clusters' children (each side gets a plan naming
+    the OTHER side's addresses) via ``fault_plans={"*": plan}`` — the cut
+    is then bidirectional at every process boundary, exactly like a
+    firewalled inter-DC link. Windows are measured per-child from import,
+    so sides that spawned seconds apart cut within that skew of each
+    other; bound assertions accordingly."""
+    plan = FaultPlan(seed=seed)
+    for addr in peer_addrs:
+        plan.add(partition(a=addr, start=start, duration=duration))
+        # gRPC twins live at port+offset: cut them with the same window,
+        # or metadata streams survive while chunk HTTP dies
+        host, _, port = str(addr).rpartition(":")
+        try:
+            g = int(port) + _GRPC_OFFSET
+        except ValueError:
+            continue
+        plan.add(
+            partition(a=f"{host}:{g}", start=start, duration=duration)
+        )
+    return plan
 
 
 def free_port_pair(taken: Optional[set] = None) -> int:
@@ -223,6 +257,10 @@ class ProcCluster:
         needle_map: str = "memory",
         batch_lookup: str = "off",
         max_volumes: int = 50,
+        data_center: str = "",
+        racks: Optional[list] = None,
+        geo_source: str = "",
+        durable_filers: bool = False,
     ):
         self.root = os.path.abspath(root)
         self.n_volumes = volumes
@@ -237,6 +275,16 @@ class ProcCluster:
         self.needle_map = needle_map
         self.batch_lookup = batch_lookup
         self.max_volumes = max_volumes
+        # geo plane (ISSUE 19): DC label flows to every volume server
+        # (-dataCenter) and filer; racks (cycled per volume index) spread
+        # the cluster across failure domains; geo_source makes every
+        # filer a second-site replica tailing that PRIMARY filer; durable
+        # filers get sqlite stores + segmented meta logs + geo cursor
+        # files under root, so kill/restart resumes instead of wiping
+        self.data_center = data_center
+        self.racks = list(racks or [])
+        self.geo_source = geo_source
+        self.durable_filers = durable_filers
         self.children: dict[str, Child] = {}
         self.fault_events: list[dict] = []
         self._ports: set = set()
@@ -350,16 +398,18 @@ class ProcCluster:
             vp = self._port()
             vdir = os.path.join(self.root, f"vol{i}")
             os.makedirs(vdir, exist_ok=True)
-            self._add(
-                f"volume-{i}", "volume", vp,
-                [
-                    "-port", str(vp), "-dir", vdir,
-                    "-max", str(self.max_volumes),
-                    "-mserver", maddr,
-                    "-index", self.needle_map,
-                    "-batchLookup", self.batch_lookup,
-                ],
-            )
+            vargs = [
+                "-port", str(vp), "-dir", vdir,
+                "-max", str(self.max_volumes),
+                "-mserver", maddr,
+                "-index", self.needle_map,
+                "-batchLookup", self.batch_lookup,
+            ]
+            if self.data_center:
+                vargs += ["-dataCenter", self.data_center]
+            if self.racks:
+                vargs += ["-rack", self.racks[i % len(self.racks)]]
+            self._add(f"volume-{i}", "volume", vp, vargs)
 
         filer_ports = [self._port() for _ in range(self.n_filers)]
         for i, fp in enumerate(filer_ports):
@@ -370,6 +420,23 @@ class ProcCluster:
             fargs = ["-port", str(fp), "-master", maddr]
             if peers:
                 fargs += ["-peers", peers]
+            if self.data_center:
+                fargs += ["-dataCenter", self.data_center]
+            if self.durable_filers:
+                fargs += [
+                    "-store", os.path.join(self.root, f"filer{i}.db"),
+                    "-metaLog", os.path.join(self.root, f"filer{i}-mlog"),
+                ]
+            if self.geo_source:
+                fargs += ["-geoSource", self.geo_source]
+                if self.durable_filers:
+                    # a durable cursor only makes sense over a durable
+                    # namespace: resuming past events a wiped in-memory
+                    # store never kept would lose them
+                    fargs += [
+                        "-geoState",
+                        os.path.join(self.root, f"filer{i}-geo.json"),
+                    ]
             self._add(f"filer-{i}", "filer", fp, fargs)
 
         if self.with_s3:

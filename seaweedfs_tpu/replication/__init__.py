@@ -22,6 +22,9 @@ from ..notification import (
 )
 
 
+from .geo import GeoReplicator, fid_signature  # noqa: E402 (geo plane, ISSUE 19)
+
+
 class ReplicationSink:
     async def apply(self, event_type: str, path: str, entry: Optional[dict]) -> None:
         raise NotImplementedError

@@ -1,0 +1,510 @@
+"""Cross-cluster async geo-replication riding the durable meta log
+(ISSUE 19 tentpole 2).
+
+The notifier/sink replicator in this package is fire-and-forget: events
+ride an in-memory queue, a crash drops whatever was queued, and nothing
+resumes. `GeoReplicator` is the durable second-site path:
+
+- it runs inside the PEER cluster's filer process (`weed filer
+  -geoSource <primary-filer>`), tailing the primary's ``SubscribeMetadata``
+  gRPC stream from a **locally-durable cursor** (JSON, shadow-write +
+  rename — the fid-refs discipline), so a kill/restart at ANY point
+  resumes exactly where the last acked event left off;
+- the stream is opened with ``strict_resume``: when the primary's
+  `DurableMetaLog` has trimmed past the cursor the server reports the gap
+  and ends the stream instead of silently resuming past the hole — the
+  replicator then surfaces **full-resync required** (counted in
+  ``seaweedfs_tpu_geo_full_resync_required_total``, loud in the log, shown
+  by ``geo.status``) and halts rather than serving a namespace with
+  invisible holes;
+- chunk bytes ship through the cold-tier transfer discipline: fetch from
+  a primary volume holder by fid (explicit per-request timeouts), assign
+  fresh fids on the peer master, re-upload — all under bounded, jittered
+  retries (`retry_async`) capped by one absolute per-event deadline; the
+  HTTP client consults the fault plane, so a WAN partition cuts chunk
+  shipping exactly like it cuts the metadata stream;
+- application is **idempotent**: every applied entry is stamped with the
+  source event timestamp + a signature over its source fids
+  (``extended["geo_ts"]/["geo_sig"]``). Delivery is at-least-once (the
+  cursor acks AFTER apply), so a crash between apply and ack replays the
+  event — the stamp detects the replay and counts it as a dup skip
+  instead of double-applying. Exactly-once EFFECTS from at-least-once
+  delivery.
+
+Lag (now - event ts at apply time) feeds the
+``seaweedfs_tpu_geo_replication_lag_seconds`` histogram; applied /
+skipped / retried counters and a local p99 back the filer's ``GeoStatus``
+RPC and the ``geo.status`` shell command.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import random
+import time
+from typing import Callable, Optional
+
+from ..client.operation import assign
+from ..filer.entry import Entry, FileChunk
+from ..ops.loadgen import LogHistogram
+from ..pb import grpc_address
+from ..pb.rpc import Stub
+from ..util import log as _log
+from ..util.backoff import (
+    BackoffPolicy,
+    deadline_after,
+    remaining,
+    retry_async,
+)
+from ..util.fasthttp import FastHTTPClient
+from ..util.metrics import (
+    GEO_EVENTS_APPLIED,
+    GEO_EVENTS_RETRIED,
+    GEO_EVENTS_SKIPPED,
+    GEO_FULL_RESYNC_REQUIRED,
+    GEO_REPLICATION_LAG,
+)
+
+GEO_TS_KEY = "geo_ts"  # source event timestamp (ns) stamped on entries
+GEO_SIG_KEY = "geo_sig"  # signature over the SOURCE fids of that event
+
+
+def fid_signature(chunks: list) -> str:
+    """Deterministic signature over a chunk list's source fids + sizes.
+
+    The dedupe key is (event ts, this signature): two deliveries of one
+    source mutation carry identical fids, while a NEW mutation of the
+    same path — even one racing a replayed older event — differs in at
+    least one of the two. Order-independent (sorted) so a re-serialized
+    entry hashes the same."""
+    h = hashlib.sha256()
+    for part in sorted(f"{c.fid}:{c.size}" for c in chunks):
+        h.update(part.encode())
+        h.update(b"\0")
+    return h.hexdigest()[:32]
+
+
+class GeoReplicator:
+    """Tails a primary filer's metadata stream into a local Filer.
+
+    Parameters: `source` is the primary filer's HTTP address; `filer` the
+    peer cluster's in-process Filer; `master` the peer master (fresh-fid
+    assigns); `state_path` the durable cursor file; `data_center` the
+    peer's DC label (write affinity for re-uploaded chunks). `kill_hook`
+    is the crash-injection seam the kill-point grid test drives: called
+    with a point name at every point where a real process could die."""
+
+    RECONNECT_POLICY = BackoffPolicy(base=0.2, cap=5.0, attempts=1 << 30)
+    SHIP_POLICY = BackoffPolicy(base=0.05, cap=2.0, attempts=6)
+
+    def __init__(
+        self,
+        source: str,
+        filer,
+        master: str,
+        state_path: str,
+        data_center: str = "",
+        client_name: str = "",
+        apply_deadline_s: float = 30.0,
+        http: Optional[FastHTTPClient] = None,
+        kill_hook: Optional[Callable[[str], None]] = None,
+    ):
+        self.source = source
+        self.filer = filer
+        self.master = master
+        self.state_path = state_path
+        self.data_center = data_center
+        self.client_name = client_name or f"geo:{os.getpid()}"
+        self.apply_deadline_s = apply_deadline_s
+        self.kill_hook = kill_hook
+        self._http = http
+        self._own_http = http is None
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+        self.connected = False
+        self.resync_required = False
+        self.trimmed_through = 0  # primary watermark when resync tripped
+        self.cursor_ns = self._load_cursor()
+        self.applied = 0
+        self.skipped = 0
+        self.retried = 0
+        self.lag_hist = LogHistogram()
+        self.last_lag_s = 0.0
+        # primary-side fid -> holder urls, filled by LookupVolume against
+        # the PRIMARY master (learned from the source filer's
+        # GetFilerConfiguration — the replicator is configured with one
+        # address, the filer tells it the rest)
+        self._source_masters: list[str] = []
+        self._vid_urls: dict[int, list[str]] = {}
+
+    # ---------------- durable cursor ----------------
+    def _load_cursor(self) -> int:
+        if not self.state_path:
+            # no durable store behind this filer (in-memory namespace):
+            # a restart wipes the namespace, so resuming a persisted
+            # cursor would skip events the wiped store never kept —
+            # the cursor is memory-only and restarts re-tail from 0
+            return 0
+        try:
+            with open(self.state_path) as f:
+                st = json.load(f)
+            if st.get("source") not in ("", None, self.source):
+                # pointed at a DIFFERENT primary: a stale cursor from
+                # another cluster's stream would silently skip the new
+                # primary's history — start over, loudly
+                _log.warning(
+                    "geo cursor %s was for source %r, now %r: resetting",
+                    self.state_path, st.get("source"), self.source,
+                )
+                return 0
+            return int(st.get("since_ns", 0))
+        except (OSError, ValueError):
+            return 0
+
+    def _ack_cursor(self, ts_ns: int) -> None:
+        """Durable ack: shadow-write + atomic rename, fsynced — a crash
+        leaves either the old cursor (replay, deduped) or the new one,
+        never a torn file."""
+        self.cursor_ns = ts_ns
+        if not self.state_path:
+            return
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"since_ns": ts_ns, "source": self.source}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.state_path)
+
+    def _kill(self, point: str) -> None:
+        if self.kill_hook is not None:
+            self.kill_hook(point)
+
+    # ---------------- lifecycle ----------------
+    async def start(self) -> None:
+        if self._http is None:
+            self._http = FastHTTPClient(pool_per_host=16)
+        self._task = asyncio.ensure_future(self._run())
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        if self._own_http and self._http is not None:
+            await self._http.close()
+            self._http = None
+
+    def status(self) -> dict:
+        return {
+            "source": self.source,
+            "connected": self.connected,
+            "cursor_ns": self.cursor_ns,
+            "resync_required": self.resync_required,
+            "trimmed_through": self.trimmed_through,
+            "applied": self.applied,
+            "skipped": self.skipped,
+            "retried": self.retried,
+            "lag_p99_seconds": round(self.lag_hist.percentile(99), 4)
+            if self.lag_hist.count
+            else 0.0,
+            "last_lag_seconds": round(self.last_lag_s, 4),
+        }
+
+    # ---------------- the tail loop ----------------
+    async def _run(self) -> None:
+        failures = 0
+        while not self._stopped and not self.resync_required:
+            try:
+                await self._tail_once()
+                failures = 0
+            except asyncio.CancelledError:
+                return
+            except Exception as e:
+                _log.warning(
+                    "geo tail of %s: %s (%s)", self.source,
+                    e, type(e).__name__,
+                )
+            self.connected = False
+            if self._stopped or self.resync_required:
+                return
+            delay = self.RECONNECT_POLICY.delay(failures, random)
+            failures = min(failures + 1, 16)
+            await asyncio.sleep(delay)
+
+    async def _tail_once(self) -> None:
+        stub = Stub(grpc_address(self.source), "filer")
+        stream = stub.server_stream(
+            "SubscribeMetadata",
+            {
+                "client_name": self.client_name,
+                "path_prefix": "/",
+                "since_ns": self.cursor_ns,
+                "strict_resume": True,
+            },
+        )
+        async for msg in stream:
+            if msg.get("error") == "trimmed":
+                # primary retention outran our cursor: events in
+                # (cursor, trimmed_through] are GONE. Silently resuming
+                # past the hole would serve a namespace missing
+                # arbitrary mutations — halt and demand a full resync.
+                self.trimmed_through = int(msg.get("trimmed_through", 0))
+                self.resync_required = True
+                GEO_FULL_RESYNC_REQUIRED.inc()
+                _log.error(
+                    "geo replication from %s REQUIRES FULL RESYNC: "
+                    "cursor %d is behind primary retention (trimmed "
+                    "through %d) — events in between are unrecoverable "
+                    "from the stream",
+                    self.source, self.cursor_ns, self.trimmed_through,
+                )
+                return
+            self.connected = True
+            ts = int(msg.get("ts_ns", 0))
+            if ts <= self.cursor_ns:
+                # redelivery below the acked cursor (server redial
+                # replay): already applied-and-acked, skip without
+                # touching the store
+                GEO_EVENTS_SKIPPED.inc(reason="stale")
+                self.skipped += 1
+                continue
+            await self._apply_with_retry(msg)
+            self._kill("pre_ack")
+            self._ack_cursor(ts)
+            lag = max(time.time() - ts / 1e9, 0.0)
+            self.last_lag_s = lag
+            self.lag_hist.record(lag)
+            GEO_REPLICATION_LAG.observe(lag)
+
+    async def _apply_with_retry(self, msg: dict) -> None:
+        """One event, applied or died trying: replication is ORDERED, so
+        an event that cannot apply (partition mid-ship, peer brownout)
+        blocks the stream — lag grows and drains after heal. Each attempt
+        gets a bounded deadline; attempts repeat forever with capped
+        backoff. Skipping instead would be a silently lost mutation."""
+        failures = 0
+        while True:
+            try:
+                await self._apply_event(msg)
+                return
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                if self._stopped:
+                    raise
+                GEO_EVENTS_RETRIED.inc()
+                self.retried += 1
+                delay = self.SHIP_POLICY.delay(failures, random)
+                failures = min(failures + 1, 16)
+                _log.warning(
+                    "geo apply (ts %s) failed: %s (%s); retrying in %.2fs",
+                    msg.get("ts_ns"), e, type(e).__name__, delay,
+                )
+                await asyncio.sleep(delay)
+
+    # ---------------- applying one event ----------------
+    async def _apply_event(self, msg: dict) -> None:
+        ts = int(msg.get("ts_ns", 0))
+        notif = msg.get("event_notification") or {}
+        etype = notif.get("event_type", "")
+        old = notif.get("old_entry")
+        new = notif.get("new_entry")
+        self._kill("pre_apply")
+        if etype in ("create", "update") and new:
+            await self._apply_upsert(ts, new)
+        elif etype == "rename" and new:
+            await self._apply_rename(ts, old, new)
+        elif etype == "delete" and (old or new):
+            self._apply_delete(ts, old or new)
+        else:
+            GEO_EVENTS_SKIPPED.inc(reason="internal")
+            self.skipped += 1
+            return
+        self._kill("post_apply")
+
+    def _is_dup(self, path: str, ts: int, sig: str) -> bool:
+        existing = self.filer.find_entry(path)
+        if existing is None:
+            return False
+        try:
+            seen_ts = int(existing.extended.get(GEO_TS_KEY, 0))
+        except (TypeError, ValueError):
+            return False
+        if seen_ts > ts:
+            return True  # a NEWER source mutation already landed
+        return seen_ts == ts and existing.extended.get(GEO_SIG_KEY) == sig
+
+    async def _apply_upsert(self, ts: int, new: dict) -> None:
+        entry = Entry.from_dict(new)
+        sig = fid_signature(entry.chunks)
+        existed = self.filer.find_entry(entry.full_path) is not None
+        if existed and self._is_dup(entry.full_path, ts, sig):
+            GEO_EVENTS_SKIPPED.inc(reason="dup")
+            self.skipped += 1
+            return
+        if not entry.is_directory and entry.chunks:
+            entry.chunks = await self._ship_chunks(entry.chunks)
+        self._kill("post_ship")
+        entry.extended = dict(entry.extended or {})
+        entry.extended[GEO_TS_KEY] = str(ts)
+        entry.extended[GEO_SIG_KEY] = sig
+        self.filer.create_entry(entry)
+        GEO_EVENTS_APPLIED.inc(type="update" if existed else "create")
+        self.applied += 1
+
+    async def _apply_rename(
+        self, ts: int, old: Optional[dict], new: dict
+    ) -> None:
+        new_path = new["full_path"]
+        old_path = (old or {}).get("full_path", "")
+        sig = fid_signature(Entry.from_dict(new).chunks)
+        if self._is_dup(new_path, ts, sig):
+            GEO_EVENTS_SKIPPED.inc(reason="dup")
+            self.skipped += 1
+            return
+        if old_path and self.filer.find_entry(old_path) is not None:
+            # the shipped chunks already live under the old peer path:
+            # rename locally (chunk bytes stay put), then stamp
+            self.filer.rename(old_path, new_path)
+            entry = self.filer.find_entry(new_path)
+            if entry is not None:
+                entry.extended = dict(entry.extended or {})
+                entry.extended[GEO_TS_KEY] = str(ts)
+                entry.extended[GEO_SIG_KEY] = sig
+                self.filer.update_entry(entry)
+            GEO_EVENTS_APPLIED.inc(type="rename")
+            self.applied += 1
+            return
+        # old side never made it here (replayed past a prior dedupe, or
+        # the create was itself renamed away on the primary before our
+        # cursor reached it): apply as a fresh upsert of the new side
+        await self._apply_upsert(ts, new)
+
+    def _apply_delete(self, ts: int, old: dict) -> None:
+        path = old.get("full_path", "")
+        if not path:
+            GEO_EVENTS_SKIPPED.inc(reason="internal")
+            self.skipped += 1
+            return
+        if self.filer.find_entry(path) is None:
+            GEO_EVENTS_SKIPPED.inc(reason="dup")
+            self.skipped += 1
+            return
+        # delete_chunks=True frees the PEER-local copies (shipped fids —
+        # never the primary's; fids were re-assigned on this cluster)
+        self.filer.delete_entry(path, recursive=True, delete_chunks=True)
+        GEO_EVENTS_APPLIED.inc(type="delete")
+        self.applied += 1
+
+    # ---------------- chunk shipping (cold-tier discipline) ----------------
+    async def _source_master(self) -> str:
+        if not self._source_masters:
+            stub = Stub(grpc_address(self.source), "filer")
+            conf = await stub.call("GetFilerConfiguration", {}, timeout=10.0)
+            self._source_masters = list(conf.get("masters") or [])
+            if not self._source_masters:
+                raise RuntimeError(
+                    f"source filer {self.source} reports no masters"
+                )
+        return self._source_masters[0]
+
+    async def _source_urls(self, vid: int, deadline) -> list[str]:
+        urls = self._vid_urls.get(vid)
+        if urls:
+            return urls
+        master = await self._source_master()
+        stub = Stub(grpc_address(master), "master")
+        resp = await stub.call(
+            "LookupVolume",
+            {"volume_ids": [str(vid)]},
+            timeout=remaining(deadline, 10.0),
+        )
+        for r in resp.get("volume_id_locations", []):
+            urls = [loc["url"] for loc in r.get("locations", [])]
+        if not urls:
+            raise LookupError(f"volume {vid} unknown to primary {master}")
+        self._vid_urls[vid] = urls
+        return urls
+
+    async def _ship_chunks(self, chunks: list[FileChunk]) -> list[FileChunk]:
+        """Fetch every chunk's bytes from the primary and re-upload under
+        fresh peer fids -> the rewritten chunk list. Encrypted chunks ship
+        as ciphertext (the volume tier never saw plaintext on the primary
+        and never will here); cipher_key rides the entry metadata."""
+        deadline = deadline_after(self.apply_deadline_s)
+        out = []
+        for c in chunks:
+            out.append(await self._ship_one(c, deadline))
+        return out
+
+    async def _ship_one(self, c: FileChunk, deadline) -> FileChunk:
+        async def fetch():
+            vid = int(c.fid.split(",")[0])
+            urls = await self._source_urls(vid, deadline)
+            last: Optional[Exception] = None
+            for url in urls:
+                try:
+                    st, body = await self._http.request(
+                        "GET", url, "/" + c.fid,
+                        timeout=remaining(deadline, 15.0),
+                    )
+                except Exception as e:
+                    last = e
+                    continue
+                if st == 200:
+                    return bytes(body)
+                last = IOError(f"chunk {c.fid} @ {url}: status {st}")
+            self._vid_urls.pop(vid, None)  # holders may have moved
+            raise last or LookupError(c.fid)
+
+        data = await retry_async(
+            fetch, policy=self.SHIP_POLICY, deadline=deadline,
+            op="geo_fetch", budget=None,
+        )
+        self._kill("post_fetch")
+
+        async def upload():
+            ar = await assign(
+                self.master,
+                collection="",
+                data_center=self.data_center,
+            )
+            headers = (
+                {"Authorization": f"Bearer {ar.auth}"} if ar.auth else None
+            )
+            st, body = await self._http.request(
+                "POST", ar.url, "/" + ar.fid,
+                body=data,
+                content_type="application/octet-stream",
+                headers=headers,
+                timeout=remaining(deadline, 15.0),
+            )
+            if st >= 300:
+                raise IOError(
+                    f"geo upload {ar.fid}: status {st} {bytes(body)[:120]!r}"
+                )
+            try:
+                etag = json.loads(body).get("eTag", "")
+            except Exception:
+                etag = ""
+            return ar.fid, etag
+
+        fid, etag = await retry_async(
+            upload, policy=self.SHIP_POLICY, deadline=deadline,
+            op="geo_upload", budget=None,
+        )
+        return FileChunk(
+            fid=fid,
+            offset=c.offset,
+            size=c.size,
+            mtime_ns=c.mtime_ns,
+            etag=etag or c.etag,
+            cipher_key=c.cipher_key,
+        )

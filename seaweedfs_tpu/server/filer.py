@@ -253,6 +253,9 @@ class FilerServer:
         cipher: bool = False,
         shards: int = 0,
         meta_log_path: str = "",
+        data_center: str = "",
+        geo_source: str = "",
+        geo_state_path: str = "",
     ):
         self.master = master
         self.host = host
@@ -335,7 +338,20 @@ class FilerServer:
                 )
             else:
                 self.meta_gate = MetaLookupGate(self.filer.store)
-        self.master_client = MasterClient(f"filer@{self.address}", [master])
+        # the filer's own DC label: read affinity (the shared vid map
+        # orders same-DC replicas first) and geo write affinity
+        self.data_center = data_center
+        self.master_client = MasterClient(
+            f"filer@{self.address}", [master], data_center=data_center
+        )
+        # cross-cluster geo replication (ISSUE 19): when -geoSource names
+        # a PRIMARY cluster's filer, this filer is the second site — a
+        # GeoReplicator tails the primary's meta stream into our namespace
+        self.geo_source = geo_source
+        self.geo_state_path = geo_state_path or (
+            (store_path + ".geo.json") if store_path else ""
+        )
+        self.geo_replicator = None
         # chunk GC state: pending (fid, attempts, host) triples ("" host =
         # resolve holders at drain time) + the drain condition the batched
         # deletion loop sleeps on (no polling interval)
@@ -404,6 +420,7 @@ class FilerServer:
         svc.unary("AssignVolume")(self._grpc_assign_volume)
         svc.unary("Statistics")(self._grpc_statistics)
         svc.unary("GetFilerConfiguration")(self._grpc_configuration)
+        svc.unary("GeoStatus")(self._grpc_geo_status)
         svc.server_stream("SubscribeMetadata")(self._grpc_subscribe_metadata)
         svc.server_stream("SubscribeLocalMetadata")(
             self._grpc_subscribe_local_metadata
@@ -411,6 +428,19 @@ class FilerServer:
         self._grpc_server = await serve(grpc_address(self.address), svc)
         if self.meta_aggregator is not None:
             self.meta_aggregator.start()
+        if self.geo_source:
+            from ..replication.geo import GeoReplicator
+
+            self.geo_replicator = GeoReplicator(
+                self.geo_source,
+                self.filer,
+                self.master,
+                self.geo_state_path,
+                data_center=self.data_center,
+                client_name=f"geo@{self.address}",
+                http=self._chunk_http,
+            )
+            await self.geo_replicator.start()
         if hasattr(self.filer.store, "maybe_rebalance"):
             self._rebalance_task = asyncio.ensure_future(
                 self._rebalance_loop()
@@ -445,6 +475,8 @@ class FilerServer:
                 pass  # next tick retries; hysteresis bounds churn
 
     async def stop(self) -> None:
+        if self.geo_replicator is not None:
+            await self.geo_replicator.stop()
         if self.meta_aggregator is not None:
             await self.meta_aggregator.stop()
         if self._grpc_server is not None:
@@ -1081,6 +1113,17 @@ class FilerServer:
     async def _grpc_statistics(self, req, context) -> dict:
         return {"used_size": 0, "file_count": 0}
 
+    async def _grpc_geo_status(self, req, context) -> dict:
+        """Geo-replication state of THIS filer: the second-site tail
+        cursor, lag percentiles and applied/skipped/retried counters
+        (when -geoSource is set), surfaced by `geo.status`."""
+        if self.geo_replicator is None:
+            return {"configured": False, "data_center": self.data_center}
+        st = self.geo_replicator.status()
+        st["configured"] = True
+        st["data_center"] = self.data_center
+        return st
+
     async def _grpc_subscribe_metadata(self, req, context):
         """Stream namespace change events from since_ns onward — the
         AGGREGATE stream (this filer + followed peers) when peers are
@@ -1111,6 +1154,7 @@ class FilerServer:
             # ones, and any event appended after this point has ts > anchor
             since_ns = log.last_ts_ns
         prefix = req.get("path_prefix", "/") or "/"
+        strict = bool(req.get("strict_resume", False))
         while True:
             try:
                 async for ev in log.subscribe(since_ns, prefix):
@@ -1118,6 +1162,25 @@ class FilerServer:
                     yield ev.to_dict()
                 return
             except MetaLogTrimmed as e:
+                if strict:
+                    # exactly-resuming subscribers (the geo replicator)
+                    # must NEVER be silently skipped past a hole: report
+                    # the gap and end the stream — the client decides
+                    # (full resync), the server never lies about
+                    # continuity
+                    _log.warning(
+                        "meta subscriber %r behind retention under "
+                        "strict_resume: events in (%d, %d] are gone; "
+                        "ending stream",
+                        req.get("client_name", ""), e.since_ns,
+                        e.trimmed_through,
+                    )
+                    yield {
+                        "error": "trimmed",
+                        "trimmed_through": e.trimmed_through,
+                        "since_ns": e.since_ns,
+                    }
+                    return
                 # remote follower older than retention (or a corrupt
                 # segment range): resume past the undeliverable range —
                 # lossy like the reference's LogBuffer window, but LOUD,

@@ -33,6 +33,7 @@ from ..storage.erasure_coding.ec_volume import ShardBits
 from ..storage.super_block import ReplicaPlacement
 from ..storage.ttl import TTL
 from ..topology import GrowOption, Topology, VolumeGrowth
+from ..topology.placement import plan_ec_domain_spread, plan_replica_spread
 from ..topology.repair import (
     RepairQueue,
     find_unresolved_divergence,
@@ -52,6 +53,7 @@ from ..util.metrics import (
     ANTIENTROPY_DIVERGED,
     LIFECYCLE_CONVERSIONS,
     LIFECYCLE_QUEUE_DEPTH,
+    PLACEMENT_VIOLATIONS,
     REPAIR_SECONDS,
     VACUUM_QUEUE_DEPTH,
 )
@@ -169,6 +171,9 @@ class MasterServer:
         self.repair_concurrency = repair_concurrency
         self.repair_queue = RepairQueue(rng=random.Random())
         self.repair_log: list[dict] = []  # last dispatch outcomes
+        # latest anti-entropy scan's placement-policy findings (served
+        # by PlacementStatus / geo.status)
+        self.placement_violations: list[dict] = []
         self._repair_task: Optional[asyncio.Task] = None
         # vacuum plane: garbage ratios ride heartbeats; findings feed a
         # highest-garbage-first queue dispatched under a concurrency cap
@@ -299,6 +304,7 @@ class MasterServer:
         svc.unary("ReleaseAdminToken")(self._grpc_release_admin_token)
         svc.unary("GetMasterConfiguration")(self._grpc_get_configuration)
         svc.unary("RepairStatus")(self._grpc_repair_status)
+        svc.unary("PlacementStatus")(self._grpc_placement_status)
         svc.unary("VacuumStatus")(self._grpc_vacuum_status)
         svc.unary("LifecycleStatus")(self._grpc_lifecycle_status)
         svc.unary("TierOrphanSweep")(self._grpc_tier_orphan_sweep)
@@ -556,23 +562,41 @@ class MasterServer:
         if not locations:
             ec = self.topo.lookup_ec_shards(vid)
             if ec is not None:
-                urls = sorted(
-                    {dn.url for locs in ec.locations for dn in locs}
-                )
-                if urls:
+                by_url = {}
+                for locs in ec.locations:
+                    for dn in locs:
+                        by_url.setdefault(dn.url, dn)
+                if by_url:
                     return {
                         "volumeId": vid_str,
                         "locations": [
-                            {"url": u, "publicUrl": u} for u in urls
+                            {
+                                "url": u,
+                                "publicUrl": u,
+                                "dataCenter": self._dc_of(by_url[u]),
+                            }
+                            for u in sorted(by_url)
                         ],
                     }
             return {"volumeId": vid_str, "error": "volume id not found"}
         return {
             "volumeId": vid_str,
             "locations": [
-                {"url": dn.url, "publicUrl": dn.public_url} for dn in locations
+                {
+                    "url": dn.url,
+                    "publicUrl": dn.public_url,
+                    "dataCenter": self._dc_of(dn),
+                }
+                for dn in locations
             ],
         }
+
+    @staticmethod
+    def _dc_of(dn) -> str:
+        """The DC label clients use for read affinity (rides lookup
+        responses and KeepConnected pushes)."""
+        dc = getattr(dn, "data_center", None)
+        return dc.id if dc is not None else ""
 
     def _leader_gate_http(self, request: web.Request) -> Optional[web.Response]:
         """None when this master may serve the request; otherwise a
@@ -895,6 +919,7 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
         msg = {
             "url": dn.url,
             "public_url": dn.public_url,
+            "data_center": self._dc_of(dn),
             "new_vids": sorted(set(new_vids)),
             "deleted_vids": sorted(set(deleted_vids)),
             "leader": self.leader,
@@ -924,6 +949,7 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
                 yield {
                     "url": dn.url,
                     "public_url": dn.public_url,
+                    "data_center": self._dc_of(dn),
                     "new_vids": vids,
                     "deleted_vids": [],
                     "leader": self.leader,
@@ -1115,6 +1141,31 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
         replica_states = self.topo.replica_states(live)
         tasks = plan_ec_repairs(ec_states)
         tasks += plan_replica_repairs(replica_states)
+        # placement policy (ISSUE 19): existing volumes/EC shards are
+        # re-checked against the spread the growth solver promises; the
+        # proposed moves queue BEHIND data-loss repairs (PLACEMENT_PRIORITY)
+        candidates = self.topo.placement_candidates(live)
+        spread_violations, spread_tasks = plan_replica_spread(
+            self.topo.placement_states(live), candidates
+        )
+        ec_violations, ec_spread_tasks = plan_ec_domain_spread(
+            ec_states, candidates
+        )
+        PLACEMENT_VIOLATIONS.set(
+            len(spread_violations), kind="replica_spread"
+        )
+        PLACEMENT_VIOLATIONS.set(len(ec_violations), kind="ec_domain")
+        self.placement_violations = spread_violations + ec_violations
+        if self.placement_violations:
+            from ..util import log
+
+            log.info(
+                "anti-entropy: %d placement-policy violation(s), "
+                "%d repair move(s) planned",
+                len(self.placement_violations),
+                len(spread_tasks) + len(ec_spread_tasks),
+            )
+        tasks += spread_tasks + ec_spread_tasks
         diverged = find_unresolved_divergence(replica_states)
         ANTIENTROPY_DIVERGED.set(len(diverged))
         if diverged:
@@ -1136,7 +1187,14 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
         )
         results: list[dict] = []
         ec_ready = [t for t in ready if t.kind == "ec_rebuild"]
-        other = [t for t in ready if t.kind != "ec_rebuild"]
+        placement = [
+            t for t in ready if t.kind in ("placement_move", "ec_placement")
+        ]
+        other = [
+            t
+            for t in ready
+            if t.kind not in ("ec_rebuild", "placement_move", "ec_placement")
+        ]
 
         # background-plane root span (ISSUE 8), only when the scan found
         # work; the tail-sync/recopy/rebuild RPCs inherit the context so
@@ -1183,6 +1241,7 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
                     for (rebuilder, collection), group in prepared.items()
                 ),
                 *(self._dispatch_replica_task(t, results) for t in other),
+                *(self._dispatch_placement_task(t, results) for t in placement),
             )
 
         self.repair_log = (self.repair_log + results)[-50:]
@@ -1191,6 +1250,7 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
             "queue_depth": self.repair_queue.depth(),
             "live_nodes": sorted(live),
             "diverged_volumes": diverged,
+            "placement_violations": self.placement_violations,
         }
 
     async def _ec_expected_total(self, st: dict) -> int:
@@ -1278,6 +1338,83 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
                 timeout=3600,
             )
             err = r.get("error")
+        except Exception as e:
+            err = str(e)
+        dt = time.perf_counter() - t0
+        if err:
+            REPAIR_SECONDS.observe(dt, kind=t.kind, result="error")
+            self.repair_queue.reschedule_failure(t, time.monotonic())
+            results.append({**t.to_info(), "error": err})
+        else:
+            REPAIR_SECONDS.observe(dt, kind=t.kind, result="ok")
+            results.append({**t.to_info(), "repaired": True})
+
+    async def _dispatch_placement_task(self, t, results: list) -> None:
+        """Execute one placement-policy move: replica volumes ride the
+        volume.move RPC pair (copy to the better-placed node, then drop
+        the source copy — full copy count at every intermediate state);
+        EC shards ride the ec.balance move sequence (copy+mount on the
+        target, unmount+delete on the source)."""
+        t0 = time.perf_counter()
+        try:
+            if t.kind == "placement_move":
+                r = await Stub(grpc_address(t.target), "volume").call(
+                    "VolumeCopy",
+                    {
+                        "volume_id": t.vid,
+                        "collection": t.collection,
+                        "source_data_node": t.source,
+                    },
+                    timeout=3600,
+                )
+                err = r.get("error")
+                if not err:
+                    r2 = await Stub(grpc_address(t.source), "volume").call(
+                        "VolumeDelete", {"volume_id": t.vid}, timeout=600
+                    )
+                    err = r2.get("error")
+            else:  # ec_placement: move one shard out of the hot domain
+                sid = int(t.missing[0])
+                tstub = Stub(grpc_address(t.target), "volume")
+                r = await tstub.call(
+                    "VolumeEcShardsCopy",
+                    {
+                        "volume_id": t.vid,
+                        "collection": t.collection,
+                        "shard_ids": [sid],
+                        "copy_ecx_file": True,
+                        "source_data_node": t.source,
+                    },
+                    timeout=3600,
+                )
+                err = r.get("error")
+                if not err:
+                    r = await tstub.call(
+                        "VolumeEcShardsMount",
+                        {
+                            "volume_id": t.vid,
+                            "collection": t.collection,
+                            "shard_ids": [sid],
+                        },
+                        timeout=600,
+                    )
+                    err = r.get("error")
+                if not err:
+                    sstub = Stub(grpc_address(t.source), "volume")
+                    await sstub.call(
+                        "VolumeEcShardsUnmount",
+                        {"volume_id": t.vid, "shard_ids": [sid]},
+                        timeout=600,
+                    )
+                    await sstub.call(
+                        "VolumeEcShardsDelete",
+                        {
+                            "volume_id": t.vid,
+                            "collection": t.collection,
+                            "shard_ids": [sid],
+                        },
+                        timeout=600,
+                    )
         except Exception as e:
             err = str(e)
         dt = time.perf_counter() - t0
@@ -1392,6 +1529,30 @@ peers: {escape(", ".join(self.raft.others()) or "none")}</p>
             "silent_nodes": sorted(all_nodes - live),
             "recent": self.repair_log[-10:],
             **({"ran": ran} if ran is not None else {}),
+        }
+
+    async def _grpc_placement_status(self, req, context) -> dict:
+        """Placement-policy introspection for `geo.status` (+ `run` to
+        force a fresh anti-entropy scan, which re-plans placement)."""
+        proxied = await self._proxy_to_leader("PlacementStatus", req)
+        if proxied is not None:
+            return proxied
+        if req.get("run"):
+            await self.run_anti_entropy_once(
+                max_dispatch=int(req.get("max_dispatch", 0) or 0) or None
+            )
+        live = {
+            dn.url
+            for dn in self.topo.live_data_nodes(self.repair_grace_seconds)
+        }
+        return {
+            "violations": self.placement_violations,
+            "nodes": self.topo.placement_candidates(live),
+            "queued_moves": [
+                t
+                for t in self.repair_queue.snapshot()
+                if t["kind"] in ("placement_move", "ec_placement")
+            ],
         }
 
     # ---------------- vacuum scheduler (ref topology_vacuum.go, rebuilt in

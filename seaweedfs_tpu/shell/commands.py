@@ -1064,6 +1064,95 @@ async def cmd_ec_repair_status(env, argv) -> str:
     return "\n".join(lines)
 
 
+@command("geo.status")
+async def cmd_geo_status(env, argv) -> str:
+    """Geo-plane status: geo.status [-run] [-filer host:port].
+
+    Master side: DC/rack placement-policy violations (replica spread +
+    EC failure domains) and the queued placement repair moves; -run
+    forces one anti-entropy scan first. Filer side (-filer, or the
+    env's sticky filer): the second-site replication tail — cursor,
+    lag p99, applied/skipped/retried counters, full-resync flag."""
+    flags = _parse_flags(argv)
+    req = {"run": True} if "run" in flags else {}
+    r = await env.master_stub.call("PlacementStatus", req, timeout=3600)
+    if r.get("error"):
+        return f"placement status failed: {r['error']}"
+    by_dc: dict[str, int] = defaultdict(int)
+    for n in r.get("nodes", []):
+        by_dc[n.get("dc", "")] += 1
+    lines = [
+        "placement: "
+        + (
+            ", ".join(
+                f"{dc or '(unlabeled)'}: {cnt} node(s)"
+                for dc, cnt in sorted(by_dc.items())
+            )
+            or "no live nodes"
+        )
+    ]
+    viols = r.get("violations", [])
+    lines.append(f"policy violations: {len(viols)}")
+    for v in viols:
+        what = (
+            f"volume {v['volume_id']} replication {v.get('replication')}"
+            if v["kind"] == "replica_spread"
+            else f"ec volume {v['volume_id']} domain {v.get('domain')} "
+            f"holds {v.get('shards_in_domain')} shards "
+            f"(parity {v.get('parity_shards')})"
+        )
+        lines.append(f"  {v['kind']}: {what} -> {v.get('repair', 'n/a')}")
+    moves = r.get("queued_moves", [])
+    if moves:
+        lines.append(f"queued placement moves: {len(moves)}")
+        for t in moves:
+            lines.append(
+                f"  {t['kind']} volume {t['volume_id']} -> {t['target']}"
+                f" (attempts {t['attempts']})"
+            )
+    filer = flags.get("filer", "") or env.filer
+    if filer:
+        from ..pb import grpc_address
+        from ..pb.rpc import Stub
+
+        try:
+            g = await Stub(grpc_address(filer), "filer").call(
+                "GeoStatus", {}, timeout=10.0
+            )
+        except Exception as e:
+            lines.append(f"filer {filer}: GeoStatus failed: {e}")
+            return "\n".join(lines)
+        if not g.get("configured"):
+            lines.append(
+                f"filer {filer}: geo replication not configured"
+                + (
+                    f" (dc {g['data_center']})"
+                    if g.get("data_center")
+                    else ""
+                )
+            )
+        else:
+            lines.append(
+                f"filer {filer} (dc {g.get('data_center') or '?'}) <- "
+                f"{g.get('source')}: "
+                + ("connected" if g.get("connected") else "DISCONNECTED")
+            )
+            lines.append(
+                f"  cursor {g.get('cursor_ns')} · lag p99 "
+                f"{g.get('lag_p99_seconds')}s (last "
+                f"{g.get('last_lag_seconds')}s) · applied "
+                f"{g.get('applied')} · skipped {g.get('skipped')} · "
+                f"retried {g.get('retried')}"
+            )
+            if g.get("resync_required"):
+                lines.append(
+                    "  FULL RESYNC REQUIRED: cursor behind primary "
+                    f"retention (trimmed through "
+                    f"{g.get('trimmed_through')})"
+                )
+    return "\n".join(lines)
+
+
 @command("ec.balance")
 async def cmd_ec_balance(env, argv) -> str:
     """Dedupe + rack-aware rebalancing of EC shards

@@ -280,6 +280,58 @@ class Topology(Node):
                 )
         return states
 
+    def placement_states(self, live_urls: Optional[set] = None) -> list[dict]:
+        """Per-volume replica placement snapshot — each volume's layout
+        `ReplicaPlacement` plus its live holders' (dc, rack) domains, in
+        the shape `placement.plan_replica_spread` consumes."""
+        out = []
+        with self._lock:
+            collections = list(self.collections.items())
+        for cname, col in collections:
+            for layout in col.layouts():
+                rp_byte = layout.replica_placement.to_byte()
+                with layout._lock:
+                    vid_locs = {
+                        vid: list(locs)
+                        for vid, locs in layout.vid_to_locations.items()
+                    }
+                for vid, locs in vid_locs.items():
+                    holders = [
+                        {
+                            "url": dn.url,
+                            "dc": dn.data_center.id if dn.data_center else "",
+                            "rack": dn.rack.id if dn.rack else "",
+                        }
+                        for dn in locs
+                        if live_urls is None or dn.url in live_urls
+                    ]
+                    if holders:
+                        out.append(
+                            {
+                                "vid": int(vid),
+                                "collection": cname,
+                                "replica_placement": rp_byte,
+                                "holders": holders,
+                            }
+                        )
+        return out
+
+    def placement_candidates(
+        self, live_urls: Optional[set] = None
+    ) -> list[dict]:
+        """Every live node with its failure domains and free slots — the
+        move-target pool for placement repair planning."""
+        return [
+            {
+                "url": dn.url,
+                "dc": dn.data_center.id if dn.data_center else "",
+                "rack": dn.rack.id if dn.rack else "",
+                "free": dn.free_space(),
+            }
+            for dn in self.data_nodes()
+            if live_urls is None or dn.url in live_urls
+        ]
+
     def ec_heat_states(self, live_urls: Optional[set] = None) -> dict:
         """{vid: {collection, read_heat, local_bits, offloaded_bits}}
         with heat SUMMED (and tier bits OR-ed) across live shard holders —

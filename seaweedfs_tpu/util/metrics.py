@@ -484,6 +484,47 @@ REPAIR_SECONDS = REGISTRY.histogram(
     "recopy/tail_sync/vacuum) and result (ok/error/skipped)",
 )
 
+# geo plane (see docs/robustness.md "Geo plane"): DC/rack-aware placement
+# violations found by the master's anti-entropy scan, and the cross-cluster
+# async replicator's applied/skipped/retried ledger + lag — the observable
+# core of the "bounded-lag, zero-loss/zero-dup after heal" SLO
+PLACEMENT_VIOLATIONS = REGISTRY.gauge(
+    "seaweedfs_tpu_placement_violations",
+    "volumes/EC volumes whose current holders violate placement policy, "
+    "by kind (replica_spread = replicas packed below the ReplicaPlacement "
+    "rack/DC spread, ec_domain = one failure domain holds more EC shards "
+    "than the volume can lose); refreshed every anti-entropy scan",
+)
+GEO_EVENTS_APPLIED = REGISTRY.counter(
+    "seaweedfs_tpu_geo_events_applied_total",
+    "meta-log events applied on the peer cluster by the geo replicator, "
+    "by type (create/update/delete/rename)",
+)
+GEO_EVENTS_SKIPPED = REGISTRY.counter(
+    "seaweedfs_tpu_geo_events_skipped_total",
+    "meta-log events the geo replicator skipped, by reason (dup = "
+    "idempotency key already applied — the kill/restart replay shield, "
+    "stale = behind the durable cursor, internal = bookkeeping paths)",
+)
+GEO_EVENTS_RETRIED = REGISTRY.counter(
+    "seaweedfs_tpu_geo_events_retried_total",
+    "geo replicator apply attempts that failed and were retried (WAN "
+    "partition / peer outage shows up here, never as a skipped event)",
+)
+GEO_REPLICATION_LAG = REGISTRY.histogram(
+    "seaweedfs_tpu_geo_replication_lag_seconds",
+    "age of each applied event at apply time (primary append -> peer "
+    "apply); p99 is the replication-lag SLO the soak scores",
+    buckets=[0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300],
+)
+GEO_FULL_RESYNC_REQUIRED = REGISTRY.counter(
+    "seaweedfs_tpu_geo_full_resync_required_total",
+    "times the replicator's cursor fell behind the primary meta-log "
+    "retention (MetaLogTrimmed): events in the hole can never stream; "
+    "the replicator halts LOUDLY and requires an operator full resync — "
+    "it never silently skips the gap",
+)
+
 # object gateway (see docs/perf.md "Object gateway"): the S3/filer fast
 # path gets the same itemized-stage treatment as the volume write path —
 # every fast-tier PutObject partitions its handler wall into

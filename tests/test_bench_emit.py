@@ -419,6 +419,47 @@ def test_production_soak_leg_shape():
     assert pk["time_capped"] is False
 
 
+def test_geo_soak_leg_shape():
+    """ISSUE 19 guard: a quick-budget soak.geo run must stand up TWO real
+    subprocess clusters (dc-a primary, dc-b second site tailing the
+    meta-log), fire a seeded WAN partition INSIDE the second site's
+    filer child (ground truth: the child's own faults_injected counter),
+    keep every primary write succeeding through the cut, and converge
+    after heal with ZERO lost / ZERO duplicated / ZERO byte-mismatched
+    mutations and no full resync. Lag p99 must be non-zero (the
+    histogram actually recorded applies) and the partition sub-leg must
+    be disclosed in the output."""
+    gk = bench.measure_geo_soak(
+        pre_files=6,
+        during_files=8,
+        post_files=3,
+        partition_start_s=8.0,
+        partition_duration_s=6.0,
+        time_cap_s=150.0,
+    )
+    assert "error" not in gk, gk.get("error")
+    # two real clusters, one process per role
+    assert len(gk["pids"]["A"]) >= 3 and len(gk["pids"]["B"]) >= 3
+    assert gk["files_written"] == 6 + 8 + 3
+    # primary writes NEVER failed, including through the cut
+    assert gk["write_failures"] == 0
+    # zero-loss / zero-dup, byte-verified through the peer
+    assert gk["missing_on_peer"] == 0
+    assert gk["extra_on_peer"] == 0
+    assert gk["byte_mismatches"] == 0
+    assert gk["resync_required"] is False
+    assert gk["drained"] is True
+    # the partition sub-leg is disclosed AND actually happened in-child
+    assert gk["partition"]["duration_s"] > 0
+    assert gk["partition_faults_fired"] > 0
+    assert gk["partition_observed"] is True
+    # non-zero replication lag p99 from real applies
+    assert gk["lag_p99_s"] > 0
+    assert gk["applied"] >= gk["files_written"]
+    assert "pass" in gk["slo"]
+    assert gk["time_capped"] is False
+
+
 def test_trace_overhead_leg_shape():
     """ISSUE 8 guard: the serving.trace_overhead leg must emit BOTH QPS
     numbers (tracing-off and tracing-on-at-1%) with their ratio, and the

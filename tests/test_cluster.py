@@ -243,60 +243,63 @@ def test_cluster_write_read_delete(tmp_path):
 
 
 def test_cluster_master_http_endpoints(tmp_path):
-    async def body():
-        cluster = Cluster(tmp_path, n_volume_servers=1)
-        await cluster.start()
-        try:
-            async with aiohttp.ClientSession() as session:
-                base = f"http://{cluster.master.address}"
-                async with session.get(f"{base}/dir/assign") as resp:
-                    body_json = await resp.json()
-                    assert "fid" in body_json, body_json
-                fid = body_json["fid"]
-                await upload_data(
-                    session, body_json["url"], fid, b"hello-http"
-                )
-                vid = fid.split(",")[0]
-                async with session.get(
-                    f"{base}/dir/lookup?volumeId={vid}"
-                ) as resp:
-                    lk = await resp.json()
-                    assert lk.get("locations")
-                async with session.get(f"{base}/dir/status") as resp:
-                    st = await resp.json()
-                    assert st["Topology"]["max_volume_id"] >= 1
-                # master redirect to the volume server
-                async with session.get(
-                    f"{base}/{fid}", allow_redirects=True
-                ) as resp:
-                    assert resp.status == 200
-                    assert await resp.read() == b"hello-http"
-        finally:
-            await cluster.stop()
+    """Migrated onto ProcCluster (ISSUE 19 satellite): the master's HTTP
+    surface exercised against a REAL subprocess cluster — same assertions
+    as the old in-process version, but now crossing process boundaries
+    like production traffic does."""
+    from seaweedfs_tpu.ops.proc_cluster import ProcCluster
 
-    asyncio.run(body())
+    async def body(master_addr):
+        async with aiohttp.ClientSession() as session:
+            base = f"http://{master_addr}"
+            async with session.get(f"{base}/dir/assign") as resp:
+                body_json = await resp.json()
+                assert "fid" in body_json, body_json
+            fid = body_json["fid"]
+            await upload_data(
+                session, body_json["url"], fid, b"hello-http"
+            )
+            vid = fid.split(",")[0]
+            async with session.get(
+                f"{base}/dir/lookup?volumeId={vid}"
+            ) as resp:
+                lk = await resp.json()
+                assert lk.get("locations")
+            async with session.get(f"{base}/dir/status") as resp:
+                st = await resp.json()
+                assert st["Topology"]["max_volume_id"] >= 1
+            # master redirect to the volume server
+            async with session.get(
+                f"{base}/{fid}", allow_redirects=True
+            ) as resp:
+                assert resp.status == 200
+                assert await resp.read() == b"hello-http"
+
+    with ProcCluster(str(tmp_path), volumes=1) as cluster:
+        asyncio.run(body(cluster.master_address))
 
 
 def test_cluster_replicated_write(tmp_path):
-    async def body():
-        cluster = Cluster(tmp_path, n_volume_servers=2)
-        await cluster.start()
-        try:
-            async with aiohttp.ClientSession() as session:
-                ar = await assign(cluster.master.address, replication="001")
-                data = random.randbytes(5000)
-                await upload_data(session, ar.url, ar.fid, data)
-                vid = int(ar.fid.split(",")[0])
-                locs = await lookup(cluster.master.address, vid)
-                assert len(locs) == 2, f"expected 2 replicas, got {locs}"
-                # read the replica directly from BOTH servers
-                for url in locs:
-                    got = await read_url(session, f"http://{url}/{ar.fid}")
-                    assert got == data
-        finally:
-            await cluster.stop()
+    """Migrated onto ProcCluster (ISSUE 19 satellite): replication=001
+    fan-out between two volume-server PROCESSES, then direct reads from
+    both replicas."""
+    from seaweedfs_tpu.ops.proc_cluster import ProcCluster
 
-    asyncio.run(body())
+    async def body(master_addr):
+        async with aiohttp.ClientSession() as session:
+            ar = await assign_retry(master_addr, replication="001")
+            data = random.randbytes(5000)
+            await upload_data(session, ar.url, ar.fid, data)
+            vid = int(ar.fid.split(",")[0])
+            locs = await lookup(master_addr, vid)
+            assert len(locs) == 2, f"expected 2 replicas, got {locs}"
+            # read the replica directly from BOTH servers
+            for url in locs:
+                got = await read_url(session, f"http://{url}/{ar.fid}")
+                assert got == data
+
+    with ProcCluster(str(tmp_path), volumes=2) as cluster:
+        asyncio.run(body(cluster.master_address))
 
 
 def test_cluster_ec_encode_spread_read_degraded(tmp_path):

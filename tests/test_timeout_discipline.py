@@ -20,7 +20,16 @@ turns a brownout into an outage). Three request layers, three checks:
 - **explicit opt-outs**: any call site passing `timeout=None` to
   `.request(` / `.call(` / `ClientSession(` must be on the allowlist
   below with a reason (today: none — `Stub.server_stream` IS the
-  streaming API and carries its own default).
+  streaming API and carries its own default);
+- **replication/ (ISSUE 19 satellite)**: the geo replicator and the
+  notifier sinks make WAN calls from background loops — the one place
+  a silent unbounded wait survives longest (nobody is waiting on the
+  response). Every `.call(` / `.request(` / `retry_async(` /
+  `server_stream(` in `replication/` must pass an EXPLICIT `timeout=`
+  or `deadline=` at the call site (defaults are not enough here: a WAN
+  deadline is a per-call policy decision, and the scan makes omitting
+  it visible); streaming/session-bounded shapes go on
+  `REPLICATION_DEADLINE_ALLOWLIST` with the bound they rely on.
 
 AST-based, so string matches in comments/docstrings cannot false-
 positive and a violation reports file:line.
@@ -51,6 +60,22 @@ SUBSCRIBE_STOPPED_ALLOWLIST: dict = {
         "gRPC server-stream handler: the stream's lifetime is the "
         "client's — the RPC layer cancels the generator on disconnect "
         "or server stop"
+    ),
+}
+
+# (relpath, callee) pairs under replication/ allowed to omit an explicit
+# per-call timeout=/deadline= — each names the bound it relies on
+# instead (ISSUE 19 satellite).
+REPLICATION_DEADLINE_ALLOWLIST: dict = {
+    ("replication/__init__.py", "request"): (
+        "aiohttp session.request: every session in the sink layer is "
+        "constructed with ClientSession(timeout=client_timeout()), "
+        "which bounds connect and every read for all requests on it"
+    ),
+    ("replication/geo.py", "server_stream"): (
+        "SubscribeMetadata tail: the stream's lifetime IS the "
+        "replication session — liveness is owned by the reconnect "
+        "loop's backoff policy, not a per-call deadline"
     ),
 }
 
@@ -121,6 +146,26 @@ def _scan() -> list:
                         "tests/test_timeout_discipline.py with a reason "
                         "if this is truly a streaming endpoint"
                     )
+            if rel.startswith("replication" + os.sep) and name in (
+                "call",
+                "request",
+                "retry_async",
+                "server_stream",
+            ):
+                # WAN calls from background loops: an explicit per-call
+                # bound, not a client default, is the requirement here
+                if (
+                    "timeout" not in kw
+                    and "deadline" not in kw
+                    and (rel, name) not in REPLICATION_DEADLINE_ALLOWLIST
+                ):
+                    violations.append(
+                        f"{rel}:{node.lineno}: {name}() in replication/ "
+                        "without an explicit timeout=/deadline= — WAN "
+                        "calls from background loops must carry their "
+                        "own bound (or be allowlisted with the bound "
+                        "they rely on)"
+                    )
             if (
                 name == "subscribe"
                 and isinstance(node.func, ast.Attribute)
@@ -181,8 +226,10 @@ def test_shared_client_timeout_bounds_connect_and_read():
 def test_allowlist_entries_are_live():
     """Every allowlist entry must still correspond to an existing file —
     dead entries hide future violations at the same spot."""
-    for rel, _callee in list(TIMEOUT_NONE_ALLOWLIST) + list(
-        SUBSCRIBE_STOPPED_ALLOWLIST
+    for rel, _callee in (
+        list(TIMEOUT_NONE_ALLOWLIST)
+        + list(SUBSCRIBE_STOPPED_ALLOWLIST)
+        + list(REPLICATION_DEADLINE_ALLOWLIST)
     ):
         assert os.path.exists(os.path.join(ROOT, rel)), (
             f"stale allowlist entry: {rel}"
