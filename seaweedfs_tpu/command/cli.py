@@ -507,6 +507,26 @@ def cmd_filer(argv: list[str]) -> int:
         default="",
         help="durable geo cursor file (default: <-store>.geo.json)",
     )
+    p.add_argument(
+        "-fleetMap",
+        default="",
+        help="shared FLEETMAP file of a shard-range filer fleet: this "
+        "filer serves the directory-prefix range the map assigns it and "
+        "forwards/redirects everything else to the owning member",
+    )
+    p.add_argument(
+        "-fleetSelf",
+        default="",
+        help="this member's address as listed in -fleetMap "
+        "(default: <-ip>:<-port>)",
+    )
+    p.add_argument(
+        "-followSource",
+        default="",
+        help="PRIMARY filer (host:port) to follow as a read-only "
+        "meta-log-fed replica: serves eventually-consistent GET/LIST "
+        "with a disclosed staleness bound, redirects writes",
+    )
     _apply_config_defaults(p, argv, ["filer", "security", "notification"])
     args = p.parse_args(argv)
     from ..notification import Notifier, build_sink
@@ -541,6 +561,9 @@ def cmd_filer(argv: list[str]) -> int:
         data_center=args.dataCenter,
         geo_source=args.geoSource,
         geo_state_path=args.geoState,
+        fleet_map_path=args.fleetMap,
+        fleet_self=args.fleetSelf,
+        follow_source=args.followSource,
     )
     print(f"filer listening on {args.ip}:{args.port}")
     asyncio.run(_run_forever(fs))

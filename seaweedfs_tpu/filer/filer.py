@@ -167,15 +167,28 @@ class Filer:
         # component walk
         find_many = getattr(self.store, "find_many", None)
         found = find_many(chain) if find_many is not None else None
+        missing: list[Entry] = []
         for path in chain:
             existing = (
                 found.get(path) if found is not None
                 else self.store.find_entry(path)
             )
             if existing is None:
-                self.store.insert_entry(new_directory_entry(path))
+                missing.append(new_directory_entry(path))
             elif not existing.is_directory:
                 raise NotADirectoryError(f"{path} is a file")
+        if missing:
+            # the missing spine inserts as ONE batched round too (the
+            # write twin of the probe above), root-first by construction
+            self._insert_batch(missing)
+
+    def _insert_batch(self, entries: list[Entry]) -> None:
+        im = getattr(self.store, "insert_many", None)
+        if im is not None:
+            im(entries)
+        else:
+            for e in entries:
+                self.store.insert_entry(e)
 
     def create_entry(self, entry: Entry, exclusive: bool = False) -> None:
         """exclusive=True is the O_EXCL analogue: refuse to replace any
@@ -292,15 +305,25 @@ class Filer:
         from ..notification import EVENT_RENAME
 
         if entry.is_directory:
-            for child in list(self.list_entries_recursive(old_path)):
-                suffix = child.full_path[len(old_path) :]
-                moved = Entry(
-                    full_path=new_path + suffix,
-                    attr=child.attr,
-                    chunks=child.chunks,
-                    extended=child.extended,
+            # the whole subtree inserts as ONE batched store round
+            # (per-child inserts paid a commit/fsync each); per-child
+            # rename events still flow so subscribers see every move
+            pairs = [
+                (
+                    child,
+                    Entry(
+                        full_path=new_path
+                        + child.full_path[len(old_path):],
+                        attr=child.attr,
+                        chunks=child.chunks,
+                        extended=child.extended,
+                    ),
                 )
-                self.store.insert_entry(moved)
+                for child in self.list_entries_recursive(old_path)
+            ]
+            if pairs:
+                self._insert_batch([moved for _c, moved in pairs])
+            for child, moved in pairs:
                 self._notify(
                     EVENT_RENAME, moved.full_path, moved, old_entry=child
                 )
@@ -331,4 +354,91 @@ class Filer:
             chunks=chunks,
         )
         self.create_entry(entry)
+        return entry
+
+    # --- gate-batched write seam (ISSUE 20) ---
+    async def create_entry_gated(
+        self,
+        entry: Entry,
+        write_gate,
+        lookup_gate=None,
+        exclusive: bool = False,
+    ) -> None:
+        """`create_entry` with both halves coalesced across concurrent
+        callers: the ancestor-spine + existing-entry probe rides the
+        lookup gate (one `find_many` per event-loop wakeup) and the
+        inserts — missing parents + the leaf — ride the write gate (one
+        `insert_many` per wakeup), so a burst of S3 PUTs costs
+        O(wakeups) store round-trips instead of O(objects).
+
+        exclusive=True keeps the synchronous path: its probe-then-insert
+        must stay one atomic block (the O_EXCL contract), which gate
+        batching deliberately gives up."""
+        if entry.full_path == "/" or exclusive or write_gate is None:
+            self.create_entry(entry, exclusive=exclusive)
+            return
+        parts = [p for p in entry.full_path.split("/") if p][:-1]
+        chain: list[str] = []
+        path = ""
+        for p in parts:
+            path += "/" + p
+            chain.append(path)
+        probe = chain + [entry.full_path]
+        if lookup_gate is not None:
+            results = await lookup_gate.lookup_many(probe)
+        else:
+            find_many = getattr(self.store, "find_many", None)
+            if find_many is not None:
+                found = find_many(probe)
+            else:
+                found = {
+                    p: e
+                    for p in probe
+                    if (e := self.store.find_entry(p)) is not None
+                }
+            results = [found.get(p) for p in probe]
+        existing = results[-1]
+        batch: list[Entry] = []
+        for p, got in zip(chain, results[:-1]):
+            if got is None:
+                batch.append(new_directory_entry(p))
+            elif not got.is_directory:
+                raise NotADirectoryError(f"{p} is a file")
+        if existing is not None and existing.chunks:
+            old_fids = {c.fid for c in existing.chunks} - {
+                c.fid for c in entry.chunks
+            }
+            if old_fids:
+                self.release_fids(old_fids)
+        batch.append(entry)
+        # parents enqueue ahead of the leaf in ONE contribution; the
+        # await returns only once the whole group is durably stored
+        await write_gate.insert_many(batch)
+        from ..notification import EVENT_CREATE, EVENT_UPDATE
+
+        self._notify(
+            EVENT_UPDATE if existing is not None else EVENT_CREATE,
+            entry.full_path,
+            entry,
+            old_entry=existing,
+        )
+
+    async def touch_gated(
+        self,
+        full_path: str,
+        mime: str,
+        chunks: list[FileChunk],
+        write_gate,
+        lookup_gate=None,
+        **attrs,
+    ) -> Entry:
+        now = time.time()
+        entry = Entry(
+            full_path=full_path,
+            attr=Attr(mtime=now, crtime=now, mime=mime, **attrs),
+            chunks=chunks,
+        )
+        await self.create_entry_gated(
+            entry, write_gate, lookup_gate=lookup_gate
+        )
         return entry

@@ -19,6 +19,7 @@ from .entry import Entry
 
 class FilerStore(Protocol):
     def insert_entry(self, entry: Entry) -> None: ...
+    def insert_many(self, entries: list[Entry]) -> None: ...
     def update_entry(self, entry: Entry) -> None: ...
     def find_entry(self, full_path: str) -> Optional[Entry]: ...
     def delete_entry(self, full_path: str) -> None: ...
@@ -40,13 +41,28 @@ class MemoryFilerStore:
         # directory -> {name -> Entry}
         self._dirs: dict[str, dict[str, Entry]] = {}
         self._lock = threading.RLock()
+        # store round-trips taken by the write path: one per
+        # insert_entry call, one per insert_many FLUSH (regardless of
+        # batch width). The write-gate bench's "counted, not projected"
+        # coalescing evidence — every store kind maintains it.
+        self.write_rounds = 0
 
     def insert_entry(self, entry: Entry) -> None:
         d, name = _split(entry.full_path)
         with self._lock:
+            self.write_rounds += 1
             self._dirs.setdefault(d, {})[name] = entry
 
     update_entry = insert_entry
+
+    def insert_many(self, entries: list[Entry]) -> None:
+        """Batched upsert: many entries under ONE lock acquisition —
+        the write-side twin of find_many (gate-batched write seam)."""
+        with self._lock:
+            self.write_rounds += 1
+            for entry in entries:
+                d, name = _split(entry.full_path)
+                self._dirs.setdefault(d, {})[name] = entry
 
     def find_entry(self, full_path: str) -> Optional[Entry]:
         d, name = _split(full_path)
@@ -113,6 +129,7 @@ class SqliteFilerStore:
     def __init__(self, path: str = ":memory:"):
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.RLock()
+        self.write_rounds = 0  # see MemoryFilerStore.write_rounds
         self._conn.execute(
             """CREATE TABLE IF NOT EXISTS filemeta (
                 directory TEXT NOT NULL,
@@ -126,6 +143,7 @@ class SqliteFilerStore:
     def insert_entry(self, entry: Entry) -> None:
         d, name = _split(entry.full_path)
         with self._lock:
+            self.write_rounds += 1
             self._conn.execute(
                 "REPLACE INTO filemeta (directory, name, meta) VALUES (?,?,?)",
                 (d, name, json.dumps(entry.to_dict())),
@@ -133,6 +151,24 @@ class SqliteFilerStore:
             self._conn.commit()
 
     update_entry = insert_entry
+
+    def insert_many(self, entries: list[Entry]) -> None:
+        """Batched upsert: ONE executemany + ONE commit for the whole
+        batch — this is where gate coalescing buys real durability
+        round-trips back (per-entry insert pays a commit each)."""
+        if not entries:
+            return
+        rows = []
+        for entry in entries:
+            d, name = _split(entry.full_path)
+            rows.append((d, name, json.dumps(entry.to_dict())))
+        with self._lock:
+            self.write_rounds += 1
+            self._conn.executemany(
+                "REPLACE INTO filemeta (directory, name, meta) VALUES (?,?,?)",
+                rows,
+            )
+            self._conn.commit()
 
     def find_entry(self, full_path: str) -> Optional[Entry]:
         d, name = _split(full_path)
@@ -494,6 +530,26 @@ class LogFilerStore(MemoryFilerStore):
             self._append({"op": "put", "entry": entry.to_dict()})
 
     update_entry = insert_entry
+
+    def insert_many(self, entries: list[Entry]) -> None:
+        """Batched upsert: one buffered write + ONE flush/fsync for the
+        whole batch (the per-entry path fsyncs each record)."""
+        if not entries:
+            return
+        with self._lock:
+            self.write_rounds += 1
+            for entry in entries:
+                d, name = _split(entry.full_path)
+                self._dirs.setdefault(d, {})[name] = entry
+                self._f.write(
+                    self._packer.pack(
+                        {"op": "put", "entry": entry.to_dict()}
+                    )
+                )
+            import os
+
+            self._f.flush()
+            os.fsync(self._f.fileno())
 
     def delete_entry(self, full_path: str) -> None:
         with self._lock:

@@ -172,6 +172,30 @@ class _Segment:
         self._f.close()
 
 
+def _arena_prefetch_hint(seg: "_Segment") -> None:
+    """Offer a newly sealed run to the process's device column arena
+    (ISSUE 20 satellite). Strictly best-effort and side-effect-free on
+    the store: an arena is never CREATED here (peek, not get), the
+    column build is skipped entirely when no arena is live, and any
+    arena-side trouble degrades to a counted skip, never a store error.
+    Every outcome lands in arena_prefetch_total{result}."""
+    try:
+        from ..ops.ragged_lookup import peek_default_arena
+
+        arena = peek_default_arena()
+        result = "no_arena" if arena is None else arena.prefetch(
+            seg.arena_segment()
+        )
+    except Exception:
+        result = "error"
+    try:
+        from ..util.metrics import ARENA_PREFETCH
+
+        ARENA_PREFETCH.inc(result=result)
+    except ImportError:
+        pass
+
+
 def _write_segment(path: str, items: List[Tuple[Tuple[str, str], Optional[dict]]]) -> None:
     packer = msgpack.Packer(use_bin_type=True)
     tmp = path + ".tmp"
@@ -221,6 +245,7 @@ class LsmFilerStore:
         self.memtable_limit = memtable_limit
         self.max_segments = max_segments
         self.fsync = fsync
+        self.write_rounds = 0  # see MemoryFilerStore.write_rounds
         self._lock = threading.RLock()
         self._mem: Dict[Tuple[str, str], Optional[dict]] = {}
         self._packer = msgpack.Packer(use_bin_type=True)
@@ -291,7 +316,8 @@ class LsmFilerStore:
         path = os.path.join(self.dir, f"seg-{seq}.sst")
         _write_segment(path, sorted(self._mem.items()))
         _fsync_dir(self.dir)  # the segment must survive before the WAL goes
-        self._segments.append(_Segment(path))
+        seg = _Segment(path)
+        self._segments.append(seg)
         self._seqs.append(seq)
         self._next_seq += 1
         self._write_manifest()
@@ -300,6 +326,13 @@ class LsmFilerStore:
         self._wal = open(self._wal_path, "wb")  # truncate: flushed == durable
         if len(self._segments) > self.max_segments:
             self._compact()
+        else:
+            # ISSUE 20 satellite: the device arena learns the sealed run
+            # NOW, from the flush path, instead of paying a first-miss
+            # ensure+refresh on the next probe batch. Compaction skips
+            # the hint — its merged run replaces segments the arena
+            # prunes at refresh anyway.
+            _arena_prefetch_hint(seg)
 
     def _compact(self) -> None:
         """Tiered compaction: merge the ADJACENT segment pair with the
@@ -330,12 +363,14 @@ class LsmFilerStore:
         if lo == 0:  # nothing older left to shadow: tombstones drop
             items = [(k, v) for k, v in items if v is not None]
         old = self._segments[lo:hi]
+        new_seg = None
         if items:
             seq = self._next_seq
             path = os.path.join(self.dir, f"seg-{seq}.sst")
             _write_segment(path, items)
             _fsync_dir(self.dir)
-            self._segments[lo:hi] = [_Segment(path)]
+            new_seg = _Segment(path)
+            self._segments[lo:hi] = [new_seg]
             self._seqs[lo:hi] = [seq]
             self._next_seq += 1
         else:
@@ -345,13 +380,39 @@ class LsmFilerStore:
         for seg in old:
             seg.close()
         self._sweep_unlisted()
+        if new_seg is not None and len(self._segments) <= self.max_segments:
+            _arena_prefetch_hint(new_seg)  # the compacted run is sealed too
 
     # ---------------- FilerStore interface ----------------
     def insert_entry(self, entry: Entry) -> None:
         with self._lock:
+            self.write_rounds += 1
             self._log(_key(entry.full_path), entry.to_dict())
 
     update_entry = insert_entry
+
+    def insert_many(self, entries: List[Entry]) -> None:
+        """Batched upsert: the whole batch's WAL records go out in ONE
+        buffered write + flush/fsync (the per-entry path pays a fsync
+        each), then land in the memtable together."""
+        if not entries:
+            return
+        with self._lock:
+            self.write_rounds += 1
+            recs = []
+            for entry in entries:
+                d, n = _key(entry.full_path)
+                recs.append(
+                    self._packer.pack({"d": d, "n": n, "e": entry.to_dict()})
+                )
+            self._wal.write(b"".join(recs))
+            self._wal.flush()
+            if self.fsync:
+                os.fsync(self._wal.fileno())
+            for entry in entries:
+                self._mem[_key(entry.full_path)] = entry.to_dict()
+            if len(self._mem) >= self.memtable_limit:
+                self._flush_memtable()
 
     def find_entry(self, full_path: str) -> Optional[Entry]:
         with self._lock:

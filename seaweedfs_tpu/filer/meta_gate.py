@@ -302,5 +302,215 @@ class MetaLookupGate:
         self._loop = None
 
 
+class MetaWriteGate:
+    """`MetaLookupGate`'s same-tick coalescing applied to the WRITE
+    side (ISSUE 20): concurrent entry upserts of one event-loop wakeup
+    pool into ONE `store.insert_many` round — a burst of S3 PUTs costs
+    O(wakeups) store round-trips (lock acquisitions, sqlite commits,
+    WAL fsyncs) instead of O(objects).
+
+    Batch formation starts like the lookup gate's (first enqueue of a
+    tick schedules the flush with `call_soon`, so a lone write flushes
+    immediately with zero added latency) and adds an ADAPTIVE
+    group-commit linger: when a flush coalesced more than one
+    concurrent contribution — the signature of a burst, where gRPC
+    delivers roughly one request per loop tick and same-tick
+    coalescing alone would degrade to batches of ~1 — the NEXT flush
+    is scheduled with `call_later(linger_s)` so in-flight arrivals
+    accumulate into one store round (classic WAL group commit).
+    Single-caller traffic never sees the linger (a one-contribution
+    flush drops straight back to `call_soon`), so the added latency is
+    paid exactly when it buys round-trip amortization. Within a flush
+    the LAST write to a path wins (same-tick create-then-update
+    collapses to its final state) while first-enqueue ORDER is kept,
+    so a contribution's parent-spine entries stay ahead of its leaf.
+
+    Per-item error isolation (the ChunkUploadGate discipline): when the
+    batched round fails, every contribution retries alone through
+    per-entry `insert_entry` — one bad entry fails only its own caller,
+    never the whole flush (counted in stats["item_retries"])."""
+
+    def __init__(
+        self,
+        store,
+        max_batch: int = 4096,
+        linger_s: Optional[float] = None,
+    ):
+        self.store = store
+        self.max_batch = max_batch
+        if linger_s is None:
+            linger_s = float(
+                os.environ.get(
+                    "SEAWEEDFS_TPU_META_WRITE_GATE_LINGER_MS", "5"
+                )
+            ) / 1000.0
+        self.linger_s = linger_s
+        self._pending: list[tuple] = []  # (entries tuple, future)
+        self._count = 0
+        self._flush_scheduled = False
+        # contributions in the last flush: >1 means concurrent callers
+        # are in flight, so the next flush lingers to group-commit them
+        self._last_contribs = 0
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._tasks: set = set()
+        self.stats = {
+            "writes": 0,
+            "batches": 0,
+            "largest_batch": 0,
+            "coalesced": 0,
+            "item_retries": 0,
+            "lingered_batches": 0,
+        }
+
+    def insert(self, entry):
+        """Awaitable -> None once the entry is durably in the store."""
+        return self._enqueue((entry,))
+
+    def insert_many(self, entries: list):
+        """One caller's ordered entry group (an `_ensure_parents` spine
+        + its leaf, a rename's subtree page) rides the flush as one
+        contribution. Awaitable -> None."""
+        return self._enqueue(tuple(entries))
+
+    def _enqueue(self, entries: tuple):
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = asyncio.get_event_loop()
+        if self._loop is not loop:
+            # fresh event loop (restart / embedded reuse): rebind, fail
+            # futures parked on the replaced loop best-effort — see
+            # MetaLookupGate._enqueue
+            stale, self._pending = self._pending, []
+            for _e, fut in stale:
+                try:
+                    if not fut.done():
+                        fut.set_exception(
+                            LookupError("meta gate rebound to a new loop")
+                        )
+                except RuntimeError:
+                    pass
+            self._count = 0
+            self._flush_scheduled = False
+            self._last_contribs = 0
+            self._loop = loop
+        fut = loop.create_future()
+        self._pending.append((entries, fut))
+        self._count += len(entries)
+        if self._count >= self.max_batch:
+            self._flush()
+        elif not self._flush_scheduled:
+            self._flush_scheduled = True
+            if self.linger_s > 0.0 and self._last_contribs > 1:
+                self.stats["lingered_batches"] += 1
+                loop.call_later(self.linger_s, self._flush)
+            else:
+                loop.call_soon(self._flush)
+        return fut
+
+    def _flush(self) -> None:
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is not None and running is not self._loop:
+            return  # flush scheduled on a since-replaced loop
+        self._flush_scheduled = False
+        if not self._pending:
+            return
+        pending, self._pending, self._count = self._pending, [], 0
+        self._last_contribs = len(pending)
+        # last-write-wins per path, first-enqueue order kept (parents
+        # enqueue ahead of their leaf within a contribution)
+        merged: dict = {}
+        total = 0
+        for entries, _fut in pending:
+            for e in entries:
+                total += 1
+                merged[e.full_path] = e
+        batch = list(merged.values())
+        self.stats["writes"] += total
+        self.stats["batches"] += 1
+        self.stats["coalesced"] += total - len(batch)
+        if total > self.stats["largest_batch"]:
+            self.stats["largest_batch"] = total
+        try:
+            from ..util.metrics import (
+                META_WRITE_GATE_BATCHES,
+                META_WRITE_GATE_WRITES,
+            )
+
+            META_WRITE_GATE_BATCHES.inc()
+            META_WRITE_GATE_WRITES.inc(total)
+        except ImportError:
+            pass
+        if len(batch) < _EXECUTOR_THRESHOLD:
+            errs = self._apply(pending, batch)
+            self._resolve(pending, errs)
+        else:
+            t = asyncio.ensure_future(self._run_batch(pending, batch))
+            self._tasks.add(t)
+            t.add_done_callback(self._tasks.discard)
+
+    async def _run_batch(self, pending: list, batch: list) -> None:
+        loop = asyncio.get_event_loop()
+        # worker thread: the batched round fsyncs / commits — the event
+        # loop keeps serving while durability happens off-loop; futures
+        # resolve back here, on their own loop
+        errs = await loop.run_in_executor(None, self._apply, pending, batch)
+        self._resolve(pending, errs)
+
+    def _apply(self, pending: list, batch: list):
+        """Store rounds only (loop-thread or executor safe). Returns
+        None on batched success, else per-contribution exceptions (None
+        where the per-item retry succeeded)."""
+        try:
+            im = getattr(self.store, "insert_many", None)
+            if im is not None:
+                im(batch)
+            else:
+                for e in batch:
+                    self.store.insert_entry(e)
+            return None
+        except Exception:
+            # isolate: the batch round failed as a unit — retry every
+            # contribution alone so one poisoned entry fails only its
+            # own caller
+            errs = []
+            for entries, _fut in pending:
+                exc = None
+                for e in entries:
+                    self.stats["item_retries"] += 1
+                    try:
+                        self.store.insert_entry(e)
+                    except Exception as item_exc:
+                        exc = item_exc
+                errs.append(exc)
+            return errs
+
+    @staticmethod
+    def _resolve(pending: list, errs) -> None:
+        for i, (_entries, fut) in enumerate(pending):
+            if fut.done():
+                continue
+            exc = errs[i] if errs is not None else None
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(None)
+
+    def close(self) -> None:
+        for _entries, fut in self._pending:
+            try:
+                if not fut.done():
+                    fut.set_exception(LookupError("meta gate closed"))
+            except RuntimeError:
+                pass
+        self._pending = []
+        self._count = 0
+        self._last_contribs = 0
+        self._loop = None
+
+
 async def _first(fut):
     return (await fut)[0]

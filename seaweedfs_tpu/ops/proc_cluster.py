@@ -261,6 +261,9 @@ class ProcCluster:
         racks: Optional[list] = None,
         geo_source: str = "",
         durable_filers: bool = False,
+        fleet: bool = False,
+        fleet_bounds: Optional[list] = None,
+        followers: int = 0,
     ):
         self.root = os.path.abspath(root)
         self.n_volumes = volumes
@@ -285,6 +288,14 @@ class ProcCluster:
         self.racks = list(racks or [])
         self.geo_source = geo_source
         self.durable_filers = durable_filers
+        # metadata fleet (ISSUE 20): fleet=True pre-writes a FLEETMAP
+        # under root assigning each filer a directory-prefix range and
+        # spawns every filer as a range-owning member; followers spawns
+        # N read-only replicas tailing filer-0's meta log
+        self.fleet = fleet
+        self.fleet_bounds = fleet_bounds
+        self.n_followers = followers
+        self.fleet_map_path = ""
         self.children: dict[str, Child] = {}
         self.fault_events: list[dict] = []
         self._ports: set = set()
@@ -412,13 +423,33 @@ class ProcCluster:
             self._add(f"volume-{i}", "volume", vp, vargs)
 
         filer_ports = [self._port() for _ in range(self.n_filers)]
+        if self.fleet and self.n_filers > 0:
+            # the map MUST exist before any member spawns: a member's
+            # first ownership check reads it during startup
+            from ..filer.fleet import FleetMap, write_fleet_map
+
+            self.fleet_map_path = os.path.join(self.root, "FLEETMAP")
+            write_fleet_map(
+                self.fleet_map_path,
+                FleetMap(
+                    [f"127.0.0.1:{p}" for p in filer_ports],
+                    bounds=self.fleet_bounds,
+                ),
+            )
         for i, fp in enumerate(filer_ports):
             peers = ",".join(
                 f"127.0.0.1:{p}" for j, p in enumerate(filer_ports)
                 if j != i
             )
             fargs = ["-port", str(fp), "-master", maddr]
-            if peers:
+            if self.fleet_map_path:
+                # fleet members own disjoint ranges — peer meta
+                # aggregation would copy every range everywhere
+                fargs += [
+                    "-fleetMap", self.fleet_map_path,
+                    "-fleetSelf", f"127.0.0.1:{fp}",
+                ]
+            elif peers:
                 fargs += ["-peers", peers]
             if self.data_center:
                 fargs += ["-dataCenter", self.data_center]
@@ -438,6 +469,18 @@ class ProcCluster:
                         os.path.join(self.root, f"filer{i}-geo.json"),
                     ]
             self._add(f"filer-{i}", "filer", fp, fargs)
+
+        for i in range(self.n_followers):
+            fp = self._port()
+            fargs = [
+                "-port", str(fp), "-master", maddr,
+                "-followSource", f"127.0.0.1:{filer_ports[0]}",
+            ]
+            if self.durable_filers:
+                fargs += [
+                    "-store", os.path.join(self.root, f"follower{i}.db"),
+                ]
+            self._add(f"follower-{i}", "filer", fp, fargs)
 
         if self.with_s3:
             self.s3_port = self._port()
@@ -459,6 +502,36 @@ class ProcCluster:
         deadline = time.monotonic() + self.ready_timeout
         for child in self.children.values():
             self._wait_ready(child, deadline)
+        self._wait_volumes_registered(deadline)
+
+    def _wait_volumes_registered(self, deadline: float) -> None:
+        """Listeners up is not assignable: the first write races the
+        first volume heartbeat unless the master has seen every volume
+        server report capacity."""
+        if self.n_volumes == 0:
+            return
+        url = f"http://127.0.0.1:{self.master_port}/dir/status"
+        while True:
+            try:
+                with urllib.request.urlopen(url, timeout=2.0) as r:
+                    topo = json.load(r).get("Topology") or {}
+                nodes = [
+                    dn
+                    for dc in topo.get("data_centers", ())
+                    for rack in dc.get("racks", ())
+                    for dn in rack.get("data_nodes", ())
+                    if dn.get("max_volume_count", 0) > 0
+                ]
+                if len(nodes) >= self.n_volumes:
+                    return
+            except (urllib.error.URLError, OSError, ValueError):
+                pass
+            if time.monotonic() > deadline:
+                raise StartupError(
+                    f"master saw fewer than {self.n_volumes} volume "
+                    f"servers within {self.ready_timeout}s"
+                )
+            time.sleep(0.05)
 
     # roles whose server also binds port+_GRPC_OFFSET: readiness
     # must cover BOTH listeners — the HTTP side comes up first in

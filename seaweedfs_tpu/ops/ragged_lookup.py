@@ -371,6 +371,30 @@ class DeviceColumnArena:
             with self._lock:
                 self._refresh_queued = False
 
+    def prefetch(self, segment: ArenaSegment) -> str:
+        """Flush-path residency hint (ISSUE 20 satellite): the LSM store
+        offers a NEWLY SEALED run right when it seals, so the background
+        refresh uploads it before the first probe would cold-miss on it.
+        Never blocks, never counts as a probe-path cold miss. Returns the
+        outcome for the `arena_prefetch_total{result}` counter."""
+        if self._dead or not device_available():
+            return "unavailable"
+        with self._lock:
+            self._tick += 1
+            self._last_used[segment.handle] = self._tick
+            if segment.handle not in self._sources:
+                self._sources[segment.handle] = segment
+            gen = self._gen
+            if gen is not None and segment.handle in gen.seg:
+                return "resident"
+            queue = not self._refresh_queued
+            if queue:
+                self._refresh_queued = True
+        if queue:
+            self._pool.submit(self._refresh)
+            return "queued"
+        return "piggybacked"
+
     def refresh_sync(self) -> None:
         """Block until a refresh including everything registered so far
         has landed (tests/bench warm-up — serving paths never call it)."""
@@ -587,3 +611,11 @@ def get_default_arena() -> DeviceColumnArena:
         if _DEFAULT is None:
             _DEFAULT = DeviceColumnArena()
         return _DEFAULT
+
+
+def peek_default_arena() -> Optional[DeviceColumnArena]:
+    """The process-wide arena IF one has been created, else None. The
+    flush-path prefetch hint rides this instead of get_default_arena():
+    a store running without any device gate must stay arena-free — a
+    hint must never be what first allocates the HBM budget."""
+    return _DEFAULT

@@ -48,7 +48,7 @@ import time
 from typing import Callable, Optional
 
 from ..client.operation import assign
-from ..filer.entry import Entry, FileChunk
+from ..filer.entry import Attr, Entry, FileChunk
 from ..ops.loadgen import LogHistogram
 from ..pb import grpc_address
 from ..pb.rpc import Stub
@@ -66,10 +66,20 @@ from ..util.metrics import (
     GEO_EVENTS_SKIPPED,
     GEO_FULL_RESYNC_REQUIRED,
     GEO_REPLICATION_LAG,
+    GEO_RESYNCED_ENTRIES,
+    GEO_RESYNCS,
+    GEO_TOMBSTONES,
 )
 
 GEO_TS_KEY = "geo_ts"  # source event timestamp (ns) stamped on entries
 GEO_SIG_KEY = "geo_sig"  # signature over the SOURCE fids of that event
+GEO_TOMB_PATH_KEY = "geo_tomb_path"  # the deleted path a tombstone covers
+
+# hidden peer-local subtree holding delete/rename tombstones: the replay
+# shield for DESTRUCTIVE events, whose target entry (the usual stamp
+# carrier) no longer exists after apply. Never replicated onward —
+# events under this prefix are peer bookkeeping, not namespace.
+GEO_TOMB_ROOT = "/.seaweedfs/geo_tomb"
 
 
 def fid_signature(chunks: list) -> str:
@@ -316,6 +326,13 @@ class GeoReplicator:
         etype = notif.get("event_type", "")
         old = notif.get("old_entry")
         new = notif.get("new_entry")
+        path_hint = ((new or old) or {}).get("full_path", "")
+        if path_hint.startswith(GEO_TOMB_ROOT):
+            # another replicator's bookkeeping (chained topologies):
+            # never replicate tombstones as namespace
+            GEO_EVENTS_SKIPPED.inc(reason="internal")
+            self.skipped += 1
+            return
         self._kill("pre_apply")
         if etype in ("create", "update") and new:
             await self._apply_upsert(ts, new)
@@ -328,6 +345,40 @@ class GeoReplicator:
             self.skipped += 1
             return
         self._kill("post_apply")
+
+    # ---------------- tombstones (ISSUE 20 satellite) ----------------
+    def _tomb_path(self, path: str) -> str:
+        return (
+            GEO_TOMB_ROOT + "/"
+            + hashlib.sha1(path.encode()).hexdigest()
+        )
+
+    def _tomb_ts(self, path: str) -> int:
+        tomb = self.filer.find_entry(self._tomb_path(path))
+        if tomb is None:
+            return 0
+        try:
+            return int((tomb.extended or {}).get(GEO_TS_KEY, 0))
+        except (TypeError, ValueError):
+            return 0
+
+    def _write_tomb(self, path: str, ts: int, sig: str, op: str) -> None:
+        """Stamp a destructive event the same way upserts are stamped —
+        but on a surviving carrier, since the entry itself is gone."""
+        now = time.time()
+        self.filer.create_entry(
+            Entry(
+                full_path=self._tomb_path(path),
+                attr=Attr(mtime=now, crtime=now, mode=0o660),
+                chunks=[],
+                extended={
+                    GEO_TS_KEY: str(ts),
+                    GEO_SIG_KEY: sig,
+                    GEO_TOMB_PATH_KEY: path,
+                },
+            )
+        )
+        GEO_TOMBSTONES.inc(op=op)
 
     def _is_dup(self, path: str, ts: int, sig: str) -> bool:
         existing = self.filer.find_entry(path)
@@ -344,6 +395,13 @@ class GeoReplicator:
     async def _apply_upsert(self, ts: int, new: dict) -> None:
         entry = Entry.from_dict(new)
         sig = fid_signature(entry.chunks)
+        if self._tomb_ts(entry.full_path) > ts:
+            # a NEWER delete/rename of this path already applied: a
+            # replayed older create must not resurrect the entry (the
+            # stamp that would normally catch this died with it)
+            GEO_EVENTS_SKIPPED.inc(reason="dup")
+            self.skipped += 1
+            return
         existed = self.filer.find_entry(entry.full_path) is not None
         if existed and self._is_dup(entry.full_path, ts, sig):
             GEO_EVENTS_SKIPPED.inc(reason="dup")
@@ -365,10 +423,15 @@ class GeoReplicator:
         new_path = new["full_path"]
         old_path = (old or {}).get("full_path", "")
         sig = fid_signature(Entry.from_dict(new).chunks)
-        if self._is_dup(new_path, ts, sig):
+        if self._is_dup(new_path, ts, sig) or self._tomb_ts(new_path) > ts:
             GEO_EVENTS_SKIPPED.inc(reason="dup")
             self.skipped += 1
             return
+        if old_path and old_path != new_path:
+            # the OLD side vanishes: tombstone it so a replayed older
+            # upsert of old_path cannot resurrect it after our stamp
+            # carrier (the entry) is gone
+            self._write_tomb(old_path, ts, sig, op="rename")
         if old_path and self.filer.find_entry(old_path) is not None:
             # the shipped chunks already live under the old peer path:
             # rename locally (chunk bytes stay put), then stamp
@@ -393,15 +456,148 @@ class GeoReplicator:
             GEO_EVENTS_SKIPPED.inc(reason="internal")
             self.skipped += 1
             return
-        if self.filer.find_entry(path) is None:
+        if self._tomb_ts(path) >= ts:
+            # this delete (or a newer destructive event) already applied;
+            # without the tombstone a replay past a vanished entry could
+            # not be told apart from a delete racing a newer create
             GEO_EVENTS_SKIPPED.inc(reason="dup")
             self.skipped += 1
             return
-        # delete_chunks=True frees the PEER-local copies (shipped fids —
-        # never the primary's; fids were re-assigned on this cluster)
-        self.filer.delete_entry(path, recursive=True, delete_chunks=True)
+        sig = fid_signature(Entry.from_dict(old).chunks)
+        if self.filer.find_entry(path) is not None:
+            # delete_chunks=True frees the PEER-local copies (shipped
+            # fids — never the primary's; fids were re-assigned here)
+            self.filer.delete_entry(
+                path, recursive=True, delete_chunks=True
+            )
+        # tombstone AFTER the destructive apply: a crash in between
+        # replays the delete (harmless — entry already gone), never
+        # records an effect that did not land
+        self._write_tomb(path, ts, sig, op="delete")
         GEO_EVENTS_APPLIED.inc(type="delete")
         self.applied += 1
+
+    # ---------------- full resync (ISSUE 20 satellite) ----------------
+    async def resync(self) -> dict:
+        """Re-seed the peer namespace from the primary after a
+        ``resync_required`` halt (`geo.resync` / the GeoResync RPC).
+
+        Idempotent by construction: the walk applies through the same
+        stamped-upsert path as the stream (an entry whose geo_sig already
+        matches is skipped without re-shipping bytes), so running it
+        twice — or crashing halfway and running it again — converges to
+        the same namespace. The cursor is acked at a primary watermark
+        taken BEFORE the walk: any mutation racing the walk lands at a
+        higher ts and replays through the resumed tail, deduped by the
+        stamps if the walk already saw it."""
+        t0 = time.perf_counter()
+        was_running = self._task is not None
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        self.connected = False
+        if self._http is None:
+            self._http = FastHTTPClient(pool_per_host=16)
+            self._own_http = True
+        try:
+            result = await self._resync_walk()
+        except Exception:
+            GEO_RESYNCS.inc(outcome="failed")
+            # resync_required stays up: the halt reason is unresolved
+            if was_running and not self._stopped:
+                self._task = asyncio.ensure_future(self._run())
+            raise
+        GEO_RESYNCS.inc(outcome="ok")
+        self.resync_required = False
+        self.trimmed_through = 0
+        if was_running and not self._stopped:
+            self._task = asyncio.ensure_future(self._run())
+        result["wall_s"] = round(time.perf_counter() - t0, 3)
+        return result
+
+    async def _resync_walk(self) -> dict:
+        stub = Stub(grpc_address(self.source), "filer")
+        conf = await stub.call("GetFilerConfiguration", {}, timeout=10.0)
+        # watermark BEFORE the walk: everything the walk could possibly
+        # miss is above it and replays through the resumed tail
+        watermark = int(conf.get("last_ts_ns", 0))
+        upserted = skipped = pruned = 0
+        primary_paths: set[str] = set()
+        stack = ["/"]
+        while stack:
+            d = stack.pop()
+            last = ""
+            while True:
+                resp = await stub.call(
+                    "ListEntries",
+                    {
+                        "directory": d,
+                        "start_from_file_name": last,
+                        "inclusive_start_from": False,
+                        "limit": 1024,
+                    },
+                    timeout=30.0,
+                )
+                ents = resp.get("entries") or []
+                if not ents:
+                    break
+                for ed in ents:
+                    p = ed.get("full_path", "")
+                    last = p.rsplit("/", 1)[-1]
+                    if not p or p.startswith("/.seaweedfs"):
+                        continue
+                    primary_paths.add(p)
+                    entry = Entry.from_dict(ed)
+                    if entry.is_directory:
+                        stack.append(p)
+                    if await self._resync_upsert(watermark, entry):
+                        upserted += 1
+                    else:
+                        skipped += 1
+                if len(ents) < 1024:
+                    break
+        # prune what the primary no longer has (deletes whose events were
+        # trimmed away); peer-local bookkeeping is exempt
+        for e in list(self.filer.list_entries_recursive("/")):
+            p = e.full_path
+            if p.startswith("/.seaweedfs") or p in primary_paths:
+                continue
+            if self.filer.find_entry(p) is None:
+                continue  # removed with a pruned parent
+            self.filer.delete_entry(p, recursive=True, delete_chunks=True)
+            GEO_RESYNCED_ENTRIES.inc(kind="pruned")
+            pruned += 1
+        self._ack_cursor(watermark)
+        return {
+            "source": self.source,
+            "upserted": upserted,
+            "skipped": skipped,
+            "pruned": pruned,
+            "cursor_ns": watermark,
+        }
+
+    async def _resync_upsert(self, watermark: int, entry: Entry) -> bool:
+        """One walked entry through the idempotent stamp discipline.
+        Returns True when the store changed (counted upserted)."""
+        sig = fid_signature(entry.chunks)
+        existing = self.filer.find_entry(entry.full_path)
+        if (
+            existing is not None
+            and (existing.extended or {}).get(GEO_SIG_KEY) == sig
+        ):
+            return False  # same source fids already landed: bytes stay
+        if not entry.is_directory and entry.chunks:
+            entry.chunks = await self._ship_chunks(entry.chunks)
+        entry.extended = dict(entry.extended or {})
+        entry.extended[GEO_TS_KEY] = str(watermark)
+        entry.extended[GEO_SIG_KEY] = sig
+        self.filer.create_entry(entry)
+        GEO_RESYNCED_ENTRIES.inc(kind="upserted")
+        return True
 
     # ---------------- chunk shipping (cold-tier discipline) ----------------
     async def _source_master(self) -> str:

@@ -256,6 +256,9 @@ class FilerServer:
         data_center: str = "",
         geo_source: str = "",
         geo_state_path: str = "",
+        fleet_map_path: str = "",
+        fleet_self: str = "",
+        follow_source: str = "",
     ):
         self.master = master
         self.host = host
@@ -338,6 +341,41 @@ class FilerServer:
                 )
             else:
                 self.meta_gate = MetaLookupGate(self.filer.store)
+        # gate-batched WRITE seam (ISSUE 20 tentpole 2): concurrent
+        # creates of one event-loop tick coalesce into ONE insert_many
+        # store round — a 1k-object PUT burst costs O(wakeups) rounds
+        # instead of O(objects). Default on; =0 keeps per-entry writes.
+        self.write_gate = None
+        _wg = _os.environ.get("SEAWEEDFS_TPU_META_WRITE_GATE", "1") or "1"
+        if _wg != "0":
+            from ..filer.meta_gate import MetaWriteGate
+
+            self.write_gate = MetaWriteGate(self.filer.store)
+        # metadata serving fleet (ISSUE 20 tentpole 1): when -fleetMap
+        # names the shared crash-safe fleet map, this filer owns one
+        # prefix-range of the namespace, forwards everything else to the
+        # owning member, and can move ranges to a neighbor under traffic
+        self._fleet = None
+        if fleet_map_path:
+            from ..filer.fleet import FleetMember
+
+            self._fleet = FleetMember(
+                fleet_map_path, fleet_self or self.address, self.filer
+            )
+        # meta-log-fed read replica (ISSUE 20 tentpole 3): -followSource
+        # makes this filer an eventually-consistent GET/LIST mirror of
+        # the named primary, with a disclosed staleness bound and a
+        # counted redirect path for read-your-writes
+        self._follower = None
+        if follow_source:
+            from ..filer.meta_follower import MetaFollower
+
+            self._follower = MetaFollower(
+                follow_source,
+                self.filer,
+                (store_path + ".follower.json") if store_path else "",
+                client_name=f"follower@{self.address}",
+            )
         # the filer's own DC label: read affinity (the shared vid map
         # orders same-DC replicas first) and geo write affinity
         self.data_center = data_center
@@ -421,11 +459,29 @@ class FilerServer:
         svc.unary("Statistics")(self._grpc_statistics)
         svc.unary("GetFilerConfiguration")(self._grpc_configuration)
         svc.unary("GeoStatus")(self._grpc_geo_status)
+        svc.unary("GeoResync")(self._grpc_geo_resync)
+        svc.unary("FleetStatus")(self._grpc_fleet_status)
+        svc.unary("FleetIngest")(self._grpc_fleet_ingest)
+        svc.unary("FleetMoveRange")(self._grpc_fleet_move_range)
         svc.server_stream("SubscribeMetadata")(self._grpc_subscribe_metadata)
         svc.server_stream("SubscribeLocalMetadata")(
             self._grpc_subscribe_local_metadata
         )
         self._grpc_server = await serve(grpc_address(self.address), svc)
+        if self._fleet is not None:
+            # finish/roll back whatever a crash left mid-move BEFORE
+            # serving: the map's intent/cleanup records are authoritative
+            rec = self._fleet.recover()
+            if rec["purged"] or rec["cleaned"] or rec["intent_cleared"]:
+                from ..util import log as _log
+
+                _log.info(
+                    "fleet recovery at %s: purged %d strays, cleaned %d, "
+                    "intent_cleared=%s", self.address, rec["purged"],
+                    rec["cleaned"], rec["intent_cleared"],
+                )
+        if self._follower is not None:
+            await self._follower.start()
         if self.meta_aggregator is not None:
             self.meta_aggregator.start()
         if self.geo_source:
@@ -475,6 +531,8 @@ class FilerServer:
                 pass  # next tick retries; hysteresis bounds churn
 
     async def stop(self) -> None:
+        if self._follower is not None:
+            await self._follower.stop()
         if self.geo_replicator is not None:
             await self.geo_replicator.stop()
         if self.meta_aggregator is not None:
@@ -500,6 +558,8 @@ class FilerServer:
             await self._chunk_http.close()
         if self.meta_gate is not None:
             self.meta_gate.close()
+        if self.write_gate is not None:
+            self.write_gate.close()
         closer = getattr(self.filer.meta_log, "close", None)
         if closer is not None:
             closer()
@@ -877,10 +937,31 @@ class FilerServer:
             return await self.meta_gate.lookup(path)
         return self.filer.find_entry(path)
 
+    def _fleet_owns(self, path: str) -> bool:
+        """True when no fleet is configured or this member owns the
+        path's directory band (HTTP handlers redirect otherwise)."""
+        if self._fleet is None:
+            return True
+        from ..filer.fleet import dir_of
+
+        return self._fleet.owner_for_dir(dir_of(path)) == (
+            self._fleet.self_addr
+        )
+
+    def _fleet_redirect(self, path: str) -> web.Response:
+        from ..filer.fleet import dir_of
+
+        owner = self._fleet.owner_for_dir(dir_of(path))
+        return web.Response(
+            status=307, headers={"Location": f"http://{owner}{path}"}
+        )
+
     async def _fast_get(self, req):
         path = self._fast_path(req)
         if path is None or path == "/":
             return FALLBACK
+        if not self._fleet_owns(path):
+            return FALLBACK  # cold tier issues the fleet redirect
         try:
             entry = await self._find_entry_gated(path)
         except Exception:
@@ -910,6 +991,10 @@ class FilerServer:
         path = self._fast_path(req)
         if path is None or path == "/":
             return FALLBACK  # ttl/encoded/dir-target uploads: cold tier
+        if self._fleet is not None or self._follower is not None:
+            # fleet routing/fencing and follower redirects live in the
+            # cold tier's full handler
+            return FALLBACK
         ct = req.headers.get(b"content-type", b"")
         if ct.startswith(b"multipart/form-data") or self._is_dir(path):
             return FALLBACK  # form uploads keep the full parser
@@ -923,13 +1008,27 @@ class FilerServer:
                 500, json.dumps({"error": str(e)}).encode()
             )
         try:
-            entry = self.filer.touch(
-                path,
-                ct.decode("latin1"),
-                chunks,
-                replication=self.replication,
-                collection=self.collection,
-            )
+            mime = ct.decode("latin1")
+            if self.write_gate is not None:
+                # the write seam: a burst of fast-tier PUTs coalesces
+                # into one insert_many per event-loop wakeup
+                entry = await self.filer.touch_gated(
+                    path,
+                    mime,
+                    chunks,
+                    self.write_gate,
+                    lookup_gate=self.meta_gate,
+                    replication=self.replication,
+                    collection=self.collection,
+                )
+            else:
+                entry = self.filer.touch(
+                    path,
+                    mime,
+                    chunks,
+                    replication=self.replication,
+                    collection=self.collection,
+                )
         except OSError as e:
             self._queue_chunk_deletion([c.fid for c in chunks])
             return render_response(
@@ -957,6 +1056,8 @@ class FilerServer:
         return web.json_response({"error": "method not allowed"}, status=405)
 
     async def _handle_get(self, request: web.Request, path: str) -> web.StreamResponse:
+        if path != "/" and not self._fleet_owns(path):
+            return self._fleet_redirect(path)
         entry = self.filer.find_entry(path)
         if entry is None:
             return web.json_response({"error": "not found"}, status=404)
@@ -1009,14 +1110,59 @@ class FilerServer:
         else:
             data = await request.read()
             mime = content_type
+        if self._follower is not None:
+            return web.json_response(
+                {"error": "read_only_follower",
+                 "primary": self._follower.source},
+                status=307,
+                headers={
+                    "Location": f"http://{self._follower.source}{path}"
+                },
+            )
         chunks = await self._write_chunks(data, ttl=request.query.get("ttl", ""))
-        entry = self.filer.touch(
-            path,
-            mime,
-            chunks,
-            replication=self.replication,
-            collection=self.collection,
-        )
+        if self._fleet is not None:
+            # chunks are cluster-global (already written); the ENTRY
+            # routes through the same fleet path as gRPC creates —
+            # ownership check, fence admission, spine broadcast and all
+            now = time.time()
+            entry = Entry(
+                full_path=path,
+                attr=Attr(
+                    mtime=now, crtime=now, mime=mime,
+                    replication=self.replication,
+                    collection=self.collection,
+                ),
+                chunks=chunks,
+            )
+            resp = await self._grpc_create_entry(
+                {"entry": entry.to_dict()}, None
+            )
+            if resp.get("error"):
+                self._queue_chunk_deletion([c.fid for c in chunks])
+                return web.json_response(
+                    {"error": resp["error"]}, status=500
+                )
+            return web.json_response(
+                {"name": entry.name, "size": len(data)}, status=201
+            )
+        if self.write_gate is not None:
+            entry = await self.filer.touch_gated(
+                path,
+                mime,
+                chunks,
+                self.write_gate,
+                lookup_gate=self.meta_gate,
+                replication=self.replication,
+                collection=self.collection,
+            )
+        else:
+            entry = self.filer.touch(
+                path,
+                mime,
+                chunks,
+                replication=self.replication,
+                collection=self.collection,
+            )
         return web.json_response(
             {"name": entry.name, "size": len(data)}, status=201
         )
@@ -1027,6 +1173,27 @@ class FilerServer:
 
     async def _handle_delete(self, request: web.Request, path: str) -> web.Response:
         recursive = request.query.get("recursive") == "true"
+        if self._follower is not None:
+            return web.json_response(
+                {"error": "read_only_follower",
+                 "primary": self._follower.source},
+                status=307,
+                headers={
+                    "Location": f"http://{self._follower.source}{path}"
+                },
+            )
+        if self._fleet is not None:
+            d = path.rsplit("/", 1)[0] or "/"
+            name = path.rsplit("/", 1)[-1]
+            resp = await self._grpc_delete_entry(
+                {"directory": d, "name": name, "is_recursive": recursive},
+                None,
+            )
+            if resp.get("error"):
+                return web.json_response(
+                    {"error": resp["error"]}, status=409
+                )
+            return web.Response(status=204)
         try:
             self.filer.delete_entry(path, recursive=recursive)
         except OSError as e:
@@ -1036,33 +1203,130 @@ class FilerServer:
     # ---------------- gRPC ----------------
     async def _grpc_lookup_entry(self, req, context) -> dict:
         path = req["directory"].rstrip("/") + "/" + req["name"]
+        if self._follower is not None:
+            # read-your-writes seam: a caller holding a primary write
+            # watermark ahead of our tail cursor gets a counted redirect
+            r = self._follower.gate_read(req)
+            if r is not None:
+                return r
+        if self._fleet is not None:
+            from ..filer.fleet import dir_of
+
+            routed = await self._fleet.admit(
+                "LookupDirectoryEntry", req, dir_of(path)
+            )
+            if routed is not None:
+                return routed
         entry = await self._find_entry_gated(path)
         if entry is None:
             return {"error": "not found"}
         return {"entry": entry.to_dict()}
 
     async def _grpc_list_entries(self, req, context) -> dict:
+        d = req["directory"].rstrip("/") or "/"
+        if self._follower is not None:
+            r = self._follower.gate_read(req)
+            if r is not None:
+                return r
+        if self._fleet is not None:
+            # children of d carry directory == d, so the lister IS the
+            # owner of d's band; subdirectory placeholders are present
+            # everywhere via the spine broadcast
+            routed = await self._fleet.admit("ListEntries", req, d)
+            if routed is not None:
+                return routed
         entries = self.filer.list_entries(
-            req["directory"],
+            d,
             req.get("start_from_file_name", ""),
             bool(req.get("inclusive_start_from", True)),
             int(req.get("limit", 1024)),
         )
         return {"entries": [e.to_dict() for e in entries]}
 
-    async def _grpc_create_entry(self, req, context) -> dict:
-        try:
-            self.filer.create_entry(
-                Entry.from_dict(req["entry"]),
-                exclusive=bool(req.get("o_excl", False)),
+    async def _create_local(self, entry: Entry, exclusive: bool) -> None:
+        """One create through the gate-batched write seam (O_EXCL keeps
+        the synchronous probe-insert path: its atomicity cannot ride a
+        coalesced flush)."""
+        if self.write_gate is not None and not exclusive:
+            await self.filer.create_entry_gated(
+                entry, self.write_gate, lookup_gate=self.meta_gate
             )
-        except OSError as e:
-            return {"error": str(e)}
-        # safe watermark: the mutation and this read run in one synchronous
-        # block (no await between), so no other event can interleave
-        return {"ts_ns": self.filer.meta_log.last_ts_ns}
+        else:
+            self.filer.create_entry(entry, exclusive=exclusive)
+
+    async def _grpc_create_entry(self, req, context) -> dict:
+        if self._follower is not None:
+            return {
+                "error": "read_only_follower",
+                "primary": self._follower.source,
+            }
+        entry_dict = req["entry"]
+        path = entry_dict["full_path"]
+        if self._fleet is None:
+            try:
+                await self._create_local(
+                    Entry.from_dict(entry_dict),
+                    bool(req.get("o_excl", False)),
+                )
+            except OSError as e:
+                return {"error": str(e)}
+            # safe watermark: last_ts_ns is taken AFTER the awaited
+            # insert landed, so it is >= this mutation's event ts — a
+            # conservative read-your-writes anchor
+            return {"ts_ns": self.filer.meta_log.last_ts_ns}
+        from ..filer.fleet import ancestor_dirs, dir_of
+
+        routed = await self._fleet.admit(
+            "CreateEntry", req, dir_of(path), mutation=True
+        )
+        if routed is not None:
+            return routed
+        try:
+            chain = ancestor_dirs(path)
+            present = self.filer.store.find_many(chain) if chain else {}
+            missing = [p for p in chain if p not in present]
+            try:
+                await self._create_local(
+                    Entry.from_dict(entry_dict),
+                    bool(req.get("o_excl", False)),
+                )
+            except OSError as e:
+                return {"error": str(e)}
+            ts = self.filer.meta_log.last_ts_ns
+            if missing:
+                # replicate freshly minted directory placeholders to
+                # every member BEFORE answering: a successful create
+                # implies a fleet-wide visible spine
+                created = self.filer.store.find_many(missing)
+                await self._fleet.broadcast_spine(
+                    [created[p] for p in missing if p in created]
+                )
+            return {"ts_ns": ts}
+        finally:
+            self._fleet.finish_mutation()
 
     async def _grpc_update_entry(self, req, context) -> dict:
+        if self._follower is not None:
+            return {
+                "error": "read_only_follower",
+                "primary": self._follower.source,
+            }
+        if self._fleet is not None:
+            from ..filer.fleet import dir_of
+
+            routed = await self._fleet.admit(
+                "UpdateEntry", req, dir_of(req["entry"]["full_path"]),
+                mutation=True,
+            )
+            if routed is not None:
+                return routed
+            try:
+                self.filer.update_entry(Entry.from_dict(req["entry"]))
+            except OSError as e:
+                return {"error": str(e)}
+            finally:
+                self._fleet.finish_mutation()
+            return {}
         try:
             self.filer.update_entry(Entry.from_dict(req["entry"]))
         except OSError as e:
@@ -1071,6 +1335,35 @@ class FilerServer:
 
     async def _grpc_delete_entry(self, req, context) -> dict:
         path = req["directory"].rstrip("/") + "/" + req["name"]
+        if self._follower is not None:
+            return {
+                "error": "read_only_follower",
+                "primary": self._follower.source,
+            }
+        if self._fleet is None:
+            return await self._delete_local(req, path)
+        from ..filer.fleet import dir_of
+
+        routed = await self._fleet.admit(
+            "DeleteEntry", req, dir_of(path), mutation=True
+        )
+        if routed is not None:
+            return routed
+        try:
+            if bool(req.get("is_recursive", False)) and not req.get(
+                "fleet_local"
+            ):
+                e = self.filer.find_entry(path)
+                if e is not None and e.is_directory:
+                    # a subtree spans owners: every member deletes its
+                    # local slice (placeholders included); chunk frees
+                    # stay member-local, so nothing double-frees
+                    await self._fleet.broadcast("DeleteEntry", req)
+            return await self._delete_local(req, path)
+        finally:
+            self._fleet.finish_mutation()
+
+    async def _delete_local(self, req: dict, path: str) -> dict:
         try:
             self.filer.delete_entry(
                 path,
@@ -1084,11 +1377,63 @@ class FilerServer:
     async def _grpc_rename(self, req, context) -> dict:
         old = req["old_directory"].rstrip("/") + "/" + req["old_name"]
         new = req["new_directory"].rstrip("/") + "/" + req["new_name"]
+        if self._follower is not None:
+            return {
+                "error": "read_only_follower",
+                "primary": self._follower.source,
+            }
+        if self._fleet is None:
+            try:
+                self.filer.rename(old, new)
+            except OSError as e:  # incl. FileNotFound/NotADirectory/self-move
+                return {"error": str(e)}
+            return {"ts_ns": self.filer.meta_log.last_ts_ns}
+        from ..filer.fleet import dir_of
+
+        routed = await self._fleet.admit(
+            "AtomicRenameEntry", req, dir_of(old), mutation=True
+        )
+        if routed is not None:
+            return routed
         try:
-            self.filer.rename(old, new)
-        except OSError as e:  # incl. FileNotFound / NotADirectory / self-move
-            return {"error": str(e)}
-        return {"ts_ns": self.filer.meta_log.last_ts_ns}
+            same_owner = self._fleet.owner_for_dir(
+                dir_of(new)
+            ) == self._fleet.self_addr
+            entry = self.filer.find_entry(old)
+            if entry is None:
+                return {"error": f"rename: {old} not found"}
+            if entry.is_directory and not same_owner:
+                # a subtree rename re-homes every child across range
+                # owners at once — out of scope for the fleet plane
+                # (documented); files move via routed create + delete
+                return {
+                    "error": "fleet: cross-range directory rename "
+                    "unsupported"
+                }
+            if same_owner:
+                try:
+                    self.filer.rename(old, new)
+                except OSError as e:
+                    return {"error": str(e)}
+                return {"ts_ns": self.filer.meta_log.last_ts_ns}
+            moved = Entry(
+                full_path=new,
+                attr=entry.attr,
+                chunks=entry.chunks,
+                extended=entry.extended,
+            )
+            resp = await self._fleet.forward(
+                "CreateEntry",
+                {"entry": moved.to_dict()},
+                self._fleet.owner_for_dir(dir_of(new)),
+            )
+            if resp.get("error"):
+                return resp
+            # the chunks now belong to the new entry on the new owner
+            self.filer.delete_entry(old, delete_chunks=False)
+            return {"ts_ns": self.filer.meta_log.last_ts_ns}
+        finally:
+            self._fleet.finish_mutation()
 
     async def _grpc_assign_volume(self, req, context) -> dict:
         try:
@@ -1123,6 +1468,61 @@ class FilerServer:
         st["configured"] = True
         st["data_center"] = self.data_center
         return st
+
+    async def _grpc_geo_resync(self, req, context) -> dict:
+        """Operator-driven full resync of the geo namespace from the
+        primary (ISSUE 20 satellite): the recovery path after
+        MetaLogTrimmed halted the tail. Idempotent and counted."""
+        if self.geo_replicator is None:
+            return {"error": "no geo replication configured"}
+        try:
+            return await self.geo_replicator.resync()
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    async def _grpc_fleet_status(self, req, context) -> dict:
+        """Fleet-plane state of THIS filer: map/epoch/range, forward and
+        ingest counters, write-gate coalescing stats, and (when
+        following) the replica tail — `meta.fleet.status` surfaces it."""
+        out: dict = {
+            "configured": self._fleet is not None,
+            "address": self.address,
+            "write_rounds": getattr(self.filer.store, "write_rounds", 0),
+        }
+        if self.write_gate is not None:
+            out["write_gate"] = dict(self.write_gate.stats)
+        if self._fleet is not None:
+            out["fleet"] = self._fleet.status()
+            out["map"] = out["fleet"]["map"]  # router convenience
+        if self._follower is not None:
+            out["follower"] = self._follower.status()
+        return out
+
+    async def _grpc_fleet_ingest(self, req, context) -> dict:
+        if self._fleet is None:
+            return {"error": "not a fleet member"}
+        loop = asyncio.get_event_loop()
+        try:
+            # store work (range purge scans, batched inserts) off the
+            # event loop: ingest pages arrive mid-move while this member
+            # keeps serving its own range
+            return await loop.run_in_executor(
+                None, self._fleet.ingest, req
+            )
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    async def _grpc_fleet_move_range(self, req, context) -> dict:
+        if self._fleet is None:
+            return {"error": "not a fleet member"}
+        try:
+            return await self._fleet.move_range(
+                req["dst"], req["lo"], req["hi"]
+            )
+        except (ValueError, TimeoutError) as e:
+            return {"error": str(e)}
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
 
     async def _grpc_subscribe_metadata(self, req, context):
         """Stream namespace change events from since_ns onward — the
@@ -1206,4 +1606,7 @@ class FilerServer:
             "replication": self.replication,
             "max_mb": self.chunk_size // (1024 * 1024),
             "cipher": self.cipher,
+            # meta-log head watermark: followers' periodic head probe
+            # (the disclosed-staleness bound's second arm) reads it here
+            "last_ts_ns": self.filer.meta_log.last_ts_ns,
         }

@@ -1153,6 +1153,118 @@ async def cmd_geo_status(env, argv) -> str:
     return "\n".join(lines)
 
 
+@command("geo.resync")
+async def cmd_geo_resync(env, argv) -> str:
+    """Re-seed a second-site filer from its primary: geo.resync
+    [-filer host:port]. Clears a geo.status 'FULL RESYNC REQUIRED'
+    halt by walking the primary namespace through the idempotent
+    stamped-upsert path (unchanged entries skip without re-shipping
+    bytes), pruning peer entries the primary no longer has, and
+    resuming the tail from a pre-walk watermark. Safe to re-run."""
+    flags = _parse_flags(argv)
+    filer = flags.get("filer", "") or env.filer
+    if not filer:
+        return "geo.resync needs -filer host:port (or a sticky filer)"
+    from ..pb import grpc_address
+    from ..pb.rpc import Stub
+
+    r = await Stub(grpc_address(filer), "filer").call(
+        "GeoResync", {}, timeout=3600
+    )
+    if r.get("error"):
+        return f"geo.resync on {filer} failed: {r['error']}"
+    return (
+        f"filer {filer} resynced from {r.get('source')}: "
+        f"{r.get('upserted')} upserted · {r.get('skipped')} unchanged · "
+        f"{r.get('pruned')} pruned · cursor {r.get('cursor_ns')} · "
+        f"{r.get('wall_s')}s"
+    )
+
+
+@command("meta.fleet.status")
+async def cmd_meta_fleet_status(env, argv) -> str:
+    """Metadata fleet status: meta.fleet.status [-filer host:port].
+    Shows the queried member's FLEETMAP view (epoch, every member's
+    directory range, pending move/cleanup), its write-gate coalescing
+    stats + store write rounds, and — when the member is a follower —
+    the tail cursor and disclosed staleness bound."""
+    flags = _parse_flags(argv)
+    filer = flags.get("filer", "") or env.filer
+    if not filer:
+        return "meta.fleet.status needs -filer host:port (or a sticky filer)"
+    from ..pb import grpc_address
+    from ..pb.rpc import Stub
+
+    r = await Stub(grpc_address(filer), "filer").call(
+        "FleetStatus", {}, timeout=10.0
+    )
+    if r.get("error"):
+        return f"meta.fleet.status on {filer} failed: {r['error']}"
+    lines = [f"filer {r.get('address', filer)}:"]
+    fleet = r.get("fleet")
+    if not r.get("configured"):
+        lines.append("  fleet: not a fleet member")
+    elif fleet:
+        m = fleet.get("map", {})
+        lines.append(
+            f"  fleet epoch {fleet.get('epoch')} · "
+            f"{fleet.get('members')} member(s) · self range "
+            f"[{fleet['range'][0] or '-inf'}, {fleet['range'][1] or '+inf'})"
+        )
+        bounds = m.get("bounds", [])
+        for i, addr in enumerate(m.get("addresses", [])):
+            lo = bounds[i - 1] if i > 0 else ""
+            hi = bounds[i] if i < len(bounds) else ""
+            marker = " (self)" if addr == fleet.get("self") else ""
+            lines.append(
+                f"    {addr}: [{lo or '-inf'}, {hi or '+inf'}){marker}"
+            )
+        if m.get("pending_move"):
+            pm = m["pending_move"]
+            lines.append(
+                f"  PENDING MOVE [{pm['lo']}, {pm['hi']}) "
+                f"{pm['src']} -> {pm['dst']}"
+            )
+        if m.get("pending_cleanup"):
+            pc = m["pending_cleanup"]
+            lines.append(
+                f"  pending cleanup [{pc['lo']}, {pc['hi']}) on {pc['src']}"
+            )
+        c = fleet.get("counters", {})
+        lines.append(
+            f"  forwarded {c.get('forwarded')} · ingested "
+            f"{c.get('ingested')} · moves {c.get('moves_committed')} ok / "
+            f"{c.get('moves_failed')} failed · fence waits "
+            f"{c.get('fence_waits')}"
+        )
+    wg = r.get("write_gate")
+    if wg:
+        lines.append(
+            f"  write gate: {wg.get('writes')} writes in "
+            f"{wg.get('batches')} round(s) · coalesced "
+            f"{wg.get('coalesced')} · largest batch "
+            f"{wg.get('largest_batch')} · item retries "
+            f"{wg.get('item_retries')}"
+        )
+    if "write_rounds" in r:
+        lines.append(f"  store write rounds: {r['write_rounds']}")
+    fo = r.get("follower")
+    if fo:
+        lines.append(
+            f"  follower of {fo.get('source')}: "
+            + ("connected" if fo.get("connected") else "DISCONNECTED")
+            + f" · cursor {fo.get('cursor_ns')} · staleness bound "
+            f"{fo.get('staleness_bound_s')}s · applied {fo.get('applied')}"
+            f" · redirects {fo.get('redirects')}"
+        )
+        if fo.get("resync_required"):
+            lines.append(
+                "  RESYNC REQUIRED: cursor behind primary retention "
+                f"(trimmed through {fo.get('trimmed_through')})"
+            )
+    return "\n".join(lines)
+
+
 @command("ec.balance")
 async def cmd_ec_balance(env, argv) -> str:
     """Dedupe + rack-aware rebalancing of EC shards

@@ -812,6 +812,74 @@ META_FEED_EVICTIONS = REGISTRY.counter(
     "validate-on-hit)",
 )
 
+# metadata serving fleet (ISSUE 20, see docs/perf.md "Metadata fleet"):
+# shard-range filer PROCESSES behind one crash-safe fleet map, the
+# gate-batched write seam, and meta-log-fed read replicas
+FLEET_FORWARDED = REGISTRY.counter(
+    "seaweedfs_tpu_fleet_forwarded_total",
+    "filer requests forwarded to the owning fleet member because the "
+    "fleet map routes the path elsewhere, by op — zero-misroute never "
+    "depends on client map freshness, the server-side hop is the "
+    "authority",
+)
+FLEET_INGESTED = REGISTRY.counter(
+    "seaweedfs_tpu_fleet_ingested_entries_total",
+    "entries applied straight to the local store by FleetIngest "
+    "(range-move copy/delta pages and directory-spine broadcasts)",
+)
+FLEET_MOVES = REGISTRY.counter(
+    "seaweedfs_tpu_fleet_range_moves_total",
+    "fleet range moves by outcome (committed/failed): a committed move "
+    "re-homed a prefix range between two live filer processes under "
+    "the fence-and-delta discipline",
+)
+META_WRITE_GATE_BATCHES = REGISTRY.counter(
+    "seaweedfs_tpu_meta_write_gate_batches_total",
+    "write-gate flushes: each one is ONE store round (insert_many) "
+    "carrying every create/update enqueued in the same event-loop tick",
+)
+META_WRITE_GATE_WRITES = REGISTRY.counter(
+    "seaweedfs_tpu_meta_write_gate_writes_total",
+    "individual entry writes that rode a write-gate flush (writes / "
+    "batches = the measured coalescing factor)",
+)
+FOLLOWER_EVENTS = REGISTRY.counter(
+    "seaweedfs_tpu_meta_follower_events_total",
+    "meta-log events a read replica applied to its local store, by "
+    "type (upsert/delete/rename)",
+)
+FOLLOWER_REDIRECTS = REGISTRY.counter(
+    "seaweedfs_tpu_meta_follower_redirects_total",
+    "follower reads redirected to the primary because the caller's "
+    "read-your-writes watermark (min_ts_ns) was ahead of the tail "
+    "cursor",
+)
+ARENA_PREFETCH = REGISTRY.counter(
+    "seaweedfs_tpu_arena_prefetch_total",
+    "LSM flush-path arena residency hints, by result (queued = this "
+    "hint scheduled the refresh, piggybacked = one was already queued, "
+    "resident = already uploaded, no_arena = no device gate ever "
+    "created an arena, unavailable = device absent or arena killed, "
+    "error = hint path failed — never the flush itself)",
+)
+GEO_RESYNCS = REGISTRY.counter(
+    "seaweedfs_tpu_geo_resyncs_total",
+    "operator-driven geo full resyncs by outcome (ok/failed): a "
+    "namespace re-seed from the primary after MetaLogTrimmed halted "
+    "the tail",
+)
+GEO_RESYNCED_ENTRIES = REGISTRY.counter(
+    "seaweedfs_tpu_geo_resynced_entries_total",
+    "entries re-seeded onto the peer by geo full resyncs, by kind "
+    "(upserted/pruned)",
+)
+GEO_TOMBSTONES = REGISTRY.counter(
+    "seaweedfs_tpu_geo_tombstones_total",
+    "geo tombstones written under /.seaweedfs/geo_tomb for replicated "
+    "deletes/renames, by op (delete/rename) — the replay shield for "
+    "destructive events whose target entry no longer exists",
+)
+
 # cold-tier follow-up (ISSUE 15 satellite): remote objects deleted by
 # the master-dispatched orphan sweep — bytes leaked by crashes between
 # manifest uncommit and remote delete, reclaimed (never data)

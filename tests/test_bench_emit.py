@@ -695,6 +695,46 @@ def test_needle_map_lookup_leg_shape():
     assert any(pr["negatives"] > 0 for pr in bl["per_run"])
 
 
+def test_meta_fleet_leg_shape():
+    """ISSUE 20 guard: the meta.fleet leg must stand up REAL filer
+    fleets per process count, emit non-zero lookup/LIST capacity QPS
+    for every count with the scaling ratios disclosed, keep every
+    probe identity-checked (zero mismatches/errors), PROVE the
+    capacity sum additive (forwarded counter 0 everywhere), and count
+    the write seam's store rounds gate-on vs gate-off on the same
+    burst. Small/short shape: structure + loose bounds here — the
+    >=2.5x / >=4x acceptance numbers come from the full bench run."""
+    r = bench.measure_meta_fleet(
+        n_dirs=12, files_per_dir=8, lookups=500, lists=150,
+        fleet_sizes=(1, 2), drivers=2, concurrency=8, put_burst=200,
+    )
+    assert r["identical"] is True
+    assert r["coordination_free"] is True
+    assert r["cpu_count"] >= 1
+    assert set(r["per_fleet_size"]) == {"1", "2"}
+    for n, v in r["per_fleet_size"].items():
+        assert v["lookup_capacity_qps"] > 0, n
+        assert v["list_capacity_qps"] > 0, n
+        assert v["concurrent_lookup"]["qps"] > 0, n
+        assert v["concurrent_list"]["qps"] > 0, n
+        assert v["forwarded_during_probes"] == 0, n
+        assert len(v["per_member_lookup"]) == int(n)
+    # scaling ratios disclosed (acceptance thresholds judged full-size)
+    assert r["lookup_qps_scaling"] > 0
+    assert r["list_qps_scaling"] > 0
+    assert r["concurrent_lookup_scaling"] > 0
+    # write seam: rounds COUNTED (not projected) on both arms of the
+    # same burst; per-entry pays at least one round per object while
+    # the gated arm visibly coalesces even at this tiny shape
+    assert r["burst_per_entry"]["write_rounds"] >= 200
+    assert 0 < r["burst_gated"]["write_rounds"]
+    assert r["write_rounds_ratio"] >= 2.0
+    gs = r["burst_gated"]["write_gate"]
+    assert gs["writes"] >= 200
+    assert gs["largest_batch"] > 1
+    assert gs["item_retries"] == 0
+
+
 def test_needle_map_device_lookup_leg_shape():
     """ISSUE 18 guard: the needle_map.device_lookup leg must be a
     MEASURED end-to-end run through the real gate seam — non-zero

@@ -240,6 +240,188 @@ def test_kill_point_grid(tmp_path):
     asyncio.run(body())
 
 
+def test_tombstone_kill_point_grid(tmp_path):
+    """ISSUE 20 satellite: delete and rename ride the SAME idempotent
+    geo path as upserts — their geo_ts/geo_sig stamp survives on a
+    tombstone carrier (the entry itself is gone), so a replicator killed
+    mid-destructive-apply and restarted from its durable cursor never
+    resurrects a deleted path, never double-applies a rename, and a full
+    replay from cursor 0 leaves the namespace bit-identical."""
+    tmp = str(tmp_path)
+
+    async def body():
+        from seaweedfs_tpu.replication.geo import GEO_TOMB_ROOT
+        from seaweedfs_tpu.util.metrics import GEO_TOMBSTONES
+
+        ma, va, fa = await _start_stack(tmp, "A", "dc-a")
+        mb = MasterServer(port=free_port_pair(), pulse_seconds=0.2)
+        await mb.start()
+        vdir = os.path.join(tmp, "B_vol")
+        os.makedirs(vdir, exist_ok=True)
+        vb = VolumeServer(
+            master=mb.address, directories=[vdir], port=free_port_pair(),
+            pulse_seconds=0.2, max_volume_counts=[20], data_center="dc-b",
+            rack="r1",
+        )
+        await vb.start()
+        for _ in range(200):
+            if len(mb.topo.data_nodes()) == 1:
+                break
+            await asyncio.sleep(0.05)
+        peer = Filer(MemoryFilerStore())
+        state = os.path.join(tmp, "geo.json")
+        http = FastHTTPClient(pool_per_host=8)
+        stub = Stub(grpc_address(fa.address), "filer")
+
+        def tombs():
+            with GEO_TOMBSTONES._lock:
+                return {
+                    dict(k).get("op"): v
+                    for k, v in GEO_TOMBSTONES._values.items()
+                }
+
+        try:
+            # seed: two files replicated clean, then stop the tail
+            for p in ("/t/dead.bin", "/t/move.bin"):
+                st, _ = await http.request(
+                    "PUT", fa.address, p, body=b"x" * 300,
+                    content_type="application/octet-stream", timeout=10.0,
+                )
+                assert st in (200, 201)
+            r0 = GeoReplicator(
+                fa.address, peer, mb.address, state,
+                data_center="dc-b", apply_deadline_s=10.0,
+            )
+            await r0.start()
+            for _ in range(400):
+                if (
+                    peer.find_entry("/t/dead.bin") is not None
+                    and peer.find_entry("/t/move.bin") is not None
+                ):
+                    break
+                await asyncio.sleep(0.025)
+            await r0.stop()
+            with open(state) as sf:
+                cursor_seed = int(json.load(sf)["since_ns"])
+            assert cursor_seed > 0
+
+            # the destructive pair lands on the PRIMARY while no tail runs
+            r = await stub.call(
+                "DeleteEntry",
+                {"directory": "/t", "name": "dead.bin",
+                 "is_recursive": False, "is_delete_data": True},
+                timeout=10.0,
+            )
+            assert not r.get("error"), r
+            r = await stub.call(
+                "AtomicRenameEntry",
+                {"old_directory": "/t", "old_name": "move.bin",
+                 "new_directory": "/t", "new_name": "moved.bin"},
+                timeout=10.0,
+            )
+            assert not r.get("error"), r
+
+            tb0 = tombs()
+            for point in ("pre_apply", "post_apply", "pre_ack"):
+                # rewind to the seed cursor EVERY round: the destructive
+                # pair replays repeatedly, each round through a crash at
+                # a different point — idempotence is what keeps the
+                # namespace from drifting
+                with open(state, "w") as sf:
+                    json.dump(
+                        {"since_ns": cursor_seed, "source": fa.address}, sf
+                    )
+                fired = []
+
+                def hook(p, _point=point, _fired=fired):
+                    if p == _point and not _fired:
+                        _fired.append(p)
+                        raise SimKill(p)
+
+                r1 = GeoReplicator(
+                    fa.address, peer, mb.address, state,
+                    data_center="dc-b", apply_deadline_s=10.0,
+                    kill_hook=hook,
+                )
+                await r1.start()
+                await _crash_and_reap(r1)
+
+                r2 = GeoReplicator(
+                    fa.address, peer, mb.address, state,
+                    data_center="dc-b", apply_deadline_s=10.0,
+                )
+                await r2.start()
+                for _ in range(400):
+                    if (
+                        r2.cursor_ns > cursor_seed
+                        and peer.find_entry("/t/moved.bin") is not None
+                    ):
+                        break
+                    await asyncio.sleep(0.025)
+                assert r2.cursor_ns > cursor_seed, f"{point}: never acked"
+                assert peer.find_entry("/t/dead.bin") is None, (
+                    f"{point}: deleted path resurrected"
+                )
+                assert peer.find_entry("/t/move.bin") is None, (
+                    f"{point}: renamed-away path resurrected"
+                )
+                moved = peer.find_entry("/t/moved.bin")
+                assert moved is not None, f"{point}: rename lost"
+                assert moved.extended.get(GEO_TS_KEY), "rename not stamped"
+                assert moved.extended.get(GEO_SIG_KEY), "rename not stamped"
+                # the stamp carrier outliving the entries: one tombstone
+                # per destroyed path, shielding replays
+                assert r2._tomb_ts("/t/dead.bin") > 0
+                assert r2._tomb_ts("/t/move.bin") > 0
+                await r2.stop()
+
+            tb1 = tombs()
+            assert tb1.get("delete", 0) > tb0.get("delete", 0)
+            assert tb1.get("rename", 0) > tb0.get("rename", 0)
+            fids = {c.fid for c in peer.find_entry("/t/moved.bin").chunks}
+
+            # the resurrection proof: a FULL replay from cursor 0 walks
+            # back through the original creates of both dead paths — the
+            # tombstones (their only surviving stamp) must shield them
+            with open(state, "w") as sf:
+                json.dump({"since_ns": 0, "source": fa.address}, sf)
+            r3 = GeoReplicator(
+                fa.address, peer, mb.address, state,
+                data_center="dc-b", apply_deadline_s=10.0,
+            )
+            await r3.start()
+            head = fa.filer.meta_log.last_ts_ns
+            for _ in range(400):
+                if r3.cursor_ns >= head:
+                    break
+                await asyncio.sleep(0.025)
+            assert r3.cursor_ns >= head, "full replay never caught up"
+            assert peer.find_entry("/t/dead.bin") is None, (
+                "full replay resurrected a deleted path past its tombstone"
+            )
+            assert peer.find_entry("/t/move.bin") is None, (
+                "full replay resurrected a renamed-away path"
+            )
+            moved = peer.find_entry("/t/moved.bin")
+            assert {c.fid for c in moved.chunks} == fids, (
+                "full replay re-shipped the renamed file's chunks"
+            )
+            assert r3.skipped >= 2  # the shielded creates were counted
+            # tombstones never leak into listings of the replicated tree
+            assert all(
+                not e.full_path.startswith(GEO_TOMB_ROOT)
+                for e in peer.list_entries("/t", "", True, 1000)
+            )
+            await r3.stop()
+        finally:
+            await http.close()
+            for srv in (fa, va, ma, vb, mb):
+                await srv.stop()
+            await close_all_channels()
+
+    asyncio.run(body())
+
+
 def test_metalog_trimmed_requires_full_resync(tmp_path):
     """A replicator whose cursor fell behind the primary's meta-log
     retention must halt and surface FULL RESYNC (counted + logged) —

@@ -1,0 +1,583 @@
+"""Metadata serving fleet (ISSUE 20): shard-range FLEETMAP routing
+units, the gate-batched write seam (coalescing, group-commit linger,
+per-item error isolation, store round economics), meta-log-fed read
+replicas (apply semantics, read-your-writes redirect, the staleness
+property across seeded crash/resume), the LSM-flush arena prefetch
+hint, and the acceptance e2e: a live range move between two real filer
+processes under concurrent traffic with zero misrouted/lost entries.
+"""
+
+import asyncio
+import os
+import random
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.entry import Attr, Entry, new_directory_entry
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.filer.filer_store import (
+    MemoryFilerStore,
+    SqliteFilerStore,
+)
+from seaweedfs_tpu.filer.fleet import (
+    FleetMap,
+    ancestor_dirs,
+    dir_of,
+    in_range,
+    read_fleet_map,
+    write_fleet_map,
+)
+from seaweedfs_tpu.filer.lsm_store import LsmFilerStore
+from seaweedfs_tpu.filer.meta_follower import MetaFollower
+from seaweedfs_tpu.filer.meta_gate import MetaWriteGate
+from seaweedfs_tpu.filer.meta_log import MetaLog
+from seaweedfs_tpu.filer.sharded_store import ShardedFilerStore
+
+
+def _e(path: str, v: str = "") -> Entry:
+    return Entry(
+        full_path=path, attr=Attr(mtime=1.0), extended={"v": v or path}
+    )
+
+
+# ---------------- routing units ----------------
+
+
+def test_dir_of_ancestors_and_range_semantics():
+    assert dir_of("/a/b/c") == "/a/b"
+    assert dir_of("/top") == "/"
+    assert dir_of("/") == "/"
+    assert ancestor_dirs("/a/b/c") == ["/a", "/a/b"]  # root excluded
+    # "" is the unbounded side on BOTH ends; hi is exclusive
+    assert in_range("/m", "", "")
+    assert in_range("/m", "/a", "/n")
+    assert not in_range("/n", "/a", "/n")
+    assert in_range("/a", "/a", "/n")
+    assert not in_range("/0", "/a", "")
+    assert in_range("/z", "/a", "")
+
+
+def test_fleet_map_owner_ranges_and_roundtrip():
+    addrs = ["h0:1", "h1:1", "h2:1"]
+    m = FleetMap(addrs, bounds=["/g", "/q"], epoch=7)
+    assert m.owner_for_dir("/a") == "h0:1"
+    assert m.owner_for_dir("/g") == "h1:1"  # bound belongs to the right
+    assert m.owner_for_dir("/p/x") == "h1:1"
+    assert m.owner_for_dir("/q") == "h2:1"
+    assert m.range_of(0) == ("", "/g")
+    assert m.range_of(1) == ("/g", "/q")
+    assert m.range_of(2) == ("/q", "")
+    m2 = FleetMap.from_dict(m.to_dict())
+    assert m2.addresses == addrs and m2.bounds == ["/g", "/q"]
+    assert m2.epoch == 7
+    # every directory resolves to exactly one owner
+    for d in ("/", "/a", "/g", "/g/x", "/p", "/q", "/zz"):
+        owners = [
+            i for i in range(3) if in_range(d, *m.range_of(i))
+        ]
+        assert owners == [m.index_for_dir(d)], d
+
+
+def test_fleet_map_write_is_crash_safe(tmp_path):
+    p = str(tmp_path / "FLEETMAP")
+    write_fleet_map(p, FleetMap(["a:1", "b:1"], bounds=["/m"], epoch=1))
+    write_fleet_map(p, FleetMap(["a:1", "b:1"], bounds=["/k"], epoch=2))
+    # a torn shadow from a crashed writer must not poison readers
+    with open(p + ".tmp", "w") as f:
+        f.write('{"addresses": ["a:1"')
+    m = read_fleet_map(p)
+    assert m.epoch == 2 and m.bounds == ["/k"]
+
+
+# ---------------- the write gate ----------------
+
+
+def test_write_gate_coalesces_a_concurrent_burst():
+    store = MemoryFilerStore()
+    gate = MetaWriteGate(store, linger_s=0.002)
+
+    async def body():
+        r0 = store.write_rounds
+        await asyncio.gather(
+            *(gate.insert(_e(f"/b/f{i:03d}")) for i in range(200))
+        )
+        rounds = store.write_rounds - r0
+        assert rounds < 50, rounds  # O(wakeups), not O(objects)
+        assert gate.stats["writes"] == 200
+        assert gate.stats["batches"] == rounds
+        assert gate.stats["largest_batch"] > 1
+        for i in range(200):
+            assert store.find_entry(f"/b/f{i:03d}") is not None
+
+    asyncio.run(body())
+    gate.close()
+
+
+def test_write_gate_last_write_wins_keeps_final_state():
+    store = MemoryFilerStore()
+    gate = MetaWriteGate(store)
+
+    async def body():
+        await asyncio.gather(
+            gate.insert(_e("/b/f", "v1")),
+            gate.insert(_e("/b/f", "v2")),
+            gate.insert_many([_e("/b", "dir"), _e("/b/f", "v3")]),
+        )
+        assert store.find_entry("/b/f").extended["v"] == "v3"
+        assert store.find_entry("/b").extended["v"] == "dir"
+        assert gate.stats["coalesced"] >= 2
+
+    asyncio.run(body())
+    gate.close()
+
+
+def test_write_gate_isolates_poisoned_entries():
+    class PoisonStore(MemoryFilerStore):
+        def insert_many(self, entries):
+            raise RuntimeError("batch arm poisoned")
+
+        def insert_entry(self, e):
+            if e.full_path.endswith("/bad"):
+                raise RuntimeError("poisoned entry")
+            return super().insert_entry(e)
+
+    store = PoisonStore()
+    gate = MetaWriteGate(store)
+
+    async def body():
+        results = await asyncio.gather(
+            *(gate.insert(_e(f"/b/f{i}")) for i in range(9)),
+            gate.insert(_e("/b/bad")),
+            return_exceptions=True,
+        )
+        # one bad entry fails ONLY its own caller
+        assert sum(1 for r in results if isinstance(r, Exception)) == 1
+        assert isinstance(results[-1], RuntimeError)
+        for i in range(9):
+            assert store.find_entry(f"/b/f{i}") is not None
+        assert store.find_entry("/b/bad") is None
+        assert gate.stats["item_retries"] == 10
+
+    asyncio.run(body())
+    gate.close()
+
+
+def test_write_gate_linger_is_adaptive():
+    """Group commit engages only under concurrency: sequential single
+    writes never pay the linger; a concurrent burst does, and that is
+    what turns per-tick batches of ~1 into real coalescing."""
+    store = MemoryFilerStore()
+    gate = MetaWriteGate(store, linger_s=0.002)
+
+    async def sequential():
+        for i in range(20):
+            await gate.insert(_e(f"/s/f{i}"))
+
+    asyncio.run(sequential())
+    assert gate.stats["lingered_batches"] == 0
+    assert gate.stats["batches"] == 20
+
+    async def burst():
+        await asyncio.gather(
+            *(gate.insert(_e(f"/c/f{i}")) for i in range(100))
+        )
+        # the burst is over: the next lone write lingers at most once,
+        # then the gate is back to zero-latency scheduling
+        await gate.insert(_e("/s/after"))
+        await gate.insert(_e("/s/after2"))
+
+    asyncio.run(burst())
+    assert gate.stats["lingered_batches"] > 0
+    assert gate.stats["largest_batch"] > 1
+    gate.close()
+
+
+def test_write_gate_close_fails_parked_writes():
+    gate = MetaWriteGate(MemoryFilerStore())
+
+    async def body():
+        loop = asyncio.get_running_loop()
+        fut = gate._enqueue((_e("/x"),))
+        gate.close()
+        with pytest.raises(LookupError):
+            await fut
+        del loop
+
+    asyncio.run(body())
+
+
+def test_insert_many_round_economics_every_store_kind(tmp_path):
+    """The seam the write gate rides: one insert_many batch costs one
+    store round (<= one per shard for the sharded store) where
+    per-entry writes cost one EACH — >=4x fewer rounds by construction,
+    with identical resulting state."""
+
+    def sqlite_factory(name):
+        return SqliteFilerStore(str(tmp_path / f"sh-{name}.db"))
+
+    stores = {
+        "memory": MemoryFilerStore(),
+        "sqlite": SqliteFilerStore(str(tmp_path / "one.db")),
+        "lsm": LsmFilerStore(str(tmp_path / "lsm"), fsync=False),
+        "sharded": ShardedFilerStore(
+            str(tmp_path / "shards"), sqlite_factory, 4
+        ),
+    }
+    for kind, store in stores.items():
+        r0 = store.write_rounds
+        for i in range(100):
+            store.insert_entry(_e(f"/p/f{i:03d}"))
+        per_entry = store.write_rounds - r0
+        r1 = store.write_rounds
+        store.insert_many([_e(f"/q/f{i:03d}") for i in range(100)])
+        batched = store.write_rounds - r1
+        assert per_entry == 100, kind
+        assert batched <= 4, (kind, batched)
+        assert per_entry / batched >= 4, kind
+        for i in range(100):
+            assert store.find_entry(f"/q/f{i:03d}") is not None, kind
+    for store in stores.values():
+        close = getattr(store, "close", None)
+        if close:
+            close()
+
+
+# ---------------- the follower (meta-log-fed read replica) ----------------
+
+
+def _mk_primary():
+    primary = Filer(MemoryFilerStore(), meta_log=MetaLog())
+    return primary
+
+
+def test_follower_applies_create_update_rename_delete(tmp_path):
+    primary = _mk_primary()
+    replica = Filer(MemoryFilerStore(), meta_log=MetaLog())
+    fol = MetaFollower(
+        "", replica, str(tmp_path / "cursor.json"),
+        source_log=primary.meta_log, head_check_s=0.02,
+    )
+
+    async def body():
+        await fol.start()
+        primary.create_entry(_e("/a/f1", "v1"))
+        primary.create_entry(_e("/a/f2", "v1"))
+        primary.update_entry(_e("/a/f1", "v2"))
+        primary.rename("/a/f2", "/a/f3")
+        primary.delete_entry("/a/f1")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if (
+                replica.find_entry("/a/f1") is None
+                and replica.find_entry("/a/f2") is None
+                and replica.find_entry("/a/f3") is not None
+            ):
+                break
+            await asyncio.sleep(0.01)
+        assert replica.find_entry("/a/f1") is None
+        assert replica.find_entry("/a/f2") is None
+        assert replica.find_entry("/a/f3").extended["v"] == "v1"
+        assert fol.applied >= 5
+        await fol.stop()
+
+    asyncio.run(body())
+
+
+def test_follower_redirects_read_your_writes(tmp_path):
+    primary = _mk_primary()
+    replica = Filer(MemoryFilerStore(), meta_log=MetaLog())
+    fol = MetaFollower(
+        "primary:8888", replica, str(tmp_path / "cursor.json"),
+        source_log=primary.meta_log,
+    )
+    # a client holding a write watermark ahead of the tail cursor gets
+    # a counted redirect, never a stale answer
+    resp = fol.gate_read({"min_ts_ns": 2**62})
+    assert resp["error"] == "redirect"
+    assert resp["primary"] == "primary:8888"
+    assert fol.redirects == 1
+    # an old (or absent) watermark is served locally
+    assert fol.gate_read({"min_ts_ns": 0}) is None
+    assert fol.gate_read({}) is None
+    assert fol.redirects == 1
+
+
+def test_follower_staleness_bound_property_with_crash_resume(tmp_path):
+    """ISSUE 20 satellite: at ANY probe time, every primary write older
+    than the DISCLOSED staleness bound must already be visible on the
+    follower — across seeded crash/resume of the tail cursor. The bound
+    may be loose (a resuming follower discloses a huge lag); it must
+    never be tight enough to hide a write it has not applied."""
+    rng = random.Random(2020)
+    primary = _mk_primary()
+    replica = Filer(MemoryFilerStore(), meta_log=MetaLog())
+    state = str(tmp_path / "cursor.json")
+    versions: dict = {}  # path -> (version, wall_s of the write)
+    paths = [f"/p/f{i}" for i in range(12)]
+
+    def write_round():
+        for _ in range(rng.randrange(3, 9)):
+            p = rng.choice(paths)
+            v = versions.get(p, (0, 0.0))[0] + 1
+            primary.create_entry(_e(p, f"v{v}"))
+            # the meta log stamps with time_ns: use ITS clock so the
+            # probe compares likes with likes
+            versions[p] = (v, primary.meta_log.last_ts_ns / 1e9)
+
+    def probe(fol):
+        now = time.time()
+        bound = fol.staleness_bound_s()
+        for p, (v, wall) in versions.items():
+            if now - wall <= bound + 0.05:  # within the disclosed lag
+                continue
+            got = replica.find_entry(p)
+            assert got is not None and got.extended["v"] == f"v{v}", (
+                f"{p}: write v{v} at {now - wall:.3f}s ago is OUTSIDE "
+                f"the disclosed bound {bound:.3f}s yet not visible"
+            )
+
+    async def body():
+        fol = MetaFollower(
+            "", replica, state,
+            source_log=primary.meta_log, head_check_s=0.02,
+        )
+        await fol.start()
+        for _round in range(10):
+            write_round()
+            if rng.random() < 0.4:  # crash: drop the tail mid-stream
+                await fol.stop()
+                write_round()  # writes land while the follower is down
+                probe(fol)  # the stopped follower's bound must widen
+                fol = MetaFollower(  # resume from the durable cursor
+                    "", replica, state,
+                    source_log=primary.meta_log, head_check_s=0.02,
+                )
+                await fol.start()
+            await asyncio.sleep(rng.uniform(0.02, 0.08))
+            probe(fol)
+        # convergence: the tail drains and the replica equals primary
+        deadline = time.monotonic() + 5.0
+        while (
+            fol.cursor_ns < primary.meta_log.last_ts_ns
+            and time.monotonic() < deadline
+        ):
+            await asyncio.sleep(0.01)
+        for p, (v, _wall) in versions.items():
+            assert replica.find_entry(p).extended["v"] == f"v{v}"
+        assert fol.staleness_bound_s() < 5.0
+        await fol.stop()
+
+    asyncio.run(body())
+
+
+# ---------------- arena prefetch on LSM flush ----------------
+
+
+def test_lsm_flush_prefetches_into_live_arena(tmp_path, monkeypatch):
+    """ISSUE 20 satellite (PR 18 follow-up): sealing a run offers it to
+    the process arena right away — counted, never store-fatal, and
+    never the thing that first CREATES an arena."""
+    from seaweedfs_tpu.ops import ragged_lookup
+    from seaweedfs_tpu.util.metrics import ARENA_PREFETCH
+
+    def total():
+        with ARENA_PREFETCH._lock:
+            return sum(ARENA_PREFETCH._values.values())
+
+    # no arena live: the hint counts no_arena and allocates nothing
+    monkeypatch.setattr(ragged_lookup, "_DEFAULT", None)
+    c0 = total()
+    s1 = LsmFilerStore(
+        str(tmp_path / "cold"), memtable_limit=10, fsync=False
+    )
+    for i in range(25):
+        s1.insert_entry(_e(f"/a/f{i:02d}"))
+    s1.close()
+    assert total() > c0
+    assert ragged_lookup._DEFAULT is None  # peek, not get
+
+    arena = ragged_lookup.DeviceColumnArena()
+    monkeypatch.setattr(ragged_lookup, "_DEFAULT", arena)
+    c1 = total()
+    s2 = LsmFilerStore(
+        str(tmp_path / "warm"), memtable_limit=10, fsync=False
+    )
+    try:
+        for i in range(25):
+            s2.insert_entry(_e(f"/b/f{i:02d}"))
+        assert total() > c1
+        # sealed runs are registered with the arena by the flush path
+        assert arena.stats()["registered_segments"] >= 1
+    finally:
+        s2.close()
+        arena.close()
+
+
+# ---------------- e2e: real processes ----------------
+
+
+def test_fleet_move_range_live_traffic_zero_lost(tmp_path):
+    """THE acceptance e2e: two real filer processes, a prefix-range
+    rebalanced between them while writers keep writing through BOTH
+    members (so half the traffic hits a stale-routed member on purpose
+    and must be forwarded server-side), then every written entry is
+    read back identity-checked through BOTH members — zero misrouted,
+    zero lost."""
+    from seaweedfs_tpu.ops.proc_cluster import ProcCluster
+    from seaweedfs_tpu.pb import grpc_address
+    from seaweedfs_tpu.pb.rpc import Stub, new_channel
+
+    with ProcCluster(
+        str(tmp_path / "c"), volumes=0, filers=2,
+        fleet=True, fleet_bounds=["/m"],
+    ) as c:
+        a0, a1 = c.address("filer-0"), c.address("filer-1")
+
+        async def body():
+            chans = [new_channel(grpc_address(a)) for a in (a0, a1)]
+            s0 = Stub(grpc_address(a0), "filer", channel=chans[0])
+            s1 = Stub(grpc_address(a1), "filer", channel=chans[1])
+            written: dict = {}
+            errors: list = []
+            stop = asyncio.Event()
+
+            async def writer(idx: int):
+                i = 0
+                while not stop.is_set():
+                    p = f"/g/d{idx}/f{i:05d}"  # in the range that moves
+                    stub = s0 if (i + idx) % 2 == 0 else s1
+                    r = await stub.call(
+                        "CreateEntry",
+                        {"entry": {
+                            "full_path": p,
+                            "attr": {"mtime": 1.0, "crtime": 1.0},
+                            "extended": {"etag": p[-9:]},
+                        }},
+                        timeout=30.0,
+                    )
+                    if r.get("error"):
+                        errors.append((p, r["error"]))
+                    else:
+                        written[p] = p[-9:]
+                    i += 1
+                    await asyncio.sleep(0.003)
+
+            writers = [
+                asyncio.ensure_future(writer(k)) for k in range(2)
+            ]
+            await asyncio.sleep(0.5)
+            pre = len(written)
+            # move [/g, /m) from member 0 to its right neighbor while
+            # the writers keep going
+            mv = await s0.call(
+                "FleetMoveRange",
+                {"dst": a1, "lo": "/g", "hi": "/m"},
+                timeout=120.0,
+            )
+            assert not mv.get("error"), mv
+            await asyncio.sleep(0.4)
+            stop.set()
+            await asyncio.gather(*writers)
+            assert not errors, errors[:3]
+            assert pre > 0 and len(written) > pre  # traffic spanned it
+            # identity through BOTH members: the new owner serves, the
+            # old owner forwards — nobody answers from a stale copy
+            for p, tag in written.items():
+                d, name = p.rsplit("/", 1)
+                for stub in (s0, s1):
+                    r = await stub.call(
+                        "LookupDirectoryEntry",
+                        {"directory": d, "name": name},
+                        timeout=30.0,
+                    )
+                    e = r.get("entry")
+                    assert e is not None, (p, "lost")
+                    assert e["extended"]["etag"] == tag, (p, "mangled")
+            st0 = await s0.call("FleetStatus", {}, timeout=10.0)
+            st1 = await s1.call("FleetStatus", {}, timeout=10.0)
+            assert st0["fleet"]["counters"]["moves_committed"] == 1
+            assert st1["fleet"]["epoch"] >= 2
+            # committed ownership: /g now belongs to member 1
+            m = FleetMap.from_dict(st1["fleet"]["map"])
+            assert m.owner_for_dir("/g/d0") == a1
+            assert m.pending_move is None and m.pending_cleanup is None
+            for ch in chans:
+                await ch.close()
+
+        asyncio.run(body())
+
+
+def test_follower_process_serves_and_redirects(tmp_path):
+    """Read-replica e2e over real processes: a follower filer tails the
+    primary's meta stream, serves the tailed namespace, discloses its
+    staleness bound, and redirects reads carrying a write watermark it
+    has not caught up to."""
+    from seaweedfs_tpu.ops.proc_cluster import ProcCluster
+    from seaweedfs_tpu.pb import grpc_address
+    from seaweedfs_tpu.pb.rpc import Stub, new_channel
+
+    with ProcCluster(
+        str(tmp_path / "c"), volumes=0, filers=1, followers=1,
+    ) as c:
+        ap, af = c.address("filer-0"), c.address("follower-0")
+
+        async def body():
+            chans = [new_channel(grpc_address(a)) for a in (ap, af)]
+            sp = Stub(grpc_address(ap), "filer", channel=chans[0])
+            sf = Stub(grpc_address(af), "filer", channel=chans[1])
+            paths = [f"/r/f{i:03d}" for i in range(40)]
+            ts = 0
+            for p in paths:
+                r = await sp.call(
+                    "CreateEntry",
+                    {"entry": {
+                        "full_path": p,
+                        "attr": {"mtime": 1.0, "crtime": 1.0},
+                        "extended": {"etag": p[-9:]},
+                    }},
+                    timeout=30.0,
+                )
+                assert not r.get("error"), r
+                ts = max(ts, int(r.get("ts_ns", 0)))
+            assert ts > 0  # write responses carry the log watermark
+            # the tail catches up and the follower serves identically
+            deadline = time.monotonic() + 15.0
+            seen = None
+            while time.monotonic() < deadline:
+                r = await sf.call(
+                    "LookupDirectoryEntry",
+                    {"directory": "/r", "name": "f039"},
+                    timeout=10.0,
+                )
+                seen = r.get("entry")
+                if seen is not None:
+                    break
+                await asyncio.sleep(0.05)
+            assert seen is not None and seen["extended"]["etag"] == (
+                paths[-1][-9:]
+            )
+            lst = await sf.call(
+                "ListEntries", {"directory": "/r", "limit": 100},
+                timeout=10.0,
+            )
+            assert len(lst["entries"]) == len(paths)
+            st = await sf.call("FleetStatus", {}, timeout=10.0)
+            fs = st["follower"]
+            assert fs["cursor_ns"] >= ts
+            assert fs["staleness_bound_s"] >= 0.0
+            assert fs["applied"] >= len(paths)
+            assert fs["resync_required"] is False
+            # read-your-writes: a watermark from the future redirects
+            r = await sf.call(
+                "LookupDirectoryEntry",
+                {"directory": "/r", "name": "f000",
+                 "min_ts_ns": 2**62},
+                timeout=10.0,
+            )
+            assert r.get("error") == "redirect"
+            assert r.get("primary") == ap
+            for ch in chans:
+                await ch.close()
+
+        asyncio.run(body())

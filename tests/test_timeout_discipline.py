@@ -30,6 +30,14 @@ turns a brownout into an outage). Three request layers, three checks:
   deadline is a per-call policy decision, and the scan makes omitting
   it visible); streaming/session-bounded shapes go on
   `REPLICATION_DEADLINE_ALLOWLIST` with the bound they rely on.
+- **fleet plane (ISSUE 20 satellite)**: the filer-to-filer call sites
+  — `filer/fleet.py` (forward/ingest/move ladder) and
+  `filer/meta_follower.py` (replica tail + head probe) — run inside
+  request handlers and background move/tail loops where a hung peer
+  member wedges the whole range migration or the follower forever.
+  Same rule as replication/: every `.call(` / `.request(` /
+  `retry_async(` / `server_stream(` carries an EXPLICIT `timeout=` or
+  `deadline=`, with streaming shapes on `FLEET_DEADLINE_ALLOWLIST`.
 
 AST-based, so string matches in comments/docstrings cannot false-
 positive and a violation reports file:line.
@@ -76,6 +84,20 @@ REPLICATION_DEADLINE_ALLOWLIST: dict = {
         "SubscribeMetadata tail: the stream's lifetime IS the "
         "replication session — liveness is owned by the reconnect "
         "loop's backoff policy, not a per-call deadline"
+    ),
+}
+
+# filer-to-filer call sites (ISSUE 20): same discipline, fleet files.
+FLEET_SCAN_FILES = (
+    os.path.join("filer", "fleet.py"),
+    os.path.join("filer", "meta_follower.py"),
+)
+FLEET_DEADLINE_ALLOWLIST: dict = {
+    (os.path.join("filer", "meta_follower.py"), "server_stream"): (
+        "SubscribeMetadata tail: the follower's stream lives as long "
+        "as the primary feeds it — liveness is owned by the reconnect "
+        "loop's backoff policy (RECONNECT_POLICY), not a per-call "
+        "deadline"
     ),
 }
 
@@ -166,6 +188,27 @@ def _scan() -> list:
                         "own bound (or be allowlisted with the bound "
                         "they rely on)"
                     )
+            if rel in FLEET_SCAN_FILES and name in (
+                "call",
+                "request",
+                "retry_async",
+                "server_stream",
+            ):
+                # filer-to-filer calls (forward, ingest, move ladder,
+                # follower head probe): a hung peer member must not
+                # wedge a migration or the replica tail
+                if (
+                    "timeout" not in kw
+                    and "deadline" not in kw
+                    and (rel, name) not in FLEET_DEADLINE_ALLOWLIST
+                ):
+                    violations.append(
+                        f"{rel}:{node.lineno}: {name}() on the fleet "
+                        "plane without an explicit timeout=/deadline= "
+                        "— filer-to-filer calls must carry their own "
+                        "bound (or be allowlisted with the bound they "
+                        "rely on)"
+                    )
             if (
                 name == "subscribe"
                 and isinstance(node.func, ast.Attribute)
@@ -230,6 +273,7 @@ def test_allowlist_entries_are_live():
         list(TIMEOUT_NONE_ALLOWLIST)
         + list(SUBSCRIBE_STOPPED_ALLOWLIST)
         + list(REPLICATION_DEADLINE_ALLOWLIST)
+        + list(FLEET_DEADLINE_ALLOWLIST)
     ):
         assert os.path.exists(os.path.join(ROOT, rel)), (
             f"stale allowlist entry: {rel}"
